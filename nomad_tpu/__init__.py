@@ -1,0 +1,20 @@
+"""nomad_tpu — a TPU-native workload orchestrator.
+
+A brand-new framework with the capabilities of HashiCorp Nomad
+(reference: conorevans/nomad): Raft-style replicated control plane,
+feasibility/scoring schedulers, node agents with pluggable task drivers —
+with the server-side placement loop reformulated as a batched
+constraint-satisfaction solve in JAX/XLA on TPU.
+
+Layer map (mirrors reference layers, see SURVEY.md §1):
+  structs/    shared data model + fit/scoring math (ref: nomad/structs/)
+  state/      in-memory MVCC state store (ref: nomad/state/)
+  scheduler/  CPU-reference schedulers, reconciler, stacks (ref: scheduler/)
+  solver/     TPU batched placement solver (the north star; no ref equivalent)
+  server/     control plane: broker, planner, workers, raft (ref: nomad/)
+  client/     node agent: runners, fingerprint, drivers (ref: client/, drivers/)
+  agent/      combined agent + HTTP API (ref: command/agent/)
+  cli/        command line (ref: command/)
+"""
+
+__version__ = "0.1.0"

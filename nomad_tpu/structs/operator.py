@@ -1,0 +1,46 @@
+"""Operator-mutable scheduler configuration (ref nomad/structs/operator.go:131-180).
+
+This is the extension point where the TPU solver registers as a scheduler
+algorithm alongside classic binpack/spread: SURVEY.md north star.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SCHED_ALG_BINPACK = "binpack"
+SCHED_ALG_SPREAD = "spread"
+SCHED_ALG_TPU = "tpu-batch"   # the new one: batched JAX/XLA solve
+
+VALID_SCHEDULER_ALGORITHMS = (SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU)
+
+
+@dataclass
+class PreemptionConfig:
+    """Per-scheduler preemption toggles (ref operator.go PreemptionConfig)."""
+    system_scheduler_enabled: bool = True
+    sysbatch_scheduler_enabled: bool = False
+    batch_scheduler_enabled: bool = False
+    service_scheduler_enabled: bool = False
+
+
+@dataclass
+class SchedulerConfiguration:
+    """Raft-replicated, runtime-mutable scheduler config
+    (ref operator.go:144, set via /v1/operator/scheduler/configuration)."""
+    scheduler_algorithm: str = SCHED_ALG_BINPACK
+    preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
+    memory_oversubscription_enabled: bool = False
+    reject_job_registration: bool = False
+    pause_eval_broker: bool = False
+    create_index: int = 0
+    modify_index: int = 0
+
+    def effective_scheduler_algorithm(self) -> str:
+        """ref operator.go:164 EffectiveSchedulerAlgorithm"""
+        return self.scheduler_algorithm or SCHED_ALG_BINPACK
+
+    def validate(self) -> str:
+        if self.scheduler_algorithm not in VALID_SCHEDULER_ALGORITHMS:
+            return (f"invalid scheduler algorithm {self.scheduler_algorithm!r}; "
+                    f"must be one of {VALID_SCHEDULER_ALGORITHMS}")
+        return ""

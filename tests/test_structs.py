@@ -1,0 +1,197 @@
+"""Data-model tests (modeled on nomad/structs/funcs_test.go and
+structs_test.go behaviors)."""
+import math
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation, AllocatedResources, AllocatedTaskResources, ComparableResources,
+    NetworkIndex, NetworkResource, Port, allocs_fit, score_fit_binpack,
+    score_fit_spread, parse_port_spec, alloc_name, alloc_name_index,
+    ALLOC_CLIENT_COMPLETE, ALLOC_DESIRED_STOP,
+)
+
+
+def test_score_fit_binpack_extremes():
+    node = mock.node()
+    # empty utilization => worst binpack score 0 (20 - 10^1 - 10^1)
+    empty = ComparableResources()
+    assert score_fit_binpack(node, empty) == 0.0
+    # full utilization => best score 18
+    full = ComparableResources(
+        cpu_shares=node.node_resources.cpu.cpu_shares - node.reserved_resources.cpu_shares,
+        memory_mb=node.node_resources.memory.memory_mb - node.reserved_resources.memory_mb)
+    assert abs(score_fit_binpack(node, full) - 18.0) < 1e-9
+    # spread is the inverse
+    assert abs(score_fit_spread(node, empty) - 18.0) < 1e-9
+    assert score_fit_spread(node, full) == 0.0
+
+
+def test_score_fit_binpack_mid():
+    node = mock.node()
+    half = ComparableResources(
+        cpu_shares=(node.node_resources.cpu.cpu_shares - node.reserved_resources.cpu_shares) // 2,
+        memory_mb=(node.node_resources.memory.memory_mb - node.reserved_resources.memory_mb) // 2)
+    expected = 20.0 - 2 * math.pow(10, 0.5)
+    assert abs(score_fit_binpack(node, half) - expected) < 1e-9
+
+
+def test_allocs_fit_basic():
+    node = mock.node()
+    job = mock.job()
+    a = mock.alloc_for(job, node)
+    fit, dim, used = allocs_fit(node, [a])
+    assert fit, dim
+    assert used.cpu_shares == 500
+    assert used.memory_mb == 256
+
+
+def test_allocs_fit_overcommit_cpu():
+    node = mock.node()
+    big = Allocation(
+        allocated_resources=AllocatedResources(
+            tasks={"t": AllocatedTaskResources(cpu_shares=10000, memory_mb=10)}))
+    fit, dim, _ = allocs_fit(node, [big])
+    assert not fit and dim == "cpu"
+
+
+def test_allocs_fit_ignores_terminal():
+    node = mock.node()
+    job = mock.job()
+    a1 = mock.alloc_for(job, node)
+    a2 = mock.alloc_for(job, node, 1)
+    a2.desired_status = ALLOC_DESIRED_STOP
+    fit, _, used = allocs_fit(node, [a1, a2])
+    assert fit
+    assert used.cpu_shares == 500  # terminal a2 not counted
+
+
+def test_allocs_fit_core_overlap():
+    node = mock.node()
+    a1 = Allocation(allocated_resources=AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu_shares=100, memory_mb=10,
+                                           reserved_cores=(0, 1))}))
+    a2 = Allocation(allocated_resources=AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu_shares=100, memory_mb=10,
+                                           reserved_cores=(1, 2))}))
+    fit, dim, _ = allocs_fit(node, [a1, a2])
+    assert not fit and dim == "cores"
+
+
+def test_allocs_fit_memory_oversubscription_claim():
+    node = mock.node()
+    # memory_max is the claim when above memory
+    a = Allocation(allocated_resources=AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu_shares=100, memory_mb=100,
+                                           memory_max_mb=100000)}))
+    fit, dim, _ = allocs_fit(node, [a])
+    assert not fit and dim == "memory"
+
+
+def test_network_index_ports():
+    node = mock.node()
+    idx = NetworkIndex()
+    assert not idx.set_node(node)
+    # reserved port 22 from node reservation is taken
+    assert idx.used_ports["192.168.0.100"].check(22)
+    ask = NetworkResource(mbits=50,
+                          reserved_ports=[Port(label="ssh", value=2222)],
+                          dynamic_ports=[Port(label="http")])
+    offer, err = idx.assign_network(ask)
+    assert err == "" and offer is not None
+    assert offer.reserved_ports[0].value == 2222
+    assert 20000 <= offer.dynamic_ports[0].value <= 32000
+
+    # colliding static port fails
+    idx.add_reserved(offer)
+    offer2, err2 = idx.assign_network(
+        NetworkResource(reserved_ports=[Port(label="x", value=2222)]))
+    assert offer2 is None and "collision" in err2
+
+
+def test_network_index_bandwidth_overcommit():
+    node = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(node)
+    ask = NetworkResource(mbits=600)
+    offer, err = idx.assign_network(ask)
+    assert err == ""
+    idx.add_reserved(offer)
+    offer2, err2 = idx.assign_network(NetworkResource(mbits=600))
+    assert offer2 is None and err2 == "bandwidth exceeded"
+
+
+def test_parse_port_spec():
+    assert parse_port_spec("22,80,8000-8002") == [22, 80, 8000, 8001, 8002]
+    assert parse_port_spec("") == []
+
+
+def test_alloc_name_roundtrip():
+    name = alloc_name("job1", "web", 7)
+    assert name == "job1.web[7]"
+    assert alloc_name_index(name) == 7
+    assert alloc_name_index("garbage") == -1
+
+
+def test_alloc_terminal_status():
+    a = mock.alloc()
+    assert not a.terminal_status()
+    a.client_status = ALLOC_CLIENT_COMPLETE
+    assert a.terminal_status()
+    assert a.client_terminal_status()
+
+
+def test_computed_node_class_stable():
+    n1 = mock.node()
+    n2 = mock.node()
+    # different unique names/ids, same class-relevant fields (names differ but
+    # name isn't class-relevant; http_addr isn't hashed)
+    assert n1.computed_class == n2.computed_class
+    n2.attributes["kernel.name"] = "windows"
+    n2.compute_class()
+    assert n1.computed_class != n2.computed_class
+
+
+def test_reschedule_backoff():
+    a = mock.alloc()
+    from nomad_tpu.structs import ReschedulePolicy, RescheduleTracker, RescheduleEvent
+    pol = ReschedulePolicy(delay_sec=10, delay_function="exponential",
+                           max_delay_sec=300, unlimited=True)
+    assert a.reschedule_delay(pol) == 10
+    a.reschedule_tracker = RescheduleTracker(events=[RescheduleEvent()] * 3)
+    assert a.reschedule_delay(pol) == 80
+    a.reschedule_tracker = RescheduleTracker(events=[RescheduleEvent()] * 10)
+    assert a.reschedule_delay(pol) == 300  # capped
+
+
+def test_allocs_fit_reserved_cores_place():
+    # regression: an alloc asking for reserved cores must fit on a node with
+    # reservable cores (node comparable carries its reservable core set)
+    node = mock.node()
+    a = Allocation(allocated_resources=AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu_shares=100, memory_mb=10,
+                                           reserved_cores=(0, 1))}))
+    fit, dim, _ = allocs_fit(node, [a])
+    assert fit, dim
+
+
+def test_memory_max_fallback_in_add():
+    # regression: summing an alloc with memory_max and one without must count
+    # the latter's memory toward the oversubscription claim
+    node = mock.node()  # 8192 - 256 = 7936 usable
+    a1 = Allocation(allocated_resources=AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu_shares=10, memory_mb=100,
+                                           memory_max_mb=4000)}))
+    a2 = Allocation(allocated_resources=AllocatedResources(
+        tasks={"t": AllocatedTaskResources(cpu_shares=10, memory_mb=7000)}))
+    fit, dim, _ = allocs_fit(node, [a1, a2])
+    assert not fit and dim == "memory"  # claim 4000+7000 > 7936
+
+
+def test_bitmap_free_count_vectorized():
+    from nomad_tpu.structs import Bitmap
+    bm = Bitmap()
+    for p in (20000, 20063, 20064, 25000):
+        bm.set(p)
+    assert bm.free_count(20000, 32000) == 12001 - 4
+    assert bm.free_count(20001, 20062) == 62
+    assert bm.free_count(25000, 25000) == 0
