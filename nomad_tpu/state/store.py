@@ -1,0 +1,804 @@
+"""In-memory MVCC state store (ref nomad/state/state_store.go, schema.go).
+
+Design: every stored object is treated as immutable once inserted — writers
+insert fresh copies stamped with a monotonically increasing raft-style index,
+so a snapshot is just a shallow copy of the table dicts taken under the write
+lock. That gives the two correctness properties the scheduler hinges on
+(SURVEY.md §7.2):
+
+  * `snapshot()` — a point-in-time, never-changing view (memdb MVCC analog);
+  * `snapshot_min_index(i)` — block until the store has applied index >= i,
+    then snapshot (ref nomad/worker.go:536, plan_apply.go:184).
+
+Blocking queries are built on one condition variable broadcast per commit
+(watch-set analog of go-memdb). Secondary indexes (allocs by node/job/eval,
+evals by job) are plain dicts maintained transactionally with the write lock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from ..structs import (
+    Allocation, Deployment, Evaluation, Job, Node, SchedulerConfiguration,
+    ALLOC_CLIENT_LOST, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_PENDING, ALLOC_CLIENT_UNKNOWN,
+    ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT,
+    EVAL_STATUS_BLOCKED, JOB_STATUS_DEAD, JOB_STATUS_PENDING,
+    JOB_STATUS_RUNNING, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM, JOB_TYPE_SYSBATCH,
+    NODE_STATUS_DOWN,
+)
+from ..structs.summary import JobSummary, TaskGroupSummary
+
+
+class StateStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._index = 0                       # latest applied index
+        self._table_index: dict[str, int] = {}
+
+        # primary tables: key -> object
+        self.nodes: dict[str, Node] = {}
+        self.jobs: dict[tuple[str, str], Job] = {}            # (ns, id)
+        self.job_versions: dict[tuple[str, str, int], Job] = {}
+        self.job_summaries: dict[tuple[str, str], JobSummary] = {}
+        self.evals: dict[str, Evaluation] = {}
+        self.allocs: dict[str, Allocation] = {}
+        self.deployments: dict[str, Deployment] = {}
+        self.periodic_launches: dict[tuple[str, str], dict] = {}
+        self.scheduler_config: SchedulerConfiguration = SchedulerConfiguration()
+        self.namespaces: dict[str, dict] = {"default": {"name": "default"}}
+
+        # secondary indexes
+        self._allocs_by_node: dict[str, set[str]] = {}
+        self._allocs_by_job: dict[tuple[str, str], set[str]] = {}
+        self._allocs_by_eval: dict[str, set[str]] = {}
+        self._evals_by_job: dict[tuple[str, str], set[str]] = {}
+
+        # event sink (wired to the event broker by the server)
+        self.event_sinks: list[Callable[[str, str, int, object], None]] = []
+
+    # ------------------------------------------------------------------ core
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def table_index(self, table: str) -> int:
+        with self._lock:
+            return self._table_index.get(table, 0)
+
+    def _bump(self, table: str, index: Optional[int] = None) -> int:
+        """Advance the store to `index` (or next) for a write to `table`."""
+        if index is None:
+            index = self._index + 1
+        self._index = max(self._index, index)
+        self._table_index[table] = self._index
+        return self._index
+
+    def _commit(self) -> None:
+        self._cond.notify_all()
+
+    def _emit(self, topic: str, etype: str, index: int, payload) -> None:
+        for sink in self.event_sinks:
+            try:
+                sink(topic, etype, index, payload)
+            except Exception:
+                pass
+
+    def snapshot(self) -> "StateSnapshot":
+        with self._lock:
+            return StateSnapshot(self)
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0
+                           ) -> "StateSnapshot":
+        """Block until latest_index >= index, then snapshot
+        (ref nomad/worker.go:536 snapshotMinIndex)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._index < index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out waiting for index {index} (at {self._index})")
+                self._cond.wait(remaining)
+            return StateSnapshot(self)
+
+    def block_min_index(self, index: int, timeout: float = 60.0) -> int:
+        """Blocking-query primitive: wait for any write past `index`."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._index <= index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._index
+                self._cond.wait(remaining)
+            return self._index
+
+    # ----------------------------------------------------------------- nodes
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            existing = self.nodes.get(node.id)
+            node = node.copy()
+            if existing:
+                node.create_index = existing.create_index
+                # preserve drain/eligibility set server-side unless provided
+                if node.drain_strategy is None and existing.drain_strategy:
+                    node.drain_strategy = existing.drain_strategy
+                    node.scheduling_eligibility = existing.scheduling_eligibility
+            else:
+                node.create_index = index
+            node.modify_index = self._bump("nodes", index)
+            self.nodes[node.id] = node
+            self._emit("Node", "NodeRegistration", node.modify_index, node)
+            self._commit()
+
+    def delete_node(self, index: int, node_ids: list[str]) -> None:
+        with self._lock:
+            for nid in node_ids:
+                self.nodes.pop(nid, None)
+            self._bump("nodes", index)
+            self._commit()
+
+    def update_node_status(self, index: int, node_id: str, status: str,
+                           updated_at: float = 0.0) -> None:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            node = node.copy()
+            node.status = status
+            node.status_updated_at = updated_at
+            node.modify_index = self._bump("nodes", index)
+            self.nodes[node_id] = node
+            self._emit("Node", "NodeStatusUpdate", node.modify_index, node)
+            self._commit()
+
+    def update_node_drain(self, index: int, node_id: str, drain,
+                          mark_eligible: bool = False) -> None:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            node = node.copy()
+            node.drain_strategy = drain
+            if drain is not None:
+                node.scheduling_eligibility = "ineligible"
+            elif mark_eligible:
+                node.scheduling_eligibility = "eligible"
+            node.modify_index = self._bump("nodes", index)
+            self.nodes[node_id] = node
+            self._emit("Node", "NodeDrain", node.modify_index, node)
+            self._commit()
+
+    def update_node_eligibility(self, index: int, node_id: str,
+                                eligibility: str) -> None:
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            node = node.copy()
+            node.scheduling_eligibility = eligibility
+            node.modify_index = self._bump("nodes", index)
+            self.nodes[node_id] = node
+            self._commit()
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        with self._lock:
+            return self.nodes.get(node_id)
+
+    def iter_nodes(self) -> list[Node]:
+        with self._lock:
+            return list(self.nodes.values())
+
+    # ------------------------------------------------------------------ jobs
+
+    def upsert_job(self, index: int, job: Job, keep_version: bool = False) -> None:
+        """Insert/update a job, maintaining version history and summary
+        (ref state_store.go UpsertJob/upsertJobImpl)."""
+        with self._lock:
+            key = (job.namespace, job.id)
+            existing = self.jobs.get(key)
+            job = job.copy()
+            if existing:
+                job.create_index = existing.create_index
+                job.job_modify_index = index
+                if not keep_version:
+                    job.version = existing.version + 1
+            else:
+                job.create_index = index
+                job.job_modify_index = index
+                job.version = 0
+            job.modify_index = self._bump("jobs", index)
+            if job.status not in (JOB_STATUS_DEAD,):
+                job.status = self._compute_job_status(job)
+            self.jobs[key] = job
+            self.job_versions[(job.namespace, job.id, job.version)] = job
+            self._prune_job_versions(job.namespace, job.id)
+            self._ensure_summary(index, job)
+            self._emit("Job", "JobRegistered", job.modify_index, job)
+            self._commit()
+
+    def _compute_job_status(self, job: Job) -> str:
+        """ref state_store.go getJobStatus: running if any live alloc; pending
+        while evals are outstanding or nothing has run yet; dead once a job
+        that had allocations has only terminal ones left."""
+        if job.stop:
+            return JOB_STATUS_DEAD
+        if job.is_periodic() or job.is_parameterized():
+            return JOB_STATUS_RUNNING
+        key = (job.namespace, job.id)
+        alloc_ids = self._allocs_by_job.get(key, ())
+        for aid in alloc_ids:  # any live alloc => running
+            if not self.allocs[aid].terminal_status():
+                return JOB_STATUS_RUNNING
+        for eid in self._evals_by_job.get(key, ()):
+            ev = self.evals.get(eid)
+            if ev is not None and not ev.terminal_status():
+                return JOB_STATUS_PENDING
+        if alloc_ids:
+            return JOB_STATUS_DEAD
+        return JOB_STATUS_PENDING
+
+    def _prune_job_versions(self, ns: str, job_id: str, keep: int = 6) -> None:
+        versions = sorted(v for (n, j, v) in self.job_versions
+                          if n == ns and j == job_id)
+        for v in versions[:-keep]:
+            self.job_versions.pop((ns, job_id, v), None)
+
+    def _ensure_summary(self, index: int, job: Job) -> None:
+        key = (job.namespace, job.id)
+        summ = self.job_summaries.get(key)
+        summ = summ.copy() if summ else JobSummary(
+            job_id=job.id, namespace=job.namespace, create_index=index)
+        for tg in job.task_groups:
+            summ.summary.setdefault(tg.name, TaskGroupSummary())
+        summ.modify_index = index
+        self.job_summaries[key] = summ
+
+    def delete_job(self, index: int, ns: str, job_id: str) -> None:
+        with self._lock:
+            self.jobs.pop((ns, job_id), None)
+            for k in [k for k in self.job_versions if k[0] == ns and k[1] == job_id]:
+                self.job_versions.pop(k)
+            self.job_summaries.pop((ns, job_id), None)
+            self.periodic_launches.pop((ns, job_id), None)
+            self._bump("jobs", index)
+            self._emit("Job", "JobDeregistered", self._index, (ns, job_id))
+            self._commit()
+
+    def job_by_id(self, ns: str, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self.jobs.get((ns, job_id))
+
+    def job_by_version(self, ns: str, job_id: str, version: int) -> Optional[Job]:
+        with self._lock:
+            return self.job_versions.get((ns, job_id, version))
+
+    def job_versions_by_id(self, ns: str, job_id: str) -> list[Job]:
+        with self._lock:
+            out = [j for (n, i, _v), j in self.job_versions.items()
+                   if n == ns and i == job_id]
+            return sorted(out, key=lambda j: -j.version)
+
+    def iter_jobs(self, ns: Optional[str] = None) -> list[Job]:
+        with self._lock:
+            return [j for j in self.jobs.values()
+                    if ns is None or j.namespace == ns]
+
+    def job_summary(self, ns: str, job_id: str) -> Optional[JobSummary]:
+        with self._lock:
+            return self.job_summaries.get((ns, job_id))
+
+    # ----------------------------------------------------------------- evals
+
+    def upsert_evals(self, index: int, evals: list[Evaluation]) -> None:
+        with self._lock:
+            idx = self._bump("evals", index)
+            for ev in evals:
+                ev = ev.copy()
+                existing = self.evals.get(ev.id)
+                ev.create_index = existing.create_index if existing else idx
+                ev.modify_index = idx
+                self._index_eval(ev)
+                self.evals[ev.id] = ev
+                self._update_summary_queued(idx, ev)
+                self._emit("Evaluation", "EvaluationUpdated", idx, ev)
+            self._commit()
+
+    def _index_eval(self, ev: Evaluation) -> None:
+        key = (ev.namespace, ev.job_id)
+        self._evals_by_job.setdefault(key, set()).add(ev.id)
+
+    def _update_summary_queued(self, index: int, ev: Evaluation) -> None:
+        key = (ev.namespace, ev.job_id)
+        summ = self.job_summaries.get(key)
+        if summ is None or not ev.queued_allocations:
+            return
+        summ = summ.copy()
+        for tg, n in ev.queued_allocations.items():
+            summ.summary.setdefault(tg, TaskGroupSummary()).queued = n
+        summ.modify_index = index
+        self.job_summaries[key] = summ
+
+    def delete_evals(self, index: int, eval_ids: list[str],
+                     alloc_ids: list[str] = ()) -> None:
+        with self._lock:
+            for eid in eval_ids:
+                ev = self.evals.pop(eid, None)
+                if ev:
+                    s = self._evals_by_job.get((ev.namespace, ev.job_id))
+                    if s:
+                        s.discard(eid)
+            for aid in alloc_ids:
+                self._delete_alloc(aid)
+            self._bump("evals", index)
+            self._commit()
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        with self._lock:
+            return self.evals.get(eval_id)
+
+    def evals_by_job(self, ns: str, job_id: str) -> list[Evaluation]:
+        with self._lock:
+            return [self.evals[e] for e in self._evals_by_job.get((ns, job_id), ())
+                    if e in self.evals]
+
+    def iter_evals(self) -> list[Evaluation]:
+        with self._lock:
+            return list(self.evals.values())
+
+    # ---------------------------------------------------------------- allocs
+
+    def upsert_allocs(self, index: int, allocs: list[Allocation]) -> None:
+        with self._lock:
+            idx = self._bump("allocs", index)
+            for alloc in allocs:
+                self._upsert_alloc_locked(idx, alloc)
+            self._commit()
+
+    def _upsert_alloc_locked(self, idx: int, alloc: Allocation) -> None:
+        existing = self.allocs.get(alloc.id)
+        alloc = alloc.copy()
+        if existing:
+            alloc.create_index = existing.create_index
+            # client-only fields are not clobbered by server-side upserts
+            # (ref state_store.go UpsertAllocs: preserves client status unless set)
+            if alloc.client_status == ALLOC_CLIENT_PENDING and \
+               existing.client_status != ALLOC_CLIENT_PENDING and \
+               alloc.desired_status != existing.desired_status:
+                alloc.client_status = existing.client_status
+                alloc.task_states = existing.task_states
+            if alloc.job is None:
+                alloc.job = existing.job
+        else:
+            alloc.create_index = idx
+        alloc.modify_index = idx
+        self.allocs[alloc.id] = alloc
+        self._index_alloc(alloc)
+        self._reconcile_summary(idx, existing, alloc)
+        self._emit("Allocation", "AllocationUpdated", idx, alloc)
+
+    def _index_alloc(self, alloc: Allocation) -> None:
+        self._allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+        self._allocs_by_job.setdefault(
+            (alloc.namespace, alloc.job_id), set()).add(alloc.id)
+        self._allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+
+    def _delete_alloc(self, alloc_id: str) -> None:
+        alloc = self.allocs.pop(alloc_id, None)
+        if not alloc:
+            return
+        for idx_map, key in ((self._allocs_by_node, alloc.node_id),
+                             (self._allocs_by_job, (alloc.namespace, alloc.job_id)),
+                             (self._allocs_by_eval, alloc.eval_id)):
+            s = idx_map.get(key)
+            if s:
+                s.discard(alloc_id)
+
+    _SUMMARY_FIELDS = {
+        ALLOC_CLIENT_PENDING: "starting",
+        ALLOC_CLIENT_RUNNING: "running",
+        ALLOC_CLIENT_COMPLETE: "complete",
+        ALLOC_CLIENT_FAILED: "failed",
+        ALLOC_CLIENT_LOST: "lost",
+        ALLOC_CLIENT_UNKNOWN: "unknown",
+    }
+
+    def _reconcile_summary(self, index: int, old: Optional[Allocation],
+                           new: Allocation) -> None:
+        """Maintain per-TG client-status counts
+        (ref state_store.go updateSummaryWithAlloc)."""
+        key = (new.namespace, new.job_id)
+        summ = self.job_summaries.get(key)
+        if summ is None:
+            return
+        summ = summ.copy()
+        tg = summ.summary.setdefault(new.task_group, TaskGroupSummary())
+        if old is not None:
+            f = self._SUMMARY_FIELDS.get(old.client_status)
+            if f:
+                setattr(tg, f, max(0, getattr(tg, f) - 1))
+        f = self._SUMMARY_FIELDS.get(new.client_status)
+        if f:
+            setattr(tg, f, getattr(tg, f) + 1)
+        summ.modify_index = index
+        self.job_summaries[key] = summ
+
+    def update_allocs_from_client(self, index: int,
+                                  allocs: list[Allocation]) -> None:
+        """Client status updates: merge client-owned fields onto stored allocs
+        (ref state_store.go UpdateAllocsFromClient/nestedUpdateAllocFromClient)."""
+        with self._lock:
+            idx = self._bump("allocs", index)
+            for update in allocs:
+                existing = self.allocs.get(update.id)
+                if existing is None:
+                    continue
+                alloc = existing.copy()
+                alloc.client_status = update.client_status
+                alloc.client_description = update.client_description
+                alloc.task_states = dict(update.task_states)
+                alloc.network_status = update.network_status
+                if update.deployment_status is not None:
+                    alloc.deployment_status = update.deployment_status
+                alloc.modify_index = idx
+                alloc.modify_time_unix = update.modify_time_unix or time.time()
+                self.allocs[alloc.id] = alloc
+                self._reconcile_summary(idx, existing, alloc)
+                self._emit("Allocation", "AllocationUpdated", idx, alloc)
+                # job status may flip (e.g. batch job completes)
+                job = self.jobs.get((alloc.namespace, alloc.job_id))
+                if job is not None:
+                    status = self._compute_job_status(job)
+                    if status != job.status:
+                        job = job.copy()
+                        job.status = status
+                        job.modify_index = idx
+                        self.jobs[(job.namespace, job.id)] = job
+            self._commit()
+
+    def update_alloc_desired_transitions(
+            self, index: int, transitions: dict[str, object],
+            evals: list[Evaluation] = ()) -> None:
+        """Drainer entry point (ref state_store.go
+        UpdateAllocsDesiredTransitions)."""
+        with self._lock:
+            idx = self._bump("allocs", index)
+            for alloc_id, transition in transitions.items():
+                existing = self.allocs.get(alloc_id)
+                if existing is None:
+                    continue
+                alloc = existing.copy()
+                alloc.desired_transition = transition
+                alloc.modify_index = idx
+                self.allocs[alloc_id] = alloc
+            for ev in evals:
+                ev = ev.copy()
+                ev.create_index = idx
+                ev.modify_index = idx
+                self.evals[ev.id] = ev
+                self._index_eval(ev)
+            self._commit()
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        with self._lock:
+            return self.allocs.get(alloc_id)
+
+    def allocs_by_node(self, node_id: str) -> list[Allocation]:
+        with self._lock:
+            return [self.allocs[a] for a in self._allocs_by_node.get(node_id, ())
+                    if a in self.allocs]
+
+    def allocs_by_node_terminal(self, node_id: str,
+                                terminal: bool) -> list[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, ns: str, job_id: str,
+                      anyCreateIndex: bool = True) -> list[Allocation]:
+        with self._lock:
+            return [self.allocs[a]
+                    for a in self._allocs_by_job.get((ns, job_id), ())
+                    if a in self.allocs]
+
+    def allocs_by_eval(self, eval_id: str) -> list[Allocation]:
+        with self._lock:
+            return [self.allocs[a] for a in self._allocs_by_eval.get(eval_id, ())
+                    if a in self.allocs]
+
+    def iter_allocs(self) -> list[Allocation]:
+        with self._lock:
+            return list(self.allocs.values())
+
+    # ------------------------------------------------------------ plan apply
+
+    def upsert_plan_results(self, index: int, result) -> None:
+        """Atomically apply a committed plan (ref nomad/fsm.go:998
+        applyPlanResults + state_store.go UpsertPlanResults).
+
+        `result` is an ApplyPlanResultsRequest-shaped object with:
+        alloc_updates (stops), alloc_placements, alloc_preemptions,
+        deployment, deployment_updates, eval_id, nodes_to_preempt.
+        """
+        with self._lock:
+            idx = self._bump("allocs", index)
+            for alloc in result.alloc_updates:      # stopped/updated allocs
+                self._upsert_alloc_locked(idx, alloc)
+            for alloc in result.alloc_placements:   # new placements
+                if alloc.create_time_unix == 0.0:
+                    alloc.create_time_unix = time.time()
+                alloc.modify_time_unix = alloc.create_time_unix
+                self._upsert_alloc_locked(idx, alloc)
+            for alloc in result.alloc_preemptions:
+                self._upsert_alloc_locked(idx, alloc)
+            if result.deployment is not None:
+                self._upsert_deployment_locked(idx, result.deployment)
+            for du in result.deployment_updates:
+                self._apply_deployment_update_locked(idx, du)
+            # deployment placement bookkeeping (ref state_store.go
+            # updateDeploymentWithAlloc)
+            for alloc in result.alloc_placements:
+                if not alloc.deployment_id:
+                    continue
+                d = self.deployments.get(alloc.deployment_id)
+                if d is None:
+                    continue
+                d = d.copy()
+                ds = d.task_groups.get(alloc.task_group)
+                if ds is not None:
+                    ds.placed_allocs += 1
+                    if alloc.deployment_status is not None and \
+                       alloc.deployment_status.canary and \
+                       alloc.id not in ds.placed_canaries:
+                        ds.placed_canaries.append(alloc.id)
+                d.modify_index = idx
+                self.deployments[d.id] = d
+            # refresh job status
+            job = None
+            if result.alloc_placements:
+                a0 = result.alloc_placements[0]
+                job = self.jobs.get((a0.namespace, a0.job_id))
+            if job is not None and job.status != JOB_STATUS_RUNNING and not job.stop:
+                job = job.copy()
+                job.status = JOB_STATUS_RUNNING
+                job.modify_index = idx
+                self.jobs[(job.namespace, job.id)] = job
+            self._commit()
+
+    # ------------------------------------------------------------ deployments
+
+    def upsert_deployment(self, index: int, deployment: Deployment) -> None:
+        with self._lock:
+            idx = self._bump("deployment", index)
+            self._upsert_deployment_locked(idx, deployment)
+            self._commit()
+
+    def _upsert_deployment_locked(self, idx: int, deployment: Deployment) -> None:
+        existing = self.deployments.get(deployment.id)
+        deployment = deployment.copy()
+        deployment.create_index = existing.create_index if existing else idx
+        deployment.modify_index = idx
+        self.deployments[deployment.id] = deployment
+        self._emit("Deployment", "DeploymentStatusUpdate", idx, deployment)
+
+    def _apply_deployment_update_locked(self, idx: int, du) -> None:
+        d = self.deployments.get(du.deployment_id)
+        if d is None:
+            return
+        d = d.copy()
+        d.status = du.status
+        d.status_description = du.status_description
+        d.modify_index = idx
+        self.deployments[d.id] = d
+        self._emit("Deployment", "DeploymentStatusUpdate", idx, d)
+
+    def update_deployment_status(self, index: int, du,
+                                 job: Optional[Job] = None,
+                                 eval: Optional[Evaluation] = None) -> None:
+        with self._lock:
+            idx = self._bump("deployment", index)
+            self._apply_deployment_update_locked(idx, du)
+            if job is not None:
+                self.upsert_job_locked_helper(idx, job)
+            if eval is not None:
+                ev = eval.copy()
+                ev.create_index = idx
+                ev.modify_index = idx
+                self.evals[ev.id] = ev
+                self._index_eval(ev)
+            self._commit()
+
+    def upsert_job_locked_helper(self, idx: int, job: Job) -> None:
+        key = (job.namespace, job.id)
+        existing = self.jobs.get(key)
+        job = job.copy()
+        if existing:
+            job.create_index = existing.create_index
+            job.version = existing.version + 1
+        job.modify_index = idx
+        self.jobs[key] = job
+        self.job_versions[(job.namespace, job.id, job.version)] = job
+
+    def update_deployment_alloc_health(self, index: int, deployment_id: str,
+                                       healthy: list[str], unhealthy: list[str],
+                                       timestamp: float = 0.0) -> None:
+        """ref state_store.go UpdateDeploymentAllocHealth"""
+        from ..structs import AllocDeploymentStatus
+        with self._lock:
+            idx = self._bump("deployment", index)
+            d = self.deployments.get(deployment_id)
+            for aid, is_healthy in [(a, True) for a in healthy] + \
+                                   [(a, False) for a in unhealthy]:
+                alloc = self.allocs.get(aid)
+                if alloc is None:
+                    continue
+                old = alloc
+                alloc = alloc.copy()
+                ds = alloc.deployment_status or AllocDeploymentStatus()
+                was = ds.healthy
+                ds.healthy = is_healthy
+                ds.timestamp_unix = timestamp or time.time()
+                ds.modify_index = idx
+                alloc.deployment_status = ds
+                alloc.modify_index = idx
+                self.allocs[aid] = alloc
+                if d is not None and alloc.deployment_id == deployment_id:
+                    d = d.copy()
+                    state = d.task_groups.get(alloc.task_group)
+                    if state is not None:
+                        if was is None:
+                            if is_healthy:
+                                state.healthy_allocs += 1
+                            else:
+                                state.unhealthy_allocs += 1
+                        elif was != is_healthy:
+                            if is_healthy:
+                                state.healthy_allocs += 1
+                                state.unhealthy_allocs -= 1
+                            else:
+                                state.healthy_allocs -= 1
+                                state.unhealthy_allocs += 1
+                    d.modify_index = idx
+                    self.deployments[d.id] = d
+                self._emit("Allocation", "AllocationUpdated", idx, alloc)
+            self._commit()
+
+    def update_deployment_promotion(self, index: int, deployment_id: str,
+                                    groups: Optional[list[str]] = None) -> None:
+        with self._lock:
+            idx = self._bump("deployment", index)
+            d = self.deployments.get(deployment_id)
+            if d is None:
+                raise KeyError(f"deployment {deployment_id} not found")
+            d = d.copy()
+            for name, state in d.task_groups.items():
+                if groups is None or name in groups:
+                    state.promoted = True
+            d.modify_index = idx
+            self.deployments[d.id] = d
+            # canary allocs get their canary flag cleared on promote via
+            # deployment watcher-created eval; state keeps alloc flags as-is
+            self._commit()
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        with self._lock:
+            return self.deployments.get(deployment_id)
+
+    def deployments_by_job(self, ns: str, job_id: str) -> list[Deployment]:
+        with self._lock:
+            return [d for d in self.deployments.values()
+                    if d.namespace == ns and d.job_id == job_id]
+
+    def latest_deployment_by_job(self, ns: str, job_id: str
+                                 ) -> Optional[Deployment]:
+        ds = self.deployments_by_job(ns, job_id)
+        if not ds:
+            return None
+        return max(ds, key=lambda d: d.create_index)
+
+    def iter_deployments(self) -> list[Deployment]:
+        with self._lock:
+            return list(self.deployments.values())
+
+    # -------------------------------------------------------- periodic/config
+
+    def upsert_periodic_launch(self, index: int, ns: str, job_id: str,
+                               launch_time: float) -> None:
+        with self._lock:
+            idx = self._bump("periodic_launch", index)
+            self.periodic_launches[(ns, job_id)] = {
+                "namespace": ns, "id": job_id, "launch": launch_time,
+                "modify_index": idx}
+            self._commit()
+
+    def periodic_launch_by_id(self, ns: str, job_id: str) -> Optional[dict]:
+        with self._lock:
+            return self.periodic_launches.get((ns, job_id))
+
+    def set_scheduler_config(self, index: int,
+                             config: SchedulerConfiguration) -> None:
+        with self._lock:
+            import dataclasses as _dc
+            config = _dc.replace(config)
+            config.modify_index = self._bump("scheduler_config", index)
+            self.scheduler_config = config
+            self._commit()
+
+    def get_scheduler_config(self) -> SchedulerConfiguration:
+        with self._lock:
+            return self.scheduler_config
+
+
+class StateSnapshot:
+    """Point-in-time read-only view. Shallow dict copies are safe because
+    stored objects are immutable-by-convention (writers always insert fresh
+    copies)."""
+
+    def __init__(self, store: StateStore):
+        self.index = store._index
+        self.nodes = dict(store.nodes)
+        self.jobs = dict(store.jobs)
+        self.job_versions = dict(store.job_versions)
+        self.evals = dict(store.evals)
+        self.allocs = dict(store.allocs)
+        self.deployments = dict(store.deployments)
+        self.scheduler_config = store.scheduler_config
+        self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
+        self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
+        self._evals_by_job = {k: set(v) for k, v in store._evals_by_job.items()}
+
+    # read API mirrors the scheduler State interface (ref scheduler/scheduler.go:66)
+
+    def latest_index(self) -> int:
+        return self.index
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self.nodes.get(node_id)
+
+    def iter_nodes(self) -> list[Node]:
+        return list(self.nodes.values())
+
+    def ready_nodes_in_dcs(self, datacenters: Iterable[str]) -> list[Node]:
+        dcs = set(datacenters)
+        return [n for n in self.nodes.values()
+                if n.ready() and n.datacenter in dcs]
+
+    def job_by_id(self, ns: str, job_id: str) -> Optional[Job]:
+        return self.jobs.get((ns, job_id))
+
+    def job_by_version(self, ns: str, job_id: str, version: int) -> Optional[Job]:
+        return self.job_versions.get((ns, job_id, version))
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self.evals.get(eval_id)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self.allocs.get(alloc_id)
+
+    def allocs_by_node(self, node_id: str) -> list[Allocation]:
+        return [self.allocs[a] for a in self._allocs_by_node.get(node_id, ())
+                if a in self.allocs]
+
+    def allocs_by_job(self, ns: str, job_id: str) -> list[Allocation]:
+        return [self.allocs[a] for a in self._allocs_by_job.get((ns, job_id), ())
+                if a in self.allocs]
+
+    def evals_by_job(self, ns: str, job_id: str) -> list[Evaluation]:
+        return [self.evals[e] for e in self._evals_by_job.get((ns, job_id), ())
+                if e in self.evals]
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self.deployments.get(deployment_id)
+
+    def latest_deployment_by_job(self, ns: str, job_id: str
+                                 ) -> Optional[Deployment]:
+        ds = [d for d in self.deployments.values()
+              if d.namespace == ns and d.job_id == job_id]
+        return max(ds, key=lambda d: d.create_index) if ds else None
+
+    def get_scheduler_config(self) -> SchedulerConfiguration:
+        return self.scheduler_config

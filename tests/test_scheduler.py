@@ -1,0 +1,369 @@
+"""Scheduler tests via the Harness (modeled on scheduler/generic_sched_test.go
+and scheduler_system_test.go behaviors)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.structs import (
+    Constraint, Evaluation, Spread, SpreadTarget,
+    ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_STOP,
+    EVAL_STATUS_COMPLETE, EVAL_STATUS_BLOCKED, NODE_STATUS_DOWN,
+    OP_DISTINCT_HOSTS, TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE,
+)
+
+
+def make_eval(job, trigger=TRIGGER_JOB_REGISTER):
+    return Evaluation(
+        namespace=job.namespace, priority=job.priority, type=job.type,
+        job_id=job.id, triggered_by=trigger)
+
+
+def process(h, job, trigger=TRIGGER_JOB_REGISTER):
+    ev = make_eval(job, trigger)
+    h.state.upsert_evals(h.get_next_index(), [ev])
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    return ev
+
+
+def test_service_job_register_places_all():
+    h = Harness()
+    nodes = [mock.node() for _ in range(10)]
+    for n in nodes:
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    # state now holds the allocs
+    out = h.state.allocs_by_job("default", job.id)
+    assert len(out) == 10
+    # names are unique indexes 0..9
+    names = sorted(a.name for a in out)
+    assert names == sorted(f"{job.id}.web[{i}]" for i in range(10))
+    # eval completed with no failures
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+    assert not h.evals[-1].failed_tg_allocs
+    # resources were actually assigned (ports etc)
+    for a in placed:
+        tr = a.allocated_resources.tasks["web"]
+        assert tr.cpu_shares == 500
+        assert tr.networks and len(tr.networks[0].dynamic_ports) == 2
+
+
+def test_service_job_register_annotates_metrics():
+    h = Harness()
+    for _ in range(3):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    out = h.state.allocs_by_job("default", job.id)
+    assert len(out) == 2
+    for a in out:
+        assert a.metrics is not None
+        assert a.metrics.nodes_evaluated >= 0
+
+
+def test_service_job_register_infeasible_constraint_blocks():
+    h = Harness()
+    for _ in range(3):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.constraints = [Constraint(ltarget="${attr.kernel.name}",
+                                  rtarget="windows")]
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    # no placements; blocked eval created with failed TG metrics
+    assert h.state.allocs_by_job("default", job.id) == []
+    assert len(h.created_evals) == 1
+    blocked = h.created_evals[0]
+    assert blocked.status == EVAL_STATUS_BLOCKED
+    assert h.evals[-1].failed_tg_allocs.get("web") is not None
+
+
+def test_service_job_register_exhausted_resources():
+    h = Harness()
+    n = mock.node()
+    h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].resources.cpu = 3000  # only one fits per node
+    job.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    out = h.state.allocs_by_job("default", job.id)
+    assert len(out) == 1
+    assert h.evals[-1].failed_tg_allocs.get("web") is not None
+    metric = h.evals[-1].failed_tg_allocs["web"]
+    assert metric.nodes_exhausted >= 1
+
+
+def test_job_deregister_stops_allocs():
+    h = Harness()
+    n = mock.node()
+    h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    assert len(h.state.allocs_by_job("default", job.id)) == 2
+
+    stopped = job.copy()
+    stopped.stop = True
+    h.state.upsert_job(h.get_next_index(), stopped)
+    process(h, stopped, "job-deregister")
+    for a in h.state.allocs_by_job("default", job.id):
+        assert a.desired_status == ALLOC_DESIRED_STOP
+
+
+def test_node_down_replaces_allocs():
+    h = Harness()
+    n1, n2 = mock.node(), mock.node()
+    h.state.upsert_node(h.get_next_index(), n1)
+    h.state.upsert_node(h.get_next_index(), n2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 2
+
+    # mark all running, then kill node1
+    for a in allocs:
+        up = a.copy()
+        up.client_status = ALLOC_CLIENT_RUNNING
+        h.state.update_allocs_from_client(h.get_next_index(), [up])
+    h.state.update_node_status(h.get_next_index(), n1.id, NODE_STATUS_DOWN)
+
+    process(h, job, TRIGGER_NODE_UPDATE)
+    allocs = h.state.allocs_by_job("default", job.id)
+    lost = [a for a in allocs if a.client_status == "lost"]
+    live = [a for a in allocs if not a.terminal_status()]
+    on_n1 = [a for a in live if a.node_id == n1.id]
+    assert not on_n1  # replacements all on n2
+    assert len(live) == 2
+    assert all(a.node_id == n2.id for a in live)
+    assert len(lost) >= 1
+
+
+def test_scale_down_stops_highest_indexes():
+    h = Harness()
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    assert len([a for a in h.state.allocs_by_job("default", job.id)
+                if not a.terminal_status()]) == 4
+
+    smaller = job.copy()
+    smaller.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), smaller)
+    process(h, smaller)
+    live = [a for a in h.state.allocs_by_job("default", job.id)
+            if a.desired_status == "run"]
+    names = sorted(a.name for a in live)
+    assert names == [f"{job.id}.web[0]", f"{job.id}.web[1]"]
+
+
+def test_distinct_hosts_constraint():
+    h = Harness()
+    for _ in range(3):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.constraints.append(Constraint(operand=OP_DISTINCT_HOSTS))
+    job.task_groups[0].count = 3
+    job.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = [a for a in h.state.allocs_by_job("default", job.id)
+              if not a.terminal_status()]
+    assert len(allocs) == 3
+    assert len({a.node_id for a in allocs}) == 3  # all on distinct nodes
+
+
+def test_distinct_hosts_infeasible_when_too_few_nodes():
+    h = Harness()
+    for _ in range(2):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.constraints.append(Constraint(operand=OP_DISTINCT_HOSTS))
+    job.task_groups[0].count = 3
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = [a for a in h.state.allocs_by_job("default", job.id)
+              if not a.terminal_status()]
+    assert len(allocs) == 2
+    assert h.evals[-1].failed_tg_allocs
+
+
+def test_batch_job_register():
+    h = Harness()
+    for _ in range(2):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.batch_job()
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    assert len(h.state.allocs_by_job("default", job.id)) == 10
+
+
+def test_batch_failed_alloc_reschedules_now():
+    h = Harness()
+    n = mock.node()
+    h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 1
+
+    import time
+    from nomad_tpu.structs import TaskState
+    failed = allocs[0].copy()
+    failed.client_status = ALLOC_CLIENT_FAILED
+    failed.task_states = {"worker": TaskState(
+        state="dead", failed=True, finished_at=time.time() - 60)}
+    h.state.update_allocs_from_client(h.get_next_index(), [failed])
+
+    process(h, job, "alloc-failure")
+    allocs = h.state.allocs_by_job("default", job.id)
+    live = [a for a in allocs if not a.terminal_status()]
+    assert len(live) == 1
+    assert live[0].previous_allocation == failed.id
+    assert live[0].reschedule_tracker is not None
+
+
+def test_system_job_on_all_nodes():
+    h = Harness()
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.system_job()
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 4
+    assert {a.node_id for a in allocs} == {n.id for n in nodes}
+
+
+def test_system_job_new_node_gets_alloc():
+    h = Harness()
+    n1 = mock.node()
+    h.state.upsert_node(h.get_next_index(), n1)
+    job = mock.system_job()
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    assert len(h.state.allocs_by_job("default", job.id)) == 1
+
+    n2 = mock.node()
+    h.state.upsert_node(h.get_next_index(), n2)
+    process(h, job, TRIGGER_NODE_UPDATE)
+    allocs = [a for a in h.state.allocs_by_job("default", job.id)
+              if not a.terminal_status()]
+    assert len(allocs) == 2
+
+
+def test_spread_even_across_dcs():
+    h = Harness()
+    for i in range(4):
+        n = mock.node()
+        n.datacenter = "dc1" if i % 2 == 0 else "dc2"
+        n.compute_class()
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.networks = []
+    job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = [a for a in h.state.allocs_by_job("default", job.id)
+              if not a.terminal_status()]
+    assert len(allocs) == 4
+    by_dc = {}
+    for a in allocs:
+        node = h.state.node_by_id(a.node_id)
+        by_dc[node.datacenter] = by_dc.get(node.datacenter, 0) + 1
+    assert by_dc == {"dc1": 2, "dc2": 2}
+
+
+def test_inplace_update_when_count_insensitive_change():
+    h = Harness()
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    before = {a.id for a in h.state.allocs_by_job("default", job.id)}
+
+    # count-insensitive change: priority bump only (no task changes)
+    updated = job.copy()
+    updated.priority = 70
+    h.state.upsert_job(h.get_next_index(), updated)
+    process(h, updated)
+    after = [a for a in h.state.allocs_by_job("default", job.id)
+             if not a.terminal_status()]
+    assert {a.id for a in after} == before  # same allocs, updated in place
+
+
+def test_destructive_update_replaces_allocs():
+    h = Harness()
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    before = {a.id for a in h.state.allocs_by_job("default", job.id)}
+
+    updated = job.copy()
+    updated.task_groups[0].tasks[0].env = {"FOO": "changed"}
+    h.state.upsert_job(h.get_next_index(), updated)
+    process(h, updated)
+    allocs = h.state.allocs_by_job("default", job.id)
+    live = [a for a in allocs if a.desired_status == "run"]
+    stopped = [a for a in allocs if a.desired_status == ALLOC_DESIRED_STOP]
+    assert len(live) == 2
+    assert {a.id for a in live}.isdisjoint(before)
+    assert {a.id for a in stopped} == before
+
+
+def test_batch_job_completes_to_dead_status():
+    # regression: a finished batch job must read 'dead', not 'pending'
+    h = Harness()
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 1
+    import time
+    from nomad_tpu.structs import TaskState
+    done = allocs[0].copy()
+    done.client_status = "complete"
+    done.task_states = {"worker": TaskState(state="dead", failed=False,
+                                            finished_at=time.time())}
+    h.state.update_allocs_from_client(h.get_next_index(), [done])
+    assert h.state.job_by_id("default", job.id).status == "dead"
+
+
+def test_tpu_algorithm_falls_back_without_solver():
+    # regression: tpu-batch configured but solver module absent must not crash
+    from nomad_tpu.structs import SchedulerConfiguration, SCHED_ALG_TPU
+    h = Harness()
+    h.state.set_scheduler_config(h.get_next_index(),
+                                 SchedulerConfiguration(
+                                     scheduler_algorithm=SCHED_ALG_TPU))
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    assert len(h.state.allocs_by_job("default", job.id)) == 2

@@ -1,0 +1,159 @@
+"""State store tests (modeled on nomad/state/state_store_test.go behaviors)."""
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_COMPLETE, ALLOC_DESIRED_STOP,
+    NODE_STATUS_DOWN, NODE_STATUS_READY, JOB_STATUS_RUNNING, JOB_STATUS_DEAD,
+    SchedulerConfiguration, SCHED_ALG_TPU,
+)
+
+
+def test_upsert_node_and_indexes():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(10, n)
+    got = s.node_by_id(n.id)
+    assert got is not None and got.modify_index == 10
+    assert s.latest_index() == 10
+    assert s.table_index("nodes") == 10
+    # snapshot isolation: later writes don't affect earlier snapshots
+    snap = s.snapshot()
+    s.update_node_status(11, n.id, NODE_STATUS_DOWN)
+    assert snap.node_by_id(n.id).status == NODE_STATUS_READY
+    assert s.node_by_id(n.id).status == NODE_STATUS_DOWN
+
+
+def test_upsert_job_versions():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(10, j)
+    assert s.job_by_id("default", j.id).version == 0
+    j2 = j.copy()
+    j2.priority = 70
+    s.upsert_job(20, j2)
+    got = s.job_by_id("default", j.id)
+    assert got.version == 1 and got.priority == 70
+    assert s.job_by_version("default", j.id, 0).priority == 50
+    versions = s.job_versions_by_id("default", j.id)
+    assert [v.version for v in versions] == [1, 0]
+
+
+def test_job_version_pruning():
+    s = StateStore()
+    j = mock.job()
+    for i in range(10):
+        s.upsert_job(10 + i, j)
+    versions = s.job_versions_by_id("default", j.id)
+    assert len(versions) == 6  # keeps latest 6
+
+
+def test_alloc_indexes_and_summary():
+    s = StateStore()
+    n = mock.node()
+    j = mock.job()
+    s.upsert_node(1, n)
+    s.upsert_job(2, j)
+    a = mock.alloc_for(j, n)
+    s.upsert_allocs(3, [a])
+    assert [x.id for x in s.allocs_by_node(n.id)] == [a.id]
+    assert [x.id for x in s.allocs_by_job("default", j.id)] == [a.id]
+    summ = s.job_summary("default", j.id)
+    assert summ.summary["web"].starting == 1
+
+    # client update flips summary bucket
+    up = a.copy()
+    up.client_status = ALLOC_CLIENT_RUNNING
+    s.update_allocs_from_client(4, [up])
+    summ = s.job_summary("default", j.id)
+    assert summ.summary["web"].starting == 0
+    assert summ.summary["web"].running == 1
+    assert s.job_by_id("default", j.id).status == JOB_STATUS_RUNNING
+
+
+def test_update_allocs_from_client_preserves_server_fields():
+    s = StateStore()
+    n = mock.node()
+    j = mock.job()
+    s.upsert_node(1, n)
+    s.upsert_job(2, j)
+    a = mock.alloc_for(j, n)
+    s.upsert_allocs(3, [a])
+    up = a.copy()
+    up.client_status = ALLOC_CLIENT_COMPLETE
+    up.desired_status = "garbage-should-not-apply"
+    s.update_allocs_from_client(4, [up])
+    got = s.alloc_by_id(a.id)
+    assert got.client_status == ALLOC_CLIENT_COMPLETE
+    assert got.desired_status == "run"  # server-owned field untouched
+
+
+def test_snapshot_min_index_blocks_until_write():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(5, n)
+
+    results = {}
+
+    def waiter():
+        snap = s.snapshot_min_index(9, timeout=5)
+        results["index"] = snap.latest_index()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert "index" not in results  # still blocked
+    s.update_node_status(9, n.id, NODE_STATUS_DOWN)
+    t.join(timeout=5)
+    assert results["index"] >= 9
+
+
+def test_snapshot_min_index_timeout():
+    s = StateStore()
+    with pytest.raises(TimeoutError):
+        s.snapshot_min_index(100, timeout=0.05)
+
+
+def test_scheduler_config_roundtrip():
+    s = StateStore()
+    cfg = SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU)
+    assert cfg.validate() == ""
+    s.set_scheduler_config(7, cfg)
+    got = s.get_scheduler_config()
+    assert got.scheduler_algorithm == SCHED_ALG_TPU
+    assert got.modify_index == 7
+
+
+def test_delete_job_cleans_tables():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(1, j)
+    s.delete_job(2, "default", j.id)
+    assert s.job_by_id("default", j.id) is None
+    assert s.job_versions_by_id("default", j.id) == []
+    assert s.job_summary("default", j.id) is None
+
+
+def test_ready_nodes_in_dcs():
+    s = StateStore()
+    n1, n2, n3 = mock.node(), mock.node(), mock.drained_node()
+    n2.datacenter = "dc2"
+    s.upsert_node(1, n1)
+    s.upsert_node(2, n2)
+    s.upsert_node(3, n3)
+    snap = s.snapshot()
+    ready = snap.ready_nodes_in_dcs(["dc1"])
+    assert [n.id for n in ready] == [n1.id]
+    assert len(snap.ready_nodes_in_dcs(["dc1", "dc2"])) == 2
+
+
+def test_job_status_computation():
+    s = StateStore()
+    j = mock.job()
+    j.stop = True
+    s.upsert_job(1, j)
+    assert s.job_by_id("default", j.id).status == JOB_STATUS_DEAD
