@@ -155,6 +155,12 @@ class BinPackIterator(RankIterator):
                 disk_mb=tg.ephemeral_disk.size_mb,
                 networks=[offer], ports=total.shared.ports)
 
+        # one device allocator per node attempt — offers reserved as assigned
+        # so multiple device asks never double-book an instance
+        from .device import DeviceAllocator
+        dev_alloc = DeviceAllocator(ctx, node)
+        dev_alloc.add_allocs(proposed)
+
         # per-task resources (ref rank.go:325-470)
         for task in tg.tasks:
             tr = AllocatedTaskResources(
@@ -185,16 +191,11 @@ class BinPackIterator(RankIterator):
 
             # devices (ref rank.go:389-436)
             for req in task.resources.devices:
-                from .device import DeviceAllocator
-                dev_alloc = DeviceAllocator(ctx, node)
-                dev_alloc.add_allocs(proposed)
-                for assigned in total.tasks.values():
-                    for d in assigned.devices:
-                        dev_alloc.add_reserved(d)
                 offer_dev, affinity_score, err = dev_alloc.assign_device(req)
                 if offer_dev is None:
                     ctx.metrics.exhausted_node(node, f"devices: {err}")
                     return None
+                dev_alloc.add_reserved(offer_dev)
                 tr.devices.append(offer_dev)
                 if req.affinities:
                     option.scores.append(affinity_score)

@@ -137,8 +137,7 @@ class GenericStack:
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
         self.tg_csi_volumes.set_volumes(tg.volumes)
-        if tg.networks:
-            self.tg_network.set_network(tg.networks[0])
+        self.tg_network.set_network(tg.networks[0] if tg.networks else None)
         self.distinct_hosts.set_task_group(tg)
         self.distinct_property.set_task_group(tg)
         self.wrapped_checks.set_task_group(tg.name)
@@ -203,8 +202,7 @@ class SystemStack:
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
         self.tg_csi_volumes.set_volumes(tg.volumes)
-        if tg.networks:
-            self.tg_network.set_network(tg.networks[0])
+        self.tg_network.set_network(tg.networks[0] if tg.networks else None)
         self.distinct_property.set_task_group(tg)
         self.wrapped_checks.set_task_group(tg.name)
         self.bin_pack.set_task_group(tg)

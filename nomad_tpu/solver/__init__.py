@@ -1,0 +1,15 @@
+"""TPU batched placement solver — the north star (BASELINE.json): the
+scheduler's scoring loop as dense XLA programs over node×resource matrices,
+registered as SchedulerAlgorithm="tpu-batch" next to binpack/spread.
+"""
+from .kernels import (  # noqa: F401
+    fill_greedy_binpack, instance_capacity, place_chunked,
+    preemption_distance, preempt_top_k, score_fit,
+    NUM_XR, XR_CPU, XR_MEM, XR_DISK, XR_PORTS, XR_MBITS,
+)
+from .tensorize import (  # noqa: F401
+    GroupTensors, alloc_usage_row, build_group_tensors, group_ask_row,
+    node_capacity_row,
+)
+from .placer import SolverPlacer  # noqa: F401
+from .sharding import make_mesh, sharded_fill_greedy  # noqa: F401

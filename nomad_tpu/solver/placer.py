@@ -1,0 +1,317 @@
+"""SolverPlacer: the bridge between GenericScheduler and the TPU batched
+solver — the SchedulerAlgorithm="tpu-batch" implementation (north star,
+BASELINE.json).
+
+Division of labor (SURVEY.md hard parts 2-3):
+  * device: feasibility-masked capacity + scoring + greedy placement counts
+    over the whole node axis at once (no log2(N) sampling — the full matrix);
+  * host: exact sequential resources for the chosen nodes only — ports via
+    NetworkIndex, device instances, cpuset cores — with per-node retry; any
+    node the exact pass rejects is masked and re-solved.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..structs import (
+    AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
+    Allocation, AllocDeploymentStatus, NetworkIndex, new_id,
+)
+from ..scheduler.stack import SelectOptions
+from .kernels import fill_greedy_binpack, place_chunked
+from .tensorize import build_group_tensors
+
+
+class SolverPlacer:
+    def __init__(self, sched):
+        self.sched = sched                # GenericScheduler
+        self.ctx = sched.ctx
+        self.state = sched.state
+        self.plan = sched.plan
+
+    def compute_placements(self, destructive, place) -> bool:
+        sched = self.sched
+        from ..scheduler.reconcile import AllocPlaceResult
+
+        deployment_id = ""
+        if sched.deployment is not None and sched.deployment.active():
+            deployment_id = sched.deployment.id
+        if sched.plan.deployment is not None:
+            deployment_id = sched.plan.deployment.id
+
+        # stop destructive old allocs first (atomic place/stop pairing)
+        for missing in destructive:
+            self.plan.append_stopped_alloc(
+                missing.stop_alloc, missing.stop_status_description)
+
+        # group placements by task group; instances of one TG are identical.
+        # Placements tied to a previous alloc (reschedules, migrations,
+        # sticky disks) keep the host path: they carry penalty/preference
+        # state the batched kernel doesn't model.
+        by_tg: dict[str, list] = {}
+        leftovers: list = []
+        for missing in list(destructive) + list(place):
+            is_place = isinstance(missing, AllocPlaceResult)
+            tg = missing.task_group if is_place else missing.place_task_group
+            if sched.job.lookup_task_group(tg.name) is None:
+                continue
+            prev = missing.previous_alloc if is_place else None
+            if prev is not None or (is_place and missing.canary):
+                leftovers.append(missing)
+            else:
+                by_tg.setdefault(tg.name, []).append(missing)
+
+        nodes = sched._ready_nodes
+        for tg_name, missings in by_tg.items():
+            tg = sched.job.lookup_task_group(tg_name)
+            placed_map = self._solve_group(tg, nodes, len(missings))
+            # expand per-node counts into concrete allocations
+            node_iter = [(node, k) for node, k in placed_map if k > 0]
+            mi = 0
+            for node, k in node_iter:
+                for _ in range(int(k)):
+                    if mi >= len(missings):
+                        break
+                    missing = missings[mi]
+                    if self._place_one(missing, tg, node, deployment_id):
+                        mi += 1
+                    else:
+                        break  # node rejected exact assignment; re-queue rest
+            leftovers.extend(missings[mi:])
+
+        # host fallback for anything the batched pass couldn't place
+        # (port-exhausted nodes, distinct_property, sticky disks, canaries
+        #  with preferred nodes, preemption)
+        if leftovers:
+            return self._fallback(leftovers, deployment_id)
+        return True
+
+    # ------------------------------------------------------------- solving
+
+    def _solve_group(self, tg, nodes, count: int):
+        """Run the batched kernel; returns [(node, count)] sorted best-first.
+        Returns [] for shapes the kernels don't model yet — those placements
+        take the host stack path, which handles them exactly."""
+        if not nodes or count == 0:
+            return []
+        job = self.sched.job
+        from ..structs import OP_DISTINCT_PROPERTY
+        # host-only features: affinities, distinct_property, targeted /
+        # multiple / negative spreads
+        if job.affinities or tg.affinities or \
+           any(t.affinities for t in tg.tasks):
+            return []
+        if any(c.operand == OP_DISTINCT_PROPERTY
+               for c in list(job.constraints) + list(tg.constraints)):
+            return []
+        spreads = list(job.spreads) + list(tg.spreads)
+        if len(spreads) > 1 or any(
+                s.weight <= 0 or s.spread_target for s in spreads):
+            return []
+
+        feasible_fn = self._feasibility_fn(tg)
+        gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn)
+        max_per_node = 1 if gt.distinct_hosts else 2 ** 30
+        use_chunked = (
+            self.ctx.scheduler_config.effective_scheduler_algorithm() == "spread"
+            or bool(spreads))
+        if use_chunked:
+            spread_w = (spreads[0].weight / 100.0) if spreads else 0.0
+            placed = place_chunked(
+                jnp.asarray(gt.cap), jnp.asarray(gt.used),
+                jnp.asarray(gt.ask), jnp.int32(count),
+                jnp.asarray(gt.feasible), jnp.asarray(gt.job_collisions),
+                jnp.int32(tg.count), jnp.asarray(gt.prop_ids),
+                jnp.asarray(gt.prop_counts), jnp.float32(spread_w),
+                max_per_node=max_per_node)
+        else:
+            placed = fill_greedy_binpack(
+                jnp.asarray(gt.cap), jnp.asarray(gt.used),
+                jnp.asarray(gt.ask), jnp.int32(count),
+                jnp.asarray(gt.feasible), max_per_node=max_per_node)
+        placed = np.asarray(placed)
+        order = np.argsort(-placed)
+        return [(gt.nodes[i], int(placed[i])) for i in order if placed[i] > 0]
+
+    def _feasibility_fn(self, tg):
+        """Irregular host-side checks with per-class caching — the solver's
+        escape hatch for non-tensorizable constraints."""
+        stack = self.sched.stack
+        from ..scheduler.stack import _task_group_constraints
+        drivers, constraints = _task_group_constraints(tg)
+        stack.tg_drivers.set_drivers(drivers)
+        stack.tg_constraint.set_constraints(constraints)
+        stack.tg_devices.set_task_group(tg)
+        stack.tg_host_volumes.set_volumes("", tg.volumes)
+        stack.tg_csi_volumes.set_volumes(tg.volumes)
+        stack.tg_network.set_network(tg.networks[0] if tg.networks else None)
+        elig = self.ctx.eligibility
+        job_checks = [stack.job_constraint]
+        tg_checks = [stack.tg_drivers, stack.tg_constraint,
+                     stack.tg_host_volumes, stack.tg_devices,
+                     stack.tg_network, stack.tg_csi_volumes]
+
+        from ..scheduler.context import (
+            EVAL_COMPUTED_CLASS_ELIGIBLE, EVAL_COMPUTED_CLASS_INELIGIBLE,
+            EVAL_COMPUTED_CLASS_UNKNOWN)
+
+        def feasible(node) -> bool:
+            klass = node.computed_class
+            st = elig.job_status(klass)
+            if st == EVAL_COMPUTED_CLASS_INELIGIBLE:
+                return False
+            if st != EVAL_COMPUTED_CLASS_ELIGIBLE:
+                ok = all(c.feasible(node) for c in job_checks)
+                if st == EVAL_COMPUTED_CLASS_UNKNOWN:
+                    elig.set_job_eligibility(ok, klass)
+                if not ok:
+                    return False
+            st = elig.task_group_status(tg.name, klass)
+            if st == EVAL_COMPUTED_CLASS_INELIGIBLE:
+                return False
+            if st != EVAL_COMPUTED_CLASS_ELIGIBLE:
+                ok = all(c.feasible(node) for c in tg_checks)
+                if st == EVAL_COMPUTED_CLASS_UNKNOWN:
+                    elig.set_task_group_eligibility(ok, tg.name, klass)
+                if not ok:
+                    return False
+            return True
+
+        return feasible
+
+    # ------------------------------------------------- exact host assignment
+
+    def _place_one(self, missing, tg, node, deployment_id: str) -> bool:
+        """Exact sequential-resource assignment on the chosen node (ports,
+        devices, cores) and plan append. Returns False if the node rejects."""
+        from ..scheduler.reconcile import AllocPlaceResult
+        sched = self.sched
+        name = (missing.name if isinstance(missing, AllocPlaceResult)
+                else missing.place_name)
+        prev = (missing.previous_alloc
+                if isinstance(missing, AllocPlaceResult)
+                else missing.stop_alloc)
+
+        proposed = self.ctx.proposed_allocs(node.id)
+        net_idx = NetworkIndex()
+        net_idx.set_node(node)
+        net_idx.add_allocs(proposed)
+
+        from ..scheduler.device import DeviceAllocator
+        dev_alloc = DeviceAllocator(self.ctx, node)
+        dev_alloc.add_allocs(proposed)
+
+        total = AllocatedResources(
+            shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb))
+        if tg.networks:
+            offer, err = net_idx.assign_network(tg.networks[0])
+            if offer is None:
+                return False
+            net_idx.add_reserved(offer)
+            total.shared.networks = [offer]
+            total.shared.ports = [
+                {"label": p.label, "value": p.value, "to": p.to,
+                 "host_ip": offer.ip}
+                for p in offer.reserved_ports + offer.dynamic_ports]
+        for task in tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu_shares=task.resources.cpu,
+                memory_mb=task.resources.memory_mb)
+            if self.ctx.scheduler_config.memory_oversubscription_enabled:
+                tr.memory_max_mb = task.resources.memory_max_mb
+            if task.resources.networks:
+                offer, err = net_idx.assign_network(task.resources.networks[0])
+                if offer is None:
+                    return False
+                net_idx.add_reserved(offer)
+                tr.networks = [offer]
+            for req in task.resources.devices:
+                offer_dev, _, err = dev_alloc.assign_device(req)
+                if offer_dev is None:
+                    return False
+                dev_alloc.add_reserved(offer_dev)
+                tr.devices.append(offer_dev)
+            if task.resources.cores > 0:
+                node_cores = set(node.node_resources.cpu.reservable_cores)
+                taken = set()
+                for a in proposed:
+                    taken |= set(a.comparable_resources().reserved_cores)
+                for assigned in total.tasks.values():
+                    taken |= set(assigned.reserved_cores)
+                avail = sorted(node_cores - taken)
+                if len(avail) < task.resources.cores:
+                    return False
+                tr.reserved_cores = tuple(avail[:task.resources.cores])
+            total.tasks[task.name] = tr
+
+        alloc = Allocation(
+            id=new_id(),
+            namespace=sched.eval.namespace,
+            eval_id=sched.eval.id,
+            name=name,
+            job_id=sched.eval.job_id,
+            task_group=tg.name,
+            metrics=self.ctx.metrics.copy(),
+            node_id=node.id,
+            node_name=node.name,
+            deployment_id=deployment_id,
+            allocated_resources=total,
+            desired_status="run",
+            client_status="pending",
+        )
+        if prev is not None:
+            alloc.previous_allocation = prev.id
+            if isinstance(missing, AllocPlaceResult) and missing.reschedule:
+                sched._update_reschedule_tracker(alloc, prev)
+        if deployment_id and isinstance(missing, AllocPlaceResult) and \
+           missing.canary:
+            alloc.deployment_status = AllocDeploymentStatus(canary=True)
+            if self.plan.deployment is not None:
+                ds = self.plan.deployment.task_groups.get(tg.name)
+                if ds is not None:
+                    ds.placed_canaries.append(alloc.id)
+        self.plan.append_alloc(alloc, None)
+        return True
+
+    def _fallback(self, leftovers, deployment_id: str) -> bool:
+        """Per-alloc stack selection for what batching couldn't handle."""
+        from ..scheduler.reconcile import AllocPlaceResult
+        sched = self.sched
+        for missing in leftovers:
+            tg = (missing.task_group if isinstance(missing, AllocPlaceResult)
+                  else missing.place_task_group)
+            name = (missing.name if isinstance(missing, AllocPlaceResult)
+                    else missing.place_name)
+            prev = (missing.previous_alloc
+                    if isinstance(missing, AllocPlaceResult)
+                    else missing.stop_alloc)
+            options = SelectOptions(alloc_name=name)
+            if prev is not None:
+                options.penalty_node_ids = {prev.node_id}
+            option = sched._select_next_option(tg, options)
+            sched.ctx.metrics.nodes_available = dict(sched._nodes_by_dc)
+            if option is None:
+                is_destructive = not isinstance(missing, AllocPlaceResult)
+                if is_destructive:
+                    self.plan.pop_update(prev)
+                    sched.queued_allocs[tg.name] = \
+                        sched.queued_allocs.get(tg.name, 0) - 1
+                sched.failed_tg_allocs[tg.name] = sched.ctx.metrics.copy()
+                continue
+            sched._handle_preemptions(option)
+            resources = AllocatedResources(
+                tasks=dict(option.task_resources),
+                shared=option.alloc_resources or AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb))
+            alloc = Allocation(
+                id=new_id(), namespace=sched.eval.namespace,
+                eval_id=sched.eval.id, name=name, job_id=sched.eval.job_id,
+                task_group=tg.name, metrics=sched.ctx.metrics.copy(),
+                node_id=option.node.id, node_name=option.node.name,
+                deployment_id=deployment_id, allocated_resources=resources,
+                desired_status="run", client_status="pending")
+            if prev is not None:
+                alloc.previous_allocation = prev.id
+            self.plan.append_alloc(alloc, None)
+        return True

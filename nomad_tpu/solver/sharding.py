@@ -1,0 +1,54 @@
+"""Multi-chip sharding for the solver (SURVEY.md §2.7: node axis over ICI).
+
+The recipe (scaling-book style): pick a Mesh, annotate input shardings, let
+GSPMD insert the collectives. The node axis shards across the "nodes" mesh
+axis; eval batches shard across "evals" (data parallel over evaluations —
+the TPU analog of the reference's per-core scheduler workers,
+ref nomad/server.go:1581).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .kernels import fill_greedy_binpack
+
+
+def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    import numpy as np
+    return Mesh(np.array(devices), (axis,))
+
+
+def sharded_fill_greedy(mesh: Mesh, axis: str = "nodes"):
+    """Jit fill_greedy_binpack with the node axis sharded over the mesh.
+
+    The argsort/cumsum over the node axis become XLA collectives; everything
+    else stays node-local. Returns a function (cap, used, ask, count,
+    feasible) -> placements i32[N]."""
+    node_sharded = NamedSharding(mesh, P(axis, None))
+    vec_sharded = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+
+    return jax.jit(
+        fill_greedy_binpack,
+        in_shardings=(node_sharded, node_sharded, replicated, replicated,
+                      vec_sharded),
+        out_shardings=vec_sharded)
+
+
+def sharded_eval_batch_fill_greedy(mesh: Mesh, node_axis: str = "nodes",
+                                   eval_axis: str = "evals"):
+    """Batched solve: vmap over an eval axis (data parallel) with the node
+    axis model-parallel — many evaluations' placement problems in one
+    dispatch (SURVEY.md §2.7 row 1)."""
+    batched = jax.vmap(fill_greedy_binpack,
+                       in_axes=(0, 0, 0, 0, 0), out_axes=0)
+    spec2 = NamedSharding(mesh, P(eval_axis, node_axis, None))
+    spec1 = NamedSharding(mesh, P(eval_axis, node_axis))
+    spec_b = NamedSharding(mesh, P(eval_axis))
+    spec_ask = NamedSharding(mesh, P(eval_axis, None))
+    return jax.jit(batched,
+                   in_shardings=(spec2, spec2, spec_ask, spec_b, spec1),
+                   out_shardings=spec1)

@@ -1,0 +1,155 @@
+"""Host-side lowering: objects -> dense tensors for the TPU solver.
+
+This is the critical contract of the dual representation (SURVEY.md §7.1):
+irregular things (attribute maps, regexp/version constraints, port bitmaps)
+are resolved HERE, once per (eval, task group), into flat arrays; the device
+only ever sees f32/i32 matrices and boolean masks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..structs import (
+    Allocation, Node, TaskGroup, DEFAULT_MAX_DYNAMIC_PORT,
+    DEFAULT_MIN_DYNAMIC_PORT, OP_DISTINCT_HOSTS,
+)
+from .kernels import NUM_XR, XR_CPU, XR_DISK, XR_MBITS, XR_MEM, XR_PORTS
+
+DYN_PORT_SPAN = DEFAULT_MAX_DYNAMIC_PORT - DEFAULT_MIN_DYNAMIC_PORT + 1
+
+
+@dataclasses.dataclass
+class GroupTensors:
+    """Per-(eval, task group) solver input."""
+    nodes: list[Node]                  # row i of every array is nodes[i]
+    cap: np.ndarray                    # f32[N, R'] usable capacity
+    used: np.ndarray                   # f32[N, R'] proposed utilization
+    feasible: np.ndarray               # bool[N] irregular-constraint verdicts
+    ask: np.ndarray                    # f32[R'] per-instance claim
+    job_collisions: np.ndarray         # i32[N] same job+tg proposed allocs
+    prop_ids: np.ndarray               # i32[N] spread-attribute value ids (-1 none)
+    prop_counts: np.ndarray            # i32[P] usage per value id
+    prop_values: list[str]             # id -> value
+    distinct_hosts: bool
+
+
+def node_capacity_row(node: Node) -> np.ndarray:
+    """Usable capacity (total − node reservation) in extended layout."""
+    row = np.zeros(NUM_XR, np.float32)
+    res, rsv = node.node_resources, node.reserved_resources
+    row[XR_CPU] = max(0, res.cpu.cpu_shares - rsv.cpu_shares)
+    row[XR_MEM] = max(0, res.memory.memory_mb - rsv.memory_mb)
+    row[XR_DISK] = max(0, res.disk.disk_mb - rsv.disk_mb)
+    row[XR_PORTS] = DYN_PORT_SPAN
+    row[XR_MBITS] = sum(n.mbits for n in res.networks) or 0
+    return row
+
+
+def alloc_usage_row(alloc: Allocation) -> np.ndarray:
+    row = np.zeros(NUM_XR, np.float32)
+    c = alloc.comparable_resources()
+    mem_claim = c.memory_max_mb if c.memory_max_mb > c.memory_mb else c.memory_mb
+    row[XR_CPU] = c.cpu_shares
+    row[XR_MEM] = mem_claim
+    row[XR_DISK] = c.disk_mb
+    ports = 0
+    mbits = 0
+    res = alloc.allocated_resources
+    nets = list(res.shared.networks)
+    for tr in res.tasks.values():
+        nets.extend(tr.networks)
+    for net in nets:
+        mbits += net.mbits
+        ports += len(net.dynamic_ports)
+        ports += sum(1 for p in net.reserved_ports
+                     if DEFAULT_MIN_DYNAMIC_PORT <= p.value
+                     <= DEFAULT_MAX_DYNAMIC_PORT)
+    row[XR_PORTS] = ports
+    row[XR_MBITS] = mbits
+    return row
+
+
+def group_ask_row(tg: TaskGroup) -> np.ndarray:
+    """Per-instance claim vector for one task group."""
+    row = np.zeros(NUM_XR, np.float32)
+    row[XR_DISK] = tg.ephemeral_disk.size_mb
+    for net in tg.networks:
+        row[XR_PORTS] += len(net.dynamic_ports)
+        row[XR_MBITS] += net.mbits
+    for task in tg.tasks:
+        r = task.resources
+        row[XR_CPU] += r.cpu
+        mem = r.memory_max_mb if r.memory_max_mb > r.memory_mb else r.memory_mb
+        row[XR_MEM] += mem
+        for net in r.networks:
+            row[XR_PORTS] += len(net.dynamic_ports)
+            row[XR_MBITS] += net.mbits
+    return row
+
+
+def build_group_tensors(ctx, job, tg: TaskGroup, nodes: list[Node],
+                        feasible_fn) -> GroupTensors:
+    """Lower one task group's placement problem.
+
+    feasible_fn(node) -> bool runs the irregular host-side checks (constraint
+    operators, drivers, volumes, devices) — typically the stack's
+    FeasibilityWrapper drained per class, so cost is O(classes), not O(N).
+    """
+    n = len(nodes)
+    cap = np.zeros((n, NUM_XR), np.float32)
+    used = np.zeros((n, NUM_XR), np.float32)
+    feasible = np.zeros(n, bool)
+    collisions = np.zeros(n, np.int32)
+
+    # spread attribute (first spread stanza; others fall back host-side)
+    spread_attr = None
+    for s in list(job.spreads) + list(tg.spreads):
+        spread_attr = s.attribute
+        break
+    prop_ids = np.full(n, -1, np.int32)
+    value_ids: dict[str, int] = {}
+    prop_counts_map: dict[int, int] = {}
+
+    distinct_hosts = any(c.operand == OP_DISTINCT_HOSTS
+                         for c in list(job.constraints) + list(tg.constraints))
+
+    from ..scheduler.feasible import resolve_target
+
+    for i, node in enumerate(nodes):
+        cap[i] = node_capacity_row(node)
+        feasible[i] = feasible_fn(node)
+        proposed = ctx.proposed_allocs(node.id)
+        for alloc in proposed:
+            used[i] += alloc_usage_row(alloc)
+            if alloc.job_id == job.id and alloc.task_group == tg.name:
+                collisions[i] += 1
+        if spread_attr is not None:
+            val, ok = resolve_target(spread_attr, node)
+            if ok and val is not None:
+                vid = value_ids.setdefault(str(val), len(value_ids))
+                prop_ids[i] = vid
+                prop_counts_map[vid] = prop_counts_map.get(vid, 0) + int(collisions[i])
+        if distinct_hosts and collisions[i] > 0:
+            feasible[i] = False
+
+    n_props = max(1, len(value_ids))
+    prop_counts = np.zeros(n_props, np.int32)
+    for vid, cnt in prop_counts_map.items():
+        prop_counts[vid] = cnt
+
+    return GroupTensors(
+        nodes=nodes,
+        cap=cap,
+        used=used,
+        feasible=feasible,
+        ask=group_ask_row(tg),
+        job_collisions=collisions,
+        prop_ids=prop_ids,
+        prop_counts=prop_counts,
+        prop_values=[v for v, _ in sorted(value_ids.items(),
+                                          key=lambda kv: kv[1])],
+        distinct_hosts=distinct_hosts,
+    )

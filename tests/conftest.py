@@ -1,11 +1,18 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so all
-sharding/collective paths are exercised without TPU hardware."""
+sharding/collective paths are exercised without TPU hardware.
+
+Note: the environment's sitecustomize may import jax at interpreter startup
+(before this file runs), so setting JAX_PLATFORMS here is too late — use
+jax.config.update, which works until a backend is initialized."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

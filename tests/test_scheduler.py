@@ -367,3 +367,29 @@ def test_tpu_algorithm_falls_back_without_solver():
     h.state.upsert_job(h.get_next_index(), job)
     process(h, job)
     assert len(h.state.allocs_by_job("default", job.id)) == 2
+
+
+def test_multiple_device_asks_no_double_booking():
+    # regression: two device asks in one task must get distinct instances
+    from nomad_tpu.structs import (NodeDevice, NodeDeviceResource,
+                                   RequestedDevice)
+    h = Harness()
+    n = mock.node()
+    n.node_resources.devices = [NodeDeviceResource(
+        vendor="nvidia", type="gpu", name="1080ti",
+        instances=[NodeDevice(id="gpu-0"), NodeDevice(id="gpu-1")])]
+    n.compute_class()
+    h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    task = job.task_groups[0].tasks[0]
+    task.resources.networks = []
+    task.resources.devices = [RequestedDevice(name="nvidia/gpu", count=1),
+                              RequestedDevice(name="nvidia/gpu", count=1)]
+    h.state.upsert_job(h.get_next_index(), job)
+    process(h, job)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 1
+    devs = allocs[0].allocated_resources.tasks["web"].devices
+    ids = [i for d in devs for i in d.device_ids]
+    assert sorted(ids) == ["gpu-0", "gpu-1"]

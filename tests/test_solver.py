@@ -1,0 +1,246 @@
+"""TPU solver tests: kernels vs the scalar oracle, the tpu-batch scheduler
+algorithm end-to-end, and multi-device sharding on the virtual CPU mesh."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.solver import (
+    fill_greedy_binpack, instance_capacity, make_mesh, place_chunked,
+    score_fit, sharded_fill_greedy, node_capacity_row, group_ask_row,
+    NUM_XR, XR_CPU, XR_MEM,
+)
+from nomad_tpu.structs import (
+    ComparableResources, Evaluation, SchedulerConfiguration, Spread,
+    score_fit_binpack, score_fit_spread, SCHED_ALG_TPU,
+)
+
+
+def _rand_cluster(n, seed=0):
+    rng = np.random.default_rng(seed)
+    cap = np.zeros((n, NUM_XR), np.float32)
+    cap[:, 0] = rng.choice([2000, 4000, 8000], n)     # cpu
+    cap[:, 1] = rng.choice([4096, 8192, 16384], n)    # mem
+    cap[:, 2] = 100_000
+    cap[:, 3] = 12001
+    cap[:, 4] = 1000
+    used = np.zeros_like(cap)
+    used[:, 0] = rng.integers(0, 1500, n)
+    used[:, 1] = rng.integers(0, 2000, n)
+    return cap, used
+
+
+def test_score_fit_matches_scalar_oracle():
+    node = mock.node()
+    cap = node_capacity_row(node)[None, :]
+    for frac in (0.0, 0.25, 0.5, 0.9):
+        used = cap * frac
+        used_c = ComparableResources(cpu_shares=int(used[0, XR_CPU]),
+                                     memory_mb=int(used[0, XR_MEM]))
+        want_bp = score_fit_binpack(node, used_c)
+        want_sp = score_fit_spread(node, used_c)
+        got_bp = float(score_fit(jnp.asarray(cap), jnp.asarray(used))[0])
+        got_sp = float(score_fit(jnp.asarray(cap), jnp.asarray(used),
+                                 spread=True)[0])
+        assert abs(got_bp - want_bp) < 1e-3, frac
+        assert abs(got_sp - want_sp) < 1e-3, frac
+
+
+def test_instance_capacity():
+    cap, used = _rand_cluster(16)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 500, 256
+    feas = np.ones(16, bool)
+    feas[3] = False
+    got = np.asarray(instance_capacity(jnp.asarray(cap), jnp.asarray(used),
+                                       jnp.asarray(ask), jnp.asarray(feas)))
+    for i in range(16):
+        want = min((cap[i, 0] - used[i, 0]) // 500,
+                   (cap[i, 1] - used[i, 1]) // 256)
+        if i == 3:
+            want = 0
+        assert got[i] == max(0, want), i
+
+
+def _greedy_oracle(cap, used, ask, count, feas):
+    """Scalar sequential greedy binpack (the reference semantics)."""
+    used = used.copy()
+    placed = np.zeros(cap.shape[0], np.int64)
+    for _ in range(count):
+        best, best_score = -1, -1.0
+        for i in range(cap.shape[0]):
+            if not feas[i]:
+                continue
+            if np.any((cap[i] - used[i] < ask) & (ask > 0)):
+                continue
+            free = 1.0 - (used[i, :2] / cap[i, :2])
+            score = min(18.0, max(0.0, 20.0 - np.sum(np.power(10.0, free))))
+            if score > best_score:
+                best, best_score = i, score
+        if best < 0:
+            break
+        placed[best] += 1
+        used[best] += ask
+    return placed
+
+
+def test_fill_greedy_matches_sequential_oracle():
+    cap, used = _rand_cluster(24, seed=7)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 650, 400
+    feas = np.ones(24, bool)
+    feas[[2, 11]] = False
+    count = 40
+    want = _greedy_oracle(cap, used, ask, count, feas)
+    got = np.asarray(fill_greedy_binpack(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.int32(count), jnp.asarray(feas)))
+    # exact greedy equivalence: same placement counts per node
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == count
+
+
+def test_fill_greedy_respects_capacity_limits():
+    cap, used = _rand_cluster(8, seed=3)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 1000, 1024
+    feas = np.ones(8, bool)
+    got = np.asarray(fill_greedy_binpack(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.int32(10_000), jnp.asarray(feas)))
+    # never overcommits any node
+    for i in range(8):
+        assert used[i, 0] + got[i] * 1000 <= cap[i, 0]
+        assert used[i, 1] + got[i] * 1024 <= cap[i, 1]
+
+
+def test_fill_greedy_max_per_node():
+    cap, used = _rand_cluster(8, seed=3)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0] = 100
+    got = np.asarray(fill_greedy_binpack(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.int32(8), jnp.ones(8, bool), max_per_node=1))
+    assert got.max() == 1 and got.sum() == 8
+
+
+def test_place_chunked_spreads_evenly():
+    # 2 property values (dc ids), even spread: 8 instances -> 4/4 split
+    n = 8
+    cap = np.zeros((n, NUM_XR), np.float32)
+    cap[:, 0], cap[:, 1], cap[:, 2] = 4000, 8192, 100000
+    cap[:, 3], cap[:, 4] = 12001, 1000
+    used = np.zeros_like(cap)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 500, 256
+    prop_ids = np.array([0, 0, 0, 0, 1, 1, 1, 1], np.int32)
+    placed = np.asarray(place_chunked(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask), jnp.int32(8),
+        jnp.ones(n, bool), jnp.zeros(n, jnp.int32), jnp.int32(8),
+        jnp.asarray(prop_ids), jnp.zeros(2, jnp.int32), jnp.float32(1.0),
+        max_steps=8))
+    assert placed.sum() == 8
+    assert placed[:4].sum() == 4 and placed[4:].sum() == 4
+
+
+def test_tpu_scheduler_places_like_binpack():
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for _ in range(10):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 15
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 15
+    assert h.evals[-1].status == "complete"
+    assert not h.evals[-1].failed_tg_allocs
+    # binpack concentration: CPU-capped at 7 per node => at most 3 nodes
+    by_node = {}
+    for a in allocs:
+        by_node[a.node_id] = by_node.get(a.node_id, 0) + 1
+    assert len(by_node) <= 3
+    # every alloc has exact ports assigned host-side
+    for a in allocs:
+        tr = a.allocated_resources.tasks["web"]
+        assert len(tr.networks[0].dynamic_ports) == 2
+        assert all(p.value > 0 for p in tr.networks[0].dynamic_ports)
+    # no duplicate ports on a node
+    for node_id in by_node:
+        seen = set()
+        for a in allocs:
+            if a.node_id != node_id:
+                continue
+            for p in a.allocated_resources.tasks["web"].networks[0].dynamic_ports:
+                assert p.value not in seen
+                seen.add(p.value)
+
+
+def test_tpu_scheduler_with_spread_stanza():
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for i in range(4):
+        n = mock.node()
+        n.datacenter = "dc1" if i % 2 == 0 else "dc2"
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 8
+    job.task_groups[0].tasks[0].resources.networks = []
+    job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 8
+    by_dc = {}
+    for a in allocs:
+        dc = h.state.node_by_id(a.node_id).datacenter
+        by_dc[dc] = by_dc.get(dc, 0) + 1
+    assert by_dc == {"dc1": 4, "dc2": 4}
+
+
+def test_tpu_scheduler_infeasible_constraint_blocks():
+    from nomad_tpu.structs import Constraint
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.job()
+    job.constraints = [Constraint(ltarget="${attr.kernel.name}",
+                                  rtarget="windows")]
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    assert h.state.allocs_by_job("default", job.id) == []
+    assert h.evals[-1].failed_tg_allocs
+
+
+def test_sharded_fill_greedy_on_8_device_mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh()
+    solve = sharded_fill_greedy(mesh)
+    n = 1024  # divisible by 8
+    cap, used = _rand_cluster(n, seed=11)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 500, 256
+    feas = np.ones(n, bool)
+    count = 2000
+    got = np.asarray(solve(jnp.asarray(cap), jnp.asarray(used),
+                           jnp.asarray(ask), jnp.int32(count),
+                           jnp.asarray(feas)))
+    want = np.asarray(fill_greedy_binpack(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.int32(count), jnp.asarray(feas)))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == count
