@@ -1,0 +1,130 @@
+"""Core (GC) scheduler (ref nomad/core_sched.go:27): internal `_core` evals
+garbage-collect terminal evals/allocs, dead jobs, down nodes and finished
+deployments past a GC threshold.
+"""
+from __future__ import annotations
+
+import time
+
+from ..structs import (
+    Evaluation, CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_FORCE_GC, DEPLOYMENT_TERMINAL,
+    JOB_STATUS_DEAD, EVAL_STATUS_COMPLETE,
+)
+from .fsm import (DEPLOYMENT_DELETE, EVAL_DELETE, JOB_DEREGISTER,
+                  NODE_DEREGISTER)
+
+
+class CoreScheduler:
+    """Processes `_core` evaluations (job_id encodes the GC kind)."""
+
+    def __init__(self, server, eval_gc_threshold: float = 3600.0,
+                 job_gc_threshold: float = 4 * 3600.0,
+                 node_gc_threshold: float = 24 * 3600.0,
+                 deployment_gc_threshold: float = 3600.0):
+        self.server = server
+        self.eval_gc_threshold = eval_gc_threshold
+        self.job_gc_threshold = job_gc_threshold
+        self.node_gc_threshold = node_gc_threshold
+        self.deployment_gc_threshold = deployment_gc_threshold
+
+    def process(self, ev: Evaluation) -> None:
+        """ref core_sched.go Process"""
+        kind = ev.job_id
+        force = kind == CORE_JOB_FORCE_GC
+        if kind in (CORE_JOB_EVAL_GC,) or force:
+            self.eval_gc(force)
+        if kind in (CORE_JOB_JOB_GC,) or force:
+            self.job_gc(force)
+        if kind in (CORE_JOB_NODE_GC,) or force:
+            self.node_gc(force)
+        if kind in (CORE_JOB_DEPLOYMENT_GC,) or force:
+            self.deployment_gc(force)
+
+    def _cutoff(self, threshold: float, force: bool) -> float:
+        return time.time() if force else time.time() - threshold
+
+    def eval_gc(self, force: bool = False) -> int:
+        """ref core_sched.go:231 evalGC: terminal evals whose allocs are all
+        terminal."""
+        state = self.server.state
+        cutoff = self._cutoff(self.eval_gc_threshold, force)
+        gc_evals, gc_allocs = [], []
+        for ev in state.iter_evals():
+            if not ev.terminal_status():
+                continue
+            if ev.modify_time_unix and ev.modify_time_unix > cutoff:
+                continue
+            allocs = state.allocs_by_eval(ev.id)
+            if any(not a.terminal_status() for a in allocs):
+                continue
+            # batch-job evals are kept while the job lives (rerun protection)
+            job = state.job_by_id(ev.namespace, ev.job_id)
+            if job is not None and job.type == "batch" and \
+               job.status != JOB_STATUS_DEAD and not force:
+                continue
+            gc_evals.append(ev.id)
+            gc_allocs.extend(a.id for a in allocs)
+        if gc_evals:
+            self.server.raft.apply(EVAL_DELETE, {
+                "eval_ids": gc_evals, "alloc_ids": gc_allocs})
+        return len(gc_evals)
+
+    def job_gc(self, force: bool = False) -> int:
+        """ref core_sched.go:94 jobGC: dead jobs with no live evals/allocs."""
+        state = self.server.state
+        gc = []
+        for job in state.iter_jobs():
+            if job.status != JOB_STATUS_DEAD:
+                continue
+            if job.is_periodic() or job.is_parameterized():
+                continue
+            evals = state.evals_by_job(job.namespace, job.id)
+            if any(not e.terminal_status() for e in evals):
+                continue
+            allocs = state.allocs_by_job(job.namespace, job.id)
+            if any(not a.terminal_status() for a in allocs):
+                continue
+            gc.append(job)
+        for job in gc:
+            eval_ids = [e.id for e in state.evals_by_job(job.namespace, job.id)]
+            alloc_ids = [a.id for a in state.allocs_by_job(job.namespace, job.id)]
+            if eval_ids or alloc_ids:
+                self.server.raft.apply(EVAL_DELETE, {
+                    "eval_ids": eval_ids, "alloc_ids": alloc_ids})
+            self.server.raft.apply(JOB_DEREGISTER, {
+                "namespace": job.namespace, "job_id": job.id, "purge": True})
+        return len(gc)
+
+    def node_gc(self, force: bool = False) -> int:
+        """ref core_sched.go:434 nodeGC: down nodes without allocs."""
+        state = self.server.state
+        cutoff = self._cutoff(self.node_gc_threshold, force)
+        gc = []
+        for node in state.iter_nodes():
+            if not node.terminal_status():
+                continue
+            if node.status_updated_at > cutoff:
+                continue
+            if any(not a.terminal_status()
+                   for a in state.allocs_by_node(node.id)):
+                continue
+            gc.append(node.id)
+        if gc:
+            self.server.raft.apply(NODE_DEREGISTER, {"node_ids": gc})
+        return len(gc)
+
+    def deployment_gc(self, force: bool = False) -> int:
+        """ref core_sched.go deploymentGC"""
+        state = self.server.state
+        cutoff = self._cutoff(self.deployment_gc_threshold, force)
+        gc = []
+        for d in state.iter_deployments():
+            if d.status not in DEPLOYMENT_TERMINAL:
+                continue
+            if d.modify_time_unix and d.modify_time_unix > cutoff:
+                continue
+            gc.append(d.id)
+        if gc:
+            self.server.raft.apply(DEPLOYMENT_DELETE, {"deployment_ids": gc})
+        return len(gc)

@@ -1,0 +1,303 @@
+"""Eval broker: leader-only priority queue of evaluations with ack/nack
+semantics (ref nomad/eval_broker.go:47).
+
+Per-scheduler-type priority heaps; at most one eval per job outstanding —
+later evals for the same job wait in a pending map (dedup, ref
+eval_broker.go:182 Enqueue); nacked evals requeue with escalating delay;
+wait_until evals sit in a delay heap served by a timer thread
+(ref :758 runDelayedEvalsWatcher).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..structs import Evaluation, new_id
+
+DEFAULT_NACK_TIMEOUT = 60.0
+DEFAULT_INITIAL_NACK_DELAY = 1.0
+DEFAULT_SUBSEQUENT_NACK_DELAY = 20.0
+
+FAILED_QUEUE = "_failed"
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+                 initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
+                 subsequent_nack_delay: float = DEFAULT_SUBSEQUENT_NACK_DELAY,
+                 delivery_limit: int = 3):
+        self.nack_timeout = nack_timeout
+        self.initial_nack_delay = initial_nack_delay
+        self.subsequent_nack_delay = subsequent_nack_delay
+        self.delivery_limit = delivery_limit
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        self._seq = itertools.count()
+
+        # scheduler type -> heap of (-priority, seq, eval_id)
+        self._ready: dict[str, list] = {}
+        self._evals: dict[str, Evaluation] = {}        # eval_id -> eval
+        self._dequeue_count: dict[str, int] = {}       # eval_id -> deliveries
+        # (namespace, job_id) -> blocked evals waiting on the outstanding one
+        self._pending: dict[tuple[str, str], list[Evaluation]] = {}
+        self._outstanding_jobs: dict[tuple[str, str], str] = {}  # -> eval_id
+        self._ready_jobs: dict[tuple[str, str], str] = {}        # -> eval_id
+        self._unack: dict[str, dict] = {}              # eval_id -> {token, deadline}
+
+        # delayed evals: (wait_until, seq, eval)
+        self._delay_heap: list = []
+        self._timer: Optional[threading.Thread] = None
+        self._shutdown = False
+
+        self.stats = {"total_ready": 0, "total_unacked": 0,
+                      "total_pending": 0, "total_waiting": 0}
+
+    # ------------------------------------------------------------- control
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            was = self._enabled
+            self._enabled = enabled
+            if not enabled:
+                self._flush()
+            elif not was:
+                self._shutdown = False
+                self._timer = threading.Thread(
+                    target=self._run_delayed_watcher, daemon=True)
+                self._timer.start()
+            self._cond.notify_all()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def _flush(self) -> None:
+        self._ready.clear()
+        self._ready_jobs.clear()
+        self._evals.clear()
+        self._pending.clear()
+        self._outstanding_jobs.clear()
+        self._unack.clear()
+        self._dequeue_count.clear()
+        self._delay_heap = []
+        self._shutdown = True
+
+    # ------------------------------------------------------------- enqueue
+
+    def enqueue(self, eval: Evaluation) -> None:
+        with self._lock:
+            self._enqueue_locked(eval)
+
+    def enqueue_all(self, evals: list[tuple[Evaluation, str]]) -> None:
+        """Enqueue evals with optional ack tokens: an eval being re-enqueued
+        while outstanding is requeued once its current delivery acks/nacks
+        (ref eval_broker.go EnqueueAll)."""
+        with self._lock:
+            for ev, token in evals:
+                if token and ev.id in self._unack:
+                    # mark for requeue on ack
+                    self._unack[ev.id]["requeue"] = ev
+                else:
+                    self._enqueue_locked(ev)
+
+    def _enqueue_locked(self, ev: Evaluation) -> None:
+        if not self._enabled:
+            return
+        if ev.id in self._evals:
+            return
+        now = time.time()
+        if ev.wait_until_unix and ev.wait_until_unix > now:
+            heapq.heappush(self._delay_heap,
+                           (ev.wait_until_unix, next(self._seq), ev))
+            self.stats["total_waiting"] += 1
+            self._cond.notify_all()
+            return
+        if ev.wait_sec:
+            heapq.heappush(self._delay_heap,
+                           (now + ev.wait_sec, next(self._seq), ev))
+            self.stats["total_waiting"] += 1
+            self._cond.notify_all()
+            return
+        job_key = (ev.namespace, ev.job_id)
+        if ev.job_id and (job_key in self._outstanding_jobs or
+                          job_key in self._ready_jobs):
+            # dedup: at most one eval per job ready-or-outstanding; later
+            # ones wait in pending until it acks (ref eval_broker.go:182)
+            self._pending.setdefault(job_key, []).append(ev)
+            self.stats["total_pending"] += 1
+            return
+        self._evals[ev.id] = ev
+        if ev.job_id:
+            self._ready_jobs[job_key] = ev.id
+        heapq.heappush(self._ready.setdefault(ev.type, []),
+                       (-ev.priority, next(self._seq), ev.id))
+        self.stats["total_ready"] += 1
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------- dequeue
+
+    def dequeue(self, schedulers: list[str], timeout: Optional[float] = None
+                ) -> tuple[Optional[Evaluation], str]:
+        """Blocking dequeue; returns (eval, ack_token) (ref :335)."""
+        deadline = time.time() + timeout if timeout is not None else None
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return None, ""
+                best = self._pick_locked(schedulers)
+                if best is not None:
+                    return best
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None, ""
+                    self._cond.wait(remaining)
+                else:
+                    self._cond.wait(1.0)
+
+    def _pick_locked(self, schedulers: list[str]
+                     ) -> Optional[tuple[Evaluation, str]]:
+        best_key = None
+        best_queue = None
+        for sched in schedulers:
+            heap = self._ready.get(sched)
+            while heap and heap[0][2] not in self._evals:
+                heapq.heappop(heap)  # stale entry
+            if not heap:
+                continue
+            if best_key is None or heap[0] < best_key:
+                best_key = heap[0]
+                best_queue = sched
+        if best_queue is None:
+            return None
+        _, _, eval_id = heapq.heappop(self._ready[best_queue])
+        ev = self._evals.pop(eval_id)
+        if ev.job_id and self._ready_jobs.get((ev.namespace, ev.job_id)) == eval_id:
+            del self._ready_jobs[(ev.namespace, ev.job_id)]
+        self.stats["total_ready"] -= 1
+        token = new_id()
+        self._unack[eval_id] = {
+            "token": token,
+            "eval": ev,
+            "deadline": time.time() + self.nack_timeout,
+        }
+        self.stats["total_unacked"] += 1
+        self._dequeue_count[eval_id] = self._dequeue_count.get(eval_id, 0) + 1
+        if ev.job_id:
+            self._outstanding_jobs[(ev.namespace, ev.job_id)] = eval_id
+        return ev, token
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._lock:
+            rec = self._unack.get(eval_id)
+            return rec["token"] if rec else None
+
+    def outstanding_reset(self, eval_id: str, token: str) -> str:
+        """Reset the nack timer (heartbeat from a busy worker)."""
+        with self._lock:
+            rec = self._unack.get(eval_id)
+            if rec is None:
+                return "not outstanding"
+            if rec["token"] != token:
+                return "token mismatch"
+            rec["deadline"] = time.time() + self.nack_timeout
+            return ""
+
+    # ------------------------------------------------------------ ack/nack
+
+    def ack(self, eval_id: str, token: str) -> None:
+        """ref :537"""
+        with self._lock:
+            rec = self._unack.get(eval_id)
+            if rec is None or rec["token"] != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            del self._unack[eval_id]
+            self.stats["total_unacked"] -= 1
+            self._dequeue_count.pop(eval_id, None)
+            ev = rec["eval"]
+            job_key = (ev.namespace, ev.job_id)
+            if self._outstanding_jobs.get(job_key) == eval_id:
+                del self._outstanding_jobs[job_key]
+            # release one pending eval for this job
+            pending = self._pending.get(job_key)
+            if pending:
+                nxt = pending.pop(0)
+                if not pending:
+                    del self._pending[job_key]
+                self.stats["total_pending"] -= 1
+                self._enqueue_locked(nxt)
+            requeue = rec.get("requeue")
+            if requeue is not None:
+                self._enqueue_locked(requeue)
+            self._cond.notify_all()
+
+    def nack(self, eval_id: str, token: str) -> None:
+        """Failed delivery: requeue with delay or move to failed queue
+        (ref :601)."""
+        with self._lock:
+            rec = self._unack.get(eval_id)
+            if rec is None or rec["token"] != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            del self._unack[eval_id]
+            self.stats["total_unacked"] -= 1
+            ev = rec["eval"]
+            job_key = (ev.namespace, ev.job_id)
+            if self._outstanding_jobs.get(job_key) == eval_id:
+                del self._outstanding_jobs[job_key]
+            count = self._dequeue_count.get(eval_id, 1)
+            if count >= self.delivery_limit:
+                # dead-letter: deliver once more via the failed queue
+                self._evals[ev.id] = ev
+                if ev.job_id:
+                    self._ready_jobs[job_key] = ev.id
+                heapq.heappush(self._ready.setdefault(FAILED_QUEUE, []),
+                               (-ev.priority, next(self._seq), ev.id))
+                self.stats["total_ready"] += 1
+            else:
+                delay = (self.initial_nack_delay if count == 1
+                         else self.subsequent_nack_delay)
+                heapq.heappush(self._delay_heap,
+                               (time.time() + delay, next(self._seq), ev))
+                self.stats["total_waiting"] += 1
+            self._cond.notify_all()
+
+    # -------------------------------------------------------- delay watcher
+
+    def _run_delayed_watcher(self) -> None:
+        """ref :758 runDelayedEvalsWatcher"""
+        while True:
+            with self._lock:
+                if self._shutdown or not self._enabled:
+                    return
+                now = time.time()
+                while self._delay_heap and self._delay_heap[0][0] <= now:
+                    _, _, ev = heapq.heappop(self._delay_heap)
+                    self.stats["total_waiting"] -= 1
+                    ev = ev.copy()
+                    ev.wait_sec = 0.0
+                    ev.wait_until_unix = 0.0
+                    self._enqueue_locked(ev)
+                wait = 0.2
+                if self._delay_heap:
+                    wait = min(wait, max(0.01, self._delay_heap[0][0] - now))
+                self._cond.wait(wait)
+
+    def check_nack_timeouts(self) -> list[str]:
+        """Requeue unacked evals past their deadline; returns timed-out ids.
+        Called by the leader loop tick."""
+        out = []
+        with self._lock:
+            now = time.time()
+            for eval_id, rec in list(self._unack.items()):
+                if rec["deadline"] <= now:
+                    out.append(eval_id)
+                    try:
+                        self.nack(eval_id, rec["token"])
+                    except ValueError:
+                        pass
+        return out

@@ -1,0 +1,240 @@
+"""FSM + replicated log (ref nomad/fsm.go:194 nomadFSM.Apply and
+hashicorp/raft usage in nomad/server.go:1221).
+
+The FSM applies typed log messages to the state store. The log abstraction
+(`RaftLog`) assigns monotonically increasing indexes and (in the single-node
+implementation) applies synchronously; a multi-node consensus backend slots
+in behind the same `apply()` contract over DCN (SURVEY.md §2.7: consensus is
+a control-plane-host protocol, not a TPU workload).
+
+Snapshots (checkpoint/resume, SURVEY.md §5): pickle the state store tables +
+last index; restore rebuilds indexes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import threading
+import time
+from typing import Callable, Optional
+
+from ..state import StateStore
+from ..structs import (
+    Allocation, Deployment, DeploymentStatusUpdate, Evaluation, Job, Node,
+    SchedulerConfiguration,
+)
+
+# message types (ref nomad/structs.go MessageType consts / fsm.go:211-307)
+NODE_REGISTER = "NodeRegisterRequestType"
+NODE_DEREGISTER = "NodeDeregisterRequestType"
+NODE_UPDATE_STATUS = "NodeUpdateStatusRequestType"
+NODE_UPDATE_DRAIN = "NodeUpdateDrainRequestType"
+NODE_UPDATE_ELIGIBILITY = "NodeUpdateEligibilityRequestType"
+JOB_REGISTER = "JobRegisterRequestType"
+JOB_DEREGISTER = "JobDeregisterRequestType"
+EVAL_UPDATE = "EvalUpdateRequestType"
+EVAL_DELETE = "EvalDeleteRequestType"
+ALLOC_CLIENT_UPDATE = "AllocClientUpdateRequestType"
+ALLOC_UPDATE_DESIRED_TRANSITION = "AllocUpdateDesiredTransitionRequestType"
+APPLY_PLAN_RESULTS = "ApplyPlanResultsRequestType"
+DEPLOYMENT_STATUS_UPDATE = "DeploymentStatusUpdateRequestType"
+DEPLOYMENT_PROMOTE = "DeploymentPromoteRequestType"
+DEPLOYMENT_ALLOC_HEALTH = "DeploymentAllocHealthRequestType"
+SCHEDULER_CONFIG = "SchedulerConfigRequestType"
+PERIODIC_LAUNCH = "PeriodicLaunchRequestType"
+BATCH_NODE_UPDATE_DRAIN = "BatchNodeUpdateDrainRequestType"
+DEPLOYMENT_DELETE = "DeploymentDeleteRequestType"
+
+
+@dataclasses.dataclass
+class PlanApplyRequest:
+    """ApplyPlanResultsRequest (ref structs.go) — what the plan applier
+    commits through the log."""
+    alloc_updates: list = dataclasses.field(default_factory=list)
+    alloc_placements: list = dataclasses.field(default_factory=list)
+    alloc_preemptions: list = dataclasses.field(default_factory=list)
+    deployment: Optional[Deployment] = None
+    deployment_updates: list = dataclasses.field(default_factory=list)
+    eval_id: str = ""
+
+
+class NomadFSM:
+    """ref nomad/fsm.go:194"""
+
+    def __init__(self, state: Optional[StateStore] = None):
+        self.state = state or StateStore()
+        # callbacks fired after specific message types commit (e.g. the
+        # leader enqueues evals into the broker, ref fsm.go:760)
+        self.on_eval_update: list[Callable[[list[Evaluation]], None]] = []
+
+    def apply(self, index: int, msg_type: str, payload: dict) -> object:
+        """ref fsm.go:194 Apply (type switch :211-307)"""
+        s = self.state
+        if msg_type == NODE_REGISTER:
+            s.upsert_node(index, payload["node"])
+        elif msg_type == NODE_DEREGISTER:
+            s.delete_node(index, payload["node_ids"])
+        elif msg_type == NODE_UPDATE_STATUS:
+            s.update_node_status(index, payload["node_id"], payload["status"],
+                                 payload.get("updated_at", time.time()))
+        elif msg_type == NODE_UPDATE_DRAIN:
+            s.update_node_drain(index, payload["node_id"], payload.get("drain"),
+                                payload.get("mark_eligible", False))
+        elif msg_type == BATCH_NODE_UPDATE_DRAIN:
+            for node_id, drain in payload["updates"].items():
+                s.update_node_drain(index, node_id, drain,
+                                    payload.get("mark_eligible", False))
+        elif msg_type == NODE_UPDATE_ELIGIBILITY:
+            s.update_node_eligibility(index, payload["node_id"],
+                                      payload["eligibility"])
+        elif msg_type == JOB_REGISTER:
+            s.upsert_job(index, payload["job"])
+            evs = payload.get("evals") or []
+            if evs:
+                s.upsert_evals(index, evs)
+                self._notify_evals(evs)
+        elif msg_type == JOB_DEREGISTER:
+            if payload.get("purge"):
+                s.delete_job(index, payload["namespace"], payload["job_id"])
+            else:
+                job = s.job_by_id(payload["namespace"], payload["job_id"])
+                if job is not None:
+                    job = job.copy()
+                    job.stop = True
+                    s.upsert_job(index, job)
+            evs = payload.get("evals") or []
+            if evs:
+                s.upsert_evals(index, evs)
+                self._notify_evals(evs)
+        elif msg_type == EVAL_UPDATE:
+            evs = payload["evals"]
+            s.upsert_evals(index, evs)
+            self._notify_evals(evs)
+        elif msg_type == EVAL_DELETE:
+            s.delete_evals(index, payload["eval_ids"],
+                           payload.get("alloc_ids", []))
+        elif msg_type == ALLOC_CLIENT_UPDATE:
+            s.update_allocs_from_client(index, payload["allocs"])
+        elif msg_type == ALLOC_UPDATE_DESIRED_TRANSITION:
+            s.update_alloc_desired_transitions(
+                index, payload["transitions"], payload.get("evals", []))
+            self._notify_evals(payload.get("evals", []))
+        elif msg_type == APPLY_PLAN_RESULTS:
+            s.upsert_plan_results(index, payload["result"])
+        elif msg_type == DEPLOYMENT_STATUS_UPDATE:
+            s.update_deployment_status(index, payload["update"],
+                                       payload.get("job"),
+                                       payload.get("eval"))
+            if payload.get("eval"):
+                self._notify_evals([payload["eval"]])
+        elif msg_type == DEPLOYMENT_PROMOTE:
+            s.update_deployment_promotion(index, payload["deployment_id"],
+                                          payload.get("groups"))
+            if payload.get("eval"):
+                s.upsert_evals(index, [payload["eval"]])
+                self._notify_evals([payload["eval"]])
+        elif msg_type == DEPLOYMENT_ALLOC_HEALTH:
+            s.update_deployment_alloc_health(
+                index, payload["deployment_id"],
+                payload.get("healthy", []), payload.get("unhealthy", []),
+                payload.get("timestamp", time.time()))
+            if payload.get("eval"):
+                s.upsert_evals(index, [payload["eval"]])
+                self._notify_evals([payload["eval"]])
+        elif msg_type == DEPLOYMENT_DELETE:
+            s.delete_deployments(index, payload["deployment_ids"])
+        elif msg_type == SCHEDULER_CONFIG:
+            s.set_scheduler_config(index, payload["config"])
+        elif msg_type == PERIODIC_LAUNCH:
+            s.upsert_periodic_launch(index, payload["namespace"],
+                                     payload["job_id"], payload["launch"])
+        else:
+            raise ValueError(f"unknown message type {msg_type!r}")
+        return None
+
+    def _notify_evals(self, evals: list[Evaluation]) -> None:
+        for cb in self.on_eval_update:
+            cb(evals)
+
+    # ------------------------------------------------------ snapshot/restore
+
+    def snapshot_bytes(self) -> bytes:
+        """ref fsm.go Snapshot/Persist"""
+        s = self.state
+        with s._lock:
+            blob = {
+                "index": s._index,
+                "table_index": dict(s._table_index),
+                "nodes": s.nodes, "jobs": s.jobs,
+                "job_versions": s.job_versions,
+                "job_summaries": s.job_summaries,
+                "evals": s.evals, "allocs": s.allocs,
+                "deployments": s.deployments,
+                "periodic_launches": s.periodic_launches,
+                "scheduler_config": s.scheduler_config,
+                "namespaces": s.namespaces,
+            }
+            return pickle.dumps(blob)
+
+    def restore_bytes(self, data: bytes) -> None:
+        """ref fsm.go Restore"""
+        blob = pickle.loads(data)
+        s = self.state
+        with s._lock:
+            s._index = blob["index"]
+            s._table_index = dict(blob["table_index"])
+            s.nodes = dict(blob["nodes"])
+            s.jobs = dict(blob["jobs"])
+            s.job_versions = dict(blob["job_versions"])
+            s.job_summaries = dict(blob["job_summaries"])
+            s.evals = dict(blob["evals"])
+            s.allocs = dict(blob["allocs"])
+            s.deployments = dict(blob["deployments"])
+            s.periodic_launches = dict(blob["periodic_launches"])
+            s.scheduler_config = blob["scheduler_config"]
+            s.namespaces = dict(blob["namespaces"])
+            # rebuild secondary indexes
+            s._allocs_by_node.clear()
+            s._allocs_by_job.clear()
+            s._allocs_by_eval.clear()
+            s._evals_by_job.clear()
+            for alloc in s.allocs.values():
+                s._index_alloc(alloc)
+            for ev in s.evals.values():
+                s._index_eval(ev)
+            s._cond.notify_all()
+
+
+class RaftLog:
+    """Single-node replicated log: serial apply with index assignment.
+
+    The contract multi-node consensus must keep: apply() returns only after
+    the message is durably committed and visible in the FSM's state store at
+    the returned index."""
+
+    def __init__(self, fsm: NomadFSM):
+        self.fsm = fsm
+        self._lock = threading.Lock()
+        self._index = fsm.state.latest_index()
+
+    def apply(self, msg_type: str, payload: dict) -> int:
+        # the lock spans index assignment AND application so state-store
+        # mutations happen in strict log order (replay determinism)
+        with self._lock:
+            self._index += 1
+            index = self._index
+            self.fsm.apply(index, msg_type, payload)
+            return index
+
+    def barrier(self) -> int:
+        """Latest committed index (leader barrier analog)."""
+        with self._lock:
+            return self._index
+
+    def snapshot(self) -> bytes:
+        return self.fsm.snapshot_bytes()
+
+    def restore(self, data: bytes) -> None:
+        self.fsm.restore_bytes(data)
+        with self._lock:
+            self._index = self.fsm.state.latest_index()

@@ -1,0 +1,124 @@
+"""Server-side node heartbeat TTLs (ref nomad/heartbeat.go:34-199).
+
+Each client heartbeat resets its TTL timer; a missed TTL marks the node
+down and creates one evaluation per job with allocations on it
+(ref nomad/node_endpoint.go:1358 createNodeEvals) so the schedulers replace
+the lost work — tier 2 of the failure-detection story (SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from ..structs import (
+    Evaluation, NODE_STATUS_DOWN, TRIGGER_NODE_UPDATE, JOB_TYPE_SYSTEM,
+)
+from .fsm import EVAL_UPDATE, NODE_UPDATE_STATUS
+
+DEFAULT_MIN_TTL = 10.0
+DEFAULT_TTL_SPREAD = 5.0
+DEFAULT_CHECK_INTERVAL = 1.0
+
+
+class HeartbeatTimers:
+    def __init__(self, server, min_ttl: float = DEFAULT_MIN_TTL,
+                 ttl_spread: float = DEFAULT_TTL_SPREAD):
+        self.server = server
+        self.min_ttl = min_ttl
+        self.ttl_spread = ttl_spread
+        self._lock = threading.Lock()
+        self._deadlines: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="heartbeat-reaper")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def reset_heartbeat_timer(self, node_id: str) -> float:
+        """Returns the TTL the client should heartbeat within
+        (ref heartbeat.go:56 resetHeartbeatTimer)."""
+        ttl = self.min_ttl + random.random() * self.ttl_spread
+        with self._lock:
+            self._deadlines[node_id] = time.time() + ttl
+        return ttl
+
+    def clear_heartbeat_timer(self, node_id: str) -> None:
+        with self._lock:
+            self._deadlines.pop(node_id, None)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            now = time.time()
+            expired = []
+            with self._lock:
+                for node_id, deadline in list(self._deadlines.items()):
+                    if deadline <= now:
+                        expired.append(node_id)
+                        del self._deadlines[node_id]
+            for node_id in expired:
+                try:
+                    self._invalidate(node_id)
+                except Exception as e:   # noqa: BLE001
+                    self.server.logger(f"heartbeat: invalidate {node_id[:8]}: "
+                                       f"{e!r}")
+            self._stop.wait(DEFAULT_CHECK_INTERVAL)
+
+    def _invalidate(self, node_id: str) -> None:
+        """Missed TTL => down + evals (ref heartbeat.go:135
+        invalidateHeartbeat)."""
+        server = self.server
+        node = server.state.node_by_id(node_id)
+        if node is None or node.terminal_status():
+            return
+        server.raft.apply(NODE_UPDATE_STATUS, {
+            "node_id": node_id, "status": NODE_STATUS_DOWN,
+            "updated_at": time.time()})
+        evals = create_node_evals(server.state, node_id)
+        if evals:
+            server.raft.apply(EVAL_UPDATE, {"evals": evals})
+
+
+def create_node_evals(state, node_id: str) -> list[Evaluation]:
+    """One eval per job with allocs on the node (+ system jobs)
+    (ref nomad/node_endpoint.go:1358)."""
+    evals = []
+    seen: set[tuple[str, str]] = set()
+    node = state.node_by_id(node_id)
+    node_index = node.modify_index if node else 0
+    for alloc in state.allocs_by_node(node_id):
+        key = (alloc.namespace, alloc.job_id)
+        if key in seen:
+            continue
+        seen.add(key)
+        job = state.job_by_id(*key)
+        evals.append(Evaluation(
+            namespace=alloc.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else "service",
+            triggered_by=TRIGGER_NODE_UPDATE,
+            job_id=alloc.job_id,
+            node_id=node_id,
+            node_modify_index=node_index,
+            status="pending",
+        ))
+    # system jobs need an eval on node up/down even without allocs
+    for job in state.iter_jobs():
+        if job.type != JOB_TYPE_SYSTEM or job.stopped():
+            continue
+        key = (job.namespace, job.id)
+        if key in seen:
+            continue
+        seen.add(key)
+        evals.append(Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            triggered_by=TRIGGER_NODE_UPDATE, job_id=job.id, node_id=node_id,
+            node_modify_index=node_index, status="pending"))
+    return evals

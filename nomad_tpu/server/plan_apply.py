@@ -1,0 +1,205 @@
+"""Serial plan applier + plan queue (ref nomad/plan_apply.go:71 planApply,
+nomad/plan_queue.go).
+
+The optimistic-concurrency heart of the design (kept untouched per the
+north star): workers submit plans computed against possibly-stale snapshots;
+the leader-serial applier re-checks every touched node against latest state
+(ref :638 evaluateNodePlan) and commits only the slices that still fit.
+Workers see rejections in the PlanResult and retry with a fresher snapshot.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..state import StateStore
+from ..structs import (
+    Allocation, NetworkIndex, Plan, PlanResult, allocs_fit,
+)
+from .fsm import APPLY_PLAN_RESULTS, PlanApplyRequest, RaftLog
+
+
+class _PendingPlan:
+    __slots__ = ("plan", "event", "result", "error")
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self.event = threading.Event()
+        self.result: Optional[PlanResult] = None
+        self.error: Optional[str] = None
+
+    def respond(self, result, error) -> None:
+        self.result = result
+        self.error = error
+        self.event.set()
+
+    def wait(self, timeout: Optional[float] = None
+             ) -> tuple[Optional[PlanResult], Optional[str]]:
+        self.event.wait(timeout)
+        return self.result, self.error
+
+
+class PlanQueue:
+    """Priority FIFO of pending plans (ref nomad/plan_queue.go)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for _, _, pending in self._heap:
+                    pending.respond(None, "plan queue disabled")
+                self._heap = []
+            self._cond.notify_all()
+
+    def enqueue(self, plan: Plan) -> _PendingPlan:
+        pending = _PendingPlan(plan)
+        with self._lock:
+            if not self._enabled:
+                pending.respond(None, "plan queue disabled")
+                return pending
+            heapq.heappush(self._heap,
+                           (-plan.priority, next(self._seq), pending))
+            self._cond.notify_all()
+        return pending
+
+    def dequeue(self, timeout: float = 1.0) -> Optional[_PendingPlan]:
+        with self._lock:
+            if not self._heap:
+                self._cond.wait(timeout)
+            if not self._heap:
+                return None
+            _, _, pending = heapq.heappop(self._heap)
+            return pending
+
+
+class Planner:
+    """The serial applier thread (ref plan_apply.go planApply:71)."""
+
+    def __init__(self, raft: RaftLog, state: StateStore):
+        self.raft = raft
+        self.state = state
+        self.queue = PlanQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self.queue.set_enabled(True)
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="plan-applier")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.set_enabled(False)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout=0.5)
+            if pending is None:
+                continue
+            try:
+                result = self.apply_plan(pending.plan)
+                pending.respond(result, None)
+            except Exception as e:       # noqa: BLE001 - report to worker
+                pending.respond(None, str(e))
+
+    # ------------------------------------------------------------ evaluate
+
+    def apply_plan(self, plan: Plan) -> PlanResult:
+        """Evaluate against latest state, then commit via the log
+        (ref :204 applyPlan / :400 evaluatePlan)."""
+        snap = self.state.snapshot_min_index(plan.snapshot_index,
+                                            timeout=5.0)
+        result = PlanResult(
+            node_update=dict(plan.node_update),
+            deployment=plan.deployment,
+            deployment_updates=list(plan.deployment_updates),
+        )
+        for node_id, allocs in plan.node_allocation.items():
+            if self._evaluate_node_plan(snap, plan, node_id):
+                result.node_allocation[node_id] = allocs
+                if node_id in plan.node_preemptions:
+                    result.node_preemptions[node_id] = \
+                        plan.node_preemptions[node_id]
+            else:
+                result.rejected_nodes.append(node_id)
+
+        if plan.all_at_once and result.rejected_nodes:
+            # all-or-nothing (ref structs.go Plan.AllAtOnce)
+            result.node_allocation = {}
+            result.node_preemptions = {}
+            result.deployment = None
+            result.deployment_updates = []
+
+        if result.rejected_nodes:
+            result.refresh_index = snap.latest_index()
+
+        if result.is_no_op() and not result.node_update:
+            result.alloc_index = self.raft.barrier()
+            return result
+
+        req = PlanApplyRequest(
+            alloc_updates=[a for allocs in result.node_update.values()
+                           for a in allocs],
+            alloc_placements=[a for allocs in result.node_allocation.values()
+                              for a in allocs],
+            alloc_preemptions=[a for allocs in result.node_preemptions.values()
+                               for a in allocs],
+            deployment=result.deployment,
+            deployment_updates=result.deployment_updates,
+            eval_id=plan.eval_id,
+        )
+        index = self.raft.apply(APPLY_PLAN_RESULTS, {"result": req})
+        result.alloc_index = index
+        return result
+
+    def _evaluate_node_plan(self, snap, plan: Plan, node_id: str) -> bool:
+        """Per-node re-check against current state (ref :638
+        evaluateNodePlan) — the vmapped fit check's scalar twin."""
+        new_allocs = plan.node_allocation.get(node_id, [])
+        if not new_allocs:
+            return True
+        node = snap.node_by_id(node_id)
+        if node is None:
+            return False
+        if node.drain or node.scheduling_eligibility != "eligible" or \
+           node.status != "ready":
+            # an existing-alloc update (inplace) is still allowed on
+            # draining nodes; new placements are not
+            existing_ids = {a.id for a in snap.allocs_by_node(node_id)}
+            if not all(a.id in existing_ids for a in new_allocs):
+                return False
+
+        existing = [a for a in snap.allocs_by_node(node_id)
+                    if not a.terminal_status()]
+        remove_ids = {a.id for a in plan.node_update.get(node_id, ())}
+        remove_ids |= {a.id for a in plan.node_preemptions.get(node_id, ())}
+        proposed = [a for a in existing if a.id not in remove_ids]
+        new_ids = {a.id for a in new_allocs}
+        proposed = [a for a in proposed if a.id not in new_ids]
+        proposed.extend(new_allocs)
+        fit, _, _ = allocs_fit(node, proposed)
+        return fit
+
+    # --------------------------------------------------- worker-facing API
+
+    def submit_plan(self, plan: Plan,
+                    timeout: float = 10.0) -> Optional[PlanResult]:
+        pending = self.queue.enqueue(plan)
+        result, err = pending.wait(timeout)
+        if err:
+            return None
+        return result

@@ -1,0 +1,397 @@
+"""Server: the control plane assembly (ref nomad/server.go:293 NewServer)
+plus the RPC endpoint surface (ref nomad/job_endpoint.go, node_endpoint.go,
+eval_endpoint.go, alloc_endpoint.go, deployment_endpoint.go,
+operator_endpoint.go — one method family per resource).
+
+Single-node for now: leadership is established immediately on start
+(ref nomad/leader.go:224 establishLeadership) — broker/planner/periodic/
+blocked-evals enabled, pending evals restored from state.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..state import StateStore
+from ..structs import (
+    Allocation, DrainStrategy, Evaluation, Job, Node, SchedulerConfiguration,
+    ALLOC_CLIENT_FAILED, ALLOC_CLIENT_COMPLETE, ALLOC_DESIRED_STOP,
+    EVAL_STATUS_PENDING, JOB_TYPE_BATCH, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
+    JOB_TYPE_SYSBATCH, NODE_STATUS_DOWN, NODE_STATUS_READY,
+    TRIGGER_ALLOC_STOP, TRIGGER_JOB_DEREGISTER, TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_DRAIN, TRIGGER_NODE_UPDATE, TRIGGER_RETRY_FAILED_ALLOC,
+    CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_FORCE_GC, JOB_TYPE_CORE,
+    new_id,
+)
+from .blocked_evals import BlockedEvals
+from .core_sched import CoreScheduler
+from .eval_broker import EvalBroker
+from .fsm import (
+    ALLOC_CLIENT_UPDATE, ALLOC_UPDATE_DESIRED_TRANSITION, EVAL_UPDATE,
+    JOB_DEREGISTER, JOB_REGISTER, NODE_REGISTER, NODE_UPDATE_DRAIN,
+    NODE_UPDATE_ELIGIBILITY, NODE_UPDATE_STATUS, NomadFSM, RaftLog,
+    SCHEDULER_CONFIG,
+)
+from .heartbeat import HeartbeatTimers, create_node_evals
+from .periodic import PeriodicDispatch
+from .plan_apply import Planner
+from .worker import Worker
+
+SCHEDULER_TYPES = [JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM,
+                   JOB_TYPE_SYSBATCH, JOB_TYPE_CORE, "_failed"]
+
+
+class Server:
+    def __init__(self, num_workers: int = 2, logger: Optional[Callable] = None,
+                 gc_interval: float = 300.0):
+        self.logger = logger or (lambda msg: None)
+        self.fsm = NomadFSM()
+        self.state: StateStore = self.fsm.state
+        self.raft = RaftLog(self.fsm)
+        self.eval_broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self._enqueue_unblocked)
+        self.planner = Planner(self.raft, self.state)
+        self.periodic = PeriodicDispatch(self)
+        self.heartbeats = HeartbeatTimers(self)
+        self.core_scheduler = CoreScheduler(self)
+        self.scheduler_types = SCHEDULER_TYPES
+        self.workers = [Worker(self, i) for i in range(num_workers)]
+        self.gc_interval = gc_interval
+        self._leader_stop = threading.Event()
+        self._leader_thread: Optional[threading.Thread] = None
+        self.is_leader = False
+
+        # the FSM tells the leader about new evals (ref fsm.go:760)
+        self.fsm.on_eval_update.append(self._on_eval_update)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._establish_leadership()
+        for w in self.workers:
+            w.start()
+
+    def shutdown(self) -> None:
+        self._leader_stop.set()
+        for w in self.workers:
+            w.stop()
+        self.planner.stop()
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.periodic.set_enabled(False)
+        self.heartbeats.stop()
+        for w in self.workers:
+            w.join(1.0)
+
+    def _establish_leadership(self) -> None:
+        """ref nomad/leader.go:224"""
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.planner.start()
+        self.periodic.set_enabled(True)
+        self.heartbeats.start()
+        self.is_leader = True
+        # restore: re-enqueue non-terminal evals, re-track periodic jobs
+        for ev in self.state.iter_evals():
+            if ev.status == EVAL_STATUS_PENDING:
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+        for job in self.state.iter_jobs():
+            if job.is_periodic() and not job.stopped():
+                self.periodic.add(job)
+        self._leader_stop.clear()
+        self._leader_thread = threading.Thread(
+            target=self._leader_loop, daemon=True, name="leader-loop")
+        self._leader_thread.start()
+
+    def _leader_loop(self) -> None:
+        """Broker nack-timeout reaping + periodic core GC evals
+        (ref leader.go schedulePeriodic / reapFailedEvaluations)."""
+        last_gc = time.time()
+        while not self._leader_stop.wait(1.0):
+            self.eval_broker.check_nack_timeouts()
+            if time.time() - last_gc >= self.gc_interval:
+                last_gc = time.time()
+                for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
+                             CORE_JOB_NODE_GC, CORE_JOB_DEPLOYMENT_GC):
+                    self.eval_broker.enqueue(Evaluation(
+                        type=JOB_TYPE_CORE, job_id=kind,
+                        priority=200, status="pending"))
+
+    def _on_eval_update(self, evals: list[Evaluation]) -> None:
+        if not self.is_leader:
+            return
+        for ev in evals:
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _enqueue_unblocked(self, ev: Evaluation) -> None:
+        self.raft.apply(EVAL_UPDATE, {"evals": [ev]})
+
+    # ------------------------------------------------------- Job endpoints
+
+    def job_register(self, job: Job) -> dict:
+        """ref nomad/job_endpoint.go:80 Job.Register (admission hooks are the
+        jobspec layer's validate/canonicalize)."""
+        err = self._validate_job(job)
+        if err:
+            raise ValueError(err)
+        evals = []
+        if job.is_periodic():
+            pass  # periodic parents don't get evals; dispatcher launches
+        elif job.is_parameterized():
+            pass
+        else:
+            evals.append(Evaluation(
+                namespace=job.namespace, priority=job.priority, type=job.type,
+                triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
+                status=EVAL_STATUS_PENDING))
+        index = self.raft.apply(JOB_REGISTER, {"job": job, "evals": evals})
+        if job.is_periodic() and not job.stopped():
+            stored = self.state.job_by_id(job.namespace, job.id)
+            self.periodic.add(stored)
+        self.blocked_evals.untrack(job.namespace, job.id)
+        return {"eval_id": evals[0].id if evals else "", "index": index,
+                "job_modify_index": index}
+
+    def _validate_job(self, job: Job) -> str:
+        if not job.id:
+            return "missing job ID"
+        if not job.task_groups:
+            return "job requires at least one task group"
+        seen = set()
+        for tg in job.task_groups:
+            if tg.name in seen:
+                return f"duplicate task group {tg.name!r}"
+            seen.add(tg.name)
+            if not tg.tasks and job.type != JOB_TYPE_SYSTEM:
+                pass
+            for task in tg.tasks:
+                if not task.driver:
+                    return f"task {task.name!r} missing driver"
+        if job.type not in (JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM,
+                            JOB_TYPE_SYSBATCH):
+            return f"invalid job type {job.type!r}"
+        cfg = self.state.get_scheduler_config()
+        if cfg.reject_job_registration:
+            return "job registration is disabled"
+        return ""
+
+    def job_deregister(self, namespace: str, job_id: str,
+                       purge: bool = False) -> dict:
+        job = self.state.job_by_id(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by=TRIGGER_JOB_DEREGISTER, job_id=job_id,
+            status=EVAL_STATUS_PENDING)
+        index = self.raft.apply(JOB_DEREGISTER, {
+            "namespace": namespace, "job_id": job_id, "purge": purge,
+            "evals": [ev]})
+        self.periodic.remove(namespace, job_id)
+        self.blocked_evals.untrack(namespace, job_id)
+        return {"eval_id": ev.id, "index": index}
+
+    def job_dispatch(self, namespace: str, job_id: str,
+                     payload: bytes = b"", meta: Optional[dict] = None) -> dict:
+        """Parameterized job dispatch (ref nomad/job_endpoint.go Dispatch)."""
+        parent = self.state.job_by_id(namespace, job_id)
+        if parent is None or not parent.is_parameterized():
+            raise ValueError(f"job {job_id!r} is not parameterized")
+        cfg = parent.parameterized
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload forbidden")
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload required")
+        meta = meta or {}
+        for key in cfg.meta_required:
+            if key not in meta:
+                raise ValueError(f"missing required dispatch meta {key!r}")
+        for key in meta:
+            if key not in cfg.meta_required and key not in cfg.meta_optional:
+                raise ValueError(f"unexpected dispatch meta {key!r}")
+        child = parent.copy()
+        child.id = f"{parent.id}/dispatch-{int(time.time())}-{new_id()[:8]}"
+        child.parent_id = parent.id
+        child.dispatched = True
+        child.payload = payload
+        child.meta = {**parent.meta, **meta}
+        ev = Evaluation(
+            namespace=namespace, priority=child.priority, type=child.type,
+            triggered_by=TRIGGER_JOB_REGISTER, job_id=child.id,
+            status=EVAL_STATUS_PENDING)
+        index = self.raft.apply(JOB_REGISTER, {"job": child, "evals": [ev]})
+        return {"dispatched_job_id": child.id, "eval_id": ev.id,
+                "index": index}
+
+    # ------------------------------------------------------ Node endpoints
+
+    def node_register(self, node: Node) -> dict:
+        """ref nomad/node_endpoint.go:81 Register"""
+        if not node.id:
+            raise ValueError("missing node ID")
+        node = node.copy()
+        if not node.computed_class:
+            node.compute_class()
+        if not node.status:
+            node.status = NODE_STATUS_READY
+        index = self.raft.apply(NODE_REGISTER, {"node": node})
+        ttl = self.heartbeats.reset_heartbeat_timer(node.id)
+        if node.status == NODE_STATUS_READY:
+            self.blocked_evals.unblock(node.computed_class, index)
+        return {"heartbeat_ttl": ttl, "index": index}
+
+    def node_update_status(self, node_id: str, status: str) -> dict:
+        """ref node_endpoint.go:421 UpdateStatus"""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node {node_id} not found")
+        evals: list[Evaluation] = []
+        if node.status != status:
+            index = self.raft.apply(NODE_UPDATE_STATUS, {
+                "node_id": node_id, "status": status,
+                "updated_at": time.time()})
+            if status == NODE_STATUS_DOWN:
+                evals = create_node_evals(self.state, node_id)
+            elif status == NODE_STATUS_READY:
+                node = self.state.node_by_id(node_id)
+                self.blocked_evals.unblock(node.computed_class, index)
+                evals = [e for e in create_node_evals(self.state, node_id)
+                         if e.type == JOB_TYPE_SYSTEM]
+            if evals:
+                self.raft.apply(EVAL_UPDATE, {"evals": evals})
+        ttl = self.heartbeats.reset_heartbeat_timer(node_id)
+        return {"heartbeat_ttl": ttl,
+                "eval_ids": [e.id for e in evals]}
+
+    def node_heartbeat(self, node_id: str) -> dict:
+        ttl = self.heartbeats.reset_heartbeat_timer(node_id)
+        return {"heartbeat_ttl": ttl}
+
+    def node_update_drain(self, node_id: str,
+                          drain: Optional[DrainStrategy],
+                          mark_eligible: bool = False) -> dict:
+        """ref node_endpoint.go:557 UpdateDrain"""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node {node_id} not found")
+        if drain is not None and drain.deadline_sec > 0:
+            drain.force_deadline_unix = time.time() + drain.deadline_sec
+        index = self.raft.apply(NODE_UPDATE_DRAIN, {
+            "node_id": node_id, "drain": drain,
+            "mark_eligible": mark_eligible})
+        evals = []
+        if drain is not None:
+            evals = create_node_evals(self.state, node_id)
+            for ev in evals:
+                ev.triggered_by = TRIGGER_NODE_DRAIN
+            if evals:
+                self.raft.apply(EVAL_UPDATE, {"evals": evals})
+            if hasattr(self, "drainer") and self.drainer is not None:
+                self.drainer.track_node(node_id)
+        return {"index": index, "eval_ids": [e.id for e in evals]}
+
+    def node_update_eligibility(self, node_id: str, eligibility: str) -> dict:
+        index = self.raft.apply(NODE_UPDATE_ELIGIBILITY, {
+            "node_id": node_id, "eligibility": eligibility})
+        if eligibility == "eligible":
+            node = self.state.node_by_id(node_id)
+            if node:
+                self.blocked_evals.unblock(node.computed_class, index)
+        return {"index": index}
+
+    def node_get_client_allocs(self, node_id: str, min_index: int = 0,
+                               timeout: float = 30.0) -> dict:
+        """Blocking query the client long-polls (ref node_endpoint.go
+        GetClientAllocs / client watchAllocations)."""
+        deadline = time.time() + timeout
+        while True:
+            allocs = self.state.allocs_by_node(node_id)
+            index = self.state.latest_index()
+            relevant = {a.id: a.modify_index for a in allocs
+                        if not (a.desired_status == ALLOC_DESIRED_STOP and
+                                a.client_terminal_status())}
+            if any(mi > min_index for mi in relevant.values()) or \
+               time.time() >= deadline:
+                return {"allocs": relevant, "index": index}
+            self.state.block_min_index(min_index,
+                                       timeout=max(0.05, deadline - time.time()))
+
+    def node_update_allocs(self, allocs: list[Allocation]) -> dict:
+        """Client pushes alloc status (ref node_endpoint.go UpdateAlloc):
+        terminal transitions trigger new evals."""
+        index = self.raft.apply(ALLOC_CLIENT_UPDATE, {"allocs": allocs})
+        evals = []
+        seen = set()
+        for alloc in allocs:
+            stored = self.state.alloc_by_id(alloc.id)
+            if stored is None or stored.job is None:
+                continue
+            key = (stored.namespace, stored.job_id)
+            if key in seen:
+                continue
+            if alloc.client_status in (ALLOC_CLIENT_FAILED,):
+                seen.add(key)
+                evals.append(Evaluation(
+                    namespace=stored.namespace,
+                    priority=stored.job.priority,
+                    type=stored.job.type,
+                    triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=stored.job_id, status=EVAL_STATUS_PENDING))
+            elif alloc.client_status == ALLOC_CLIENT_COMPLETE and \
+                    stored.job.type in (JOB_TYPE_BATCH, JOB_TYPE_SYSBATCH):
+                seen.add(key)
+                evals.append(Evaluation(
+                    namespace=stored.namespace,
+                    priority=stored.job.priority,
+                    type=stored.job.type,
+                    triggered_by=TRIGGER_ALLOC_STOP,
+                    job_id=stored.job_id, status=EVAL_STATUS_PENDING))
+        if evals:
+            self.raft.apply(EVAL_UPDATE, {"evals": evals})
+        return {"index": index, "eval_ids": [e.id for e in evals]}
+
+    # ------------------------------------------------------ Eval endpoints
+
+    def eval_dequeue(self, schedulers: list[str],
+                     timeout: float = 1.0) -> tuple[Optional[Evaluation], str]:
+        """ref nomad/eval_endpoint.go:83 Dequeue"""
+        return self.eval_broker.dequeue(schedulers, timeout)
+
+    def eval_ack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.ack(eval_id, token)
+
+    def eval_nack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.nack(eval_id, token)
+
+    # -------------------------------------------------- Operator endpoints
+
+    def get_scheduler_configuration(self) -> SchedulerConfiguration:
+        return self.state.get_scheduler_config()
+
+    def set_scheduler_configuration(self, config: SchedulerConfiguration
+                                    ) -> dict:
+        err = config.validate()
+        if err:
+            raise ValueError(err)
+        index = self.raft.apply(SCHEDULER_CONFIG, {"config": config})
+        return {"index": index}
+
+    # ----------------------------------------------------------- utilities
+
+    def run_gc(self) -> None:
+        """Force a full GC pass (the `nomad system gc` analog)."""
+        self.core_scheduler.process(Evaluation(
+            type=JOB_TYPE_CORE, job_id=CORE_JOB_FORCE_GC))
+
+    def snapshot_save(self) -> bytes:
+        return self.raft.snapshot()
+
+    def snapshot_restore(self, data: bytes) -> None:
+        self.raft.restore(data)

@@ -1,0 +1,118 @@
+"""Scheduler workers (ref nomad/worker.go:385 Worker.run): dequeue an eval,
+wait for state to catch up to it, run the scheduler, submit plans, ack/nack.
+
+The worker is the scheduler's Planner implementation (ref
+scheduler/scheduler.go:113): SubmitPlan routes through the serial plan
+applier; eval updates commit through the log.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..scheduler import new_scheduler
+from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_FAILED
+from .eval_broker import EvalBroker
+from .fsm import EVAL_UPDATE, RaftLog
+from .plan_apply import Planner
+
+DEQUEUE_TIMEOUT = 0.5
+
+
+class Worker:
+    def __init__(self, server, worker_id: int = 0):
+        self.server = server
+        self.id = worker_id
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._snapshot = None
+        self._eval_token = ""
+        self._eval: Optional[Evaluation] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"worker-{self.id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    # ---------------------------------------------------------------- loop
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            ev, token = self.server.eval_broker.dequeue(
+                self.server.scheduler_types, timeout=DEQUEUE_TIMEOUT)
+            if ev is None:
+                continue
+            self._eval, self._eval_token = ev, token
+            try:
+                self._invoke_scheduler(ev)
+            except Exception as e:      # noqa: BLE001
+                self.server.logger(f"worker-{self.id}: eval {ev.id[:8]} "
+                                   f"failed: {e!r}")
+                try:
+                    self.server.eval_broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+                continue
+            try:
+                self.server.eval_broker.ack(ev.id, token)
+            except ValueError:
+                pass
+
+    def _invoke_scheduler(self, ev: Evaluation) -> None:
+        """ref worker.go:552 invokeScheduler"""
+        if ev.type == "_core":
+            self.server.core_scheduler.process(ev)
+            return
+        wait_index = max(ev.modify_index, ev.snapshot_index)
+        self._snapshot = self.server.state.snapshot_min_index(
+            wait_index, timeout=5.0)
+        sched = new_scheduler(ev.type, self._snapshot, self)
+        sched.process(ev)
+
+    # ------------------------------------------------- Planner interface
+
+    def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
+        """ref worker.go:585 SubmitPlan"""
+        plan.eval_token = self._eval_token
+        plan.snapshot_index = max(plan.snapshot_index,
+                                  self._snapshot.latest_index()
+                                  if self._snapshot else 0)
+        result = self.server.planner.submit_plan(plan)
+        if result is None:
+            return None
+        # state refresh hint after rejections (ref worker.go shouldResubmit)
+        if result.refresh_index:
+            try:
+                self._snapshot = self.server.state.snapshot_min_index(
+                    result.refresh_index, timeout=5.0)
+            except TimeoutError:
+                pass
+        return result
+
+    def update_eval(self, ev: Evaluation) -> None:
+        """ref worker.go:640 UpdateEval"""
+        ev = ev.copy()
+        ev.modify_time_unix = time.time()
+        self.server.raft.apply(EVAL_UPDATE, {"evals": [ev]})
+
+    def create_eval(self, ev: Evaluation) -> None:
+        """ref worker.go:665 CreateEval"""
+        ev = ev.copy()
+        ev.create_time_unix = ev.modify_time_unix = time.time()
+        self.server.raft.apply(EVAL_UPDATE, {"evals": [ev]})
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.blocked_evals.block(ev)
+
+    def refresh_snapshot(self, old):
+        self._snapshot = self.server.state.snapshot()
+        return self._snapshot

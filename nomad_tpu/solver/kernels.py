@@ -67,6 +67,7 @@ def instance_capacity(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     return jnp.maximum(capacity, 0.0).astype(jnp.int32)
 
 
+@jax.jit
 def fill_greedy_binpack(cap: jnp.ndarray, used: jnp.ndarray,
                         ask: jnp.ndarray, count: jnp.ndarray,
                         feasible: jnp.ndarray,
@@ -156,11 +157,14 @@ def place_chunked(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
         anti = -(collisions + 1.0) / jnp.maximum(desired_count, 1)
         anti_present = collisions > 0
 
-        # even-spread boost per property value (spread.go:178)
+        # even-spread boost per property value (spread.go:178); padded
+        # pcounts entries are -1 sentinels and excluded from min/max
+        valid_p = pcounts >= 0
         node_pc = jnp.where(prop_ids >= 0,
                             pcounts[jnp.clip(prop_ids, 0, n_props - 1)], 0)
-        min_c = jnp.min(jnp.where(pcounts >= 0, pcounts, 0))
-        max_c = jnp.max(pcounts)
+        min_c = jnp.min(jnp.where(valid_p, pcounts, 2 ** 30))
+        min_c = jnp.where(jnp.any(valid_p), min_c, 0)
+        max_c = jnp.max(jnp.where(valid_p, pcounts, 0))
         any_placed = (max_c > 0)
         at_min = node_pc == min_c
         boost_nonmin = jnp.where(min_c == 0, -1.0,

@@ -112,6 +112,25 @@ class SolverPlacer:
 
         feasible_fn = self._feasibility_fn(tg)
         gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn)
+        # pad the node axis to a power-of-2 bucket so the jitted kernels
+        # compile once per bucket, not once per cluster size; padding rows
+        # are infeasible and can never be chosen
+        n = gt.cap.shape[0]
+        padded = max(8, 1 << (n - 1).bit_length())
+        if padded != n:
+            pad = padded - n
+            gt.cap = np.pad(gt.cap, ((0, pad), (0, 0)))
+            gt.used = np.pad(gt.used, ((0, pad), (0, 0)))
+            gt.feasible = np.pad(gt.feasible, (0, pad))
+            gt.job_collisions = np.pad(gt.job_collisions, (0, pad))
+            gt.prop_ids = np.pad(gt.prop_ids, (0, pad), constant_values=-1)
+        p = gt.prop_counts.shape[0]
+        p_padded = max(2, 1 << (p - 1).bit_length())
+        if p_padded != p:
+            # -1 sentinel: padded property slots are excluded from the
+            # kernel's min/max usage calculation
+            gt.prop_counts = np.pad(gt.prop_counts, (0, p_padded - p),
+                                    constant_values=-1)
         max_per_node = 1 if gt.distinct_hosts else 2 ** 30
         use_chunked = (
             self.ctx.scheduler_config.effective_scheduler_algorithm() == "spread"
@@ -130,7 +149,7 @@ class SolverPlacer:
                 jnp.asarray(gt.cap), jnp.asarray(gt.used),
                 jnp.asarray(gt.ask), jnp.int32(count),
                 jnp.asarray(gt.feasible), max_per_node=max_per_node)
-        placed = np.asarray(placed)
+        placed = np.asarray(placed)[:n]
         order = np.argsort(-placed)
         return [(gt.nodes[i], int(placed[i])) for i in order if placed[i] > 0]
 

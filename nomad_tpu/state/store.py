@@ -684,6 +684,13 @@ class StateStore:
             # deployment watcher-created eval; state keeps alloc flags as-is
             self._commit()
 
+    def delete_deployments(self, index: int, deployment_ids: list[str]) -> None:
+        with self._lock:
+            for did in deployment_ids:
+                self.deployments.pop(did, None)
+            self._bump("deployment", index)
+            self._commit()
+
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
         with self._lock:
             return self.deployments.get(deployment_id)

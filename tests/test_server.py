@@ -1,0 +1,313 @@
+"""Server runtime tests (modeled on nomad/eval_broker_test.go,
+plan_apply_test.go, and server integration behaviors)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import EvalBroker, Server, cron_next
+from nomad_tpu.structs import (
+    Evaluation, PeriodicConfig, SchedulerConfiguration,
+    ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_COMPLETE,
+    NODE_STATUS_DOWN, NODE_STATUS_READY, EVAL_STATUS_COMPLETE,
+)
+
+
+def wait_until(fn, timeout=5.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+# ---------------------------------------------------------------- broker
+
+def test_broker_priority_and_ack():
+    b = EvalBroker()
+    b.set_enabled(True)
+    lo = Evaluation(type="service", priority=10, job_id="a")
+    hi = Evaluation(type="service", priority=90, job_id="b")
+    b.enqueue(lo)
+    b.enqueue(hi)
+    ev, tok = b.dequeue(["service"], timeout=1)
+    assert ev.id == hi.id  # higher priority first
+    b.ack(ev.id, tok)
+    ev2, tok2 = b.dequeue(["service"], timeout=1)
+    assert ev2.id == lo.id
+    b.ack(ev2.id, tok2)
+    assert b.stats["total_ready"] == 0 and b.stats["total_unacked"] == 0
+
+
+def test_broker_job_dedup_pending():
+    b = EvalBroker()
+    b.set_enabled(True)
+    e1 = Evaluation(type="service", job_id="j1")
+    e2 = Evaluation(type="service", job_id="j1")
+    b.enqueue(e1)
+    ev, tok = b.dequeue(["service"], timeout=1)
+    b.enqueue(e2)  # same job while outstanding -> pending
+    assert b.stats["total_pending"] == 1
+    none, _ = b.dequeue(["service"], timeout=0.05)
+    assert none is None
+    b.ack(ev.id, tok)  # releases the pending eval
+    ev2, tok2 = b.dequeue(["service"], timeout=1)
+    assert ev2.id == e2.id
+    b.ack(ev2.id, tok2)
+
+
+def test_broker_nack_requeues_with_delay():
+    b = EvalBroker(initial_nack_delay=0.05)
+    b.set_enabled(True)
+    e = Evaluation(type="service", job_id="j1")
+    b.enqueue(e)
+    ev, tok = b.dequeue(["service"], timeout=1)
+    b.nack(ev.id, tok)
+    # requeued after the nack delay via the delayed watcher
+    ev2, tok2 = b.dequeue(["service"], timeout=2)
+    assert ev2 is not None and ev2.id == e.id
+    b.ack(ev2.id, tok2)
+
+
+def test_broker_delivery_limit_failed_queue():
+    b = EvalBroker(initial_nack_delay=0.01, subsequent_nack_delay=0.01,
+                   delivery_limit=2)
+    b.set_enabled(True)
+    e = Evaluation(type="service", job_id="j1")
+    b.enqueue(e)
+    for _ in range(2):
+        ev, tok = b.dequeue(["service", "_failed"], timeout=2)
+        assert ev is not None
+        b.nack(ev.id, tok)
+    # after delivery_limit nacks it lands on the failed queue
+    ev, tok = b.dequeue(["_failed"], timeout=2)
+    assert ev is not None and ev.id == e.id
+    b.ack(ev.id, tok)
+
+
+def test_broker_wait_until_delayed():
+    b = EvalBroker()
+    b.set_enabled(True)
+    e = Evaluation(type="service", job_id="j1",
+                   wait_until_unix=time.time() + 0.2)
+    b.enqueue(e)
+    none, _ = b.dequeue(["service"], timeout=0.05)
+    assert none is None
+    ev, tok = b.dequeue(["service"], timeout=2)
+    assert ev is not None
+    b.ack(ev.id, tok)
+
+
+def test_broker_token_mismatch():
+    b = EvalBroker()
+    b.set_enabled(True)
+    b.enqueue(Evaluation(type="service", job_id="x"))
+    ev, tok = b.dequeue(["service"], timeout=1)
+    with pytest.raises(ValueError):
+        b.ack(ev.id, "bogus")
+    b.ack(ev.id, tok)
+
+
+# ------------------------------------------------------------------ cron
+
+def test_cron_next():
+    # every 5 minutes
+    t = cron_next("*/5 * * * *", 0.0)
+    assert t == 300.0
+    # @every shorthand
+    assert cron_next("@every 30s", 100.0) == 130.0
+    assert cron_next("garbage", 0.0) is None
+
+
+# ------------------------------------------------- end-to-end server flow
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=2, gc_interval=9999)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def test_server_job_register_schedules(server):
+    for _ in range(3):
+        server.node_register(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 4
+    resp = server.job_register(job)
+    assert resp["eval_id"]
+    assert wait_until(
+        lambda: len(server.state.allocs_by_job("default", job.id)) == 4)
+    ev = server.state.eval_by_id(resp["eval_id"])
+    assert wait_until(
+        lambda: server.state.eval_by_id(resp["eval_id"]).status == "complete")
+
+
+def test_server_blocked_eval_unblocks_on_node_register(server):
+    job = mock.job()
+    job.task_groups[0].count = 2
+    server.job_register(job)  # no nodes yet -> blocked
+    assert wait_until(
+        lambda: server.blocked_evals.stats["total_blocked"] >= 1)
+    assert server.state.allocs_by_job("default", job.id) == []
+    # capacity arrives
+    server.node_register(mock.node())
+    assert wait_until(
+        lambda: len(server.state.allocs_by_job("default", job.id)) == 2)
+
+
+def test_server_heartbeat_failure_marks_down_and_replaces(server):
+    server.heartbeats.min_ttl = 0.2
+    server.heartbeats.ttl_spread = 0.0
+    n1 = mock.node()
+    server.node_register(n1)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    server.job_register(job)
+    assert wait_until(
+        lambda: len(server.state.allocs_by_job("default", job.id)) == 1)
+    # n2 keeps heartbeating; n1 stops
+    n2 = mock.node()
+    server.node_register(n2)
+    stop = time.time() + 3.0
+
+    def beat():
+        server.node_heartbeat(n2.id)
+        return server.state.node_by_id(n1.id).status == NODE_STATUS_DOWN
+
+    assert wait_until(beat, timeout=5)
+    # replacement lands on n2
+    assert wait_until(lambda: any(
+        a.node_id == n2.id and not a.terminal_status()
+        for a in server.state.allocs_by_job("default", job.id)), timeout=5)
+
+
+def test_server_failed_alloc_triggers_eval(server):
+    server.node_register(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    server.job_register(job)
+    assert wait_until(
+        lambda: len(server.state.allocs_by_job("default", job.id)) == 1)
+    alloc = server.state.allocs_by_job("default", job.id)[0]
+    from nomad_tpu.structs import TaskState
+    up = alloc.copy()
+    up.client_status = ALLOC_CLIENT_FAILED
+    up.task_states = {"web": TaskState(state="dead", failed=True,
+                                       finished_at=time.time())}
+    resp = server.node_update_allocs([up])
+    assert resp["eval_ids"]
+    # reschedule policy: constant 5s delay -> follow-up eval exists
+    assert wait_until(lambda: any(
+        e.triggered_by == "alloc-failure"
+        for e in server.state.evals_by_job("default", job.id)))
+
+
+def test_server_periodic_job_launches_children(server):
+    job = mock.batch_job()
+    job.periodic = PeriodicConfig(enabled=True, spec="@every 0.2s")
+    server.node_register(mock.node())
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        j.parent_id == job.id for j in server.state.iter_jobs()), timeout=5)
+
+
+def test_server_gc_cleans_terminal_evals(server):
+    server.node_register(mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    server.job_register(job)
+    assert wait_until(
+        lambda: len(server.state.allocs_by_job("default", job.id)) == 1)
+    alloc = server.state.allocs_by_job("default", job.id)[0]
+    from nomad_tpu.structs import TaskState
+    up = alloc.copy()
+    up.client_status = ALLOC_CLIENT_COMPLETE
+    up.task_states = {"worker": TaskState(state="dead", failed=False,
+                                          finished_at=time.time())}
+    server.node_update_allocs([up])
+    assert wait_until(
+        lambda: server.state.job_by_id("default", job.id).status == "dead")
+    # wait for the completion-triggered evals to finish, then force GC
+    assert wait_until(lambda: all(
+        e.terminal_status()
+        for e in server.state.evals_by_job("default", job.id)))
+    server.run_gc()
+    assert server.state.job_by_id("default", job.id) is None
+    assert server.state.allocs_by_job("default", job.id) == []
+
+
+def test_server_snapshot_restore_roundtrip(server):
+    server.node_register(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 2
+    server.job_register(job)
+    assert wait_until(
+        lambda: len(server.state.allocs_by_job("default", job.id)) == 2)
+    blob = server.snapshot_save()
+
+    s2 = Server(num_workers=0, gc_interval=9999)
+    s2.snapshot_restore(blob)
+    assert len(s2.state.allocs_by_job("default", job.id)) == 2
+    assert s2.state.job_by_id("default", job.id) is not None
+    assert s2.state.latest_index() == server.state.latest_index()
+
+
+def test_server_scheduler_config_endpoint(server):
+    cfg = SchedulerConfiguration(scheduler_algorithm="tpu-batch")
+    server.set_scheduler_configuration(cfg)
+    assert server.get_scheduler_configuration().scheduler_algorithm == \
+        "tpu-batch"
+    with pytest.raises(ValueError):
+        server.set_scheduler_configuration(
+            SchedulerConfiguration(scheduler_algorithm="bogus"))
+
+
+def test_server_parameterized_dispatch(server):
+    from nomad_tpu.structs import ParameterizedJobConfig
+    server.node_register(mock.node())
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.parameterized = ParameterizedJobConfig(
+        payload="optional", meta_required=["input"])
+    server.job_register(job)
+    with pytest.raises(ValueError):
+        server.job_dispatch("default", job.id, meta={})  # missing meta
+    resp = server.job_dispatch("default", job.id, meta={"input": "x"})
+    assert wait_until(lambda: len(
+        server.state.allocs_by_job("default", resp["dispatched_job_id"])) == 1)
+
+
+def test_broker_ready_dedup_before_dequeue():
+    # regression: two evals for one job enqueued before any dequeue must not
+    # both go ready (at most one ready-or-outstanding per job)
+    b = EvalBroker()
+    b.set_enabled(True)
+    e1 = Evaluation(type="service", job_id="j1")
+    e2 = Evaluation(type="service", job_id="j1")
+    b.enqueue(e1)
+    b.enqueue(e2)
+    assert b.stats["total_ready"] == 1
+    assert b.stats["total_pending"] == 1
+    ev, tok = b.dequeue(["service"], timeout=1)
+    none, _ = b.dequeue(["service"], timeout=0.05)
+    assert none is None  # second is still pending
+    b.ack(ev.id, tok)
+    ev2, tok2 = b.dequeue(["service"], timeout=1)
+    assert ev2.id == e2.id
+    b.ack(ev2.id, tok2)
+
+
+def test_periodic_fast_forward_no_replay():
+    # regression: missed windows while down collapse into one launch
+    from nomad_tpu.server.periodic import cron_next
+    spec = "@every 60s"
+    last, now = 0.0, 3600.0
+    nxt = cron_next(spec, last)
+    while True:
+        after = cron_next(spec, nxt)
+        if after is None or after > now:
+            break
+        nxt = after
+    assert nxt == 3600.0  # latest elapsed boundary, not 60.0
