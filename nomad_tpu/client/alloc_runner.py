@@ -1,0 +1,260 @@
+"""AllocRunner: one allocation's state machine (ref
+client/allocrunner/alloc_runner.go:299 Run, clientAlloc:653, Update:809,
+Restore:417).
+
+Runs the group's TaskRunners with lifecycle ordering (prestart -> main ->
+poststop), rolls task states up into a client status, tracks deployment
+health (min_healthy_time), and reacts to server-desired stops.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..structs import (
+    Allocation, AllocDeploymentStatus, TaskState,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING, ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT,
+    TASK_STATE_DEAD, TASK_STATE_RUNNING,
+)
+from .driver import Driver
+from .task_runner import TaskRunner
+from .taskenv import build_task_env
+
+
+class AllocRunner:
+    def __init__(self, client, alloc: Allocation):
+        self.client = client
+        self.alloc = alloc
+        self._lock = threading.Lock()
+        self.task_runners: dict[str, TaskRunner] = {}
+        self.task_states: dict[str, TaskState] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._destroyed = threading.Event()
+        self._waiters_done = threading.Event()
+        self._dirty = threading.Event()   # state changed, sync to server
+        self.deployment_healthy_at: float = 0.0
+
+        self.alloc_dir = os.path.join(client.alloc_dir_root, alloc.id)
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"alloc-{self.alloc.id[:8]}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        alloc = self.alloc
+        if alloc.server_terminal_status():
+            return
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if tg is None:
+            self._set_client_status(ALLOC_CLIENT_FAILED,
+                                    "task group not found in job")
+            return
+        os.makedirs(self.alloc_dir, exist_ok=True)
+
+        prestart = [t for t in tg.tasks if t.is_prestart()]
+        main = [t for t in tg.tasks
+                if t.lifecycle is None or (t.is_prestart() and t.lifecycle.sidecar)]
+        poststart = [t for t in tg.tasks if t.is_poststart()]
+        poststop = [t for t in tg.tasks if t.is_poststop()]
+
+        # prestart (non-sidecar) must finish before main starts
+        # (ref client/allocrunner/task_hook_coordinator.go)
+        blockers = []
+        for task in prestart:
+            tr = self._make_runner(task)
+            tr.start()
+            if not task.lifecycle.sidecar:
+                blockers.append(tr)
+        for tr in blockers:
+            tr.wait_done()
+            if tr.state.failed:
+                self._set_client_status(ALLOC_CLIENT_FAILED,
+                                        "prestart task failed")
+                self._run_poststop(poststop)
+                return
+
+        runners = []
+        for task in main:
+            if task.is_prestart():
+                continue  # sidecars already started
+            tr = self._make_runner(task)
+            tr.start()
+            runners.append(tr)
+        for task in poststart:
+            tr = self._make_runner(task)
+            tr.start()
+            runners.append(tr)
+
+        for tr in runners:
+            tr.wait_done()
+        # main work done: stop prestart sidecars
+        for task in prestart:
+            if task.lifecycle.sidecar:
+                tr = self.task_runners.get(task.name)
+                if tr:
+                    tr.kill("main tasks finished")
+                    tr.wait_done(timeout=10)
+        self._run_poststop(poststop)
+        self._waiters_done.set()
+
+    def _run_poststop(self, tasks) -> None:
+        runners = []
+        for task in tasks:
+            tr = self._make_runner(task)
+            tr.start()
+            runners.append(tr)
+        for tr in runners:
+            tr.wait_done(timeout=60)
+
+    def _make_runner(self, task) -> TaskRunner:
+        driver = self.client.get_driver(task.driver)
+        task_dir = os.path.join(self.alloc_dir, task.name)
+        env = build_task_env(self.alloc, task, self.client.node, task_dir,
+                             self.alloc_dir,
+                             os.path.join(task_dir, "secrets"))
+        tr = TaskRunner(self.alloc, task, driver, task_dir, env,
+                        self._on_task_state)
+        with self._lock:
+            self.task_runners[task.name] = tr
+        return tr
+
+    # --------------------------------------------------------------- state
+
+    def _on_task_state(self, task_name: str, state: TaskState) -> None:
+        """ref alloc_runner.go:486 handleTaskStateUpdates"""
+        with self._lock:
+            self.task_states[task_name] = state
+            # a failed leader/main task takes the others down
+            if state.state == TASK_STATE_DEAD and state.failed:
+                for name, tr in self.task_runners.items():
+                    if name != task_name and not tr.state.failed:
+                        tr.kill("sibling task failed")
+        self._dirty.set()
+        self.client.alloc_state_updated(self)
+
+    def client_alloc(self) -> Allocation:
+        """Roll task states up into the alloc's client view
+        (ref alloc_runner.go:653 clientAlloc)."""
+        with self._lock:
+            states = dict(self.task_states)
+        a = self.alloc.copy()
+        a.task_states = states
+        if not states:
+            a.client_status = ALLOC_CLIENT_PENDING
+        else:
+            any_failed = any(s.failed for s in states.values())
+            all_dead = all(s.state == TASK_STATE_DEAD for s in states.values())
+            any_running = any(s.state == TASK_STATE_RUNNING
+                              for s in states.values())
+            if all_dead:
+                a.client_status = (ALLOC_CLIENT_FAILED if any_failed
+                                   else ALLOC_CLIENT_COMPLETE)
+            elif any_failed:
+                a.client_status = ALLOC_CLIENT_FAILED
+            elif any_running:
+                a.client_status = ALLOC_CLIENT_RUNNING
+            else:
+                a.client_status = ALLOC_CLIENT_PENDING
+        a.deployment_status = self._deployment_status(a)
+        a.modify_time_unix = time.time()
+        return a
+
+    def _deployment_status(self, a: Allocation
+                           ) -> Optional[AllocDeploymentStatus]:
+        """Deployment health (ref client/allocrunner/health_hook.go +
+        allochealth tracker): healthy once all tasks run for
+        min_healthy_time; unhealthy on failure."""
+        if not self.alloc.deployment_id:
+            return self.alloc.deployment_status
+        tg = (self.alloc.job.lookup_task_group(self.alloc.task_group)
+              if self.alloc.job else None)
+        update = tg.update if tg else None
+        min_healthy = update.min_healthy_time_sec if update else 10.0
+        prev = self.alloc.deployment_status
+        canary = bool(prev and prev.canary)
+        if a.client_status == ALLOC_CLIENT_FAILED:
+            return AllocDeploymentStatus(healthy=False, canary=canary,
+                                         timestamp_unix=time.time())
+        states = a.task_states
+        if states and all(s.state == TASK_STATE_RUNNING and not s.failed
+                          for s in states.values()):
+            started = max(s.started_at for s in states.values())
+            if time.time() - started >= min_healthy:
+                return AllocDeploymentStatus(healthy=True, canary=canary,
+                                             timestamp_unix=time.time())
+        if prev is not None and prev.healthy is not None:
+            return prev
+        return AllocDeploymentStatus(healthy=None, canary=canary)
+
+    # -------------------------------------------------------------- update
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new alloc version (ref alloc_runner.go:809)."""
+        old_desired = self.alloc.desired_status
+        self.alloc = alloc
+        if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT) \
+           and old_desired not in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            self.stop()
+
+    def stop(self) -> None:
+        with self._lock:
+            runners = list(self.task_runners.values())
+        for tr in runners:
+            tr.kill("alloc stopped by server")
+        self._dirty.set()
+        self.client.alloc_state_updated(self)
+
+    def destroy(self) -> None:
+        self.stop()
+        self._destroyed.set()
+
+    def is_done(self) -> bool:
+        with self._lock:
+            states = dict(self.task_states)
+        return bool(states) and all(s.state == TASK_STATE_DEAD
+                                    for s in states.values())
+
+    # ------------------------------------------------------------- restore
+
+    def restore(self, handles: dict[str, dict]) -> None:
+        """Reattach task runners to live tasks (ref alloc_runner.go:417)."""
+        alloc = self.alloc
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if tg is None:
+            return
+        from .driver import TaskHandle
+        for task in tg.tasks:
+            h = handles.get(task.name)
+            if not h:
+                continue
+            tr = self._make_runner(task)
+            handle = TaskHandle(**h)
+            if not tr.restore(handle):
+                # task died while client was down
+                tr.state.state = TASK_STATE_DEAD
+                tr.state.failed = True
+                tr.state.finished_at = time.time()
+                self._on_task_state(task.name, tr.state)
+
+    def persistable_handles(self) -> dict[str, dict]:
+        with self._lock:
+            out = {}
+            for name, tr in self.task_runners.items():
+                if tr.handle is not None and \
+                   tr.state.state == TASK_STATE_RUNNING:
+                    out[name] = {
+                        "task_id": tr.handle.task_id,
+                        "driver": tr.handle.driver,
+                        "pid": tr.handle.pid,
+                        "config": tr.handle.config,
+                        "started_at": tr.handle.started_at,
+                    }
+            return out

@@ -1,0 +1,243 @@
+"""Client / node agent (ref client/client.go:325 NewClient, run:1710,
+registerAndHeartbeat:1584, watchAllocations:2033, runAllocs:2263,
+restoreState:1090).
+
+Talks to the server through an RPC interface (in-process for -dev mode,
+HTTP otherwise): node_register / node_heartbeat / node_update_status /
+node_get_client_allocs / node_update_allocs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..structs import (
+    Allocation, Node, ALLOC_DESIRED_STOP, NODE_STATUS_DOWN,
+    NODE_STATUS_INIT, NODE_STATUS_READY,
+)
+from .alloc_runner import AllocRunner
+from .driver import BUILTIN_DRIVERS, Driver
+from .fingerprint import fingerprint_drivers, fingerprint_node
+from .state_db import StateDB
+
+
+class Client:
+    def __init__(self, rpc, data_dir: str, datacenter: str = "dc1",
+                 node_class: str = "", name: str = "",
+                 drivers: Optional[dict[str, Driver]] = None,
+                 logger=None):
+        self.rpc = rpc
+        self.data_dir = data_dir
+        self.alloc_dir_root = os.path.join(data_dir, "allocs")
+        self.logger = logger or (lambda msg: None)
+        os.makedirs(self.alloc_dir_root, exist_ok=True)
+
+        self.state_db = StateDB(os.path.join(data_dir, "client_state.db"))
+        self.drivers: dict[str, Driver] = drivers if drivers is not None \
+            else {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+
+        node_id = self.state_db.get_node_id()
+        self.node: Node = fingerprint_node(data_dir, datacenter, node_class,
+                                           name, node_id)
+        self.state_db.put_node_id(self.node.id)
+        self.node.drivers = fingerprint_drivers(self.drivers)
+        for dname, info in self.node.drivers.items():
+            if info.detected:
+                self.node.attributes[f"driver.{dname}"] = "1"
+        self.node.status = NODE_STATUS_INIT
+        self.node.compute_class()
+
+        self._lock = threading.Lock()
+        self.alloc_runners: dict[str, AllocRunner] = {}
+        self._alloc_versions: dict[str, int] = {}   # alloc_id -> modify_index
+        self._last_alloc_index = 0
+        self._heartbeat_ttl = 10.0
+        self._shutdown = threading.Event()
+        self._dirty_allocs: set[str] = set()
+        self._dirty_cond = threading.Condition()
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._restore_state()
+        self._register()
+        for target, name in ((self._heartbeat_loop, "client-heartbeat"),
+                             (self._watch_allocations, "client-watch-allocs"),
+                             (self._sync_allocs_loop, "client-alloc-sync")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._dirty_cond:
+            self._dirty_cond.notify_all()
+        with self._lock:
+            runners = list(self.alloc_runners.values())
+        for ar in runners:
+            for tr in list(ar.task_runners.values()):
+                tr.kill("client shutting down")
+
+    # ---------------------------------------------------------- registration
+
+    def _register(self) -> None:
+        """ref client.go:1584 registerAndHeartbeat (register half)"""
+        while not self._shutdown.is_set():
+            try:
+                resp = self.rpc.node_register(self.node)
+                self._heartbeat_ttl = resp.get("heartbeat_ttl", 10.0)
+                break
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"client: register failed: {e!r}")
+                self._shutdown.wait(1.0)
+        try:
+            self.rpc.node_update_status(self.node.id, NODE_STATUS_READY)
+            self.node.status = NODE_STATUS_READY
+        except Exception as e:          # noqa: BLE001
+            self.logger(f"client: ready update failed: {e!r}")
+
+    def _heartbeat_loop(self) -> None:
+        # heartbeats go through UpdateStatus(ready), not a bare TTL reset,
+        # so a node the server marked down transitions back to ready and
+        # blocked evals unblock (ref client.go registerAndHeartbeat ->
+        # Node.UpdateStatus)
+        while not self._shutdown.wait(max(0.2, self._heartbeat_ttl / 2)):
+            try:
+                resp = self.rpc.node_update_status(self.node.id,
+                                                   NODE_STATUS_READY)
+                self._heartbeat_ttl = resp.get("heartbeat_ttl",
+                                               self._heartbeat_ttl)
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"client: heartbeat failed: {e!r}")
+                # re-register: the server may have GC'd us
+                try:
+                    self.rpc.node_register(self.node)
+                    self.rpc.node_update_status(self.node.id,
+                                                NODE_STATUS_READY)
+                except Exception:       # noqa: BLE001
+                    pass
+
+    # --------------------------------------------------------- alloc watch
+
+    def _watch_allocations(self) -> None:
+        """Long-poll the server for alloc changes (ref client.go:2033)."""
+        while not self._shutdown.is_set():
+            try:
+                resp = self.rpc.node_get_client_allocs(
+                    self.node.id, min_index=self._last_alloc_index,
+                    timeout=5.0)
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"client: watch allocs failed: {e!r}")
+                self._shutdown.wait(1.0)
+                continue
+            self._last_alloc_index = max(self._last_alloc_index,
+                                         resp.get("index", 0))
+            self._run_allocs(resp.get("allocs", {}))
+
+    def _run_allocs(self, server_allocs: dict[str, int]) -> None:
+        """Diff desired vs running (ref client.go:2263 runAllocs)."""
+        with self._lock:
+            known = dict(self._alloc_versions)
+        # removed allocs: server no longer tracks them => destroy
+        for alloc_id in set(known) - set(server_allocs):
+            self._remove_alloc(alloc_id)
+        # new or updated
+        for alloc_id, modify_index in server_allocs.items():
+            if known.get(alloc_id) == modify_index:
+                continue
+            try:
+                alloc = self.rpc.alloc_get(alloc_id)
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"client: fetch alloc {alloc_id[:8]}: {e!r}")
+                continue
+            if alloc is None:
+                continue
+            with self._lock:
+                self._alloc_versions[alloc_id] = modify_index
+                existing = self.alloc_runners.get(alloc_id)
+            if existing is not None:
+                existing.update(alloc)
+            elif not alloc.terminal_status():
+                self._add_alloc(alloc)
+
+    def _add_alloc(self, alloc: Allocation) -> None:
+        ar = AllocRunner(self, alloc)
+        with self._lock:
+            self.alloc_runners[alloc.id] = ar
+        self.state_db.put_allocation(alloc)
+        ar.run()
+
+    def _remove_alloc(self, alloc_id: str) -> None:
+        with self._lock:
+            ar = self.alloc_runners.pop(alloc_id, None)
+            self._alloc_versions.pop(alloc_id, None)
+        if ar is not None:
+            ar.destroy()
+        self.state_db.delete_allocation(alloc_id)
+
+    # ----------------------------------------------------------- alloc sync
+
+    def alloc_state_updated(self, ar: AllocRunner) -> None:
+        with self._dirty_cond:
+            self._dirty_allocs.add(ar.alloc.id)
+            self._dirty_cond.notify_all()
+        # persist reattach handles on every transition
+        self.state_db.put_task_handles(ar.alloc.id, ar.persistable_handles())
+
+    def _sync_allocs_loop(self) -> None:
+        """Batched client->server status updates (ref client.go
+        allocSync)."""
+        while not self._shutdown.is_set():
+            with self._dirty_cond:
+                if not self._dirty_allocs:
+                    self._dirty_cond.wait(1.0)
+                dirty = list(self._dirty_allocs)
+                self._dirty_allocs.clear()
+            if not dirty:
+                continue
+            updates = []
+            with self._lock:
+                for alloc_id in dirty:
+                    ar = self.alloc_runners.get(alloc_id)
+                    if ar is not None:
+                        updates.append(ar.client_alloc())
+            if not updates:
+                continue
+            try:
+                self.rpc.node_update_allocs(updates)
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"client: alloc sync failed: {e!r}")
+                with self._dirty_cond:
+                    self._dirty_allocs.update(dirty)
+                self._shutdown.wait(0.5)
+
+    # -------------------------------------------------------------- restore
+
+    def _restore_state(self) -> None:
+        """Reattach to allocs from the local state DB (ref client.go:1090
+        restoreState)."""
+        for alloc in self.state_db.get_all_allocations():
+            if alloc.server_terminal_status():
+                self.state_db.delete_allocation(alloc.id)
+                continue
+            handles = self.state_db.get_task_handles(alloc.id)
+            ar = AllocRunner(self, alloc)
+            with self._lock:
+                self.alloc_runners[alloc.id] = ar
+            if handles:
+                ar.restore(handles)
+
+    # -------------------------------------------------------------- helpers
+
+    def get_driver(self, name: str) -> Driver:
+        driver = self.drivers.get(name)
+        if driver is None:
+            raise ValueError(f"driver {name!r} not available")
+        return driver
+
+    def num_allocs(self) -> int:
+        with self._lock:
+            return len(self.alloc_runners)

@@ -1,0 +1,251 @@
+"""Task driver interface + built-in drivers (ref plugins/drivers/driver.go:47
+DriverPlugin and drivers/mock, drivers/rawexec).
+
+The DriverPlugin contract: fingerprint / start_task / wait_task / stop_task /
+destroy_task / inspect_task / recover_task. In-process here; the executor
+subprocess boundary (ref drivers/shared/executor) arrives with the C++
+runtime sidecar.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+from typing import Optional
+
+from ..structs import DriverInfo
+
+
+@dataclasses.dataclass
+class TaskHandle:
+    """Recoverable handle to a running task (ref drivers TaskHandle +
+    reattach config)."""
+    task_id: str = ""
+    driver: str = ""
+    pid: int = 0
+    config: dict = dataclasses.field(default_factory=dict)
+    started_at: float = 0.0
+
+
+@dataclasses.dataclass
+class ExitResult:
+    exit_code: int = 0
+    signal: int = 0
+    oom_killed: bool = False
+    err: str = ""
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.err
+
+
+class Driver:
+    name = "driver"
+
+    def fingerprint(self) -> DriverInfo:
+        return DriverInfo(detected=True, healthy=True)
+
+    def start_task(self, task_id: str, task, task_dir: str,
+                   env: dict[str, str]) -> TaskHandle:
+        raise NotImplementedError
+
+    def wait_task(self, task_id: str, timeout: Optional[float] = None
+                  ) -> Optional[ExitResult]:
+        raise NotImplementedError
+
+    def stop_task(self, task_id: str, kill_timeout: float = 5.0,
+                  sig: str = "") -> None:
+        raise NotImplementedError
+
+    def destroy_task(self, task_id: str) -> None:
+        pass
+
+    def inspect_task(self, task_id: str) -> Optional[TaskHandle]:
+        return None
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        """Reattach after client restart; True if the task is still live."""
+        return False
+
+
+class MockDriver(Driver):
+    """Configurable fake driver for tests (ref drivers/mock): config keys
+    run_for (sec), exit_code, start_error, kill_after."""
+
+    name = "mock_driver"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: dict[str, dict] = {}
+
+    def start_task(self, task_id, task, task_dir, env):
+        cfg = task.config
+        if cfg.get("start_error"):
+            raise RuntimeError(cfg["start_error"])
+        now = time.time()
+        rec = {
+            "ends_at": now + float(cfg.get("run_for", 0.0)),
+            "exit_code": int(cfg.get("exit_code", 0)),
+            "stopped": threading.Event(),
+            "started_at": now,
+        }
+        with self._lock:
+            self._tasks[task_id] = rec
+        return TaskHandle(task_id=task_id, driver=self.name, started_at=now)
+
+    def wait_task(self, task_id, timeout=None):
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            return ExitResult(err="unknown task")
+        deadline = time.time() + timeout if timeout is not None else None
+        while True:
+            remaining = rec["ends_at"] - time.time()
+            if rec["stopped"].is_set():
+                return ExitResult(exit_code=0, signal=9)
+            if remaining <= 0:
+                return ExitResult(exit_code=rec["exit_code"])
+            if deadline is not None and time.time() >= deadline:
+                return None
+            rec["stopped"].wait(min(0.05, max(0.01, remaining)))
+
+    def stop_task(self, task_id, kill_timeout=5.0, sig=""):
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec:
+            rec["stopped"].set()
+
+    def destroy_task(self, task_id):
+        with self._lock:
+            self._tasks.pop(task_id, None)
+
+    def recover_task(self, handle):
+        with self._lock:
+            return handle.task_id in self._tasks
+
+
+class RawExecDriver(Driver):
+    """Fork/exec without isolation (ref drivers/rawexec): config keys
+    command, args."""
+
+    name = "raw_exec"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def start_task(self, task_id, task, task_dir, env):
+        cfg = task.config
+        command = cfg.get("command", "")
+        if not command:
+            raise ValueError("raw_exec requires config.command")
+        args = cfg.get("args", [])
+        if isinstance(args, str):
+            args = shlex.split(args)
+        full_env = dict(os.environ)
+        full_env.update(env)
+        stdout = open(os.path.join(task_dir, f"{task.name}.stdout.log"), "ab")
+        stderr = open(os.path.join(task_dir, f"{task.name}.stderr.log"), "ab")
+        proc = subprocess.Popen(
+            [command] + list(args), cwd=task_dir, env=full_env,
+            stdout=stdout, stderr=stderr,
+            start_new_session=True)   # own process group for clean kill
+        with self._lock:
+            self._procs[task_id] = proc
+        return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid,
+                          started_at=time.time())
+
+    def wait_task(self, task_id, timeout=None):
+        with self._lock:
+            proc = self._procs.get(task_id)
+        if proc is None:
+            return ExitResult(err="unknown task")
+        try:
+            code = proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        if code is None:
+            return None
+        if code < 0:
+            return ExitResult(exit_code=0, signal=-code)
+        return ExitResult(exit_code=code)
+
+    def stop_task(self, task_id, kill_timeout=5.0, sig=""):
+        with self._lock:
+            proc = self._procs.get(task_id)
+        if proc is None or proc.poll() is not None:
+            return
+        signum = getattr(signal, sig, signal.SIGINT) if sig else signal.SIGINT
+        try:
+            os.killpg(os.getpgid(proc.pid), signum)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + kill_timeout
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def destroy_task(self, task_id):
+        self.stop_task(task_id, kill_timeout=0.1)
+        with self._lock:
+            self._procs.pop(task_id, None)
+
+    def recover_task(self, handle):
+        if handle.pid <= 0:
+            return False
+        try:
+            os.kill(handle.pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            pass
+        # re-wrap the pid so wait/stop work post-restart
+        proc = _ReattachedProcess(handle.pid)
+        with self._lock:
+            self._procs[handle.task_id] = proc   # type: ignore[assignment]
+        return True
+
+
+class _ReattachedProcess:
+    """Minimal Popen-alike over a bare pid for post-restart reattach."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._code: Optional[int] = None
+
+    def poll(self):
+        if self._code is not None:
+            return self._code
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            self._code = 0
+            return self._code
+
+    def wait(self, timeout=None):
+        deadline = time.time() + timeout if timeout is not None else None
+        while True:
+            code = self.poll()
+            if code is not None:
+                return code
+            if deadline is not None and time.time() >= deadline:
+                raise subprocess.TimeoutExpired(cmd=f"pid:{self.pid}",
+                                                timeout=timeout)
+            time.sleep(0.05)
+
+
+BUILTIN_DRIVERS = {
+    "mock_driver": MockDriver,
+    "raw_exec": RawExecDriver,
+    "exec": RawExecDriver,      # isolation-free placeholder until the
+                                # C++ executor sidecar lands
+}

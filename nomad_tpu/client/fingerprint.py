@@ -1,0 +1,110 @@
+"""Host fingerprinting (ref client/fingerprint/ — arch, cpu, memory,
+storage, network, host, nomad version — one fingerprinter per concern,
+merged into the Node)."""
+from __future__ import annotations
+
+import os
+import platform
+import shutil
+import socket
+import uuid
+
+from ..structs import (
+    NetworkResource, Node, NodeCpuResources, NodeDiskResources,
+    NodeMemoryResources, NodeNetworkResource, NodeResources,
+)
+from .. import __version__
+
+
+def _cpu_mhz_total() -> tuple[int, int]:
+    """(total MHz across cores, core count)"""
+    cores = os.cpu_count() or 1
+    mhz = 1000.0
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    mhz = float(line.split(":")[1])
+                    break
+    except OSError:
+        pass
+    return int(mhz * cores), cores
+
+
+def _memory_mb() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return 1024
+
+
+def _disk_mb(path: str) -> int:
+    try:
+        usage = shutil.disk_usage(path)
+        return usage.free // (1024 * 1024)
+    except OSError:
+        return 10 * 1024
+
+
+def _host_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def fingerprint_node(data_dir: str = "/tmp", datacenter: str = "dc1",
+                     node_class: str = "", name: str = "",
+                     node_id: str = "") -> Node:
+    """Assemble a Node from host fingerprints (ref
+    client/fingerprint_manager.go + client.go:1462
+    updateNodeFromFingerprint)."""
+    cpu_mhz, cores = _cpu_mhz_total()
+    ip = _host_ip()
+    node = Node(
+        id=node_id or str(uuid.uuid4()),
+        name=name or platform.node() or "node",
+        datacenter=datacenter,
+        node_class=node_class,
+        attributes={
+            "kernel.name": platform.system().lower(),
+            "kernel.version": platform.release(),
+            "arch": platform.machine(),
+            "os.name": platform.system().lower(),
+            "cpu.numcores": str(cores),
+            "cpu.totalcompute": str(cpu_mhz),
+            "memory.totalbytes": str(_memory_mb() * 1024 * 1024),
+            "nomad.version": __version__,
+            "unique.hostname": platform.node(),
+            "unique.network.ip-address": ip,
+        },
+        node_resources=NodeResources(
+            cpu=NodeCpuResources(cpu_shares=cpu_mhz, total_core_count=cores,
+                                 reservable_cores=list(range(cores))),
+            memory=NodeMemoryResources(memory_mb=_memory_mb()),
+            disk=NodeDiskResources(disk_mb=_disk_mb(data_dir)),
+            networks=[NetworkResource(device="eth0", ip=ip,
+                                      cidr=f"{ip}/32", mbits=1000)],
+            node_networks=[NodeNetworkResource(
+                mode="host", device="eth0", speed=1000,
+                addresses=[{"alias": "default", "address": ip}])],
+        ),
+    )
+    return node
+
+
+def fingerprint_drivers(drivers: dict) -> dict:
+    """Driver fingerprints -> node.drivers + attributes
+    (ref pluginmanager/drivermanager)."""
+    out = {}
+    for name, driver in drivers.items():
+        out[name] = driver.fingerprint()
+    return out

@@ -1,0 +1,82 @@
+"""Client-local state persistence (ref client/state/state_database.go:123
+BoltStateDB): allocs + task handles survive client restarts so a restarted
+client reattaches to live tasks instead of killing them."""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+from typing import Optional
+
+from ..structs import Allocation
+
+
+class StateDB:
+    """Durable map of alloc -> (alloc snapshot, task handles). File-backed
+    pickle with atomic replace; the interface mirrors the reference's
+    (PutAllocation / GetAllAllocations / PutTaskRunnerHandle /
+    DeleteAllocationBucket)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._allocs: dict[str, Allocation] = {}
+        self._handles: dict[str, dict[str, dict]] = {}
+        self._node_id: str = ""
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, "rb") as f:
+                blob = pickle.load(f)
+            self._allocs = blob.get("allocs", {})
+            self._handles = blob.get("handles", {})
+            self._node_id = blob.get("node_id", "")
+        except Exception:
+            # corrupt state: start fresh (the reference logs + recovers too)
+            self._allocs, self._handles = {}, {}
+
+    def _flush_locked(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"allocs": self._allocs, "handles": self._handles,
+                         "node_id": self._node_id}, f)
+        os.replace(tmp, self.path)
+
+    def put_node_id(self, node_id: str) -> None:
+        with self._lock:
+            self._node_id = node_id
+            self._flush_locked()
+
+    def get_node_id(self) -> str:
+        with self._lock:
+            return self._node_id
+
+    def put_allocation(self, alloc: Allocation) -> None:
+        with self._lock:
+            self._allocs[alloc.id] = alloc
+            self._flush_locked()
+
+    def get_all_allocations(self) -> list[Allocation]:
+        with self._lock:
+            return list(self._allocs.values())
+
+    def put_task_handles(self, alloc_id: str,
+                         handles: dict[str, dict]) -> None:
+        with self._lock:
+            self._handles[alloc_id] = handles
+            self._flush_locked()
+
+    def get_task_handles(self, alloc_id: str) -> dict[str, dict]:
+        with self._lock:
+            return dict(self._handles.get(alloc_id, {}))
+
+    def delete_allocation(self, alloc_id: str) -> None:
+        with self._lock:
+            self._allocs.pop(alloc_id, None)
+            self._handles.pop(alloc_id, None)
+            self._flush_locked()

@@ -1,0 +1,212 @@
+"""TaskRunner: one task's lifecycle (ref
+client/allocrunner/taskrunner/task_runner.go:480 Run, restart logic :738,
+restoreHandle :1129).
+
+Loop: prestart hooks (dirs, env, artifacts/templates as stubs) -> driver
+start -> wait -> restart policy (attempts within interval, delay,
+mode fail|delay) -> terminal state. Task events accumulate on TaskState.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..structs import (
+    Task, TaskEvent, TaskState, TASK_STATE_DEAD, TASK_STATE_PENDING,
+    TASK_STATE_RUNNING,
+)
+from .driver import Driver, ExitResult, TaskHandle
+
+EVENT_RECEIVED = "Received"
+EVENT_TASK_SETUP = "Task Setup"
+EVENT_STARTED = "Started"
+EVENT_TERMINATED = "Terminated"
+EVENT_RESTARTING = "Restarting"
+EVENT_NOT_RESTARTING = "Not Restarting"
+EVENT_KILLING = "Killing"
+EVENT_KILLED = "Killed"
+EVENT_DRIVER_FAILURE = "Driver Failure"
+
+
+class TaskRunner:
+    def __init__(self, alloc, task: Task, driver: Driver, task_dir: str,
+                 env: dict[str, str],
+                 on_state_change: Callable[[str, TaskState], None]):
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.task_dir = task_dir
+        self.env = env
+        self.on_state_change = on_state_change
+
+        self.state = TaskState()
+        self.handle: Optional[TaskHandle] = None
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._restarts_in_window: list[float] = []
+
+        tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
+        self.restart_policy = tg.restart_policy if tg else None
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.alloc.id}/{self.task.name}"
+
+    # ---------------------------------------------------------------- run
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"task-{self.task.name}")
+        self._thread.start()
+
+    def run(self) -> None:
+        self._emit(EVENT_RECEIVED, "task received by client")
+        try:
+            self._setup()
+        except Exception as e:          # noqa: BLE001
+            self._fail(EVENT_TASK_SETUP, f"setup failed: {e}")
+            return
+        while not self._kill.is_set():
+            try:
+                self.handle = self.driver.start_task(
+                    self.task_id, self.task, self.task_dir, self.env)
+            except Exception as e:      # noqa: BLE001
+                if not self._should_restart(failed=True,
+                                            reason=f"driver start: {e}"):
+                    self._fail(EVENT_DRIVER_FAILURE, str(e))
+                    return
+                continue
+            self._set_state(TASK_STATE_RUNNING, EVENT_STARTED,
+                            "task started by client")
+            result = self._wait_for_exit()
+            if self._kill.is_set():
+                self._emit(EVENT_KILLED, "task killed")
+                self._finish(failed=False)
+                return
+            failed = result is None or not result.successful()
+            code = result.exit_code if result else -1
+            self._emit(EVENT_TERMINATED, f"exit code: {code}")
+            if not self._should_restart(failed=failed,
+                                        reason=f"exit {code}"):
+                self._finish(failed=failed)
+                return
+        self._emit(EVENT_KILLED, "task killed")
+        self._finish(failed=False)
+
+    def _setup(self) -> None:
+        os.makedirs(self.task_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.task_dir, "local"), exist_ok=True)
+        os.makedirs(os.path.join(self.task_dir, "secrets"), exist_ok=True)
+
+    def _wait_for_exit(self) -> Optional[ExitResult]:
+        while not self._kill.is_set():
+            result = self.driver.wait_task(self.task_id, timeout=0.2)
+            if result is not None:
+                return result
+        # killed: stop the task
+        self.driver.stop_task(self.task_id,
+                              kill_timeout=self.task.kill_timeout_sec,
+                              sig=self.task.kill_signal)
+        return None
+
+    # ------------------------------------------------------------ restarts
+
+    def _should_restart(self, failed: bool, reason: str) -> bool:
+        """ref taskrunner/restarts/restarts.go"""
+        pol = self.restart_policy
+        if pol is None or self._kill.is_set():
+            return False
+        if not failed and self.alloc.job is not None and \
+           self.alloc.job.type == "service":
+            # service tasks restart even on clean exit
+            pass
+        elif not failed:
+            return False
+        now = time.time()
+        window_start = now - pol.interval_sec
+        self._restarts_in_window = [t for t in self._restarts_in_window
+                                    if t >= window_start]
+        if len(self._restarts_in_window) >= pol.attempts:
+            if pol.mode == "delay":
+                self._emit(EVENT_RESTARTING,
+                           f"exceeded attempts, delaying {pol.interval_sec}s")
+                if self._kill.wait(pol.interval_sec):
+                    return False
+                self._restarts_in_window = []
+            else:
+                self._emit(EVENT_NOT_RESTARTING, "exceeded restart attempts")
+                return False
+        self._restarts_in_window.append(now)
+        self.state.restarts += 1
+        self.state.last_restart_unix = now
+        self._emit(EVENT_RESTARTING, f"restarting: {reason}")
+        if self._kill.wait(pol.delay_sec):
+            return False
+        return True
+
+    # ---------------------------------------------------------------- kill
+
+    def kill(self, reason: str = "") -> None:
+        self._emit(EVENT_KILLING, reason or "task is being killed")
+        self._kill.set()
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def restore(self, handle: TaskHandle) -> bool:
+        """Reattach to a live task after client restart (ref
+        task_runner.go:1129 restoreHandle)."""
+        if self.driver.recover_task(handle):
+            self.handle = handle
+            self._thread = threading.Thread(
+                target=self._run_restored, daemon=True,
+                name=f"task-{self.task.name}")
+            self._thread.start()
+            return True
+        return False
+
+    def _run_restored(self) -> None:
+        self._set_state(TASK_STATE_RUNNING, EVENT_RECEIVED,
+                        "task reattached after client restart")
+        result = self._wait_for_exit()
+        if self._kill.is_set():
+            self._emit(EVENT_KILLED, "task killed")
+            self._finish(failed=False)
+            return
+        failed = result is None or not result.successful()
+        self._emit(EVENT_TERMINATED,
+                   f"exit code: {result.exit_code if result else -1}")
+        if self._should_restart(failed=failed, reason="post-restore exit"):
+            self.run()
+            return
+        self._finish(failed=failed)
+
+    # --------------------------------------------------------------- state
+
+    def _emit(self, etype: str, message: str) -> None:
+        self.state.events.append(TaskEvent(type=etype, time_unix=time.time(),
+                                           message=message))
+        self.on_state_change(self.task.name, self.state)
+
+    def _set_state(self, state: str, etype: str, message: str) -> None:
+        self.state.state = state
+        if state == TASK_STATE_RUNNING and not self.state.started_at:
+            self.state.started_at = time.time()
+        self.state.events.append(TaskEvent(type=etype, time_unix=time.time(),
+                                           message=message))
+        self.on_state_change(self.task.name, self.state)
+
+    def _finish(self, failed: bool) -> None:
+        self.state.state = TASK_STATE_DEAD
+        self.state.failed = failed
+        self.state.finished_at = time.time()
+        self.driver.destroy_task(self.task_id)
+        self.on_state_change(self.task.name, self.state)
+        self._done.set()
+
+    def _fail(self, etype: str, message: str) -> None:
+        self._emit(etype, message)
+        self._finish(failed=True)

@@ -71,8 +71,10 @@ class CoreScheduler:
         return len(gc_evals)
 
     def job_gc(self, force: bool = False) -> int:
-        """ref core_sched.go:94 jobGC: dead jobs with no live evals/allocs."""
+        """ref core_sched.go:94 jobGC: dead jobs with no live evals/allocs,
+        older than the GC threshold (unless forced)."""
         state = self.server.state
+        cutoff = self._cutoff(self.job_gc_threshold, force)
         gc = []
         for job in state.iter_jobs():
             if job.status != JOB_STATUS_DEAD:
@@ -84,6 +86,12 @@ class CoreScheduler:
                 continue
             allocs = state.allocs_by_job(job.namespace, job.id)
             if any(not a.terminal_status() for a in allocs):
+                continue
+            last_activity = max(
+                [job.submit_time] +
+                [e.modify_time_unix for e in evals] +
+                [a.modify_time_unix for a in allocs])
+            if last_activity > cutoff:
                 continue
             gc.append(job)
         for job in gc:

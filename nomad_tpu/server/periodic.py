@@ -55,12 +55,14 @@ def cron_next(spec: str, after: float) -> Optional[float]:
     hours = parse_cron_field(fields[1], 0, 23)
     doms = parse_cron_field(fields[2], 1, 31)
     months = parse_cron_field(fields[3], 1, 12)
-    dows = parse_cron_field(fields[4], 0, 6)
+    # cron DOW: Sun=0 (and 7 as the common Sunday alias)
+    dows = {v % 7 for v in parse_cron_field(fields[4], 0, 7)}
     t = datetime.fromtimestamp(after, tz=timezone.utc).replace(
         second=0, microsecond=0) + timedelta(minutes=1)
     for _ in range(366 * 24 * 60):   # bounded search: one year of minutes
+        cron_dow = (t.weekday() + 1) % 7   # Python Mon=0 -> cron Sun=0
         if (t.minute in mins and t.hour in hours and t.day in doms and
-                t.month in months and t.weekday() % 7 in dows):
+                t.month in months and cron_dow in dows):
             return t.timestamp()
         t += timedelta(minutes=1)
     return None
@@ -136,7 +138,8 @@ class PeriodicDispatch:
             nxt = after
         if job.periodic.prohibit_overlap:
             for child in state.iter_jobs(job.namespace):
-                if child.parent_id == job.id and child.status == "running":
+                # any non-terminal child (pending/blocked included) blocks
+                if child.parent_id == job.id and child.status != "dead":
                     return
         self.force_launch(job, nxt)
 

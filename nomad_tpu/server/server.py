@@ -39,8 +39,10 @@ from .periodic import PeriodicDispatch
 from .plan_apply import Planner
 from .worker import Worker
 
+# workers do NOT consume "_failed": the leader reaps the dead-letter queue
+# (ref nomad/leader.go:782 reapFailedEvaluations)
 SCHEDULER_TYPES = [JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM,
-                   JOB_TYPE_SYSBATCH, JOB_TYPE_CORE, "_failed"]
+                   JOB_TYPE_SYSBATCH, JOB_TYPE_CORE]
 
 
 class Server:
@@ -113,6 +115,7 @@ class Server:
         last_gc = time.time()
         while not self._leader_stop.wait(1.0):
             self.eval_broker.check_nack_timeouts()
+            self._reap_failed_evaluations()
             if time.time() - last_gc >= self.gc_interval:
                 last_gc = time.time()
                 for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
@@ -120,6 +123,25 @@ class Server:
                     self.eval_broker.enqueue(Evaluation(
                         type=JOB_TYPE_CORE, job_id=kind,
                         priority=200, status="pending"))
+
+    def _reap_failed_evaluations(self) -> None:
+        """Dead-letter consumer (ref leader.go:782): mark the eval failed and
+        schedule a delayed retry so a broken eval can't hot-loop workers."""
+        from ..structs import EVAL_STATUS_FAILED
+        while True:
+            ev, token = self.eval_broker.dequeue(["_failed"], timeout=0.0)
+            if ev is None:
+                return
+            failed = ev.copy()
+            failed.status = EVAL_STATUS_FAILED
+            failed.status_description = \
+                "evaluation reached delivery limit"
+            follow_up = ev.create_failed_follow_up_eval(wait_sec=60.0)
+            self.raft.apply(EVAL_UPDATE, {"evals": [failed, follow_up]})
+            try:
+                self.eval_broker.ack(ev.id, token)
+            except ValueError:
+                pass
 
     def _on_eval_update(self, evals: list[Evaluation]) -> None:
         if not self.is_leader:
@@ -152,9 +174,10 @@ class Server:
                 triggered_by=TRIGGER_JOB_REGISTER, job_id=job.id,
                 status=EVAL_STATUS_PENDING))
         index = self.raft.apply(JOB_REGISTER, {"job": job, "evals": evals})
-        if job.is_periodic() and not job.stopped():
-            stored = self.state.job_by_id(job.namespace, job.id)
-            self.periodic.add(stored)
+        # unconditional: PeriodicDispatch.add untracks jobs that are no
+        # longer periodic/are stopped, so updates can't leave stale children
+        stored = self.state.job_by_id(job.namespace, job.id)
+        self.periodic.add(stored)
         self.blocked_evals.untrack(job.namespace, job.id)
         return {"eval_id": evals[0].id if evals else "", "index": index,
                 "job_modify_index": index}
@@ -356,6 +379,30 @@ class Server:
         if evals:
             self.raft.apply(EVAL_UPDATE, {"evals": evals})
         return {"index": index, "eval_ids": [e.id for e in evals]}
+
+    # ----------------------------------------------------- Alloc endpoints
+
+    def alloc_get(self, alloc_id: str):
+        """ref nomad/alloc_endpoint.go GetAlloc"""
+        return self.state.alloc_by_id(alloc_id)
+
+    def alloc_stop(self, alloc_id: str) -> dict:
+        """User-initiated alloc stop (ref alloc_endpoint.go Stop): mark the
+        transition and create an eval."""
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id} not found")
+        from ..structs import DesiredTransition
+        ev = Evaluation(
+            namespace=alloc.namespace,
+            priority=alloc.job.priority if alloc.job else 50,
+            type=alloc.job.type if alloc.job else JOB_TYPE_SERVICE,
+            triggered_by=TRIGGER_ALLOC_STOP, job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING)
+        self.raft.apply(ALLOC_UPDATE_DESIRED_TRANSITION, {
+            "transitions": {alloc_id: DesiredTransition(migrate=True)},
+            "evals": [ev]})
+        return {"eval_id": ev.id}
 
     # ------------------------------------------------------ Eval endpoints
 

@@ -1,0 +1,188 @@
+"""Client tests: end-to-end server+client with mock and raw_exec drivers
+(modeled on client/client_test.go behaviors)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    RestartPolicy, ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_RUNNING,
+)
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    client = Client(server, data_dir=str(tmp_path / "client"))
+    client.start()
+    assert wait_until(lambda: server.state.node_by_id(client.node.id) is not None
+                      and server.state.node_by_id(client.node.id).ready())
+    yield server, client
+    client.shutdown()
+    server.shutdown()
+
+
+def _job(run_for=60.0, exit_code=0, count=1, jtype="service"):
+    job = mock.job() if jtype == "service" else mock.batch_job()
+    job.type = jtype
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": run_for, "exit_code": exit_code}
+    task.resources.networks = []
+    task.resources.cpu = 100
+    task.resources.memory_mb = 32
+    return job
+
+
+def test_end_to_end_service_job_runs(cluster):
+    server, client = cluster
+    job = _job(run_for=60.0)
+    server.job_register(job)
+    # alloc placed, picked up by the client, and reported running
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)))
+    assert client.num_allocs() == 1
+
+
+def test_end_to_end_batch_job_completes(cluster):
+    server, client = cluster
+    job = _job(run_for=0.2, jtype="batch")
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in server.state.allocs_by_job("default", job.id)))
+    assert wait_until(
+        lambda: server.state.job_by_id("default", job.id).status == "dead")
+
+
+def test_end_to_end_raw_exec_process(cluster, tmp_path):
+    server, client = cluster
+    marker = tmp_path / "ran.txt"
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", f"echo $NOMAD_ALLOC_ID > {marker}"]}
+    task.resources.networks = []
+    task.resources.cpu = 100
+    task.resources.memory_mb = 32
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in server.state.allocs_by_job("default", job.id)))
+    assert marker.exists()
+    alloc = server.state.allocs_by_job("default", job.id)[0]
+    assert marker.read_text().strip() == alloc.id
+
+
+def test_failed_task_restarts_then_fails(cluster):
+    server, client = cluster
+    job = _job(run_for=0.05, exit_code=1, jtype="service")
+    tg = job.task_groups[0]
+    tg.restart_policy = RestartPolicy(attempts=1, interval_sec=300,
+                                      delay_sec=0.05, mode="fail")
+    tg.reschedule_policy = None
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_FAILED
+        for a in server.state.allocs_by_job("default", job.id)))
+    alloc = next(a for a in server.state.allocs_by_job("default", job.id)
+                 if a.client_status == ALLOC_CLIENT_FAILED)
+    ts = alloc.task_states["web"]
+    assert ts.restarts == 1
+    assert ts.failed
+
+
+def test_job_stop_kills_running_allocs(cluster):
+    server, client = cluster
+    job = _job(run_for=120.0)
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)))
+    server.job_deregister("default", job.id)
+    assert wait_until(lambda: all(
+        a.client_terminal_status()
+        for a in server.state.allocs_by_job("default", job.id)))
+
+
+def test_task_env_interpolation(cluster, tmp_path):
+    server, client = cluster
+    out = tmp_path / "env.txt"
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.env = {"MY_DC": "${node.datacenter}", "MY_JOB": "${NOMAD_JOB_ID}"}
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", f"echo $MY_DC $MY_JOB > {out}"]}
+    task.resources.networks = []
+    task.resources.cpu = 100
+    task.resources.memory_mb = 32
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in server.state.allocs_by_job("default", job.id)))
+    assert out.read_text().strip() == f"dc1 {job.id}"
+
+
+def test_client_restart_reattaches_raw_exec(tmp_path):
+    """The clientstate story: a restarted client must reattach to live
+    processes, not kill them (ref task_runner.go:1129)."""
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    data_dir = str(tmp_path / "client")
+    client = Client(server, data_dir=data_dir)
+    client.start()
+    assert wait_until(lambda: server.state.node_by_id(client.node.id) is not None)
+
+    marker = tmp_path / "done.txt"
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", f"sleep 2 && echo ok > {marker}"]}
+    task.resources.networks = []
+    task.resources.cpu = 100
+    task.resources.memory_mb = 32
+    server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)))
+
+    # "crash" the client without killing tasks: drop runners on the floor
+    client._shutdown.set()
+    old_node_id = client.node.id
+
+    # new client over the same data dir reattaches (same node identity)
+    client2 = Client(server, data_dir=data_dir)
+    assert client2.node.id == old_node_id
+    client2.start()
+    assert wait_until(lambda: marker.exists(), timeout=10)
+    assert wait_until(lambda: any(
+        a.client_status == ALLOC_CLIENT_COMPLETE
+        for a in server.state.allocs_by_job("default", job.id)), timeout=10)
+    client2.shutdown()
+    server.shutdown()

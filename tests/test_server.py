@@ -311,3 +311,52 @@ def test_periodic_fast_forward_no_replay():
             break
         nxt = after
     assert nxt == 3600.0  # latest elapsed boundary, not 60.0
+
+
+def test_cron_dow_numbering():
+    # regression: cron DOW is Sun=0 (7 also Sunday); 2026-08-02 is a Sunday
+    import datetime, calendar
+    t = cron_next("0 0 * * 0", datetime.datetime(
+        2026, 7, 29, tzinfo=datetime.timezone.utc).timestamp())
+    d = datetime.datetime.fromtimestamp(t, tz=datetime.timezone.utc)
+    assert d.strftime("%A") == "Sunday"
+    t7 = cron_next("0 0 * * 7", datetime.datetime(
+        2026, 7, 29, tzinfo=datetime.timezone.utc).timestamp())
+    assert t7 == t
+
+
+def test_periodic_update_to_nonperiodic_untracks(server):
+    job = mock.batch_job()
+    job.periodic = PeriodicConfig(enabled=True, spec="@every 3600s")
+    server.job_register(job)
+    assert len(server.periodic.tracked()) == 1
+    j2 = job.copy()
+    j2.periodic = None
+    server.job_register(j2)
+    assert server.periodic.tracked() == []
+
+
+def test_failed_eval_reaped_by_leader():
+    # an eval that exhausts its delivery limit must terminate as failed
+    # with a delayed follow-up, not hot-loop through workers
+    s = Server(num_workers=0, gc_interval=9999)
+    s.eval_broker.delivery_limit = 2
+    s.eval_broker.initial_nack_delay = 0.01
+    s.eval_broker.subsequent_nack_delay = 0.01
+    s.start()
+    try:
+        ev = Evaluation(type="service", job_id="bad-job")
+        s.eval_broker.enqueue(ev)
+        for _ in range(2):  # simulate a crashing scheduler: dequeue + nack
+            got, tok = s.eval_broker.dequeue(["service"], timeout=2)
+            assert got is not None
+            s.eval_broker.nack(got.id, tok)
+        # now dead-lettered; the leader loop reaps it
+        assert wait_until(lambda: (
+            (stored := s.state.eval_by_id(ev.id)) is not None and
+            stored.status == "failed"), timeout=10)
+        follow = [e for e in s.state.iter_evals()
+                  if e.previous_eval == ev.id]
+        assert follow and follow[0].triggered_by == "failed-follow-up"
+    finally:
+        s.shutdown()
