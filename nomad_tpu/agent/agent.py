@@ -1,0 +1,95 @@
+"""Agent: embeds a Server and/or Client in one process (ref
+command/agent/agent.go:115 NewAgent, -dev mode presets) and serves the
+HTTP API."""
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+from typing import Optional
+
+from ..client import Client
+from ..server import Server
+from .http import HTTPAPI, make_http_server
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    """ref command/agent/config.go (subset)"""
+    data_dir: str = ""
+    bind_addr: str = "127.0.0.1"
+    http_port: int = 4646
+    server_enabled: bool = True
+    client_enabled: bool = True
+    num_workers: int = 2
+    datacenter: str = "dc1"
+    node_class: str = ""
+    node_name: str = ""
+    dev_mode: bool = False
+
+
+class Agent:
+    def __init__(self, config: Optional[AgentConfig] = None, logger=None):
+        self.config = config or AgentConfig(dev_mode=True)
+        if not self.config.data_dir:
+            self.config.data_dir = tempfile.mkdtemp(prefix="nomad_tpu_")
+        self.logger = logger or (lambda msg: None)
+        self.server: Optional[Server] = None
+        self.client: Optional[Client] = None
+        self.http = None
+        self._http_thread: Optional[threading.Thread] = None
+
+        if self.config.server_enabled:
+            self.server = Server(num_workers=self.config.num_workers,
+                                 logger=self.logger)
+        if self.config.client_enabled:
+            if self.server is None:
+                raise ValueError("client-only agents need a server address "
+                                 "(remote RPC arrives with the network layer)")
+            self.client = Client(
+                self.server,
+                data_dir=os.path.join(self.config.data_dir, "client"),
+                datacenter=self.config.datacenter,
+                node_class=self.config.node_class,
+                name=self.config.node_name,
+                logger=self.logger)
+        self.api = HTTPAPI(self)
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+        if self.client is not None:
+            self.client.start()
+        self.http = make_http_server(self.api, self.config.bind_addr,
+                                     self.config.http_port)
+        # pick up the OS-assigned port when asked for :0
+        self.config.http_port = self.http.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self.http.serve_forever, daemon=True, name="http")
+        self._http_thread.start()
+
+    def shutdown(self) -> None:
+        if self.http is not None:
+            self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+
+    @property
+    def http_addr(self) -> str:
+        return f"http://{self.config.bind_addr}:{self.config.http_port}"
+
+    def stats(self) -> dict:
+        out = {}
+        if self.server is not None:
+            out["broker"] = dict(self.server.eval_broker.stats)
+            out["blocked_evals"] = dict(self.server.blocked_evals.stats)
+            out["state_index"] = self.server.state.latest_index()
+            out["nodes"] = len(self.server.state.nodes)
+            out["jobs"] = len(self.server.state.jobs)
+            out["allocs"] = len(self.server.state.allocs)
+        if self.client is not None:
+            out["client_allocs"] = self.client.num_allocs()
+        return out

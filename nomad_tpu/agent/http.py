@@ -1,0 +1,354 @@
+"""HTTP API (ref command/agent/http.go:274-420 registerHandlers): the /v1/*
+REST surface over the server RPC methods, with blocking-query support
+(?index=N&wait=Ss) and namespace scoping (?namespace=)."""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..api_codec import from_api, to_api
+from ..structs import (
+    DrainStrategy, Job, SchedulerConfiguration,
+)
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class HTTPAPI:
+    """Route table + handlers; transport-agnostic (used by the HTTP server
+    and directly by tests)."""
+
+    def __init__(self, agent):
+        self.agent = agent
+        self.server = agent.server
+
+    # ------------------------------------------------------------ dispatch
+
+    def handle(self, method: str, path: str, query: dict, body: Optional[dict]):
+        s = self.server
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise HTTPError(404, "not found")
+        parts = parts[1:]
+        ns = query.get("namespace", "default")
+        body = body or {}   # body-less PUT/POST is an empty request
+
+        def blocking(index_fn, payload_fn):
+            min_index = int(query.get("index", 0) or 0)
+            wait = min(float(query.get("wait", "0").rstrip("s") or 0), 30.0)
+            if min_index and wait:
+                deadline = time.time() + wait
+                while index_fn() <= min_index and time.time() < deadline:
+                    s.state.block_min_index(
+                        min_index, timeout=max(0.05, deadline - time.time()))
+            return payload_fn(), index_fn()
+
+        # ---- jobs
+        if parts == ["jobs"]:
+            if method == "GET":
+                prefix = query.get("prefix", "")
+                payload, index = blocking(
+                    lambda: s.state.table_index("jobs"),
+                    lambda: [self._job_stub(j) for j in s.state.iter_jobs(ns)
+                             if j.id.startswith(prefix)])
+                return payload, index
+            if method in ("PUT", "POST"):
+                job = from_api(Job, body.get("Job", body))
+                if not job.namespace:
+                    job.namespace = ns
+                try:
+                    return s.job_register(job), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+        if parts and parts[0] == "job":
+            if len(parts) < 2:
+                raise HTTPError(404, "missing job id")
+            job_id = urllib.parse.unquote(parts[1])
+            rest = parts[2:]
+            if not rest:
+                if method == "GET":
+                    job = s.state.job_by_id(ns, job_id)
+                    if job is None:
+                        raise HTTPError(404, f"job {job_id!r} not found")
+                    return to_api(job), s.state.table_index("jobs")
+                if method in ("PUT", "POST"):
+                    job = from_api(Job, body.get("Job", body))
+                    job.id = job_id
+                    if not job.namespace:
+                        job.namespace = ns
+                    try:
+                        return s.job_register(job), None
+                    except ValueError as e:
+                        raise HTTPError(400, str(e))
+                if method == "DELETE":
+                    purge = query.get("purge", "") in ("1", "true")
+                    return s.job_deregister(ns, job_id, purge), None
+            elif rest == ["evaluations"]:
+                return [to_api(e) for e in s.state.evals_by_job(ns, job_id)], \
+                    s.state.table_index("evals")
+            elif rest == ["allocations"]:
+                return [self._alloc_stub(a)
+                        for a in s.state.allocs_by_job(ns, job_id)], \
+                    s.state.table_index("allocs")
+            elif rest == ["deployments"]:
+                return [to_api(d)
+                        for d in s.state.deployments_by_job(ns, job_id)], \
+                    s.state.table_index("deployment")
+            elif rest == ["deployment"]:
+                d = s.state.latest_deployment_by_job(ns, job_id)
+                return (to_api(d) if d else None), \
+                    s.state.table_index("deployment")
+            elif rest == ["summary"]:
+                summ = s.state.job_summary(ns, job_id)
+                if summ is None:
+                    raise HTTPError(404, f"job {job_id!r} not found")
+                return to_api(summ), s.state.table_index("jobs")
+            elif rest == ["versions"]:
+                return [to_api(j)
+                        for j in s.state.job_versions_by_id(ns, job_id)], \
+                    s.state.table_index("jobs")
+            elif rest == ["dispatch"] and method in ("PUT", "POST"):
+                import base64
+                payload = base64.b64decode(body.get("Payload", "") or "")
+                meta = body.get("Meta", {}) or {}
+                try:
+                    return s.job_dispatch(ns, job_id, payload, meta), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+            elif rest == ["periodic", "force"] and method in ("PUT", "POST"):
+                job = s.state.job_by_id(ns, job_id)
+                if job is None or not job.is_periodic():
+                    raise HTTPError(400, f"job {job_id!r} is not periodic")
+                child = s.periodic.force_launch(job)
+                return {"dispatched_job_id": child.id}, None
+
+        # ---- evaluations
+        if parts == ["evaluations"]:
+            return [to_api(e) for e in s.state.iter_evals()], \
+                s.state.table_index("evals")
+        if parts and parts[0] == "evaluation" and len(parts) >= 2:
+            ev = s.state.eval_by_id(parts[1])
+            if ev is None:
+                raise HTTPError(404, "eval not found")
+            if parts[2:] == ["allocations"]:
+                return [self._alloc_stub(a)
+                        for a in s.state.allocs_by_eval(parts[1])], None
+            return to_api(ev), s.state.table_index("evals")
+
+        # ---- allocations
+        if parts == ["allocations"]:
+            payload, index = blocking(
+                lambda: s.state.table_index("allocs"),
+                lambda: [self._alloc_stub(a) for a in s.state.iter_allocs()])
+            return payload, index
+        if parts and parts[0] == "allocation" and len(parts) >= 2:
+            alloc = s.state.alloc_by_id(parts[1])
+            if alloc is None:
+                raise HTTPError(404, "alloc not found")
+            if parts[2:] == ["stop"] and method in ("PUT", "POST"):
+                return s.alloc_stop(parts[1]), None
+            return to_api(alloc), s.state.table_index("allocs")
+
+        # ---- nodes
+        if parts == ["nodes"]:
+            payload, index = blocking(
+                lambda: s.state.table_index("nodes"),
+                lambda: [self._node_stub(n) for n in s.state.iter_nodes()])
+            return payload, index
+        if parts and parts[0] == "node" and len(parts) >= 2:
+            node_id = parts[1]
+            node = s.state.node_by_id(node_id)
+            if node is None:
+                raise HTTPError(404, "node not found")
+            rest = parts[2:]
+            if not rest:
+                return to_api(node), s.state.table_index("nodes")
+            if rest == ["allocations"]:
+                return [self._alloc_stub(a)
+                        for a in s.state.allocs_by_node(node_id)], None
+            if rest == ["drain"] and method in ("PUT", "POST"):
+                spec = body.get("DrainSpec") if body else None
+                drain = None
+                if spec is not None:
+                    drain = DrainStrategy(
+                        deadline_sec=float(spec.get("Deadline", 0)) / 1e9
+                        if spec.get("Deadline", 0) > 1e6
+                        else float(spec.get("Deadline", 0)),
+                        ignore_system_jobs=spec.get("IgnoreSystemJobs", False))
+                mark = bool(body.get("MarkEligible")) if body else False
+                return s.node_update_drain(node_id, drain, mark), None
+            if rest == ["eligibility"] and method in ("PUT", "POST"):
+                elig = body.get("Eligibility", "eligible")
+                return s.node_update_eligibility(node_id, elig), None
+
+        # ---- deployments
+        if parts == ["deployments"]:
+            return [to_api(d) for d in s.deployment_list(ns)], \
+                s.state.table_index("deployment")
+        if parts and parts[0] == "deployment" and len(parts) >= 2:
+            if parts[1] == "promote" and method in ("PUT", "POST"):
+                try:
+                    return s.deployment_promote(
+                        parts[2] if len(parts) > 2 else body.get("DeploymentID"),
+                        body.get("Groups")), None
+                except (KeyError, ValueError) as e:
+                    raise HTTPError(400, str(e))
+            if parts[1] == "fail" and len(parts) > 2 and \
+               method in ("PUT", "POST"):
+                return s.deployment_fail(parts[2]), None
+            if parts[1] == "pause" and len(parts) > 2 and \
+               method in ("PUT", "POST"):
+                return s.deployment_pause(
+                    parts[2], bool(body.get("Pause", True))), None
+            d = s.state.deployment_by_id(parts[1])
+            if d is None:
+                raise HTTPError(404, "deployment not found")
+            if parts[2:] == ["allocations"]:
+                allocs = [a for a in s.state.iter_allocs()
+                          if a.deployment_id == parts[1]]
+                return [self._alloc_stub(a) for a in allocs], None
+            return to_api(d), s.state.table_index("deployment")
+
+        # ---- operator
+        if parts == ["operator", "scheduler", "configuration"]:
+            if method == "GET":
+                return {"SchedulerConfig":
+                        to_api(s.get_scheduler_configuration())}, None
+            if method in ("PUT", "POST"):
+                cfg = from_api(SchedulerConfiguration, body)
+                try:
+                    return s.set_scheduler_configuration(cfg), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+
+        # ---- misc
+        if parts == ["status", "leader"]:
+            return "127.0.0.1:4647" if s.is_leader else "", None
+        if parts == ["agent", "self"]:
+            return {"config": {"Server": {"Enabled": True},
+                               "Client": {"Enabled": self.agent.client is not None},
+                               "Version": self._version()},
+                    "stats": self.agent.stats()}, None
+        if parts == ["agent", "members"]:
+            return {"Members": [{"Name": "server-1", "Status": "alive",
+                                 "Tags": {"role": "nomad_tpu"}}]}, None
+        if parts == ["system", "gc"] and method in ("PUT", "POST"):
+            s.run_gc()
+            return {}, None
+        if parts == ["metrics"]:
+            return self.agent.stats(), None
+
+        raise HTTPError(404, f"no handler for {method} {path}")
+
+    def _version(self) -> str:
+        from .. import __version__
+        return __version__
+
+    # ------------------------------------------------------------- stubs
+
+    def _job_stub(self, j) -> dict:
+        summ = self.server.state.job_summary(j.namespace, j.id)
+        return {
+            "ID": j.id, "Name": j.name, "Namespace": j.namespace,
+            "Type": j.type, "Priority": j.priority, "Status": j.status,
+            "StatusDescription": j.status_description, "Stop": j.stop,
+            "JobSummary": to_api(summ) if summ else None,
+            "Version": j.version, "SubmitTime": j.submit_time,
+            "CreateIndex": j.create_index, "ModifyIndex": j.modify_index,
+        }
+
+    def _alloc_stub(self, a) -> dict:
+        return {
+            "ID": a.id, "Name": a.name, "Namespace": a.namespace,
+            "EvalID": a.eval_id, "NodeID": a.node_id, "NodeName": a.node_name,
+            "JobID": a.job_id, "JobVersion": a.job.version if a.job else 0,
+            "TaskGroup": a.task_group,
+            "DesiredStatus": a.desired_status,
+            "DesiredDescription": a.desired_description,
+            "ClientStatus": a.client_status,
+            "DeploymentID": a.deployment_id,
+            "FollowupEvalID": a.follow_up_eval_id,
+            "TaskStates": to_api(a.task_states),
+            "CreateIndex": a.create_index, "ModifyIndex": a.modify_index,
+            "CreateTime": a.create_time_unix, "ModifyTime": a.modify_time_unix,
+        }
+
+    def _node_stub(self, n) -> dict:
+        return {
+            "ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
+            "NodeClass": n.node_class, "Status": n.status,
+            "SchedulingEligibility": n.scheduling_eligibility,
+            "Drain": n.drain, "Drivers": to_api(n.drivers),
+            "Address": n.http_addr,
+            "CreateIndex": n.create_index, "ModifyIndex": n.modify_index,
+        }
+
+
+def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
+                     port: int = 4646) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):   # quiet
+            pass
+
+        def _do(self, method: str) -> None:
+            parsed = urllib.parse.urlparse(self.path)
+            query = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(parsed.query).items()}
+            body = None
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if length:
+                raw = self.rfile.read(length)
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    self._respond(400, {"error": "invalid JSON body"})
+                    return
+            try:
+                payload, index = api.handle(method, parsed.path, query, body)
+            except HTTPError as e:
+                self._respond(e.code, {"error": e.message})
+                return
+            except (KeyError,) as e:
+                self._respond(404, {"error": str(e)})
+                return
+            except Exception as e:      # noqa: BLE001
+                self._respond(500, {"error": repr(e)})
+                return
+            headers = {}
+            if index is not None:
+                headers["X-Nomad-Index"] = str(index)
+            self._respond(200, payload, headers)
+
+        def _respond(self, code: int, payload, headers=None) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._do("GET")
+
+        def do_PUT(self):
+            self._do("PUT")
+
+        def do_POST(self):
+            self._do("POST")
+
+        def do_DELETE(self):
+            self._do("DELETE")
+
+    return ThreadingHTTPServer((host, port), Handler)

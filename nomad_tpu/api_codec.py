@@ -1,0 +1,91 @@
+"""Dataclass <-> API JSON codec (ref api/ SDK types + command/agent JSON
+encoding): snake_case Python fields map to the reference API's PascalCase
+names (ID, TaskGroups, MemoryMB, ...) so clients of the reference find the
+shapes they expect.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Optional, get_args, get_origin, get_type_hints
+
+_ACRONYMS = {
+    "id": "ID", "cpu": "CPU", "mb": "MB", "ttl": "TTL", "dc": "DC",
+    "dcs": "DCs", "ip": "IP", "dns": "DNS", "url": "URL", "acl": "ACL",
+    "csi": "CSI", "cidr": "CIDR", "tg": "TG", "gc": "GC", "os": "OS",
+    "http": "HTTP", "api": "API",
+}
+
+
+def pascal(name: str) -> str:
+    parts = name.split("_")
+    out = []
+    for p in parts:
+        out.append(_ACRONYMS.get(p, p.capitalize()))
+    return "".join(out)
+
+
+def to_api(obj: Any) -> Any:
+    """Recursively encode dataclasses to API-shaped dicts."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            val = getattr(obj, f.name)
+            out[pascal(f.name)] = to_api(val)
+        return out
+    if isinstance(obj, dict):
+        return {k: to_api(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_api(v) for v in obj]
+    if isinstance(obj, bytes):
+        import base64
+        return base64.b64encode(obj).decode()
+    return obj
+
+
+def _strip_optional(tp):
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_api(cls, data: Any) -> Any:
+    """Recursively decode API-shaped dicts into dataclass `cls`.
+
+    Accepts both PascalCase and snake_case keys; unknown keys are ignored
+    (forward compatibility, like the reference's codec)."""
+    cls = _strip_optional(cls)
+    if data is None:
+        return None
+    origin = get_origin(cls)
+    if origin in (list, tuple):
+        (item_t,) = get_args(cls)[:1] or (Any,)
+        seq = [from_api(item_t, v) for v in data]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = get_args(cls)
+        val_t = args[1] if len(args) == 2 else Any
+        return {k: from_api(val_t, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(cls):
+        if not isinstance(data, dict):
+            return data
+        hints = get_type_hints(cls)
+        lookup = {}
+        for f in dataclasses.fields(cls):
+            lookup[pascal(f.name)] = f
+            lookup[f.name] = f
+        kwargs = {}
+        for key, val in data.items():
+            f = lookup.get(key)
+            if f is None:
+                continue
+            kwargs[f.name] = from_api(hints.get(f.name, Any), val)
+        return cls(**kwargs)
+    if cls is bytes and isinstance(data, str):
+        import base64
+        return base64.b64decode(data)
+    if cls in (int, float) and isinstance(data, (int, float)):
+        return cls(data)
+    return data

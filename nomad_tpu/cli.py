@@ -1,0 +1,381 @@
+"""CLI (ref command/ — the `nomad <cmd>` surface over the HTTP API).
+
+Usage:
+  python -m nomad_tpu.cli agent -dev [-port N]
+  python -m nomad_tpu.cli job run <spec.json>
+  python -m nomad_tpu.cli job status [job_id]
+  python -m nomad_tpu.cli job stop [-purge] <job_id>
+  python -m nomad_tpu.cli job dispatch <job_id> [-meta k=v ...]
+  python -m nomad_tpu.cli node status [node_id]
+  python -m nomad_tpu.cli node drain -enable <node_id>
+  python -m nomad_tpu.cli node eligibility -enable|-disable <node_id>
+  python -m nomad_tpu.cli alloc status <alloc_id>
+  python -m nomad_tpu.cli eval status <eval_id>
+  python -m nomad_tpu.cli deployment list|status|promote <...>
+  python -m nomad_tpu.cli operator scheduler get-config
+  python -m nomad_tpu.cli operator scheduler set-config -scheduler-algorithm <alg>
+  python -m nomad_tpu.cli system gc
+  python -m nomad_tpu.cli server members
+  python -m nomad_tpu.cli status
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _addr() -> str:
+    return os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+
+
+def api(method: str, path: str, body=None):
+    url = _addr() + path
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=35) as resp:
+            return json.loads(resp.read() or "null")
+    except urllib.error.HTTPError as e:
+        try:
+            err = json.loads(e.read()).get("error", str(e))
+        except Exception:   # noqa: BLE001
+            err = str(e)
+        print(f"Error: {err}", file=sys.stderr)
+        sys.exit(1)
+    except urllib.error.URLError as e:
+        print(f"Error connecting to {url}: {e.reason}", file=sys.stderr)
+        sys.exit(1)
+
+
+def _table(rows: list[list], headers: list[str]) -> None:
+    widths = [max(len(str(r[i])) for r in [headers] + rows)
+              for i in range(len(headers))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers))
+    for r in rows:
+        print(fmt.format(*[str(c) for c in r]))
+
+
+# ------------------------------------------------------------------ agent
+
+def cmd_agent(args) -> None:
+    from .agent import Agent, AgentConfig
+    cfg = AgentConfig(dev_mode=args.dev, http_port=args.port,
+                      data_dir=args.data_dir or "",
+                      num_workers=args.workers)
+    agent = Agent(cfg, logger=lambda m: print(f"    {m}", flush=True))
+    agent.start()
+    mode = []
+    if agent.server:
+        mode.append("server")
+    if agent.client:
+        mode.append("client")
+    print("==> nomad_tpu agent started! Log data will stream below:")
+    print(f"    Mode: {' + '.join(mode)}{' (dev)' if args.dev else ''}")
+    print(f"    HTTP: {agent.http_addr}")
+    if agent.client:
+        print(f"    Node: {agent.client.node.name} ({agent.client.node.id[:8]})")
+    stop = False
+
+    def on_sig(*_):
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGINT, on_sig)
+    signal.signal(signal.SIGTERM, on_sig)
+    while not stop:
+        time.sleep(0.2)
+    print("==> caught signal, shutting down")
+    agent.shutdown()
+
+
+# ------------------------------------------------------------------- jobs
+
+def cmd_job_run(args) -> None:
+    with open(args.spec) as f:
+        spec = json.load(f)
+    resp = api("PUT", "/v1/jobs", spec)
+    print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
+    if args.detach:
+        return
+    eval_id = resp.get("eval_id")
+    if not eval_id:
+        return
+    for _ in range(100):
+        ev = api("GET", f"/v1/evaluation/{eval_id}")
+        if ev["Status"] in ("complete", "failed", "canceled"):
+            print(f"==> Evaluation status: {ev['Status']}")
+            if ev.get("FailedTgAllocs"):
+                for tg, m in ev["FailedTgAllocs"].items():
+                    print(f"    group {tg!r}: placement failed "
+                          f"(filtered {m.get('NodesFiltered', 0)}, "
+                          f"exhausted {m.get('NodesExhausted', 0)})")
+            blocked = ev.get("BlockedEval")
+            if blocked:
+                print(f"    blocked eval {blocked[:8]} waiting for capacity")
+            return
+        time.sleep(0.2)
+
+
+def cmd_job_status(args) -> None:
+    if not args.job_id:
+        jobs = api("GET", "/v1/jobs")
+        if not jobs:
+            print("No running jobs")
+            return
+        _table([[j["ID"], j["Type"], j["Priority"], j["Status"]]
+                for j in jobs], ["ID", "Type", "Priority", "Status"])
+        return
+    job = api("GET", f"/v1/job/{args.job_id}")
+    print(f"ID            = {job['ID']}")
+    print(f"Name          = {job['Name']}")
+    print(f"Type          = {job['Type']}")
+    print(f"Priority      = {job['Priority']}")
+    print(f"Status        = {job['Status']}")
+    print(f"Version       = {job['Version']}")
+    allocs = api("GET", f"/v1/job/{args.job_id}/allocations")
+    if allocs:
+        print("\nAllocations")
+        _table([[a["ID"][:8], a["NodeName"] or a["NodeID"][:8], a["TaskGroup"],
+                 a["JobVersion"], a["DesiredStatus"], a["ClientStatus"]]
+                for a in allocs],
+               ["ID", "Node", "Group", "Version", "Desired", "Status"])
+
+
+def cmd_job_stop(args) -> None:
+    path = f"/v1/job/{args.job_id}"
+    if args.purge:
+        path += "?purge=true"
+    resp = api("DELETE", path)
+    print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
+
+
+def cmd_job_dispatch(args) -> None:
+    meta = dict(kv.split("=", 1) for kv in (args.meta or []))
+    resp = api("PUT", f"/v1/job/{args.job_id}/dispatch", {"Meta": meta})
+    print(f"==> Dispatched job {resp['dispatched_job_id']}")
+
+
+# ------------------------------------------------------------------ nodes
+
+def cmd_node_status(args) -> None:
+    if not args.node_id:
+        nodes = api("GET", "/v1/nodes")
+        _table([[n["ID"][:8], n["Name"], n["Datacenter"], n["Status"],
+                 n["SchedulingEligibility"], "true" if n["Drain"] else "false"]
+                for n in nodes],
+               ["ID", "Name", "DC", "Status", "Eligibility", "Drain"])
+        return
+    node = api("GET", f"/v1/node/{args.node_id}")
+    print(f"ID          = {node['ID']}")
+    print(f"Name        = {node['Name']}")
+    print(f"Status      = {node['Status']}")
+    print(f"Eligibility = {node['SchedulingEligibility']}")
+    allocs = api("GET", f"/v1/node/{args.node_id}/allocations")
+    if allocs:
+        print("\nAllocations")
+        _table([[a["ID"][:8], a["JobID"], a["TaskGroup"], a["DesiredStatus"],
+                 a["ClientStatus"]] for a in allocs],
+               ["ID", "Job", "Group", "Desired", "Status"])
+
+
+def cmd_node_drain(args) -> None:
+    body = {}
+    if args.enable:
+        body["DrainSpec"] = {"Deadline": args.deadline,
+                             "IgnoreSystemJobs": args.ignore_system}
+    else:
+        body["DrainSpec"] = None
+        body["MarkEligible"] = True
+    api("PUT", f"/v1/node/{args.node_id}/drain", body)
+    print(f"==> Node {args.node_id[:8]} drain "
+          f"{'enabled' if args.enable else 'disabled'}")
+
+
+def cmd_node_eligibility(args) -> None:
+    elig = "eligible" if args.enable else "ineligible"
+    api("PUT", f"/v1/node/{args.node_id}/eligibility", {"Eligibility": elig})
+    print(f"==> Node {args.node_id[:8]} marked {elig}")
+
+
+# ------------------------------------------------------------------ other
+
+def cmd_alloc_status(args) -> None:
+    a = api("GET", f"/v1/allocation/{args.alloc_id}")
+    print(f"ID            = {a['ID']}")
+    print(f"Name          = {a['Name']}")
+    print(f"Node          = {a['NodeName'] or a['NodeID'][:8]}")
+    print(f"Job           = {a['JobID']}")
+    print(f"Desired       = {a['DesiredStatus']}")
+    print(f"Status        = {a['ClientStatus']}")
+    for task, st in (a.get("TaskStates") or {}).items():
+        print(f"\nTask {task!r} is {st['State']}"
+              f"{' (failed)' if st['Failed'] else ''}")
+        for ev in st.get("Events", [])[-5:]:
+            print(f"  {ev['Type']}: {ev['Message']}")
+
+
+def cmd_eval_status(args) -> None:
+    ev = api("GET", f"/v1/evaluation/{args.eval_id}")
+    for k in ("ID", "Type", "TriggeredBy", "JobID", "Status",
+              "StatusDescription"):
+        print(f"{k:<18}= {ev.get(k)}")
+
+
+def cmd_deployment(args) -> None:
+    if args.action == "list":
+        ds = api("GET", "/v1/deployments")
+        _table([[d["ID"][:8], d["JobID"], d["JobVersion"], d["Status"],
+                 d["StatusDescription"]] for d in ds],
+               ["ID", "Job", "Version", "Status", "Description"])
+    elif args.action == "status":
+        d = api("GET", f"/v1/deployment/{args.id}")
+        print(json.dumps(d, indent=2))
+    elif args.action == "promote":
+        api("PUT", f"/v1/deployment/promote/{args.id}", {})
+        print("==> Deployment promoted")
+    elif args.action == "fail":
+        api("PUT", f"/v1/deployment/fail/{args.id}", {})
+        print("==> Deployment marked failed")
+
+
+def cmd_operator_scheduler(args) -> None:
+    if args.action == "get-config":
+        cfg = api("GET", "/v1/operator/scheduler/configuration")
+        print(json.dumps(cfg, indent=2))
+    else:
+        cfg = api("GET", "/v1/operator/scheduler/configuration")[
+            "SchedulerConfig"]
+        if args.scheduler_algorithm:
+            cfg["SchedulerAlgorithm"] = args.scheduler_algorithm
+        if args.memory_oversubscription is not None:
+            cfg["MemoryOversubscriptionEnabled"] = \
+                args.memory_oversubscription == "true"
+        api("PUT", "/v1/operator/scheduler/configuration", cfg)
+        print("==> Scheduler configuration updated")
+
+
+def cmd_system_gc(args) -> None:
+    api("PUT", "/v1/system/gc", {})
+    print("==> GC triggered")
+
+
+def cmd_server_members(args) -> None:
+    m = api("GET", "/v1/agent/members")
+    _table([[x["Name"], x["Status"]] for x in m["Members"]],
+           ["Name", "Status"])
+
+
+def cmd_status(args) -> None:
+    me = api("GET", "/v1/agent/self")
+    print(json.dumps(me.get("stats", {}), indent=2))
+
+
+# ------------------------------------------------------------------ main
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent")
+    ag.add_argument("-dev", action="store_true")
+    ag.add_argument("-port", type=int, default=4646)
+    ag.add_argument("-data-dir", dest="data_dir", default="")
+    ag.add_argument("-workers", type=int, default=2)
+    ag.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job")
+    jsub = job.add_subparsers(dest="job_cmd", required=True)
+    jr = jsub.add_parser("run")
+    jr.add_argument("spec")
+    jr.add_argument("-detach", action="store_true")
+    jr.set_defaults(fn=cmd_job_run)
+    js = jsub.add_parser("status")
+    js.add_argument("job_id", nargs="?", default="")
+    js.set_defaults(fn=cmd_job_status)
+    jst = jsub.add_parser("stop")
+    jst.add_argument("job_id")
+    jst.add_argument("-purge", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+    jd = jsub.add_parser("dispatch")
+    jd.add_argument("job_id")
+    jd.add_argument("-meta", action="append")
+    jd.set_defaults(fn=cmd_job_dispatch)
+
+    node = sub.add_parser("node")
+    nsub = node.add_subparsers(dest="node_cmd", required=True)
+    ns = nsub.add_parser("status")
+    ns.add_argument("node_id", nargs="?", default="")
+    ns.set_defaults(fn=cmd_node_status)
+    nd = nsub.add_parser("drain")
+    nd.add_argument("node_id")
+    nd.add_argument("-enable", action="store_true")
+    nd.add_argument("-disable", dest="enable", action="store_false")
+    nd.add_argument("-deadline", type=float, default=3600.0)
+    nd.add_argument("-ignore-system", dest="ignore_system",
+                    action="store_true")
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = nsub.add_parser("eligibility")
+    ne.add_argument("node_id")
+    ne.add_argument("-enable", action="store_true")
+    ne.add_argument("-disable", dest="enable", action="store_false")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    alloc = sub.add_parser("alloc")
+    asub = alloc.add_subparsers(dest="alloc_cmd", required=True)
+    ast = asub.add_parser("status")
+    ast.add_argument("alloc_id")
+    ast.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval")
+    esub = ev.add_subparsers(dest="eval_cmd", required=True)
+    est = esub.add_parser("status")
+    est.add_argument("eval_id")
+    est.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment")
+    dep.add_argument("action",
+                     choices=["list", "status", "promote", "fail"])
+    dep.add_argument("id", nargs="?", default="")
+    dep.set_defaults(fn=cmd_deployment)
+
+    op = sub.add_parser("operator")
+    osub = op.add_subparsers(dest="op_cmd", required=True)
+    osch = osub.add_parser("scheduler")
+    osch.add_argument("action", choices=["get-config", "set-config"])
+    osch.add_argument("-scheduler-algorithm", dest="scheduler_algorithm",
+                      default="")
+    osch.add_argument("-memory-oversubscription",
+                      dest="memory_oversubscription",
+                      choices=["true", "false"], default=None)
+    osch.set_defaults(fn=cmd_operator_scheduler)
+
+    system = sub.add_parser("system")
+    ssub = system.add_subparsers(dest="sys_cmd", required=True)
+    sgc = ssub.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
+
+    srv = sub.add_parser("server")
+    srvsub = srv.add_subparsers(dest="srv_cmd", required=True)
+    sm = srvsub.add_parser("members")
+    sm.set_defaults(fn=cmd_server_members)
+
+    st = sub.add_parser("status")
+    st.set_defaults(fn=cmd_status)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -193,9 +193,20 @@ class Client:
         while not self._shutdown.is_set():
             with self._dirty_cond:
                 if not self._dirty_allocs:
-                    self._dirty_cond.wait(1.0)
+                    self._dirty_cond.wait(0.5)
                 dirty = list(self._dirty_allocs)
                 self._dirty_allocs.clear()
+            # deployment health is time-based (min_healthy_time elapses with
+            # no task-state change), so allocs with an undecided verdict are
+            # re-evaluated every pass (ref allocrunner health_hook's timer)
+            with self._lock:
+                for alloc_id, ar in self.alloc_runners.items():
+                    if alloc_id in dirty:
+                        continue
+                    if ar.alloc.deployment_id and (
+                            ar.alloc.deployment_status is None or
+                            ar.alloc.deployment_status.healthy is None):
+                        dirty.append(alloc_id)
             if not dirty:
                 continue
             updates = []

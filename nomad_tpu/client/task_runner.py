@@ -88,7 +88,10 @@ class TaskRunner:
                 return
             failed = result is None or not result.successful()
             code = result.exit_code if result else -1
-            self._emit(EVENT_TERMINATED, f"exit code: {code}")
+            # between exit and restart the task is pending, not running —
+            # deployment health must not count it as live
+            self._set_state(TASK_STATE_PENDING, EVENT_TERMINATED,
+                            f"exit code: {code}")
             if not self._should_restart(failed=failed,
                                         reason=f"exit {code}"):
                 self._finish(failed=failed)
@@ -177,8 +180,8 @@ class TaskRunner:
             self._finish(failed=False)
             return
         failed = result is None or not result.successful()
-        self._emit(EVENT_TERMINATED,
-                   f"exit code: {result.exit_code if result else -1}")
+        self._set_state(TASK_STATE_PENDING, EVENT_TERMINATED,
+                        f"exit code: {result.exit_code if result else -1}")
         if self._should_restart(failed=failed, reason="post-restore exit"):
             self.run()
             return
@@ -193,7 +196,7 @@ class TaskRunner:
 
     def _set_state(self, state: str, etype: str, message: str) -> None:
         self.state.state = state
-        if state == TASK_STATE_RUNNING and not self.state.started_at:
+        if state == TASK_STATE_RUNNING:
             self.state.started_at = time.time()
         self.state.events.append(TaskEvent(type=etype, time_unix=time.time(),
                                            message=message))

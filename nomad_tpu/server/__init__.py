@@ -9,4 +9,6 @@ from .worker import Worker  # noqa: F401
 from .heartbeat import HeartbeatTimers, create_node_evals  # noqa: F401
 from .periodic import PeriodicDispatch, cron_next  # noqa: F401
 from .core_sched import CoreScheduler  # noqa: F401
+from .deployment_watcher import DeploymentWatcher  # noqa: F401
+from .drainer import NodeDrainer  # noqa: F401
 from .server import Server  # noqa: F401

@@ -27,6 +27,8 @@ from ..structs import (
 )
 from .blocked_evals import BlockedEvals
 from .core_sched import CoreScheduler
+from .deployment_watcher import DeploymentWatcher
+from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
 from .fsm import (
     ALLOC_CLIENT_UPDATE, ALLOC_UPDATE_DESIRED_TRANSITION, EVAL_UPDATE,
@@ -58,6 +60,8 @@ class Server:
         self.periodic = PeriodicDispatch(self)
         self.heartbeats = HeartbeatTimers(self)
         self.core_scheduler = CoreScheduler(self)
+        self.deployment_watcher = DeploymentWatcher(self)
+        self.drainer = NodeDrainer(self)
         self.scheduler_types = SCHEDULER_TYPES
         self.workers = [Worker(self, i) for i in range(num_workers)]
         self.gc_interval = gc_interval
@@ -79,6 +83,8 @@ class Server:
         self._leader_stop.set()
         for w in self.workers:
             w.stop()
+        self.deployment_watcher.stop()
+        self.drainer.stop()
         self.planner.stop()
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
@@ -94,6 +100,8 @@ class Server:
         self.planner.start()
         self.periodic.set_enabled(True)
         self.heartbeats.start()
+        self.deployment_watcher.start()
+        self.drainer.start()
         self.is_leader = True
         # restore: re-enqueue non-terminal evals, re-track periodic jobs
         for ev in self.state.iter_evals():
@@ -316,8 +324,7 @@ class Server:
                 ev.triggered_by = TRIGGER_NODE_DRAIN
             if evals:
                 self.raft.apply(EVAL_UPDATE, {"evals": evals})
-            if hasattr(self, "drainer") and self.drainer is not None:
-                self.drainer.track_node(node_id)
+            self.drainer.track_node(node_id)
         return {"index": index, "eval_ids": [e.id for e in evals]}
 
     def node_update_eligibility(self, node_id: str, eligibility: str) -> dict:
@@ -416,6 +423,22 @@ class Server:
 
     def eval_nack(self, eval_id: str, token: str) -> None:
         self.eval_broker.nack(eval_id, token)
+
+    # ------------------------------------------------ Deployment endpoints
+
+    def deployment_list(self, namespace: Optional[str] = None) -> list:
+        return [d for d in self.state.iter_deployments()
+                if namespace is None or d.namespace == namespace]
+
+    def deployment_promote(self, deployment_id: str,
+                           groups: Optional[list] = None) -> dict:
+        return self.deployment_watcher.promote(deployment_id, groups)
+
+    def deployment_fail(self, deployment_id: str) -> dict:
+        return self.deployment_watcher.fail_deployment(deployment_id)
+
+    def deployment_pause(self, deployment_id: str, paused: bool) -> dict:
+        return self.deployment_watcher.pause(deployment_id, paused)
 
     # -------------------------------------------------- Operator endpoints
 

@@ -444,7 +444,33 @@ class StateStore:
                 alloc.task_states = dict(update.task_states)
                 alloc.network_status = update.network_status
                 if update.deployment_status is not None:
+                    # deployment health accounting rides the client update
+                    # (ref state_store.go nestedUpdateAllocFromClient ->
+                    #  updateDeploymentWithAlloc)
+                    was = (existing.deployment_status.healthy
+                           if existing.deployment_status else None)
+                    now_h = update.deployment_status.healthy
                     alloc.deployment_status = update.deployment_status
+                    if alloc.deployment_id and was != now_h and \
+                       now_h is not None:
+                        d = self.deployments.get(alloc.deployment_id)
+                        if d is not None and d.active():
+                            d = d.copy()
+                            st = d.task_groups.get(alloc.task_group)
+                            if st is not None:
+                                if was is None:
+                                    if now_h:
+                                        st.healthy_allocs += 1
+                                    else:
+                                        st.unhealthy_allocs += 1
+                                elif now_h:
+                                    st.healthy_allocs += 1
+                                    st.unhealthy_allocs -= 1
+                                else:
+                                    st.healthy_allocs -= 1
+                                    st.unhealthy_allocs += 1
+                            d.modify_index = idx
+                            self.deployments[d.id] = d
                 alloc.modify_index = idx
                 alloc.modify_time_unix = update.modify_time_unix or time.time()
                 self.allocs[alloc.id] = alloc
@@ -594,6 +620,20 @@ class StateStore:
         d.status_description = du.status_description
         d.modify_index = idx
         self.deployments[d.id] = d
+        # a successful deployment marks its job version stable — the anchor
+        # auto-revert rolls back to (ref deploymentwatcher SetJobStable)
+        if du.status == "successful":
+            vkey = (d.namespace, d.job_id, d.job_version)
+            job = self.job_versions.get(vkey)
+            if job is not None and not job.stable:
+                job = job.copy()
+                job.stable = True
+                self.job_versions[vkey] = job
+                current = self.jobs.get((d.namespace, d.job_id))
+                if current is not None and current.version == d.job_version:
+                    cur = current.copy()
+                    cur.stable = True
+                    self.jobs[(d.namespace, d.job_id)] = cur
         self._emit("Deployment", "DeploymentStatusUpdate", idx, d)
 
     def update_deployment_status(self, index: int, du,

@@ -1,0 +1,178 @@
+"""Agent + HTTP API end-to-end tests (modeled on command/agent HTTP
+endpoint tests): a -dev agent driven entirely through REST."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api_codec import from_api, to_api
+from nomad_tpu.structs import Job
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    assert wait_until(lambda: a.client.node.ready()
+                      if a.server.state.node_by_id(a.client.node.id) is None
+                      else a.server.state.node_by_id(a.client.node.id).ready())
+    yield a
+    a.shutdown()
+
+
+def call(agent, method, path, body=None):
+    url = agent.http_addr + path
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=35) as resp:
+        return json.loads(resp.read() or "null"), dict(resp.headers)
+
+
+def _spec(run_for=0.3, count=1, driver="mock_driver"):
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.driver = driver
+    task.config = {"run_for": run_for}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    return {"Job": to_api(job)}, job.id
+
+
+def test_api_codec_roundtrip():
+    job = mock.job()
+    encoded = to_api(job)
+    assert encoded["ID"] == job.id
+    assert encoded["TaskGroups"][0]["Tasks"][0]["Resources"]["CPU"] == 500
+    decoded = from_api(Job, encoded)
+    assert decoded == job
+
+
+def test_http_job_lifecycle(agent):
+    spec, job_id = _spec(run_for=0.2)
+    resp, _ = call(agent, "PUT", "/v1/jobs", spec)
+    assert resp["eval_id"]
+    # eval completes, alloc runs to completion
+    assert wait_until(lambda: call(
+        agent, "GET", f"/v1/evaluation/{resp['eval_id']}")[0]["Status"]
+        == "complete")
+    assert wait_until(lambda: any(
+        a["ClientStatus"] == "complete"
+        for a in call(agent, "GET", f"/v1/job/{job_id}/allocations")[0]))
+    job, headers = call(agent, "GET", f"/v1/job/{job_id}")
+    assert job["ID"] == job_id
+    assert "X-Nomad-Index" in headers
+    summary, _ = call(agent, "GET", f"/v1/job/{job_id}/summary")
+    assert summary["Summary"]["worker"]["Complete"] == 1
+    # list + prefix filter
+    jobs, _ = call(agent, "GET", f"/v1/jobs?prefix={job_id[:6]}")
+    assert [j["ID"] for j in jobs] == [job_id]
+    # stop with purge
+    call(agent, "DELETE", f"/v1/job/{job_id}?purge=true")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        call(agent, "GET", f"/v1/job/{job_id}")
+    assert exc.value.code == 404
+
+
+def test_http_nodes_and_allocs(agent):
+    nodes, _ = call(agent, "GET", "/v1/nodes")
+    assert len(nodes) == 1 and nodes[0]["Status"] == "ready"
+    node, _ = call(agent, "GET", f"/v1/node/{nodes[0]['ID']}")
+    assert node["Drivers"]["mock_driver"]["Healthy"]
+
+    spec, job_id = _spec(run_for=60)
+    resp, _ = call(agent, "PUT", "/v1/jobs", spec)
+    assert wait_until(lambda: any(
+        a["ClientStatus"] == "running"
+        for a in call(agent, "GET", f"/v1/job/{job_id}/allocations")[0]))
+    allocs, _ = call(agent, "GET", f"/v1/job/{job_id}/allocations")
+    alloc, _ = call(agent, "GET", f"/v1/allocation/{allocs[0]['ID']}")
+    assert alloc["TaskStates"]["worker"]["State"] == "running"
+    call(agent, "DELETE", f"/v1/job/{job_id}?purge=true")
+
+
+def test_http_scheduler_config(agent):
+    cfg, _ = call(agent, "GET", "/v1/operator/scheduler/configuration")
+    assert cfg["SchedulerConfig"]["SchedulerAlgorithm"] == "binpack"
+    cfg["SchedulerConfig"]["SchedulerAlgorithm"] = "spread"
+    call(agent, "PUT", "/v1/operator/scheduler/configuration",
+         cfg["SchedulerConfig"])
+    cfg2, _ = call(agent, "GET", "/v1/operator/scheduler/configuration")
+    assert cfg2["SchedulerConfig"]["SchedulerAlgorithm"] == "spread"
+    # invalid algorithm rejected
+    cfg2["SchedulerConfig"]["SchedulerAlgorithm"] = "bogus"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        call(agent, "PUT", "/v1/operator/scheduler/configuration",
+             cfg2["SchedulerConfig"])
+    assert exc.value.code == 400
+    cfg2["SchedulerConfig"]["SchedulerAlgorithm"] = "binpack"
+    call(agent, "PUT", "/v1/operator/scheduler/configuration",
+         cfg2["SchedulerConfig"])
+
+
+def test_http_blocking_query(agent):
+    jobs, headers = call(agent, "GET", "/v1/jobs")
+    index = int(headers["X-Nomad-Index"])
+    start = time.time()
+    # no change: blocks until the short wait expires
+    _, _ = call(agent, "GET", f"/v1/jobs?index={index}&wait=1s")
+    assert time.time() - start >= 0.9
+
+
+def test_http_agent_self_and_metrics(agent):
+    me, _ = call(agent, "GET", "/v1/agent/self")
+    assert me["config"]["Server"]["Enabled"] is True
+    stats, _ = call(agent, "GET", "/v1/metrics")
+    assert "state_index" in stats
+
+
+def test_http_404s(agent):
+    for path in ("/v1/job/nope", "/v1/allocation/nope", "/v1/node/nope",
+                 "/v1/evaluation/nope", "/nope"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            call(agent, "GET", path)
+        assert exc.value.code == 404
+
+
+def test_cli_against_agent(agent, capsys, tmp_path, monkeypatch):
+    from nomad_tpu import cli
+    monkeypatch.setenv("NOMAD_ADDR", agent.http_addr)
+    spec, job_id = _spec(run_for=0.2)
+    spec_file = tmp_path / "job.json"
+    spec_file.write_text(json.dumps(spec))
+    cli.main(["job", "run", str(spec_file)])
+    out = capsys.readouterr().out
+    assert "Evaluation" in out and "complete" in out
+    cli.main(["job", "status", job_id])
+    out = capsys.readouterr().out
+    assert job_id in out and "Allocations" in out
+    cli.main(["node", "status"])
+    out = capsys.readouterr().out
+    assert "ready" in out
+    cli.main(["operator", "scheduler", "set-config",
+              "-scheduler-algorithm", "tpu-batch"])
+    cli.main(["operator", "scheduler", "get-config"])
+    out = capsys.readouterr().out
+    assert "tpu-batch" in out
+    cli.main(["operator", "scheduler", "set-config",
+              "-scheduler-algorithm", "binpack"])
+    cli.main(["job", "stop", "-purge", job_id])
+    cli.main(["system", "gc"])
+    cli.main(["status"])
+    out = capsys.readouterr().out
+    assert "state_index" in out

