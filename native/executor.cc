@@ -1,0 +1,190 @@
+// nomad-executor: task supervisor subprocess (the C++ analog of the
+// reference's re-exec'd executor, ref drivers/shared/executor/executor.go:240
+// UniversalExecutor + executor_linux.go).
+//
+// The client driver launches one executor per task. The executor:
+//   * detaches into its own session (clean process-group kill semantics),
+//   * applies resource limits (RLIMIT_AS for memory, RLIMIT_NPROC, nice for
+//     cpu shares) before exec'ing the task,
+//   * redirects stdout/stderr to the task's log files,
+//   * supervises the child and writes {exit_code, signal} to a result file
+//     the driver polls — surviving driver/client restarts (reattach), and
+//   * forwards SIGTERM/SIGINT to the child's process group.
+//
+// Protocol: argv[1] is a spec file of simple `key=value` lines:
+//   command=/bin/sh        (required)
+//   arg=-c                 (repeated, in order)
+//   arg=echo hi
+//   env=KEY=VALUE          (repeated)
+//   cwd=/path
+//   stdout=/path/out.log
+//   stderr=/path/err.log
+//   memory_mb=256          (0 = unlimited)
+//   cpu_nice=5             (0-19)
+//   result=/path/result.json
+//   pidfile=/path/executor.pid
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <fstream>
+#include <string>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+static pid_t g_child = -1;
+
+static void forward_signal(int sig) {
+  if (g_child > 0) {
+    // negative pid: the whole process group
+    kill(-g_child, sig);
+  }
+}
+
+struct Spec {
+  std::string command;
+  std::vector<std::string> args;
+  std::vector<std::string> env;
+  std::string cwd;
+  std::string stdout_path;
+  std::string stderr_path;
+  std::string result_path;
+  std::string pid_path;
+  long memory_mb = 0;
+  int cpu_nice = 0;
+};
+
+static bool parse_spec(const char *path, Spec *spec) {
+  std::ifstream in(path);
+  if (!in.is_open()) return false;
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = line.substr(0, eq);
+    std::string val = line.substr(eq + 1);
+    if (key == "command") spec->command = val;
+    else if (key == "arg") spec->args.push_back(val);
+    else if (key == "env") spec->env.push_back(val);
+    else if (key == "cwd") spec->cwd = val;
+    else if (key == "stdout") spec->stdout_path = val;
+    else if (key == "stderr") spec->stderr_path = val;
+    else if (key == "result") spec->result_path = val;
+    else if (key == "pidfile") spec->pid_path = val;
+    else if (key == "memory_mb") spec->memory_mb = atol(val.c_str());
+    else if (key == "cpu_nice") spec->cpu_nice = atoi(val.c_str());
+  }
+  return !spec->command.empty();
+}
+
+static void write_result(const Spec &spec, int exit_code, int sig,
+                         const char *err) {
+  if (spec.result_path.empty()) return;
+  std::string tmp = spec.result_path + ".tmp";
+  std::ofstream out(tmp);
+  out << "{\"exit_code\": " << exit_code << ", \"signal\": " << sig
+      << ", \"err\": \"" << (err ? err : "") << "\"}\n";
+  out.close();
+  rename(tmp.c_str(), spec.result_path.c_str());
+}
+
+static int open_log(const std::string &path) {
+  if (path.empty()) return open("/dev/null", O_WRONLY);
+  return open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: nomad-executor <spec-file>\n");
+    return 2;
+  }
+  Spec spec;
+  if (!parse_spec(argv[1], &spec)) {
+    fprintf(stderr, "nomad-executor: bad spec %s\n", argv[1]);
+    return 2;
+  }
+
+  // our own session: the driver kills the executor's group as one unit
+  setsid();
+
+  g_child = fork();
+  if (g_child < 0) {
+    write_result(spec, -1, 0, "fork failed");
+    return 1;
+  }
+  if (g_child == 0) {
+    // child: new process group so the supervisor can signal the whole tree
+    setpgid(0, 0);
+    if (!spec.cwd.empty() && chdir(spec.cwd.c_str()) != 0) {
+      fprintf(stderr, "chdir(%s): %s\n", spec.cwd.c_str(), strerror(errno));
+      _exit(127);
+    }
+    int out_fd = open_log(spec.stdout_path);
+    int err_fd = open_log(spec.stderr_path);
+    if (out_fd >= 0) dup2(out_fd, STDOUT_FILENO);
+    if (err_fd >= 0) dup2(err_fd, STDERR_FILENO);
+
+    // resource isolation (ref executor_linux.go resource limits; cgroups
+    // arrive with the containerized driver)
+    if (spec.memory_mb > 0) {
+      struct rlimit rl;
+      rl.rlim_cur = rl.rlim_max =
+          static_cast<rlim_t>(spec.memory_mb) * 1024 * 1024;
+      setrlimit(RLIMIT_AS, &rl);
+    }
+    if (spec.cpu_nice > 0) {
+      if (nice(spec.cpu_nice) == -1 && errno != 0) { /* best effort */ }
+    }
+
+    std::vector<char *> cargs;
+    cargs.push_back(const_cast<char *>(spec.command.c_str()));
+    for (auto &a : spec.args) cargs.push_back(const_cast<char *>(a.c_str()));
+    cargs.push_back(nullptr);
+    std::vector<char *> cenv;
+    for (auto &e : spec.env) cenv.push_back(const_cast<char *>(e.c_str()));
+    cenv.push_back(nullptr);
+    execve(spec.command.c_str(), cargs.data(), cenv.data());
+    fprintf(stderr, "execve(%s): %s\n", spec.command.c_str(),
+            strerror(errno));
+    _exit(127);
+  }
+  setpgid(g_child, g_child);
+
+  // pidfile: "<executor_pid> <child_pid>" — the driver SIGKILLs the child's
+  // group directly if the executor itself is gone
+  if (!spec.pid_path.empty()) {
+    std::ofstream pf(spec.pid_path);
+    pf << getpid() << " " << g_child << "\n";
+  }
+
+  // forward every catchable termination-ish signal (a job may configure
+  // kill_signal=SIGUSR1/SIGHUP/...)
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = forward_signal;
+  int forwarded[] = {SIGTERM, SIGINT, SIGQUIT, SIGHUP, SIGUSR1, SIGUSR2};
+  for (int sig : forwarded) sigaction(sig, &sa, nullptr);
+
+  int status = 0;
+  while (true) {
+    pid_t got = waitpid(g_child, &status, 0);
+    if (got == g_child) break;
+    if (got < 0 && errno != EINTR) {
+      write_result(spec, -1, 0, "waitpid failed");
+      return 1;
+    }
+  }
+  int exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 0;
+  int sig = WIFSIGNALED(status) ? WTERMSIG(status) : 0;
+  // reap any stragglers in the task's group
+  kill(-g_child, SIGKILL);
+  write_result(spec, exit_code, sig, nullptr);
+  return 0;
+}

@@ -243,9 +243,13 @@ class _ReattachedProcess:
             time.sleep(0.05)
 
 
+def _exec_driver():
+    from .exec_driver import ExecDriver
+    return ExecDriver()
+
+
 BUILTIN_DRIVERS = {
     "mock_driver": MockDriver,
     "raw_exec": RawExecDriver,
-    "exec": RawExecDriver,      # isolation-free placeholder until the
-                                # C++ executor sidecar lands
+    "exec": _exec_driver,       # native C++ executor supervisor
 }

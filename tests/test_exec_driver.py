@@ -1,0 +1,174 @@
+"""Native C++ executor driver tests: build, run, limits, signals,
+reattach-through-result-file."""
+import os
+import signal
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.exec_driver import ExecDriver, ensure_executor_binary
+from nomad_tpu.client.driver import TaskHandle
+from nomad_tpu.structs import Resources, Task
+
+
+@pytest.fixture(scope="module")
+def driver():
+    if ensure_executor_binary() is None:
+        pytest.skip("cannot build nomad-executor")
+    return ExecDriver()
+
+
+def _task(command, args, memory_mb=0):
+    return Task(name="t", driver="exec",
+                config={"command": command, "args": args},
+                resources=Resources(cpu=100, memory_mb=memory_mb))
+
+
+def test_fingerprint_builds_binary(driver):
+    info = driver.fingerprint()
+    assert info.detected and info.healthy
+    assert os.path.exists(ensure_executor_binary())
+
+
+def test_run_success_and_output(driver, tmp_path):
+    task = _task("/bin/sh", ["-c", "echo hello-from-executor"])
+    h = driver.start_task("t1", task, str(tmp_path), {"FOO": "bar"})
+    assert h.pid > 0
+    result = driver.wait_task("t1", timeout=10)
+    assert result is not None and result.exit_code == 0
+    out = (tmp_path / "t.stdout.log").read_text()
+    assert "hello-from-executor" in out
+    driver.destroy_task("t1")
+
+
+def test_env_passed_through(driver, tmp_path):
+    task = _task("/bin/sh", ["-c", "echo $MY_VAR"])
+    driver.start_task("t2", task, str(tmp_path), {"MY_VAR": "xyz123"})
+    result = driver.wait_task("t2", timeout=10)
+    assert result.exit_code == 0
+    assert "xyz123" in (tmp_path / "t.stdout.log").read_text()
+    driver.destroy_task("t2")
+
+
+def test_exit_code_propagates(driver, tmp_path):
+    task = _task("/bin/sh", ["-c", "exit 7"])
+    driver.start_task("t3", task, str(tmp_path), {})
+    result = driver.wait_task("t3", timeout=10)
+    assert result.exit_code == 7 and not result.successful()
+    driver.destroy_task("t3")
+
+
+def test_memory_limit_enforced(driver, tmp_path):
+    # allocate ~300MB under a 64MB RLIMIT_AS: the task must die
+    code = "x = bytearray(300*1024*1024); print(len(x))"
+    task = _task("/usr/bin/env", ["python3", "-c", code], memory_mb=64)
+    driver.start_task("t4", task, str(tmp_path), {})
+    result = driver.wait_task("t4", timeout=20)
+    assert result is not None
+    assert not result.successful()
+    driver.destroy_task("t4")
+
+
+def test_stop_kills_process_tree(driver, tmp_path):
+    task = _task("/bin/sh", ["-c", "sleep 60 & sleep 60"])
+    h = driver.start_task("t5", task, str(tmp_path), {})
+    time.sleep(0.3)
+    t0 = time.time()
+    driver.stop_task("t5", kill_timeout=5)
+    result = driver.wait_task("t5", timeout=5)
+    assert result is not None
+    assert time.time() - t0 < 5
+    driver.destroy_task("t5")
+
+
+def test_reattach_via_result_file(driver, tmp_path):
+    task = _task("/bin/sh", ["-c", "sleep 0.3; exit 5"])
+    h = driver.start_task("t6", task, str(tmp_path), {})
+    handle = TaskHandle(task_id="t6", driver="exec", pid=h.pid,
+                        config=dict(h.config))
+    # simulate a fresh driver (client restart)
+    d2 = ExecDriver()
+    assert d2.recover_task(handle)
+    result = d2.wait_task("t6", timeout=10)
+    assert result is not None and result.exit_code == 5
+    driver.destroy_task("t6")
+
+
+def test_end_to_end_exec_driver_through_cluster(tmp_path):
+    if ensure_executor_binary() is None:
+        pytest.skip("cannot build nomad-executor")
+    from nomad_tpu.client import Client
+    from nomad_tpu.server import Server
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    client = Client(server, data_dir=str(tmp_path / "c"))
+    client.start()
+
+    def wait(fn, t=15):
+        dl = time.time() + t
+        while time.time() < dl:
+            if fn():
+                return True
+            time.sleep(0.05)
+        return False
+
+    try:
+        assert wait(lambda: (n := server.state.node_by_id(client.node.id))
+                    is not None and n.ready())
+        marker = tmp_path / "native.txt"
+        job = mock.batch_job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", f"echo native-$NOMAD_ALLOC_INDEX > {marker}"]}
+        t.resources.networks = []
+        t.resources.cpu = 50
+        t.resources.memory_mb = 64
+        server.job_register(job)
+        assert wait(lambda: any(
+            a.client_status == "complete"
+            for a in server.state.allocs_by_job("default", job.id)))
+        assert marker.read_text().strip() == "native-0"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_spec_injection_rejected(driver, tmp_path):
+    # regression: newlines in env/args must not inject spec directives
+    task = _task("/bin/sh", ["-c", "echo hi"])
+    with pytest.raises(ValueError, match="newline"):
+        driver.start_task("t7", task, str(tmp_path),
+                          {"X": "a\ncommand=/bin/evil"})
+    task2 = _task("/bin/sh", ["-c\nresult=/tmp/hijack", "echo hi"])
+    with pytest.raises(ValueError, match="newline"):
+        driver.start_task("t8", task2, str(tmp_path), {})
+
+
+def test_bare_command_resolved_from_path(driver, tmp_path):
+    task = _task("echo", ["from-path-lookup"])
+    driver.start_task("t9", task, str(tmp_path), {})
+    result = driver.wait_task("t9", timeout=10)
+    assert result.exit_code == 0
+    assert "from-path-lookup" in (tmp_path / "t.stdout.log").read_text()
+    driver.destroy_task("t9")
+
+
+def test_sigterm_ignoring_task_gets_killed(driver, tmp_path):
+    # a task shell ignoring SIGTERM must still die via child-group SIGKILL
+    task = _task("/bin/sh",
+                 ["-c", "trap '' TERM; while :; do sleep 0.37717; done"])
+    h = driver.start_task("t10", task, str(tmp_path), {})
+    time.sleep(0.3)
+    with driver._lock:
+        rec = dict(driver._tasks["t10"])
+    child = driver._child_pid(rec)
+    assert child > 0
+    driver.stop_task("t10", kill_timeout=1.0)
+    time.sleep(0.3)
+    with pytest.raises(ProcessLookupError):
+        os.kill(child, 0)   # the trap-ignoring shell is gone
+    driver.destroy_task("t10")
