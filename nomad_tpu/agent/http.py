@@ -117,6 +117,21 @@ class HTTPAPI:
                 return [to_api(j)
                         for j in s.state.job_versions_by_id(ns, job_id)], \
                     s.state.table_index("jobs")
+            elif rest == ["plan"] and method in ("PUT", "POST"):
+                job = from_api(Job, body.get("Job", body))
+                if job.id and job.id != job_id:
+                    raise HTTPError(400, f"job ID {job.id!r} does not match "
+                                    f"URL job id {job_id!r}")
+                job.id = job_id
+                if not job.name:
+                    job.name = job_id
+                if not job.namespace:
+                    job.namespace = ns
+                try:
+                    return s.job_plan(job, diff=bool(body.get("Diff", True))), \
+                        None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
             elif rest == ["dispatch"] and method in ("PUT", "POST"):
                 import base64
                 payload = base64.b64decode(body.get("Payload", "") or "")

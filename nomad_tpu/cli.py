@@ -98,9 +98,25 @@ def cmd_agent(args) -> None:
 
 # ------------------------------------------------------------------- jobs
 
+def _load_spec(path: str, var_flags=None) -> dict:
+    """Load a jobspec file — HCL (.nomad/.hcl) or JSON — into the API job
+    payload (ref command/job_run.go: HCL parse then api.Job submit)."""
+    if path.endswith(".json"):
+        with open(path) as f:
+            spec = json.load(f)
+        return spec if "Job" in spec else {"Job": spec}
+    from .jobspec import parse_file
+    from .api_codec import to_api
+    variables = {}
+    for kv in var_flags or []:
+        k, _, v = kv.partition("=")
+        variables[k] = v
+    job = parse_file(path, variables)
+    return {"Job": to_api(job)}
+
+
 def cmd_job_run(args) -> None:
-    with open(args.spec) as f:
-        spec = json.load(f)
+    spec = _load_spec(args.spec, getattr(args, "var", None))
     resp = api("PUT", "/v1/jobs", spec)
     print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
     if args.detach:
@@ -155,6 +171,74 @@ def cmd_job_stop(args) -> None:
         path += "?purge=true"
     resp = api("DELETE", path)
     print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
+
+
+def _render_field_diffs(fields: list, indent: str) -> None:
+    marks = {"Added": "+", "Deleted": "-", "Edited": "+/-", "None": " "}
+    for f in fields or []:
+        m = marks.get(f["Type"], " ")
+        if f["Type"] == "Edited":
+            print(f"{indent}{m} {f['Name']}: {f['Old']!r} => {f['New']!r}")
+        elif f["Type"] == "Added":
+            print(f"{indent}{m} {f['Name']}: {f['New']!r}")
+        elif f["Type"] == "Deleted":
+            print(f"{indent}{m} {f['Name']}: {f['Old']!r}")
+
+
+def _render_object_diffs(objs: list, indent: str) -> None:
+    for o in objs or []:
+        print(f"{indent}{o['Type']} {o['Name']} {{")
+        _render_field_diffs(o.get("Fields"), indent + "  ")
+        _render_object_diffs(o.get("Objects"), indent + "  ")
+        print(f"{indent}}}")
+
+
+def cmd_job_plan(args) -> None:
+    spec = _load_spec(args.spec, getattr(args, "var", None))
+    spec["Diff"] = True
+    resp = api("PUT", f"/v1/job/{spec['Job'].get('Id') or spec['Job'].get('ID')}/plan",
+               spec)
+    diff = resp.get("Diff") or {}
+    if diff.get("Type", "None") != "None":
+        print(f"{diff['Type']} job {diff.get('ID', '')!r}")
+        _render_field_diffs(diff.get("Fields"), "  ")
+        _render_object_diffs(diff.get("Objects"), "  ")
+        for tg in diff.get("TaskGroups", []):
+            print(f"  {tg['Type']} group {tg['Name']!r}")
+            _render_field_diffs(tg.get("Fields"), "    ")
+            _render_object_diffs(tg.get("Objects"), "    ")
+            for t in tg.get("Tasks", []):
+                print(f"    {t['Type']} task {t['Name']!r}")
+                _render_field_diffs(t.get("Fields"), "      ")
+                _render_object_diffs(t.get("Objects"), "      ")
+    else:
+        print("No changes")
+    ann = resp.get("Annotations") or {}
+    for tg, upd in (ann.get("DesiredTgUpdates") or {}).items():
+        parts = [f"{k.lower()} {v}" for k, v in sorted(upd.items()) if v]
+        if parts:
+            print(f"==> group {tg!r}: " + ", ".join(parts))
+    failed = resp.get("FailedTGAllocs")
+    if failed:
+        for tg, m in failed.items():
+            print(f"!!  group {tg!r} would fail to place "
+                  f"(filtered {m.get('NodesFiltered', 0)}, "
+                  f"exhausted {m.get('NodesExhausted', 0)})")
+    print(f"Job Modify Index: {resp.get('JobModifyIndex', 0)}")
+
+
+def cmd_job_validate(args) -> None:
+    try:
+        _load_spec(args.spec, getattr(args, "var", None))
+    except Exception as e:   # noqa: BLE001
+        print(f"Job validation errors:\n  {e}")
+        raise SystemExit(1)
+    print("Job validation successful")
+
+
+def cmd_job_inspect(args) -> None:
+    job = api("GET", f"/v1/job/{args.job_id}")
+    print(json.dumps({"Job": job}, indent=2, default=str))
 
 
 def cmd_job_dispatch(args) -> None:
@@ -296,7 +380,19 @@ def build_parser() -> argparse.ArgumentParser:
     jr = jsub.add_parser("run")
     jr.add_argument("spec")
     jr.add_argument("-detach", action="store_true")
+    jr.add_argument("-var", action="append")
     jr.set_defaults(fn=cmd_job_run)
+    jp = jsub.add_parser("plan")
+    jp.add_argument("spec")
+    jp.add_argument("-var", action="append")
+    jp.set_defaults(fn=cmd_job_plan)
+    jv = jsub.add_parser("validate")
+    jv.add_argument("spec")
+    jv.add_argument("-var", action="append")
+    jv.set_defaults(fn=cmd_job_validate)
+    ji = jsub.add_parser("inspect")
+    ji.add_argument("job_id")
+    ji.set_defaults(fn=cmd_job_inspect)
     js = jsub.add_parser("status")
     js.add_argument("job_id", nargs="?", default="")
     js.set_defaults(fn=cmd_job_status)

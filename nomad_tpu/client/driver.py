@@ -71,9 +71,18 @@ class Driver:
         return False
 
 
+def _seconds(v) -> float:
+    """Accept bare seconds or duration strings ('10s', '1m') — the reference
+    mock driver's config takes Go duration strings (drivers/mock)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    from ..jobspec import duration
+    return duration(str(v))
+
+
 class MockDriver(Driver):
     """Configurable fake driver for tests (ref drivers/mock): config keys
-    run_for (sec), exit_code, start_error, kill_after."""
+    run_for (sec or duration string), exit_code, start_error, kill_after."""
 
     name = "mock_driver"
 
@@ -87,7 +96,7 @@ class MockDriver(Driver):
             raise RuntimeError(cfg["start_error"])
         now = time.time()
         rec = {
-            "ends_at": now + float(cfg.get("run_for", 0.0)),
+            "ends_at": now + _seconds(cfg.get("run_for", 0.0)),
             "exit_code": int(cfg.get("exit_code", 0)),
             "stopped": threading.Event(),
             "started_at": now,

@@ -218,6 +218,7 @@ class StaticIterator(FeasibleIterator):
         node = self.nodes[self.offset]
         self.offset += 1
         self.seen += 1
+        self.ctx.metrics.nodes_evaluated += 1   # ref feasible.go:86
         return node
 
     def reset(self) -> None:
@@ -479,6 +480,9 @@ class FeasibilityWrapper(FeasibleIterator):
             # job-level
             job_status = elig.job_status(klass)
             if job_status == EVAL_COMPUTED_CLASS_INELIGIBLE:
+                # fast path still counts (ref feasible.go FeasibilityWrapper:
+                # FilterNode "computed class ineligible")
+                self.ctx.metrics.filter_node(node, "computed class ineligible")
                 continue
             if job_status in (EVAL_COMPUTED_CLASS_UNKNOWN,
                               EVAL_COMPUTED_CLASS_ESCAPED,
@@ -493,6 +497,8 @@ class FeasibilityWrapper(FeasibleIterator):
             if self.tg_name:
                 tg_status = elig.task_group_status(self.tg_name, klass)
                 if tg_status == EVAL_COMPUTED_CLASS_INELIGIBLE:
+                    self.ctx.metrics.filter_node(node,
+                                                 "computed class ineligible")
                     continue
                 if tg_status in (EVAL_COMPUTED_CLASS_UNKNOWN,
                                  EVAL_COMPUTED_CLASS_ESCAPED,

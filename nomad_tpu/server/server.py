@@ -213,6 +213,42 @@ class Server:
             return "job registration is disabled"
         return ""
 
+    def job_plan(self, job: Job, diff: bool = True) -> dict:
+        """Dry-run scheduler pass over a forked state (ref
+        nomad/job_endpoint.go Job.Plan): insert the candidate job into a
+        scratch store, run the real scheduler with a capturing planner, and
+        return the annotated plan + job diff — Raft is never touched."""
+        from ..scheduler import new_scheduler
+        from ..scheduler.testing import Harness
+        from ..structs.diff import job_diff
+        from ..api_codec import to_api
+        err = self._validate_job(job)
+        if err:
+            raise ValueError(err)
+        old = self.state.job_by_id(job.namespace, job.id)
+        scratch = self.state.fork()
+        cand = job.copy()
+        cand.version = (old.version + 1) if old else 0
+        scratch.upsert_job(scratch.latest_index() + 1, cand)
+        h = Harness(scratch)
+        h.next_index = scratch.latest_index() + 1
+        ev = Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            job_id=job.id, triggered_by=TRIGGER_JOB_REGISTER,
+            status=EVAL_STATUS_PENDING, annotate_plan=True)
+        h.process(lambda snap, planner: new_scheduler(ev.type, snap, planner),
+                  ev)
+        plan = h.plans[-1] if h.plans else None
+        final_ev = h.evals[-1] if h.evals else ev
+        return {
+            "Annotations": to_api(plan.annotations) if plan else None,
+            "FailedTGAllocs": to_api(final_ev.failed_tg_allocs) or None,
+            "JobModifyIndex": old.modify_index if old else 0,
+            "CreatedEvals": [to_api(e) for e in h.created_evals],
+            "Diff": job_diff(old, cand) if diff else None,
+            "Index": self.state.latest_index(),
+        }
+
     def job_deregister(self, namespace: str, job_id: str,
                        purge: bool = False) -> dict:
         job = self.state.job_by_id(namespace, job_id)

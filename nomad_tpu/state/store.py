@@ -92,6 +92,35 @@ class StateStore:
         with self._lock:
             return StateSnapshot(self)
 
+    def fork(self) -> "StateStore":
+        """Writable scratch copy for dry-run planning (the Job.Plan endpoint
+        runs a real scheduler pass against a snapshot without touching Raft —
+        ref nomad/job_endpoint.go Job.Plan). Shallow table copies are safe:
+        stored objects are immutable-by-convention."""
+        with self._lock:
+            out = StateStore()
+            out._index = self._index
+            out._table_index = dict(self._table_index)
+            out.nodes = dict(self.nodes)
+            out.jobs = dict(self.jobs)
+            out.job_versions = dict(self.job_versions)
+            out.job_summaries = dict(self.job_summaries)
+            out.evals = dict(self.evals)
+            out.allocs = dict(self.allocs)
+            out.deployments = dict(self.deployments)
+            out.periodic_launches = dict(self.periodic_launches)
+            out.scheduler_config = self.scheduler_config
+            out.namespaces = dict(self.namespaces)
+            out._allocs_by_node = {k: set(v)
+                                   for k, v in self._allocs_by_node.items()}
+            out._allocs_by_job = {k: set(v)
+                                  for k, v in self._allocs_by_job.items()}
+            out._allocs_by_eval = {k: set(v)
+                                   for k, v in self._allocs_by_eval.items()}
+            out._evals_by_job = {k: set(v)
+                                 for k, v in self._evals_by_job.items()}
+            return out
+
     def snapshot_min_index(self, index: int, timeout: float = 5.0
                            ) -> "StateSnapshot":
         """Block until latest_index >= index, then snapshot

@@ -1,0 +1,177 @@
+"""Job diff for `job plan` (behavioral ref nomad/structs/diff.go — a field-
+level diff of two job versions with Added/Deleted/Edited annotations,
+grouped by task group and task).
+
+Implemented as a generic recursive diff over the API (PascalCase dict)
+representation rather than hand-written per-struct methods: the dataclass
+model is uniform enough that one walker covers the whole tree.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..api_codec import to_api
+
+DIFF_NONE = "None"
+DIFF_ADDED = "Added"
+DIFF_DELETED = "Deleted"
+DIFF_EDITED = "Edited"
+
+# fields excluded from diffs (server-maintained bookkeeping)
+_IGNORED = {
+    "Id", "ID", "Status", "StatusDescription", "Version", "SubmitTime",
+    "CreateIndex", "ModifyIndex", "JobModifyIndex", "Stable", "Stop",
+    "Dispatched", "ParentId", "ParentID", "NomadTokenId", "NomadTokenID",
+    "VaultToken", "ConsulToken", "Payload",
+}
+
+
+def _scalar(v) -> bool:
+    return not isinstance(v, (dict, list))
+
+
+def _fmt(v) -> str:
+    from ..jobspec.hcl import _to_string
+    return _to_string(v)
+
+
+def _field_diff(name: str, old, new) -> Optional[dict]:
+    if old == new:
+        return None
+    if old in (None, "", 0, False, [], {}) and new in (None, "", 0, False,
+                                                       [], {}):
+        return None
+    typ = DIFF_EDITED
+    if old in (None, "", [], {}):
+        typ = DIFF_ADDED
+    elif new in (None, "", [], {}):
+        typ = DIFF_DELETED
+    return {"Type": typ, "Name": name, "Old": _fmt(old), "New": _fmt(new)}
+
+
+def _object_diff(name: str, old: Optional[dict], new: Optional[dict]
+                 ) -> Optional[dict]:
+    """Diff two API dicts into {Type, Name, Fields, Objects}."""
+    old = old or {}
+    new = new or {}
+    fields, objects = [], []
+    for key in sorted(set(old) | set(new)):
+        if key in _IGNORED:
+            continue
+        ov, nv = old.get(key), new.get(key)
+        if _scalar(ov) and _scalar(nv):
+            fd = _field_diff(key, ov, nv)
+            if fd:
+                fields.append(fd)
+        elif isinstance(ov, dict) or isinstance(nv, dict):
+            od = _object_diff(key, ov if isinstance(ov, dict) else None,
+                              nv if isinstance(nv, dict) else None)
+            if od:
+                objects.append(od)
+        else:   # lists
+            od = _list_diff(key, ov or [], nv or [])
+            if od:
+                objects.extend(od)
+    if not fields and not objects:
+        return None
+    typ = DIFF_EDITED
+    if not old:
+        typ = DIFF_ADDED
+    elif not new:
+        typ = DIFF_DELETED
+    return {"Type": typ, "Name": name, "Fields": fields, "Objects": objects}
+
+
+def _list_key(item) -> str:
+    if isinstance(item, dict):
+        for k in ("Name", "Label", "Value", "LTarget", "Attribute",
+                  "GetterSource", "DestPath", "Volume"):
+            if item.get(k):
+                return str(item[k])
+        return str(sorted(item.items()))
+    return str(item)
+
+
+def _list_diff(name: str, old: list, new: list) -> list[dict]:
+    """Diff element lists keyed by a natural identity field."""
+    out = []
+    if all(_scalar(x) for x in old + new):
+        olds, news = set(map(str, old)), set(map(str, new))
+        for v in sorted(olds - news):
+            out.append({"Type": DIFF_DELETED, "Name": name,
+                        "Fields": [{"Type": DIFF_DELETED, "Name": name,
+                                    "Old": v, "New": ""}], "Objects": []})
+        for v in sorted(news - olds):
+            out.append({"Type": DIFF_ADDED, "Name": name,
+                        "Fields": [{"Type": DIFF_ADDED, "Name": name,
+                                    "Old": "", "New": v}], "Objects": []})
+        return out
+    om = {_list_key(x): x for x in old}
+    nm = {_list_key(x): x for x in new}
+    for key in sorted(set(om) | set(nm)):
+        od = _object_diff(name, om.get(key), nm.get(key))
+        if od:
+            out.append(od)
+    return out
+
+
+def task_diff(old: Optional[dict], new: Optional[dict]) -> Optional[dict]:
+    name = (new or old or {}).get("Name", "")
+    d = _object_diff("Task", old, new)
+    if d is None:
+        return None
+    d["Name"] = name
+    d["Annotations"] = []
+    return d
+
+
+def task_group_diff(old: Optional[dict], new: Optional[dict]
+                    ) -> Optional[dict]:
+    name = (new or old or {}).get("Name", "")
+    old, new = dict(old or {}), dict(new or {})
+    old_tasks = {t.get("Name"): t for t in old.pop("Tasks", None) or []}
+    new_tasks = {t.get("Name"): t for t in new.pop("Tasks", None) or []}
+    d = _object_diff("Group", old or None, new or None) or \
+        {"Type": DIFF_NONE, "Name": "Group", "Fields": [], "Objects": []}
+    tasks = []
+    for tname in sorted(set(old_tasks) | set(new_tasks)):
+        td = task_diff(old_tasks.get(tname), new_tasks.get(tname))
+        if td:
+            tasks.append(td)
+    if d["Type"] == DIFF_NONE and not tasks:
+        return None
+    typ = d["Type"]
+    if not old and new:
+        typ = DIFF_ADDED
+    elif old and not new:
+        typ = DIFF_DELETED
+    elif tasks and typ == DIFF_NONE:
+        typ = DIFF_EDITED
+    return {"Type": typ, "Name": name, "Fields": d["Fields"],
+            "Objects": d["Objects"], "Tasks": tasks, "Updates": {}}
+
+
+def job_diff(old, new) -> dict:
+    """Diff two Job dataclasses (either may be None) into the JobDiff API
+    shape consumed by `job plan` (ref structs/diff.go JobDiff)."""
+    oapi = to_api(old) if old is not None else {}
+    napi = to_api(new) if new is not None else {}
+    job_id = (napi or oapi).get("Id") or (napi or oapi).get("ID", "")
+    old_tgs = {g.get("Name"): g for g in oapi.pop("TaskGroups", None) or []}
+    new_tgs = {g.get("Name"): g for g in napi.pop("TaskGroups", None) or []}
+    top = _object_diff("Job", oapi or None, napi or None) or \
+        {"Type": DIFF_NONE, "Fields": [], "Objects": []}
+    tgs = []
+    for name in sorted(set(old_tgs) | set(new_tgs)):
+        tgd = task_group_diff(old_tgs.get(name), new_tgs.get(name))
+        if tgd:
+            tgs.append(tgd)
+    typ = top["Type"]
+    if not oapi:
+        typ = DIFF_ADDED
+    elif not napi:
+        typ = DIFF_DELETED
+    elif typ == DIFF_NONE and tgs:
+        typ = DIFF_EDITED
+    return {"Type": typ, "ID": job_id, "Fields": top["Fields"],
+            "Objects": top["Objects"], "TaskGroups": tgs}

@@ -176,3 +176,68 @@ def test_cli_against_agent(agent, capsys, tmp_path, monkeypatch):
     cli.main(["status"])
     out = capsys.readouterr().out
     assert "state_index" in out
+
+
+def test_http_job_plan(agent):
+    """Dry-run Job.Plan: diff + annotations, no state mutation
+    (ref nomad/job_endpoint.go Job.Plan)."""
+    job = mock.job()
+    job.id = job.name = "plan-test"
+    before = agent.server.state.latest_index()
+    resp, _ = call(agent, "PUT", f"/v1/job/{job.id}/plan",
+                   {"Job": to_api(job), "Diff": True})
+    assert resp["Diff"]["Type"] == "Added"
+    assert resp["JobModifyIndex"] == 0
+    # plan must not have registered the job or advanced Raft
+    assert agent.server.state.job_by_id("default", job.id) is None
+    assert agent.server.state.latest_index() == before
+    # now register for real, then plan an edit
+    call(agent, "PUT", "/v1/jobs", {"Job": to_api(job)})
+    assert wait_until(lambda: agent.server.state.job_by_id("default", job.id))
+    edited = from_api(Job, to_api(job))
+    edited.task_groups[0].count = 7
+    resp2, _ = call(agent, "PUT", f"/v1/job/{job.id}/plan",
+                    {"Job": to_api(edited), "Diff": True})
+    assert resp2["Diff"]["Type"] == "Edited"
+    tg = resp2["Diff"]["TaskGroups"][0]
+    counts = [f for f in tg["Fields"] if f["Name"] == "Count"]
+    assert counts and counts[0]["New"] == "7"
+    call(agent, "DELETE", f"/v1/job/{job.id}?purge=true")
+
+
+def test_cli_hcl_job_run(agent, capsys, tmp_path, monkeypatch):
+    """`job run` with an HCL spec file through the real CLI + HTTP path."""
+    from nomad_tpu import cli
+    monkeypatch.setenv("NOMAD_ADDR", agent.http_addr)
+    spec = tmp_path / "hello.nomad"
+    spec.write_text('''
+job "hello-hcl" {
+  datacenters = ["dc1"]
+  type        = "batch"
+  group "g" {
+    count = 1
+    task "t" {
+      driver = "mock"
+      config {
+        run_for = "0s"
+      }
+      resources {
+        cpu    = 50
+        memory = 32
+      }
+    }
+  }
+}
+''')
+    cli.main(["job", "validate", str(spec)])
+    out = capsys.readouterr().out
+    assert "successful" in out
+    cli.main(["job", "plan", str(spec)])
+    out = capsys.readouterr().out
+    assert "Added job" in out
+    cli.main(["job", "run", "-detach", str(spec)])
+    out = capsys.readouterr().out
+    assert "Evaluation" in out
+    assert wait_until(
+        lambda: agent.server.state.job_by_id("default", "hello-hcl"))
+    cli.main(["job", "stop", "-purge", "hello-hcl"])

@@ -1,0 +1,310 @@
+"""Jobspec parser + job diff tests (modeled on jobspec2/parse_test.go and
+structs/diff_test.go behavioral coverage)."""
+import pytest
+
+from nomad_tpu.jobspec import ParseError, duration, parse
+from nomad_tpu.structs import Job, Task, TaskGroup
+from nomad_tpu.structs.diff import job_diff
+
+
+BASIC = '''
+job "web" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "frontend" {
+    count = 2
+    task "server" {
+      driver = "mock"
+      config {
+        run_for = "10s"
+      }
+      resources {
+        cpu    = 250
+        memory = 128
+      }
+    }
+  }
+}
+'''
+
+
+def test_parse_basic():
+    job = parse(BASIC)
+    assert job.id == "web" and job.name == "web"
+    assert job.type == "service"
+    tg = job.task_groups[0]
+    assert tg.name == "frontend" and tg.count == 2
+    t = tg.tasks[0]
+    assert t.driver == "mock"
+    assert t.config["run_for"] == "10s"
+    assert t.resources.cpu == 250 and t.resources.memory_mb == 128
+
+
+def test_duration_parsing():
+    assert duration("30s") == 30.0
+    assert duration("1h30m") == 5400.0
+    assert duration("250ms") == 0.25
+    assert duration("2d") == 172800.0
+    assert duration(15) == 15.0
+    with pytest.raises(ParseError):
+        duration("bogus")
+
+
+def test_variables_and_locals():
+    src = '''
+    variable "count" {
+      type    = number
+      default = 3
+    }
+    variable "prefix" { default = "app" }
+    locals {
+      full = "${var.prefix}-prod"
+    }
+    job "x" {
+      group "${local.full}" {
+        count = var.count * 2
+        task "t" { driver = "mock" }
+      }
+    }
+    '''
+    # interpolation not allowed in labels; group name via label is literal —
+    # use attributes instead
+    src = src.replace('group "${local.full}"', 'group "g"')
+    job = parse(src, {"count": "5"})
+    assert job.task_groups[0].count == 10
+
+
+def test_missing_required_variable():
+    src = '''
+    variable "req" { type = string }
+    job "x" { group "g" { task "t" { driver = "mock" } } }
+    '''
+    with pytest.raises(ParseError, match="missing required variable"):
+        parse(src)
+    job = parse(src, {"req": "ok"})
+    assert job.id == "x"
+
+
+def test_undeclared_variable_override_rejected():
+    with pytest.raises(ParseError, match="undeclared"):
+        parse(BASIC, {"nope": "1"})
+
+
+def test_runtime_interpolation_preserved():
+    src = '''
+    job "x" {
+      constraint {
+        attribute = "${attr.kernel.name}"
+        value     = "linux"
+      }
+      group "g" {
+        task "t" {
+          driver = "mock"
+          env {
+            ADDR = "${NOMAD_ADDR_http}"
+            HOST = "${node.unique.name}"
+          }
+        }
+      }
+    }
+    '''
+    job = parse(src)
+    assert job.constraints[0].ltarget == "${attr.kernel.name}"
+    env = job.task_groups[0].tasks[0].env
+    assert env["ADDR"] == "${NOMAD_ADDR_http}"
+    assert env["HOST"] == "${node.unique.name}"
+
+
+def test_functions_and_expressions():
+    src = '''
+    job "x" {
+      meta {
+        a = join(",", ["x", "y"])
+        b = format("%s-%d", upper("web"), 1 + 2)
+        c = "${3 > 2 ? "yes" : "no"}"
+        d = jsonencode({k = 1})
+      }
+      group "g" { task "t" { driver = "mock" } }
+    }
+    '''
+    job = parse(src)
+    assert job.meta["a"] == "x,y"
+    assert job.meta["b"] == "WEB-3"
+    assert job.meta["c"] == "yes"
+    assert job.meta["d"] == '{"k": 1}'
+
+
+def test_heredoc_template():
+    src = '''
+    job "x" {
+      group "g" {
+        task "t" {
+          driver = "mock"
+          template {
+            data        = <<EOF
+line one
+line two
+EOF
+            destination = "local/out.txt"
+          }
+        }
+      }
+    }
+    '''
+    job = parse(src)
+    tmpl = job.task_groups[0].tasks[0].templates[0]
+    assert tmpl.embedded_tmpl == "line one\nline two\n"
+    assert tmpl.dest_path == "local/out.txt"
+
+
+def test_constraint_sugar_forms():
+    src = '''
+    job "x" {
+      constraint {
+        attribute = "${attr.driver.mock}"
+        operator  = "is_set"
+      }
+      group "g" {
+        constraint {
+          distinct_hosts = true
+        }
+        task "t" { driver = "mock" }
+      }
+    }
+    '''
+    job = parse(src)
+    assert job.constraints[0].operand == "is_set"
+    assert job.task_groups[0].constraints[0].operand == "distinct_hosts"
+
+
+def test_periodic_and_parameterized():
+    src = '''
+    job "cron" {
+      type = "batch"
+      periodic {
+        cron             = "*/15 * * * *"
+        prohibit_overlap = true
+      }
+      group "g" { task "t" { driver = "mock" } }
+    }
+    '''
+    job = parse(src)
+    assert job.periodic.spec == "*/15 * * * *"
+    assert job.periodic.prohibit_overlap
+
+    src2 = '''
+    job "param" {
+      type = "batch"
+      parameterized {
+        payload       = "required"
+        meta_required = ["k"]
+      }
+      group "g" { task "t" { driver = "mock" } }
+    }
+    '''
+    job2 = parse(src2)
+    assert job2.parameterized.payload == "required"
+    assert job2.parameterized.meta_required == ["k"]
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse("job \"x\" {")           # unterminated block
+    with pytest.raises(ParseError):
+        parse("nothing_here = 1")      # no job block
+    with pytest.raises(ParseError):
+        parse('job "a" {} job "b" {}')  # two jobs
+
+
+# ------------------------------------------------------------------- diff
+
+def _mk(count=2, cpu=100):
+    return Job(id="j", name="j", task_groups=[
+        TaskGroup(name="g", count=count,
+                  tasks=[Task(name="t", driver="mock")])])
+
+
+def test_job_diff_none():
+    d = job_diff(_mk(), _mk())
+    assert d["Type"] == "None"
+
+
+def test_job_diff_edited_count():
+    d = job_diff(_mk(count=2), _mk(count=5))
+    assert d["Type"] == "Edited"
+    tg = d["TaskGroups"][0]
+    assert tg["Type"] == "Edited"
+    counts = [f for f in tg["Fields"] if f["Name"] == "Count"]
+    assert counts and counts[0]["Old"] == "2" and counts[0]["New"] == "5"
+
+
+def test_job_diff_added_group():
+    new = _mk()
+    new.task_groups.append(TaskGroup(name="extra", count=1,
+                                     tasks=[Task(name="t2", driver="mock")]))
+    d = job_diff(_mk(), new)
+    added = [g for g in d["TaskGroups"] if g["Name"] == "extra"]
+    assert added and added[0]["Type"] == "Added"
+
+
+def test_job_diff_new_job():
+    d = job_diff(None, _mk())
+    assert d["Type"] == "Added"
+    d2 = job_diff(_mk(), None)
+    assert d2["Type"] == "Deleted"
+
+
+def test_distinct_property_sugar():
+    src = '''
+    job "x" {
+      group "g" {
+        constraint {
+          distinct_property = "${meta.rack}"
+          value             = "2"
+        }
+        task "t" { driver = "mock" }
+      }
+    }
+    '''
+    c = parse(src).task_groups[0].constraints[0]
+    assert c.operand == "distinct_property"
+    assert c.ltarget == "${meta.rack}"
+    assert c.rtarget == "2"
+
+
+def test_distinct_hosts_false_skipped():
+    src = '''
+    job "x" {
+      group "g" {
+        constraint {
+          distinct_hosts = false
+        }
+        task "t" { driver = "mock" }
+      }
+    }
+    '''
+    assert parse(src).task_groups[0].constraints == []
+
+
+def test_bool_constraint_value_renders_hcl_style():
+    src = '''
+    job "x" {
+      constraint {
+        attribute = "${attr.driver.docker}"
+        value     = true
+      }
+      group "g" { task "t" { driver = "mock" } }
+    }
+    '''
+    assert parse(src).constraints[0].rtarget == "true"
+
+
+def test_variable_without_default_is_required():
+    src = '''
+    variable "image" {}
+    job "x" { group "g" { task "t" { driver = "mock" } } }
+    '''
+    with pytest.raises(ParseError, match="missing required variable"):
+        parse(src)
+    assert parse(src, {"image": "i"}).id == "x"
