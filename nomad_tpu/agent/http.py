@@ -312,11 +312,17 @@ class HTTPAPI:
 def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                      port: int = 4646) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
+        # chunked transfer (event stream) requires HTTP/1.1 framing
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):   # quiet
             pass
 
         def _do(self, method: str) -> None:
             parsed = urllib.parse.urlparse(self.path)
+            if parsed.path == "/v1/event/stream" and method == "GET":
+                self._event_stream(parsed)
+                return
             query = {k: v[0] for k, v in
                      urllib.parse.parse_qs(parsed.query).items()}
             body = None
@@ -343,6 +349,66 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             if index is not None:
                 headers["X-Nomad-Index"] = str(index)
             self._respond(200, payload, headers)
+
+        def _event_stream(self, parsed) -> None:
+            """Long-lived ndjson stream of state events
+            (ref command/agent/event_endpoint.go EventStream)."""
+            from ..server.event_broker import SubscriptionClosedError
+            q = urllib.parse.parse_qs(parsed.query)
+            topics: dict[str, list[str]] = {}
+            for spec in q.get("topic", []):
+                topic, _, key = spec.partition(":")
+                topics.setdefault(topic, []).append(key or "*")
+            try:
+                index = int(q.get("index", ["0"])[0] or 0)
+            except ValueError:
+                self._respond(400, {"error": "invalid index"})
+                return
+            # default namespace matches the rest of the API; "*" = all
+            namespace = q.get("namespace", ["default"])[0]
+            if namespace == "*":
+                namespace = ""
+            broker = api.server.event_broker
+            sub = broker.subscribe(topics=topics, index=index,
+                                   namespace=namespace)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                idle = 0.0
+                while True:
+                    got = sub.next_events(timeout=1.0)
+                    if got is None:
+                        idle += 1.0
+                        if idle >= 10.0:      # heartbeat (ref: newline ping)
+                            write_chunk(b"{}\n")
+                            idle = 0.0
+                        continue
+                    idle = 0.0
+                    bidx, events = got
+                    line = json.dumps({
+                        "Index": bidx,
+                        "Events": [e.to_api() for e in events]})
+                    write_chunk(line.encode() + b"\n")
+            except SubscriptionClosedError:
+                try:
+                    write_chunk(json.dumps(
+                        {"Error": "subscription closed by server"}).encode()
+                        + b"\n")
+                    write_chunk(b"")
+                except OSError:
+                    pass
+            except OSError:
+                pass       # client went away
+            finally:
+                sub.close()
 
         def _respond(self, code: int, payload, headers=None) -> None:
             data = json.dumps(payload).encode()

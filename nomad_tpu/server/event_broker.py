@@ -1,0 +1,193 @@
+"""Event broker: at-most-once pub/sub of state-change events
+(ref nomad/stream/event_broker.go:30 EventBroker, event_buffer.go).
+
+A bounded ring buffer of event batches with per-subscriber cursors: slow
+subscribers that fall off the tail are closed and must re-subscribe (the
+reference's ErrSubscriptionClosed contract). Feeds `/v1/event/stream`.
+
+Events originate from the state store's `event_sinks` (our analog of
+nomad/state/events.go eventsFromChanges).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+ALL_KEYS = "*"
+
+TOPIC_JOB = "Job"
+TOPIC_EVAL = "Evaluation"
+TOPIC_ALLOC = "Allocation"
+TOPIC_DEPLOYMENT = "Deployment"
+TOPIC_NODE = "Node"
+TOPIC_ALL = "*"
+
+
+class SubscriptionClosedError(Exception):
+    """The subscriber fell behind the ring buffer and was dropped
+    (ref stream/subscription.go ErrSubscriptionClosed)."""
+
+
+@dataclass
+class Event:
+    topic: str
+    type: str
+    key: str = ""
+    namespace: str = ""
+    filter_keys: list[str] = field(default_factory=list)
+    index: int = 0
+    payload: Any = None
+
+    def to_api(self) -> dict:
+        from ..api_codec import to_api
+        wrapper_key = {
+            TOPIC_JOB: "Job", TOPIC_EVAL: "Evaluation",
+            TOPIC_ALLOC: "Allocation", TOPIC_DEPLOYMENT: "Deployment",
+            TOPIC_NODE: "Node",
+        }.get(self.topic, "Payload")
+        payload = self.payload
+        if payload is not None and not isinstance(payload, (dict, str, int,
+                                                            float, list)):
+            payload = to_api(payload)
+        return {"Topic": self.topic, "Type": self.type, "Key": self.key,
+                "Namespace": self.namespace, "FilterKeys": self.filter_keys,
+                "Index": self.index, "Payload": {wrapper_key: payload}}
+
+
+def _match(req_topics: dict[str, list[str]], ev: Event) -> bool:
+    for topic in (ev.topic, TOPIC_ALL):
+        keys = req_topics.get(topic)
+        if keys is None:
+            continue
+        for k in keys:
+            if k == ALL_KEYS or k == ev.key or k in ev.filter_keys:
+                return True
+    return False
+
+
+class Subscription:
+    def __init__(self, broker: "EventBroker", topics: dict[str, list[str]],
+                 namespace: str = ""):
+        self._broker = broker
+        self.topics = topics or {TOPIC_ALL: [ALL_KEYS]}
+        self.namespace = namespace
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def _offer(self, index: int, events: list[Event]) -> None:
+        wanted = [e for e in events if _match(self.topics, e)
+                  and (not self.namespace or not e.namespace
+                       or e.namespace == self.namespace)]
+        dropped = False
+        with self._cond:
+            if self._closed:
+                return
+            if wanted:
+                self._queue.append((index, wanted))
+                if len(self._queue) > self._broker.max_pending:
+                    self._closed = True   # slow consumer: drop
+                    self._queue.clear()
+                    dropped = True
+            self._cond.notify_all()
+        if dropped:
+            self._broker._unsubscribe(self)
+
+    def next_events(self, timeout: Optional[float] = None
+                    ) -> Optional[tuple[int, list[Event]]]:
+        """Block until the next matching batch; None on timeout. Raises
+        SubscriptionClosedError if dropped for falling behind."""
+        with self._cond:
+            if not self._queue and not self._closed:
+                self._cond.wait(timeout)
+            if self._closed:
+                raise SubscriptionClosedError()
+            if self._queue:
+                return self._queue.popleft()
+            return None
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._broker._unsubscribe(self)
+
+
+class EventBroker:
+    """ref nomad/stream/event_broker.go:30; buffer_size mirrors
+    EventBufferSize (default 100 batches)."""
+
+    def __init__(self, buffer_size: int = 256, max_pending: int = 512):
+        # RLock: subscribe() replays into the sub while holding the lock; an
+        # overflowing replay re-enters via _unsubscribe
+        self._lock = threading.RLock()
+        self._buffer: deque[tuple[int, list[Event]]] = deque(
+            maxlen=buffer_size)
+        self._subs: list[Subscription] = []
+        self.max_pending = max_pending
+        self._latest_index = 0
+
+    # ------------------------------------------------------------- publish
+
+    def publish(self, index: int, events: list[Event]) -> None:
+        """ref event_broker.go:95 Publish"""
+        if not events:
+            return
+        with self._lock:
+            self._latest_index = max(self._latest_index, index)
+            self._buffer.append((index, events))
+            subs = list(self._subs)
+        for sub in subs:
+            sub._offer(index, events)
+
+    def sink(self, topic: str, etype: str, index: int, payload) -> None:
+        """Adapter matching StateStore.event_sinks signature."""
+        self.publish(index, [make_event(topic, etype, index, payload)])
+
+    # ----------------------------------------------------------- subscribe
+
+    def subscribe(self, topics: Optional[dict[str, list[str]]] = None,
+                  index: int = 0, namespace: str = "") -> Subscription:
+        """ref event_broker.go:138 Subscribe — replays buffered batches with
+        index > `index` before going live."""
+        sub = Subscription(self, topics or {}, namespace)
+        with self._lock:
+            # replay while holding the broker lock, BEFORE the sub becomes
+            # visible to publish(), so batch order stays index-monotonic
+            if index:
+                for i, evs in self._buffer:
+                    if i > index:
+                        sub._offer(i, evs)
+            self._subs.append(sub)
+        return sub
+
+    def _unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._latest_index
+
+
+def make_event(topic: str, etype: str, index: int, payload) -> Event:
+    """Derive key/namespace/filter-keys from the state object
+    (ref nomad/state/events.go eventFromChange)."""
+    key, ns, fkeys = "", "", []
+    if isinstance(payload, tuple):          # (ns, job_id) deregister form
+        ns, key = payload
+        payload = {"ID": key, "Namespace": ns}
+    else:
+        key = getattr(payload, "id", "") or ""
+        ns = getattr(payload, "namespace", "") or ""
+        job_id = getattr(payload, "job_id", "") or ""
+        node_id = getattr(payload, "node_id", "") or ""
+        if job_id:
+            fkeys.append(job_id)
+        if node_id:
+            fkeys.append(node_id)
+    return Event(topic=topic, type=etype, key=key, namespace=ns,
+                 filter_keys=fkeys, index=index, payload=payload)

@@ -55,6 +55,9 @@ class Server:
         self.state: StateStore = self.fsm.state
         self.raft = RaftLog(self.fsm)
         self.eval_broker = EvalBroker()
+        from .event_broker import EventBroker
+        self.event_broker = EventBroker()
+        self.state.event_sinks.append(self.event_broker.sink)
         self.blocked_evals = BlockedEvals(self._enqueue_unblocked)
         self.planner = Planner(self.raft, self.state)
         self.periodic = PeriodicDispatch(self)

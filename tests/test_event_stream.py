@@ -1,0 +1,130 @@
+"""Event broker + /v1/event/stream tests (modeled on
+nomad/stream/event_broker_test.go and command/agent/event_endpoint_test.go)."""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api_codec import to_api
+from nomad_tpu.server.event_broker import (
+    Event, EventBroker, SubscriptionClosedError, make_event,
+)
+
+
+def _ev(topic="Job", key="j1", index=1, **kw):
+    return Event(topic=topic, type="T", key=key, index=index, **kw)
+
+
+def test_subscribe_topic_filtering():
+    b = EventBroker()
+    sub_all = b.subscribe({"*": ["*"]})
+    sub_job = b.subscribe({"Job": ["j1"]})
+    sub_node = b.subscribe({"Node": ["*"]})
+    b.publish(5, [_ev(topic="Job", key="j1", index=5),
+                  _ev(topic="Node", key="n1", index=5)])
+    idx, evs = sub_all.next_events(timeout=1)
+    assert idx == 5 and len(evs) == 2
+    idx, evs = sub_job.next_events(timeout=1)
+    assert [e.key for e in evs] == ["j1"]
+    idx, evs = sub_node.next_events(timeout=1)
+    assert [e.topic for e in evs] == ["Node"]
+    assert sub_job.next_events(timeout=0.05) is None
+
+
+def test_filter_keys_match():
+    b = EventBroker()
+    sub = b.subscribe({"Allocation": ["job-9"]})
+    b.publish(2, [_ev(topic="Allocation", key="a1",
+                      filter_keys=["job-9", "node-3"], index=2)])
+    _, evs = sub.next_events(timeout=1)
+    assert evs[0].key == "a1"
+
+
+def test_replay_from_index():
+    b = EventBroker()
+    b.publish(1, [_ev(index=1, key="a")])
+    b.publish(2, [_ev(index=2, key="b")])
+    b.publish(3, [_ev(index=3, key="c")])
+    sub = b.subscribe({"*": ["*"]}, index=1)
+    got = []
+    for _ in range(2):
+        _, evs = sub.next_events(timeout=1)
+        got.extend(e.key for e in evs)
+    assert got == ["b", "c"]
+
+
+def test_slow_consumer_dropped():
+    b = EventBroker(max_pending=3)
+    sub = b.subscribe({"*": ["*"]})
+    for i in range(10):
+        b.publish(i + 1, [_ev(index=i + 1)])
+    with pytest.raises(SubscriptionClosedError):
+        for _ in range(10):
+            sub.next_events(timeout=0.1)
+
+
+def test_namespace_scoping():
+    b = EventBroker()
+    sub = b.subscribe({"*": ["*"]}, namespace="team-a")
+    b.publish(1, [_ev(index=1, key="x", namespace="team-a"),
+                  _ev(index=1, key="y", namespace="team-b")])
+    _, evs = sub.next_events(timeout=1)
+    assert [e.key for e in evs] == ["x"]
+
+
+def test_make_event_from_state_object():
+    alloc = mock.alloc()
+    ev = make_event("Allocation", "AllocationUpdated", 7, alloc)
+    assert ev.key == alloc.id
+    assert alloc.job_id in ev.filter_keys
+    assert alloc.node_id in ev.filter_keys
+    api = ev.to_api()
+    assert api["Topic"] == "Allocation"
+    assert api["Payload"]["Allocation"]["ID"] == alloc.id
+
+
+# ------------------------------------------------------------- HTTP stream
+
+def test_http_event_stream():
+    from nomad_tpu.agent import Agent, AgentConfig
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=1))
+    a.start()
+    try:
+        lines: list[dict] = []
+        ready = threading.Event()
+
+        def reader():
+            url = a.http_addr + "/v1/event/stream?topic=Job:*"
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                ready.set()
+                for raw in resp:
+                    raw = raw.strip()
+                    if not raw or raw == b"{}":
+                        continue
+                    lines.append(json.loads(raw))
+                    if len(lines) >= 1:
+                        return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        time.sleep(0.3)
+        job = mock.job()
+        job.id = job.name = "stream-test"
+        data = json.dumps({"Job": to_api(job)}).encode()
+        req = urllib.request.Request(
+            a.http_addr + "/v1/jobs", data=data, method="PUT",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).read()
+        t.join(timeout=15)
+        assert lines, "no events received on stream"
+        batch = lines[0]
+        assert batch["Index"] > 0
+        evs = batch["Events"]
+        assert any(e["Topic"] == "Job" and e["Key"] == "stream-test"
+                   for e in evs)
+    finally:
+        a.shutdown()
