@@ -27,6 +27,7 @@ class AgentConfig:
     node_class: str = ""
     node_name: str = ""
     dev_mode: bool = False
+    acl_enabled: bool = False
 
 
 class Agent:
@@ -42,7 +43,8 @@ class Agent:
 
         if self.config.server_enabled:
             self.server = Server(num_workers=self.config.num_workers,
-                                 logger=self.logger)
+                                 logger=self.logger,
+                                 acl_enabled=self.config.acl_enabled)
         if self.config.client_enabled:
             if self.server is None:
                 raise ValueError("client-only agents need a server address "

@@ -34,7 +34,8 @@ class HTTPAPI:
 
     # ------------------------------------------------------------ dispatch
 
-    def handle(self, method: str, path: str, query: dict, body: Optional[dict]):
+    def handle(self, method: str, path: str, query: dict,
+               body: Optional[dict], token: str = ""):
         s = self.server
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":
@@ -42,6 +43,54 @@ class HTTPAPI:
         parts = parts[1:]
         ns = query.get("namespace", "default")
         body = body or {}   # body-less PUT/POST is an empty request
+
+        # ---- ACL resolution (ref command/agent/http.go parseToken +
+        # per-endpoint aclObj checks)
+        from ..server.acl_endpoint import TokenNotFoundError
+        from ..acl import (
+            NS_DISPATCH_JOB, NS_LIST_JOBS, NS_READ_JOB, NS_SUBMIT_JOB,
+        )
+        try:
+            acl = s.acl.resolve_token(token)
+        except TokenNotFoundError:
+            raise HTTPError(403, "ACL token not found")
+
+        def require(ok: bool) -> None:
+            if not ok:
+                raise HTTPError(403, "Permission denied")
+
+        # ---- ACL management endpoints
+        if parts and parts[0] == "acl":
+            return self._handle_acl(method, parts[1:], body, token, acl)
+        if parts == ["namespaces"]:
+            # filtered to namespaces the token can access (ref
+            # nomad/namespace_endpoint — no blanket 403)
+            return [self._ns_api(n) for n in s.state.iter_namespaces()
+                    if acl.allow_namespace(n.get("name", ""))], \
+                s.state.table_index("namespaces")
+        if parts and parts[0] == "namespace":
+            if method == "GET" and len(parts) == 2:
+                n = s.state.namespace_by_name(parts[1])
+                if n is None:
+                    raise HTTPError(404, "namespace not found")
+                require(acl.allow_namespace(parts[1]))
+                return self._ns_api(n), s.state.table_index("namespaces")
+            require(acl.is_management())
+            if method in ("PUT", "POST"):
+                name = body.get("Name") or (parts[1] if len(parts) > 1
+                                            else "")
+                if not name:
+                    raise HTTPError(400, "namespace name required")
+                s.namespace_upsert([{
+                    "name": name,
+                    "description": body.get("Description", "")}])
+                return {}, None
+            if method == "DELETE" and len(parts) == 2:
+                try:
+                    s.namespace_delete([parts[1]])
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                return {}, None
 
         def blocking(index_fn, payload_fn):
             min_index = int(query.get("index", 0) or 0)
@@ -56,6 +105,7 @@ class HTTPAPI:
         # ---- jobs
         if parts == ["jobs"]:
             if method == "GET":
+                require(acl.allow_namespace_operation(ns, NS_LIST_JOBS))
                 prefix = query.get("prefix", "")
                 payload, index = blocking(
                     lambda: s.state.table_index("jobs"),
@@ -66,6 +116,8 @@ class HTTPAPI:
                 job = from_api(Job, body.get("Job", body))
                 if not job.namespace:
                     job.namespace = ns
+                require(acl.allow_namespace_operation(job.namespace,
+                                                      NS_SUBMIT_JOB))
                 try:
                     return s.job_register(job), None
                 except ValueError as e:
@@ -75,6 +127,12 @@ class HTTPAPI:
                 raise HTTPError(404, "missing job id")
             job_id = urllib.parse.unquote(parts[1])
             rest = parts[2:]
+            if method == "GET":
+                require(acl.allow_namespace_operation(ns, NS_READ_JOB))
+            elif rest == ["dispatch"]:
+                require(acl.allow_namespace_operation(ns, NS_DISPATCH_JOB))
+            else:
+                require(acl.allow_namespace_operation(ns, NS_SUBMIT_JOB))
             if not rest:
                 if method == "GET":
                     job = s.state.job_by_id(ns, job_id)
@@ -86,6 +144,9 @@ class HTTPAPI:
                     job.id = job_id
                     if not job.namespace:
                         job.namespace = ns
+                    # the body's namespace is authoritative — re-check it
+                    require(acl.allow_namespace_operation(job.namespace,
+                                                          NS_SUBMIT_JOB))
                     try:
                         return s.job_register(job), None
                     except ValueError as e:
@@ -127,6 +188,8 @@ class HTTPAPI:
                     job.name = job_id
                 if not job.namespace:
                     job.namespace = ns
+                require(acl.allow_namespace_operation(job.namespace,
+                                                      NS_SUBMIT_JOB))
                 try:
                     return s.job_plan(job, diff=bool(body.get("Diff", True))), \
                         None
@@ -149,12 +212,19 @@ class HTTPAPI:
 
         # ---- evaluations
         if parts == ["evaluations"]:
-            return [to_api(e) for e in s.state.iter_evals()], \
-                s.state.table_index("evals")
+            if ns != "*":
+                require(acl.allow_namespace_operation(ns, NS_READ_JOB))
+            evs = [e for e in s.state.iter_evals()
+                   if (e.namespace == ns if ns != "*" else
+                       acl.allow_namespace_operation(e.namespace,
+                                                     NS_READ_JOB))]
+            return [to_api(e) for e in evs], s.state.table_index("evals")
         if parts and parts[0] == "evaluation" and len(parts) >= 2:
             ev = s.state.eval_by_id(parts[1])
             if ev is None:
                 raise HTTPError(404, "eval not found")
+            # authorize against the resource's own namespace
+            require(acl.allow_namespace_operation(ev.namespace, NS_READ_JOB))
             if parts[2:] == ["allocations"]:
                 return [self._alloc_stub(a)
                         for a in s.state.allocs_by_eval(parts[1])], None
@@ -162,25 +232,40 @@ class HTTPAPI:
 
         # ---- allocations
         if parts == ["allocations"]:
+            if ns != "*":
+                require(acl.allow_namespace_operation(ns, NS_READ_JOB))
             payload, index = blocking(
                 lambda: s.state.table_index("allocs"),
-                lambda: [self._alloc_stub(a) for a in s.state.iter_allocs()])
+                lambda: [self._alloc_stub(a) for a in s.state.iter_allocs()
+                         if (a.namespace == ns if ns != "*" else
+                             acl.allow_namespace_operation(a.namespace,
+                                                           NS_READ_JOB))])
             return payload, index
         if parts and parts[0] == "allocation" and len(parts) >= 2:
             alloc = s.state.alloc_by_id(parts[1])
             if alloc is None:
                 raise HTTPError(404, "alloc not found")
+            # authorize against the alloc's own namespace
+            require(acl.allow_namespace_operation(alloc.namespace,
+                                                  NS_READ_JOB))
             if parts[2:] == ["stop"] and method in ("PUT", "POST"):
+                # stopping a workload is a lifecycle write
+                from ..acl import NS_ALLOC_LIFECYCLE
+                require(acl.allow_namespace_operation(alloc.namespace,
+                                                      NS_ALLOC_LIFECYCLE))
                 return s.alloc_stop(parts[1]), None
             return to_api(alloc), s.state.table_index("allocs")
 
         # ---- nodes
         if parts == ["nodes"]:
+            require(acl.allow_node_read())
             payload, index = blocking(
                 lambda: s.state.table_index("nodes"),
                 lambda: [self._node_stub(n) for n in s.state.iter_nodes()])
             return payload, index
         if parts and parts[0] == "node" and len(parts) >= 2:
+            require(acl.allow_node_write() if method != "GET"
+                    else acl.allow_node_read())
             node_id = parts[1]
             node = s.state.node_by_id(node_id)
             if node is None:
@@ -208,9 +293,12 @@ class HTTPAPI:
 
         # ---- deployments
         if parts == ["deployments"]:
+            require(acl.allow_namespace_operation(ns, NS_READ_JOB))
             return [to_api(d) for d in s.deployment_list(ns)], \
                 s.state.table_index("deployment")
         if parts and parts[0] == "deployment" and len(parts) >= 2:
+            require(acl.allow_namespace_operation(
+                ns, NS_READ_JOB if method == "GET" else NS_SUBMIT_JOB))
             if parts[1] == "promote" and method in ("PUT", "POST"):
                 try:
                     return s.deployment_promote(
@@ -237,9 +325,11 @@ class HTTPAPI:
         # ---- operator
         if parts == ["operator", "scheduler", "configuration"]:
             if method == "GET":
+                require(acl.allow_operator_read())
                 return {"SchedulerConfig":
                         to_api(s.get_scheduler_configuration())}, None
             if method in ("PUT", "POST"):
+                require(acl.allow_operator_write())
                 cfg = from_api(SchedulerConfiguration, body)
                 try:
                     return s.set_scheduler_configuration(cfg), None
@@ -250,6 +340,7 @@ class HTTPAPI:
         if parts == ["status", "leader"]:
             return "127.0.0.1:4647" if s.is_leader else "", None
         if parts == ["agent", "self"]:
+            require(acl.allow_agent_read())
             return {"config": {"Server": {"Enabled": True},
                                "Client": {"Enabled": self.agent.client is not None},
                                "Version": self._version()},
@@ -258,9 +349,11 @@ class HTTPAPI:
             return {"Members": [{"Name": "server-1", "Status": "alive",
                                  "Tags": {"role": "nomad_tpu"}}]}, None
         if parts == ["system", "gc"] and method in ("PUT", "POST"):
+            require(acl.is_management())
             s.run_gc()
             return {}, None
         if parts == ["metrics"]:
+            require(acl.allow_agent_read())
             return self.agent.stats(), None
 
         raise HTTPError(404, f"no handler for {method} {path}")
@@ -268,6 +361,123 @@ class HTTPAPI:
     def _version(self) -> str:
         from .. import __version__
         return __version__
+
+    # ------------------------------------------------------------------ ACL
+
+    def _handle_acl(self, method: str, parts: list[str],
+                    body: dict, token: str, acl):
+        """/v1/acl/* routes (ref command/agent/acl_endpoint.go)."""
+        from ..server.acl_endpoint import (
+            ACLDisabledError, PermissionDeniedError,
+        )
+        from ..structs import ACLPolicy, ACLToken
+        s = self.server
+
+        def require(ok: bool) -> None:
+            if not ok:
+                raise HTTPError(403, "Permission denied")
+
+        try:
+            if parts == ["bootstrap"] and method in ("PUT", "POST"):
+                return self._token_api(s.acl.bootstrap(),
+                                       secret=True), None
+            if parts == ["policies"] and method == "GET":
+                require(acl.is_management())
+                return [{"Name": p.name, "Description": p.description,
+                         "CreateIndex": p.create_index,
+                         "ModifyIndex": p.modify_index}
+                        for p in s.state.iter_acl_policies()], \
+                    s.state.table_index("acl_policy")
+            if parts and parts[0] == "policy" and len(parts) == 2:
+                name = parts[1]
+                require(acl.is_management())
+                if method == "GET":
+                    pol = s.state.acl_policy_by_name(name)
+                    if pol is None:
+                        raise HTTPError(404, "policy not found")
+                    return {"Name": pol.name,
+                            "Description": pol.description,
+                            "Rules": pol.rules,
+                            "CreateIndex": pol.create_index,
+                            "ModifyIndex": pol.modify_index}, None
+                if method in ("PUT", "POST"):
+                    pol = ACLPolicy(name=name,
+                                    description=body.get("Description", ""),
+                                    rules=body.get("Rules", ""))
+                    try:
+                        s.acl.upsert_policies([pol])
+                    except ValueError as e:
+                        raise HTTPError(400, str(e))
+                    return {}, None
+                if method == "DELETE":
+                    s.acl.delete_policies([name])
+                    return {}, None
+            if parts == ["tokens"] and method == "GET":
+                require(acl.is_management())
+                return [self._token_api(t)
+                        for t in s.state.iter_acl_tokens()], \
+                    s.state.table_index("acl_token")
+            if parts == ["token"] and method in ("PUT", "POST"):
+                require(acl.is_management())
+                tok = ACLToken(
+                    name=body.get("Name", ""),
+                    type=body.get("Type", "client"),
+                    policies=body.get("Policies", []) or [],
+                    global_=bool(body.get("Global", False)))
+                try:
+                    created = s.acl.upsert_tokens([tok])
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                return self._token_api(created[0], secret=True), None
+            if parts and parts[0] == "token" and len(parts) == 2:
+                if parts[1] == "self":
+                    tok = s.state.acl_token_by_secret(token)
+                    if tok is None:
+                        raise HTTPError(403, "ACL token not found")
+                    return self._token_api(tok, secret=True), None
+                require(acl.is_management())
+                tok = s.state.acl_token_by_accessor(parts[1])
+                if method == "GET":
+                    if tok is None:
+                        raise HTTPError(404, "token not found")
+                    return self._token_api(tok, secret=True), None
+                if method in ("PUT", "POST"):
+                    upd = ACLToken(
+                        accessor_id=parts[1],
+                        name=body.get("Name", ""),
+                        type=body.get("Type", "client"),
+                        policies=body.get("Policies", []) or [],
+                        global_=bool(body.get("Global", False)))
+                    try:
+                        out = s.acl.upsert_tokens([upd])
+                    except ValueError as e:
+                        raise HTTPError(400, str(e))
+                    return self._token_api(out[0], secret=True), None
+                if method == "DELETE":
+                    if tok is None:
+                        raise HTTPError(404, "token not found")
+                    s.acl.delete_tokens([parts[1]])
+                    return {}, None
+        except ACLDisabledError as e:
+            raise HTTPError(400, str(e))
+        except PermissionDeniedError as e:
+            raise HTTPError(403, str(e))
+        raise HTTPError(404, "no such ACL endpoint")
+
+    def _token_api(self, tok, secret: bool = False) -> dict:
+        out = {
+            "AccessorID": tok.accessor_id, "Name": tok.name,
+            "Type": tok.type, "Policies": list(tok.policies),
+            "Global": tok.global_, "CreateTime": tok.create_time_unix,
+            "CreateIndex": tok.create_index, "ModifyIndex": tok.modify_index,
+        }
+        if secret:
+            out["SecretID"] = tok.secret_id
+        return out
+
+    def _ns_api(self, n: dict) -> dict:
+        return {"Name": n.get("name", ""),
+                "Description": n.get("description", "")}
 
     # ------------------------------------------------------------- stubs
 
@@ -334,8 +544,11 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 except json.JSONDecodeError:
                     self._respond(400, {"error": "invalid JSON body"})
                     return
+            token = self.headers.get("X-Nomad-Token", "") or \
+                query.get("token", "")
             try:
-                payload, index = api.handle(method, parsed.path, query, body)
+                payload, index = api.handle(method, parsed.path, query, body,
+                                            token=token)
             except HTTPError as e:
                 self._respond(e.code, {"error": e.message})
                 return
@@ -368,6 +581,31 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             namespace = q.get("namespace", ["default"])[0]
             if namespace == "*":
                 namespace = ""
+            token = self.headers.get("X-Nomad-Token", "") or \
+                q.get("token", [""])[0]
+            from ..server.acl_endpoint import TokenNotFoundError
+            from ..acl import NS_READ_JOB
+            try:
+                acl = api.server.acl.resolve_token(token)
+            except TokenNotFoundError:
+                self._respond(403, {"error": "ACL token not found"})
+                return
+            if not (acl.is_management()
+                    or (namespace and acl.allow_namespace_operation(
+                        namespace, NS_READ_JOB))):
+                self._respond(403, {"error": "Permission denied"})
+                return
+            # Node events are namespace-less; without node:read they must
+            # not leak onto a namespace-scoped stream
+            if not acl.allow_node_read():
+                if "Node" in topics:
+                    self._respond(403, {"error": "Permission denied"})
+                    return
+                if "*" in topics:
+                    keys = topics.pop("*")
+                    for t in ("Job", "Evaluation", "Allocation",
+                              "Deployment"):
+                        topics.setdefault(t, list(keys))
             broker = api.server.event_broker
             sub = broker.subscribe(topics=topics, index=index,
                                    namespace=namespace)

@@ -37,8 +37,12 @@ def _addr() -> str:
 def api(method: str, path: str, body=None):
     url = _addr() + path
     data = json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"}
+    token = os.environ.get("NOMAD_TOKEN", "")
+    if token:
+        headers["X-Nomad-Token"] = token
     req = urllib.request.Request(url, data=data, method=method,
-                                 headers={"Content-Type": "application/json"})
+                                 headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=35) as resp:
             return json.loads(resp.read() or "null")
@@ -69,7 +73,8 @@ def cmd_agent(args) -> None:
     from .agent import Agent, AgentConfig
     cfg = AgentConfig(dev_mode=args.dev, http_port=args.port,
                       data_dir=args.data_dir or "",
-                      num_workers=args.workers)
+                      num_workers=args.workers,
+                      acl_enabled=getattr(args, "acl_enabled", False))
     agent = Agent(cfg, logger=lambda m: print(f"    {m}", flush=True))
     agent.start()
     mode = []
@@ -351,6 +356,85 @@ def cmd_system_gc(args) -> None:
     print("==> GC triggered")
 
 
+def cmd_acl_bootstrap(args) -> None:
+    tok = api("POST", "/v1/acl/bootstrap")
+    print(f"Accessor ID  = {tok['AccessorID']}")
+    print(f"Secret ID    = {tok['SecretID']}")
+    print(f"Name         = {tok['Name']}")
+    print(f"Type         = {tok['Type']}")
+
+
+def cmd_acl_policy_apply(args) -> None:
+    with open(args.rules_file) as f:
+        rules = f.read()
+    api("PUT", f"/v1/acl/policy/{args.name}",
+        {"Description": args.description or "", "Rules": rules})
+    print(f"Successfully wrote ACL policy {args.name!r}")
+
+
+def cmd_acl_policy_list(args) -> None:
+    pols = api("GET", "/v1/acl/policies")
+    if not pols:
+        print("No policies")
+        return
+    _table([[p["Name"], p["Description"]] for p in pols],
+           ["Name", "Description"])
+
+
+def cmd_acl_policy_delete(args) -> None:
+    api("DELETE", f"/v1/acl/policy/{args.name}")
+    print(f"Successfully deleted ACL policy {args.name!r}")
+
+
+def cmd_acl_token_create(args) -> None:
+    tok = api("PUT", "/v1/acl/token", {
+        "Name": args.name or "",
+        "Type": args.type,
+        "Policies": args.policy or [],
+        "Global": bool(args.global_)})
+    print(f"Accessor ID  = {tok['AccessorID']}")
+    print(f"Secret ID    = {tok['SecretID']}")
+    print(f"Type         = {tok['Type']}")
+    print(f"Policies     = {tok['Policies']}")
+
+
+def cmd_acl_token_list(args) -> None:
+    toks = api("GET", "/v1/acl/tokens")
+    _table([[t["AccessorID"][:8], t["Name"], t["Type"],
+             ",".join(t["Policies"])] for t in toks],
+           ["Accessor", "Name", "Type", "Policies"])
+
+
+def cmd_acl_token_delete(args) -> None:
+    api("DELETE", f"/v1/acl/token/{args.accessor_id}")
+    print("Token deleted")
+
+
+def cmd_acl_token_self(args) -> None:
+    tok = api("GET", "/v1/acl/token/self")
+    print(f"Accessor ID  = {tok['AccessorID']}")
+    print(f"Name         = {tok['Name']}")
+    print(f"Type         = {tok['Type']}")
+    print(f"Policies     = {tok['Policies']}")
+
+
+def cmd_namespace_apply(args) -> None:
+    api("PUT", f"/v1/namespace/{args.name}",
+        {"Name": args.name, "Description": args.description or ""})
+    print(f"Successfully applied namespace {args.name!r}")
+
+
+def cmd_namespace_list(args) -> None:
+    nss = api("GET", "/v1/namespaces")
+    _table([[n["Name"], n["Description"]] for n in nss],
+           ["Name", "Description"])
+
+
+def cmd_namespace_delete(args) -> None:
+    api("DELETE", f"/v1/namespace/{args.name}")
+    print(f"Successfully deleted namespace {args.name!r}")
+
+
 def cmd_server_members(args) -> None:
     m = api("GET", "/v1/agent/members")
     _table([[x["Name"], x["Status"]] for x in m["Members"]],
@@ -373,6 +457,7 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-port", type=int, default=4646)
     ag.add_argument("-data-dir", dest="data_dir", default="")
     ag.add_argument("-workers", type=int, default=2)
+    ag.add_argument("-acl-enabled", dest="acl_enabled", action="store_true")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job")
@@ -441,6 +526,50 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["list", "status", "promote", "fail"])
     dep.add_argument("id", nargs="?", default="")
     dep.set_defaults(fn=cmd_deployment)
+
+    aclp = sub.add_parser("acl")
+    aclsub = aclp.add_subparsers(dest="acl_cmd", required=True)
+    ab = aclsub.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl_bootstrap)
+    apol = aclsub.add_parser("policy")
+    apolsub = apol.add_subparsers(dest="policy_cmd", required=True)
+    apa = apolsub.add_parser("apply")
+    apa.add_argument("name")
+    apa.add_argument("rules_file")
+    apa.add_argument("-description", default="")
+    apa.set_defaults(fn=cmd_acl_policy_apply)
+    apl = apolsub.add_parser("list")
+    apl.set_defaults(fn=cmd_acl_policy_list)
+    apd = apolsub.add_parser("delete")
+    apd.add_argument("name")
+    apd.set_defaults(fn=cmd_acl_policy_delete)
+    atok = aclsub.add_parser("token")
+    atoksub = atok.add_subparsers(dest="token_cmd", required=True)
+    atc = atoksub.add_parser("create")
+    atc.add_argument("-name", default="")
+    atc.add_argument("-type", default="client")
+    atc.add_argument("-policy", action="append")
+    atc.add_argument("-global", dest="global_", action="store_true")
+    atc.set_defaults(fn=cmd_acl_token_create)
+    atl = atoksub.add_parser("list")
+    atl.set_defaults(fn=cmd_acl_token_list)
+    atd = atoksub.add_parser("delete")
+    atd.add_argument("accessor_id")
+    atd.set_defaults(fn=cmd_acl_token_delete)
+    ats = atoksub.add_parser("self")
+    ats.set_defaults(fn=cmd_acl_token_self)
+
+    nsp = sub.add_parser("namespace")
+    nssub = nsp.add_subparsers(dest="ns_cmd", required=True)
+    nsa = nssub.add_parser("apply")
+    nsa.add_argument("name")
+    nsa.add_argument("-description", default="")
+    nsa.set_defaults(fn=cmd_namespace_apply)
+    nsl = nssub.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace_list)
+    nsd = nssub.add_parser("delete")
+    nsd.add_argument("name")
+    nsd.set_defaults(fn=cmd_namespace_delete)
 
     op = sub.add_parser("operator")
     osub = op.add_subparsers(dest="op_cmd", required=True)

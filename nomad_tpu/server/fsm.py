@@ -44,6 +44,13 @@ SCHEDULER_CONFIG = "SchedulerConfigRequestType"
 PERIODIC_LAUNCH = "PeriodicLaunchRequestType"
 BATCH_NODE_UPDATE_DRAIN = "BatchNodeUpdateDrainRequestType"
 DEPLOYMENT_DELETE = "DeploymentDeleteRequestType"
+ACL_POLICY_UPSERT = "ACLPolicyUpsertRequestType"
+ACL_POLICY_DELETE = "ACLPolicyDeleteRequestType"
+ACL_TOKEN_UPSERT = "ACLTokenUpsertRequestType"
+ACL_TOKEN_DELETE = "ACLTokenDeleteRequestType"
+ACL_TOKEN_BOOTSTRAP = "ACLTokenBootstrapRequestType"
+NAMESPACE_UPSERT = "NamespaceUpsertRequestType"
+NAMESPACE_DELETE = "NamespaceDeleteRequestType"
 
 
 @dataclasses.dataclass
@@ -148,6 +155,18 @@ class NomadFSM:
         elif msg_type == PERIODIC_LAUNCH:
             s.upsert_periodic_launch(index, payload["namespace"],
                                      payload["job_id"], payload["launch"])
+        elif msg_type == ACL_POLICY_UPSERT:
+            s.upsert_acl_policies(index, payload["policies"])
+        elif msg_type == ACL_POLICY_DELETE:
+            s.delete_acl_policies(index, payload["names"])
+        elif msg_type in (ACL_TOKEN_UPSERT, ACL_TOKEN_BOOTSTRAP):
+            s.upsert_acl_tokens(index, payload["tokens"])
+        elif msg_type == ACL_TOKEN_DELETE:
+            s.delete_acl_tokens(index, payload["accessor_ids"])
+        elif msg_type == NAMESPACE_UPSERT:
+            s.upsert_namespaces(index, payload["namespaces"])
+        elif msg_type == NAMESPACE_DELETE:
+            s.delete_namespaces(index, payload["names"])
         else:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
@@ -173,6 +192,8 @@ class NomadFSM:
                 "periodic_launches": s.periodic_launches,
                 "scheduler_config": s.scheduler_config,
                 "namespaces": s.namespaces,
+                "acl_policies": s.acl_policies,
+                "acl_tokens": s.acl_tokens,
             }
             return pickle.dumps(blob)
 
@@ -193,6 +214,10 @@ class NomadFSM:
             s.periodic_launches = dict(blob["periodic_launches"])
             s.scheduler_config = blob["scheduler_config"]
             s.namespaces = dict(blob["namespaces"])
+            s.acl_policies = dict(blob.get("acl_policies", {}))
+            s.acl_tokens = dict(blob.get("acl_tokens", {}))
+            s._acl_token_by_secret = {
+                t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes
             s._allocs_by_node.clear()
             s._allocs_by_job.clear()

@@ -49,7 +49,7 @@ SCHEDULER_TYPES = [JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM,
 
 class Server:
     def __init__(self, num_workers: int = 2, logger: Optional[Callable] = None,
-                 gc_interval: float = 300.0):
+                 gc_interval: float = 300.0, acl_enabled: bool = False):
         self.logger = logger or (lambda msg: None)
         self.fsm = NomadFSM()
         self.state: StateStore = self.fsm.state
@@ -59,6 +59,8 @@ class Server:
         self.event_broker = EventBroker()
         self.state.event_sinks.append(self.event_broker.sink)
         self.blocked_evals = BlockedEvals(self._enqueue_unblocked)
+        from .acl_endpoint import ACLEndpoint
+        self.acl = ACLEndpoint(self, enabled=acl_enabled)
         self.planner = Planner(self.raft, self.state)
         self.periodic = PeriodicDispatch(self)
         self.heartbeats = HeartbeatTimers(self)
@@ -215,6 +217,21 @@ class Server:
         if cfg.reject_job_registration:
             return "job registration is disabled"
         return ""
+
+    def namespace_upsert(self, namespaces: list[dict]) -> int:
+        from .fsm import NAMESPACE_UPSERT
+        return self.raft.apply(NAMESPACE_UPSERT, {"namespaces": namespaces})
+
+    def namespace_delete(self, names: list[str]) -> int:
+        from .fsm import NAMESPACE_DELETE
+        # validate BEFORE the log apply: a raising FSM apply would burn a
+        # log index and diverge across replicas
+        for name in names:
+            if name == "default":
+                raise ValueError("default namespace cannot be deleted")
+            if any(j.namespace == name for j in self.state.iter_jobs(name)):
+                raise ValueError(f"namespace {name!r} has registered jobs")
+        return self.raft.apply(NAMESPACE_DELETE, {"names": names})
 
     def job_plan(self, job: Job, diff: bool = True) -> dict:
         """Dry-run scheduler pass over a forked state (ref

@@ -50,6 +50,9 @@ class StateStore:
         self.periodic_launches: dict[tuple[str, str], dict] = {}
         self.scheduler_config: SchedulerConfiguration = SchedulerConfiguration()
         self.namespaces: dict[str, dict] = {"default": {"name": "default"}}
+        self.acl_policies: dict[str, object] = {}          # name -> ACLPolicy
+        self.acl_tokens: dict[str, object] = {}            # accessor -> token
+        self._acl_token_by_secret: dict[str, str] = {}     # secret -> accessor
 
         # secondary indexes
         self._allocs_by_node: dict[str, set[str]] = {}
@@ -109,6 +112,9 @@ class StateStore:
             out.allocs = dict(self.allocs)
             out.deployments = dict(self.deployments)
             out.periodic_launches = dict(self.periodic_launches)
+            out.acl_policies = dict(self.acl_policies)
+            out.acl_tokens = dict(self.acl_tokens)
+            out._acl_token_by_secret = dict(self._acl_token_by_secret)
             out.scheduler_config = self.scheduler_config
             out.namespaces = dict(self.namespaces)
             out._allocs_by_node = {k: set(v)
@@ -807,6 +813,98 @@ class StateStore:
     def get_scheduler_config(self) -> SchedulerConfiguration:
         with self._lock:
             return self.scheduler_config
+
+    # ------------------------------------------------------------------ ACL
+    # ref nomad/state/state_store.go ACL tables (acl_policy, acl_token)
+
+    def upsert_acl_policies(self, index: int, policies: list) -> None:
+        with self._lock:
+            idx = self._bump("acl_policy", index)
+            for pol in policies:
+                pol = pol.copy()
+                existing = self.acl_policies.get(pol.name)
+                pol.create_index = existing.create_index if existing else idx
+                pol.modify_index = idx
+                self.acl_policies[pol.name] = pol
+            self._commit()
+
+    def delete_acl_policies(self, index: int, names: list[str]) -> None:
+        with self._lock:
+            self._bump("acl_policy", index)
+            for name in names:
+                self.acl_policies.pop(name, None)
+            self._commit()
+
+    def acl_policy_by_name(self, name: str):
+        with self._lock:
+            return self.acl_policies.get(name)
+
+    def iter_acl_policies(self) -> list:
+        with self._lock:
+            return sorted(self.acl_policies.values(), key=lambda p: p.name)
+
+    def upsert_acl_tokens(self, index: int, tokens: list) -> None:
+        with self._lock:
+            idx = self._bump("acl_token", index)
+            for tok in tokens:
+                tok = tok.copy()
+                existing = self.acl_tokens.get(tok.accessor_id)
+                tok.create_index = (existing.create_index if existing
+                                    else idx)
+                tok.modify_index = idx
+                if existing and existing.secret_id != tok.secret_id:
+                    self._acl_token_by_secret.pop(existing.secret_id, None)
+                self.acl_tokens[tok.accessor_id] = tok
+                self._acl_token_by_secret[tok.secret_id] = tok.accessor_id
+            self._commit()
+
+    def delete_acl_tokens(self, index: int, accessor_ids: list[str]) -> None:
+        with self._lock:
+            self._bump("acl_token", index)
+            for aid in accessor_ids:
+                tok = self.acl_tokens.pop(aid, None)
+                if tok is not None:
+                    self._acl_token_by_secret.pop(tok.secret_id, None)
+            self._commit()
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        with self._lock:
+            return self.acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        with self._lock:
+            aid = self._acl_token_by_secret.get(secret_id)
+            return self.acl_tokens.get(aid) if aid else None
+
+    def iter_acl_tokens(self) -> list:
+        with self._lock:
+            return sorted(self.acl_tokens.values(),
+                          key=lambda t: t.create_index)
+
+    # ------------------------------------------------------------ namespaces
+
+    def upsert_namespaces(self, index: int, namespaces: list[dict]) -> None:
+        with self._lock:
+            self._bump("namespaces", index)
+            for ns in namespaces:
+                self.namespaces[ns["name"]] = dict(ns)
+            self._commit()
+
+    def delete_namespaces(self, index: int, names: list[str]) -> None:
+        with self._lock:
+            self._bump("namespaces", index)
+            for name in names:
+                if name != "default":   # request validation lives in Server
+                    self.namespaces.pop(name, None)
+            self._commit()
+
+    def namespace_by_name(self, name: str) -> Optional[dict]:
+        with self._lock:
+            return self.namespaces.get(name)
+
+    def iter_namespaces(self) -> list[dict]:
+        with self._lock:
+            return sorted(self.namespaces.values(), key=lambda n: n["name"])
 
 
 class StateSnapshot:

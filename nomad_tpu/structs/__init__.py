@@ -74,3 +74,7 @@ from .operator import (  # noqa: F401
     SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU,
     VALID_SCHEDULER_ALGORITHMS,
 )
+from .acl_structs import (  # noqa: F401
+    ACLPolicy, ACLToken, TOKEN_TYPE_CLIENT, TOKEN_TYPE_MANAGEMENT,
+    anonymous_token,
+)

@@ -1,0 +1,215 @@
+"""ACL tests (modeled on acl/acl_test.go, acl/policy_test.go, and
+nomad/acl_endpoint_test.go behavioral coverage)."""
+import pytest
+
+from nomad_tpu.acl import (
+    ACL, PolicyParseError, parse_acl, parse_policy,
+    NS_DENY, NS_LIST_JOBS, NS_READ_JOB, NS_SUBMIT_JOB,
+)
+
+
+READ_POLICY = '''
+namespace "default" {
+  policy = "read"
+}
+node { policy = "read" }
+'''
+
+WRITE_POLICY = '''
+namespace "default" {
+  policy = "write"
+}
+namespace "prod-*" {
+  policy       = "read"
+  capabilities = ["scale-job"]
+}
+node     { policy = "write" }
+operator { policy = "write" }
+agent    { policy = "read" }
+'''
+
+
+def test_parse_policy_read():
+    pol = parse_policy(READ_POLICY)
+    assert pol.namespaces[0].name == "default"
+    assert NS_READ_JOB in pol.namespaces[0].capabilities
+    assert NS_SUBMIT_JOB not in pol.namespaces[0].capabilities
+    assert pol.node == "read"
+
+
+def test_parse_policy_invalid():
+    with pytest.raises(PolicyParseError):
+        parse_policy('namespace "x" { policy = "banana" }')
+    with pytest.raises(PolicyParseError):
+        parse_policy('namespace "x" { capabilities = ["nope"] }')
+    with pytest.raises(PolicyParseError):
+        parse_policy('widget { policy = "read" }')
+
+
+def test_acl_checks():
+    acl = parse_acl([READ_POLICY])
+    assert acl.allow_namespace_operation("default", NS_READ_JOB)
+    assert acl.allow_namespace_operation("default", NS_LIST_JOBS)
+    assert not acl.allow_namespace_operation("default", NS_SUBMIT_JOB)
+    assert not acl.allow_namespace_operation("other", NS_READ_JOB)
+    assert acl.allow_node_read()
+    assert not acl.allow_node_write()
+    assert not acl.allow_operator_read()
+
+
+def test_acl_merge_broader_wins():
+    acl = parse_acl([READ_POLICY, WRITE_POLICY])
+    assert acl.allow_namespace_operation("default", NS_SUBMIT_JOB)
+    assert acl.allow_node_write()
+    assert acl.allow_agent_read() and not acl.allow_agent_write()
+
+
+def test_acl_deny_wins():
+    deny = 'namespace "default" { policy = "deny" }\nnode { policy = "deny" }'
+    acl = parse_acl([WRITE_POLICY, deny])
+    assert not acl.allow_namespace_operation("default", NS_READ_JOB)
+    assert not acl.allow_node_read()
+
+
+def test_glob_namespace_most_specific():
+    pol = '''
+    namespace "*" { policy = "read" }
+    namespace "prod-*" { policy = "deny" }
+    namespace "prod-api" { policy = "write" }
+    '''
+    acl = parse_acl([pol])
+    assert acl.allow_namespace_operation("dev", NS_READ_JOB)
+    assert not acl.allow_namespace_operation("prod-web", NS_READ_JOB)
+    assert acl.allow_namespace_operation("prod-api", NS_SUBMIT_JOB)
+
+
+def test_management_allows_everything():
+    acl = ACL(management=True)
+    assert acl.allow_namespace_operation("anything", NS_SUBMIT_JOB)
+    assert acl.allow_operator_write()
+    assert acl.is_management()
+
+
+def test_host_volume_policy():
+    pol = 'host_volume "ssd-*" { policy = "write" }'
+    acl = parse_acl([pol])
+    assert acl.allow_host_volume_operation("ssd-1", "mount-readwrite")
+    assert not acl.allow_host_volume_operation("hdd-1", "mount-readonly")
+
+
+# --------------------------------------------------------- server + HTTP
+
+@pytest.fixture(scope="module")
+def acl_agent():
+    from nomad_tpu.agent import Agent, AgentConfig
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=1,
+                          acl_enabled=True))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def _call(agent, method, path, body=None, token=""):
+    import json as _json
+    import urllib.request
+    import urllib.error
+    url = agent.http_addr + path
+    data = _json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["X-Nomad-Token"] = token
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, _json.loads(resp.read() or "null")
+    except urllib.error.HTTPError as e:
+        return e.code, _json.loads(e.read() or "{}")
+
+
+def test_acl_bootstrap_and_enforcement(acl_agent):
+    # anonymous requests are denied when ACLs are on
+    code, _ = _call(acl_agent, "GET", "/v1/jobs")
+    assert code == 403
+    # bootstrap produces a management token; second bootstrap fails
+    code, boot = _call(acl_agent, "POST", "/v1/acl/bootstrap")
+    assert code == 200 and boot["Type"] == "management"
+    root = boot["SecretID"]
+    acl_agent._test_root_token = root   # for later tests in this module
+    code, _ = _call(acl_agent, "POST", "/v1/acl/bootstrap")
+    assert code == 403
+    # management token can list jobs
+    code, jobs = _call(acl_agent, "GET", "/v1/jobs", token=root)
+    assert code == 200
+
+    # create a read-only policy + client token
+    code, _ = _call(acl_agent, "PUT", "/v1/acl/policy/readonly",
+                    {"Rules": READ_POLICY}, token=root)
+    assert code == 200
+    code, tok = _call(acl_agent, "PUT", "/v1/acl/token",
+                      {"Name": "ro", "Type": "client",
+                       "Policies": ["readonly"]}, token=root)
+    assert code == 200
+    ro = tok["SecretID"]
+
+    # read-only token: list ok, submit denied, node read ok, drain denied
+    code, _ = _call(acl_agent, "GET", "/v1/jobs", token=ro)
+    assert code == 200
+    from nomad_tpu import mock
+    from nomad_tpu.api_codec import to_api
+    job = mock.job()
+    code, _ = _call(acl_agent, "PUT", "/v1/jobs", {"Job": to_api(job)},
+                    token=ro)
+    assert code == 403
+    code, _ = _call(acl_agent, "GET", "/v1/nodes", token=ro)
+    assert code == 200
+    code, _ = _call(acl_agent, "GET", "/v1/operator/scheduler/configuration",
+                    token=ro)
+    assert code == 403
+    # bogus token 403s
+    code, _ = _call(acl_agent, "GET", "/v1/jobs", token="bogus-secret")
+    assert code == 403
+    # token self
+    code, me = _call(acl_agent, "GET", "/v1/acl/token/self", token=ro)
+    assert code == 200 and me["Name"] == "ro"
+    # management can submit
+    code, _ = _call(acl_agent, "PUT", "/v1/jobs", {"Job": to_api(job)},
+                    token=root)
+    assert code == 200
+
+
+def test_namespace_crud(acl_agent):
+    root = acl_agent._test_root_token
+    # anonymous token listing denied
+    code, _ = _call(acl_agent, "GET", "/v1/acl/tokens")
+    assert code == 403
+    code, toks = _call(acl_agent, "GET", "/v1/acl/tokens", token=root)
+    assert code == 200 and len(toks) >= 2
+    # namespace CRUD requires management
+    code, _ = _call(acl_agent, "PUT", "/v1/namespace/team-a",
+                    {"Description": "team A"})
+    assert code == 403
+    code, _ = _call(acl_agent, "PUT", "/v1/namespace/team-a",
+                    {"Description": "team A"}, token=root)
+    assert code == 200
+    code, nss = _call(acl_agent, "GET", "/v1/namespaces", token=root)
+    assert code == 200 and any(n["Name"] == "team-a" for n in nss)
+    code, _ = _call(acl_agent, "DELETE", "/v1/namespace/default", token=root)
+    assert code == 400   # default not deletable
+    code, _ = _call(acl_agent, "DELETE", "/v1/namespace/team-a", token=root)
+    assert code == 200
+
+
+def test_acl_snapshot_restore_roundtrip(acl_agent):
+    """ACL tables survive FSM snapshot/restore (checkpoint/resume)."""
+    from nomad_tpu.server.fsm import NomadFSM
+    blob = acl_agent.server.fsm.snapshot_bytes()
+    fresh = NomadFSM()
+    fresh.restore_bytes(blob)
+    toks = fresh.state.iter_acl_tokens()
+    assert any(t.type == "management" for t in toks)
+    pol = fresh.state.acl_policy_by_name("readonly")
+    assert pol is not None and "namespace" in pol.rules
+    # secret index rebuilt
+    root = acl_agent._test_root_token
+    assert fresh.state.acl_token_by_secret(root) is not None
