@@ -111,7 +111,7 @@ class ACL:
             return False
         if write:
             return disp == POLICY_WRITE
-        return disp in (POLICY_READ, POLICY_WRITE, POLICY_LIST)
+        return disp in (POLICY_READ, POLICY_WRITE)
 
     def allow_node_read(self) -> bool:
         return self._coarse_allows(self.node, write=False)
@@ -141,7 +141,12 @@ class ACL:
         return self._coarse_allows(self.plugin, write=False)
 
     def allow_plugin_list(self) -> bool:
-        return self._coarse_allows(self.plugin, write=False)
+        # list is a plugin-only disposition weaker than read
+        # (ref acl/acl.go AllowPluginList)
+        if self.management:
+            return True
+        return self.plugin == POLICY_LIST or \
+            self._coarse_allows(self.plugin, write=False)
 
     def is_management(self) -> bool:
         return self.management

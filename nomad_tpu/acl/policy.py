@@ -173,8 +173,17 @@ def parse_policy(src: str) -> Policy:
                 name=name, policy=a.get("policy", ""),
                 capabilities=list(a.get("capabilities", []) or []))
             if hv.policy:
+                if hv.policy not in _COARSE:
+                    raise PolicyParseError(
+                        f"invalid host_volume policy {hv.policy!r}")
                 hv.capabilities = list(dict.fromkeys(
                     expand_host_volume_policy(hv.policy) + hv.capabilities))
+            bad = set(hv.capabilities) - {HOST_VOLUME_MOUNT_READONLY,
+                                          HOST_VOLUME_MOUNT_READWRITE,
+                                          HOST_VOLUME_DENY}
+            if bad:
+                raise PolicyParseError(
+                    f"invalid host_volume capabilities {sorted(bad)}")
             pol.host_volumes.append(hv)
         elif blk.type in ("agent", "node", "operator", "quota", "plugin"):
             disp = a.get("policy", "")

@@ -20,6 +20,9 @@ class AgentConfig:
     data_dir: str = ""
     bind_addr: str = "127.0.0.1"
     http_port: int = 4646
+    rpc_port: int = -1          # -1 = no network RPC (-dev default); 0 = any
+    servers: tuple = ()         # client-only mode: server "host:port" list
+    encrypt_key: str = ""       # cluster RPC/gossip key (HMAC)
     server_enabled: bool = True
     client_enabled: bool = True
     num_workers: int = 2
@@ -28,6 +31,10 @@ class AgentConfig:
     node_name: str = ""
     dev_mode: bool = False
     acl_enabled: bool = False
+
+    def key_bytes(self) -> bytes:
+        from ..rpc.server import DEFAULT_KEY
+        return self.encrypt_key.encode() if self.encrypt_key else DEFAULT_KEY
 
 
 class Agent:
@@ -41,16 +48,23 @@ class Agent:
         self.http = None
         self._http_thread: Optional[threading.Thread] = None
 
+        self._server_rpc = None
         if self.config.server_enabled:
             self.server = Server(num_workers=self.config.num_workers,
                                  logger=self.logger,
                                  acl_enabled=self.config.acl_enabled)
         if self.config.client_enabled:
-            if self.server is None:
-                raise ValueError("client-only agents need a server address "
-                                 "(remote RPC arrives with the network layer)")
+            if self.server is not None:
+                rpc = self.server       # in-process fast path (-dev)
+            elif self.config.servers:
+                from ..rpc import ServerRpc
+                self._server_rpc = ServerRpc(list(self.config.servers),
+                                             key=self.config.key_bytes())
+                rpc = self._server_rpc
+            else:
+                raise ValueError("client-only agents need config.servers")
             self.client = Client(
-                self.server,
+                rpc,
                 data_dir=os.path.join(self.config.data_dir, "client"),
                 datacenter=self.config.datacenter,
                 node_class=self.config.node_class,
@@ -61,6 +75,10 @@ class Agent:
     def start(self) -> None:
         if self.server is not None:
             self.server.start()
+            if self.config.rpc_port >= 0:
+                self.server.rpc_listen(self.config.bind_addr,
+                                       self.config.rpc_port,
+                                       key=self.config.key_bytes())
         if self.client is not None:
             self.client.start()
         self.http = make_http_server(self.api, self.config.bind_addr,
@@ -76,6 +94,8 @@ class Agent:
             self.http.shutdown()
         if self.client is not None:
             self.client.shutdown()
+        if self._server_rpc is not None:
+            self._server_rpc.close()
         if self.server is not None:
             self.server.shutdown()
 

@@ -24,6 +24,12 @@ class HTTPError(Exception):
         self.message = message
 
 
+def require(ok: bool) -> None:
+    """403 unless the ACL check passed (shared by all route families)."""
+    if not ok:
+        raise HTTPError(403, "Permission denied")
+
+
 class HTTPAPI:
     """Route table + handlers; transport-agnostic (used by the HTTP server
     and directly by tests)."""
@@ -37,6 +43,11 @@ class HTTPAPI:
     def handle(self, method: str, path: str, query: dict,
                body: Optional[dict], token: str = ""):
         s = self.server
+        if s is None:
+            # client-only agents serve no server-backed routes yet (the
+            # reference proxies these RPCs to its servers; our CLI/SDK talk
+            # to a server agent's HTTP address directly)
+            raise HTTPError(501, "agent is not running a server")
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":
             raise HTTPError(404, "not found")
@@ -54,10 +65,6 @@ class HTTPAPI:
             acl = s.acl.resolve_token(token)
         except TokenNotFoundError:
             raise HTTPError(403, "ACL token not found")
-
-        def require(ok: bool) -> None:
-            if not ok:
-                raise HTTPError(403, "Permission denied")
 
         # ---- ACL management endpoints
         if parts and parts[0] == "acl":
@@ -297,8 +304,19 @@ class HTTPAPI:
             return [to_api(d) for d in s.deployment_list(ns)], \
                 s.state.table_index("deployment")
         if parts and parts[0] == "deployment" and len(parts) >= 2:
+            # authorize against the deployment's OWN namespace, not the
+            # caller-supplied query namespace (ref nomad/deployment_endpoint.go
+            # resolves the deployment first, then checks its .Namespace)
+            dep_id = parts[2] if parts[1] in ("promote", "fail", "pause") \
+                and len(parts) > 2 else \
+                (body.get("DeploymentID") if parts[1] == "promote"
+                 else parts[1])
+            dep = s.state.deployment_by_id(dep_id) if dep_id else None
+            if dep is None:
+                raise HTTPError(404, "deployment not found")
             require(acl.allow_namespace_operation(
-                ns, NS_READ_JOB if method == "GET" else NS_SUBMIT_JOB))
+                dep.namespace,
+                NS_READ_JOB if method == "GET" else NS_SUBMIT_JOB))
             if parts[1] == "promote" and method in ("PUT", "POST"):
                 try:
                     return s.deployment_promote(
@@ -372,10 +390,6 @@ class HTTPAPI:
         )
         from ..structs import ACLPolicy, ACLToken
         s = self.server
-
-        def require(ok: bool) -> None:
-            if not ok:
-                raise HTTPError(403, "Permission denied")
 
         try:
             if parts == ["bootstrap"] and method in ("PUT", "POST"):

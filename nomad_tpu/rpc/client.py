@@ -1,0 +1,173 @@
+"""RPC client: pooled connections with server failover and leader redirect
+(ref helper/pool/pool.go ConnPool, client/servers/manager.go server registry,
+client/rpc.go RPC retry/failover).
+"""
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from typing import Optional
+
+from .codec import NotLeaderError, RpcError, recv_msg, send_msg
+from .server import DEFAULT_KEY
+
+
+class RpcClient:
+    """Thread-safe RPC caller over a set of candidate server addresses.
+
+    A connection is checked out per call (pooled afterwards); on connection
+    failure the next server is tried (ref client/servers/manager.go
+    rebalancing is simplified to shuffle-on-failure). A NotLeaderError
+    response carrying a leader address triggers one transparent retry
+    against that leader.
+    """
+
+    def __init__(self, servers: list[str], key: bytes = DEFAULT_KEY,
+                 timeout: float = 30.0):
+        if not servers:
+            raise ValueError("RpcClient needs at least one server address")
+        self.key = key
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._servers = list(servers)
+        self._pool: dict[str, list[socket.socket]] = {}
+        self._seq = 0
+
+    # ------------------------------------------------------------- servers
+    def set_servers(self, servers: list[str]) -> None:
+        with self._lock:
+            self._servers = list(servers)
+
+    def servers(self) -> list[str]:
+        with self._lock:
+            return list(self._servers)
+
+    # ----------------------------------------------------------- transport
+    def _connect(self, addr: str) -> socket.socket:
+        host, _, port = addr.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=self.timeout)
+        sock.settimeout(self.timeout)
+        return sock
+
+    def _checkout(self, addr: str) -> socket.socket:
+        with self._lock:
+            conns = self._pool.get(addr)
+            if conns:
+                return conns.pop()
+        return self._connect(addr)
+
+    def _checkin(self, addr: str, sock: socket.socket) -> None:
+        with self._lock:
+            self._pool.setdefault(addr, []).append(sock)
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _call_addr(self, addr: str, method: str, args, kwargs,
+                   sock_timeout: Optional[float] = None):
+        sock = self._checkout(addr)
+        try:
+            sock.settimeout(sock_timeout or self.timeout)
+            seq = self._next_seq()
+            send_msg(sock, {"seq": seq, "method": method, "args": args,
+                            "kwargs": kwargs}, self.key)
+            resp = recv_msg(sock, self.key)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._checkin(addr, sock)
+        if resp.get("kind") == "NotLeaderError":
+            raise NotLeaderError(resp.get("error") or "")
+        if "error" in resp and resp["error"] is not None and "result" not in resp:
+            raise RpcError(resp["error"], kind=resp.get("kind", "RpcError"))
+        return resp.get("result")
+
+    # ---------------------------------------------------------------- call
+    def call(self, method: str, *args, **kwargs):
+        return self.call_timeout(None, method, *args, **kwargs)
+
+    def call_timeout(self, sock_timeout: Optional[float], method: str,
+                     *args, **kwargs):
+        """Like call(); sock_timeout overrides the per-connection socket
+        timeout for this call (long-polls must out-wait the server hold)."""
+        last_err: Optional[Exception] = None
+        # deterministic preference for the first configured server keeps
+        # -dev single-server behavior snappy; the shuffled remainder is the
+        # failover order (dedup'd so a dead first server costs one timeout)
+        first = self.servers()[:1]
+        rest = [a for a in self.servers() if a not in first]
+        random.shuffle(rest)
+        for addr in first + rest:
+            try:
+                return self._call_addr(addr, method, args, kwargs,
+                                       sock_timeout=sock_timeout)
+            except NotLeaderError as e:
+                if e.leader_addr and e.leader_addr != addr:
+                    try:
+                        return self._call_addr(e.leader_addr, method, args,
+                                               kwargs,
+                                               sock_timeout=sock_timeout)
+                    except (ConnectionError, OSError, TimeoutError) as e2:
+                        last_err = e2
+                        continue
+                last_err = e
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last_err = e
+        raise last_err if last_err else RpcError("no servers available")
+
+    def close(self) -> None:
+        with self._lock:
+            for conns in self._pool.values():
+                for sock in conns:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._pool.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ServerRpc:
+    """The client node's view of the control plane over the network — the
+    same duck-typed surface Client uses in-process (ref client/rpc.go: the
+    client RPCs Node.Register / Node.UpdateStatus / Node.GetClientAllocs /
+    Alloc.GetAlloc / Node.UpdateAlloc through its server list)."""
+
+    def __init__(self, servers: list[str], key: bytes = DEFAULT_KEY,
+                 timeout: float = 30.0):
+        self.rpc = RpcClient(servers, key=key, timeout=timeout)
+
+    def node_register(self, node):
+        return self.rpc.call("Node.Register", node)
+
+    def node_update_status(self, node_id: str, status: str):
+        return self.rpc.call("Node.UpdateStatus", node_id, status)
+
+    def node_get_client_allocs(self, node_id: str, min_index: int = 0,
+                               timeout: float = 30.0):
+        # long-poll: the server may hold the call up to `timeout`, so the
+        # socket deadline must strictly exceed the hold time
+        return self.rpc.call_timeout(timeout + 15.0, "Node.GetClientAllocs",
+                                     node_id, min_index=min_index,
+                                     timeout=timeout)
+
+    def alloc_get(self, alloc_id: str):
+        return self.rpc.call("Alloc.GetAlloc", alloc_id)
+
+    def node_update_allocs(self, allocs):
+        return self.rpc.call("Node.UpdateAlloc", allocs)
+
+    def close(self) -> None:
+        self.rpc.close()

@@ -1,0 +1,99 @@
+"""Wire codec: length-prefixed, HMAC-authenticated frames carrying
+restricted-pickle payloads (ref nomad/rpc.go msgpack codec; the reference
+trusts its wire via TLS + serf encrypt keys — here the shared cluster key
+authenticates every frame, and deserialization is allow-listed to framework
+modules so a hostile peer cannot instantiate arbitrary classes).
+
+Frame layout:  4-byte big-endian length | 32-byte HMAC-SHA256 | payload
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import io
+import pickle
+import pickletools  # noqa: F401  (kept importable for debugging frames)
+import socket
+import struct
+
+MAX_FRAME = 64 * 1024 * 1024      # 64 MiB: snapshots cross this transport
+_HDR = struct.Struct(">I")
+
+# modules whose classes may be reconstructed from the wire
+_ALLOWED_PREFIXES = ("nomad_tpu.",)
+_ALLOWED_EXACT = {
+    ("builtins", "set"), ("builtins", "frozenset"), ("builtins", "bytearray"),
+    ("builtins", "complex"), ("builtins", "bytes"),
+    ("collections", "OrderedDict"), ("collections", "defaultdict"),
+    ("collections", "deque"), ("datetime", "datetime"),
+    ("datetime", "timedelta"),
+}
+
+
+class FrameError(Exception):
+    """Malformed, oversized, or unauthenticated frame."""
+
+
+class RpcError(Exception):
+    """Remote handler raised; .kind carries the remote exception class name."""
+
+    def __init__(self, message: str, kind: str = "RpcError"):
+        super().__init__(message)
+        self.kind = kind
+
+
+class NotLeaderError(Exception):
+    """Write hit a follower (ref nomad/rpc.go forward). .leader_addr may
+    name the current leader's rpc address ("host:port") or be empty."""
+
+    def __init__(self, leader_addr: str = ""):
+        super().__init__(f"node is not the leader (leader={leader_addr or '?'})")
+        self.leader_addr = leader_addr
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if (module, name) in _ALLOWED_EXACT or \
+                any(module.startswith(p) for p in _ALLOWED_PREFIXES):
+            return super().find_class(module, name)
+        raise FrameError(f"disallowed wire type {module}.{name}")
+
+
+def encode(obj) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def _mac(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def send_msg(sock: socket.socket, obj, key: bytes) -> None:
+    payload = encode(obj)
+    if len(payload) > MAX_FRAME:
+        raise FrameError(f"frame too large ({len(payload)} bytes)")
+    sock.sendall(_HDR.pack(len(payload)) + _mac(key, payload) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket, key: bytes):
+    (length,) = _HDR.unpack(_recv_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise FrameError(f"frame too large ({length} bytes)")
+    mac = _recv_exact(sock, 32)
+    payload = _recv_exact(sock, length)
+    if not hmac.compare_digest(mac, _mac(key, payload)):
+        raise FrameError("frame failed HMAC authentication")
+    return decode(payload)

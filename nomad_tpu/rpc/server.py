@@ -1,0 +1,124 @@
+"""RPC server: threaded TCP listener dispatching named methods to registered
+handlers, with transparent leader forwarding for leader-only methods (ref
+nomad/rpc.go:341 handleConn / :450 forward, nomad/server.go:1146
+setupRpcServer).
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Callable, Optional
+
+from .codec import (FrameError, NotLeaderError, RpcError, recv_msg, send_msg)
+
+DEFAULT_KEY = b"nomad-tpu-dev-cluster-key"
+
+
+class RpcServer:
+    """One per agent process. Handlers are registered as
+    ``register("Node.Register", fn, leader_only=True)``; leader-only calls
+    arriving on a follower are proxied to the current leader (server-side
+    forwarding, matching the reference) when ``leader_addr_fn`` names one.
+    """
+
+    def __init__(self, bind: str = "127.0.0.1", port: int = 0,
+                 key: bytes = DEFAULT_KEY, logger=None):
+        self.key = key
+        self.logger = logger or (lambda msg: None)
+        self._handlers: dict[str, tuple[Callable, bool]] = {}
+        # wired by the consensus layer: () -> (is_leader, leader_rpc_addr)
+        self.leadership_fn: Callable[[], tuple[bool, str]] = lambda: (True, "")
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock: socket.socket = self.request
+                sock.settimeout(None)
+                try:
+                    while True:
+                        try:
+                            req = recv_msg(sock, outer.key)
+                        except (ConnectionError, OSError):
+                            return
+                        except FrameError as e:
+                            outer.logger(f"rpc: bad frame: {e}")
+                            return
+                        resp = outer._dispatch(req)
+                        try:
+                            send_msg(sock, resp, outer.key)
+                        except (ConnectionError, OSError):
+                            return
+                except Exception as e:   # noqa: BLE001
+                    outer.logger(f"rpc: connection error: {e!r}")
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._tcp = _Server((bind, port), _Handler)
+        self.addr = "%s:%d" % self._tcp.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ registry
+    def register(self, method: str, fn: Callable,
+                 leader_only: bool = False) -> None:
+        self._handlers[method] = (fn, leader_only)
+
+    def register_endpoints(self, obj, spec: dict[str, tuple[str, bool]]) -> None:
+        """spec: {"Node.Register": ("node_register", leader_only), ...}"""
+        for method, (attr, leader_only) in spec.items():
+            self.register(method, getattr(obj, attr), leader_only=leader_only)
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, req) -> dict:
+        if not isinstance(req, dict) or "method" not in req:
+            return {"seq": None, "error": "malformed request",
+                    "kind": "FrameError"}
+        seq = req.get("seq")
+        method = req["method"]
+        entry = self._handlers.get(method)
+        if entry is None:
+            return {"seq": seq, "error": f"unknown rpc method {method!r}",
+                    "kind": "RpcError"}
+        fn, leader_only = entry
+        if leader_only:
+            is_leader, leader_addr = self.leadership_fn()
+            if not is_leader:
+                fwd = self._forward(method, req, leader_addr)
+                if fwd is not None:
+                    fwd["seq"] = seq
+                    return fwd
+                return {"seq": seq, "error": leader_addr,
+                        "kind": "NotLeaderError"}
+        try:
+            result = fn(*req.get("args", ()), **req.get("kwargs", {}))
+            return {"seq": seq, "result": result}
+        except NotLeaderError as e:
+            return {"seq": seq, "error": e.leader_addr, "kind": "NotLeaderError"}
+        except Exception as e:   # noqa: BLE001
+            return {"seq": seq, "error": str(e), "kind": type(e).__name__}
+
+    def _forward(self, method: str, req, leader_addr: str) -> Optional[dict]:
+        """Proxy a leader-only call to the leader (ref nomad/rpc.go:450)."""
+        if not leader_addr or leader_addr == self.addr:
+            return None
+        from .client import RpcClient
+        try:
+            with RpcClient([leader_addr], key=self.key) as cli:
+                return {"result": cli.call(method, *req.get("args", ()),
+                                           **req.get("kwargs", {}))}
+        except NotLeaderError as e:
+            return {"error": e.leader_addr, "kind": "NotLeaderError"}
+        except Exception as e:   # noqa: BLE001
+            return {"error": f"leader forward failed: {e}", "kind": "RpcError"}
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="rpc-server")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()

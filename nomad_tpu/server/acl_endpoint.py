@@ -115,9 +115,18 @@ class ACLEndpoint:
             self.server.raft.apply(ACL_TOKEN_BOOTSTRAP, {"tokens": [token]})
         return token
 
+    def _require_enabled(self) -> None:
+        """All ACL CRUD is rejected while ACLs are off (ref
+        nomad/acl_endpoint.go: every method starts with aclDisabled check)
+        — otherwise anonymous callers could persist tokens that later
+        poison bootstrap."""
+        if not self.enabled:
+            raise ACLDisabledError("ACL support disabled")
+
     # -------------------------------------------------------------- policy
 
     def upsert_policies(self, policies: list[ACLPolicy]) -> int:
+        self._require_enabled()
         for pol in policies:
             if not pol.name:
                 raise ValueError("policy name required")
@@ -129,11 +138,13 @@ class ACLEndpoint:
                                       {"policies": policies})
 
     def delete_policies(self, names: list[str]) -> int:
+        self._require_enabled()
         return self.server.raft.apply(ACL_POLICY_DELETE, {"names": names})
 
     # -------------------------------------------------------------- tokens
 
     def upsert_tokens(self, tokens: list[ACLToken]) -> list[ACLToken]:
+        self._require_enabled()
         out = []
         for tok in tokens:
             if tok.type not in (TOKEN_TYPE_CLIENT, TOKEN_TYPE_MANAGEMENT):
@@ -162,5 +173,6 @@ class ACLEndpoint:
         return out
 
     def delete_tokens(self, accessor_ids: list[str]) -> int:
+        self._require_enabled()
         return self.server.raft.apply(ACL_TOKEN_DELETE,
                                       {"accessor_ids": accessor_ids})

@@ -46,6 +46,37 @@ from .worker import Worker
 SCHEDULER_TYPES = [JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM,
                    JOB_TYPE_SYSBATCH, JOB_TYPE_CORE]
 
+# network RPC surface (ref nomad/server.go:1146 setupRpcServer):
+# method name -> (Server attr, leader_only). Writes go through Raft and are
+# leader-only; reads run on any server against its replicated state.
+RPC_ENDPOINTS = {
+    "Node.Register": ("node_register", True),
+    "Node.UpdateStatus": ("node_update_status", True),
+    "Node.UpdateDrain": ("node_update_drain", True),
+    "Node.UpdateEligibility": ("node_update_eligibility", True),
+    "Node.GetClientAllocs": ("node_get_client_allocs", False),
+    "Node.UpdateAlloc": ("node_update_allocs", True),
+    "Alloc.GetAlloc": ("alloc_get", False),
+    "Alloc.Stop": ("alloc_stop", True),
+    "Job.Register": ("job_register", True),
+    "Job.Deregister": ("job_deregister", True),
+    "Job.Plan": ("job_plan", True),
+    "Job.Dispatch": ("job_dispatch", True),
+    "Eval.Dequeue": ("eval_dequeue", True),
+    "Eval.Ack": ("eval_ack", True),
+    "Eval.Nack": ("eval_nack", True),
+    "Deployment.List": ("deployment_list", False),
+    "Deployment.Promote": ("deployment_promote", True),
+    "Deployment.Fail": ("deployment_fail", True),
+    "Deployment.Pause": ("deployment_pause", True),
+    "Operator.SchedulerGetConfiguration": ("get_scheduler_configuration",
+                                           False),
+    "Operator.SchedulerSetConfiguration": ("set_scheduler_configuration",
+                                           True),
+    "Operator.SnapshotSave": ("snapshot_save", False),
+    "Operator.SnapshotRestore": ("snapshot_restore", True),
+}
+
 
 class Server:
     def __init__(self, num_workers: int = 2, logger: Optional[Callable] = None,
@@ -73,6 +104,10 @@ class Server:
         self._leader_stop = threading.Event()
         self._leader_thread: Optional[threading.Thread] = None
         self.is_leader = False
+        # network RPC (optional; wired by rpc_listen). leader_rpc_addr is
+        # maintained by the consensus layer for follower->leader forwarding.
+        self.rpc_server = None
+        self.leader_rpc_addr = ""
 
         # the FSM tells the leader about new evals (ref fsm.go:760)
         self.fsm.on_eval_update.append(self._on_eval_update)
@@ -84,7 +119,27 @@ class Server:
         for w in self.workers:
             w.start()
 
+    def rpc_listen(self, bind: str = "127.0.0.1", port: int = 0,
+                   key: bytes = None) -> str:
+        """Start serving the network RPC surface (ref nomad/rpc.go
+        listen/handleConn). Returns the bound "host:port" address."""
+        from ..rpc.server import DEFAULT_KEY, RpcServer
+        self.rpc_server = RpcServer(bind=bind, port=port,
+                                    key=key or DEFAULT_KEY,
+                                    logger=self.logger)
+        self.rpc_server.register_endpoints(self, RPC_ENDPOINTS)
+        self.rpc_server.leadership_fn = \
+            lambda: (self.is_leader, self.leader_rpc_addr)
+        self.rpc_server.start()
+        return self.rpc_server.addr
+
+    @property
+    def rpc_addr(self) -> str:
+        return self.rpc_server.addr if self.rpc_server is not None else ""
+
     def shutdown(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.shutdown()
         self._leader_stop.set()
         for w in self.workers:
             w.stop()

@@ -1,0 +1,150 @@
+"""Network RPC tests: transport framing/auth, server dispatch, failover, and
+an end-to-end remote client agent running a job over TCP (ref
+nomad/rpc_test.go + client/rpc.go behaviors)."""
+import socket
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.rpc import (FrameError, NotLeaderError, RpcClient, RpcError,
+                           RpcServer, recv_msg, send_msg)
+from nomad_tpu.rpc.server import DEFAULT_KEY
+from nomad_tpu.structs import ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_RUNNING
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+# --------------------------------------------------------------- transport
+
+def test_frame_roundtrip_and_hmac_rejection():
+    srv = RpcServer(port=0)
+    srv.register("Echo.Echo", lambda x: {"got": x})
+    srv.start()
+    try:
+        with RpcClient([srv.addr]) as cli:
+            assert cli.call("Echo.Echo", [1, "two", {"three": 3}]) == {
+                "got": [1, "two", {"three": 3}]}
+        # wrong key: the server must drop the frame, not answer
+        bad = RpcClient([srv.addr], key=b"wrong-key", timeout=0.5)
+        with pytest.raises((ConnectionError, OSError, TimeoutError)):
+            bad.call("Echo.Echo", 1)
+        bad.close()
+    finally:
+        srv.shutdown()
+
+
+def test_restricted_unpickler_blocks_arbitrary_types():
+    host_sock, peer_sock = socket.socketpair()
+    try:
+        send_msg(host_sock, {"method": "X", "args": (compile,)}, DEFAULT_KEY)
+    except Exception:
+        pass  # pickling builtins.compile itself is fine; decoding must fail
+    else:
+        with pytest.raises(FrameError):
+            recv_msg(peer_sock, DEFAULT_KEY)
+    host_sock.close()
+    peer_sock.close()
+
+
+def test_remote_error_propagates_kind():
+    srv = RpcServer(port=0)
+
+    def boom():
+        raise KeyError("nope")
+
+    srv.register("Boom.Boom", boom)
+    srv.start()
+    try:
+        with RpcClient([srv.addr]) as cli:
+            with pytest.raises(RpcError) as exc:
+                cli.call("Boom.Boom")
+            assert exc.value.kind == "KeyError"
+    finally:
+        srv.shutdown()
+
+
+def test_failover_to_live_server():
+    srv = RpcServer(port=0)
+    srv.register("Status.Ping", lambda: "pong")
+    srv.start()
+    try:
+        # first server is a dead address; client must fail over
+        with RpcClient(["127.0.0.1:1", srv.addr], timeout=1.0) as cli:
+            assert cli.call("Status.Ping") == "pong"
+    finally:
+        srv.shutdown()
+
+
+def test_not_leader_redirect():
+    leader = RpcServer(port=0)
+    leader.register("Job.Register", lambda j: {"ok": True, "who": "leader"})
+    leader.start()
+    follower = RpcServer(port=0)
+    follower.register("Job.Register", lambda j: {"ok": True, "who": "f"})
+    follower.start()
+    # follower reports leader's address; dispatch forwards server-side
+    follower.leadership_fn = lambda: (False, leader.addr)
+    follower._handlers["Job.Register"] = (
+        follower._handlers["Job.Register"][0], True)
+    try:
+        with RpcClient([follower.addr]) as cli:
+            assert cli.call("Job.Register", {})["who"] == "leader"
+    finally:
+        leader.shutdown()
+        follower.shutdown()
+
+
+# ------------------------------------------------------------- end-to-end
+
+def test_remote_client_agent_runs_job(tmp_path):
+    """A server agent and a client-only agent talk over real TCP; a mock
+    job is placed on the remote node and completes."""
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    server_agent = Agent(AgentConfig(
+        data_dir=str(tmp_path / "server"), http_port=0, rpc_port=0,
+        client_enabled=False))
+    server_agent.start()
+    try:
+        rpc_addr = server_agent.server.rpc_addr
+        assert rpc_addr
+        client_agent = Agent(AgentConfig(
+            data_dir=str(tmp_path / "client"), http_port=0,
+            server_enabled=False, servers=(rpc_addr,),
+            node_name="remote-node"))
+        client_agent.start()
+        try:
+            state = server_agent.server.state
+            node_id = client_agent.client.node.id
+            assert wait_until(lambda: state.node_by_id(node_id) is not None
+                              and state.node_by_id(node_id).ready())
+
+            job = mock.batch_job()
+            job.type = "batch"
+            tg = job.task_groups[0]
+            task = tg.tasks[0]
+            task.driver = "mock_driver"
+            task.config = {"run_for": 0.2}
+            task.resources.networks = []
+            server_agent.server.job_register(job)
+
+            def done():
+                allocs = state.allocs_by_job("default", job.id)
+                return allocs and all(
+                    a.client_status == ALLOC_CLIENT_COMPLETE for a in allocs)
+            assert wait_until(done, timeout=20.0)
+            # the alloc really ran on the remote node
+            assert all(a.node_id == node_id
+                       for a in state.allocs_by_job("default", job.id))
+        finally:
+            client_agent.shutdown()
+    finally:
+        server_agent.shutdown()
