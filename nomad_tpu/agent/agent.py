@@ -74,6 +74,14 @@ class Agent:
 
     def start(self) -> None:
         if self.server is not None:
+            if self.config.rpc_port >= 0 and self.config.acl_enabled and \
+                    not self.config.encrypt_key:
+                # the RPC surface trusts the HMAC key as its auth boundary
+                # (like the reference trusts TLS+gossip keys); a public
+                # default key + ACLs would let anyone bypass every token
+                # check by speaking RPC directly
+                raise ValueError(
+                    "acl_enabled with network RPC requires encrypt_key")
             self.server.start()
             if self.config.rpc_port >= 0:
                 self.server.rpc_listen(self.config.bind_addr,

@@ -331,14 +331,12 @@ class HTTPAPI:
                method in ("PUT", "POST"):
                 return s.deployment_pause(
                     parts[2], bool(body.get("Pause", True))), None
-            d = s.state.deployment_by_id(parts[1])
-            if d is None:
-                raise HTTPError(404, "deployment not found")
+            # dep (resolved for the auth check above) is the target here
             if parts[2:] == ["allocations"]:
                 allocs = [a for a in s.state.iter_allocs()
                           if a.deployment_id == parts[1]]
                 return [self._alloc_stub(a) for a in allocs], None
-            return to_api(d), s.state.table_index("deployment")
+            return to_api(dep), s.state.table_index("deployment")
 
         # ---- operator
         if parts == ["operator", "scheduler", "configuration"]:

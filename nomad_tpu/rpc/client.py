@@ -69,19 +69,29 @@ class RpcClient:
 
     def _call_addr(self, addr: str, method: str, args, kwargs,
                    sock_timeout: Optional[float] = None):
-        sock = self._checkout(addr)
-        try:
-            sock.settimeout(sock_timeout or self.timeout)
-            seq = self._next_seq()
-            send_msg(sock, {"seq": seq, "method": method, "args": args,
-                            "kwargs": kwargs}, self.key)
-            resp = recv_msg(sock, self.key)
-        except BaseException:
+        resp = None
+        for attempt in (0, 1):
+            with self._lock:
+                pooled = bool(self._pool.get(addr))
+            sock = self._checkout(addr)
             try:
-                sock.close()
-            except OSError:
-                pass
-            raise
+                sock.settimeout(sock_timeout or self.timeout)
+                seq = self._next_seq()
+                send_msg(sock, {"seq": seq, "method": method, "args": args,
+                                "kwargs": kwargs}, self.key)
+                resp = recv_msg(sock, self.key)
+                break
+            except BaseException as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                # a stale pooled socket (server restarted / idle-closed)
+                # gets one retry on a fresh connection
+                if attempt == 0 and pooled and \
+                        isinstance(e, (ConnectionError, OSError)):
+                    continue
+                raise
         self._checkin(addr, sock)
         if resp.get("kind") == "NotLeaderError":
             raise NotLeaderError(resp.get("error") or "")
@@ -114,10 +124,24 @@ class RpcClient:
                         return self._call_addr(e.leader_addr, method, args,
                                                kwargs,
                                                sock_timeout=sock_timeout)
+                    except RpcError as e2:
+                        if e2.kind != "RetryableError":
+                            raise
+                        last_err = e2
+                        continue
+                    except NotLeaderError as e2:
+                        # leadership moved again mid-call: keep trying the
+                        # remaining servers, which may know the new leader
+                        last_err = e2
+                        continue
                     except (ConnectionError, OSError, TimeoutError) as e2:
                         last_err = e2
                         continue
                 last_err = e
+            except RpcError as e:
+                if e.kind != "RetryableError":
+                    raise
+                last_err = e    # stale-leader forward: try the next server
             except (ConnectionError, OSError, TimeoutError) as e:
                 last_err = e
         raise last_err if last_err else RpcError("no servers available")

@@ -8,6 +8,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 from typing import Callable, Optional
 
 from .codec import (FrameError, NotLeaderError, RpcError, recv_msg, send_msg)
@@ -34,7 +35,9 @@ class RpcServer:
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock: socket.socket = self.request
-                sock.settimeout(None)
+                # idle/trickle connections may not pin a thread (and up to
+                # MAX_FRAME of pre-auth buffer) forever
+                sock.settimeout(300.0)
                 try:
                     while True:
                         try:
@@ -84,6 +87,16 @@ class RpcServer:
         fn, leader_only = entry
         if leader_only:
             is_leader, leader_addr = self.leadership_fn()
+            if not is_leader and not leader_addr:
+                # no known leader yet (mid-election): wait briefly for
+                # discovery instead of bouncing the caller
+                # (ref nomad/rpc.go:450 forward retries on ErrNoLeader)
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    time.sleep(0.05)
+                    is_leader, leader_addr = self.leadership_fn()
+                    if is_leader or leader_addr:
+                        break
             if not is_leader:
                 fwd = self._forward(method, req, leader_addr)
                 if fwd is not None:
@@ -111,7 +124,10 @@ class RpcServer:
         except NotLeaderError as e:
             return {"error": e.leader_addr, "kind": "NotLeaderError"}
         except Exception as e:   # noqa: BLE001
-            return {"error": f"leader forward failed: {e}", "kind": "RpcError"}
+            # RetryableError tells the caller to try another server — the
+            # advertised leader may have just died (stale leader_addr)
+            return {"error": f"leader forward failed: {e}",
+                    "kind": "RetryableError"}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:

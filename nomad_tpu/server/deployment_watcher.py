@@ -51,6 +51,11 @@ class DeploymentWatcher:
 
     def stop(self) -> None:
         self._stop.set()
+        # join so a quick leadership re-acquire can't clear the stop event
+        # before this loop observes it (would leak a second watcher)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     def _run(self) -> None:
         """ref deployments_watcher.go:164 watchDeployments"""

@@ -31,6 +31,10 @@ class NodeDrainer:
 
     def stop(self) -> None:
         self._stop.set()
+        # join: see deployment_watcher.stop (stop/start flap race)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     def track_node(self, node_id: str) -> None:
         """Hook for UpdateDrain; polling picks it up on the next tick."""

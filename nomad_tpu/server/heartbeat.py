@@ -41,6 +41,10 @@ class HeartbeatTimers:
 
     def stop(self) -> None:
         self._stop.set()
+        # join: see deployment_watcher.stop (stop/start flap race)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     def reset_heartbeat_timer(self, node_id: str) -> float:
         """Returns the TTL the client should heartbeat within

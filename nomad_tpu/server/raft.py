@@ -1,0 +1,614 @@
+"""Raft consensus (ref hashicorp/raft as wired in nomad/server.go:1221
+setupRaft; nomad/leader.go:56 monitorLeadership): leader election, log
+replication, and FSM snapshots over the framework's RPC transport.
+
+TPU-native design note (SURVEY.md §2.7): consensus is a DCN protocol between
+control-plane hosts — deliberately independent of the JAX/ICI compute path.
+The contract it keeps for the scheduler is the same as the single-node
+``RaftLog``: ``apply()`` returns only after the message is durably committed
+and visible in the local FSM at the returned index, and every replica applies
+the identical message sequence (replay determinism; the scheduler's
+snapshot-min-index barrier, nomad/worker.go:536, builds on this).
+
+Persistence (checkpoint/resume, SURVEY.md §5): term/vote in a small metadata
+file, log entries in an append-only frame file, FSM snapshots with log
+truncation — a restarted server restores snapshot + replays its log before
+rejoining (ref raft-boltdb + fsm.go Snapshot/Restore).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import struct
+import threading
+import time
+from typing import Callable, Optional
+
+from ..rpc.codec import NotLeaderError
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+_FRAME = struct.Struct(">I")
+
+
+class _Entry:
+    __slots__ = ("term", "type", "payload")
+
+    def __init__(self, term: int, type_: str, payload):
+        self.term = term
+        self.type = type_
+        self.payload = payload
+
+
+class RaftNode:
+    """One consensus participant. Peers are {server_id: rpc_addr}; the RPC
+    handlers are registered on the server's RpcServer so Raft traffic shares
+    the agent's single TCP listener (the reference multiplexes Raft on its
+    RPC port the same way, nomad/rpc.go:341)."""
+
+    def __init__(self, fsm, node_id: str, rpc_server, peers: dict[str, str],
+                 data_dir: Optional[str] = None, logger=None,
+                 election_timeout: tuple[float, float] = (0.4, 0.8),
+                 heartbeat_interval: float = 0.1,
+                 snapshot_threshold: int = 8192):
+        self.fsm = fsm
+        self.node_id = node_id
+        self.rpc_server = rpc_server
+        self.addr = rpc_server.addr
+        self.logger = logger or (lambda msg: None)
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.snapshot_threshold = snapshot_threshold
+        self.data_dir = data_dir
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+
+        self._lock = threading.RLock()
+        self._apply_cond = threading.Condition(self._lock)
+        self._commit_cond = threading.Condition(self._lock)
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: list[_Entry] = []      # log[i] has index base_index + i + 1
+        self.base_index = 0              # last index covered by the snapshot
+        self.base_term = 0
+        self.peers = dict(peers)         # id -> addr, includes self
+
+        # volatile state
+        self.state = FOLLOWER
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+        self.leader_addr = ""
+        self._last_contact = time.monotonic()
+        self._votes = 0
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._replicate_events: dict[str, threading.Event] = {}
+        # leadership observer (Server establish/revoke), called off-lock
+        self.on_leadership_change: Callable[[bool], None] = lambda lead: None
+
+        self._log_file = None
+        self._restore_from_disk()
+
+        rpc_server.register("Raft.RequestVote", self._rpc_request_vote)
+        rpc_server.register("Raft.AppendEntries", self._rpc_append_entries)
+        rpc_server.register("Raft.InstallSnapshot", self._rpc_install_snapshot)
+
+    # ------------------------------------------------------------ indexing
+
+    def _last_index(self) -> int:
+        return self.base_index + len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        if index == self.base_index:
+            return self.base_term
+        if index < self.base_index or index > self._last_index():
+            return -1
+        return self.log[index - self.base_index - 1].term
+
+    def _entry_at(self, index: int) -> _Entry:
+        return self.log[index - self.base_index - 1]
+
+    # --------------------------------------------------------- persistence
+
+    def _meta_path(self):
+        return os.path.join(self.data_dir, "raft_meta.pickle")
+
+    def _log_path(self):
+        return os.path.join(self.data_dir, "raft_log.bin")
+
+    def _snap_path(self):
+        return os.path.join(self.data_dir, "raft_snapshot.bin")
+
+    def _persist_meta(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"term": self.current_term, "voted_for": self.voted_for,
+                         "peers": self.peers}, f)
+        os.replace(tmp, self._meta_path())
+
+    def _append_to_disk(self, entries: list[_Entry]) -> None:
+        if not self.data_dir:
+            return
+        if self._log_file is None:
+            self._log_file = open(self._log_path(), "ab")
+        for e in entries:
+            blob = pickle.dumps((e.term, e.type, e.payload),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            self._log_file.write(_FRAME.pack(len(blob)) + blob)
+        self._log_file.flush()
+
+    def _rewrite_log_on_disk(self) -> None:
+        """After truncation/conflict resolution or snapshot compaction."""
+        if not self.data_dir:
+            return
+        if self._log_file is not None:
+            self._log_file.close()
+            self._log_file = None
+        tmp = self._log_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self.log:
+                blob = pickle.dumps((e.term, e.type, e.payload),
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(_FRAME.pack(len(blob)) + blob)
+        os.replace(tmp, self._log_path())
+
+    def _persist_snapshot(self, data: bytes) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"index": self.base_index, "term": self.base_term,
+                         "data": data, "peers": self.peers}, f)
+        os.replace(tmp, self._snap_path())
+
+    def _restore_from_disk(self) -> None:
+        if not self.data_dir:
+            return
+        if os.path.exists(self._snap_path()):
+            with open(self._snap_path(), "rb") as f:
+                snap = pickle.load(f)
+            self.fsm.restore_bytes(snap["data"])
+            self.base_index = snap["index"]
+            self.base_term = snap["term"]
+            self.peers.update(snap.get("peers", {}))
+            self.commit_index = self.last_applied = self.base_index
+        if os.path.exists(self._meta_path()):
+            with open(self._meta_path(), "rb") as f:
+                meta = pickle.load(f)
+            self.current_term = meta["term"]
+            self.voted_for = meta["voted_for"]
+            self.peers.update(meta.get("peers", {}))
+        if os.path.exists(self._log_path()):
+            with open(self._log_path(), "rb") as f:
+                raw = f.read()
+            off = 0
+            while off + 4 <= len(raw):
+                (ln,) = _FRAME.unpack_from(raw, off)
+                off += 4
+                if off + ln > len(raw):
+                    break       # torn tail write: drop it
+                term, type_, payload = pickle.loads(raw[off:off + ln])
+                self.log.append(_Entry(term, type_, payload))
+                off += ln
+            # committed-but-unapplied entries replay on the apply loop once
+            # commit advances; conservatively re-apply everything we have
+            # (FSM application is idempotent per replay determinism)
+            for i, e in enumerate(self.log):
+                idx = self.base_index + i + 1
+                if e.type != "_noop":
+                    self.fsm.apply(idx, e.type, e.payload)
+            self.commit_index = self.last_applied = self._last_index()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._stop.clear()
+        t = threading.Thread(target=self._run_elections, daemon=True,
+                             name=f"raft-elect-{self.node_id}")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._run_apply, daemon=True,
+                             name=f"raft-apply-{self.node_id}")
+        t.start()
+        self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._commit_cond.notify_all()
+            self._apply_cond.notify_all()
+            for ev in self._replicate_events.values():
+                ev.set()
+        if self._log_file is not None:
+            self._log_file.close()
+            self._log_file = None
+
+    # ------------------------------------------------------- public: apply
+
+    def apply(self, msg_type: str, payload, timeout: float = 30.0):
+        """Commit one message through the replicated log. Leader-only;
+        raises NotLeaderError with a redirect hint on followers."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_addr)
+            entry = _Entry(self.current_term, msg_type, payload)
+            self.log.append(entry)
+            index = self._last_index()
+            self._append_to_disk([entry])
+            self._match_index[self.node_id] = index
+            for ev in self._replicate_events.values():
+                ev.set()
+            if len(self.peers) == 1:
+                self._advance_commit_locked()
+            deadline = time.monotonic() + timeout
+            while self.last_applied < index and not self._stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"raft apply of {msg_type} timed out at index {index}")
+                if self.state != LEADER:
+                    raise NotLeaderError(self.leader_addr)
+                self._apply_cond.wait(min(remaining, 0.5))
+            # leadership lost mid-wait: a new leader may have overwritten
+            # our uncommitted entry at this index (hashicorp/raft returns
+            # ErrLeadershipLost for exactly this)
+            if index > self.base_index and \
+                    self._term_at(index) != entry.term:
+                raise NotLeaderError(self.leader_addr)
+            return index
+
+    def barrier(self) -> int:
+        with self._lock:
+            return self.last_applied
+
+    def snapshot(self) -> bytes:
+        return self.fsm.snapshot_bytes()
+
+    def restore(self, data: bytes) -> None:
+        """Operator-initiated restore (snapshot_restore endpoint)."""
+        self.fsm.restore_bytes(data)
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leadership(self) -> tuple[bool, str]:
+        with self._lock:
+            return self.state == LEADER, self.leader_addr
+
+    # ----------------------------------------------------------- elections
+
+    def _election_deadline(self) -> float:
+        lo, hi = self.election_timeout
+        return time.monotonic() + random.uniform(lo, hi)
+
+    def _run_elections(self) -> None:
+        deadline = self._election_deadline()
+        while not self._stop.is_set():
+            time.sleep(0.02)
+            with self._lock:
+                if self.state == LEADER:
+                    deadline = self._election_deadline()
+                    continue
+                if time.monotonic() < deadline:
+                    continue
+                # recent leader contact pushes the deadline instead of
+                # triggering an election
+                lo, _hi = self.election_timeout
+                if time.monotonic() - self._last_contact < lo:
+                    deadline = self._last_contact + \
+                        random.uniform(*self.election_timeout)
+                    continue
+                self.current_term += 1
+                self.voted_for = self.node_id
+                self._persist_meta()
+                self.state = CANDIDATE
+                self._votes = 1
+                term = self.current_term
+                last_idx = self._last_index()
+                last_term = self._term_at(last_idx)
+                peers = {pid: addr for pid, addr in self.peers.items()
+                         if pid != self.node_id}
+                deadline = self._election_deadline()
+            if not peers:
+                self._become_leader(term)
+                continue
+            for pid, addr in peers.items():
+                threading.Thread(
+                    target=self._request_vote_from, daemon=True,
+                    args=(pid, addr, term, last_idx, last_term)).start()
+
+    def _request_vote_from(self, pid, addr, term, last_idx, last_term):
+        from ..rpc.client import RpcClient
+        try:
+            with RpcClient([addr], key=self.rpc_server.key,
+                           timeout=1.0) as cli:
+                resp = cli.call("Raft.RequestVote", term, self.node_id,
+                                last_idx, last_term)
+        except Exception:    # noqa: BLE001
+            return
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._step_down_locked(resp["term"])
+                return
+            if self.state != CANDIDATE or term != self.current_term:
+                return
+            if resp["granted"]:
+                self._votes += 1
+                if self._votes * 2 > len(self.peers):
+                    # transition exactly once: later vote responses see
+                    # state != CANDIDATE and bail above
+                    self.state = LEADER
+                    threading.Thread(target=self._become_leader, daemon=True,
+                                     args=(term,)).start()
+
+    def _become_leader(self, term: int) -> None:
+        with self._lock:
+            if self.current_term != term:
+                return
+            self.state = LEADER     # idempotent for the self-elect path
+            self.leader_id = self.node_id
+            self.leader_addr = self.addr
+            nxt = self._last_index() + 1
+            self._next_index = {pid: nxt for pid in self.peers}
+            self._match_index = {pid: 0 for pid in self.peers}
+            self._match_index[self.node_id] = self._last_index()
+            # commit a no-op entry to finalize commitment of prior terms
+            # (Raft §8: a leader may only count replicas of current-term
+            # entries toward commit)
+            noop = _Entry(term, "_noop", {})
+            self.log.append(noop)
+            self._append_to_disk([noop])
+            self._match_index[self.node_id] = self._last_index()
+            peers = {pid: addr for pid, addr in self.peers.items()
+                     if pid != self.node_id}
+            if not peers:
+                self._advance_commit_locked()
+        self.logger(f"raft: {self.node_id} became leader (term {term})")
+        for pid in peers:
+            ev = threading.Event()
+            ev.set()
+            self._replicate_events[pid] = ev
+            t = threading.Thread(target=self._replicate_loop, daemon=True,
+                                 args=(pid, term), name=f"raft-repl-{pid}")
+            t.start()
+            self._threads.append(t)
+        self.on_leadership_change(True)
+
+    def _step_down_locked(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        if term > self.current_term:
+            # only a term bump may reset the vote (one vote per term)
+            self.current_term = term
+            self.voted_for = None
+        self.state = FOLLOWER
+        self._persist_meta()
+        if was_leader:
+            self.leader_id = None
+            self.leader_addr = ""
+            threading.Thread(target=self.on_leadership_change, daemon=True,
+                             args=(False,)).start()
+
+    # --------------------------------------------------------- replication
+
+    def _replicate_loop(self, pid: str, term: int) -> None:
+        from ..rpc.client import RpcClient
+        addr = self.peers.get(pid)
+        if addr is None:
+            return
+        cli = RpcClient([addr], key=self.rpc_server.key, timeout=2.0)
+        ev = self._replicate_events[pid]
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    if self.state != LEADER or self.current_term != term:
+                        return
+                ev.wait(self.heartbeat_interval)
+                ev.clear()
+                try:
+                    self._replicate_once(cli, pid, term)
+                except Exception:    # noqa: BLE001
+                    time.sleep(self.heartbeat_interval)
+        finally:
+            cli.close()
+
+    def _replicate_once(self, cli, pid: str, term: int) -> None:
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            nxt = self._next_index.get(pid, self._last_index() + 1)
+            if nxt <= self.base_index:
+                # follower is behind our snapshot horizon
+                snap = {"index": self.base_index, "term": self.base_term,
+                        "data": self.fsm.snapshot_bytes(),
+                        "peers": dict(self.peers)}
+                commit = self.commit_index
+            else:
+                snap = None
+                prev_idx = nxt - 1
+                prev_term = self._term_at(prev_idx)
+                entries = [(e.term, e.type, e.payload)
+                           for e in self.log[prev_idx - self.base_index:
+                                             prev_idx - self.base_index + 64]]
+                commit = self.commit_index
+        if snap is not None:
+            resp = cli.call("Raft.InstallSnapshot", term, self.node_id,
+                            self.addr, snap)
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._step_down_locked(resp["term"])
+                    return
+                self._next_index[pid] = snap["index"] + 1
+                self._match_index[pid] = snap["index"]
+            return
+        resp = cli.call("Raft.AppendEntries", term, self.node_id, self.addr,
+                        prev_idx, prev_term, entries, commit)
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._step_down_locked(resp["term"])
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if resp["success"]:
+                match = prev_idx + len(entries)
+                self._match_index[pid] = max(self._match_index.get(pid, 0),
+                                             match)
+                self._next_index[pid] = self._match_index[pid] + 1
+                self._advance_commit_locked()
+                if self._next_index[pid] <= self._last_index():
+                    self._replicate_events[pid].set()   # more to send
+            else:
+                # conflict: back up (follower hints its last index)
+                hint = resp.get("last_index")
+                self._next_index[pid] = max(
+                    1, min(nxt - 1, (hint + 1) if hint is not None else nxt - 1))
+                self._replicate_events[pid].set()
+
+    def _advance_commit_locked(self) -> None:
+        """Majority-match commit rule (current-term entries only)."""
+        matches = sorted(self._match_index.get(pid, 0) for pid in self.peers)
+        majority_idx = matches[(len(matches) - 1) // 2]
+        if majority_idx > self.commit_index and \
+                self._term_at(majority_idx) == self.current_term:
+            self.commit_index = majority_idx
+            self._commit_cond.notify_all()
+
+    # --------------------------------------------------------------- apply
+
+    def _run_apply(self) -> None:
+        """Dedicated applier: keeps FSM application strictly ordered."""
+        while not self._stop.is_set():
+            with self._lock:
+                while self.last_applied >= self.commit_index and \
+                        not self._stop.is_set():
+                    self._commit_cond.wait(0.5)
+                if self._stop.is_set():
+                    return
+                start = self.last_applied + 1
+                end = self.commit_index
+                batch = [(i, self._entry_at(i)) for i in range(start, end + 1)]
+            for idx, e in batch:
+                if e.type != "_noop":
+                    try:
+                        self.fsm.apply(idx, e.type, e.payload)
+                    except Exception as ex:   # noqa: BLE001
+                        self.logger(f"raft: fsm apply failed at {idx}: {ex!r}")
+            with self._lock:
+                self.last_applied = end
+                self._apply_cond.notify_all()
+                if len(self.log) >= self.snapshot_threshold:
+                    self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Snapshot the FSM and truncate the applied prefix of the log."""
+        snap_index = self.last_applied
+        if snap_index <= self.base_index:
+            return
+        data = self.fsm.snapshot_bytes()
+        keep_from = snap_index - self.base_index
+        self.base_term = self._term_at(snap_index)
+        self.log = self.log[keep_from:]
+        self.base_index = snap_index
+        self._persist_snapshot(data)
+        self._rewrite_log_on_disk()
+
+    # ------------------------------------------------------- RPC handlers
+
+    def _rpc_request_vote(self, term, candidate_id, last_idx, last_term):
+        with self._lock:
+            if term > self.current_term:
+                self._step_down_locked(term)
+            granted = False
+            if term == self.current_term and \
+                    self.voted_for in (None, candidate_id):
+                my_last = self._last_index()
+                my_term = self._term_at(my_last)
+                up_to_date = (last_term, last_idx) >= (my_term, my_last)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = candidate_id
+                    self._persist_meta()
+                    self._last_contact = time.monotonic()
+                    # the old leader is presumed dead: stop advertising it
+                    # for forwarding until the new leader heartbeats us
+                    self.leader_id = None
+                    self.leader_addr = ""
+            return {"term": self.current_term, "granted": granted}
+
+    def _rpc_append_entries(self, term, leader_id, leader_addr,
+                            prev_idx, prev_term, entries, leader_commit):
+        with self._lock:
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._step_down_locked(term)
+            self.leader_id = leader_id
+            self.leader_addr = leader_addr
+            self._last_contact = time.monotonic()
+
+            if prev_idx > self._last_index() or \
+                    (prev_idx >= self.base_index and
+                     self._term_at(prev_idx) != prev_term):
+                return {"term": self.current_term, "success": False,
+                        "last_index": min(self._last_index(), prev_idx - 1)}
+            if prev_idx < self.base_index:
+                # snapshot already covers part of this batch
+                skip = self.base_index - prev_idx
+                entries = entries[skip:]
+                prev_idx = self.base_index
+            # append, truncating conflicts; the common case is a pure
+            # append which hits the cheap append-only disk path
+            truncated = False
+            appended: list[_Entry] = []
+            for i, (eterm, etype, epayload) in enumerate(entries):
+                idx = prev_idx + i + 1
+                if idx <= self._last_index():
+                    if self._term_at(idx) != eterm:
+                        self.log = self.log[:idx - self.base_index - 1]
+                        truncated = True
+                    else:
+                        continue
+                e = _Entry(eterm, etype, epayload)
+                self.log.append(e)
+                appended.append(e)
+            if truncated:
+                self._rewrite_log_on_disk()
+            elif appended:
+                self._append_to_disk(appended)
+            if leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, self._last_index())
+                self._commit_cond.notify_all()
+            return {"term": self.current_term, "success": True}
+
+    def _rpc_install_snapshot(self, term, leader_id, leader_addr, snap):
+        with self._lock:
+            if term < self.current_term:
+                return {"term": self.current_term}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._step_down_locked(term)
+            self.leader_id = leader_id
+            self.leader_addr = leader_addr
+            self._last_contact = time.monotonic()
+            if snap["index"] <= self.base_index:
+                return {"term": self.current_term}
+            self.fsm.restore_bytes(snap["data"])
+            self.base_index = snap["index"]
+            self.base_term = snap["term"]
+            self.log = []
+            self.peers.update(snap.get("peers", {}))
+            self.commit_index = max(self.commit_index, snap["index"])
+            self.last_applied = snap["index"]
+            self._persist_snapshot(snap["data"])
+            self._rewrite_log_on_disk()
+            self._persist_meta()
+            return {"term": self.current_term}

@@ -108,6 +108,9 @@ class Server:
         # maintained by the consensus layer for follower->leader forwarding.
         self.rpc_server = None
         self.leader_rpc_addr = ""
+        # multi-server consensus (optional; wired by enable_raft). When set,
+        # leadership is election-driven instead of immediate-on-start.
+        self.raft_node = None
 
         # the FSM tells the leader about new evals (ref fsm.go:760)
         self.fsm.on_eval_update.append(self._on_eval_update)
@@ -115,9 +118,44 @@ class Server:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        self._establish_leadership()
+        if self.raft_node is None:
+            self._establish_leadership()
+        else:
+            self.raft_node.start()
         for w in self.workers:
             w.start()
+
+    def enable_raft(self, node_id: str, peers: dict[str, str],
+                    data_dir: str = None, **raft_kw) -> None:
+        """Switch from the single-node log to elected multi-server consensus
+        (ref nomad/server.go:1221 setupRaft + leader.go:56 monitorLeadership).
+        Must be called after rpc_listen() and before start()."""
+        if self.rpc_server is None:
+            raise RuntimeError("enable_raft requires rpc_listen() first")
+        from .raft import RaftNode
+        peers = dict(peers)
+        peers.setdefault(node_id, self.rpc_server.addr)
+        self.raft_node = RaftNode(self.fsm, node_id, self.rpc_server, peers,
+                                  data_dir=data_dir, logger=self.logger,
+                                  **raft_kw)
+        self.raft = self.raft_node
+        self.planner.raft = self.raft_node
+        self.raft_node.on_leadership_change = self._on_leadership_change
+        self.rpc_server.leadership_fn = self._raft_leadership
+
+    def _raft_leadership(self) -> tuple[bool, str]:
+        is_leader, leader_addr = self.raft_node.leadership()
+        self.leader_rpc_addr = leader_addr
+        return is_leader, leader_addr
+
+    def _on_leadership_change(self, is_leader: bool) -> None:
+        """ref nomad/leader.go:56 monitorLeadership"""
+        if is_leader:
+            self.logger("server: leadership acquired")
+            self._establish_leadership()
+        else:
+            self.logger("server: leadership lost")
+            self._revoke_leadership()
 
     def rpc_listen(self, bind: str = "127.0.0.1", port: int = 0,
                    key: bytes = None) -> str:
@@ -138,6 +176,8 @@ class Server:
         return self.rpc_server.addr if self.rpc_server is not None else ""
 
     def shutdown(self) -> None:
+        if self.raft_node is not None:
+            self.raft_node.shutdown()
         if self.rpc_server is not None:
             self.rpc_server.shutdown()
         self._leader_stop.set()
@@ -153,8 +193,30 @@ class Server:
         for w in self.workers:
             w.join(1.0)
 
+    def _revoke_leadership(self) -> None:
+        """ref nomad/leader.go revokeLeadership: disable every leader-only
+        subsystem; scheduling resumes wherever the new leader is."""
+        if not self.is_leader:
+            return
+        self.is_leader = False
+        self._leader_stop.set()
+        # join before a re-election can clear the stop event, else the old
+        # loop never observes it and two leader loops run after re-elect
+        if self._leader_thread is not None:
+            self._leader_thread.join(timeout=5.0)
+            self._leader_thread = None
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.planner.stop()
+        self.periodic.set_enabled(False)
+        self.heartbeats.stop()
+        self.deployment_watcher.stop()
+        self.drainer.stop()
+
     def _establish_leadership(self) -> None:
         """ref nomad/leader.go:224"""
+        if self.is_leader:
+            return
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.planner.start()

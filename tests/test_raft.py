@@ -1,0 +1,211 @@
+"""Multi-server consensus tests (modeled on nomad/server_test.go +
+nomad/leader_test.go: in-process servers on free ports, leader election,
+replication, failover, snapshot restore)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+
+# fast enough for quick tests, slack enough that GIL contention under a
+# full parallel suite can't starve heartbeats past the election timeout
+FAST = dict(election_timeout=(0.4, 0.8), heartbeat_interval=0.08)
+
+
+def wait_until(fn, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def make_cluster(n, tmp_path=None, snapshot_threshold=8192):
+    servers = []
+    for i in range(n):
+        s = Server(num_workers=1, gc_interval=9999)
+        s.rpc_listen()
+        servers.append(s)
+    peers = {f"s{i}": s.rpc_addr for i, s in enumerate(servers)}
+    for i, s in enumerate(servers):
+        s.enable_raft(
+            f"s{i}", peers,
+            data_dir=str(tmp_path / f"raft{i}") if tmp_path else None,
+            snapshot_threshold=snapshot_threshold, **FAST)
+        s.start()
+    return servers
+
+
+def leaders(servers):
+    return [s for s in servers if s.raft_node.is_leader()]
+
+
+def wait_stable_leader(servers, timeout=10.0):
+    """Wait until exactly one leader exists AND every live server agrees on
+    its address (rules out the brief double-leader window during converge)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        led = leaders(servers)
+        if len(led) == 1:
+            addr = led[0].rpc_addr
+            if all(s.raft_node.leadership()[1] == addr for s in servers):
+                return led[0]
+        time.sleep(0.02)
+    raise AssertionError("no stable leader")
+
+
+def shutdown_all(servers):
+    for s in servers:
+        s.shutdown()
+
+
+def test_three_server_cluster_elects_one_leader():
+    servers = make_cluster(3)
+    try:
+        assert wait_until(lambda: len(leaders(servers)) == 1, timeout=10)
+        # stability: converges back to exactly one leader and stays there
+        wait_stable_leader(servers)
+        time.sleep(0.3)
+        assert len(leaders(servers)) == 1
+    finally:
+        shutdown_all(servers)
+
+
+def test_write_replicates_to_all_servers():
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        job = mock.job()
+        leader.job_register(job)
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job.id) is not None
+            for s in servers))
+    finally:
+        shutdown_all(servers)
+
+
+def test_follower_write_is_forwarded_to_leader():
+    """A Job.Register RPC sent to a follower must land via the leader."""
+    from nomad_tpu.rpc import RpcClient
+    servers = make_cluster(3)
+    try:
+        wait_stable_leader(servers)
+        follower = next(s for s in servers if not s.raft_node.is_leader())
+        job = mock.job()
+        with RpcClient([follower.rpc_addr]) as cli:
+            resp = cli.call("Job.Register", job)
+        assert resp["index"] > 0
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job.id) is not None
+            for s in servers))
+    finally:
+        shutdown_all(servers)
+
+
+def test_leader_failover_preserves_state_and_liveness():
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        job = mock.job()
+        leader.job_register(job)
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job.id) is not None
+            for s in servers))
+
+        leader.shutdown()
+        rest = [s for s in servers if s is not leader]
+        assert wait_until(lambda: len(leaders(rest)) == 1, timeout=10)
+        new_leader = leaders(rest)[0]
+        # old state survived the failover
+        assert new_leader.state.job_by_id("default", job.id) is not None
+        # the new leader accepts writes
+        job2 = mock.job()
+        new_leader.job_register(job2)
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job2.id) is not None for s in rest))
+    finally:
+        shutdown_all(servers)
+
+
+def test_scheduling_works_under_raft():
+    """End to end on a 3-server cluster: node + job registered -> the
+    elected leader's workers place allocs, replicated everywhere."""
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        node = mock.node()
+        leader.node_register(node)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        leader.job_register(job)
+        assert wait_until(lambda: len(
+            leader.state.allocs_by_job("default", job.id)) == 2, timeout=15)
+        # replicas converge on the same placements
+        assert wait_until(lambda: all(
+            len(s.state.allocs_by_job("default", job.id)) == 2
+            for s in servers))
+    finally:
+        shutdown_all(servers)
+
+
+def test_restart_restores_from_disk(tmp_path):
+    """A server restarted with the same data_dir recovers term, log, and
+    FSM state (ref fsm.go Snapshot/Restore + raft-boltdb persistence)."""
+    s = Server(num_workers=1, gc_interval=9999)
+    s.rpc_listen()
+    s.enable_raft("s0", {"s0": s.rpc_addr},
+                  data_dir=str(tmp_path / "raft"), **FAST)
+    s.start()
+    try:
+        assert wait_until(lambda: s.raft_node.is_leader())
+        job = mock.job()
+        s.job_register(job)
+        assert s.state.job_by_id("default", job.id) is not None
+    finally:
+        s.shutdown()
+
+    s2 = Server(num_workers=1, gc_interval=9999)
+    s2.rpc_listen()
+    s2.enable_raft("s0", {"s0": s2.rpc_addr},
+                   data_dir=str(tmp_path / "raft"), **FAST)
+    s2.start()
+    try:
+        assert wait_until(lambda: s2.raft_node.is_leader())
+        assert s2.state.job_by_id("default", job.id) is not None
+    finally:
+        s2.shutdown()
+
+
+def test_log_compaction_snapshot(tmp_path):
+    """Crossing snapshot_threshold compacts the log; a restart restores
+    from the snapshot plus the truncated tail."""
+    s = Server(num_workers=1, gc_interval=9999)
+    s.rpc_listen()
+    s.enable_raft("s0", {"s0": s.rpc_addr},
+                  data_dir=str(tmp_path / "raft"), snapshot_threshold=20,
+                  **FAST)
+    s.start()
+    jobs = []
+    try:
+        assert wait_until(lambda: s.raft_node.is_leader())
+        for _ in range(30):
+            job = mock.job()
+            jobs.append(job)
+            s.job_register(job)
+        assert wait_until(lambda: s.raft_node.base_index > 0, timeout=5)
+    finally:
+        s.shutdown()
+
+    s2 = Server(num_workers=1, gc_interval=9999)
+    s2.rpc_listen()
+    s2.enable_raft("s0", {"s0": s2.rpc_addr},
+                   data_dir=str(tmp_path / "raft"), **FAST)
+    s2.start()
+    try:
+        assert wait_until(lambda: s2.raft_node.is_leader())
+        for job in jobs:
+            assert s2.state.job_by_id("default", job.id) is not None
+    finally:
+        s2.shutdown()
