@@ -26,6 +26,7 @@ class AgentConfig:
     server_enabled: bool = True
     client_enabled: bool = True
     num_workers: int = 2
+    region: str = "global"
     datacenter: str = "dc1"
     node_class: str = ""
     node_name: str = ""

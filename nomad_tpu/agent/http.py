@@ -134,7 +134,19 @@ class HTTPAPI:
                 raise HTTPError(404, "missing job id")
             job_id = urllib.parse.unquote(parts[1])
             rest = parts[2:]
-            if method == "GET":
+            from ..acl import (
+                NS_READ_JOB_SCALING, NS_SCALE_JOB,
+            )
+            if rest == ["scale"]:
+                if method == "GET":
+                    require(acl.allow_namespace_operation(
+                        ns, NS_READ_JOB_SCALING)
+                        or acl.allow_namespace_operation(ns, NS_READ_JOB))
+                else:
+                    require(acl.allow_namespace_operation(ns, NS_SCALE_JOB)
+                            or acl.allow_namespace_operation(
+                                ns, NS_SUBMIT_JOB))
+            elif method == "GET":
                 require(acl.allow_namespace_operation(ns, NS_READ_JOB))
             elif rest == ["dispatch"]:
                 require(acl.allow_namespace_operation(ns, NS_DISPATCH_JOB))
@@ -216,6 +228,47 @@ class HTTPAPI:
                     raise HTTPError(400, f"job {job_id!r} is not periodic")
                 child = s.periodic.force_launch(job)
                 return {"dispatched_job_id": child.id}, None
+            elif rest == ["scale"]:
+                if method == "GET":
+                    try:
+                        return to_api(s.job_scale_status(ns, job_id)), \
+                            s.state.table_index("scaling_event")
+                    except ValueError as e:
+                        raise HTTPError(404, str(e))
+                if method not in ("PUT", "POST"):
+                    raise HTTPError(405, "method not allowed")
+                target = body.get("Target", {}) or {}
+                count = body.get("Count")
+                if count is not None:
+                    try:
+                        count = int(count)
+                    except (TypeError, ValueError):
+                        raise HTTPError(400, "Count must be an integer")
+                try:
+                    return s.job_scale(
+                        ns, job_id, target.get("Group", ""),
+                        count=count,
+                        message=body.get("Message", ""),
+                        error=bool(body.get("Error", False)),
+                        meta=body.get("Meta"),
+                        policy_override=bool(
+                            body.get("PolicyOverride", False))), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+            elif rest == ["revert"] and method in ("PUT", "POST"):
+                try:
+                    return s.job_revert(
+                        ns, job_id, int(body.get("JobVersion", 0)),
+                        body.get("EnforcePriorVersion")), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+            elif rest == ["stable"] and method in ("PUT", "POST"):
+                try:
+                    return s.job_stable(
+                        ns, job_id, int(body.get("JobVersion", 0)),
+                        bool(body.get("Stable", False))), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
 
         # ---- evaluations
         if parts == ["evaluations"]:
@@ -353,6 +406,58 @@ class HTTPAPI:
                     raise HTTPError(400, str(e))
 
         # ---- misc
+        # ---- scaling policies (ref command/agent/scaling_endpoint.go)
+        if parts == ["scaling", "policies"]:
+            from ..acl import NS_LIST_SCALING_POLICIES
+            pols = [p for p in s.scaling_policies_list(
+                        None if ns == "*" else ns,
+                        query.get("job") or None,
+                        query.get("type") or None)
+                    if acl.allow_namespace_operation(
+                        p.target_key()[0], NS_LIST_SCALING_POLICIES)]
+            return [{"ID": p.id, "Enabled": p.enabled, "Type": p.type,
+                     "Target": dict(p.target),
+                     "CreateIndex": p.create_index,
+                     "ModifyIndex": p.modify_index} for p in pols], \
+                s.state.table_index("scaling_policy")
+        if parts[:2] == ["scaling", "policy"] and len(parts) == 3:
+            from ..acl import NS_READ_SCALING_POLICY
+            p = s.scaling_policy_get(parts[2])
+            if p is None:
+                raise HTTPError(404, "scaling policy not found")
+            require(acl.allow_namespace_operation(p.target_key()[0],
+                                                  NS_READ_SCALING_POLICY))
+            return to_api(p), s.state.table_index("scaling_policy")
+
+        # ---- jobspec utilities
+        if parts == ["jobs", "parse"] and method in ("PUT", "POST"):
+            from ..acl import NS_PARSE_JOB
+            require(acl.allow_namespace_operation(ns, NS_PARSE_JOB))
+            from ..jobspec import ParseError, parse as parse_jobspec
+            from ..jobspec.hcl import HCLError
+            try:
+                job = parse_jobspec(body.get("JobHCL", ""),
+                                    variables=body.get("Variables"))
+            except (ParseError, HCLError) as e:
+                raise HTTPError(400, str(e))
+            return to_api(job), None
+        if parts == ["validate", "job"] and method in ("PUT", "POST"):
+            job = from_api(Job, body.get("Job", body))
+            require(acl.allow_namespace_operation(
+                job.namespace or ns, NS_SUBMIT_JOB))
+            err = s._validate_job(job)
+            return {"DriverConfigValidated": True,
+                    "ValidationErrors": [err] if err else [],
+                    "Error": err, "Warnings": ""}, None
+
+        if parts == ["regions"]:
+            return [self.agent.config.region], None
+        if parts == ["status", "peers"]:
+            peers = getattr(s.raft, "peers", None)
+            if peers:
+                return sorted(peers.values()), None
+            return [s.rpc_addr() if s.rpc_server is not None
+                    else "127.0.0.1:4647"], None
         if parts == ["status", "leader"]:
             return "127.0.0.1:4647" if s.is_leader else "", None
         if parts == ["agent", "self"]:

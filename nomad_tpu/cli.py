@@ -252,6 +252,29 @@ def cmd_job_dispatch(args) -> None:
     print(f"==> Dispatched job {resp['dispatched_job_id']}")
 
 
+def cmd_job_scale(args) -> None:
+    """ref command/job_scale.go"""
+    resp = api("PUT", f"/v1/job/{args.job_id}/scale", {
+        "Target": {"Group": args.group}, "Count": int(args.count),
+        "Message": "scaled via CLI"})
+    print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
+
+
+def cmd_job_revert(args) -> None:
+    """ref command/job_revert.go"""
+    resp = api("PUT", f"/v1/job/{args.job_id}/revert",
+               {"JobVersion": int(args.version)})
+    print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
+
+
+def cmd_job_history(args) -> None:
+    """ref command/job_history.go"""
+    versions = api("GET", f"/v1/job/{args.job_id}/versions")
+    _table([[str(v["Version"]), "true" if v.get("Stable") else "false",
+             v["Status"]] for v in versions],
+           ["Version", "Stable", "Status"])
+
+
 # ------------------------------------------------------------------ nodes
 
 def cmd_node_status(args) -> None:
@@ -489,6 +512,18 @@ def build_parser() -> argparse.ArgumentParser:
     jd.add_argument("job_id")
     jd.add_argument("-meta", action="append")
     jd.set_defaults(fn=cmd_job_dispatch)
+    jsc = jsub.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group")
+    jsc.add_argument("count")
+    jsc.set_defaults(fn=cmd_job_scale)
+    jrv = jsub.add_parser("revert")
+    jrv.add_argument("job_id")
+    jrv.add_argument("version")
+    jrv.set_defaults(fn=cmd_job_revert)
+    jh = jsub.add_parser("history")
+    jh.add_argument("job_id")
+    jh.set_defaults(fn=cmd_job_history)
 
     node = sub.add_parser("node")
     nsub = node.add_subparsers(dest="node_cmd", required=True)

@@ -51,6 +51,8 @@ ACL_TOKEN_DELETE = "ACLTokenDeleteRequestType"
 ACL_TOKEN_BOOTSTRAP = "ACLTokenBootstrapRequestType"
 NAMESPACE_UPSERT = "NamespaceUpsertRequestType"
 NAMESPACE_DELETE = "NamespaceDeleteRequestType"
+SCALING_EVENT_REGISTER = "ScalingEventRegisterRequestType"
+JOB_STABILITY = "JobStabilityRequestType"
 
 
 @dataclasses.dataclass
@@ -167,6 +169,14 @@ class NomadFSM:
             s.upsert_namespaces(index, payload["namespaces"])
         elif msg_type == NAMESPACE_DELETE:
             s.delete_namespaces(index, payload["names"])
+        elif msg_type == SCALING_EVENT_REGISTER:
+            s.upsert_scaling_event(index, payload["namespace"],
+                                   payload["job_id"], payload["group"],
+                                   payload["event"])
+        elif msg_type == JOB_STABILITY:
+            s.update_job_stability(index, payload["namespace"],
+                                   payload["job_id"], payload["version"],
+                                   payload["stable"])
         else:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
@@ -194,6 +204,9 @@ class NomadFSM:
                 "namespaces": s.namespaces,
                 "acl_policies": s.acl_policies,
                 "acl_tokens": s.acl_tokens,
+                "scaling_policies": s.scaling_policies,
+                "scaling_policy_by_target": s._scaling_policy_by_target,
+                "scaling_events": s.scaling_events,
             }
             return pickle.dumps(blob)
 
@@ -216,6 +229,10 @@ class NomadFSM:
             s.namespaces = dict(blob["namespaces"])
             s.acl_policies = dict(blob.get("acl_policies", {}))
             s.acl_tokens = dict(blob.get("acl_tokens", {}))
+            s.scaling_policies = dict(blob.get("scaling_policies", {}))
+            s._scaling_policy_by_target = dict(
+                blob.get("scaling_policy_by_target", {}))
+            s.scaling_events = dict(blob.get("scaling_events", {}))
             s._acl_token_by_secret = {
                 t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes

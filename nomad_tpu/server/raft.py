@@ -206,7 +206,13 @@ class RaftNode:
             for i, e in enumerate(self.log):
                 idx = self.base_index + i + 1
                 if e.type != "_noop":
-                    self.fsm.apply(idx, e.type, e.payload)
+                    try:
+                        self.fsm.apply(idx, e.type, e.payload)
+                    except Exception as ex:   # noqa: BLE001
+                        # same contract as the runtime apply loop: a bad
+                        # entry must never brick restart/replay
+                        self.logger(
+                            f"raft: fsm replay failed at {idx}: {ex!r}")
             self.commit_index = self.last_applied = self._last_index()
 
     # ----------------------------------------------------------- lifecycle

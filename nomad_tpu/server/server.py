@@ -62,6 +62,12 @@ RPC_ENDPOINTS = {
     "Job.Deregister": ("job_deregister", True),
     "Job.Plan": ("job_plan", True),
     "Job.Dispatch": ("job_dispatch", True),
+    "Job.Scale": ("job_scale", True),
+    "Job.ScaleStatus": ("job_scale_status", False),
+    "Job.Revert": ("job_revert", True),
+    "Job.Stable": ("job_stable", True),
+    "Scaling.ListPolicies": ("scaling_policies_list", False),
+    "Scaling.GetPolicy": ("scaling_policy_get", False),
     "Eval.Dequeue": ("eval_dequeue", True),
     "Eval.Ack": ("eval_ack", True),
     "Eval.Nack": ("eval_nack", True),
@@ -433,6 +439,126 @@ class Server:
         index = self.raft.apply(JOB_REGISTER, {"job": child, "evals": [ev]})
         return {"dispatched_job_id": child.id, "eval_id": ev.id,
                 "index": index}
+
+    def job_scale(self, namespace: str, job_id: str, group: str,
+                  count: Optional[int] = None, message: str = "",
+                  error: bool = False, meta: Optional[dict] = None,
+                  policy_override: bool = False) -> dict:
+        """Scale a task group's count and record a scaling event (ref
+        nomad/job_endpoint.go Job.Scale). With count=None only the event is
+        recorded (autoscaler heartbeat/error reporting)."""
+        from .fsm import SCALING_EVENT_REGISTER
+        from ..structs.scaling import ScalingEvent
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(f"task group {group!r} not found in {job_id!r}")
+        prev_count = tg.count
+        eval_id = ""
+        index = 0
+        if count is not None:
+            if count < 0:
+                raise ValueError("scaling count must be >= 0")
+            if error:
+                raise ValueError("cannot scale and report an error at once")
+            pol = self.state.scaling_policy_by_target(namespace, job_id, group)
+            if pol is not None and not policy_override:
+                if count < pol.min:
+                    raise ValueError(
+                        f"group count was less than scaling policy minimum: "
+                        f"{count} < {pol.min}")
+                if pol.max and count > pol.max:
+                    raise ValueError(
+                        f"group count was greater than scaling policy "
+                        f"maximum: {count} > {pol.max}")
+            job = job.copy()
+            job.lookup_task_group(group).count = count
+            result = self.job_register(job)
+            eval_id, index = result["eval_id"], result["index"]
+        event = ScalingEvent(
+            time=time.time(), count=count, previous_count=prev_count,
+            message=message, error=error, meta=dict(meta or {}),
+            eval_id=eval_id)
+        ev_index = self.raft.apply(SCALING_EVENT_REGISTER, {
+            "namespace": namespace, "job_id": job_id, "group": group,
+            "event": event})
+        return {"eval_id": eval_id, "index": index or ev_index,
+                "eval_create_index": index}
+
+    def job_scale_status(self, namespace: str, job_id: str) -> dict:
+        """ref nomad/job_endpoint.go Job.ScaleStatus / structs.JobScaleStatus."""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        events = self.state.scaling_events_by_job(namespace, job_id)
+        groups = {}
+        allocs = self.state.allocs_by_job(namespace, job_id)
+        for tg in job.task_groups:
+            placed = running = healthy = unhealthy = 0
+            for a in allocs:
+                if a.task_group != tg.name or a.terminal_status():
+                    continue
+                placed += 1
+                if a.client_status == "running":
+                    running += 1
+                ds = a.deployment_status
+                if ds is not None and ds.healthy is True:
+                    healthy += 1
+                elif ds is not None and ds.healthy is False:
+                    unhealthy += 1
+            groups[tg.name] = {
+                "Desired": tg.count, "Placed": placed, "Running": running,
+                "Healthy": healthy, "Unhealthy": unhealthy,
+                "Events": events.get(tg.name, []),
+            }
+        return {
+            "JobID": job.id, "Namespace": job.namespace,
+            "JobStopped": job.stop, "JobCreateIndex": job.create_index,
+            "JobModifyIndex": job.modify_index, "TaskGroups": groups,
+        }
+
+    def job_revert(self, namespace: str, job_id: str, version: int,
+                   enforce_prior_version: Optional[int] = None) -> dict:
+        """Re-register an older job version (ref nomad/job_endpoint.go
+        Job.Revert)."""
+        cur = self.state.job_by_id(namespace, job_id)
+        if cur is None:
+            raise ValueError(f"job {job_id!r} not found")
+        if enforce_prior_version is not None \
+                and cur.version != enforce_prior_version:
+            raise ValueError(
+                f"current version {cur.version} does not match enforced "
+                f"prior version {enforce_prior_version}")
+        if version == cur.version:
+            raise ValueError(f"job already at version {version}")
+        target = self.state.job_by_version(namespace, job_id, version)
+        if target is None:
+            raise ValueError(f"job {job_id!r} at version {version} not found")
+        revert = target.copy()
+        revert.stop = False
+        return self.job_register(revert)
+
+    def job_stable(self, namespace: str, job_id: str, version: int,
+                   stable: bool) -> dict:
+        """Mark a job version (un)stable (ref nomad/job_endpoint.go
+        Job.Stable; used by deployment auto-revert)."""
+        from .fsm import JOB_STABILITY
+        if self.state.job_by_version(namespace, job_id, version) is None:
+            raise ValueError(f"job {job_id!r} version {version} not found")
+        index = self.raft.apply(JOB_STABILITY, {
+            "namespace": namespace, "job_id": job_id, "version": version,
+            "stable": stable})
+        return {"index": index}
+
+    def scaling_policies_list(self, namespace: Optional[str] = None,
+                              job_id: Optional[str] = None,
+                              type_: Optional[str] = None) -> list:
+        return self.state.iter_scaling_policies(namespace, job_id, type_)
+
+    def scaling_policy_get(self, policy_id: str):
+        return self.state.scaling_policy_by_id(policy_id)
 
     # ------------------------------------------------------ Node endpoints
 

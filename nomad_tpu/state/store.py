@@ -53,6 +53,10 @@ class StateStore:
         self.acl_policies: dict[str, object] = {}          # name -> ACLPolicy
         self.acl_tokens: dict[str, object] = {}            # accessor -> token
         self._acl_token_by_secret: dict[str, str] = {}     # secret -> accessor
+        # scaling (ref nomad/state/schema.go scaling_policy/scaling_event)
+        self.scaling_policies: dict[str, object] = {}      # id -> policy
+        self._scaling_policy_by_target: dict[tuple, str] = {}
+        self.scaling_events: dict[tuple[str, str], dict[str, list]] = {}
 
         # secondary indexes
         self._allocs_by_node: dict[str, set[str]] = {}
@@ -117,6 +121,10 @@ class StateStore:
             out._acl_token_by_secret = dict(self._acl_token_by_secret)
             out.scheduler_config = self.scheduler_config
             out.namespaces = dict(self.namespaces)
+            out.scaling_policies = dict(self.scaling_policies)
+            out._scaling_policy_by_target = dict(self._scaling_policy_by_target)
+            out.scaling_events = {k: {g: list(evs) for g, evs in v.items()}
+                                  for k, v in self.scaling_events.items()}
             out._allocs_by_node = {k: set(v)
                                    for k, v in self._allocs_by_node.items()}
             out._allocs_by_job = {k: set(v)
@@ -254,6 +262,7 @@ class StateStore:
             self.job_versions[(job.namespace, job.id, job.version)] = job
             self._prune_job_versions(job.namespace, job.id)
             self._ensure_summary(index, job)
+            self._update_scaling_policies(index, job)
             self._emit("Job", "JobRegistered", job.modify_index, job)
             self._commit()
 
@@ -301,6 +310,12 @@ class StateStore:
                 self.job_versions.pop(k)
             self.job_summaries.pop((ns, job_id), None)
             self.periodic_launches.pop((ns, job_id), None)
+            self.scaling_events.pop((ns, job_id), None)
+            for tkey in [k for k in self._scaling_policy_by_target
+                         if k[0] == ns and k[1] == job_id]:
+                pid = self._scaling_policy_by_target.pop(tkey)
+                self.scaling_policies.pop(pid, None)
+                self._bump("scaling_policy", index)
             self._bump("jobs", index)
             self._emit("Job", "JobDeregistered", self._index, (ns, job_id))
             self._commit()
@@ -327,6 +342,105 @@ class StateStore:
     def job_summary(self, ns: str, job_id: str) -> Optional[JobSummary]:
         with self._lock:
             return self.job_summaries.get((ns, job_id))
+
+    # --------------------------------------------------------------- scaling
+
+    def _update_scaling_policies(self, index: int, job: Job) -> None:
+        """Sync the scaling_policy table with a job's scaling blocks (ref
+        state_store.go updateJobScalingPolicies). Must hold self._lock."""
+        from ..structs.scaling import policy_from_group
+        live_targets = set()
+        for tg in job.task_groups:
+            pol = policy_from_group(job, tg)
+            if pol is None:
+                continue
+            tkey = pol.target_key()
+            live_targets.add(tkey)
+            existing_id = self._scaling_policy_by_target.get(tkey)
+            if existing_id is not None:
+                existing = self.scaling_policies[existing_id]
+                pol.id = existing.id
+                pol.create_index = existing.create_index
+                if (existing.min == pol.min and existing.max == pol.max
+                        and existing.policy == pol.policy
+                        and existing.enabled == pol.enabled
+                        and existing.type == pol.type):
+                    continue  # unchanged — keep modify_index stable
+                pol.modify_index = self._bump("scaling_policy", index)
+            else:
+                pol.create_index = index
+                pol.modify_index = self._bump("scaling_policy", index)
+            self.scaling_policies[pol.id] = pol
+            self._scaling_policy_by_target[tkey] = pol.id
+        # drop policies for groups no longer in the job
+        for tkey in [k for k in self._scaling_policy_by_target
+                     if k[0] == job.namespace and k[1] == job.id
+                     and k not in live_targets]:
+            pid = self._scaling_policy_by_target.pop(tkey)
+            self.scaling_policies.pop(pid, None)
+            self._bump("scaling_policy", index)
+
+    def iter_scaling_policies(self, ns: Optional[str] = None,
+                              job_id: Optional[str] = None,
+                              type_: Optional[str] = None) -> list:
+        with self._lock:
+            out = []
+            for pol in self.scaling_policies.values():
+                pns, pjob, _ = pol.target_key()
+                if ns is not None and pns != ns:
+                    continue
+                if job_id is not None and pjob != job_id:
+                    continue
+                if type_ is not None and pol.type != type_:
+                    continue
+                out.append(pol)
+            return sorted(out, key=lambda p: p.target_key())
+
+    def scaling_policy_by_id(self, policy_id: str):
+        with self._lock:
+            return self.scaling_policies.get(policy_id)
+
+    def scaling_policy_by_target(self, ns: str, job_id: str, group: str):
+        with self._lock:
+            pid = self._scaling_policy_by_target.get((ns, job_id, group))
+            return self.scaling_policies.get(pid) if pid else None
+
+    def upsert_scaling_event(self, index: int, ns: str, job_id: str,
+                             group: str, event) -> None:
+        """ref state_store.go UpsertScalingEvent — bounded trail per group."""
+        from ..structs.scaling import JOB_TRACKED_SCALING_EVENTS
+        with self._lock:
+            event = event.copy()
+            event.create_index = self._bump("scaling_event", index)
+            groups = self.scaling_events.setdefault((ns, job_id), {})
+            trail = groups.setdefault(group, [])
+            trail.insert(0, event)
+            del trail[JOB_TRACKED_SCALING_EVENTS:]
+            self._commit()
+
+    def scaling_events_by_job(self, ns: str, job_id: str) -> dict[str, list]:
+        with self._lock:
+            return {g: list(evs) for g, evs in
+                    self.scaling_events.get((ns, job_id), {}).items()}
+
+    def update_job_stability(self, index: int, ns: str, job_id: str,
+                             version: int, stable: bool) -> None:
+        """ref state_store.go UpdateJobStability."""
+        with self._lock:
+            j = self.job_versions.get((ns, job_id, version))
+            if j is None:
+                return  # validated at the endpoint; FSM apply must not raise
+            j = j.copy()
+            j.stable = stable
+            j.modify_index = self._bump("jobs", index)
+            self.job_versions[(ns, job_id, version)] = j
+            cur = self.jobs.get((ns, job_id))
+            if cur is not None and cur.version == version:
+                cur = cur.copy()
+                cur.stable = stable
+                cur.modify_index = j.modify_index
+                self.jobs[(ns, job_id)] = cur
+            self._commit()
 
     # ----------------------------------------------------------------- evals
 

@@ -74,6 +74,11 @@ from .operator import (  # noqa: F401
     SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU,
     VALID_SCHEDULER_ALGORITHMS,
 )
+from .scaling import (  # noqa: F401
+    ScalingEvent, ScalingPolicyState, policy_from_group,
+    JOB_TRACKED_SCALING_EVENTS, SCALING_POLICY_TYPE_HORIZONTAL,
+    SCALING_TARGET_GROUP, SCALING_TARGET_JOB, SCALING_TARGET_NAMESPACE,
+)
 from .acl_structs import (  # noqa: F401
     ACLPolicy, ACLToken, TOKEN_TYPE_CLIENT, TOKEN_TYPE_MANAGEMENT,
     anonymous_token,

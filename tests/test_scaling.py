@@ -1,0 +1,224 @@
+"""Scaling: policy table sync, /v1/job/:id/scale, scale status, revert,
+stability, scaling policy endpoints (modeled on nomad/job_endpoint_test.go
+Job.Scale/Revert/Stable tests and state_store scaling-policy tests)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    Job, ScalingPolicy, SCALING_TARGET_GROUP, SCALING_TARGET_JOB,
+    SCALING_TARGET_NAMESPACE,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _scaling_job(job_id="scaler", min_=1, max_=10):
+    job = mock.job()
+    job.id = job.name = job_id
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.scaling = ScalingPolicy(min=min_, max=max_, enabled=True,
+                               policy={"target-value": 1})
+    return job
+
+
+def test_scaling_policy_table_synced_from_job(server):
+    job = _scaling_job()
+    server.job_register(job)
+    pols = server.scaling_policies_list()
+    assert len(pols) == 1
+    pol = pols[0]
+    assert pol.min == 1 and pol.max == 10
+    assert pol.target == {
+        SCALING_TARGET_NAMESPACE: "default",
+        SCALING_TARGET_JOB: "scaler",
+        SCALING_TARGET_GROUP: job.task_groups[0].name,
+    }
+    assert server.scaling_policy_get(pol.id) is pol
+    # same-policy re-register keeps the id and modify index
+    server.job_register(_scaling_job())
+    pols2 = server.scaling_policies_list()
+    assert len(pols2) == 1 and pols2[0].id == pol.id
+    assert pols2[0].modify_index == pol.modify_index
+    # changed bounds bump the modify index but keep the id
+    server.job_register(_scaling_job(max_=20))
+    pols3 = server.scaling_policies_list()
+    assert pols3[0].id == pol.id
+    assert pols3[0].max == 20
+    assert pols3[0].modify_index > pol.modify_index
+    # purge removes the row
+    server.job_deregister("default", "scaler", purge=True)
+    assert server.scaling_policies_list() == []
+
+
+def test_job_scale_enforces_policy_bounds(server):
+    job = _scaling_job()
+    group = job.task_groups[0].name
+    server.job_register(job)
+    with pytest.raises(ValueError, match="less than"):
+        server.job_scale("default", "scaler", group, count=0)
+    with pytest.raises(ValueError, match="greater than"):
+        server.job_scale("default", "scaler", group, count=11)
+    # policy_override skips the bounds (ref Job.Scale PolicyOverride)
+    server.job_scale("default", "scaler", group, count=11,
+                     policy_override=True)
+    assert server.state.job_by_id("default", "scaler") \
+        .task_groups[0].count == 11
+
+
+def test_job_scale_updates_count_and_records_event(server):
+    job = _scaling_job()
+    group = job.task_groups[0].name
+    server.job_register(job)
+    out = server.job_scale("default", "scaler", group, count=5,
+                           message="manual scale")
+    assert out["eval_id"]
+    stored = server.state.job_by_id("default", "scaler")
+    assert stored.task_groups[0].count == 5
+    assert stored.version == 1
+    status = server.job_scale_status("default", "scaler")
+    tg_status = status["TaskGroups"][group]
+    assert tg_status["Desired"] == 5
+    events = tg_status["Events"]
+    assert len(events) == 1
+    assert events[0].count == 5 and events[0].previous_count == 1
+    assert events[0].eval_id == out["eval_id"]
+
+
+def test_job_scale_event_only_no_new_version(server):
+    job = _scaling_job()
+    group = job.task_groups[0].name
+    server.job_register(job)
+    out = server.job_scale("default", "scaler", group, count=None,
+                           message="autoscaler error", error=True)
+    assert out["eval_id"] == ""
+    stored = server.state.job_by_id("default", "scaler")
+    assert stored.version == 0          # no job update
+    events = server.state.scaling_events_by_job("default", "scaler")[group]
+    assert events[0].error and events[0].message == "autoscaler error"
+
+
+def test_job_revert(server):
+    v0 = _scaling_job("revjob")
+    v0.task_groups[0].tasks[0].env = {"REV": "v0"}
+    server.job_register(v0)
+    v1 = _scaling_job("revjob")
+    v1.task_groups[0].tasks[0].env = {"REV": "v1"}
+    server.job_register(v1)
+    assert server.state.job_by_id("default", "revjob").version == 1
+    with pytest.raises(ValueError, match="already at version"):
+        server.job_revert("default", "revjob", 1)
+    with pytest.raises(ValueError, match="enforced prior version"):
+        server.job_revert("default", "revjob", 0, enforce_prior_version=5)
+    server.job_revert("default", "revjob", 0, enforce_prior_version=1)
+    cur = server.state.job_by_id("default", "revjob")
+    assert cur.version == 2
+    assert cur.task_groups[0].tasks[0].env == {"REV": "v0"}
+
+
+def test_job_stability(server):
+    job = _scaling_job("stab")
+    server.job_register(job)
+    server.job_stable("default", "stab", 0, True)
+    assert server.state.job_by_id("default", "stab").stable is True
+    assert server.state.job_by_version("default", "stab", 0).stable is True
+    server.job_stable("default", "stab", 0, False)
+    assert server.state.job_by_id("default", "stab").stable is False
+
+
+def test_scaling_survives_snapshot_restore(server):
+    job = _scaling_job("snapjob")
+    group = job.task_groups[0].name
+    server.job_register(job)
+    server.job_scale("default", "snapjob", group, count=3)
+    blob = server.snapshot_save()
+
+    s2 = Server(num_workers=0)
+    s2.start()
+    try:
+        s2.snapshot_restore(blob)
+        pols = s2.scaling_policies_list(job_id="snapjob")
+        assert len(pols) == 1 and pols[0].min == 1
+        events = s2.state.scaling_events_by_job("default", "snapjob")
+        assert events[group][0].count == 3
+    finally:
+        s2.shutdown()
+
+
+def test_http_scale_endpoints():
+    """End-to-end over REST: scale, scale status, policies list/get,
+    validate, parse, regions."""
+    import json
+    import urllib.request
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api_codec import to_api
+
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, client_enabled=False))
+    a.start()
+    try:
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                a.http_addr + path, data=data, method=method,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read() or "null")
+
+        job = _scaling_job("httpscale")
+        call("PUT", "/v1/jobs", {"Job": to_api(job)})
+
+        pols = call("GET", "/v1/scaling/policies?job=httpscale")
+        assert len(pols) == 1
+        pol = call("GET", f"/v1/scaling/policy/{pols[0]['ID']}")
+        assert pol["Min"] == 1 and pol["Max"] == 10
+
+        out = call("PUT", "/v1/job/httpscale/scale", {
+            "Target": {"Group": job.task_groups[0].name},
+            "Count": 4, "Message": "via http"})
+        assert out["eval_id"]
+
+        status = call("GET", "/v1/job/httpscale/scale")
+        assert status["TaskGroups"][job.task_groups[0].name]["Desired"] == 4
+
+        # revert to v0 (count back to 1)
+        call("PUT", "/v1/job/httpscale/revert", {"JobVersion": 0})
+        status = call("GET", "/v1/job/httpscale/scale")
+        assert status["TaskGroups"][job.task_groups[0].name]["Desired"] == 1
+
+        call("PUT", "/v1/job/httpscale/stable",
+             {"JobVersion": 0, "Stable": True})
+
+        # validate + parse + regions
+        ok = call("PUT", "/v1/validate/job", {"Job": to_api(job)})
+        assert ok["ValidationErrors"] == []
+        bad = to_api(job)
+        bad["TaskGroups"] = []
+        res = call("PUT", "/v1/validate/job", {"Job": bad})
+        assert res["ValidationErrors"]
+
+        parsed = call("PUT", "/v1/jobs/parse", {"JobHCL": """
+job "parsed" {
+  datacenters = ["dc1"]
+  group "web" {
+    count = 2
+    task "main" {
+      driver = "mock_driver"
+      resources { cpu = 100\n memory = 64 }
+    }
+  }
+}
+"""})
+        assert parsed["ID"] == "parsed"
+        assert parsed["TaskGroups"][0]["Count"] == 2
+
+        assert call("GET", "/v1/regions") == ["global"]
+        assert call("GET", "/v1/status/peers")
+    finally:
+        a.shutdown()
