@@ -30,6 +30,14 @@ def require(ok: bool) -> None:
         raise HTTPError(403, "Permission denied")
 
 
+class RawResponse:
+    """Non-JSON payload (file contents, logs) passed through verbatim."""
+
+    def __init__(self, data: bytes, content_type: str = "text/plain"):
+        self.data = data
+        self.content_type = content_type
+
+
 class HTTPAPI:
     """Route table + handlers; transport-agnostic (used by the HTTP server
     and directly by tests)."""
@@ -43,15 +51,19 @@ class HTTPAPI:
     def handle(self, method: str, path: str, query: dict,
                body: Optional[dict], token: str = ""):
         s = self.server
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise HTTPError(404, "not found")
+        parts = parts[1:]
+        if parts and parts[0] == "client":
+            # node-local routes served by the client half of the agent
+            # (ref command/agent/fs_endpoint.go, agent_endpoint.go)
+            return self._handle_client(method, parts[1:], query, body, token)
         if s is None:
             # client-only agents serve no server-backed routes yet (the
             # reference proxies these RPCs to its servers; our CLI/SDK talk
             # to a server agent's HTTP address directly)
             raise HTTPError(501, "agent is not running a server")
-        parts = [p for p in path.split("/") if p]
-        if not parts or parts[0] != "v1":
-            raise HTTPError(404, "not found")
-        parts = parts[1:]
         ns = query.get("namespace", "default")
         body = body or {}   # body-less PUT/POST is an empty request
 
@@ -429,6 +441,16 @@ class HTTPAPI:
                                                   NS_READ_SCALING_POLICY))
             return to_api(p), s.state.table_index("scaling_policy")
 
+        # ---- search (ref command/agent/search_endpoint.go)
+        if parts == ["search"] and method in ("PUT", "POST"):
+            return s.search_prefix(
+                body.get("Prefix", ""), body.get("Context", "all") or "all",
+                ns, acl), s.state.latest_index()
+        if parts == ["search", "fuzzy"] and method in ("PUT", "POST"):
+            return s.search_fuzzy(
+                body.get("Text", ""), body.get("Context", "all") or "all",
+                ns, acl), s.state.latest_index()
+
         # ---- jobspec utilities
         if parts == ["jobs", "parse"] and method in ("PUT", "POST"):
             from ..acl import NS_PARSE_JOB
@@ -482,6 +504,106 @@ class HTTPAPI:
     def _version(self) -> str:
         from .. import __version__
         return __version__
+
+    # ----------------------------------------------------------- client API
+
+    def _handle_client(self, method: str, parts: list[str], query: dict,
+                       body: Optional[dict], token: str):
+        """/v1/client/* — node-local: fs, logs, stats, gc, alloc lifecycle
+        (ref command/agent/fs_endpoint.go + alloc_endpoint.go; these hit the
+        local client or are proxied server->client in the reference)."""
+        c = self.agent.client
+        if c is None:
+            raise HTTPError(501, "agent is not running a client")
+        body = body or {}
+
+        # ACL: resolve through the server when present (client-only agents
+        # resolve via server RPC in the reference; dev agents are combined)
+        from ..acl import (
+            NS_ALLOC_LIFECYCLE, NS_READ_FS, NS_READ_JOB, NS_READ_LOGS,
+        )
+        if self.server is not None:
+            from ..server.acl_endpoint import TokenNotFoundError
+            try:
+                acl = self.server.acl.resolve_token(token)
+            except TokenNotFoundError:
+                raise HTTPError(403, "ACL token not found")
+        elif self.agent.config.acl_enabled:
+            # fail closed: a client-only agent cannot resolve tokens until
+            # server-RPC token resolution lands (the reference resolves via
+            # its servers, client/acl.go)
+            raise HTTPError(501, "ACL token resolution requires a server")
+        else:
+            acl = None
+
+        def ns_require(alloc_id: str, cap: str) -> None:
+            if acl is None:
+                return
+            try:
+                ns = c.alloc_namespace(alloc_id)
+            except KeyError:
+                raise HTTPError(404, f"unknown allocation {alloc_id!r}")
+            require(acl.allow_namespace_operation(ns, cap))
+
+        try:
+            if parts == ["stats"]:
+                if acl is not None:
+                    require(acl.allow_node_read())
+                return c.host_stats(), None
+            if parts == ["gc"] and method in ("PUT", "POST"):
+                if acl is not None:
+                    require(acl.allow_node_write())
+                return {"Collected": c.gc_all()}, None
+
+            if len(parts) >= 2 and parts[0] == "allocation":
+                alloc_id, rest = parts[1], parts[2:]
+                if rest == ["stats"]:
+                    ns_require(alloc_id, NS_READ_JOB)
+                    return c.alloc_stats(alloc_id), None
+                if rest == ["signal"] and method in ("PUT", "POST"):
+                    ns_require(alloc_id, NS_ALLOC_LIFECYCLE)
+                    c.alloc_signal(alloc_id, body.get("Task", ""),
+                                   body.get("Signal", "SIGUSR1"))
+                    return {}, None
+                if rest == ["restart"] and method in ("PUT", "POST"):
+                    ns_require(alloc_id, NS_ALLOC_LIFECYCLE)
+                    c.alloc_restart(alloc_id, body.get("TaskName",
+                                                       body.get("Task", "")))
+                    return {}, None
+                if rest == ["gc"] and method in ("PUT", "POST"):
+                    ns_require(alloc_id, NS_ALLOC_LIFECYCLE)
+                    c.gc_alloc(alloc_id)
+                    return {}, None
+
+            if len(parts) >= 2 and parts[0] == "fs":
+                op, alloc_id = parts[1], parts[2] if len(parts) > 2 else ""
+                if not alloc_id:
+                    raise HTTPError(400, "missing allocation id")
+                path_q = query.get("path", "/")
+                offset = int(query.get("offset", 0) or 0)
+                limit = int(query.get("limit", -1) or -1)
+                if op == "ls":
+                    ns_require(alloc_id, NS_READ_FS)
+                    return c.fs_list(alloc_id, path_q), None
+                if op == "stat":
+                    ns_require(alloc_id, NS_READ_FS)
+                    return c.fs_stat(alloc_id, path_q), None
+                if op in ("cat", "readat"):
+                    ns_require(alloc_id, NS_READ_FS)
+                    data = c.fs_read(alloc_id, path_q, offset, limit)
+                    return RawResponse(data), None
+                if op == "logs":
+                    ns_require(alloc_id, NS_READ_LOGS)
+                    data = c.fs_logs(
+                        alloc_id, query.get("task", ""),
+                        query.get("type", "stdout"), offset,
+                        query.get("origin", "start"), limit)
+                    return RawResponse(data), None
+        except KeyError as e:
+            raise HTTPError(404, str(e))
+        except (ValueError, OSError) as e:
+            raise HTTPError(400, str(e))
+        raise HTTPError(404, f"no client handler for {'/'.join(parts)}")
 
     # ------------------------------------------------------------------ ACL
 
@@ -766,9 +888,14 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 sub.close()
 
         def _respond(self, code: int, payload, headers=None) -> None:
-            data = json.dumps(payload).encode()
+            if isinstance(payload, RawResponse):
+                data = payload.data
+                ctype = payload.content_type
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)

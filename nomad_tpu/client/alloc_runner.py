@@ -215,6 +215,68 @@ class AllocRunner:
         self.stop()
         self._destroyed.set()
 
+    def signal(self, task_name: str, sig: str) -> None:
+        """Signal one task, or every task when task_name is empty (ref
+        client/allocrunner Signal)."""
+        from ..structs import TASK_STATE_RUNNING
+        with self._lock:
+            runners = dict(self.task_runners)
+        if task_name:
+            tr = runners.get(task_name)
+            if tr is None:
+                raise ValueError(f"unknown task {task_name!r}")
+            tr.signal(sig)
+            return
+        # all-task signal: act only on running tasks, and check eligibility
+        # up front so we never partially apply then error
+        eligible = [tr for tr in runners.values()
+                    if tr.state.state == TASK_STATE_RUNNING]
+        if not eligible:
+            raise ValueError("allocation has no running tasks")
+        for tr in eligible:
+            tr.signal(sig)
+
+    def restart_task(self, task_name: str = "") -> None:
+        """Restart one task or the whole alloc (ref allocrunner Restart)."""
+        with self._lock:
+            runners = dict(self.task_runners)
+        if task_name:
+            tr = runners.get(task_name)
+            if tr is None:
+                raise ValueError(f"unknown task {task_name!r}")
+            tr.restart()
+            return
+        eligible = [tr for tr in runners.values()
+                    if not tr._done.is_set()]
+        if not eligible:
+            raise ValueError("allocation has no restartable tasks")
+        for tr in eligible:
+            tr.restart()
+
+    def stats(self) -> dict:
+        """Per-task + rolled-up resource usage (ref
+        client/allocrunner AllocStats / structs.AllocResourceUsage)."""
+        with self._lock:
+            runners = dict(self.task_runners)
+        tasks = {name: tr.stats() for name, tr in runners.items()}
+        return {
+            "ResourceUsage": {
+                "MemoryStats": {"RSS": sum(
+                    t.get("memory_rss_bytes", 0) for t in tasks.values())},
+                "CpuStats": {"TotalTicks": sum(
+                    t.get("cpu_total_ticks", 0.0) for t in tasks.values())},
+            },
+            "Tasks": {
+                name: {"ResourceUsage": {
+                    "MemoryStats": {"RSS": t.get("memory_rss_bytes", 0)},
+                    "CpuStats": {
+                        "TotalTicks": t.get("cpu_total_ticks", 0.0),
+                        "Percent": t.get("cpu_percent", 0.0)},
+                }} for name, t in tasks.items()
+            },
+            "Timestamp": time.time(),
+        }
+
     def is_done(self) -> bool:
         with self._lock:
             states = dict(self.task_states)

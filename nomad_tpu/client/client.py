@@ -243,6 +243,168 @@ class Client:
 
     # -------------------------------------------------------------- helpers
 
+    # ------------------------------------------------- client API surface
+    # (ref client/alloc_endpoint.go, client/fs_endpoint.go — served over
+    # HTTP by the agent, reachable directly or via server proxy)
+
+    def _runner(self, alloc_id: str) -> AllocRunner:
+        with self._lock:
+            ar = self.alloc_runners.get(alloc_id)
+        if ar is None:
+            raise KeyError(f"unknown allocation {alloc_id!r}")
+        return ar
+
+    def alloc_signal(self, alloc_id: str, task: str = "",
+                     sig: str = "SIGUSR1") -> None:
+        self._runner(alloc_id).signal(task, sig)
+
+    def alloc_restart(self, alloc_id: str, task: str = "") -> None:
+        self._runner(alloc_id).restart_task(task)
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        return self._runner(alloc_id).stats()
+
+    def alloc_namespace(self, alloc_id: str) -> str:
+        return self._runner(alloc_id).alloc.namespace
+
+    def _fs_path(self, alloc_id: str, path: str) -> str:
+        """Resolve a path inside the alloc dir, refusing escapes (the
+        reference's alloc-dir sandboxing, client/allocdir)."""
+        root = os.path.realpath(self._runner(alloc_id).alloc_dir)
+        full = os.path.realpath(os.path.join(root, path.lstrip("/")))
+        if full != root and not full.startswith(root + os.sep):
+            raise ValueError("path escapes allocation directory")
+        return full
+
+    def fs_list(self, alloc_id: str, path: str = "/") -> list[dict]:
+        """ref client/fs_endpoint.go List"""
+        full = self._fs_path(alloc_id, path)
+        out = []
+        for name in sorted(os.listdir(full)):
+            st = os.stat(os.path.join(full, name))
+            out.append({
+                "Name": name,
+                "IsDir": os.path.isdir(os.path.join(full, name)),
+                "Size": st.st_size,
+                "FileMode": oct(st.st_mode & 0o7777),
+                "ModTime": st.st_mtime,
+            })
+        return out
+
+    def fs_stat(self, alloc_id: str, path: str) -> dict:
+        full = self._fs_path(alloc_id, path)
+        st = os.stat(full)
+        return {
+            "Name": os.path.basename(full) or "/",
+            "IsDir": os.path.isdir(full),
+            "Size": st.st_size,
+            "FileMode": oct(st.st_mode & 0o7777),
+            "ModTime": st.st_mtime,
+        }
+
+    def fs_read(self, alloc_id: str, path: str, offset: int = 0,
+                limit: int = -1) -> bytes:
+        """ref fs_endpoint.go Cat/ReadAt"""
+        full = self._fs_path(alloc_id, path)
+        with open(full, "rb") as f:
+            if offset:
+                f.seek(offset)
+            return f.read(limit if limit >= 0 else -1)
+
+    def fs_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
+                offset: int = 0, origin: str = "start",
+                limit: int = -1) -> bytes:
+        """Task log access (ref fs_endpoint.go Logs). Logs live at
+        <alloc>/<task>/<task>.<type>.log (driver log convention)."""
+        if log_type not in ("stdout", "stderr"):
+            raise ValueError("type must be stdout or stderr")
+        ar = self._runner(alloc_id)
+        alloc = ar.alloc
+        tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job \
+            else None
+        if tg is None or tg.lookup_task(task) is None:
+            raise ValueError(f"unknown task {task!r} in allocation")
+        path = f"{task}/{task}.{log_type}.log"
+        full = self._fs_path(alloc_id, path)
+        if not os.path.exists(full):
+            return b""
+        size = os.path.getsize(full)
+        with open(full, "rb") as f:
+            if origin == "end":
+                # offset counts back from EOF (ref api/fs.go Logs origin)
+                f.seek(max(0, size - offset) if offset else
+                       (max(0, size - limit) if limit >= 0 else 0))
+            elif offset:
+                f.seek(offset)
+            return f.read(limit if limit >= 0 else -1)
+
+    def host_stats(self) -> dict:
+        """ref client/stats/host.go HostStats"""
+        stats = {"Timestamp": time.time(), "CPUTicksConsumed": 0.0}
+        try:
+            load1, load5, load15 = os.getloadavg()
+            stats["CPU"] = [{"CPU": "cpu-total", "Total": load1 * 100}]
+            stats["LoadAvg"] = [load1, load5, load15]
+        except OSError:
+            pass
+        try:
+            meminfo = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    meminfo[k] = int(v.split()[0]) * 1024
+            stats["Memory"] = {
+                "Total": meminfo.get("MemTotal", 0),
+                "Available": meminfo.get("MemAvailable", 0),
+                "Free": meminfo.get("MemFree", 0),
+                "Used": meminfo.get("MemTotal", 0)
+                - meminfo.get("MemAvailable", 0),
+            }
+        except OSError:
+            pass
+        try:
+            st = os.statvfs(self.data_dir)
+            stats["DiskStats"] = [{
+                "Device": self.data_dir,
+                "Size": st.f_blocks * st.f_frsize,
+                "Available": st.f_bavail * st.f_frsize,
+                "UsedPercent": 100.0 * (1 - st.f_bavail / st.f_blocks)
+                if st.f_blocks else 0.0,
+            }]
+        except OSError:
+            pass
+        stats["AllocDirStats"] = {"Allocs": self.num_allocs()}
+        stats["Uptime"] = time.monotonic()
+        return stats
+
+    def gc_alloc(self, alloc_id: str) -> None:
+        """Destroy one terminal alloc and remove its dir (ref
+        client/gc.go Collect)."""
+        import shutil
+        ar = self._runner(alloc_id)
+        if not ar.alloc.terminal_status() and not ar.is_done():
+            raise ValueError(f"allocation {alloc_id!r} is not terminal")
+        ar.destroy()
+        with self._lock:
+            self.alloc_runners.pop(alloc_id, None)
+            self._alloc_versions.pop(alloc_id, None)
+        self.state_db.delete_allocation(alloc_id)
+        shutil.rmtree(ar.alloc_dir, ignore_errors=True)
+
+    def gc_all(self) -> int:
+        """Destroy all terminal allocs (ref client/gc.go CollectAll)."""
+        with self._lock:
+            candidates = [aid for aid, ar in self.alloc_runners.items()
+                          if ar.alloc.terminal_status() or ar.is_done()]
+        n = 0
+        for aid in candidates:
+            try:
+                self.gc_alloc(aid)
+                n += 1
+            except (KeyError, ValueError):
+                pass
+        return n
+
     def get_driver(self, name: str) -> Driver:
         driver = self.drivers.get(name)
         if driver is None:

@@ -63,12 +63,42 @@ class Driver:
     def destroy_task(self, task_id: str) -> None:
         pass
 
+    def signal_task(self, task_id: str, sig: str) -> None:
+        """Send a signal to a running task (ref DriverPlugin.SignalTask,
+        plugins/drivers/driver.go:47)."""
+        raise NotImplementedError(
+            f"driver {self.name!r} does not support signaling")
+
+    def task_stats(self, task_id: str) -> dict:
+        """Point-in-time resource usage (ref DriverPlugin.TaskStats):
+        {"cpu_percent": float, "memory_rss_bytes": int}."""
+        return {"cpu_percent": 0.0, "memory_rss_bytes": 0}
+
     def inspect_task(self, task_id: str) -> Optional[TaskHandle]:
         return None
 
     def recover_task(self, handle: TaskHandle) -> bool:
         """Reattach after client restart; True if the task is still live."""
         return False
+
+
+def read_proc_stats(pid: int) -> dict:
+    """Read one process's usage from /proc (ref client/stats and the
+    executor's TaskStats: total_ticks + RSS)."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(") ", 1)[1].split()
+        utime, stime = int(fields[11]), int(fields[12])
+        hz = os.sysconf("SC_CLK_TCK")
+        return {
+            "cpu_percent": 0.0,   # needs two samples; ticks are the basis
+            "cpu_total_ticks": (utime + stime) / hz,
+            "memory_rss_bytes": rss_pages * os.sysconf("SC_PAGE_SIZE"),
+        }
+    except (OSError, IndexError, ValueError):
+        return {"cpu_percent": 0.0, "memory_rss_bytes": 0}
 
 
 def _seconds(v) -> float:
@@ -100,6 +130,7 @@ class MockDriver(Driver):
             "exit_code": int(cfg.get("exit_code", 0)),
             "stopped": threading.Event(),
             "started_at": now,
+            "signals": [],
         }
         with self._lock:
             self._tasks[task_id] = rec
@@ -130,6 +161,18 @@ class MockDriver(Driver):
     def destroy_task(self, task_id):
         with self._lock:
             self._tasks.pop(task_id, None)
+
+    def signal_task(self, task_id, sig):
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            raise ValueError("unknown task")
+        rec["signals"].append(sig)
+
+    def received_signals(self, task_id) -> list[str]:
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        return list(rec["signals"]) if rec else []
 
     def recover_task(self, handle):
         with self._lock:
@@ -206,6 +249,23 @@ class RawExecDriver(Driver):
         self.stop_task(task_id, kill_timeout=0.1)
         with self._lock:
             self._procs.pop(task_id, None)
+
+    def signal_task(self, task_id, sig):
+        with self._lock:
+            proc = self._procs.get(task_id)
+        if proc is None or proc.poll() is not None:
+            raise ValueError("task not running")
+        signum = getattr(signal, sig, None)
+        if signum is None:
+            raise ValueError(f"invalid signal {sig!r}")
+        os.killpg(os.getpgid(proc.pid), signum)
+
+    def task_stats(self, task_id):
+        with self._lock:
+            proc = self._procs.get(task_id)
+        if proc is None or proc.poll() is not None:
+            return super().task_stats(task_id)
+        return read_proc_stats(proc.pid)
 
     def recover_task(self, handle):
         if handle.pid <= 0:

@@ -206,6 +206,32 @@ class ExecDriver(Driver):
         except (ProcessLookupError, PermissionError):
             pass
 
+    def signal_task(self, task_id: str, sig: str) -> None:
+        """Signal the task's process group directly (the executor's child,
+        from the pidfile) — ref executor Signal RPC."""
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            raise ValueError("unknown task")
+        signum = getattr(signal, sig, None)
+        if signum is None:
+            raise ValueError(f"invalid signal {sig!r}")
+        child = self._child_pid(rec)
+        if child <= 0:
+            raise ValueError("task not running")
+        os.killpg(os.getpgid(child), signum)
+
+    def task_stats(self, task_id: str) -> dict:
+        from .driver import read_proc_stats
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            return super().task_stats(task_id)
+        child = self._child_pid(rec)
+        if child <= 0:
+            return super().task_stats(task_id)
+        return read_proc_stats(child)
+
     def _child_pid(self, rec: dict) -> int:
         try:
             with open(rec.get("pidfile", "")) as f:

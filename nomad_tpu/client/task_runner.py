@@ -28,6 +28,8 @@ EVENT_NOT_RESTARTING = "Not Restarting"
 EVENT_KILLING = "Killing"
 EVENT_KILLED = "Killed"
 EVENT_DRIVER_FAILURE = "Driver Failure"
+EVENT_SIGNALING = "Signaling"
+EVENT_RESTART_SIGNAL = "Restart Signaled"
 
 
 class TaskRunner:
@@ -47,6 +49,7 @@ class TaskRunner:
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._restarts_in_window: list[float] = []
+        self._restart_req = False
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
         self.restart_policy = tg.restart_policy if tg else None
@@ -119,6 +122,12 @@ class TaskRunner:
 
     def _should_restart(self, failed: bool, reason: str) -> bool:
         """ref taskrunner/restarts/restarts.go"""
+        if self._restart_req and not self._kill.is_set():
+            # user-initiated restart (alloc restart API): bypasses the
+            # restart-policy accounting (ref restarts.go SetRestartTriggered)
+            self._restart_req = False
+            self._emit(EVENT_RESTARTING, "restarting: user requested")
+            return True
         pol = self.restart_policy
         if pol is None or self._kill.is_set():
             return False
@@ -155,6 +164,31 @@ class TaskRunner:
     def kill(self, reason: str = "") -> None:
         self._emit(EVENT_KILLING, reason or "task is being killed")
         self._kill.set()
+
+    def signal(self, sig: str, reason: str = "") -> None:
+        """Deliver a signal to the running task (ref taskrunner Signal /
+        client/alloc_endpoint.go Allocations.Signal)."""
+        if self.state.state != TASK_STATE_RUNNING:
+            raise ValueError(f"task {self.task.name!r} is not running")
+        self._emit(EVENT_SIGNALING, reason or f"signal {sig}")
+        self.driver.signal_task(self.task_id, sig)
+
+    def restart(self, reason: str = "") -> None:
+        """Stop and rerun the task, bypassing restart-policy limits (ref
+        taskrunner Restart / client/alloc_endpoint.go Allocations.Restart)."""
+        if self._done.is_set():
+            raise ValueError(f"task {self.task.name!r} is terminal")
+        self._emit(EVENT_RESTART_SIGNAL,
+                   reason or "restart requested by user")
+        self._restart_req = True
+        self.driver.stop_task(self.task_id,
+                              kill_timeout=self.task.kill_timeout_sec,
+                              sig=self.task.kill_signal)
+
+    def stats(self) -> dict:
+        if self.state.state != TASK_STATE_RUNNING:
+            return {"cpu_percent": 0.0, "memory_rss_bytes": 0}
+        return self.driver.task_stats(self.task_id)
 
     def wait_done(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)

@@ -68,6 +68,8 @@ RPC_ENDPOINTS = {
     "Job.Stable": ("job_stable", True),
     "Scaling.ListPolicies": ("scaling_policies_list", False),
     "Scaling.GetPolicy": ("scaling_policy_get", False),
+    "Search.PrefixSearch": ("search_prefix", False),
+    "Search.FuzzySearch": ("search_fuzzy", False),
     "Eval.Dequeue": ("eval_dequeue", True),
     "Eval.Ack": ("eval_ack", True),
     "Eval.Nack": ("eval_nack", True),
@@ -452,6 +454,8 @@ class Server:
         job = self.state.job_by_id(namespace, job_id)
         if job is None:
             raise ValueError(f"job {job_id!r} not found")
+        if job.stop and count is not None:
+            raise ValueError("cannot scale a stopped job")
         tg = job.lookup_task_group(group)
         if tg is None:
             raise ValueError(f"task group {group!r} not found in {job_id!r}")
@@ -559,6 +563,18 @@ class Server:
 
     def scaling_policy_get(self, policy_id: str):
         return self.state.scaling_policy_by_id(policy_id)
+
+    # ------------------------------------------------------ Search endpoints
+
+    def search_prefix(self, prefix: str, context: str = "all",
+                      namespace: str = "default", acl=None) -> dict:
+        from .search import prefix_search
+        return prefix_search(self.state, prefix, context, namespace, acl)
+
+    def search_fuzzy(self, text: str, context: str = "all",
+                     namespace: str = "default", acl=None) -> dict:
+        from .search import fuzzy_search
+        return fuzzy_search(self.state, text, context, namespace, acl)
 
     # ------------------------------------------------------ Node endpoints
 

@@ -1,0 +1,160 @@
+"""Client API surface: fs ls/stat/cat/readat/logs, alloc signal/restart,
+alloc+host stats, client GC (modeled on client/fs_endpoint.go and
+client/alloc_endpoint.go tests)."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api_codec import to_api
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    assert wait_until(
+        lambda: a.server.state.node_by_id(a.client.node.id) is not None
+        and a.server.state.node_by_id(a.client.node.id).ready())
+    yield a
+    a.shutdown()
+
+
+def call(agent, method, path, body=None, raw=False):
+    url = agent.http_addr + path
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=35) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or "null")
+
+
+def _run_job(agent, job_id, run_for=60, driver="mock_driver", config=None):
+    job = mock.batch_job()
+    job.id = job.name = job_id
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = driver
+    task.config = config or {"run_for": run_for}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    call(agent, "PUT", "/v1/jobs", {"Job": to_api(job)})
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in agent.server.state.allocs_by_job("default", job_id)))
+    allocs = agent.server.state.allocs_by_job("default", job_id)
+    return [a for a in allocs if a.client_status == "running"][0]
+
+
+def test_fs_ls_stat_cat(agent):
+    alloc = _run_job(agent, "fsjob", driver="raw_exec",
+                     config={"command": "/bin/sh",
+                             "args": ["-c", "echo hello-fs; sleep 60"]})
+    task = "task1" if False else alloc.job.task_groups[0].tasks[0].name
+    # the task dir exists with local/ + secrets/ + logs
+    entries = call(agent, "GET", f"/v1/client/fs/ls/{alloc.id}?path={task}")
+    names = [e["Name"] for e in entries]
+    assert "local" in names and "secrets" in names
+    assert wait_until(lambda: call(
+        agent, "GET",
+        f"/v1/client/fs/cat/{alloc.id}?path={task}/{task}.stdout.log",
+        raw=True) == b"hello-fs\n")
+    st = call(agent, "GET",
+              f"/v1/client/fs/stat/{alloc.id}?path={task}/{task}.stdout.log")
+    assert st["Size"] == len(b"hello-fs\n")
+    assert not st["IsDir"]
+    # readat with offset+limit
+    out = call(agent, "GET",
+               f"/v1/client/fs/readat/{alloc.id}"
+               f"?path={task}/{task}.stdout.log&offset=6&limit=2",
+               raw=True)
+    assert out == b"fs"
+    # logs endpoint
+    out = call(agent, "GET",
+               f"/v1/client/fs/logs/{alloc.id}?task={task}&type=stdout",
+               raw=True)
+    assert out == b"hello-fs\n"
+
+
+def test_fs_path_escape_rejected(agent):
+    alloc = _run_job(agent, "fsescape")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(agent, "GET", f"/v1/client/fs/cat/{alloc.id}?path=../../etc/passwd")
+    assert e.value.code == 400
+
+
+def test_alloc_signal_mock(agent):
+    alloc = _run_job(agent, "sigjob")
+    task = alloc.job.task_groups[0].tasks[0].name
+    call(agent, "PUT", f"/v1/client/allocation/{alloc.id}/signal",
+         {"Signal": "SIGHUP", "Task": task})
+    drv = agent.client.drivers["mock_driver"]
+    assert drv.received_signals(f"{alloc.id}/{task}") == ["SIGHUP"]
+
+
+def test_alloc_restart(agent):
+    alloc = _run_job(agent, "restartjob")
+    task = alloc.job.task_groups[0].tasks[0].name
+    ar = agent.client.alloc_runners[alloc.id]
+    before = ar.task_states[task].restarts
+    call(agent, "PUT", f"/v1/client/allocation/{alloc.id}/restart",
+         {"TaskName": task})
+    assert wait_until(
+        lambda: ar.task_states[task].restarts == before
+        and ar.task_states[task].state == "running"
+        and any(ev.type == "Restart Signaled"
+                for ev in ar.task_states[task].events))
+
+
+def test_alloc_and_host_stats(agent):
+    alloc = _run_job(agent, "statsjob", driver="raw_exec",
+                     config={"command": "/bin/sleep", "args": ["60"]})
+    task = alloc.job.task_groups[0].tasks[0].name
+    stats = call(agent, "GET", f"/v1/client/allocation/{alloc.id}/stats")
+    assert task in stats["Tasks"]
+    assert stats["ResourceUsage"]["MemoryStats"]["RSS"] > 0
+    host = call(agent, "GET", "/v1/client/stats")
+    assert host["Memory"]["Total"] > 0
+    assert host["DiskStats"][0]["Size"] > 0
+
+
+def test_client_gc(agent):
+    alloc = _run_job(agent, "gcjob", run_for=0.2)
+    assert wait_until(lambda: agent.client.alloc_runners[alloc.id].is_done())
+    alloc_dir = agent.client.alloc_runners[alloc.id].alloc_dir
+    out = call(agent, "PUT", "/v1/client/gc")
+    assert out["Collected"] >= 1
+    assert alloc.id not in agent.client.alloc_runners
+    import os
+    assert not os.path.exists(alloc_dir)
+
+
+def test_gc_refuses_live_alloc(agent):
+    alloc = _run_job(agent, "gclive")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(agent, "PUT", f"/v1/client/allocation/{alloc.id}/gc")
+    assert e.value.code == 400
+    assert alloc.id in agent.client.alloc_runners
+
+
+def test_server_alloc_stop_still_works(agent):
+    alloc = _run_job(agent, "stopjob")
+    out = call(agent, "PUT", f"/v1/allocation/{alloc.id}/stop")
+    assert wait_until(lambda: agent.server.state.alloc_by_id(alloc.id)
+                      .desired_status == "stop")
+    assert out

@@ -1,0 +1,139 @@
+"""Search endpoint tests (modeled on nomad/search_endpoint_test.go):
+prefix matching per context, truncation, ACL namespace filtering, fuzzy
+matching incl. job-scoped group/task results."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.search import (
+    TRUNCATE_LIMIT, fuzzy_search, prefix_search,
+)
+from nomad_tpu.structs import Node
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _register_jobs(server, n, prefix="web"):
+    for i in range(n):
+        job = mock.job()
+        job.id = job.name = f"{prefix}-{i:03d}"
+        server.job_register(job)
+
+
+def test_prefix_search_jobs(server):
+    _register_jobs(server, 3)
+    _register_jobs(server, 2, prefix="db")
+    out = server.search_prefix("web", "jobs")
+    assert out["Matches"]["jobs"] == ["web-000", "web-001", "web-002"]
+    assert out["Truncations"]["jobs"] is False
+    # the "all" context sweeps every table
+    out = server.search_prefix("db", "all")
+    assert out["Matches"]["jobs"] == ["db-000", "db-001"]
+    assert "nodes" in out["Matches"]
+
+
+def test_prefix_search_truncation(server):
+    _register_jobs(server, TRUNCATE_LIMIT + 5)
+    out = server.search_prefix("web", "jobs")
+    assert len(out["Matches"]["jobs"]) == TRUNCATE_LIMIT
+    assert out["Truncations"]["jobs"] is True
+
+
+def test_prefix_search_nodes_and_evals(server):
+    node = mock.node()
+    server.node_register(node)
+    _register_jobs(server, 1)
+    out = server.search_prefix(node.id[:8], "nodes")
+    assert node.id in out["Matches"]["nodes"]
+    evs = server.state.iter_evals()
+    assert evs
+    out = server.search_prefix(evs[0].id[:8], "evals")
+    assert evs[0].id in out["Matches"]["evals"]
+
+
+def test_prefix_search_acl_namespace_filter(server):
+    """A token without access to a namespace must not see its jobs."""
+    class DenyAll:
+        def allow_namespace(self, ns):
+            return ns != "secret"
+    server.namespace_upsert([{"name": "secret"}])
+    job = mock.job()
+    job.id = job.name = "web-secret"
+    job.namespace = "secret"
+    server.job_register(job)
+    _register_jobs(server, 1)
+    out = prefix_search(server.state, "web", "jobs", namespace="*",
+                        acl=DenyAll())
+    assert "web-secret" not in out["Matches"]["jobs"]
+    assert "web-000" in out["Matches"]["jobs"]
+
+
+def test_fuzzy_search_jobs_groups_tasks(server):
+    job = mock.job()
+    job.id = job.name = "example-cache"
+    job.task_groups[0].name = "cache-group"
+    job.task_groups[0].tasks[0].name = "redis-task"
+    server.job_register(job)
+    out = server.search_fuzzy("cache", "all")
+    assert any(m["ID"] == "example-cache" for m in out["Matches"]["jobs"])
+    assert any(m["ID"] == "cache-group" for m in out["Matches"]["groups"])
+    out = server.search_fuzzy("redis", "all")
+    tasks = out["Matches"]["tasks"]
+    assert tasks[0]["ID"] == "redis-task"
+    assert tasks[0]["Scope"] == ["default", "example-cache", "cache-group"]
+
+
+def test_fuzzy_search_substring_ranks_before_subsequence(server):
+    for name in ("abz-service", "a-b-z-scattered"):
+        job = mock.job()
+        job.id = job.name = name
+        server.job_register(job)
+    out = server.search_fuzzy("abz", "jobs")
+    ids = [m["ID"] for m in out["Matches"]["jobs"]]
+    assert ids.index("abz-service") < ids.index("a-b-z-scattered")
+
+
+def test_fuzzy_search_nodes(server):
+    node = mock.node()
+    node.name = "rack42-host7"
+    server.node_register(node)
+    out = server.search_fuzzy("rack42", "nodes")
+    assert out["Matches"]["nodes"][0]["ID"] == "rack42-host7"
+    assert out["Matches"]["nodes"][0]["Scope"] == [node.id]
+
+
+def test_http_search_routes():
+    import json
+    import urllib.request
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api_codec import to_api
+
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, client_enabled=False))
+    a.start()
+    try:
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                a.http_addr + path, data=data, method=method,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read() or "null")
+
+        job = mock.job()
+        job.id = job.name = "http-search-job"
+        call("PUT", "/v1/jobs", {"Job": to_api(job)})
+        out = call("POST", "/v1/search",
+                   {"Prefix": "http-search", "Context": "jobs"})
+        assert out["Matches"]["jobs"] == ["http-search-job"]
+        out = call("POST", "/v1/search/fuzzy",
+                   {"Text": "search", "Context": "all"})
+        assert any(m["ID"] == "http-search-job"
+                   for m in out["Matches"]["jobs"])
+    finally:
+        a.shutdown()
