@@ -19,6 +19,7 @@ class AgentConfig:
     """ref command/agent/config.go (subset)"""
     data_dir: str = ""
     bind_addr: str = "127.0.0.1"
+    advertise_addr: str = ""    # address peers use; required if bind is 0.0.0.0
     http_port: int = 4646
     rpc_port: int = -1          # -1 = no network RPC (-dev default); 0 = any
     servers: tuple = ()         # client-only mode: server "host:port" list
@@ -88,8 +89,6 @@ class Agent:
                 self.server.rpc_listen(self.config.bind_addr,
                                        self.config.rpc_port,
                                        key=self.config.key_bytes())
-        if self.client is not None:
-            self.client.start()
         self.http = make_http_server(self.api, self.config.bind_addr,
                                      self.config.http_port)
         # pick up the OS-assigned port when asked for :0
@@ -97,6 +96,19 @@ class Agent:
         self._http_thread = threading.Thread(
             target=self.http.serve_forever, daemon=True, name="http")
         self._http_thread.start()
+        if self.client is not None:
+            # the node advertises its agent's HTTP address so peers can
+            # migrate ephemeral disks from it (ref structs.Node.HTTPAddr;
+            # bind vs advertise split as in command/agent/config.go)
+            adv = self.config.advertise_addr or self.config.bind_addr
+            if adv in ("0.0.0.0", "::", ""):
+                import socket as _socket
+                try:
+                    adv = _socket.gethostbyname(_socket.gethostname())
+                except OSError:
+                    adv = "127.0.0.1"
+            self.client.node.http_addr = f"{adv}:{self.config.http_port}"
+            self.client.start()
 
     def shutdown(self) -> None:
         if self.http is not None:

@@ -46,6 +46,15 @@ class HTTPAPI:
         self.agent = agent
         self.server = agent.server
 
+    def resolve_acl(self, token: str):
+        """Token -> ACL object via the server, 403 on unknown tokens (the
+        single resolution path for all route families)."""
+        from ..server.acl_endpoint import TokenNotFoundError
+        try:
+            return self.server.acl.resolve_token(token)
+        except TokenNotFoundError:
+            raise HTTPError(403, "ACL token not found")
+
     # ------------------------------------------------------------ dispatch
 
     def handle(self, method: str, path: str, query: dict,
@@ -69,14 +78,10 @@ class HTTPAPI:
 
         # ---- ACL resolution (ref command/agent/http.go parseToken +
         # per-endpoint aclObj checks)
-        from ..server.acl_endpoint import TokenNotFoundError
         from ..acl import (
             NS_DISPATCH_JOB, NS_LIST_JOBS, NS_READ_JOB, NS_SUBMIT_JOB,
         )
-        try:
-            acl = s.acl.resolve_token(token)
-        except TokenNotFoundError:
-            raise HTTPError(403, "ACL token not found")
+        acl = self.resolve_acl(token)
 
         # ---- ACL management endpoints
         if parts and parts[0] == "acl":
@@ -523,11 +528,7 @@ class HTTPAPI:
             NS_ALLOC_LIFECYCLE, NS_READ_FS, NS_READ_JOB, NS_READ_LOGS,
         )
         if self.server is not None:
-            from ..server.acl_endpoint import TokenNotFoundError
-            try:
-                acl = self.server.acl.resolve_token(token)
-            except TokenNotFoundError:
-                raise HTTPError(403, "ACL token not found")
+            acl = self.resolve_acl(token)
         elif self.agent.config.acl_enabled:
             # fail closed: a client-only agent cannot resolve tokens until
             # server-RPC token resolution lands (the reference resolves via
@@ -822,12 +823,11 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 namespace = ""
             token = self.headers.get("X-Nomad-Token", "") or \
                 q.get("token", [""])[0]
-            from ..server.acl_endpoint import TokenNotFoundError
             from ..acl import NS_READ_JOB
             try:
-                acl = api.server.acl.resolve_token(token)
-            except TokenNotFoundError:
-                self._respond(403, {"error": "ACL token not found"})
+                acl = api.resolve_acl(token)
+            except HTTPError as e:
+                self._respond(e.code, {"error": e.message})
                 return
             if not (acl.is_management()
                     or (namespace and acl.allow_namespace_operation(

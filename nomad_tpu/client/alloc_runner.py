@@ -36,6 +36,9 @@ class AllocRunner:
         self._waiters_done = threading.Event()
         self._dirty = threading.Event()   # state changed, sync to server
         self.deployment_healthy_at: float = 0.0
+        # set once a terminal client status was acked by the server —
+        # gates local GC (client.gc_alloc)
+        self.synced_terminal = False
 
         self.alloc_dir = os.path.join(client.alloc_dir_root, alloc.id)
 
@@ -57,6 +60,16 @@ class AllocRunner:
                                     "task group not found in job")
             return
         os.makedirs(self.alloc_dir, exist_ok=True)
+
+        # previous-alloc wait + ephemeral disk migration (ref
+        # client/allocwatcher; the migrate_hook in alloc_runner_hooks.go)
+        if alloc.previous_allocation:
+            from .alloc_watcher import PrevAllocWatcher
+            try:
+                PrevAllocWatcher(self.client, alloc,
+                                 logger=self.client.logger).wait_and_migrate()
+            except Exception as e:      # noqa: BLE001 — best-effort
+                self.client.logger(f"allocwatcher: migrate failed: {e!r}")
 
         prestart = [t for t in tg.tasks if t.is_prestart()]
         main = [t for t in tg.tasks

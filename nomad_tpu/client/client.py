@@ -49,6 +49,12 @@ class Client:
         self.node.status = NODE_STATUS_INIT
         self.node.compute_class()
 
+        # GC knobs (ref client/config gc_interval, gc_disk_usage_threshold,
+        # gc_max_allocs)
+        self.gc_interval_sec = 60.0
+        self.gc_max_allocs = 50
+        self.gc_disk_usage_threshold = 80.0
+
         self._lock = threading.Lock()
         self.alloc_runners: dict[str, AllocRunner] = {}
         self._alloc_versions: dict[str, int] = {}   # alloc_id -> modify_index
@@ -66,7 +72,8 @@ class Client:
         self._register()
         for target, name in ((self._heartbeat_loop, "client-heartbeat"),
                              (self._watch_allocations, "client-watch-allocs"),
-                             (self._sync_allocs_loop, "client-alloc-sync")):
+                             (self._sync_allocs_loop, "client-alloc-sync"),
+                             (self._gc_loop, "client-gc")):
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -219,6 +226,13 @@ class Client:
                 continue
             try:
                 self.rpc.node_update_allocs(updates)
+                # GC eligibility: a terminal status the server has acked
+                # (ref client/gc.go — collection waits for server sync)
+                with self._lock:
+                    for u in updates:
+                        if u.client_terminal_status() and \
+                                u.id in self.alloc_runners:
+                            self.alloc_runners[u.id].synced_terminal = True
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: alloc sync failed: {e!r}")
                 with self._dirty_cond:
@@ -377,14 +391,64 @@ class Client:
         stats["Uptime"] = time.monotonic()
         return stats
 
+    def _gc_loop(self) -> None:
+        """Disk-pressure / alloc-count driven GC (ref client/gc.go
+        AllocGarbageCollector.run: checks every interval, evicts oldest
+        terminal allocs while above thresholds)."""
+        while not self._shutdown.wait(self.gc_interval_sec):
+            try:
+                self._gc_check()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"client: gc pass failed: {e!r}")
+
+    def _gc_check(self) -> None:
+        with self._lock:
+            runners = dict(self.alloc_runners)
+        terminal = sorted(
+            (ar for ar in runners.values()
+             if ar.alloc.terminal_status() or ar.synced_terminal),
+            key=lambda ar: ar.alloc.modify_index)  # oldest first
+        if not terminal:
+            return
+        over_count = len(runners) > self.gc_max_allocs
+
+        def disk_pressure() -> bool:
+            try:
+                st = os.statvfs(self.alloc_dir_root)
+            except OSError:
+                return False
+            if not st.f_blocks:
+                return False
+            used = 100.0 * (1 - st.f_bavail / st.f_blocks)
+            return used >= self.gc_disk_usage_threshold
+        for ar in terminal:
+            if not over_count and not disk_pressure():
+                return
+            try:
+                self.gc_alloc(ar.alloc.id)
+                self.logger(f"client: gc'd alloc {ar.alloc.id[:8]}")
+            except (KeyError, ValueError):
+                pass
+            with self._lock:
+                over_count = len(self.alloc_runners) > self.gc_max_allocs
+
     def gc_alloc(self, alloc_id: str) -> None:
         """Destroy one terminal alloc and remove its dir (ref
         client/gc.go Collect)."""
         import shutil
         ar = self._runner(alloc_id)
-        if not ar.alloc.terminal_status() and not ar.is_done():
+        # eligible once the SERVER knows it's over: either the server marked
+        # it terminal (our stored copy reflects server desired/client state)
+        # or we've successfully synced a terminal client status. A merely
+        # is_done() runner whose status hasn't synced yet would be re-added
+        # by the next alloc-watch pass after GC.
+        if not (ar.alloc.terminal_status() or ar.synced_terminal):
             raise ValueError(f"allocation {alloc_id!r} is not terminal")
         ar.destroy()
+        # wait for task processes to actually exit before deleting their
+        # dirs (ref client/allocrunner destroy channel)
+        for tr in list(ar.task_runners.values()):
+            tr.wait_done(timeout=tr.task.kill_timeout_sec + 5.0)
         with self._lock:
             self.alloc_runners.pop(alloc_id, None)
             self._alloc_versions.pop(alloc_id, None)
@@ -395,7 +459,7 @@ class Client:
         """Destroy all terminal allocs (ref client/gc.go CollectAll)."""
         with self._lock:
             candidates = [aid for aid, ar in self.alloc_runners.items()
-                          if ar.alloc.terminal_status() or ar.is_done()]
+                          if ar.alloc.terminal_status() or ar.synced_terminal]
         n = 0
         for aid in candidates:
             try:

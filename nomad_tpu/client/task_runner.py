@@ -50,6 +50,7 @@ class TaskRunner:
         self._thread: Optional[threading.Thread] = None
         self._restarts_in_window: list[float] = []
         self._restart_req = False
+        self._logmon = None
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
         self.restart_policy = tg.restart_policy if tg else None
@@ -106,6 +107,11 @@ class TaskRunner:
         os.makedirs(self.task_dir, exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "local"), exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "secrets"), exist_ok=True)
+        # log rotation per the task's log stanza (ref logmon_hook.go)
+        from .logmon import LogRotator
+        self._logmon = LogRotator(self.task_dir, self.task.name,
+                                  self.task.log_config)
+        self._logmon.start()
 
     def _wait_for_exit(self) -> Optional[ExitResult]:
         while not self._kill.is_set():
@@ -176,8 +182,10 @@ class TaskRunner:
     def restart(self, reason: str = "") -> None:
         """Stop and rerun the task, bypassing restart-policy limits (ref
         taskrunner Restart / client/alloc_endpoint.go Allocations.Restart)."""
-        if self._done.is_set():
-            raise ValueError(f"task {self.task.name!r} is terminal")
+        if self.state.state != TASK_STATE_RUNNING:
+            # pending (between runs) or dead: stop_task would be a no-op and
+            # the flag would fire a spurious restart on the NEXT exit
+            raise ValueError(f"task {self.task.name!r} is not running")
         self._emit(EVENT_RESTART_SIGNAL,
                    reason or "restart requested by user")
         self._restart_req = True
@@ -240,6 +248,8 @@ class TaskRunner:
         self.state.state = TASK_STATE_DEAD
         self.state.failed = failed
         self.state.finished_at = time.time()
+        if self._logmon is not None:
+            self._logmon.stop()
         self.driver.destroy_task(self.task_id)
         self.on_state_change(self.task.name, self.state)
         self._done.set()

@@ -190,6 +190,9 @@ class ServerRpc:
     def alloc_get(self, alloc_id: str):
         return self.rpc.call("Alloc.GetAlloc", alloc_id)
 
+    def node_get_http_addr(self, node_id: str) -> str:
+        return self.rpc.call("Node.GetHTTPAddr", node_id)
+
     def node_update_allocs(self, allocs):
         return self.rpc.call("Node.UpdateAlloc", allocs)
 

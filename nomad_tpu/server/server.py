@@ -58,6 +58,7 @@ RPC_ENDPOINTS = {
     "Node.UpdateAlloc": ("node_update_allocs", True),
     "Alloc.GetAlloc": ("alloc_get", False),
     "Alloc.Stop": ("alloc_stop", True),
+    "Node.GetHTTPAddr": ("node_get_http_addr", False),
     "Job.Register": ("job_register", True),
     "Job.Deregister": ("job_deregister", True),
     "Job.Plan": ("job_plan", True),
@@ -703,6 +704,12 @@ class Server:
         return {"index": index, "eval_ids": [e.id for e in evals]}
 
     # ----------------------------------------------------- Alloc endpoints
+
+    def node_get_http_addr(self, node_id: str) -> str:
+        """HTTP address of a node's agent (used by remote ephemeral-disk
+        migration, ref client/allocwatcher remotePrevAlloc)."""
+        node = self.state.node_by_id(node_id)
+        return node.http_addr if node else ""
 
     def alloc_get(self, alloc_id: str):
         """ref nomad/alloc_endpoint.go GetAlloc"""

@@ -135,7 +135,9 @@ def test_alloc_and_host_stats(agent):
 
 def test_client_gc(agent):
     alloc = _run_job(agent, "gcjob", run_for=0.2)
-    assert wait_until(lambda: agent.client.alloc_runners[alloc.id].is_done())
+    # GC is gated on the server acking the terminal status (sync loop)
+    assert wait_until(lambda: alloc.id not in agent.client.alloc_runners
+                      or agent.client.alloc_runners[alloc.id].synced_terminal)
     alloc_dir = agent.client.alloc_runners[alloc.id].alloc_dir
     out = call(agent, "PUT", "/v1/client/gc")
     assert out["Collected"] >= 1
