@@ -446,6 +446,72 @@ class HTTPAPI:
                                                   NS_READ_SCALING_POLICY))
             return to_api(p), s.state.table_index("scaling_policy")
 
+        # ---- CSI volumes + plugins (ref command/agent/csi_endpoint.go)
+        if parts == ["volumes"]:
+            from ..acl import NS_CSI_LIST_VOLUME, NS_CSI_WRITE_VOLUME
+            from ..structs import CSIVolume, volume_stub
+            if method == "GET":
+                vols = [v for v in s.csi_volume_list(
+                            None if ns == "*" else ns,
+                            query.get("plugin_id") or None)
+                        if acl.allow_namespace_operation(
+                            v.namespace, NS_CSI_LIST_VOLUME)]
+                return [volume_stub(v) for v in vols], \
+                    s.state.table_index("csi_volumes")
+            if method in ("PUT", "POST"):
+                vols = [from_api(CSIVolume, v)
+                        for v in body.get("Volumes", [])]
+                for v in vols:
+                    if not v.namespace:
+                        v.namespace = ns
+                    require(acl.allow_namespace_operation(
+                        v.namespace, NS_CSI_WRITE_VOLUME))
+                try:
+                    return s.csi_volume_register(vols), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+        if parts[:2] == ["volume", "csi"] and len(parts) >= 3:
+            from ..acl import NS_CSI_READ_VOLUME, NS_CSI_WRITE_VOLUME
+            from ..structs import CSIVolume
+            vol_id = urllib.parse.unquote(parts[2])
+            if method == "GET":
+                require(acl.allow_namespace_operation(ns, NS_CSI_READ_VOLUME))
+                vol = s.csi_volume_get(ns, vol_id)
+                if vol is None:
+                    raise HTTPError(404, f"volume {vol_id!r} not found")
+                out = to_api(vol)
+                # never serve mount secrets back out of the API
+                # (ref csi_endpoint.go: Secrets redacted from reads)
+                out.pop("Secrets", None)
+                return out, s.state.table_index("csi_volumes")
+            require(acl.allow_namespace_operation(ns, NS_CSI_WRITE_VOLUME))
+            if method in ("PUT", "POST") and parts[3:] == []:
+                vol = from_api(CSIVolume, body.get("Volume", body))
+                vol.id = vol.id or vol_id
+                if not vol.namespace:
+                    vol.namespace = ns
+                try:
+                    return s.csi_volume_register([vol]), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+            if method == "DELETE":
+                force = query.get("force", "") in ("1", "true")
+                try:
+                    return s.csi_volume_deregister(ns, vol_id, force), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+        if parts == ["plugins"]:
+            require(acl.allow_plugin_list())
+            from ..structs import plugin_stub
+            return [plugin_stub(p) for p in s.csi_plugin_list()], \
+                s.state.table_index("csi_plugins")
+        if parts[:2] == ["plugin", "csi"] and len(parts) == 3:
+            require(acl.allow_plugin_read())
+            p = s.csi_plugin_get(parts[2])
+            if p is None:
+                raise HTTPError(404, "plugin not found")
+            return to_api(p), s.state.table_index("csi_plugins")
+
         # ---- search (ref command/agent/search_endpoint.go)
         if parts == ["search"] and method in ("PUT", "POST"):
             return s.search_prefix(

@@ -275,6 +275,57 @@ def cmd_job_history(args) -> None:
            ["Version", "Stable", "Status"])
 
 
+# ---------------------------------------------------------------- volumes
+
+def cmd_volume_status(args) -> None:
+    """ref command/volume_status.go"""
+    if not args.volume_id:
+        vols = api("GET", "/v1/volumes")
+        _table([[v["ID"], v["Name"], v["PluginID"],
+                 "true" if v["Schedulable"] else "false",
+                 v["AccessMode"]] for v in vols],
+               ["ID", "Name", "Plugin", "Schedulable", "Access"])
+        return
+    v = api("GET", f"/v1/volume/csi/{args.volume_id}")
+    print(f"ID          = {v['ID']}")
+    print(f"Name        = {v['Name']}")
+    print(f"Plugin      = {v['PluginID']}")
+    print(f"Schedulable = {v['Schedulable']}")
+    print(f"Access Mode = {v['AccessMode']}")
+    print(f"Readers     = {len(v.get('ReadClaims') or {})}")
+    print(f"Writers     = {len(v.get('WriteClaims') or {})}")
+
+
+def cmd_volume_register(args) -> None:
+    with open(args.spec) as f:
+        spec = json.load(f)
+    vol = spec.get("Volume", spec)
+    api("PUT", f"/v1/volume/csi/{vol.get('ID', '')}", {"Volume": vol})
+    print(f"==> Registered volume {vol.get('ID')}")
+
+
+def cmd_volume_deregister(args) -> None:
+    force = "?force=true" if args.force else ""
+    api("DELETE", f"/v1/volume/csi/{args.volume_id}{force}")
+    print(f"==> Deregistered volume {args.volume_id}")
+
+
+def cmd_plugin_status(args) -> None:
+    """ref command/plugin_status.go"""
+    if not args.plugin_id:
+        plugins = api("GET", "/v1/plugins")
+        _table([[p["ID"], p["Provider"],
+                 f"{p['ControllersHealthy']}/{p['ControllersExpected']}",
+                 f"{p['NodesHealthy']}/{p['NodesExpected']}"]
+                for p in plugins],
+               ["ID", "Provider", "Controllers", "Nodes"])
+        return
+    p = api("GET", f"/v1/plugin/csi/{args.plugin_id}")
+    print(f"ID       = {p['ID']}")
+    print(f"Provider = {p['Provider']}")
+    print(f"Version  = {p['Version']}")
+
+
 # ------------------------------------------------------------------ nodes
 
 def cmd_node_status(args) -> None:
@@ -629,6 +680,25 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)
+
+    vol = sub.add_parser("volume")
+    vsub = vol.add_subparsers(dest="volume_cmd", required=True)
+    vs = vsub.add_parser("status")
+    vs.add_argument("volume_id", nargs="?", default="")
+    vs.set_defaults(fn=cmd_volume_status)
+    vr = vsub.add_parser("register")
+    vr.add_argument("spec")
+    vr.set_defaults(fn=cmd_volume_register)
+    vd = vsub.add_parser("deregister")
+    vd.add_argument("volume_id")
+    vd.add_argument("-force", action="store_true")
+    vd.set_defaults(fn=cmd_volume_deregister)
+
+    plug = sub.add_parser("plugin")
+    psub = plug.add_subparsers(dest="plugin_cmd", required=True)
+    ps = psub.add_parser("status")
+    ps.add_argument("plugin_id", nargs="?", default="")
+    ps.set_defaults(fn=cmd_plugin_status)
     return p
 
 

@@ -50,6 +50,14 @@ class AllocRunner:
         self._thread.start()
 
     def _run(self) -> None:
+        try:
+            self._run_impl()
+        finally:
+            # release any CSI claims/mounts whatever path we exited on
+            # (ref csi_hook.go Postrun)
+            self.client.csi_manager.unmount_all(self.alloc)
+
+    def _run_impl(self) -> None:
         alloc = self.alloc
         if alloc.server_terminal_status():
             return
@@ -70,6 +78,18 @@ class AllocRunner:
                                  logger=self.client.logger).wait_and_migrate()
             except Exception as e:      # noqa: BLE001 — best-effort
                 self.client.logger(f"allocwatcher: migrate failed: {e!r}")
+
+        # CSI volumes: claim + stage + publish before any task starts
+        # (ref client/allocrunner/csi_hook.go Prerun)
+        csi_reqs = [r for r in tg.volumes.values() if r.type == "csi"]
+        if csi_reqs:
+            try:
+                for req in csi_reqs:
+                    self.client.csi_manager.mount_volume(alloc, req)
+            except Exception as e:      # noqa: BLE001
+                self._set_client_status(ALLOC_CLIENT_FAILED,
+                                        f"CSI volume mount failed: {e}")
+                return
 
         prestart = [t for t in tg.tasks if t.is_prestart()]
         main = [t for t in tg.tasks

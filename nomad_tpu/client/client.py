@@ -38,6 +38,9 @@ class Client:
         self.drivers: dict[str, Driver] = drivers if drivers is not None \
             else {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
 
+        from .csimanager import CSIManager
+        self.csi_manager = CSIManager(self)
+
         node_id = self.state_db.get_node_id()
         self.node: Node = fingerprint_node(data_dir, datacenter, node_class,
                                            name, node_id)
@@ -468,6 +471,16 @@ class Client:
             except (KeyError, ValueError):
                 pass
         return n
+
+    def register_csi_plugin(self, plugin_id: str, plugin) -> None:
+        """Attach a CSI node plugin and refresh the node fingerprint (ref
+        client/pluginmanager/csimanager fingerprint loop)."""
+        self.csi_manager.register_plugin(plugin_id, plugin)
+        self.node.csi_node_plugins = self.csi_manager.fingerprint()
+        try:
+            self.rpc.node_register(self.node)
+        except Exception as e:          # noqa: BLE001
+            self.logger(f"client: csi fingerprint update failed: {e!r}")
 
     def get_driver(self, name: str) -> Driver:
         driver = self.drivers.get(name)

@@ -1,0 +1,138 @@
+"""Client CSI manager (ref client/pluginmanager/csimanager/: volume
+staging/publishing for allocs + plugin fingerprinting into the node).
+
+The reference talks gRPC to external CSI plugin processes (plugins/csi/).
+Here the plugin boundary is the `CSIPluginClient` interface; the built-in
+`HostPathCSIPlugin` implements it with node-local directories (the upstream
+csi-driver-host-path analog), which is also what tests exercise. Real
+drivers slot in behind the same stage/publish/unpublish contract.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+from ..structs.csi import CSIVolumeClaim, CLAIM_READ, CLAIM_STATE_READY_TO_FREE, CLAIM_WRITE
+
+
+class CSIPluginClient:
+    """ref plugins/csi CSIPlugin interface (node service subset)."""
+
+    name = "csi-plugin"
+    requires_controller = False
+
+    def fingerprint(self) -> dict:
+        return {"healthy": True, "provider": self.name,
+                "provider_version": "0.1.0",
+                "requires_controller": self.requires_controller}
+
+    def node_stage_volume(self, volume_id: str, context: dict) -> None:
+        pass
+
+    def node_publish_volume(self, volume_id: str, target_path: str,
+                            readonly: bool, context: dict) -> None:
+        raise NotImplementedError
+
+    def node_unpublish_volume(self, volume_id: str,
+                              target_path: str) -> None:
+        raise NotImplementedError
+
+
+class HostPathCSIPlugin(CSIPluginClient):
+    """Node-local directory-backed volumes (the csi-driver-host-path
+    pattern): publish = symlink the per-volume dir at the target path."""
+
+    name = "hostpath"
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _vol_dir(self, volume_id: str) -> str:
+        return os.path.join(self.base_dir, volume_id)
+
+    def node_stage_volume(self, volume_id: str, context: dict) -> None:
+        os.makedirs(self._vol_dir(volume_id), exist_ok=True)
+
+    def node_publish_volume(self, volume_id, target_path, readonly, context):
+        os.makedirs(os.path.dirname(target_path), exist_ok=True)
+        if os.path.islink(target_path):
+            os.unlink(target_path)
+        os.symlink(self._vol_dir(volume_id), target_path)
+
+    def node_unpublish_volume(self, volume_id, target_path):
+        if os.path.islink(target_path):
+            os.unlink(target_path)
+        elif os.path.isdir(target_path):
+            shutil.rmtree(target_path, ignore_errors=True)
+
+
+class CSIManager:
+    """Per-client manager: claims volumes through the servers and drives the
+    node plugin's stage/publish lifecycle for each alloc (ref
+    csimanager/volume.go MountVolume/UnmountVolume)."""
+
+    def __init__(self, client):
+        self.client = client
+        self.plugins: dict[str, CSIPluginClient] = {}
+        # (alloc_id, vol_id) -> (plugin_id, target_path)
+        self._mounts: dict[tuple[str, str], tuple[str, str]] = {}
+
+    def register_plugin(self, plugin_id: str,
+                        plugin: CSIPluginClient) -> None:
+        self.plugins[plugin_id] = plugin
+
+    def fingerprint(self) -> dict[str, dict]:
+        """node.csi_node_plugins payload."""
+        return {pid: p.fingerprint() for pid, p in self.plugins.items()}
+
+    # ------------------------------------------------------------- mounts
+
+    def mount_volume(self, alloc, req) -> str:
+        """Claim + stage + publish; returns the alloc-local mount path
+        (ref csimanager MountVolume)."""
+        ns = alloc.namespace
+        vol = self.client.rpc.csi_volume_get(ns, req.source)
+        if vol is None:
+            raise ValueError(f"CSI volume {req.source!r} not found")
+        plugin = self.plugins.get(vol.plugin_id)
+        if plugin is None:
+            raise ValueError(
+                f"node has no CSI plugin {vol.plugin_id!r}")
+        mode = CLAIM_READ if req.read_only else CLAIM_WRITE
+        claim = CSIVolumeClaim(alloc_id=alloc.id,
+                               node_id=self.client.node.id, mode=mode)
+        self.client.rpc.csi_volume_claim(ns, vol.id, claim)
+        # record before publish: a failed stage/publish must still release
+        # the claim in Postrun (unmount_all)
+        target = os.path.join(self.client.alloc_dir_root, alloc.id,
+                              "volumes", req.name)
+        self._mounts[(alloc.id, vol.id)] = (vol.plugin_id, target)
+        plugin.node_stage_volume(vol.id, vol.context)
+        plugin.node_publish_volume(vol.id, target, req.read_only,
+                                   vol.context)
+        return target
+
+    def unmount_all(self, alloc) -> None:
+        """Unpublish + release every claim this alloc holds (ref
+        csimanager UnmountVolume + csi_hook Postrun)."""
+        for (alloc_id, vol_id), (plugin_id, target) in \
+                list(self._mounts.items()):
+            if alloc_id != alloc.id:
+                continue
+            plugin = self.plugins.get(plugin_id)
+            if plugin is not None:
+                try:
+                    plugin.node_unpublish_volume(vol_id, target)
+                except Exception as e:  # noqa: BLE001 — must keep releasing
+                    self.client.logger(f"csi: unpublish failed: {e!r}")
+            try:
+                self.client.rpc.csi_volume_claim(
+                    alloc.namespace, vol_id,
+                    CSIVolumeClaim(alloc_id=alloc.id,
+                                   node_id=self.client.node.id,
+                                   state=CLAIM_STATE_READY_TO_FREE))
+            except Exception as e:      # noqa: BLE001 — server may be gone
+                self.client.logger(f"csi: release claim failed: {e!r}")
+            del self._mounts[(alloc_id, vol_id)]

@@ -193,6 +193,12 @@ class ServerRpc:
     def node_get_http_addr(self, node_id: str) -> str:
         return self.rpc.call("Node.GetHTTPAddr", node_id)
 
+    def csi_volume_get(self, namespace: str, volume_id: str):
+        return self.rpc.call("CSIVolume.Get", namespace, volume_id)
+
+    def csi_volume_claim(self, namespace: str, volume_id: str, claim):
+        return self.rpc.call("CSIVolume.Claim", namespace, volume_id, claim)
+
     def node_update_allocs(self, allocs):
         return self.rpc.call("Node.UpdateAlloc", allocs)
 

@@ -427,15 +427,19 @@ class CSIVolumeChecker(ChecksFeasibility):
         self.ctx = ctx
         self.plugins: set[str] = set()
 
-    def set_volumes(self, volumes: dict, csi_volume_lookup=None) -> None:
+    def set_volumes(self, volumes: dict, namespace: str = "default",
+                    csi_volume_lookup=None) -> None:
         self.plugins = set()
-        self._lookup = csi_volume_lookup
+        if csi_volume_lookup is None:
+            by_id = getattr(self.ctx.state, "csi_volume_by_id", None)
+            if by_id is not None:
+                csi_volume_lookup = lambda src: by_id(namespace, src)  # noqa: E731
         for req in volumes.values():
             if req.type == "csi":
                 plugin = None
                 if csi_volume_lookup is not None:
                     vol = csi_volume_lookup(req.source)
-                    plugin = vol.get("plugin_id") if vol else None
+                    plugin = getattr(vol, "plugin_id", None) if vol else None
                 self.plugins.add(plugin or req.source)
 
     def feasible(self, node: Node) -> bool:
@@ -456,11 +460,16 @@ class FeasibilityWrapper(FeasibleIterator):
 
     def __init__(self, ctx: EvalContext, source: FeasibleIterator,
                  job_checks: list[ChecksFeasibility],
-                 tg_checks: list[ChecksFeasibility]):
+                 tg_checks: list[ChecksFeasibility],
+                 tg_available: Optional[list[ChecksFeasibility]] = None):
         self.ctx = ctx
         self.source = source
         self.job_checks = job_checks
         self.tg_checks = tg_checks
+        # "available" checks depend on state outside the computed node class
+        # (CSI plugin health) so they can never be class-cached
+        # (ref feasible.go FeasibilityWrapper tgAvailable)
+        self.tg_available = tg_available or []
         self.tg_name = ""
 
     def set_task_group(self, tg: str) -> None:
@@ -508,6 +517,8 @@ class FeasibilityWrapper(FeasibleIterator):
                         elig.set_task_group_eligibility(ok, self.tg_name, klass)
                     if not ok:
                         continue
+                if not all(c.feasible(node) for c in self.tg_available):
+                    continue
             return node
 
 

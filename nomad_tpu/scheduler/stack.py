@@ -63,6 +63,7 @@ class GenericStack:
         self.tg_devices = DeviceChecker(ctx)
         self.tg_host_volumes = HostVolumeChecker(ctx)
         self.tg_csi_volumes = CSIVolumeChecker(ctx)
+        self.job_namespace = "default"
         self.tg_network = NetworkChecker(ctx)
 
         self.wrapped_checks = FeasibilityWrapper(
@@ -70,7 +71,8 @@ class GenericStack:
             job_checks=[self.job_constraint],
             tg_checks=[self.tg_drivers, self.tg_constraint,
                        self.tg_host_volumes, self.tg_devices,
-                       self.tg_network, self.tg_csi_volumes])
+                       self.tg_network],
+            tg_available=[self.tg_csi_volumes])
         self.distinct_hosts = DistinctHostsIterator(ctx, self.wrapped_checks)
         self.distinct_property = DistinctPropertyIterator(
             ctx, self.distinct_hosts)
@@ -105,6 +107,7 @@ class GenericStack:
         if self.job_version is not None and self.job_version == job.version:
             return
         self.job_version = job.version
+        self.job_namespace = job.namespace
         self.job_constraint.set_constraints(list(job.constraints))
         self.distinct_hosts.set_job(job)
         self.distinct_property.set_job(job)
@@ -136,7 +139,7 @@ class GenericStack:
         self.tg_constraint.set_constraints(constraints)
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
-        self.tg_csi_volumes.set_volumes(tg.volumes)
+        self.tg_csi_volumes.set_volumes(tg.volumes, self.job_namespace)
         self.tg_network.set_network(tg.networks[0] if tg.networks else None)
         self.distinct_hosts.set_task_group(tg)
         self.distinct_property.set_task_group(tg)
@@ -168,13 +171,15 @@ class SystemStack:
         self.tg_devices = DeviceChecker(ctx)
         self.tg_host_volumes = HostVolumeChecker(ctx)
         self.tg_csi_volumes = CSIVolumeChecker(ctx)
+        self.job_namespace = "default"
         self.tg_network = NetworkChecker(ctx)
         self.wrapped_checks = FeasibilityWrapper(
             ctx, self.source,
             job_checks=[self.job_constraint],
             tg_checks=[self.tg_drivers, self.tg_constraint,
                        self.tg_host_volumes, self.tg_devices,
-                       self.tg_network, self.tg_csi_volumes])
+                       self.tg_network],
+            tg_available=[self.tg_csi_volumes])
         self.distinct_property = DistinctPropertyIterator(
             ctx, self.wrapped_checks)
         rank_source = FeasibleRankIterator(ctx, self.distinct_property)
@@ -187,6 +192,7 @@ class SystemStack:
         self.source.set_nodes(nodes)
 
     def set_job(self, job: Job) -> None:
+        self.job_namespace = job.namespace
         self.job_constraint.set_constraints(list(job.constraints))
         self.distinct_property.set_job(job)
         self.bin_pack.set_job(job)
@@ -201,7 +207,7 @@ class SystemStack:
         self.tg_constraint.set_constraints(constraints)
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
-        self.tg_csi_volumes.set_volumes(tg.volumes)
+        self.tg_csi_volumes.set_volumes(tg.volumes, self.job_namespace)
         self.tg_network.set_network(tg.networks[0] if tg.networks else None)
         self.distinct_property.set_task_group(tg)
         self.wrapped_checks.set_task_group(tg.name)

@@ -53,6 +53,9 @@ NAMESPACE_UPSERT = "NamespaceUpsertRequestType"
 NAMESPACE_DELETE = "NamespaceDeleteRequestType"
 SCALING_EVENT_REGISTER = "ScalingEventRegisterRequestType"
 JOB_STABILITY = "JobStabilityRequestType"
+CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequestType"
+CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequestType"
+CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
 
 
 @dataclasses.dataclass
@@ -177,6 +180,16 @@ class NomadFSM:
             s.update_job_stability(index, payload["namespace"],
                                    payload["job_id"], payload["version"],
                                    payload["stable"])
+        elif msg_type == CSI_VOLUME_REGISTER:
+            for vol in payload["volumes"]:
+                s.upsert_csi_volume(index, vol)
+        elif msg_type == CSI_VOLUME_DEREGISTER:
+            s.delete_csi_volume(index, payload["namespace"],
+                                payload["volume_id"],
+                                payload.get("force", False))
+        elif msg_type == CSI_VOLUME_CLAIM:
+            s.csi_volume_claim(index, payload["namespace"],
+                               payload["volume_id"], payload["claim"])
         else:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
@@ -207,6 +220,8 @@ class NomadFSM:
                 "scaling_policies": s.scaling_policies,
                 "scaling_policy_by_target": s._scaling_policy_by_target,
                 "scaling_events": s.scaling_events,
+                "csi_volumes": s.csi_volumes,
+                "csi_plugins": s.csi_plugins,
             }
             return pickle.dumps(blob)
 
@@ -233,6 +248,8 @@ class NomadFSM:
             s._scaling_policy_by_target = dict(
                 blob.get("scaling_policy_by_target", {}))
             s.scaling_events = dict(blob.get("scaling_events", {}))
+            s.csi_volumes = dict(blob.get("csi_volumes", {}))
+            s.csi_plugins = dict(blob.get("csi_plugins", {}))
             s._acl_token_by_secret = {
                 t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes

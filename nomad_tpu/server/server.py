@@ -71,6 +71,13 @@ RPC_ENDPOINTS = {
     "Scaling.GetPolicy": ("scaling_policy_get", False),
     "Search.PrefixSearch": ("search_prefix", False),
     "Search.FuzzySearch": ("search_fuzzy", False),
+    "CSIVolume.Register": ("csi_volume_register", True),
+    "CSIVolume.Deregister": ("csi_volume_deregister", True),
+    "CSIVolume.Claim": ("csi_volume_claim", True),
+    "CSIVolume.List": ("csi_volume_list", False),
+    "CSIVolume.Get": ("csi_volume_get", False),
+    "CSIPlugin.List": ("csi_plugin_list", False),
+    "CSIPlugin.Get": ("csi_plugin_get", False),
     "Eval.Dequeue": ("eval_dequeue", True),
     "Eval.Ack": ("eval_ack", True),
     "Eval.Nack": ("eval_nack", True),
@@ -107,6 +114,8 @@ class Server:
         self.core_scheduler = CoreScheduler(self)
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
+        from .volume_watcher import VolumeWatcher
+        self.volume_watcher = VolumeWatcher(self)
         self.scheduler_types = SCHEDULER_TYPES
         self.workers = [Worker(self, i) for i in range(num_workers)]
         self.gc_interval = gc_interval
@@ -221,6 +230,7 @@ class Server:
         self.heartbeats.stop()
         self.deployment_watcher.stop()
         self.drainer.stop()
+        self.volume_watcher.stop()
 
     def _establish_leadership(self) -> None:
         """ref nomad/leader.go:224"""
@@ -233,6 +243,7 @@ class Server:
         self.heartbeats.start()
         self.deployment_watcher.start()
         self.drainer.start()
+        self.volume_watcher.start()
         self.is_leader = True
         # restore: re-enqueue non-terminal evals, re-track periodic jobs
         for ev in self.state.iter_evals():
@@ -564,6 +575,72 @@ class Server:
 
     def scaling_policy_get(self, policy_id: str):
         return self.state.scaling_policy_by_id(policy_id)
+
+    # --------------------------------------------------------- CSI endpoints
+
+    def csi_volume_register(self, volumes: list) -> dict:
+        """ref nomad/csi_endpoint.go CSIVolume.Register"""
+        for vol in volumes:
+            if not vol.id:
+                raise ValueError("volume requires an ID")
+            if not vol.plugin_id:
+                raise ValueError(f"volume {vol.id!r} requires a plugin ID")
+        from .fsm import CSI_VOLUME_REGISTER
+        index = self.raft.apply(CSI_VOLUME_REGISTER, {"volumes": volumes})
+        return {"index": index}
+
+    def csi_volume_deregister(self, namespace: str, volume_id: str,
+                              force: bool = False) -> dict:
+        from .fsm import CSI_VOLUME_DEREGISTER
+        # fail fast with a readable error before paying the raft round-trip
+        vol = self.state.csi_volume_by_id(namespace, volume_id)
+        if vol is None:
+            raise ValueError(f"volume {volume_id!r} not found")
+        if vol.in_use() and not force:
+            raise ValueError(f"volume {volume_id!r} is in use")
+        index = self.raft.apply(CSI_VOLUME_DEREGISTER, {
+            "namespace": namespace, "volume_id": volume_id, "force": force})
+        return {"index": index}
+
+    def csi_volume_claim(self, namespace: str, volume_id: str, claim) -> dict:
+        """Claim (or release, via claim.state) a volume for an alloc
+        (ref csi_endpoint.go CSIVolume.Claim)."""
+        from .fsm import CSI_VOLUME_CLAIM
+        from ..structs.csi import CLAIM_STATE_READY_TO_FREE
+        vol = self.state.csi_volume_by_id(namespace, volume_id)
+        if vol is None:
+            raise ValueError(f"volume {volume_id!r} not found")
+        if claim.state != CLAIM_STATE_READY_TO_FREE:
+            if not vol.schedulable:
+                raise ValueError(f"volume {volume_id!r} is not schedulable")
+            # enforce claim limits BEFORE the raft round-trip: the clustered
+            # applier swallows FSM errors, so an in-FSM rejection would be
+            # reported as success to the caller
+            from ..structs.csi import CLAIM_WRITE
+            if claim.mode == CLAIM_WRITE \
+                    and claim.alloc_id not in vol.write_claims \
+                    and not vol.claim_ok(claim.mode):
+                raise ValueError(
+                    f"volume {volume_id!r} has no free write claims")
+            if claim.mode != CLAIM_WRITE and not vol.claim_ok(claim.mode):
+                raise ValueError(f"volume {volume_id!r} not readable")
+        index = self.raft.apply(CSI_VOLUME_CLAIM, {
+            "namespace": namespace, "volume_id": volume_id, "claim": claim})
+        return {"index": index,
+                "volume": self.state.csi_volume_by_id(namespace, volume_id)}
+
+    def csi_volume_list(self, namespace: Optional[str] = None,
+                        plugin_id: Optional[str] = None) -> list:
+        return self.state.iter_csi_volumes(namespace, plugin_id)
+
+    def csi_volume_get(self, namespace: str, volume_id: str):
+        return self.state.csi_volume_by_id(namespace, volume_id)
+
+    def csi_plugin_list(self) -> list:
+        return self.state.iter_csi_plugins()
+
+    def csi_plugin_get(self, plugin_id: str):
+        return self.state.csi_plugin_by_id(plugin_id)
 
     # ------------------------------------------------------ Search endpoints
 

@@ -1,0 +1,64 @@
+"""Volume watcher: CSI claim reaping (ref nomad/volumewatcher/
+volumes_watcher.go + volume_watcher.go — the leader-only loop that releases
+claims held by terminal allocations so volumes become schedulable again).
+
+The reference drives controller/node Unpublish RPCs through the claimed
+node's plugin; our detach path is the claim state machine only (the client's
+csimanager unmounts on its side when the alloc stops), so reaping advances
+claims straight to ready-to-free.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..structs.csi import CSIVolumeClaim, CLAIM_STATE_READY_TO_FREE
+
+
+class VolumeWatcher:
+    """ref volumeswatcher.Watcher"""
+
+    def __init__(self, server, interval: float = 5.0):
+        self.server = server
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="volume-watcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # join before a leadership re-acquire clears the stop event, else
+        # the old loop never observes it and two watchers run
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.reap_once()
+            except Exception as e:      # noqa: BLE001
+                self.server.logger(f"volumewatcher: {e!r}")
+
+    def reap_once(self) -> int:
+        """Release claims whose alloc is gone or terminal (ref
+        volume_watcher.go volumeReapImpl)."""
+        from .fsm import CSI_VOLUME_CLAIM
+        state = self.server.state
+        released = 0
+        for vol in state.iter_csi_volumes():
+            for alloc_id in list(vol.read_claims) + list(vol.write_claims):
+                alloc = state.alloc_by_id(alloc_id)
+                if alloc is not None and not alloc.terminal_status():
+                    continue
+                self.server.raft.apply(CSI_VOLUME_CLAIM, {
+                    "namespace": vol.namespace, "volume_id": vol.id,
+                    "claim": CSIVolumeClaim(
+                        alloc_id=alloc_id,
+                        state=CLAIM_STATE_READY_TO_FREE)})
+                released += 1
+        return released

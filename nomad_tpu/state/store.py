@@ -57,6 +57,9 @@ class StateStore:
         self.scaling_policies: dict[str, object] = {}      # id -> policy
         self._scaling_policy_by_target: dict[tuple, str] = {}
         self.scaling_events: dict[tuple[str, str], dict[str, list]] = {}
+        # CSI (ref schema.go csi_volumes/csi_plugins)
+        self.csi_volumes: dict[tuple[str, str], object] = {}  # (ns, id)
+        self.csi_plugins: dict[str, object] = {}              # plugin id
 
         # secondary indexes
         self._allocs_by_node: dict[str, set[str]] = {}
@@ -125,6 +128,8 @@ class StateStore:
             out._scaling_policy_by_target = dict(self._scaling_policy_by_target)
             out.scaling_events = {k: {g: list(evs) for g, evs in v.items()}
                                   for k, v in self.scaling_events.items()}
+            out.csi_volumes = dict(self.csi_volumes)
+            out.csi_plugins = dict(self.csi_plugins)
             out._allocs_by_node = {k: set(v)
                                    for k, v in self._allocs_by_node.items()}
             out._allocs_by_job = {k: set(v)
@@ -176,6 +181,7 @@ class StateStore:
                 node.create_index = index
             node.modify_index = self._bump("nodes", index)
             self.nodes[node.id] = node
+            self._update_csi_plugins_from_node(index, node)
             self._emit("Node", "NodeRegistration", node.modify_index, node)
             self._commit()
 
@@ -183,6 +189,7 @@ class StateStore:
         with self._lock:
             for nid in node_ids:
                 self.nodes.pop(nid, None)
+                self._delete_node_from_csi_plugins(index, nid)
             self._bump("nodes", index)
             self._commit()
 
@@ -422,6 +429,152 @@ class StateStore:
         with self._lock:
             return {g: list(evs) for g, evs in
                     self.scaling_events.get((ns, job_id), {}).items()}
+
+    # ------------------------------------------------------------------ CSI
+
+    def _update_csi_plugins_from_node(self, index: int, node) -> None:
+        """Fold one node's fingerprinted CSI plugins into the aggregated
+        plugin table (ref state_store.go updateNodeCSIPlugins). Holds lock."""
+        from ..structs.csi import CSIPlugin
+        seen = set()
+        for pid, info in {**node.csi_node_plugins,
+                          **node.csi_controller_plugins}.items():
+            seen.add(pid)
+            plug = self.csi_plugins.get(pid)
+            plug = plug.copy() if plug else CSIPlugin(
+                id=pid, create_index=index)
+            plug.provider = info.get("provider", plug.provider)
+            plug.version = info.get("provider_version", plug.version)
+            if info.get("requires_controller"):
+                plug.controller_required = True
+            if pid in node.csi_node_plugins:
+                plug.nodes[node.id] = bool(
+                    node.csi_node_plugins[pid].get("healthy", False))
+            if pid in node.csi_controller_plugins:
+                plug.controllers[node.id] = bool(
+                    node.csi_controller_plugins[pid].get("healthy", False))
+            plug.modify_index = self._bump("csi_plugins", index)
+            self.csi_plugins[pid] = plug
+        # node no longer fingerprints a plugin -> drop its contribution
+        for pid in [p for p in self.csi_plugins if p not in seen]:
+            plug = self.csi_plugins[pid]
+            if node.id in plug.nodes or node.id in plug.controllers:
+                plug = plug.copy()
+                plug.nodes.pop(node.id, None)
+                plug.controllers.pop(node.id, None)
+                plug.modify_index = self._bump("csi_plugins", index)
+                if plug.is_empty():
+                    del self.csi_plugins[pid]
+                else:
+                    self.csi_plugins[pid] = plug
+
+    def _delete_node_from_csi_plugins(self, index: int, node_id: str) -> None:
+        for pid in list(self.csi_plugins):
+            plug = self.csi_plugins[pid]
+            if node_id in plug.nodes or node_id in plug.controllers:
+                plug = plug.copy()
+                plug.nodes.pop(node_id, None)
+                plug.controllers.pop(node_id, None)
+                self._bump("csi_plugins", index)
+                if plug.is_empty():
+                    del self.csi_plugins[pid]
+                else:
+                    self.csi_plugins[pid] = plug
+
+    def upsert_csi_volume(self, index: int, vol) -> None:
+        """ref state_store.go CSIVolumeRegister"""
+        with self._lock:
+            key = (vol.namespace, vol.id)
+            existing = self.csi_volumes.get(key)
+            vol = vol.copy()
+            if existing:
+                vol.create_index = existing.create_index
+                # claims survive re-registration
+                vol.read_claims = {k: v.copy() for k, v
+                                   in existing.read_claims.items()}
+                vol.write_claims = {k: v.copy() for k, v
+                                    in existing.write_claims.items()}
+            else:
+                vol.create_index = index
+            vol.modify_index = self._bump("csi_volumes", index)
+            self.csi_volumes[key] = vol
+            self._commit()
+
+    def delete_csi_volume(self, index: int, ns: str, vol_id: str,
+                          force: bool = False) -> None:
+        """ref state_store.go CSIVolumeDeregister"""
+        with self._lock:
+            vol = self.csi_volumes.get((ns, vol_id))
+            if vol is None:
+                raise ValueError(f"volume {vol_id!r} not found")
+            if vol.in_use() and not force:
+                raise ValueError(f"volume {vol_id!r} is in use")
+            del self.csi_volumes[(ns, vol_id)]
+            self._bump("csi_volumes", index)
+            self._commit()
+
+    def csi_volume_claim(self, index: int, ns: str, vol_id: str,
+                         claim) -> None:
+        """Take or update one claim (ref state_store.go CSIVolumeClaim)."""
+        from ..structs.csi import CLAIM_WRITE, CLAIM_STATE_READY_TO_FREE
+        with self._lock:
+            vol = self.csi_volumes.get((ns, vol_id))
+            if vol is None:
+                raise ValueError(f"volume {vol_id!r} not found")
+            vol = vol.copy()
+            if claim.state == CLAIM_STATE_READY_TO_FREE:
+                vol.read_claims.pop(claim.alloc_id, None)
+                vol.write_claims.pop(claim.alloc_id, None)
+            elif claim.mode == CLAIM_WRITE:
+                if not vol.claim_ok(claim.mode) and \
+                        claim.alloc_id not in vol.write_claims:
+                    raise ValueError(
+                        f"volume {vol_id!r} has no free write claims")
+                vol.read_claims.pop(claim.alloc_id, None)
+                vol.write_claims[claim.alloc_id] = claim.copy()
+            else:
+                if not vol.claim_ok(claim.mode):
+                    raise ValueError(f"volume {vol_id!r} not readable")
+                vol.read_claims[claim.alloc_id] = claim.copy()
+            vol.modify_index = self._bump("csi_volumes", index)
+            self.csi_volumes[(ns, vol_id)] = vol
+            self._commit()
+
+    def _csi_denormalize(self, vol):
+        """Attach live plugin health to a volume copy at read time
+        (ref state_store.go CSIVolumeDenormalize)."""
+        plug = self.csi_plugins.get(vol.plugin_id)
+        vol = vol.copy()
+        if plug is not None:
+            vol.controllers_healthy = plug.controllers_healthy
+            vol.nodes_healthy = plug.nodes_healthy
+            vol.controller_required = plug.controller_required
+            vol.schedulable = plug.nodes_healthy > 0 and (
+                not plug.controller_required or plug.controllers_healthy > 0)
+        else:
+            vol.schedulable = False
+        return vol
+
+    def csi_volume_by_id(self, ns: str, vol_id: str):
+        with self._lock:
+            vol = self.csi_volumes.get((ns, vol_id))
+            return self._csi_denormalize(vol) if vol else None
+
+    def iter_csi_volumes(self, ns: Optional[str] = None,
+                         plugin_id: Optional[str] = None) -> list:
+        with self._lock:
+            return [self._csi_denormalize(v)
+                    for v in self.csi_volumes.values()
+                    if (ns is None or v.namespace == ns)
+                    and (plugin_id is None or v.plugin_id == plugin_id)]
+
+    def csi_plugin_by_id(self, plugin_id: str):
+        with self._lock:
+            return self.csi_plugins.get(plugin_id)
+
+    def iter_csi_plugins(self) -> list:
+        with self._lock:
+            return sorted(self.csi_plugins.values(), key=lambda p: p.id)
 
     def update_job_stability(self, index: int, ns: str, job_id: str,
                              version: int, stable: bool) -> None:
@@ -1035,6 +1188,8 @@ class StateSnapshot:
         self.allocs = dict(store.allocs)
         self.deployments = dict(store.deployments)
         self.scheduler_config = store.scheduler_config
+        self.csi_volumes = dict(store.csi_volumes)
+        self.csi_plugins = dict(store.csi_plugins)
         self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
         self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
         self._evals_by_job = {k: set(v) for k, v in store._evals_by_job.items()}
@@ -1046,6 +1201,9 @@ class StateSnapshot:
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
         return self.nodes.get(node_id)
+
+    def csi_volume_by_id(self, ns: str, vol_id: str):
+        return self.csi_volumes.get((ns, vol_id))
 
     def iter_nodes(self) -> list[Node]:
         return list(self.nodes.values())

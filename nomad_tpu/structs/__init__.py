@@ -74,6 +74,14 @@ from .operator import (  # noqa: F401
     SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU,
     VALID_SCHEDULER_ALGORITHMS,
 )
+from .csi import (  # noqa: F401
+    CSIPlugin, CSIVolume, CSIVolumeClaim, plugin_stub, volume_stub,
+    ACCESS_MODE_MULTI_NODE_MULTI_WRITER, ACCESS_MODE_MULTI_NODE_READER,
+    ACCESS_MODE_MULTI_NODE_SINGLE_WRITER, ACCESS_MODE_SINGLE_NODE_READER,
+    ACCESS_MODE_SINGLE_NODE_WRITER, ATTACHMENT_MODE_BLOCK,
+    ATTACHMENT_MODE_FS, CLAIM_READ, CLAIM_STATE_READY_TO_FREE,
+    CLAIM_STATE_TAKEN, CLAIM_WRITE,
+)
 from .scaling import (  # noqa: F401
     ScalingEvent, ScalingPolicyState, policy_from_group,
     JOB_TRACKED_SCALING_EVENTS, SCALING_POLICY_TYPE_HORIZONTAL,

@@ -1,0 +1,232 @@
+"""CSI subsystem tests (modeled on nomad/csi_endpoint_test.go,
+nomad/state/state_store_test.go CSI cases, nomad/volumewatcher tests, and
+client csimanager/csi_hook tests)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.client.csimanager import HostPathCSIPlugin
+from nomad_tpu.server import Server
+from nomad_tpu.structs import (
+    CSIVolume, CSIVolumeClaim, Node, VolumeRequest,
+    ACCESS_MODE_MULTI_NODE_READER, ACCESS_MODE_SINGLE_NODE_WRITER,
+    CLAIM_READ, CLAIM_STATE_READY_TO_FREE, CLAIM_WRITE,
+)
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _csi_node(plugin="hostpath", healthy=True):
+    node = mock.node()
+    node.csi_node_plugins = {plugin: {"healthy": healthy,
+                                      "provider": plugin,
+                                      "provider_version": "0.1.0"}}
+    return node
+
+
+def _vol(vol_id="vol0", plugin="hostpath",
+         access=ACCESS_MODE_SINGLE_NODE_WRITER):
+    return CSIVolume(id=vol_id, name=vol_id, plugin_id=plugin,
+                     access_mode=access)
+
+
+def test_plugin_aggregation_from_nodes(server):
+    n1, n2 = _csi_node(), _csi_node(healthy=False)
+    server.node_register(n1)
+    server.node_register(n2)
+    plugins = server.csi_plugin_list()
+    assert len(plugins) == 1
+    p = plugins[0]
+    assert p.id == "hostpath"
+    assert len(p.nodes) == 2 and p.nodes_healthy == 1
+    # node deregistration removes its contribution
+    server.raft.apply("NodeDeregisterRequestType", {"node_ids": [n2.id]})
+    p = server.csi_plugin_get("hostpath")
+    assert len(p.nodes) == 1 and p.nodes_healthy == 1
+
+
+def test_volume_register_claim_lifecycle(server):
+    server.node_register(_csi_node())
+    server.csi_volume_register([_vol()])
+    vol = server.csi_volume_get("default", "vol0")
+    assert vol.schedulable
+    # write claim taken; second writer refused (single-node-writer)
+    c1 = CSIVolumeClaim(alloc_id="a1", node_id="n1", mode=CLAIM_WRITE)
+    server.csi_volume_claim("default", "vol0", c1)
+    with pytest.raises(ValueError, match="free write claims"):
+        server.csi_volume_claim("default", "vol0", CSIVolumeClaim(
+            alloc_id="a2", node_id="n1", mode=CLAIM_WRITE))
+    # in-use deregister refused without force
+    with pytest.raises(ValueError, match="in use"):
+        server.csi_volume_deregister("default", "vol0")
+    # release -> free again
+    server.csi_volume_claim("default", "vol0", CSIVolumeClaim(
+        alloc_id="a1", state=CLAIM_STATE_READY_TO_FREE))
+    vol = server.csi_volume_get("default", "vol0")
+    assert not vol.in_use()
+    server.csi_volume_deregister("default", "vol0")
+    assert server.csi_volume_get("default", "vol0") is None
+
+
+def test_multi_reader_access_mode(server):
+    server.node_register(_csi_node())
+    server.csi_volume_register([_vol("rvol",
+                                     access=ACCESS_MODE_MULTI_NODE_READER)])
+    for aid in ("a1", "a2", "a3"):
+        server.csi_volume_claim("default", "rvol", CSIVolumeClaim(
+            alloc_id=aid, mode=CLAIM_READ))
+    vol = server.csi_volume_get("default", "rvol")
+    assert len(vol.read_claims) == 3
+    with pytest.raises(ValueError, match="write"):
+        server.csi_volume_claim("default", "rvol", CSIVolumeClaim(
+            alloc_id="w1", mode=CLAIM_WRITE))
+
+
+def test_volume_unschedulable_without_healthy_plugin(server):
+    server.csi_volume_register([_vol("lonely", plugin="missing")])
+    vol = server.csi_volume_get("default", "lonely")
+    assert not vol.schedulable
+    with pytest.raises(ValueError, match="not schedulable"):
+        server.csi_volume_claim("default", "lonely", CSIVolumeClaim(
+            alloc_id="a1", mode=CLAIM_WRITE))
+
+
+def test_volume_watcher_reaps_terminal_alloc_claims(server):
+    from nomad_tpu.structs import Allocation
+    server.node_register(_csi_node())
+    server.csi_volume_register([_vol("reap")])
+    alloc = mock.alloc()
+    alloc.client_status = "complete"
+    alloc.desired_status = "stop"
+    server.state.upsert_allocs(server.raft.barrier() + 1, [alloc])
+    server.csi_volume_claim("default", "reap", CSIVolumeClaim(
+        alloc_id=alloc.id, mode=CLAIM_WRITE))
+    assert server.volume_watcher.reap_once() == 1
+    vol = server.csi_volume_get("default", "reap")
+    assert not vol.in_use()
+    # claims of live allocs survive
+    live = mock.alloc()
+    live.client_status = "running"
+    server.state.upsert_allocs(server.raft.barrier() + 1, [live])
+    server.csi_volume_claim("default", "reap", CSIVolumeClaim(
+        alloc_id=live.id, mode=CLAIM_WRITE))
+    assert server.volume_watcher.reap_once() == 0
+
+
+def test_csi_survives_snapshot_restore(server):
+    server.node_register(_csi_node())
+    server.csi_volume_register([_vol("snapvol")])
+    blob = server.snapshot_save()
+    s2 = Server(num_workers=0)
+    s2.start()
+    try:
+        s2.snapshot_restore(blob)
+        assert s2.csi_volume_get("default", "snapvol") is not None
+        assert s2.csi_plugin_get("hostpath") is not None
+    finally:
+        s2.shutdown()
+
+
+def test_scheduler_filters_nodes_without_plugin(server):
+    """CSIVolumeChecker: only nodes fingerprinting the volume's plugin are
+    feasible."""
+    good = _csi_node()
+    bad = mock.node()
+    server.node_register(good)
+    server.node_register(bad)
+    server.csi_volume_register([_vol("schedvol")])
+    job = mock.job()
+    job.id = job.name = "csijob"
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.volumes = {"data": VolumeRequest(name="data", type="csi",
+                                        source="schedvol")}
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].resources.networks = []
+    server.job_register(job)
+    # run the scheduler synchronously via the harness against the server's
+    # state (testing.go pattern)
+    from nomad_tpu.scheduler import new_scheduler
+    from nomad_tpu.scheduler.testing import Harness
+    ev = server.state.evals_by_job("default", "csijob")[0]
+    h = Harness(server.state.fork())
+    h.process(lambda state, planner: new_scheduler(
+        "service", state, planner), ev)
+    assert h.plans
+    placed_nodes = [nid for plan in h.plans
+                    for nid, allocs in plan.node_allocation.items()
+                    for _ in allocs]
+    assert placed_nodes
+    assert all(nid == good.id for nid in placed_nodes)
+
+
+def test_end_to_end_hostpath_volume():
+    """A job with a CSI volume runs against the dev agent: the hostpath
+    plugin publishes the volume into the alloc dir and data persists across
+    allocs."""
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    try:
+        csi_base = os.path.join(a.config.data_dir, "csi-hostpath")
+        a.client.register_csi_plugin("hostpath",
+                                     HostPathCSIPlugin(csi_base))
+        assert wait_until(
+            lambda: (a.server.csi_plugin_get("hostpath") or
+                     None) is not None
+            and a.server.csi_plugin_get("hostpath").nodes_healthy == 1)
+        a.server.csi_volume_register([_vol("appdata")])
+
+        job = mock.job()
+        job.id = job.name = "csirun"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {"data": VolumeRequest(name="data", type="csi",
+                                            source="appdata")}
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c",
+                                "echo persisted > ../volumes/data/state.txt; sleep 30"]}
+        task.resources.networks = []
+        task.resources.cpu = 50
+        task.resources.memory_mb = 32
+        a.server.job_register(job)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "csirun")))
+        alloc = [al for al in a.server.state.allocs_by_job("default", "csirun")
+                 if al.client_status == "running"][0]
+        # claim registered server-side
+        vol = a.server.csi_volume_get("default", "appdata")
+        assert alloc.id in vol.write_claims
+        # the write landed in the backing hostpath volume dir
+        backing = os.path.join(csi_base, "appdata", "state.txt")
+        assert wait_until(lambda: os.path.exists(backing), timeout=10)
+        # stop the job -> claim released by the alloc runner postrun
+        a.server.job_deregister("default", "csirun")
+        assert wait_until(
+            lambda: not a.server.csi_volume_get("default",
+                                                "appdata").in_use(),
+            timeout=20)
+        with open(backing) as f:
+            assert f.read().strip() == "persisted"
+    finally:
+        a.shutdown()
