@@ -44,7 +44,14 @@ class Agent:
         self.config = config or AgentConfig(dev_mode=True)
         if not self.config.data_dir:
             self.config.data_dir = tempfile.mkdtemp(prefix="nomad_tpu_")
-        self.logger = logger or (lambda msg: None)
+        from .monitor import LogMonitor
+        self.monitor = LogMonitor()
+        _user_logger = logger or (lambda msg: None)
+
+        def _log(msg: str) -> None:
+            _user_logger(msg)
+            self.monitor.logger(msg)
+        self.logger = _log
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http = None

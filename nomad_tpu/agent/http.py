@@ -421,6 +421,49 @@ class HTTPAPI:
                     return s.set_scheduler_configuration(cfg), None
                 except ValueError as e:
                     raise HTTPError(400, str(e))
+        if parts == ["operator", "raft", "configuration"]:
+            require(acl.allow_operator_read())
+            return s.operator_raft_configuration(), None
+        if parts == ["operator", "raft", "peer"] and method == "DELETE":
+            require(acl.allow_operator_write())
+            try:
+                return s.operator_raft_remove_peer(
+                    peer_id=query.get("id", ""),
+                    address=query.get("address", "")), None
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+        if parts == ["operator", "autopilot", "configuration"]:
+            if method == "GET":
+                require(acl.allow_operator_read())
+                return s.operator_autopilot_get_config(), \
+                    s.state.table_index("autopilot")
+            require(acl.allow_operator_write())
+            return s.operator_autopilot_set_config(body), None
+        if parts == ["operator", "autopilot", "health"]:
+            require(acl.allow_operator_read())
+            return s.operator_server_health(), None
+        if parts == ["operator", "snapshot"]:
+            # management-only BOTH ways: the snapshot embeds every ACL token
+            # secret, and restore deserializes arbitrary bytes
+            # (ref nomad/operator_endpoint.go SnapshotSave/Restore: management)
+            if method == "GET":
+                require(acl.is_management())
+                return RawResponse(s.snapshot_save(),
+                                   "application/octet-stream"), None
+            if method in ("PUT", "POST"):
+                require(acl.is_management())
+                import base64
+                raw = body.get("_raw") if isinstance(body, dict) else None
+                if raw is None and isinstance(body, dict) \
+                        and body.get("Snapshot"):
+                    raw = base64.b64decode(body["Snapshot"])
+                if not raw:
+                    raise HTTPError(400, "missing snapshot body")
+                try:
+                    s.snapshot_restore(raw)
+                except Exception as e:  # noqa: BLE001
+                    raise HTTPError(400, f"restore failed: {e}")
+                return {}, None
 
         # ---- misc
         # ---- scaling policies (ref command/agent/scaling_endpoint.go)
@@ -559,9 +602,60 @@ class HTTPAPI:
                                "Client": {"Enabled": self.agent.client is not None},
                                "Version": self._version()},
                     "stats": self.agent.stats()}, None
+        if parts == ["agent", "health"]:
+            # ref command/agent/agent_endpoint.go HealthRequest
+            out = {}
+            if self.server is not None:
+                out["server"] = {"ok": True, "message": "ok"}
+            if self.agent.client is not None:
+                out["client"] = {"ok": self.agent.client.node.ready(),
+                                 "message": "ok"}
+            return out, None
         if parts == ["agent", "members"]:
-            return {"Members": [{"Name": "server-1", "Status": "alive",
-                                 "Tags": {"role": "nomad_tpu"}}]}, None
+            cfg = s.operator_raft_configuration()
+            return {"Members": [{
+                "Name": sv["ID"], "Addr": sv["Address"].rsplit(":", 1)[0],
+                "Port": int(sv["Address"].rsplit(":", 1)[1])
+                if ":" in sv["Address"] else 0,
+                "Status": "alive",
+                "Tags": {"role": "nomad", "raft_vsn": sv["RaftProtocol"]},
+                "Leader": sv["Leader"],
+            } for sv in cfg["Servers"]]}, None
+        if parts == ["agent", "join"] and method in ("PUT", "POST"):
+            require(acl.allow_agent_write())
+            address = query.get("address", "")
+            name = query.get("name", address)
+            if not address:
+                raise HTTPError(400, "missing address")
+            try:
+                s.operator_raft_add_peer(name, address)
+                return {"num_joined": 1, "error": ""}, None
+            except ValueError as e:
+                return {"num_joined": 0, "error": str(e)}, None
+        if parts == ["agent", "force-leave"] and method in ("PUT", "POST"):
+            require(acl.allow_agent_write())
+            node = query.get("node", "")
+            if not node:
+                raise HTTPError(400, "missing node")
+            try:
+                s.operator_raft_remove_peer(peer_id=node)
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            return {}, None
+        if parts[:2] == ["agent", "pprof"]:
+            # ref command/agent/pprof/pprof.go — Python-runtime analogs
+            require(acl.allow_agent_write())
+            from .monitor import sample_stacks, thread_dump
+            which = parts[2] if len(parts) > 2 else ""
+            if which == "cmdline":
+                import sys as _sys
+                return RawResponse(" ".join(_sys.argv).encode()), None
+            if which in ("goroutine", "threadcreate"):
+                return RawResponse(thread_dump().encode()), None
+            if which in ("profile", "trace"):
+                secs = float(query.get("seconds", 1) or 1)
+                return RawResponse(sample_stacks(secs).encode()), None
+            raise HTTPError(404, f"unknown profile {which!r}")
         if parts == ["system", "gc"] and method in ("PUT", "POST"):
             require(acl.is_management())
             s.run_gc()
@@ -839,17 +933,23 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             if parsed.path == "/v1/event/stream" and method == "GET":
                 self._event_stream(parsed)
                 return
+            if parsed.path == "/v1/agent/monitor" and method == "GET":
+                self._monitor_stream(parsed)
+                return
             query = {k: v[0] for k, v in
                      urllib.parse.parse_qs(parsed.query).items()}
             body = None
             length = int(self.headers.get("Content-Length", 0) or 0)
             if length:
                 raw = self.rfile.read(length)
-                try:
-                    body = json.loads(raw) if raw else None
-                except json.JSONDecodeError:
-                    self._respond(400, {"error": "invalid JSON body"})
-                    return
+                if parsed.path == "/v1/operator/snapshot":
+                    body = {"_raw": raw}   # binary passthrough
+                else:
+                    try:
+                        body = json.loads(raw) if raw else None
+                    except json.JSONDecodeError:
+                        self._respond(400, {"error": "invalid JSON body"})
+                        return
             token = self.headers.get("X-Nomad-Token", "") or \
                 query.get("token", "")
             try:
@@ -868,6 +968,46 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             if index is not None:
                 headers["X-Nomad-Index"] = str(index)
             self._respond(200, payload, headers)
+
+        def _monitor_stream(self, parsed) -> None:
+            """Live log streaming (ref command/agent/monitor: the
+            /v1/agent/monitor chunked response of hclog lines)."""
+            import queue as _queue
+            q = urllib.parse.parse_qs(parsed.query)
+            level = q.get("log_level", ["info"])[0]
+            token = self.headers.get("X-Nomad-Token", "") or \
+                q.get("token", [""])[0]
+            if api.server is not None:
+                try:
+                    acl = api.resolve_acl(token)
+                except HTTPError as e:
+                    self._respond(e.code, {"error": e.message})
+                    return
+                if not acl.allow_agent_read():
+                    self._respond(403, {"error": "Permission denied"})
+                    return
+            sub = api.agent.monitor.subscribe(level=level)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+            try:
+                while True:
+                    try:
+                        line = sub.get(timeout=10.0)
+                        payload = json.dumps({"Data": line}).encode() + b"\n"
+                    except _queue.Empty:
+                        payload = b"{}\n"   # heartbeat keeps conn alive
+                    write_chunk(payload)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                api.agent.monitor.unsubscribe(sub)
 
         def _event_stream(self, parsed) -> None:
             """Long-lived ndjson stream of state events

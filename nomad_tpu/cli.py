@@ -425,6 +425,87 @@ def cmd_operator_scheduler(args) -> None:
         print("==> Scheduler configuration updated")
 
 
+def cmd_operator_raft(args) -> None:
+    """ref command/operator_raft_list.go / operator_raft_remove.go"""
+    if args.action == "list-peers":
+        cfg = api("GET", "/v1/operator/raft/configuration")
+        _table([[sv["ID"], sv["Address"],
+                 "leader" if sv["Leader"] else "follower",
+                 "true" if sv["Voter"] else "false"]
+                for sv in cfg["Servers"]],
+               ["ID", "Address", "State", "Voter"])
+    else:
+        q = []
+        if args.peer_id:
+            q.append(f"id={args.peer_id}")
+        if args.peer_address:
+            q.append(f"address={args.peer_address}")
+        api("DELETE", "/v1/operator/raft/peer?" + "&".join(q))
+        print("==> Peer removed")
+
+
+def cmd_operator_snapshot(args) -> None:
+    """ref command/operator_snapshot_save.go / _restore.go"""
+    import urllib.request
+    addr = os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+    headers = {}
+    if os.environ.get("NOMAD_TOKEN"):
+        headers["X-Nomad-Token"] = os.environ["NOMAD_TOKEN"]
+    if args.action == "save":
+        req = urllib.request.Request(addr + "/v1/operator/snapshot",
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            data = resp.read()
+        with open(args.file, "wb") as f:
+            f.write(data)
+        print(f"==> Snapshot saved to {args.file} ({len(data)} bytes)")
+    else:
+        with open(args.file, "rb") as f:
+            data = f.read()
+        req = urllib.request.Request(addr + "/v1/operator/snapshot",
+                                     data=data, method="PUT",
+                                     headers=headers)
+        urllib.request.urlopen(req, timeout=60).read()
+        print("==> Snapshot restored")
+
+
+def cmd_operator_autopilot(args) -> None:
+    if args.action == "get-config":
+        print(json.dumps(api("GET", "/v1/operator/autopilot/configuration"),
+                         indent=2))
+    elif args.action == "health":
+        print(json.dumps(api("GET", "/v1/operator/autopilot/health"),
+                         indent=2))
+    else:
+        cfg = {}
+        if args.cleanup_dead_servers is not None:
+            cfg["CleanupDeadServers"] = args.cleanup_dead_servers == "true"
+        api("PUT", "/v1/operator/autopilot/configuration", cfg)
+        print("==> Autopilot configuration updated")
+
+
+def cmd_monitor(args) -> None:
+    """Stream agent logs (ref command/monitor.go)."""
+    import urllib.request
+    addr = os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+    url = f"{addr}/v1/agent/monitor?log_level={args.log_level}"
+    headers = {}
+    if os.environ.get("NOMAD_TOKEN"):
+        headers["X-Nomad-Token"] = os.environ["NOMAD_TOKEN"]
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=3600) as resp:
+        for line in resp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                data = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if data.get("Data"):
+                print(data["Data"])
+
+
 def cmd_system_gc(args) -> None:
     api("PUT", "/v1/system/gc", {})
     print("==> GC triggered")
@@ -667,6 +748,20 @@ def build_parser() -> argparse.ArgumentParser:
                       dest="memory_oversubscription",
                       choices=["true", "false"], default=None)
     osch.set_defaults(fn=cmd_operator_scheduler)
+    oraft = osub.add_parser("raft")
+    oraft.add_argument("action", choices=["list-peers", "remove-peer"])
+    oraft.add_argument("-peer-id", dest="peer_id", default="")
+    oraft.add_argument("-peer-address", dest="peer_address", default="")
+    oraft.set_defaults(fn=cmd_operator_raft)
+    osnap = osub.add_parser("snapshot")
+    osnap.add_argument("action", choices=["save", "restore"])
+    osnap.add_argument("file")
+    osnap.set_defaults(fn=cmd_operator_snapshot)
+    oap = osub.add_parser("autopilot")
+    oap.add_argument("action", choices=["get-config", "set-config", "health"])
+    oap.add_argument("-cleanup-dead-servers", dest="cleanup_dead_servers",
+                     choices=["true", "false"], default=None)
+    oap.set_defaults(fn=cmd_operator_autopilot)
 
     system = sub.add_parser("system")
     ssub = system.add_subparsers(dest="sys_cmd", required=True)
@@ -680,6 +775,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)
+
+    mon = sub.add_parser("monitor")
+    mon.add_argument("-log-level", dest="log_level", default="info")
+    mon.set_defaults(fn=cmd_monitor)
 
     vol = sub.add_parser("volume")
     vsub = vol.add_subparsers(dest="volume_cmd", required=True)

@@ -152,6 +152,15 @@ class AllocRunner:
         env = build_task_env(self.alloc, task, self.client.node, task_dir,
                              self.alloc_dir,
                              os.path.join(task_dir, "secrets"))
+        # device hook: reserved device instances -> visibility env vars
+        # (ref taskrunner/device_hook.go)
+        tres = self.alloc.allocated_resources.tasks.get(task.name)
+        for ad in (tres.devices if tres else []):
+            try:
+                res = self.client.device_manager.reserve(ad)
+                env.update(res.envs)
+            except ValueError as e:
+                self.client.logger(f"device reserve failed: {e}")
         tr = TaskRunner(self.alloc, task, driver, task_dir, env,
                         self._on_task_state)
         with self._lock:

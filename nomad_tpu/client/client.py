@@ -40,6 +40,8 @@ class Client:
 
         from .csimanager import CSIManager
         self.csi_manager = CSIManager(self)
+        from .devicemanager import DeviceManager
+        self.device_manager = DeviceManager(self)
 
         node_id = self.state_db.get_node_id()
         self.node: Node = fingerprint_node(data_dir, datacenter, node_class,
@@ -391,6 +393,7 @@ class Client:
         except OSError:
             pass
         stats["AllocDirStats"] = {"Allocs": self.num_allocs()}
+        stats["DeviceStats"] = self.device_manager.all_stats()
         stats["Uptime"] = time.monotonic()
         return stats
 
@@ -471,6 +474,16 @@ class Client:
             except (KeyError, ValueError):
                 pass
         return n
+
+    def register_device_plugin(self, plugin) -> None:
+        """Attach a device plugin and refresh the node's device inventory
+        (ref client/devicemanager fingerprint -> updateNodeFromDevices)."""
+        self.device_manager.register_plugin(plugin)
+        self.node.node_resources.devices = self.device_manager.fingerprint()
+        try:
+            self.rpc.node_register(self.node)
+        except Exception as e:          # noqa: BLE001
+            self.logger(f"client: device fingerprint update failed: {e!r}")
 
     def register_csi_plugin(self, plugin_id: str, plugin) -> None:
         """Attach a CSI node plugin and refresh the node fingerprint (ref

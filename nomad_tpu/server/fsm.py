@@ -56,6 +56,7 @@ JOB_STABILITY = "JobStabilityRequestType"
 CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequestType"
 CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequestType"
 CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
+AUTOPILOT_CONFIG = "AutopilotRequestType"
 
 
 @dataclasses.dataclass
@@ -190,6 +191,8 @@ class NomadFSM:
         elif msg_type == CSI_VOLUME_CLAIM:
             s.csi_volume_claim(index, payload["namespace"],
                                payload["volume_id"], payload["claim"])
+        elif msg_type == AUTOPILOT_CONFIG:
+            s.set_autopilot_config(index, payload["config"])
         else:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
@@ -222,6 +225,7 @@ class NomadFSM:
                 "scaling_events": s.scaling_events,
                 "csi_volumes": s.csi_volumes,
                 "csi_plugins": s.csi_plugins,
+                "autopilot_config": s.autopilot_config,
             }
             return pickle.dumps(blob)
 
@@ -250,6 +254,8 @@ class NomadFSM:
             s.scaling_events = dict(blob.get("scaling_events", {}))
             s.csi_volumes = dict(blob.get("csi_volumes", {}))
             s.csi_plugins = dict(blob.get("csi_plugins", {}))
+            s.autopilot_config = dict(
+                blob.get("autopilot_config", s.autopilot_config))
             s._acl_token_by_secret = {
                 t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes

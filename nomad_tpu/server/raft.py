@@ -88,6 +88,9 @@ class RaftNode:
         self._votes = 0
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
+        self._last_ok: dict[str, float] = {}   # peer -> last successful repl
+        now = time.monotonic()
+        self._peer_added_at: dict[str, float] = {p: now for p in peers}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._replicate_events: dict[str, threading.Event] = {}
@@ -180,14 +183,18 @@ class RaftNode:
             self.fsm.restore_bytes(snap["data"])
             self.base_index = snap["index"]
             self.base_term = snap["term"]
-            self.peers.update(snap.get("peers", {}))
+            if snap.get("peers"):
+                # authoritative config at snapshot time: replace, don't
+                # merge — a merge would resurrect removed peers
+                self.peers = dict(snap["peers"])
             self.commit_index = self.last_applied = self.base_index
         if os.path.exists(self._meta_path()):
             with open(self._meta_path(), "rb") as f:
                 meta = pickle.load(f)
             self.current_term = meta["term"]
             self.voted_for = meta["voted_for"]
-            self.peers.update(meta.get("peers", {}))
+            if meta.get("peers"):
+                self.peers = dict(meta["peers"])
         if os.path.exists(self._log_path()):
             with open(self._log_path(), "rb") as f:
                 raw = f.read()
@@ -205,7 +212,13 @@ class RaftNode:
             # (FSM application is idempotent per replay determinism)
             for i, e in enumerate(self.log):
                 idx = self.base_index + i + 1
-                if e.type != "_noop":
+                if e.type == "_config_remove":
+                    with self._lock:
+                        self._apply_config_locked(e.payload)
+                elif e.type == "_config_add":
+                    with self._lock:
+                        self._apply_config_add_locked(e.payload)
+                elif e.type != "_noop":
                     try:
                         self.fsm.apply(idx, e.type, e.payload)
                     except Exception as ex:   # noqa: BLE001
@@ -272,6 +285,92 @@ class RaftNode:
                     self._term_at(index) != entry.term:
                 raise NotLeaderError(self.leader_addr)
             return index
+
+    def add_peer(self, peer_id: str, addr: str, timeout: float = 30.0) -> int:
+        """Single-entry membership addition (ref raft AddVoter / agent
+        join): replicate a _config_add entry; the leader starts replicating
+        to the new peer on apply."""
+        with self._lock:
+            if peer_id in self.peers and self.peers[peer_id] == addr:
+                return self.last_applied
+        return self.apply("_config_add", (peer_id, addr), timeout=timeout)
+
+    def remove_peer(self, peer_id: str, timeout: float = 30.0) -> int:
+        """Single-entry membership change: replicate a _config_remove entry;
+        every node drops the peer on apply (ref raft RemoveServer /
+        operator raft remove-peer). Removing self steps down."""
+        if peer_id not in self.peers:
+            raise ValueError(f"unknown raft peer {peer_id!r}")
+        if len(self.peers) <= 1:
+            raise ValueError("cannot remove the last raft peer")
+        return self.apply("_config_remove", peer_id, timeout=timeout)
+
+    def _apply_config_locked(self, payload) -> None:
+        pid = payload
+        self.peers.pop(pid, None)
+        self._next_index.pop(pid, None)
+        self._match_index.pop(pid, None)
+        self._replicate_events.pop(pid, None)
+        self._peer_added_at.pop(pid, None)
+        self._persist_meta()
+        if pid == self.node_id and self.state == LEADER:
+            self._step_down_locked(self.current_term)
+
+    def _apply_config_add_locked(self, payload) -> None:
+        pid, addr = payload
+        if pid in self.peers:
+            self.peers[pid] = addr
+            self._persist_meta()
+            return
+        self.peers[pid] = addr
+        self._peer_added_at[pid] = time.monotonic()
+        self._persist_meta()
+        if self.state == LEADER:
+            self._next_index[pid] = self._last_index() + 1
+            self._match_index[pid] = 0
+            ev = threading.Event()
+            self._replicate_events[pid] = ev
+            t = threading.Thread(target=self._replicate_loop, daemon=True,
+                                 args=(pid, self.current_term),
+                                 name=f"raft-repl-{pid}")
+            t.start()
+            self._threads.append(t)
+            ev.set()
+
+    def server_health(self) -> list[dict]:
+        """Per-peer replication health (operator autopilot health analog)."""
+        with self._lock:
+            now = time.monotonic()
+            is_leader = self.state == LEADER
+            out = []
+            for pid, addr in sorted(self.peers.items()):
+                known_for = now - self._peer_added_at.get(pid, now)
+                if pid == self.node_id:
+                    healthy, age = True, 0.0
+                elif is_leader:
+                    last = self._last_ok.get(pid)
+                    age = (now - last) if last is not None else float("inf")
+                    healthy = age < max(1.0, self.heartbeat_interval * 10)
+                elif pid == self.leader_id:
+                    # a follower knows only its leader's liveness
+                    age = now - self._last_contact
+                    healthy = age < max(1.0, self.election_timeout[0])
+                else:
+                    # unknown from here: only the leader tracks replication
+                    age, healthy = None, None
+                out.append({
+                    "ID": pid, "Address": addr,
+                    "Leader": pid == self.node_id and is_leader
+                    or pid == self.leader_id,
+                    "Voter": True,
+                    "Healthy": healthy,
+                    "LastContactSec": None
+                    if age in (None, float("inf")) else age,
+                    "KnownForSec": known_for,
+                    "MatchIndex": self._match_index.get(pid, 0)
+                    if is_leader else None,
+                })
+            return out
 
     def barrier(self) -> int:
         with self._lock:
@@ -419,6 +518,8 @@ class RaftNode:
                 with self._lock:
                     if self.state != LEADER or self.current_term != term:
                         return
+                    if pid not in self.peers:
+                        return   # removed from the config mid-term
                 ev.wait(self.heartbeat_interval)
                 ev.clear()
                 try:
@@ -430,7 +531,8 @@ class RaftNode:
 
     def _replicate_once(self, cli, pid: str, term: int) -> None:
         with self._lock:
-            if self.state != LEADER or self.current_term != term:
+            if self.state != LEADER or self.current_term != term \
+                    or pid not in self.peers:
                 return
             nxt = self._next_index.get(pid, self._last_index() + 1)
             if nxt <= self.base_index:
@@ -456,6 +558,7 @@ class RaftNode:
                     return
                 self._next_index[pid] = snap["index"] + 1
                 self._match_index[pid] = snap["index"]
+                self._last_ok[pid] = time.monotonic()
             return
         resp = cli.call("Raft.AppendEntries", term, self.node_id, self.addr,
                         prev_idx, prev_term, entries, commit)
@@ -467,18 +570,23 @@ class RaftNode:
                 return
             if resp["success"]:
                 match = prev_idx + len(entries)
+                self._last_ok[pid] = time.monotonic()
                 self._match_index[pid] = max(self._match_index.get(pid, 0),
                                              match)
                 self._next_index[pid] = self._match_index[pid] + 1
                 self._advance_commit_locked()
                 if self._next_index[pid] <= self._last_index():
-                    self._replicate_events[pid].set()   # more to send
+                    ev = self._replicate_events.get(pid)
+                    if ev is not None:
+                        ev.set()   # more to send
             else:
                 # conflict: back up (follower hints its last index)
                 hint = resp.get("last_index")
                 self._next_index[pid] = max(
                     1, min(nxt - 1, (hint + 1) if hint is not None else nxt - 1))
-                self._replicate_events[pid].set()
+                ev = self._replicate_events.get(pid)
+                if ev is not None:
+                    ev.set()
 
     def _advance_commit_locked(self) -> None:
         """Majority-match commit rule (current-term entries only)."""
@@ -504,7 +612,13 @@ class RaftNode:
                 end = self.commit_index
                 batch = [(i, self._entry_at(i)) for i in range(start, end + 1)]
             for idx, e in batch:
-                if e.type != "_noop":
+                if e.type == "_config_remove":
+                    with self._lock:
+                        self._apply_config_locked(e.payload)
+                elif e.type == "_config_add":
+                    with self._lock:
+                        self._apply_config_add_locked(e.payload)
+                elif e.type != "_noop":
                     try:
                         self.fsm.apply(idx, e.type, e.payload)
                     except Exception as ex:   # noqa: BLE001
@@ -532,6 +646,8 @@ class RaftNode:
 
     def _rpc_request_vote(self, term, candidate_id, last_idx, last_term):
         with self._lock:
+            if self._stop.is_set():
+                return {"term": self.current_term, "granted": False}
             if term > self.current_term:
                 self._step_down_locked(term)
             granted = False
@@ -554,6 +670,10 @@ class RaftNode:
     def _rpc_append_entries(self, term, leader_id, leader_addr,
                             prev_idx, prev_term, entries, leader_commit):
         with self._lock:
+            if self._stop.is_set():
+                # a shut-down node must not ack replication: live pooled
+                # connections would otherwise keep it looking healthy
+                return {"term": self.current_term, "success": False}
             if term < self.current_term:
                 return {"term": self.current_term, "success": False}
             if term > self.current_term or self.state != FOLLOWER:
@@ -611,7 +731,8 @@ class RaftNode:
             self.base_index = snap["index"]
             self.base_term = snap["term"]
             self.log = []
-            self.peers.update(snap.get("peers", {}))
+            if snap.get("peers"):
+                self.peers = dict(snap["peers"])
             self.commit_index = max(self.commit_index, snap["index"])
             self.last_applied = snap["index"]
             self._persist_snapshot(snap["data"])

@@ -91,6 +91,14 @@ RPC_ENDPOINTS = {
                                            True),
     "Operator.SnapshotSave": ("snapshot_save", False),
     "Operator.SnapshotRestore": ("snapshot_restore", True),
+    "Operator.RaftGetConfiguration": ("operator_raft_configuration", False),
+    "Operator.RaftRemovePeer": ("operator_raft_remove_peer", True),
+    "Operator.RaftAddPeer": ("operator_raft_add_peer", True),
+    "Operator.AutopilotGetConfiguration": ("operator_autopilot_get_config",
+                                           False),
+    "Operator.AutopilotSetConfiguration": ("operator_autopilot_set_config",
+                                           True),
+    "Operator.ServerHealth": ("operator_server_health", False),
 }
 
 
@@ -266,6 +274,10 @@ class Server:
         while not self._leader_stop.wait(1.0):
             self.eval_broker.check_nack_timeouts()
             self._reap_failed_evaluations()
+            try:
+                self._autopilot_cleanup_dead_servers()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"autopilot: {e!r}")
             if time.time() - last_gc >= self.gc_interval:
                 last_gc = time.time()
                 for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
@@ -840,6 +852,109 @@ class Server:
         return self.deployment_watcher.pause(deployment_id, paused)
 
     # -------------------------------------------------- Operator endpoints
+
+    # ----------------------------------------------------- Operator: raft
+
+    def operator_raft_configuration(self) -> dict:
+        """ref nomad/operator_endpoint.go RaftGetConfiguration"""
+        from .raft import RaftNode
+        if isinstance(self.raft, RaftNode):
+            is_leader, _ = self.raft.leadership()
+            servers = [{
+                "ID": pid, "Node": pid, "Address": addr,
+                "Leader": (pid == self.raft.node_id and is_leader)
+                or pid == self.raft.leader_id,
+                "Voter": True, "RaftProtocol": "3",
+            } for pid, addr in sorted(self.raft.peers.items())]
+            return {"Servers": servers, "Index": self.raft.barrier()}
+        return {"Servers": [{
+            "ID": "server-1", "Node": "server-1",
+            "Address": self.rpc_addr() if self.rpc_server else "local",
+            "Leader": self.is_leader, "Voter": True, "RaftProtocol": "3",
+        }], "Index": self.raft.barrier()}
+
+    def operator_raft_remove_peer(self, peer_id: str = "",
+                                  address: str = "") -> dict:
+        """ref operator_endpoint.go RaftRemovePeerByAddress/ID"""
+        from .raft import RaftNode
+        if not isinstance(self.raft, RaftNode):
+            raise ValueError("raft membership requires a multi-node cluster")
+        if not peer_id and address:
+            matches = [pid for pid, a in self.raft.peers.items()
+                       if a == address]
+            if not matches:
+                raise ValueError(f"no raft peer at address {address!r}")
+            peer_id = matches[0]
+        index = self.raft.remove_peer(peer_id)
+        return {"index": index}
+
+    def operator_raft_add_peer(self, peer_id: str, address: str) -> dict:
+        """Join a new server into the raft configuration (agent join path)."""
+        from .raft import RaftNode
+        if not isinstance(self.raft, RaftNode):
+            raise ValueError("raft membership requires a multi-node cluster")
+        index = self.raft.add_peer(peer_id, address)
+        return {"index": index}
+
+    def operator_autopilot_get_config(self) -> dict:
+        return self.state.get_autopilot_config()
+
+    def operator_autopilot_set_config(self, config: dict) -> dict:
+        from .fsm import AUTOPILOT_CONFIG
+        index = self.raft.apply(AUTOPILOT_CONFIG, {"config": config})
+        return {"Updated": True, "index": index}
+
+    def operator_server_health(self) -> dict:
+        """ref operator autopilot health endpoint"""
+        from .raft import RaftNode
+        if isinstance(self.raft, RaftNode):
+            servers = self.raft.server_health()
+        else:
+            servers = [{"ID": "server-1", "Address": "local",
+                        "Leader": self.is_leader, "Voter": True,
+                        "Healthy": True, "LastContactSec": 0.0,
+                        "MatchIndex": self.raft.barrier()}]
+        # Healthy=None means "unknown from this server" (follower view);
+        # only definite failures make the cluster unhealthy
+        healthy = all(s["Healthy"] is not False for s in servers)
+        return {"Healthy": healthy,
+                "FailureTolerance": max(0, (sum(
+                    1 for s in servers if s["Healthy"]) - 1) // 2),
+                "Servers": servers}
+
+    def _autopilot_cleanup_dead_servers(self) -> None:
+        """Leader-side dead-server reaping (ref nomad/autopilot.go
+        pruneDeadServers), driven by the stored autopilot config."""
+        from .raft import RaftNode
+        if not isinstance(self.raft, RaftNode) or not self.is_leader:
+            return
+        cfg = self.state.get_autopilot_config()
+        if not cfg.get("CleanupDeadServers", True):
+            return
+        threshold = float(cfg.get("LastContactThresholdSec", 10.0))
+        stabilization = float(cfg.get("ServerStabilizationTimeSec", 10.0))
+        health = self.raft.server_health()
+        # never remove below a majority of the current config (autopilot's
+        # quorum guard)
+        removable = len(health) - max(2, len(health) // 2 + 1)
+        for s in health:
+            if removable <= 0:
+                break
+            if s["Healthy"] or s["ID"] == self.raft.node_id:
+                continue
+            if s.get("KnownForSec", 0.0) < stabilization:
+                # just joined: give it time to come up before reaping
+                continue
+            age = s["LastContactSec"]
+            if age is not None and age < threshold:
+                continue
+            try:
+                self.raft.remove_peer(s["ID"])
+                self.logger(f"autopilot: removed dead server {s['ID']}")
+                removable -= 1
+            except Exception as e:  # noqa: BLE001
+                self.logger(f"autopilot: remove failed: {e!r}")
+                break
 
     def get_scheduler_configuration(self) -> SchedulerConfiguration:
         return self.state.get_scheduler_config()

@@ -60,6 +60,12 @@ class StateStore:
         # CSI (ref schema.go csi_volumes/csi_plugins)
         self.csi_volumes: dict[tuple[str, str], object] = {}  # (ns, id)
         self.csi_plugins: dict[str, object] = {}              # plugin id
+        # autopilot (ref nomad/state/autopilot.go AutopilotConfig)
+        self.autopilot_config: dict = {
+            "CleanupDeadServers": True,
+            "LastContactThresholdSec": 10.0,
+            "ServerStabilizationTimeSec": 10.0,
+        }
 
         # secondary indexes
         self._allocs_by_node: dict[str, set[str]] = {}
@@ -575,6 +581,18 @@ class StateStore:
     def iter_csi_plugins(self) -> list:
         with self._lock:
             return sorted(self.csi_plugins.values(), key=lambda p: p.id)
+
+    # ------------------------------------------------------------ autopilot
+
+    def get_autopilot_config(self) -> dict:
+        with self._lock:
+            return dict(self.autopilot_config)
+
+    def set_autopilot_config(self, index: int, config: dict) -> None:
+        with self._lock:
+            self.autopilot_config = {**self.autopilot_config, **config}
+            self._bump("autopilot", index)
+            self._commit()
 
     def update_job_stability(self, index: int, ns: str, job_id: str,
                              version: int, stable: bool) -> None:

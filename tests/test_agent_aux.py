@@ -1,0 +1,129 @@
+"""Agent auxiliary endpoints: health, members, monitor stream, pprof,
+join/force-leave (modeled on command/agent/agent_endpoint_test.go)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.agent.monitor import LogMonitor, sample_stacks, thread_dump
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=0))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def call(agent, method, path, body=None, raw=False):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(agent.http_addr + path, data=data,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or "null")
+
+
+def test_agent_health(agent):
+    out = call(agent, "GET", "/v1/agent/health")
+    assert out["server"]["ok"] is True
+    assert "client" in out
+
+
+def test_agent_members(agent):
+    out = call(agent, "GET", "/v1/agent/members")
+    assert len(out["Members"]) == 1
+    assert out["Members"][0]["Status"] == "alive"
+
+
+def test_pprof_endpoints(agent):
+    dump = call(agent, "GET", "/v1/agent/pprof/goroutine", raw=True)
+    assert b"thread" in dump
+    prof = call(agent, "GET", "/v1/agent/pprof/profile?seconds=0.3",
+                raw=True)
+    assert b"samples over" in prof
+    cmdline = call(agent, "GET", "/v1/agent/pprof/cmdline", raw=True)
+    assert cmdline
+
+
+def test_log_monitor_fanout():
+    mon = LogMonitor()
+    mon.write("before subscribe", "info")
+    q = mon.subscribe(level="info", replay=True)
+    assert "before subscribe" in q.get_nowait()
+    mon.write("an error happened", "error")
+    assert "an error happened" in q.get(timeout=1)
+    # level filter: debug line not delivered to info subscriber
+    mon.write("noisy detail", "debug")
+    mon.write("visible", "info")
+    assert "visible" in q.get(timeout=1)
+    mon.unsubscribe(q)
+    mon.write("after unsub", "info")
+    assert q.empty()
+
+
+def test_monitor_stream_http(agent):
+    """The /v1/agent/monitor stream delivers live agent log lines."""
+    url = agent.http_addr + "/v1/agent/monitor?log_level=info"
+    resp = urllib.request.urlopen(url, timeout=10)
+    agent.logger("hello-from-monitor-test")
+    deadline = time.time() + 10
+    seen = False
+    while time.time() < deadline and not seen:
+        line = resp.readline().strip()
+        if not line:
+            continue
+        try:
+            data = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "hello-from-monitor-test" in data.get("Data", ""):
+            seen = True
+    resp.close()
+    assert seen
+
+
+def test_thread_dump_and_sampler():
+    dump = thread_dump()
+    assert "MainThread" in dump
+    out = sample_stacks(seconds=0.2, hz=50)
+    assert "samples over" in out
+
+
+def test_join_force_leave_cluster():
+    """agent join adds a raft peer; force-leave removes it."""
+    from tests.test_raft import FAST
+    from nomad_tpu.server import Server
+
+    s1 = Server(num_workers=0)
+    s1.rpc_listen()
+    s1.enable_raft("s1", {"s1": s1.rpc_addr}, **FAST)
+    s1.start()
+    s2 = Server(num_workers=0)
+    s2.rpc_listen()
+    try:
+        deadline = time.time() + 10
+        while not s1.raft_node.is_leader() and time.time() < deadline:
+            time.sleep(0.05)
+        assert s1.raft_node.is_leader()
+        s1.operator_raft_add_peer("s2", s2.rpc_addr)
+        assert "s2" in s1.raft_node.peers
+        # new peer starts with the existing cluster in its peer set and
+        # receives replicated state
+        s2.enable_raft("s2", {"s1": s1.rpc_addr, "s2": s2.rpc_addr}, **FAST)
+        s2.start()
+        from nomad_tpu import mock
+        s1.job_register(mock.job())
+        deadline = time.time() + 10
+        while not s2.state.iter_jobs() and time.time() < deadline:
+            time.sleep(0.05)
+        assert s2.state.iter_jobs()
+        # force-leave path
+        s1.operator_raft_remove_peer(peer_id="s2")
+        assert "s2" not in s1.raft_node.peers
+    finally:
+        s2.shutdown()
+        s1.shutdown()

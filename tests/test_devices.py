@@ -1,0 +1,126 @@
+"""Device plugin manager tests (modeled on client/devicemanager tests,
+plugins/device, and scheduler/device_test.go end-to-end behavior)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.client.devicemanager import (
+    ContainerReservation, StaticDevicePlugin,
+)
+from nomad_tpu.structs import RequestedDevice
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_static_plugin_fingerprint_and_reserve():
+    p = StaticDevicePlugin("nvidia", "gpu", "1080ti", ["GPU-0", "GPU-1"])
+    groups = p.fingerprint()
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.id_tuple() == ("nvidia", "gpu", "1080ti")
+    assert [i.id for i in g.instances] == ["GPU-0", "GPU-1"]
+    res = p.reserve(["GPU-1"])
+    assert res.envs == {"NVIDIA_GPU_VISIBLE_DEVICES": "GPU-1"}
+    with pytest.raises(ValueError, match="unknown device ids"):
+        p.reserve(["GPU-9"])
+
+
+def test_unhealthy_instances_fingerprint():
+    p = StaticDevicePlugin("v", "fpga", "x1", ["a", "b"])
+    p.unhealthy.add("b")
+    g = p.fingerprint()[0]
+    health = {i.id: i.healthy for i in g.instances}
+    assert health == {"a": True, "b": False}
+    assert p.stats() == {"a": {"healthy": True}, "b": {"healthy": False}}
+
+
+def test_device_scheduling_end_to_end():
+    """A job asking for a device gets specific instance ids assigned by the
+    scheduler and sees them in its env; a second ask beyond capacity
+    blocks."""
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    try:
+        a.client.register_device_plugin(
+            StaticDevicePlugin("fake", "gpu", "model-x",
+                               ["GPU-0", "GPU-1"]))
+        assert wait_until(
+            lambda: (n := a.server.state.node_by_id(a.client.node.id))
+            is not None and n.ready() and n.node_resources.devices)
+
+        job = mock.job()
+        job.id = job.name = "gpujob"
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", "env > local/env.txt; sleep 30"]}
+        task.resources.networks = []
+        task.resources.cpu = 50
+        task.resources.memory_mb = 32
+        task.resources.devices = [RequestedDevice(name="fake/gpu", count=2)]
+        a.server.job_register(job)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "gpujob")))
+        alloc = [al for al in
+                 a.server.state.allocs_by_job("default", "gpujob")
+                 if al.client_status == "running"][0]
+        devs = alloc.allocated_resources.tasks[task.name].devices
+        assert len(devs) == 1
+        assert sorted(devs[0].device_ids) == ["GPU-0", "GPU-1"]
+        # the task env carries the visibility variable
+        import os
+        env_file = os.path.join(a.client.alloc_dir_root, alloc.id,
+                                task.name, "local", "env.txt")
+        assert wait_until(lambda: os.path.exists(env_file), timeout=10)
+
+        def env_has_devices():
+            with open(env_file) as f:
+                content = f.read()
+            return "FAKE_GPU_VISIBLE_DEVICES=GPU-0,GPU-1" in content \
+                or "FAKE_GPU_VISIBLE_DEVICES=GPU-1,GPU-0" in content
+        assert wait_until(env_has_devices, timeout=10)
+
+        # all instances used: a second device job can't place
+        job2 = mock.job()
+        job2.id = job2.name = "gpujob2"
+        tg2 = job2.task_groups[0]
+        tg2.count = 1
+        t2 = tg2.tasks[0]
+        t2.driver = "mock_driver"
+        t2.config = {"run_for": 30}
+        t2.resources.networks = []
+        t2.resources.cpu = 50
+        t2.resources.memory_mb = 32
+        t2.resources.devices = [RequestedDevice(name="fake/gpu", count=1)]
+        a.server.job_register(job2)
+        assert wait_until(lambda: any(
+            e.status == "blocked"
+            for e in a.server.state.evals_by_job("default", "gpujob2")),
+            timeout=15)
+        assert not a.server.state.allocs_by_job("default", "gpujob2")
+    finally:
+        a.shutdown()
+
+
+def test_client_stats_include_devices():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=0))
+    a.start()
+    try:
+        a.client.register_device_plugin(
+            StaticDevicePlugin("fake", "gpu", "m", ["g0"]))
+        stats = a.client.host_stats()
+        assert stats["DeviceStats"] == {"fake/gpu/m": {"g0": {"healthy": True}}}
+    finally:
+        a.shutdown()

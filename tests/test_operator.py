@@ -1,0 +1,148 @@
+"""Operator endpoints: raft configuration/peer removal, snapshot
+save/restore over HTTP, autopilot config + health + dead-server cleanup
+(modeled on nomad/operator_endpoint_test.go and nomad/autopilot_test.go)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from tests.test_raft import (
+    FAST, make_cluster, shutdown_all, wait_stable_leader, wait_until,
+)
+
+
+def test_raft_configuration_single_node():
+    s = Server(num_workers=0)
+    s.start()
+    try:
+        cfg = s.operator_raft_configuration()
+        assert len(cfg["Servers"]) == 1
+        assert cfg["Servers"][0]["Leader"] is True
+    finally:
+        s.shutdown()
+
+
+def test_raft_configuration_and_remove_peer_cluster():
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        cfg = leader.operator_raft_configuration()
+        assert len(cfg["Servers"]) == 3
+        assert sum(1 for sv in cfg["Servers"] if sv["Leader"]) == 1
+        # remove a follower by id
+        follower_id = next(sv["ID"] for sv in cfg["Servers"]
+                           if not sv["Leader"])
+        leader.operator_raft_remove_peer(peer_id=follower_id)
+        assert wait_until(lambda: len(
+            leader.operator_raft_configuration()["Servers"]) == 2)
+        # removed peer no longer receives writes; cluster still commits
+        leader.job_register(mock.job())
+        assert len(leader.state.iter_jobs()) == 1
+    finally:
+        shutdown_all(servers)
+
+
+def test_remove_unknown_peer_rejected():
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        with pytest.raises(ValueError, match="unknown raft peer"):
+            leader.operator_raft_remove_peer(peer_id="nope")
+        with pytest.raises(ValueError, match="no raft peer at address"):
+            leader.operator_raft_remove_peer(address="1.2.3.4:1")
+    finally:
+        shutdown_all(servers)
+
+
+def test_autopilot_config_roundtrip():
+    s = Server(num_workers=0)
+    s.start()
+    try:
+        cfg = s.operator_autopilot_get_config()
+        assert cfg["CleanupDeadServers"] is True
+        s.operator_autopilot_set_config({"CleanupDeadServers": False})
+        assert s.operator_autopilot_get_config()["CleanupDeadServers"] \
+            is False
+        health = s.operator_server_health()
+        assert health["Healthy"] is True
+    finally:
+        s.shutdown()
+
+
+def test_autopilot_dead_server_cleanup():
+    """A crashed follower is reaped from the raft config by the leader once
+    past the last-contact threshold."""
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        leader.operator_autopilot_set_config(
+            {"LastContactThresholdSec": 0.5})
+        victim = next(s for s in servers if s is not leader)
+        victim_id = victim.raft_node.node_id
+        victim.shutdown()
+        # the leader loop runs cleanup every second
+        assert wait_until(
+            lambda: victim_id not in leader.raft_node.peers, timeout=20)
+        # still serving writes with 2/3
+        leader.job_register(mock.job())
+    finally:
+        shutdown_all(servers)
+
+
+def test_snapshot_save_restore_http():
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api_codec import to_api
+
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, client_enabled=False))
+    a.start()
+    try:
+        job = mock.job()
+        job.id = job.name = "snapjob"
+        a.server.job_register(job)
+        with urllib.request.urlopen(a.http_addr + "/v1/operator/snapshot",
+                                    timeout=10) as resp:
+            blob = resp.read()
+        assert blob
+
+        b = Agent(AgentConfig(dev_mode=True, http_port=0,
+                              client_enabled=False))
+        b.start()
+        try:
+            req = urllib.request.Request(
+                b.http_addr + "/v1/operator/snapshot", data=blob,
+                method="PUT")
+            urllib.request.urlopen(req, timeout=10).read()
+            assert b.server.state.job_by_id("default", "snapjob") is not None
+        finally:
+            b.shutdown()
+    finally:
+        a.shutdown()
+
+
+def test_autopilot_http_routes():
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, client_enabled=False))
+    a.start()
+    try:
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(a.http_addr + path, data=data,
+                                         method=method)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read() or "null")
+        cfg = call("GET", "/v1/operator/autopilot/configuration")
+        assert "CleanupDeadServers" in cfg
+        call("PUT", "/v1/operator/autopilot/configuration",
+             {"CleanupDeadServers": False})
+        assert call("GET", "/v1/operator/autopilot/configuration")[
+            "CleanupDeadServers"] is False
+        health = call("GET", "/v1/operator/autopilot/health")
+        assert health["Healthy"] is True
+        raft_cfg = call("GET", "/v1/operator/raft/configuration")
+        assert raft_cfg["Servers"]
+    finally:
+        a.shutdown()
