@@ -68,6 +68,16 @@ class HTTPAPI:
             # node-local routes served by the client half of the agent
             # (ref command/agent/fs_endpoint.go, agent_endpoint.go)
             return self._handle_client(method, parts[1:], query, body, token)
+        if parts == ["agent", "health"]:
+            # reachable on client-only agents too: monitoring probes client
+            # nodes through this (ref agent_endpoint.go HealthRequest)
+            out = {}
+            if self.server is not None:
+                out["server"] = {"ok": True, "message": "ok"}
+            if self.agent.client is not None:
+                out["client"] = {"ok": self.agent.client.node.ready(),
+                                 "message": "ok"}
+            return out, None
         if s is None:
             # client-only agents serve no server-backed routes yet (the
             # reference proxies these RPCs to its servers; our CLI/SDK talk
@@ -592,7 +602,7 @@ class HTTPAPI:
             peers = getattr(s.raft, "peers", None)
             if peers:
                 return sorted(peers.values()), None
-            return [s.rpc_addr() if s.rpc_server is not None
+            return [s.rpc_addr if s.rpc_server is not None
                     else "127.0.0.1:4647"], None
         if parts == ["status", "leader"]:
             return "127.0.0.1:4647" if s.is_leader else "", None
@@ -602,15 +612,6 @@ class HTTPAPI:
                                "Client": {"Enabled": self.agent.client is not None},
                                "Version": self._version()},
                     "stats": self.agent.stats()}, None
-        if parts == ["agent", "health"]:
-            # ref command/agent/agent_endpoint.go HealthRequest
-            out = {}
-            if self.server is not None:
-                out["server"] = {"ok": True, "message": "ok"}
-            if self.agent.client is not None:
-                out["client"] = {"ok": self.agent.client.node.ready(),
-                                 "message": "ok"}
-            return out, None
         if parts == ["agent", "members"]:
             cfg = s.operator_raft_configuration()
             return {"Members": [{

@@ -446,26 +446,17 @@ def cmd_operator_raft(args) -> None:
 
 def cmd_operator_snapshot(args) -> None:
     """ref command/operator_snapshot_save.go / _restore.go"""
-    import urllib.request
-    addr = os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
-    headers = {}
-    if os.environ.get("NOMAD_TOKEN"):
-        headers["X-Nomad-Token"] = os.environ["NOMAD_TOKEN"]
+    from .api import Client
+    sdk = Client(timeout=60)
     if args.action == "save":
-        req = urllib.request.Request(addr + "/v1/operator/snapshot",
-                                     headers=headers)
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            data = resp.read()
+        data = sdk.operator.snapshot_save()
         with open(args.file, "wb") as f:
             f.write(data)
         print(f"==> Snapshot saved to {args.file} ({len(data)} bytes)")
     else:
         with open(args.file, "rb") as f:
             data = f.read()
-        req = urllib.request.Request(addr + "/v1/operator/snapshot",
-                                     data=data, method="PUT",
-                                     headers=headers)
-        urllib.request.urlopen(req, timeout=60).read()
+        sdk.operator.snapshot_restore(data)
         print("==> Snapshot restored")
 
 
@@ -486,24 +477,10 @@ def cmd_operator_autopilot(args) -> None:
 
 def cmd_monitor(args) -> None:
     """Stream agent logs (ref command/monitor.go)."""
-    import urllib.request
-    addr = os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
-    url = f"{addr}/v1/agent/monitor?log_level={args.log_level}"
-    headers = {}
-    if os.environ.get("NOMAD_TOKEN"):
-        headers["X-Nomad-Token"] = os.environ["NOMAD_TOKEN"]
-    req = urllib.request.Request(url, headers=headers)
-    with urllib.request.urlopen(req, timeout=3600) as resp:
-        for line in resp:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                data = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if data.get("Data"):
-                print(data["Data"])
+    from .api import Client
+    sdk = Client(timeout=3600)
+    for line in sdk.agent.monitor(log_level=args.log_level):
+        print(line)
 
 
 def cmd_system_gc(args) -> None:

@@ -153,16 +153,19 @@ class AllocRunner:
                              self.alloc_dir,
                              os.path.join(task_dir, "secrets"))
         # device hook: reserved device instances -> visibility env vars
-        # (ref taskrunner/device_hook.go)
+        # (ref taskrunner/device_hook.go); a reservation failure fails the
+        # task rather than launching it without its devices
+        setup_error = ""
         tres = self.alloc.allocated_resources.tasks.get(task.name)
         for ad in (tres.devices if tres else []):
             try:
                 res = self.client.device_manager.reserve(ad)
                 env.update(res.envs)
             except ValueError as e:
-                self.client.logger(f"device reserve failed: {e}")
+                setup_error = f"device reservation failed: {e}"
+                self.client.logger(setup_error)
         tr = TaskRunner(self.alloc, task, driver, task_dir, env,
-                        self._on_task_state)
+                        self._on_task_state, setup_error=setup_error)
         with self._lock:
             self.task_runners[task.name] = tr
         return tr

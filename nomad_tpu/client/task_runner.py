@@ -35,7 +35,8 @@ EVENT_RESTART_SIGNAL = "Restart Signaled"
 class TaskRunner:
     def __init__(self, alloc, task: Task, driver: Driver, task_dir: str,
                  env: dict[str, str],
-                 on_state_change: Callable[[str, TaskState], None]):
+                 on_state_change: Callable[[str, TaskState], None],
+                 setup_error: str = ""):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -51,6 +52,7 @@ class TaskRunner:
         self._restarts_in_window: list[float] = []
         self._restart_req = False
         self._logmon = None
+        self.setup_error = setup_error   # pre-start hook failure (devices)
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
         self.restart_policy = tg.restart_policy if tg else None
@@ -68,6 +70,11 @@ class TaskRunner:
 
     def run(self) -> None:
         self._emit(EVENT_RECEIVED, "task received by client")
+        if self.setup_error:
+            # a failed pre-start hook (e.g. device reservation) fails the
+            # task rather than launching it degraded (ref device_hook.go)
+            self._fail(EVENT_TASK_SETUP, self.setup_error)
+            return
         try:
             self._setup()
         except Exception as e:          # noqa: BLE001

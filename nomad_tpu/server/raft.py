@@ -468,6 +468,11 @@ class RaftNode:
             self._next_index = {pid: nxt for pid in self.peers}
             self._match_index = {pid: 0 for pid in self.peers}
             self._match_index[self.node_id] = self._last_index()
+            # baseline contact at election: a fresh leader must not report
+            # never-contacted-yet peers as long-dead (autopilot would reap
+            # a briefly-slow follower right after failover)
+            now = time.monotonic()
+            self._last_ok = {pid: now for pid in self.peers}
             # commit a no-op entry to finalize commitment of prior terms
             # (Raft §8: a leader may only count replicas of current-term
             # entries toward commit)

@@ -869,7 +869,7 @@ class Server:
             return {"Servers": servers, "Index": self.raft.barrier()}
         return {"Servers": [{
             "ID": "server-1", "Node": "server-1",
-            "Address": self.rpc_addr() if self.rpc_server else "local",
+            "Address": self.rpc_addr if self.rpc_server else "local",
             "Leader": self.is_leader, "Voter": True, "RaftProtocol": "3",
         }], "Index": self.raft.barrier()}
 
@@ -946,10 +946,14 @@ class Server:
                 # just joined: give it time to come up before reaping
                 continue
             age = s["LastContactSec"]
-            if age is not None and age < threshold:
+            if age is None or age < threshold:
+                # None = no contact data (shouldn't happen on a leader past
+                # election baseline) — never treat unknown as dead
                 continue
             try:
-                self.raft.remove_peer(s["ID"])
+                # bounded wait: a quorum-less cluster must not stall the
+                # leader housekeeping loop for the full apply timeout
+                self.raft.remove_peer(s["ID"], timeout=5.0)
                 self.logger(f"autopilot: removed dead server {s['ID']}")
                 removable -= 1
             except Exception as e:  # noqa: BLE001

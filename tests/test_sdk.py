@@ -1,0 +1,214 @@
+"""SDK tests: the typed api.Client against a live dev agent (modeled on
+the reference's api/ package tests, which run against a real agent via
+testutil.TestServer — ref testutil/server.go:126)."""
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import APIError, Client, QueryOptions, event_stream
+from nomad_tpu.api_codec import to_api
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    assert wait_until(
+        lambda: a.server.state.node_by_id(a.client.node.id) is not None
+        and a.server.state.node_by_id(a.client.node.id).ready())
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return Client(address=agent.http_addr)
+
+
+def _job_spec(job_id, run_for=30, count=1):
+    job = mock.job()
+    job.id = job.name = job_id
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": run_for}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    return to_api(job)
+
+
+def test_jobs_family(api):
+    out = api.jobs.register(_job_spec("sdkjob"))
+    assert out["eval_id"]
+    jobs, meta = api.jobs.list()
+    assert any(j["ID"] == "sdkjob" for j in jobs)
+    assert meta.last_index > 0
+    info, _ = api.jobs.info("sdkjob")
+    assert info["ID"] == "sdkjob"
+    evals, _ = api.jobs.evaluations("sdkjob")
+    assert evals
+    assert wait_until(lambda: api.jobs.allocations("sdkjob")[0])
+    summary, _ = api.jobs.summary("sdkjob")
+    assert "Summary" in summary
+    versions, _ = api.jobs.versions("sdkjob")
+    assert versions[0]["Version"] == 0
+    parsed = api.jobs.parse(
+        'job "p" { group "g" { task "t" { driver = "mock_driver" } } }')
+    assert parsed["ID"] == "p"
+    validated = api.jobs.validate(_job_spec("whatever"))
+    assert validated["ValidationErrors"] == []
+
+
+def test_blocking_query(api):
+    jobs, meta = api.jobs.list()
+    results = {}
+
+    def blocked():
+        results["out"] = api.jobs.list(QueryOptions(
+            wait_index=meta.last_index, wait_time_sec=10))
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.3)
+    api.jobs.register(_job_spec("sdkblocking"))
+    t.join(timeout=15)
+    assert not t.is_alive()
+    out, meta2 = results["out"]
+    assert meta2.last_index > meta.last_index
+    assert any(j["ID"] == "sdkblocking" for j in out)
+
+
+def test_allocations_and_logs(api, agent):
+    job = mock.job()
+    job.id = job.name = "sdklogs"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", "echo sdk-log-line; sleep 30"]}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    api.jobs.register(to_api(job))
+    assert wait_until(lambda: any(
+        a["ClientStatus"] == "running"
+        for a in api.jobs.allocations("sdklogs")[0]))
+    alloc = [a for a in api.jobs.allocations("sdklogs")[0]
+             if a["ClientStatus"] == "running"][0]
+    info, _ = api.allocations.info(alloc["ID"])
+    assert info["JobID"] == "sdklogs"
+    assert wait_until(lambda: api.allocations.logs(
+        alloc["ID"], task.name) == b"sdk-log-line\n")
+    ls, _ = api.allocations.fs_list(alloc["ID"], task.name)
+    assert any(e["Name"] == "local" for e in ls)
+    stats, _ = api.allocations.stats(alloc["ID"])
+    assert "ResourceUsage" in stats
+    api.allocations.signal(alloc["ID"], "SIGHUP", task.name)
+    api.allocations.stop(alloc["ID"])
+
+
+def test_nodes_and_search(api):
+    nodes, _ = api.nodes.list()
+    assert nodes
+    node, _ = api.nodes.info(nodes[0]["ID"])
+    assert node["Status"] == "ready"
+    out = api.search.prefix(nodes[0]["ID"][:8], "nodes")
+    assert nodes[0]["ID"] in out["Matches"]["nodes"]
+    out = api.search.fuzzy("sdk", "jobs")
+    assert out["Matches"]
+
+
+def test_scaling_and_operator(api):
+    from nomad_tpu.structs import ScalingPolicy
+    job = mock.job()
+    job.id = job.name = "sdkscale"
+    job.task_groups[0].count = 1
+    job.task_groups[0].scaling = ScalingPolicy(min=1, max=5)
+    job.task_groups[0].tasks[0].driver = "mock_driver"
+    job.task_groups[0].tasks[0].config = {"run_for": 5}
+    job.task_groups[0].tasks[0].resources.networks = []
+    api.jobs.register(to_api(job))
+    pols, _ = api.scaling.policies(job="sdkscale")
+    assert len(pols) == 1
+    pol, _ = api.scaling.policy_info(pols[0]["ID"])
+    assert pol["Max"] == 5
+    api.jobs.scale("sdkscale", job.task_groups[0].name, 3, "sdk test")
+    status, _ = api.jobs.scale_status("sdkscale")
+    assert status["TaskGroups"][job.task_groups[0].name]["Desired"] == 3
+
+    cfg, _ = api.operator.scheduler_get_configuration()
+    assert "SchedulerConfig" in cfg
+    raft, _ = api.operator.raft_get_configuration()
+    assert raft["Servers"]
+    health, _ = api.operator.autopilot_health()
+    assert health["Healthy"] is True
+    snap = api.operator.snapshot_save()
+    assert snap
+
+
+def test_agent_and_system(api):
+    health, _ = api.agent.health()
+    assert health["server"]["ok"]
+    members, _ = api.agent.members()
+    assert members["Members"]
+    regions, _ = api.agent.regions()
+    assert regions == ["global"]
+    stats, _ = api.client_api.stats()
+    assert stats["Memory"]["Total"] > 0
+    api.system.gc()
+
+
+def test_api_error(api):
+    with pytest.raises(APIError) as e:
+        api.jobs.info("does-not-exist-xyz")
+    assert e.value.status == 404
+
+
+def test_event_stream(api):
+    events = []
+    done = threading.Event()
+
+    def consume():
+        for frame in event_stream(api, topics={"Job": ["*"]}):
+            if frame.get("Events"):
+                events.extend(frame["Events"])
+                done.set()
+                return
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    api.jobs.register(_job_spec("sdkevents", run_for=1))
+    assert done.wait(timeout=15)
+    assert any(e.get("Topic") == "Job" for e in events)
+
+
+def test_csi_volume_family(api, agent):
+    import os
+    from nomad_tpu.client.csimanager import HostPathCSIPlugin
+    agent.client.register_csi_plugin(
+        "hostpath", HostPathCSIPlugin(
+            os.path.join(agent.config.data_dir, "csi-sdk")))
+    assert wait_until(lambda: api.csi_plugins.list()[0])
+    api.csi_volumes.register({"ID": "sdkvol", "Name": "sdkvol",
+                              "PluginID": "hostpath"})
+    vols, _ = api.csi_volumes.list()
+    assert any(v["ID"] == "sdkvol" for v in vols)
+    vol, _ = api.csi_volumes.info("sdkvol")
+    assert vol["PluginID"] == "hostpath"
+    plugins, _ = api.csi_plugins.list()
+    assert plugins[0]["ID"] == "hostpath"
+    api.csi_volumes.deregister("sdkvol")
