@@ -499,6 +499,27 @@ class HTTPAPI:
                                                   NS_READ_SCALING_POLICY))
             return to_api(p), s.state.table_index("scaling_policy")
 
+        # ---- native service catalog (the consul integration's API face)
+        if parts == ["services"]:
+            require(ns == "*" or acl.allow_namespace_operation(ns,
+                                                               NS_READ_JOB))
+            by_name: dict[str, list] = {}
+            for inst in s.service_list(None if ns == "*" else ns):
+                if ns == "*" and not acl.allow_namespace_operation(
+                        inst.namespace, NS_READ_JOB):
+                    continue
+                by_name.setdefault(inst.service_name, []).append(inst)
+            return [{"Namespace": insts[0].namespace, "ServiceName": name,
+                     "Tags": sorted({t for i in insts for t in i.tags})}
+                    for name, insts in sorted(by_name.items())], \
+                s.state.table_index("services")
+        if parts and parts[0] == "service" and len(parts) >= 2:
+            require(acl.allow_namespace_operation(ns, NS_READ_JOB))
+            name = urllib.parse.unquote(parts[1])
+            insts = s.service_instances(ns, name)
+            return [to_api(i) for i in insts], \
+                s.state.table_index("services")
+
         # ---- CSI volumes + plugins (ref command/agent/csi_endpoint.go)
         if parts == ["volumes"]:
             from ..acl import NS_CSI_LIST_VOLUME, NS_CSI_WRITE_VOLUME

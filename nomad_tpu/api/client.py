@@ -63,6 +63,7 @@ class Client:
         self.scaling = Scaling(self)
         self.csi_volumes = CSIVolumes(self)
         self.csi_plugins = CSIPlugins(self)
+        self.services = Services(self)
         self.system = System(self)
         self.agent = AgentAPI(self)
         self.client_api = ClientAPI(self)
@@ -499,6 +500,16 @@ class CSIPlugins(_Handle):
 
     def info(self, plugin_id: str):
         return self.c.get(f"/v1/plugin/csi/{plugin_id}")
+
+
+class Services(_Handle):
+    """ref api/services.go (native service discovery)"""
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/services", q)
+
+    def instances(self, name: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/service/{urllib.parse.quote(name)}", q)
 
 
 class System(_Handle):

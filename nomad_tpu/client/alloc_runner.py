@@ -39,6 +39,9 @@ class AllocRunner:
         # set once a terminal client status was acked by the server —
         # gates local GC (client.gc_alloc)
         self.synced_terminal = False
+        self._vault_tokens: dict[str, str] = {}      # task -> token
+        self._services_registered = False
+        self._check_runners: list = []
 
         self.alloc_dir = os.path.join(client.alloc_dir_root, alloc.id)
 
@@ -53,9 +56,132 @@ class AllocRunner:
         try:
             self._run_impl()
         finally:
-            # release any CSI claims/mounts whatever path we exited on
-            # (ref csi_hook.go Postrun)
+            # postrun hooks, whatever path we exited on: CSI unmount
+            # (csi_hook.go), service deregistration (the consul group
+            # services hook), vault token revocation (vault_hook.go Stop)
             self.client.csi_manager.unmount_all(self.alloc)
+            self._deregister_services()
+            for token in self._vault_tokens.values():
+                try:
+                    self.client.rpc.vault_revoke_token(token)
+                except Exception:       # noqa: BLE001 — best effort
+                    pass
+            self._vault_tokens.clear()
+
+    def _start_vault_renewal(self, task, token: str, ttl_sec: float) -> None:
+        """Half-TTL renewal loop; a failed renewal applies the task's vault
+        change_mode (ref client/vaultclient token renewal +
+        taskrunner/vault_hook.go watch loop)."""
+        def renew_loop():
+            interval = max(1.0, ttl_sec / 2)
+            while not self._destroyed.wait(interval):
+                if self._vault_tokens.get(task.name) != token:
+                    return   # replaced or revoked
+                try:
+                    self.client.rpc.vault_renew_token(token)
+                except Exception as e:  # noqa: BLE001
+                    self.client.logger(
+                        f"vault: renew failed for {task.name}: {e!r}")
+                    tr = self.task_runners.get(task.name)
+                    mode = task.vault.change_mode
+                    try:
+                        if tr is not None and mode == "restart":
+                            tr.restart("vault token renewal failed")
+                        elif tr is not None and mode == "signal":
+                            tr.signal(task.vault.change_signal or "SIGHUP",
+                                      "vault token renewal failed")
+                    except ValueError:
+                        pass   # task not running: nothing to notify
+                    return
+        threading.Thread(target=renew_loop, daemon=True,
+                         name=f"vault-renew-{task.name}").start()
+
+    # ------------------------------------------------------------- services
+
+    def _service_instances(self):
+        """Build catalog rows for every tg- and task-level service (ref
+        command/agent/consul service registration)."""
+        from ..integrations.services import ServiceInstance
+        alloc = self.alloc
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        if tg is None:
+            return []
+        address = (self.client.node.http_addr.rsplit(":", 1)[0]
+                   if self.client.node.http_addr else "127.0.0.1")
+        out = []
+
+        def port_for(label: str, task_name: str = "") -> int:
+            if label.isdigit():
+                return int(label)
+            tres = alloc.allocated_resources.tasks.get(task_name) \
+                if task_name else None
+            nets = (tres.networks if tres else []) or []
+            for net in nets:
+                for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                    if p.label == label:
+                        return p.value
+            # group-network ports live in shared resources
+            # (ref scheduler/rank.py shared.ports / structs AllocatedPorts)
+            for p in alloc.allocated_resources.shared.ports:
+                if p.get("label") == label:
+                    return p.get("value", 0)
+            return 0
+
+        for svc, task_name in (
+                [(s, "") for s in tg.services]
+                + [(s, t.name) for t in tg.tasks for s in t.services]):
+            out.append((ServiceInstance(
+                service_name=svc.name, namespace=alloc.namespace,
+                job_id=alloc.job_id, alloc_id=alloc.id,
+                node_id=alloc.node_id, task=task_name, address=address,
+                port=port_for(svc.port_label, task_name),
+                tags=tuple(svc.tags)), list(svc.checks)))
+        return out
+
+    def _register_services(self) -> None:
+        from ..integrations.services import CheckRunner
+        with self._lock:
+            # claim-before-RPC so concurrent RUNNING transitions don't
+            # double-register / double-spawn check runners
+            if self._services_registered:
+                return
+            self._services_registered = True
+        pairs = self._service_instances()
+        if not pairs:
+            return
+        try:
+            self.client.rpc.service_register([inst for inst, _ in pairs])
+        except Exception as e:          # noqa: BLE001
+            self.client.logger(f"service register failed: {e!r}")
+            with self._lock:
+                self._services_registered = False   # retried by sync loop
+            return
+
+        def on_status(instance, status):
+            instance = instance.copy()
+            instance.status = status
+            try:
+                self.client.rpc.service_register([instance])
+            except Exception as e:      # noqa: BLE001
+                self.client.logger(f"check status push failed: {e!r}")
+        for inst, checks in pairs:
+            if checks:
+                cr = CheckRunner(inst, checks, on_status)
+                cr.start()
+                self._check_runners.append(cr)
+
+    def _deregister_services(self) -> None:
+        for cr in self._check_runners:
+            cr.stop()
+        self._check_runners.clear()
+        if not self._services_registered:
+            return
+        self._services_registered = False
+        try:
+            self.client.rpc.service_deregister(alloc_id=self.alloc.id)
+        except Exception as e:          # noqa: BLE001
+            self.client.logger(f"service deregister failed: {e!r}")
 
     def _run_impl(self) -> None:
         alloc = self.alloc
@@ -164,8 +290,46 @@ class AllocRunner:
             except ValueError as e:
                 setup_error = f"device reservation failed: {e}"
                 self.client.logger(setup_error)
+
+        rendered: list[tuple[str, str, str]] = []
+        # vault hook: derive a task token, expose VAULT_TOKEN + the
+        # secrets/vault_token file (ref taskrunner/vault_hook.go)
+        if task.vault is not None and not setup_error:
+            try:
+                out = self.client.rpc.vault_derive_token(self.alloc.id,
+                                                         task.name)
+                token = out["token"]
+                self._vault_tokens[task.name] = token
+                self._start_vault_renewal(task, token,
+                                          float(out.get("ttl_sec", 3600)))
+                if task.vault.env:
+                    env["VAULT_TOKEN"] = token
+                rendered.append(("secrets/vault_token", token, "0600"))
+            except Exception as e:      # noqa: BLE001
+                setup_error = f"vault token derivation failed: {e}"
+                self.client.logger(setup_error)
+
+        # template hook: render embedded templates against env + secrets +
+        # the service catalog (ref taskrunner/template_hook.go)
+        if task.templates and not setup_error:
+            from ..integrations.template import TemplateError, render_template
+            for tmpl in task.templates:
+                try:
+                    content = render_template(
+                        tmpl.embedded_tmpl, env,
+                        secret_reader=self.client.rpc.secret_read,
+                        service_lookup=lambda name: self.client.rpc
+                        .service_instances(self.alloc.namespace, name))
+                    rendered.append((tmpl.dest_path or "local/template",
+                                     content, tmpl.perms))
+                except TemplateError as e:
+                    setup_error = f"template render failed: {e}"
+                    self.client.logger(setup_error)
+                    break
+
         tr = TaskRunner(self.alloc, task, driver, task_dir, env,
-                        self._on_task_state, setup_error=setup_error)
+                        self._on_task_state, setup_error=setup_error,
+                        rendered_files=rendered)
         with self._lock:
             self.task_runners[task.name] = tr
         return tr
@@ -181,6 +345,11 @@ class AllocRunner:
                 for name, tr in self.task_runners.items():
                     if name != task_name and not tr.state.failed:
                         tr.kill("sibling task failed")
+        if state.state == TASK_STATE_RUNNING \
+                and not self._services_registered:
+            # first task up: publish the alloc's services (ref the consul
+            # group-services + service hooks firing at poststart)
+            self._register_services()
         self._dirty.set()
         self.client.alloc_state_updated(self)
 

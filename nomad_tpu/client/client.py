@@ -208,6 +208,15 @@ class Client:
                     self._dirty_cond.wait(0.5)
                 dirty = list(self._dirty_allocs)
                 self._dirty_allocs.clear()
+            # service registration retry (the consul sync-loop analog): a
+            # running alloc whose register RPC failed re-attempts each pass
+            with self._lock:
+                runners = list(self.alloc_runners.values())
+            for ar in runners:
+                if not ar._services_registered and any(
+                        s.state == "running"
+                        for s in ar.task_states.values()):
+                    ar._register_services()
             # deployment health is time-based (min_healthy_time elapses with
             # no task-state change), so allocs with an undecided verdict are
             # re-evaluated every pass (ref allocrunner health_hook's timer)

@@ -36,7 +36,8 @@ class TaskRunner:
     def __init__(self, alloc, task: Task, driver: Driver, task_dir: str,
                  env: dict[str, str],
                  on_state_change: Callable[[str, TaskState], None],
-                 setup_error: str = ""):
+                 setup_error: str = "",
+                 rendered_files: Optional[list] = None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -53,6 +54,9 @@ class TaskRunner:
         self._restart_req = False
         self._logmon = None
         self.setup_error = setup_error   # pre-start hook failure (devices)
+        # (relative_path, content, perms) written into the task dir at
+        # setup: rendered templates, vault token (ref template/vault hooks)
+        self.rendered_files = rendered_files or []
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
         self.restart_policy = tg.restart_policy if tg else None
@@ -114,6 +118,15 @@ class TaskRunner:
         os.makedirs(self.task_dir, exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "local"), exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "secrets"), exist_ok=True)
+        for rel, content, perms in self.rendered_files:
+            path = os.path.join(self.task_dir, rel.lstrip("/"))
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(content)
+            try:
+                os.chmod(path, int(perms, 8))
+            except (ValueError, OSError):
+                pass
         # log rotation per the task's log stanza (ref logmon_hook.go)
         from .logmon import LogRotator
         self._logmon = LogRotator(self.task_dir, self.task.name,

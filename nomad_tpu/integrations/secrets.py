@@ -1,0 +1,101 @@
+"""Secrets provider: the Vault integration redesigned as an interface
+(ref nomad/vault.go vaultClient — token derivation/renewal/revocation —
+and client/vaultclient/vaultclient.go).
+
+The server owns one provider; clients derive per-task tokens through the
+`Vault.DeriveToken` RPC exactly like the reference's Node.DeriveVaultToken
+path (nomad/node_endpoint.go DeriveVaultToken). `InMemorySecretsProvider`
+is the dev/test backend (static KV + local token issuance with TTLs); a
+real Vault backend implements the same four methods over HTTP.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Optional
+
+
+@dataclasses.dataclass
+class VaultToken:
+    token: str = ""
+    accessor: str = ""
+    policies: tuple = ()
+    ttl_sec: float = 3600.0
+    expires_at: float = 0.0
+    renewable: bool = True
+
+
+class SecretsProvider:
+    """ref nomad/vault.go VaultClient interface (subset that matters)."""
+
+    def derive_token(self, alloc_id: str, task: str,
+                     policies: list[str]) -> VaultToken:
+        raise NotImplementedError
+
+    def renew_token(self, token: str) -> VaultToken:
+        raise NotImplementedError
+
+    def revoke_token(self, token: str) -> None:
+        raise NotImplementedError
+
+    def read(self, path: str) -> Optional[dict]:
+        """KV read for template rendering ({{secret "path"}})."""
+        raise NotImplementedError
+
+
+class InMemorySecretsProvider(SecretsProvider):
+    """Dev-mode backend: static KV store + locally-issued TTL tokens.
+
+    Cluster note: this backend is process-local, so all Vault RPCs are
+    leader-routed (server.py RPC_ENDPOINTS); a leader failover loses issued
+    tokens (clients re-derive via their renewal loop's failure path). A
+    real Vault backend is an external shared service and has neither
+    limitation."""
+
+    def __init__(self, kv: Optional[dict[str, dict]] = None,
+                 default_ttl: float = 3600.0):
+        self.kv = dict(kv or {})
+        self.default_ttl = default_ttl
+        self._lock = threading.Lock()
+        self._tokens: dict[str, VaultToken] = {}
+
+    def put(self, path: str, data: dict) -> None:
+        with self._lock:
+            self.kv[path] = dict(data)
+
+    def derive_token(self, alloc_id, task, policies):
+        tok = VaultToken(
+            token=str(uuid.uuid4()), accessor=str(uuid.uuid4()),
+            policies=tuple(policies), ttl_sec=self.default_ttl,
+            expires_at=time.time() + self.default_ttl)
+        with self._lock:
+            self._tokens[tok.token] = tok
+        return tok
+
+    def renew_token(self, token):
+        with self._lock:
+            tok = self._tokens.get(token)
+            if tok is None:
+                raise ValueError("unknown or revoked token")
+            if not tok.renewable:
+                raise ValueError("token is not renewable")
+            tok = dataclasses.replace(
+                tok, expires_at=time.time() + tok.ttl_sec)
+            self._tokens[token] = tok
+            return tok
+
+    def revoke_token(self, token):
+        with self._lock:
+            self._tokens.pop(token, None)
+
+    def token_valid(self, token: str) -> bool:
+        with self._lock:
+            tok = self._tokens.get(token)
+            return tok is not None and tok.expires_at > time.time()
+
+    def read(self, path):
+        with self._lock:
+            data = self.kv.get(path)
+            return dict(data) if data is not None else None

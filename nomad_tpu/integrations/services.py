@@ -1,0 +1,122 @@
+"""Native service catalog + health checking: the Consul integration
+redesigned as a built-in subsystem (ref nomad/consul.go +
+command/agent/consul/service_client.go registration lifecycle and check
+watching; the catalog itself is state-store-backed like the native service
+discovery the reference line later added).
+
+Registrations are raft-replicated rows keyed (namespace, service, alloc);
+clients register/deregister through Service RPCs and run their checks
+locally, pushing status transitions the same way Consul agents do.
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import socket
+import threading
+import urllib.parse
+from typing import Callable, Optional
+
+CHECK_PASSING = "passing"
+CHECK_CRITICAL = "critical"
+
+
+@dataclasses.dataclass
+class ServiceInstance:
+    """One registered service instance (ref structs ServiceRegistration)."""
+    service_name: str = ""
+    namespace: str = "default"
+    job_id: str = ""
+    alloc_id: str = ""
+    node_id: str = ""
+    task: str = ""
+    address: str = "127.0.0.1"
+    port: int = 0
+    tags: tuple = ()
+    status: str = CHECK_PASSING
+    create_index: int = 0
+    modify_index: int = 0
+
+    def key(self) -> tuple[str, str, str, str]:
+        # task in the key: one alloc may expose the same service name from
+        # several tasks (different ports) without rows clobbering each other
+        return (self.namespace, self.service_name, self.alloc_id, self.task)
+
+    def copy(self) -> "ServiceInstance":
+        return dataclasses.replace(self)
+
+
+def check_service(check: dict, address: str, port: int,
+                  timeout: float = 3.0) -> bool:
+    """Execute one health check definition (ref command/agent/consul
+    check types: http/tcp)."""
+    ctype = check.get("type", "tcp")
+    if ctype == "tcp":
+        try:
+            with socket.create_connection((address, port), timeout=timeout):
+                return True
+        except OSError:
+            return False
+    if ctype == "http":
+        path = check.get("path", "/")
+        try:
+            conn = http.client.HTTPConnection(address, port, timeout=timeout)
+            conn.request(check.get("method", "GET"), path)
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            return 200 <= resp.status < 400
+        except OSError:
+            return False
+    if ctype == "script":
+        import subprocess
+        try:
+            return subprocess.run(
+                check.get("command", "/bin/true").split(),
+                timeout=timeout, capture_output=True).returncode == 0
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+    return True  # unknown check types pass (like a TTL check never set)
+
+
+class CheckRunner:
+    """Periodic check execution for one service instance; pushes status
+    transitions through the provided callback (ref consul check_watcher)."""
+
+    def __init__(self, instance: ServiceInstance, checks: list[dict],
+                 on_status: Callable[[ServiceInstance, str], None],
+                 interval: float = 5.0):
+        self.instance = instance
+        self.checks = checks
+        self.on_status = on_status
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.status = CHECK_PASSING
+
+    def start(self) -> None:
+        if not self.checks:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"check-{self.instance.service_name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_once(self) -> str:
+        ok = all(check_service(c, self.instance.address,
+                               self.instance.port) for c in self.checks)
+        status = CHECK_PASSING if ok else CHECK_CRITICAL
+        if status != self.status:
+            self.status = status
+            self.on_status(self.instance, status)
+        return status
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception:   # noqa: BLE001 — checks must never die
+                pass

@@ -1,0 +1,68 @@
+"""Template rendering for task `template` stanzas (ref
+client/allocrunner/taskrunner/template/template.go, which embeds
+consul-template).
+
+Supported functions — the consul-template subset the reference's docs lean
+on, resolved against framework-native sources:
+
+  {{ env "NAME" }}                  task environment variable
+  {{ key "path" }}                  service-catalog KV -> secrets provider
+  {{ secret "path" "field" }}       secrets provider read (field optional)
+  {{ service "name" }}              -> "addr:port" of first healthy instance
+  {{ range service "name" }}...{{ end }} is NOT supported (static subset)
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Callable, Optional
+
+_FUNC = re.compile(
+    r"\{\{\s*(env|key|secret|service)\s+\"([^\"]+)\"(?:\s+\"([^\"]+)\")?"
+    r"\s*\}\}")
+
+
+class TemplateError(Exception):
+    pass
+
+
+def render_template(tmpl: str, env: dict[str, str],
+                    secret_reader: Optional[Callable] = None,
+                    service_lookup: Optional[Callable] = None) -> str:
+    """Render one embedded template. Missing keys raise TemplateError so a
+    task fails visibly instead of starting with a half-rendered config
+    (ref template.go: blocks until all dependencies resolve)."""
+
+    def sub(m: re.Match) -> str:
+        fn, arg, field = m.group(1), m.group(2), m.group(3)
+        if fn == "env":
+            if arg not in env:
+                raise TemplateError(f"env var {arg!r} not set")
+            return env[arg]
+        if fn in ("key", "secret"):
+            if secret_reader is None:
+                raise TemplateError("no secrets provider configured")
+            data = secret_reader(arg)
+            if data is None:
+                raise TemplateError(f"secret {arg!r} not found")
+            if fn == "secret" and field:
+                if field not in data:
+                    raise TemplateError(
+                        f"secret {arg!r} has no field {field!r}")
+                return str(data[field])
+            if len(data) == 1:
+                return str(next(iter(data.values())))
+            return json.dumps(data, sort_keys=True)
+        if fn == "service":
+            if service_lookup is None:
+                raise TemplateError("no service catalog configured")
+            instances = service_lookup(arg)
+            healthy = [i for i in instances
+                       if getattr(i, "status", "passing") == "passing"]
+            if not healthy:
+                raise TemplateError(f"no healthy instances of {arg!r}")
+            inst = healthy[0]
+            return f"{inst.address}:{inst.port}"
+        raise TemplateError(f"unknown function {fn!r}")
+
+    return _FUNC.sub(sub, tmpl)

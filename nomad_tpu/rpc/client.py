@@ -199,6 +199,27 @@ class ServerRpc:
     def csi_volume_claim(self, namespace: str, volume_id: str, claim):
         return self.rpc.call("CSIVolume.Claim", namespace, volume_id, claim)
 
+    def vault_derive_token(self, alloc_id: str, task: str):
+        return self.rpc.call("Vault.DeriveToken", alloc_id, task)
+
+    def vault_renew_token(self, token: str):
+        return self.rpc.call("Vault.RenewToken", token)
+
+    def vault_revoke_token(self, token: str):
+        return self.rpc.call("Vault.RevokeToken", token)
+
+    def secret_read(self, path: str):
+        return self.rpc.call("Vault.Read", path)
+
+    def service_register(self, instances):
+        return self.rpc.call("Service.Register", instances)
+
+    def service_deregister(self, alloc_id: str = "", keys=None):
+        return self.rpc.call("Service.Deregister", alloc_id, keys)
+
+    def service_instances(self, namespace: str, name: str):
+        return self.rpc.call("Service.Instances", namespace, name)
+
     def node_update_allocs(self, allocs):
         return self.rpc.call("Node.UpdateAlloc", allocs)
 

@@ -57,6 +57,8 @@ CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequestType"
 CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequestType"
 CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
 AUTOPILOT_CONFIG = "AutopilotRequestType"
+SERVICE_REGISTER = "ServiceRegistrationUpsertRequestType"
+SERVICE_DEREGISTER = "ServiceRegistrationDeleteRequestType"
 
 
 @dataclasses.dataclass
@@ -193,6 +195,11 @@ class NomadFSM:
                                payload["volume_id"], payload["claim"])
         elif msg_type == AUTOPILOT_CONFIG:
             s.set_autopilot_config(index, payload["config"])
+        elif msg_type == SERVICE_REGISTER:
+            s.upsert_service_registrations(index, payload["services"])
+        elif msg_type == SERVICE_DEREGISTER:
+            s.delete_service_registrations(
+                index, payload.get("alloc_id", ""), payload.get("keys"))
         else:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
@@ -226,6 +233,7 @@ class NomadFSM:
                 "csi_volumes": s.csi_volumes,
                 "csi_plugins": s.csi_plugins,
                 "autopilot_config": s.autopilot_config,
+                "services": s.services,
             }
             return pickle.dumps(blob)
 
@@ -256,6 +264,7 @@ class NomadFSM:
             s.csi_plugins = dict(blob.get("csi_plugins", {}))
             s.autopilot_config = dict(
                 blob.get("autopilot_config", s.autopilot_config))
+            s.services = dict(blob.get("services", {}))
             s._acl_token_by_secret = {
                 t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes

@@ -78,6 +78,17 @@ RPC_ENDPOINTS = {
     "CSIVolume.Get": ("csi_volume_get", False),
     "CSIPlugin.List": ("csi_plugin_list", False),
     "CSIPlugin.Get": ("csi_plugin_get", False),
+    "Service.Register": ("service_register", True),
+    "Service.Deregister": ("service_deregister", True),
+    "Service.List": ("service_list", False),
+    "Service.Instances": ("service_instances", False),
+    "Vault.DeriveToken": ("vault_derive_token", True),
+    "Vault.RenewToken": ("vault_renew_token", True),
+    "Vault.RevokeToken": ("vault_revoke_token", True),
+    # leader-only: the in-memory dev backend lives in one process; routing
+    # every secret op at the leader keeps reads/renews consistent (a real
+    # Vault backend is an external shared service, unaffected)
+    "Vault.Read": ("secret_read", True),
     "Eval.Dequeue": ("eval_dequeue", True),
     "Eval.Ack": ("eval_ack", True),
     "Eval.Nack": ("eval_nack", True),
@@ -124,6 +135,8 @@ class Server:
         self.drainer = NodeDrainer(self)
         from .volume_watcher import VolumeWatcher
         self.volume_watcher = VolumeWatcher(self)
+        from ..integrations.secrets import InMemorySecretsProvider
+        self.secrets = InMemorySecretsProvider()
         self.scheduler_types = SCHEDULER_TYPES
         self.workers = [Worker(self, i) for i in range(num_workers)]
         self.gc_interval = gc_interval
@@ -278,6 +291,10 @@ class Server:
                 self._autopilot_cleanup_dead_servers()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"autopilot: {e!r}")
+            try:
+                self._reap_stale_services()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"service reap: {e!r}")
             if time.time() - last_gc >= self.gc_interval:
                 last_gc = time.time()
                 for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
@@ -587,6 +604,64 @@ class Server:
 
     def scaling_policy_get(self, policy_id: str):
         return self.state.scaling_policy_by_id(policy_id)
+
+    # ----------------------------------------------- Service catalog + Vault
+
+    def service_register(self, instances: list) -> dict:
+        """ref the consul service_client Register path, state-store backed."""
+        from .fsm import SERVICE_REGISTER
+        index = self.raft.apply(SERVICE_REGISTER, {"services": instances})
+        return {"index": index}
+
+    def service_deregister(self, alloc_id: str = "",
+                           keys: Optional[list] = None) -> dict:
+        from .fsm import SERVICE_DEREGISTER
+        index = self.raft.apply(SERVICE_DEREGISTER,
+                                {"alloc_id": alloc_id, "keys": keys})
+        return {"index": index}
+
+    def service_list(self, namespace: Optional[str] = None) -> list:
+        return self.state.iter_services(namespace)
+
+    def service_instances(self, namespace: str, name: str) -> list:
+        return self.state.services_by_name(namespace, name)
+
+    def _reap_stale_services(self) -> None:
+        """Registrations of terminal/vanished allocs are removed by the
+        leader (the consul-integration's deregister-on-stop safety net)."""
+        doomed = []
+        for inst in self.state.iter_services():
+            alloc = self.state.alloc_by_id(inst.alloc_id)
+            if alloc is None or alloc.terminal_status():
+                doomed.append(list(inst.key()))
+        if doomed:
+            self.service_deregister(keys=doomed)
+
+    def vault_derive_token(self, alloc_id: str, task: str) -> dict:
+        """ref nomad/node_endpoint.go DeriveVaultToken: validates the alloc
+        asks for vault before issuing."""
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise ValueError(f"allocation {alloc_id!r} not found")
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        t = tg.lookup_task(task) if tg else None
+        if t is None or t.vault is None:
+            raise ValueError(f"task {task!r} does not use vault")
+        tok = self.secrets.derive_token(alloc_id, task,
+                                        list(t.vault.policies))
+        return {"token": tok.token, "ttl_sec": tok.ttl_sec}
+
+    def vault_renew_token(self, token: str) -> dict:
+        tok = self.secrets.renew_token(token)
+        return {"ttl_sec": tok.ttl_sec, "expires_at": tok.expires_at}
+
+    def vault_revoke_token(self, token: str) -> dict:
+        self.secrets.revoke_token(token)
+        return {}
+
+    def secret_read(self, path: str) -> Optional[dict]:
+        return self.secrets.read(path)
 
     # --------------------------------------------------------- CSI endpoints
 

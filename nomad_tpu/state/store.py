@@ -60,6 +60,9 @@ class StateStore:
         # CSI (ref schema.go csi_volumes/csi_plugins)
         self.csi_volumes: dict[tuple[str, str], object] = {}  # (ns, id)
         self.csi_plugins: dict[str, object] = {}              # plugin id
+        # native service catalog (the consul-integration redesign;
+        # ref nomad/state service_registration table in later lines)
+        self.services: dict[tuple[str, str, str], object] = {}
         # autopilot (ref nomad/state/autopilot.go AutopilotConfig)
         self.autopilot_config: dict = {
             "CleanupDeadServers": True,
@@ -581,6 +584,43 @@ class StateStore:
     def iter_csi_plugins(self) -> list:
         with self._lock:
             return sorted(self.csi_plugins.values(), key=lambda p: p.id)
+
+    # ------------------------------------------------------------- services
+
+    def upsert_service_registrations(self, index: int,
+                                     instances: list) -> None:
+        with self._lock:
+            idx = self._bump("services", index)
+            for inst in instances:
+                inst = inst.copy()
+                existing = self.services.get(inst.key())
+                inst.create_index = existing.create_index if existing else idx
+                inst.modify_index = idx
+                self.services[inst.key()] = inst
+            self._commit()
+
+    def delete_service_registrations(self, index: int,
+                                     alloc_id: str = "",
+                                     keys: Optional[list] = None) -> None:
+        with self._lock:
+            doomed = list(keys or [])
+            if alloc_id:
+                doomed += [k for k in self.services if k[2] == alloc_id]
+            for k in doomed:
+                self.services.pop(tuple(k), None)
+            if doomed:
+                self._bump("services", index)
+            self._commit()
+
+    def services_by_name(self, ns: str, name: str) -> list:
+        with self._lock:
+            return [s for s in self.services.values()
+                    if s.namespace == ns and s.service_name == name]
+
+    def iter_services(self, ns: Optional[str] = None) -> list:
+        with self._lock:
+            return [s for s in self.services.values()
+                    if ns is None or s.namespace == ns]
 
     # ------------------------------------------------------------ autopilot
 

@@ -18,7 +18,7 @@ from .job import (  # noqa: F401
     MigrateStrategy, Multiregion, ParameterizedJobConfig, PeriodicConfig,
     ReschedulePolicy, RestartPolicy, ScalingPolicy, Service, Spread,
     SpreadTarget, Task, TaskArtifact, TaskGroup, TaskLifecycle, Template,
-    UpdateStrategy, VolumeMount, VolumeRequest,
+    UpdateStrategy, Vault, VolumeMount, VolumeRequest,
     JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM, JOB_TYPE_SYSBATCH,
     JOB_TYPE_CORE, JOB_STATUS_PENDING, JOB_STATUS_RUNNING, JOB_STATUS_DEAD,
     JOB_DEFAULT_PRIORITY, JOB_MIN_PRIORITY, JOB_MAX_PRIORITY, CORE_JOB_PRIORITY,

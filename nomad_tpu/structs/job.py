@@ -234,6 +234,17 @@ class ScalingPolicy:
 
 
 @dataclass
+class Vault:
+    """Task vault stanza (ref structs.go Vault): the policies the derived
+    token carries and how the task reacts to token changes."""
+    policies: list[str] = field(default_factory=list)
+    env: bool = True                 # expose VAULT_TOKEN to the task
+    change_mode: str = "restart"     # restart | signal | noop
+    change_signal: str = ""
+    namespace: str = ""
+
+
+@dataclass
 class Task:
     name: str = ""
     driver: str = ""
@@ -255,6 +266,7 @@ class Task:
     leader: bool = False
     shutdown_delay_sec: float = 0.0
     kill_signal: str = ""
+    vault: Optional[Vault] = None
 
     def copy(self) -> "Task":
         return dataclasses.replace(

@@ -1,0 +1,245 @@
+"""Vault/Consul-equivalent integration tests: secrets provider + token
+lifecycle, template rendering, native service catalog with checks
+(modeled on nomad/vault_test.go, taskrunner/vault_hook + template_hook
+tests, and command/agent/consul tests)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import Client
+from nomad_tpu.api_codec import to_api
+from nomad_tpu.integrations.secrets import InMemorySecretsProvider
+from nomad_tpu.integrations.services import (
+    CheckRunner, ServiceInstance, check_service,
+)
+from nomad_tpu.integrations.template import TemplateError, render_template
+from nomad_tpu.structs import Service, Template, Vault
+
+
+def wait_until(fn, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+# ---------------------------------------------------------------- secrets
+
+def test_secrets_token_lifecycle():
+    p = InMemorySecretsProvider(default_ttl=60)
+    tok = p.derive_token("alloc1", "web", ["db-read"])
+    assert tok.token and tok.policies == ("db-read",)
+    assert p.token_valid(tok.token)
+    renewed = p.renew_token(tok.token)
+    assert renewed.expires_at >= tok.expires_at
+    p.revoke_token(tok.token)
+    assert not p.token_valid(tok.token)
+    with pytest.raises(ValueError):
+        p.renew_token(tok.token)
+
+
+def test_secrets_kv():
+    p = InMemorySecretsProvider(kv={"db/creds": {"user": "u", "pass": "p"}})
+    assert p.read("db/creds") == {"user": "u", "pass": "p"}
+    assert p.read("missing") is None
+    p.put("new/path", {"x": 1})
+    assert p.read("new/path") == {"x": 1}
+
+
+# --------------------------------------------------------------- template
+
+def test_render_template_functions():
+    env = {"PORT": "8080"}
+    secrets = {"db/creds": {"user": "admin", "pass": "s3cret"},
+               "single": {"value": "only"}}
+    services = {"redis": [ServiceInstance(service_name="redis",
+                                          address="10.0.0.5", port=6379)]}
+    out = render_template(
+        'port={{ env "PORT" }} user={{ secret "db/creds" "user" }} '
+        'kv={{ key "single" }} redis={{ service "redis" }}',
+        env, secret_reader=secrets.get,
+        service_lookup=lambda n: services.get(n, []))
+    assert out == "port=8080 user=admin kv=only redis=10.0.0.5:6379"
+
+
+def test_render_template_errors():
+    with pytest.raises(TemplateError, match="env var"):
+        render_template('{{ env "NOPE" }}', {})
+    with pytest.raises(TemplateError, match="not found"):
+        render_template('{{ secret "nope" }}', {},
+                        secret_reader=lambda p: None)
+    with pytest.raises(TemplateError, match="no healthy"):
+        render_template('{{ service "gone" }}', {},
+                        service_lookup=lambda n: [])
+
+
+# --------------------------------------------------------------- services
+
+def test_check_service_tcp_http():
+    import http.server
+    import threading
+    srv = http.server.HTTPServer(("127.0.0.1", 0),
+                                 http.server.BaseHTTPRequestHandler)
+
+    class OK(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+    srv.RequestHandlerClass = OK
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        assert check_service({"type": "tcp"}, "127.0.0.1", port)
+        assert check_service({"type": "http", "path": "/"},
+                             "127.0.0.1", port)
+        assert not check_service({"type": "tcp"}, "127.0.0.1", 1)
+    finally:
+        srv.shutdown()
+
+
+def test_check_runner_status_transitions():
+    inst = ServiceInstance(service_name="x", address="127.0.0.1", port=1)
+    statuses = []
+    cr = CheckRunner(inst, [{"type": "tcp"}],
+                     lambda i, s: statuses.append(s))
+    assert cr.run_once() == "critical"
+    assert statuses == ["critical"]
+    # no transition -> no duplicate push
+    assert cr.run_once() == "critical"
+    assert statuses == ["critical"]
+
+
+# ------------------------------------------------------------- end to end
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    assert wait_until(
+        lambda: a.server.state.node_by_id(a.client.node.id) is not None
+        and a.server.state.node_by_id(a.client.node.id).ready())
+    yield a
+    a.shutdown()
+
+
+def test_vault_hook_end_to_end(agent):
+    """A task with a vault stanza gets VAULT_TOKEN + secrets/vault_token,
+    and the token is revoked when the alloc stops."""
+    job = mock.job()
+    job.id = job.name = "vaultjob"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.vault = Vault(policies=["db-read"])
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", "echo tok=$VAULT_TOKEN; sleep 30"]}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    agent.server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in agent.server.state.allocs_by_job("default", "vaultjob")))
+    alloc = [a for a in agent.server.state.allocs_by_job("default", "vaultjob")
+             if a.client_status == "running"][0]
+    token_file = os.path.join(agent.client.alloc_dir_root, alloc.id,
+                              task.name, "secrets", "vault_token")
+    assert wait_until(lambda: os.path.exists(token_file))
+    with open(token_file) as f:
+        token = f.read().strip()
+    assert agent.server.secrets.token_valid(token)
+    log = os.path.join(agent.client.alloc_dir_root, alloc.id,
+                       task.name, f"{task.name}.stdout.log")
+    assert wait_until(lambda: os.path.exists(log)
+                      and f"tok={token}".encode() in open(log, "rb").read())
+    # stop -> revoke
+    agent.server.job_deregister("default", "vaultjob")
+    assert wait_until(
+        lambda: not agent.server.secrets.token_valid(token), timeout=20)
+
+
+def test_template_hook_end_to_end(agent):
+    agent.server.secrets.put("app/config", {"greeting": "hello-tmpl"})
+    job = mock.job()
+    job.id = job.name = "tmpljob"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.templates = [Template(
+        embedded_tmpl='greeting={{ secret "app/config" "greeting" }}\n',
+        dest_path="local/app.conf")]
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", "cat local/app.conf; sleep 30"]}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    agent.server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in agent.server.state.allocs_by_job("default", "tmpljob")))
+    alloc = [a for a in agent.server.state.allocs_by_job("default", "tmpljob")
+             if a.client_status == "running"][0]
+    log = os.path.join(agent.client.alloc_dir_root, alloc.id,
+                       task.name, f"{task.name}.stdout.log")
+    assert wait_until(lambda: os.path.exists(log)
+                      and b"greeting=hello-tmpl" in open(log, "rb").read())
+
+
+def test_missing_template_secret_fails_task(agent):
+    job = mock.job()
+    job.id = job.name = "tmplfail"
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.restart_policy.attempts = 0
+    tg.restart_policy.mode = "fail"
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.templates = [Template(embedded_tmpl='{{ secret "does/not/exist" }}',
+                               dest_path="local/x")]
+    task.config = {"run_for": 30}
+    task.resources.networks = []
+    agent.server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == "failed"
+        for a in agent.server.state.allocs_by_job("default", "tmplfail")),
+        timeout=20)
+
+
+def test_service_catalog_end_to_end(agent):
+    """Task services register in the catalog when running, appear in
+    /v1/services + /v1/service/:name, and deregister on stop."""
+    job = mock.job()
+    job.id = job.name = "svcjob"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.services = [Service(name="web-svc", port_label="8080",
+                             tags=["http", "frontend"])]
+    task.config = {"run_for": 30}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    agent.server.job_register(job)
+    api = Client(address=agent.http_addr)
+    assert wait_until(lambda: any(
+        s["ServiceName"] == "web-svc" for s in api.services.list()[0]))
+    insts, _ = api.services.instances("web-svc")
+    assert len(insts) == 1
+    assert insts[0]["Port"] == 8080
+    assert sorted(insts[0]["Tags"]) == ["frontend", "http"]
+    # stop -> catalog entry removed (client dereg or leader reap)
+    agent.server.job_deregister("default", "svcjob")
+    assert wait_until(lambda: api.services.instances("web-svc")[0] == [],
+                      timeout=20)
