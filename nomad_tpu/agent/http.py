@@ -503,15 +503,16 @@ class HTTPAPI:
         if parts == ["services"]:
             require(ns == "*" or acl.allow_namespace_operation(ns,
                                                                NS_READ_JOB))
-            by_name: dict[str, list] = {}
+            by_key: dict[tuple[str, str], list] = {}
             for inst in s.service_list(None if ns == "*" else ns):
                 if ns == "*" and not acl.allow_namespace_operation(
                         inst.namespace, NS_READ_JOB):
                     continue
-                by_name.setdefault(inst.service_name, []).append(inst)
-            return [{"Namespace": insts[0].namespace, "ServiceName": name,
+                by_key.setdefault((inst.namespace, inst.service_name),
+                                  []).append(inst)
+            return [{"Namespace": key[0], "ServiceName": key[1],
                      "Tags": sorted({t for i in insts for t in i.tags})}
-                    for name, insts in sorted(by_name.items())], \
+                    for key, insts in sorted(by_key.items())], \
                 s.state.table_index("services")
         if parts and parts[0] == "service" and len(parts) >= 2:
             require(acl.allow_namespace_operation(ns, NS_READ_JOB))
@@ -952,6 +953,10 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
 
         def _do(self, method: str) -> None:
             parsed = urllib.parse.urlparse(self.path)
+            if method == "GET" and (parsed.path in ("/", "/ui")
+                                    or parsed.path.startswith("/ui/")):
+                self._serve_ui(parsed.path)
+                return
             if parsed.path == "/v1/event/stream" and method == "GET":
                 self._event_stream(parsed)
                 return
@@ -990,6 +995,26 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             if index is not None:
                 headers["X-Nomad-Index"] = str(index)
             self._respond(200, payload, headers)
+
+        def _serve_ui(self, path: str) -> None:
+            """Single-page web UI (ref ui/ — Ember SPA; here a static
+            vanilla-JS app over the same REST API)."""
+            if path == "/":
+                self.send_response(307)
+                self.send_header("Location", "/ui")
+                self.end_headers()
+                return
+            import importlib.resources as res
+            try:
+                html = (res.files("nomad_tpu.ui") / "index.html").read_bytes()
+            except (OSError, ModuleNotFoundError):
+                self._respond(404, {"error": "UI assets unavailable"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(html)))
+            self.end_headers()
+            self.wfile.write(html)
 
         def _monitor_stream(self, parsed) -> None:
             """Live log streaming (ref command/agent/monitor: the

@@ -68,11 +68,13 @@ class AllocRunner:
                     pass
             self._vault_tokens.clear()
 
-    def _start_vault_renewal(self, task, token: str, ttl_sec: float) -> None:
+    def _start_vault_renewal(self, task, start_token: str,
+                             ttl_sec: float) -> None:
         """Half-TTL renewal loop; a failed renewal applies the task's vault
         change_mode (ref client/vaultclient token renewal +
         taskrunner/vault_hook.go watch loop)."""
         def renew_loop():
+            token = start_token
             interval = max(1.0, ttl_sec / 2)
             while not self._destroyed.wait(interval):
                 if self._vault_tokens.get(task.name) != token:
@@ -83,16 +85,38 @@ class AllocRunner:
                     self.client.logger(
                         f"vault: renew failed for {task.name}: {e!r}")
                     tr = self.task_runners.get(task.name)
+                    # re-derive a fresh token (the failure path after e.g. a
+                    # leader failover wiped the in-memory backend), update
+                    # the env + secrets file, THEN notify per change_mode
+                    try:
+                        out = self.client.rpc.vault_derive_token(
+                            self.alloc.id, task.name)
+                        token = out["token"]
+                        self._vault_tokens[task.name] = token
+                        if tr is not None:
+                            if task.vault.env:
+                                tr.env["VAULT_TOKEN"] = token
+                            tok_path = os.path.join(tr.task_dir, "secrets",
+                                                    "vault_token")
+                            fd = os.open(tok_path,
+                                         os.O_WRONLY | os.O_CREAT
+                                         | os.O_TRUNC, 0o600)
+                            with os.fdopen(fd, "w") as f:
+                                f.write(token)
+                    except Exception as e2:  # noqa: BLE001
+                        self.client.logger(
+                            f"vault: re-derive failed for {task.name}: "
+                            f"{e2!r}")
+                        return
                     mode = task.vault.change_mode
                     try:
                         if tr is not None and mode == "restart":
-                            tr.restart("vault token renewal failed")
+                            tr.restart("vault token rotated")
                         elif tr is not None and mode == "signal":
                             tr.signal(task.vault.change_signal or "SIGHUP",
-                                      "vault token renewal failed")
+                                      "vault token rotated")
                     except ValueError:
                         pass   # task not running: nothing to notify
-                    return
         threading.Thread(target=renew_loop, daemon=True,
                          name=f"vault-renew-{task.name}").start()
 

@@ -213,10 +213,14 @@ class Client:
             with self._lock:
                 runners = list(self.alloc_runners.values())
             for ar in runners:
-                if not ar._services_registered and any(
-                        s.state == "running"
-                        for s in ar.task_states.values()):
-                    ar._register_services()
+                with ar._lock:
+                    any_running = any(s.state == "running"
+                                      for s in ar.task_states.values())
+                if not ar._services_registered and any_running:
+                    try:
+                        ar._register_services()
+                    except Exception as e:      # noqa: BLE001
+                        self.logger(f"client: service sync: {e!r}")
             # deployment health is time-based (min_healthy_time elapses with
             # no task-state change), so allocs with an undecided verdict are
             # re-evaluated every pass (ref allocrunner health_hook's timer)

@@ -317,8 +317,26 @@ def _exec_driver():
     return ExecDriver()
 
 
+def _java_driver():
+    from .ext_drivers import JavaDriver
+    return JavaDriver()
+
+
+def _qemu_driver():
+    from .ext_drivers import QemuDriver
+    return QemuDriver()
+
+
+def _docker_driver():
+    from .ext_drivers import DockerDriver
+    return DockerDriver()
+
+
 BUILTIN_DRIVERS = {
     "mock_driver": MockDriver,
     "raw_exec": RawExecDriver,
     "exec": _exec_driver,       # native C++ executor supervisor
+    "java": _java_driver,
+    "qemu": _qemu_driver,       # gated: fingerprints only with qemu present
+    "docker": _docker_driver,   # gated: fingerprints only with a live daemon
 }

@@ -121,12 +121,16 @@ class TaskRunner:
         for rel, content, perms in self.rendered_files:
             path = os.path.join(self.task_dir, rel.lstrip("/"))
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "w") as f:
-                f.write(content)
             try:
-                os.chmod(path, int(perms, 8))
-            except (ValueError, OSError):
-                pass
+                mode = int(perms, 8)
+            except (ValueError, TypeError):
+                mode = 0o600
+            # create with the final mode from the start: secrets must never
+            # transit through a umask-default world-readable window
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+            with os.fdopen(fd, "w") as f:
+                f.write(content)
+            os.chmod(path, mode)   # existing file: tighten to the ask
         # log rotation per the task's log stanza (ref logmon_hook.go)
         from .logmon import LogRotator
         self._logmon = LogRotator(self.task_dir, self.task.name,

@@ -66,15 +66,16 @@ def check_service(check: dict, address: str, port: int,
             resp.read()
             conn.close()
             return 200 <= resp.status < 400
-        except OSError:
+        except (OSError, http.client.HTTPException):
             return False
     if ctype == "script":
+        import shlex
         import subprocess
         try:
             return subprocess.run(
-                check.get("command", "/bin/true").split(),
+                shlex.split(check.get("command", "/bin/true")),
                 timeout=timeout, capture_output=True).returncode == 0
-        except (OSError, subprocess.TimeoutExpired):
+        except (OSError, ValueError, subprocess.TimeoutExpired):
             return False
     return True  # unknown check types pass (like a TTL check never set)
 

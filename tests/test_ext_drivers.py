@@ -1,0 +1,186 @@
+"""Extended driver tests (modeled on drivers/java + drivers/qemu +
+drivers/docker driver tests): fingerprint gating, command construction,
+and lifecycle against fake host runtimes (the real binaries are absent in
+CI, exactly the case the gating exists for)."""
+import os
+import stat
+import subprocess
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.ext_drivers import (
+    DockerDriver, JavaDriver, QemuDriver, _parse_size,
+)
+
+
+def _fake_bin(dir_, name, script):
+    path = os.path.join(dir_, name)
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n" + script)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+    return path
+
+
+@pytest.fixture
+def fakepath(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return str(bindir)
+
+
+def _task(name="t", driver="java", config=None, memory=64):
+    job = mock.job()
+    task = job.task_groups[0].tasks[0]
+    task.name = name
+    task.driver = driver
+    task.config = config or {}
+    task.resources.memory_mb = memory
+    return task
+
+
+def test_gating_without_binaries(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATH", str(tmp_path))   # empty PATH
+    assert JavaDriver().fingerprint().detected is False
+    assert QemuDriver().fingerprint().detected is False
+    assert DockerDriver().fingerprint().detected is False
+
+
+def test_java_driver_command_and_lifecycle(fakepath, tmp_path):
+    # fake java: prints its argv then sleeps briefly
+    _fake_bin(fakepath, "java", 'echo "JAVA $@"; sleep 0.2\n')
+    drv = JavaDriver()
+    fp = drv.fingerprint()
+    assert fp.detected and fp.healthy
+    task_dir = str(tmp_path / "task")
+    os.makedirs(task_dir)
+    task = _task(config={"jar_path": "/opt/app.jar",
+                         "jvm_options": ["-Dfoo=bar"], "args": ["--port=1"]})
+    drv.start_task("a/t", task, task_dir, {})
+    res = drv.wait_task("a/t", timeout=10)
+    assert res is not None and res.exit_code == 0
+    with open(os.path.join(task_dir, "t.stdout.log"), "rb") as f:
+        line = f.read().decode()
+    assert line.startswith("JAVA -Dfoo=bar -Xmx64m -jar /opt/app.jar")
+    assert "--port=1" in line
+
+
+def test_java_requires_jar_or_class(fakepath, tmp_path):
+    _fake_bin(fakepath, "java", "exit 0\n")
+    with pytest.raises(ValueError, match="jar_path or class"):
+        JavaDriver().start_task("a/t", _task(config={}),
+                                str(tmp_path), {})
+
+
+def test_qemu_driver_command(fakepath, tmp_path):
+    _fake_bin(fakepath, "qemu-system-x86_64",
+              'echo "QEMU $@"; sleep 0.2\n')
+    drv = QemuDriver()
+    assert drv.fingerprint().detected
+    task_dir = str(tmp_path / "task")
+    os.makedirs(task_dir)
+    task = _task(driver="qemu", config={
+        "image_path": "/images/vm.qcow2",
+        "port_map": [{"host": 8080, "guest": 80}]}, memory=256)
+    drv.start_task("a/q", task, task_dir, {})
+    res = drv.wait_task("a/q", timeout=10)
+    assert res.exit_code == 0
+    with open(os.path.join(task_dir, "t.stdout.log"), "rb") as f:
+        line = f.read().decode()
+    assert "-m 256M" in line
+    assert "file=/images/vm.qcow2" in line
+    assert "hostfwd=tcp::8080-:80" in line
+
+
+def test_qemu_requires_image(fakepath, tmp_path):
+    _fake_bin(fakepath, "qemu-system-x86_64", "exit 0\n")
+    with pytest.raises(ValueError, match="image_path"):
+        QemuDriver().start_task("a/q", _task(driver="qemu", config={}),
+                                str(tmp_path), {})
+
+
+FAKE_DOCKER = r'''
+cmd="$1"; shift
+case "$cmd" in
+  version) echo "24.0.7"; exit 0 ;;
+  run)     echo "deadbeefcafe"; echo "RUN $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
+  wait)    sleep 0.1; echo "0"; exit 0 ;;
+  logs)    echo "container-stdout"; exit 0 ;;
+  stop)    echo "STOP $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
+  rm)      echo "RM $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
+  kill)    echo "KILL $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
+  stats)   echo "1.5% 12MiB / 64MiB"; exit 0 ;;
+  inspect) echo "true"; exit 0 ;;
+esac
+exit 1
+'''
+
+
+def test_docker_driver_lifecycle(fakepath, tmp_path, monkeypatch):
+    log = tmp_path / "docker.log"
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    _fake_bin(fakepath, "docker", FAKE_DOCKER)
+    drv = DockerDriver()
+    fp = drv.fingerprint()
+    assert fp.detected
+    assert fp.attributes["driver.docker.version"] == "24.0.7"
+
+    task_dir = str(tmp_path / "task")
+    os.makedirs(task_dir)
+    task = _task(driver="docker", config={
+        "image": "redis:7", "command": "redis-server",
+        "args": ["--appendonly", "yes"], "ports": ["6379:6379"]})
+    handle = drv.start_task("a/d", task, task_dir, {"FOO": "bar"})
+    assert handle.config["container_id"] == "deadbeefcafe"
+    run_line = log.read_text()
+    assert "--memory 64m" in run_line
+    assert "-e FOO=bar" in run_line
+    assert "redis:7 redis-server --appendonly yes" in run_line
+    assert "-p 6379:6379" in run_line
+
+    res = drv.wait_task("a/d", timeout=10)
+    assert res.exit_code == 0
+    with open(os.path.join(task_dir, "t.stdout.log"), "rb") as f:
+        assert b"container-stdout" in f.read()
+
+    stats = drv.task_stats("a/d")
+    assert stats["cpu_percent"] == 1.5
+    assert stats["memory_rss_bytes"] == 12 * 1024 * 1024
+
+    drv.signal_task("a/d", "SIGHUP")
+    drv.stop_task("a/d", kill_timeout=2)
+    drv.destroy_task("a/d")
+    entries = log.read_text()
+    assert "KILL --signal SIGHUP deadbeefcafe" in entries
+    assert "STOP -t 2 deadbeefcafe" in entries
+    assert "RM -f deadbeefcafe" in entries
+
+
+def test_docker_recover_task(fakepath, tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(tmp_path / "l"))
+    _fake_bin(fakepath, "docker", FAKE_DOCKER)
+    from nomad_tpu.client.driver import TaskHandle
+    drv = DockerDriver()
+    ok = drv.recover_task(TaskHandle(
+        task_id="a/d", driver="docker",
+        config={"container_id": "deadbeefcafe"}))
+    assert ok
+    assert "a/d" in drv._containers
+
+
+def test_parse_size():
+    assert _parse_size("12.5MiB") == int(12.5 * (1 << 20))
+    assert _parse_size("2GiB") == 2 << 30
+    assert _parse_size("100B") == 100
+    assert _parse_size("1.2kB") == 1200
+    assert _parse_size("bogus") == 0
+
+
+def test_registered_in_builtin_drivers():
+    from nomad_tpu.client.driver import BUILTIN_DRIVERS
+    for name in ("java", "qemu", "docker"):
+        assert name in BUILTIN_DRIVERS
+        drv = BUILTIN_DRIVERS[name]()
+        assert drv.name == name

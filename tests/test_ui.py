@@ -1,0 +1,40 @@
+"""Web UI serving tests: /ui loads, / redirects, API endpoints the UI
+consumes respond (the Mirage-style smoke test of the SPA contract)."""
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, client_enabled=False))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def test_ui_served(agent):
+    with urllib.request.urlopen(agent.http_addr + "/ui", timeout=10) as r:
+        body = r.read().decode()
+    assert r.status == 200
+    assert "<title>nomad-tpu</title>" in body
+    # the SPA's API surface is referenced
+    for path in ("/jobs", "/nodes", "/event/stream", "/agent/members"):
+        assert path in body
+
+
+def test_root_redirects_to_ui(agent):
+    with urllib.request.urlopen(agent.http_addr + "/", timeout=10) as r:
+        assert "<title>nomad-tpu</title>" in r.read().decode()
+
+
+def test_ui_api_contract(agent):
+    """Every endpoint the UI fetches exists and returns JSON."""
+    for path in ("/v1/jobs?namespace=*", "/v1/nodes",
+                 "/v1/services?namespace=*", "/v1/agent/members"):
+        with urllib.request.urlopen(agent.http_addr + path,
+                                    timeout=10) as r:
+            json.loads(r.read())
