@@ -43,6 +43,154 @@ def build_cluster(n_nodes: int, seed: int = 42):
     return cap, used, feasible
 
 
+def _bench(fn, *host_args, reps: int = 5) -> tuple[float, "np.ndarray"]:
+    """Median wall-clock of transfer + solve + readback.
+
+    host_args stay on the host (numpy/python scalars); each timed rep pays
+    the device transfer via jnp.asarray, matching the per-evaluation cost
+    the scheduler path pays (module docstring)."""
+    import jax.numpy as jnp
+
+    def put():
+        return [jnp.asarray(a) if isinstance(a, np.ndarray) else a
+                for a in host_args]
+    out = fn(*put())
+    np.asarray(out)                      # warmup/compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*put())
+        counts = np.asarray(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), counts
+
+
+def config2() -> dict:
+    """BASELINE config 2: 1k-task batch / 500 sim nodes, cpu+mem."""
+    import jax
+    import jax.numpy as jnp
+    from nomad_tpu.solver import NUM_XR, fill_greedy_binpack
+    cap, used, feas = build_cluster(500)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 100.0, 256.0
+    solve = jax.jit(fill_greedy_binpack)
+    value, counts = _bench(solve, cap, used, ask, jnp.int32(1_000), feas)
+    assert int(counts.sum()) == 1_000
+    return {"metric": "cfg2: 1k-task batch / 500 nodes", "value":
+            round(value, 6), "unit": "s",
+            "vs_baseline": round(1.0 / value, 2)}
+
+
+def config3() -> dict:
+    """BASELINE config 3: 10k-task batch / 2k nodes with spread +
+    anti-affinity + distinct_hosts (the interacting-score scan path)."""
+    import jax
+    import jax.numpy as jnp
+    from nomad_tpu.solver import NUM_XR
+    from nomad_tpu.solver.kernels import place_chunked
+    rng = np.random.default_rng(7)
+    n_nodes, n_tasks = 2_000, 10_000
+    cap, used, feas = build_cluster(n_nodes, seed=7)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 100.0, 128.0
+    racks = rng.integers(0, 100, n_nodes)          # spread property: rack
+    prop_counts = np.zeros(100, np.int32)
+    solve = jax.jit(lambda *a: place_chunked(
+        *a, max_per_node=8, max_steps=256))        # distinct-ish cap
+    value, counts = _bench(
+        solve, cap, used, ask, jnp.int32(n_tasks), feas,
+        np.zeros(n_nodes, np.int32), jnp.int32(n_tasks),
+        racks.astype(np.int32), prop_counts, jnp.float32(50.0))
+    assert int(counts.sum()) == n_tasks, f"placed {counts.sum()}"
+    assert int(counts.max()) <= 8
+    return {"metric": "cfg3: 10k tasks / 2k nodes spread+anti-affinity",
+            "value": round(value, 6), "unit": "s",
+            "vs_baseline": round(1.0 / value, 2)}
+
+
+def config4() -> dict:
+    """BASELINE config 4: mixed service+batch with device asks +
+    preemption on 5k nodes."""
+    import jax
+    import jax.numpy as jnp
+    from nomad_tpu.solver import NUM_XR, fill_greedy_binpack
+    from nomad_tpu.solver.kernels import preempt_top_k
+    rng = np.random.default_rng(11)
+    n_nodes = 5_000
+    cap, used, feas = build_cluster(n_nodes, seed=11)
+    batch_ask = np.zeros(NUM_XR, np.float32)
+    batch_ask[0], batch_ask[1] = 400.0, 1024.0
+    svc_ask = np.zeros(NUM_XR, np.float32)
+    svc_ask[0], svc_ask[1] = 2000.0, 4096.0
+    # device asks enter the solver as a pre-lowered feasibility mask
+    # (SURVEY.md §7.4: irregular constraints and device groups tensorize to
+    # per-node bits; exact instance ids assigned host-side) — the service
+    # wave only fits on the ~20%% of nodes fingerprinting the device
+    has_device = rng.random(n_nodes) < 0.2
+
+    solve = jax.jit(fill_greedy_binpack)
+    preempt = jax.jit(preempt_top_k)
+
+    def run(cap_j, used_j, feas_j, dev_j):
+        placed = solve(cap_j, used_j, jnp.asarray(batch_ask),
+                       jnp.int32(15_000), feas_j)
+        used2 = used_j + placed[:, None] * jnp.asarray(batch_ask)[None, :]
+        # high-priority service wave with device ask; preemption pass on
+        # the tightest node
+        svc = solve(cap_j, used2, jnp.asarray(svc_ask), jnp.int32(500),
+                    feas_j & dev_j)
+        # victims on node 0: its batch placements
+        victims = jnp.tile(jnp.asarray(batch_ask)[None, :], (64, 1))
+        vprio = jnp.full((64,), 50, jnp.int32)
+        mask = preempt(victims, vprio, jnp.asarray(svc_ask),
+                       cap_j[0] - used2[0], jnp.int32(80))
+        return svc + jnp.zeros_like(placed).at[0].set(
+            mask.sum().astype(jnp.int32) * 0)
+    value, counts = _bench(run, cap, used, feas, has_device)
+    assert int(counts.sum()) >= 500
+    return {"metric":
+            "cfg4: mixed service+batch, device-masked + preemption, "
+            "5k nodes",
+            "value": round(value, 6), "unit": "s",
+            "vs_baseline": round(1.0 / value, 2)}
+
+
+def config5() -> dict:
+    """BASELINE config 5: C2M-style replay — 2M tasks across 10k nodes as
+    200 sequential 10k-task evals with running usage (multi-job stream,
+    the C2M 'containers scheduled' analog). Reports evals/sec."""
+    import jax
+    import jax.numpy as jnp
+    from nomad_tpu.solver import NUM_XR, fill_greedy_binpack
+    n_nodes, evals, tasks_per = 10_000, 200, 10_000
+    cap, used, feas = build_cluster(n_nodes)
+    # C2M containers are tiny (the challenge used minimal redis containers)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 1.0, 1.0
+
+    @jax.jit
+    def eval_stream(cap_j, used_j, feas_j):
+        def one(used_acc, _):
+            placed = fill_greedy_binpack(cap_j, used_acc, jnp.asarray(ask),
+                                         jnp.int32(tasks_per), feas_j)
+            return used_acc + placed[:, None] * jnp.asarray(ask)[None, :], \
+                placed.sum()
+        _, placed_counts = jax.lax.scan(one, used_j, None, length=evals)
+        return placed_counts
+
+    value, counts = _bench(eval_stream, cap, used, feas, reps=3)
+    total = int(counts.sum())
+    assert total == evals * tasks_per, f"placed {total}"
+    # vs_baseline uses the same <1s-per-eval-stream convention as the other
+    # configs; the quota/federation parts of BASELINE cfg5 are control-plane
+    # behavior outside this solver microbench's scope
+    return {"metric": "cfg5: C2M-style eval stream, 2M tasks / 10k nodes "
+            f"({evals} evals)", "value": round(value, 6), "unit": "s",
+            "evals_per_sec": round(evals / value, 1),
+            "tasks_per_sec": round(total / value, 0),
+            "vs_baseline": round(TARGET_S / value, 2)}
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -89,4 +237,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if len(sys.argv) > 1 and sys.argv[1] == "--config":
+        which = sys.argv[2] if len(sys.argv) > 2 else "all"
+        fns = {"2": config2, "3": config3, "4": config4, "5": config5}
+        for key, fn in fns.items():
+            if which in (key, "all"):
+                print(json.dumps(fn()))
+    else:
+        main()   # driver contract: exactly one JSON line

@@ -1,0 +1,122 @@
+"""Pallas TPU kernel for the placement hot loop's inner pass: fused
+per-node instance capacity + binpack/spread score (the dense AllocsFit +
+ScoreFitBinPack pair, ref nomad/structs/funcs.go:147,236; consumed by the
+fill-greedy placement in kernels.py).
+
+Why a hand kernel: the XLA path materializes `free`, `per_dim`, `free_pct`
+and two pow() temporaries in HBM between fusions for large N. Here one VMEM
+pass per node tile computes both outputs — a single HBM read of cap/used
+and a single write of the (2, N) result.
+
+Layout: resources on the sublane axis, nodes on the lane axis — [R8, N]
+with R8 = 8 rows (5 real resource dims zero-padded to the f32 sublane tile)
+and N padded to the 128-lane multiple. Per-node reductions become sublane
+reductions, which the VPU does natively.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import BINPACK_MAX_SCORE, NUM_XR
+
+R8 = 8            # f32 sublane tile
+LANE = 128
+TILE_N = 512      # nodes per grid step (4 lane tiles)
+_BIG = 1e9
+
+
+def _score_capacity_kernel(cap_ref, used_ref, ask_ref, out_ref,
+                           *, spread: bool):
+    """One node tile: out row 0 = instance capacity, row 1 = fit score."""
+    cap = cap_ref[:]                    # [R8, TILE_N]
+    used = used_ref[:]
+    ask = ask_ref[:]                    # [R8, 1] broadcast over lanes
+
+    # capacity = min over resource rows of floor(free / ask), ask>0 rows only
+    free = cap - used
+    ask_pos = ask > 0.0
+    per_dim = jnp.where(ask_pos,
+                        jnp.floor(free / jnp.where(ask_pos, ask, 1.0)),
+                        _BIG)
+    capacity = jnp.max(jnp.min(per_dim, axis=0, keepdims=True), initial=0.0,
+                       axis=0, keepdims=True)      # [1, TILE_N], clamp >= 0
+
+    # score from cpu (row 0) + mem (row 1) free fractions (funcs.go:236)
+    safe_cap = jnp.where(cap[:2] > 0.0, cap[:2], 1.0)
+    free_pct = 1.0 - used[:2] / safe_cap
+    total = jnp.sum(jnp.power(10.0, free_pct), axis=0, keepdims=True)
+    raw = (total - 2.0) if spread else (20.0 - total)
+    score = jnp.clip(raw, 0.0, BINPACK_MAX_SCORE)  # [1, TILE_N]
+
+    out_ref[0:1, :] = capacity
+    out_ref[1:2, :] = score
+    out_ref[2:, :] = jnp.zeros_like(cap[2:])       # pad rows
+
+
+@functools.partial(jax.jit, static_argnames=("spread", "interpret"))
+def score_capacity_fused(cap: jnp.ndarray, used: jnp.ndarray,
+                         ask: jnp.ndarray, feasible: jnp.ndarray,
+                         spread: bool = False,
+                         interpret: bool = False):
+    """Fused (capacity i32[N], score f32[N]) via one pallas pass.
+
+    cap/used: f32[N, NUM_XR]; ask: f32[NUM_XR]; feasible: bool[N].
+    `interpret=True` runs the interpreter (CPU tests); on TPU leave False.
+    """
+    from jax.experimental import pallas as pl
+
+    n = cap.shape[0]
+    n_pad = -(-n // TILE_N) * TILE_N
+
+    def to_tiles(x):
+        # [N, R'] -> padded [R8, Npad] (resources on sublanes)
+        x = jnp.pad(x, ((0, n_pad - n), (0, R8 - NUM_XR)))
+        return x.T
+
+    cap_t = to_tiles(cap)
+    # padded nodes get used=cap so capacity=0 and score clamps safely
+    used_t = jnp.pad(used, ((0, n_pad - n), (0, R8 - NUM_XR)))
+    used_t = used_t.at[n:, :].set(
+        jnp.pad(cap, ((0, n_pad - n), (0, R8 - NUM_XR)))[n:, :])
+    used_t = used_t.T
+    ask_col = jnp.pad(ask, (0, R8 - NUM_XR)).reshape(R8, 1)
+
+    grid = (n_pad // TILE_N,)
+    out = pl.pallas_call(
+        functools.partial(_score_capacity_kernel, spread=spread),
+        out_shape=jax.ShapeDtypeStruct((R8, n_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R8, TILE_N), lambda i: (0, i)),
+            pl.BlockSpec((R8, TILE_N), lambda i: (0, i)),
+            pl.BlockSpec((R8, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((R8, TILE_N), lambda i: (0, i)),
+        interpret=interpret,
+    )(cap_t, used_t, ask_col)
+
+    capacity = out[0, :n]
+    score = out[1, :n]
+    capacity = jnp.where(feasible, capacity, 0.0).astype(jnp.int32)
+    score = jnp.where(capacity > 0, score, -1.0)
+    return capacity, score
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fill_greedy_binpack_fused(cap, used, ask, count, feasible,
+                              max_per_node=2 ** 30, interpret=False):
+    """fill_greedy_binpack with the pallas fused inner pass: same sort +
+    cumsum greedy equivalence (see kernels.py), different capacity/score
+    producer."""
+    capacity, score = score_capacity_fused(cap, used, ask, feasible,
+                                           interpret=interpret)
+    capacity = jnp.minimum(capacity, max_per_node)
+    score = jnp.where(capacity > 0, score, -1.0)
+    order = jnp.argsort(-score)
+    cap_sorted = capacity[order]
+    prior = jnp.cumsum(cap_sorted) - cap_sorted
+    take_sorted = jnp.clip(count - prior, 0, cap_sorted)
+    return jnp.zeros_like(capacity).at[order].set(take_sorted)

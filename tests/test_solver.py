@@ -244,3 +244,42 @@ def test_sharded_fill_greedy_on_8_device_mesh():
         jnp.int32(count), jnp.asarray(feas)))
     np.testing.assert_array_equal(got, want)
     assert got.sum() == count
+
+
+# ------------------------------------------------------------ pallas kernel
+
+def test_pallas_score_capacity_matches_xla():
+    """The fused pallas inner pass is differentially tested against the
+    jnp reference (interpret mode on CPU; compiled on real TPU)."""
+    from nomad_tpu.solver.kernels import instance_capacity, score_fit
+    from nomad_tpu.solver.pallas_kernels import score_capacity_fused
+    cap, used = _rand_cluster(700, seed=3)   # non-multiple of the tile
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1], ask[2] = 250, 512, 300
+    feas = np.random.default_rng(3).random(700) < 0.9
+    c_got, s_got = score_capacity_fused(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.asarray(feas), interpret=True)
+    c_want = instance_capacity(jnp.asarray(cap), jnp.asarray(used),
+                               jnp.asarray(ask), jnp.asarray(feas))
+    s_want = jnp.where(c_want > 0, score_fit(jnp.asarray(cap),
+                                             jnp.asarray(used)), -1.0)
+    np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_want))
+    np.testing.assert_allclose(np.asarray(s_got), np.asarray(s_want),
+                               atol=1e-4)
+
+
+def test_pallas_fill_greedy_matches_xla():
+    from nomad_tpu.solver.pallas_kernels import fill_greedy_binpack_fused
+    cap, used = _rand_cluster(900, seed=5)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 100, 128
+    feas = np.ones(900, bool)
+    got = np.asarray(fill_greedy_binpack_fused(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.int32(3000), jnp.asarray(feas), interpret=True))
+    want = np.asarray(fill_greedy_binpack(
+        jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+        jnp.int32(3000), jnp.asarray(feas)))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == 3000
