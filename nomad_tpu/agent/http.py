@@ -1033,6 +1033,13 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 if not acl.allow_agent_read():
                     self._respond(403, {"error": "Permission denied"})
                     return
+            elif api.agent.config.acl_enabled:
+                # fail closed like _handle_client: a client-only agent cannot
+                # resolve tokens, so monitor must not leak live logs (the
+                # reference requires agent:read for /v1/agent/monitor)
+                self._respond(
+                    501, {"error": "ACL token resolution requires a server"})
+                return
             sub = api.agent.monitor.subscribe(level=level)
             self.send_response(200)
             self.send_header("Content-Type", "application/json")

@@ -420,35 +420,76 @@ def _resolve_device_target(target: str, dev) -> tuple[Optional[str], bool]:
 
 
 class CSIVolumeChecker(ChecksFeasibility):
-    """Node runs healthy CSI node plugins for requested CSI volumes
-    (ref feasible.go:209). Volume claim limits enforced at apply time."""
+    """Node runs healthy CSI node plugins for requested CSI volumes, and the
+    volume itself is schedulable with free claims for the requested mode
+    (ref feasible.go:209 CSIVolumeChecker, csi.go WriteFreeClaims)."""
 
     def __init__(self, ctx: EvalContext):
         self.ctx = ctx
         self.plugins: set[str] = set()
+        # (volume-or-None, source, read_only) per requested CSI volume —
+        # claim capacity is volume-wide, checked once per feasibility pass
+        self.volumes: list[tuple] = []
+        self.namespace = "default"
+        self.job_id = ""
 
     def set_volumes(self, volumes: dict, namespace: str = "default",
-                    csi_volume_lookup=None) -> None:
+                    csi_volume_lookup=None, job_id: str = "") -> None:
         self.plugins = set()
+        self.volumes = []
+        self.namespace = namespace
+        self.job_id = job_id
         if csi_volume_lookup is None:
             by_id = getattr(self.ctx.state, "csi_volume_by_id", None)
             if by_id is not None:
                 csi_volume_lookup = lambda src: by_id(namespace, src)  # noqa: E731
         for req in volumes.values():
             if req.type == "csi":
+                vol = None
                 plugin = None
                 if csi_volume_lookup is not None:
                     vol = csi_volume_lookup(req.source)
                     plugin = getattr(vol, "plugin_id", None) if vol else None
                 self.plugins.add(plugin or req.source)
+                self.volumes.append(
+                    (vol, req.source, getattr(req, "read_only", False)))
 
     def feasible(self, node: Node) -> bool:
         if not self.plugins:
             return True
+        for vol, source, read_only in self.volumes:
+            if vol is not None:
+                if not getattr(vol, "schedulable", True):
+                    self.ctx.metrics.filter_node(
+                        node, f"CSI volume {source} unschedulable")
+                    return False
+                mode = "read" if read_only else "write"
+                if not vol.claim_ok(mode) and \
+                        not self._claims_held_by_this_job(vol):
+                    self.ctx.metrics.filter_node(
+                        node, f"CSI volume {source} has no free claims")
+                    return False
         for plugin in self.plugins:
             info = node.csi_node_plugins.get(plugin)
             if info is None or not info.get("healthy", False):
                 self.ctx.metrics.filter_node(node, "missing CSI plugins")
+                return False
+        return True
+
+    def _claims_held_by_this_job(self, vol) -> bool:
+        """Claims held by allocs of the job being scheduled don't block it:
+        a rolling update / reschedule of the claim-holding job must be able
+        to place its replacement (ref feasible.go: blocking write claims
+        only filter when they belong to a different job)."""
+        if not self.job_id:
+            return False
+        alloc_by_id = getattr(self.ctx.state, "alloc_by_id", None)
+        if alloc_by_id is None:
+            return False
+        for claim in vol.write_claims.values():
+            alloc = alloc_by_id(claim.alloc_id)
+            if alloc is None or alloc.namespace != self.namespace or \
+                    alloc.job_id != self.job_id:
                 return False
         return True
 

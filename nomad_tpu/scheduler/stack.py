@@ -64,6 +64,7 @@ class GenericStack:
         self.tg_host_volumes = HostVolumeChecker(ctx)
         self.tg_csi_volumes = CSIVolumeChecker(ctx)
         self.job_namespace = "default"
+        self.job_id = ""
         self.tg_network = NetworkChecker(ctx)
 
         self.wrapped_checks = FeasibilityWrapper(
@@ -108,6 +109,7 @@ class GenericStack:
             return
         self.job_version = job.version
         self.job_namespace = job.namespace
+        self.job_id = job.id
         self.job_constraint.set_constraints(list(job.constraints))
         self.distinct_hosts.set_job(job)
         self.distinct_property.set_job(job)
@@ -139,7 +141,8 @@ class GenericStack:
         self.tg_constraint.set_constraints(constraints)
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
-        self.tg_csi_volumes.set_volumes(tg.volumes, self.job_namespace)
+        self.tg_csi_volumes.set_volumes(tg.volumes, self.job_namespace,
+                                        job_id=self.job_id)
         self.tg_network.set_network(tg.networks[0] if tg.networks else None)
         self.distinct_hosts.set_task_group(tg)
         self.distinct_property.set_task_group(tg)
@@ -172,6 +175,7 @@ class SystemStack:
         self.tg_host_volumes = HostVolumeChecker(ctx)
         self.tg_csi_volumes = CSIVolumeChecker(ctx)
         self.job_namespace = "default"
+        self.job_id = ""
         self.tg_network = NetworkChecker(ctx)
         self.wrapped_checks = FeasibilityWrapper(
             ctx, self.source,
@@ -193,6 +197,7 @@ class SystemStack:
 
     def set_job(self, job: Job) -> None:
         self.job_namespace = job.namespace
+        self.job_id = job.id
         self.job_constraint.set_constraints(list(job.constraints))
         self.distinct_property.set_job(job)
         self.bin_pack.set_job(job)
@@ -207,7 +212,8 @@ class SystemStack:
         self.tg_constraint.set_constraints(constraints)
         self.tg_devices.set_task_group(tg)
         self.tg_host_volumes.set_volumes(options.alloc_name, tg.volumes)
-        self.tg_csi_volumes.set_volumes(tg.volumes, self.job_namespace)
+        self.tg_csi_volumes.set_volumes(tg.volumes, self.job_namespace,
+                                        job_id=self.job_id)
         self.tg_network.set_network(tg.networks[0] if tg.networks else None)
         self.distinct_property.set_task_group(tg)
         self.wrapped_checks.set_task_group(tg.name)

@@ -77,6 +77,11 @@ class RaftNode:
         self.base_index = 0              # last index covered by the snapshot
         self.base_term = 0
         self.peers = dict(peers)         # id -> addr, includes self
+        # configuration as of base_index (snapshot point); the live config
+        # is always _base_peers + the _config_* entries in the log, so a
+        # truncated config entry can be rolled back (Raft §4.1: servers
+        # adopt the latest configuration entry in their log at append time)
+        self._base_peers = dict(peers)
 
         # volatile state
         self.state = FOLLOWER
@@ -171,7 +176,7 @@ class RaftNode:
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump({"index": self.base_index, "term": self.base_term,
-                         "data": data, "peers": self.peers}, f)
+                         "data": data, "peers": self._base_peers}, f)
         os.replace(tmp, self._snap_path())
 
     def _restore_from_disk(self) -> None:
@@ -187,6 +192,7 @@ class RaftNode:
                 # authoritative config at snapshot time: replace, don't
                 # merge — a merge would resurrect removed peers
                 self.peers = dict(snap["peers"])
+                self._base_peers = dict(snap["peers"])
             self.commit_index = self.last_applied = self.base_index
         if os.path.exists(self._meta_path()):
             with open(self._meta_path(), "rb") as f:
@@ -264,11 +270,24 @@ class RaftNode:
             self.log.append(entry)
             index = self._last_index()
             self._append_to_disk([entry])
+            if msg_type in ("_config_add", "_config_remove"):
+                # adopt the new configuration at append time (§4.1); a
+                # leader removing itself keeps replicating but no longer
+                # counts toward majority, and steps down only once the
+                # entry commits (§4.2.2, handled by the apply loop)
+                self._adopt_config_locked(entry)
             self._match_index[self.node_id] = index
             for ev in self._replicate_events.values():
                 ev.set()
             if len(self.peers) == 1:
                 self._advance_commit_locked()
+            if msg_type in ("_config_add", "_config_remove"):
+                # membership changes take effect at append (adopted above)
+                # and commit asynchronously once the NEW majority acks —
+                # blocking here would deadlock a 1→2 addition where the
+                # joining server only starts raft after `join` returns
+                # (hashicorp/raft AddVoter likewise returns an index future)
+                return index
             deadline = time.monotonic() + timeout
             while self.last_applied < index and not self._stop.is_set():
                 remaining = deadline - time.monotonic()
@@ -305,14 +324,41 @@ class RaftNode:
             raise ValueError("cannot remove the last raft peer")
         return self.apply("_config_remove", peer_id, timeout=timeout)
 
-    def _apply_config_locked(self, payload) -> None:
-        pid = payload
+    def _adopt_config_locked(self, entry: "_Entry") -> None:
+        """Structural config change without the leader-self-removal
+        step-down — safe to run at append time and idempotent at commit."""
+        if entry.type == "_config_add":
+            self._apply_config_add_locked(entry.payload)
+            return
+        pid = entry.payload
         self.peers.pop(pid, None)
         self._next_index.pop(pid, None)
         self._match_index.pop(pid, None)
-        self._replicate_events.pop(pid, None)
+        ev = self._replicate_events.pop(pid, None)
+        if ev is not None:
+            ev.set()    # wake the loop so it notices removal and exits
         self._peer_added_at.pop(pid, None)
         self._persist_meta()
+
+    def _recompute_config_locked(self) -> None:
+        """Rebuild the configuration from the snapshot-point config plus
+        every _config_* entry still in the log. Called after log truncation
+        on a follower: a conflicting leader may have removed an appended
+        (never-committed) config entry, which must be rolled back."""
+        peers = dict(self._base_peers)
+        for e in self.log:
+            if e.type == "_config_add":
+                pid, addr = e.payload
+                peers[pid] = addr
+            elif e.type == "_config_remove":
+                peers.pop(e.payload, None)
+        if peers != self.peers:
+            self.peers = peers
+            self._persist_meta()
+
+    def _apply_config_locked(self, payload) -> None:
+        pid = payload
+        self._adopt_config_locked(_Entry(0, "_config_remove", pid))
         if pid == self.node_id and self.state == LEADER:
             self._step_down_locked(self.current_term)
 
@@ -542,9 +588,12 @@ class RaftNode:
             nxt = self._next_index.get(pid, self._last_index() + 1)
             if nxt <= self.base_index:
                 # follower is behind our snapshot horizon
+                # ship the config as of base_index, not the live one: the
+                # receiver stores this as its rollback base, and live peers
+                # may include uncommitted config entries past base_index
                 snap = {"index": self.base_index, "term": self.base_term,
                         "data": self.fsm.snapshot_bytes(),
-                        "peers": dict(self.peers)}
+                        "peers": dict(self._base_peers)}
                 commit = self.commit_index
             else:
                 snap = None
@@ -642,6 +691,13 @@ class RaftNode:
         data = self.fsm.snapshot_bytes()
         keep_from = snap_index - self.base_index
         self.base_term = self._term_at(snap_index)
+        # fold config entries covered by the snapshot into the base config
+        for e in self.log[:keep_from]:
+            if e.type == "_config_add":
+                pid, addr = e.payload
+                self._base_peers[pid] = addr
+            elif e.type == "_config_remove":
+                self._base_peers.pop(e.payload, None)
         self.log = self.log[keep_from:]
         self.base_index = snap_index
         self._persist_snapshot(data)
@@ -716,6 +772,11 @@ class RaftNode:
                 self._rewrite_log_on_disk()
             elif appended:
                 self._append_to_disk(appended)
+            if truncated or any(e.type in ("_config_add", "_config_remove")
+                                for e in appended):
+                # adopt appended config entries immediately (§4.1) and roll
+                # back any truncated ones, in one recompute
+                self._recompute_config_locked()
             if leader_commit > self.commit_index:
                 self.commit_index = min(leader_commit, self._last_index())
                 self._commit_cond.notify_all()
@@ -738,6 +799,7 @@ class RaftNode:
             self.log = []
             if snap.get("peers"):
                 self.peers = dict(snap["peers"])
+                self._base_peers = dict(snap["peers"])
             self.commit_index = max(self.commit_index, snap["index"])
             self.last_applied = snap["index"]
             self._persist_snapshot(snap["data"])

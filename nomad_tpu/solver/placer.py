@@ -162,8 +162,11 @@ class SolverPlacer:
         stack.tg_drivers.set_drivers(drivers)
         stack.tg_constraint.set_constraints(constraints)
         stack.tg_devices.set_task_group(tg)
+        job = self.sched.job
         stack.tg_host_volumes.set_volumes("", tg.volumes)
-        stack.tg_csi_volumes.set_volumes(tg.volumes)
+        stack.tg_csi_volumes.set_volumes(
+            tg.volumes, job.namespace if job else "default",
+            job_id=job.id if job else "")
         stack.tg_network.set_network(tg.networks[0] if tg.networks else None)
         elig = self.ctx.eligibility
         job_checks = [stack.job_constraint]

@@ -139,6 +139,8 @@ class StateStore:
                                   for k, v in self.scaling_events.items()}
             out.csi_volumes = dict(self.csi_volumes)
             out.csi_plugins = dict(self.csi_plugins)
+            out.services = dict(self.services)
+            out.autopilot_config = dict(self.autopilot_config)
             out._allocs_by_node = {k: set(v)
                                    for k, v in self._allocs_by_node.items()}
             out._allocs_by_job = {k: set(v)

@@ -213,3 +213,30 @@ def test_acl_snapshot_restore_roundtrip(acl_agent):
     # secret index rebuilt
     root = acl_agent._test_root_token
     assert fresh.state.acl_token_by_secret(root) is not None
+
+
+def test_monitor_fails_closed_on_client_only_agent(tmp_path):
+    """/v1/agent/monitor must not leak live logs on a client-only agent
+    with ACLs enabled: no server means no token resolution, so fail
+    closed with 501 like the other client endpoints (ADVICE r1 #1;
+    ref command/agent/agent_endpoint.go requires agent:read)."""
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    server_agent = Agent(AgentConfig(
+        data_dir=str(tmp_path / "server"), http_port=0, rpc_port=0,
+        client_enabled=False))
+    server_agent.start()
+    try:
+        rpc_addr = server_agent.server.rpc_addr
+        client_agent = Agent(AgentConfig(
+            data_dir=str(tmp_path / "client"), http_port=0,
+            server_enabled=False, servers=(rpc_addr,),
+            acl_enabled=True, node_name="mon-node"))
+        client_agent.start()
+        try:
+            code, _ = _call(client_agent, "GET", "/v1/agent/monitor")
+            assert code == 501
+        finally:
+            client_agent.shutdown()
+    finally:
+        server_agent.shutdown()

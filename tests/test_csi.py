@@ -230,3 +230,60 @@ def test_end_to_end_hostpath_volume():
             assert f.read().strip() == "persisted"
     finally:
         a.shutdown()
+
+
+def test_scheduler_rejects_claimed_single_writer_volume(server):
+    """A single-node-writer volume with an existing write claim is not
+    schedulable for another writer (ADVICE r1 #2; ref feasible.go
+    CSIVolumeChecker + csi.go WriteFreeClaims)."""
+    server.node_register(_csi_node())
+    vol = _vol("busyvol")
+    vol.write_claims["some-alloc"] = CSIVolumeClaim(
+        alloc_id="some-alloc", node_id="n1", mode=CLAIM_WRITE)
+    server.csi_volume_register([vol])
+    job = mock.job()
+    job.id = job.name = "busyjob"
+    tg = job.task_groups[0]
+    tg.volumes = {"data": VolumeRequest(name="data", type="csi",
+                                        source="busyvol")}
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].resources.networks = []
+    server.job_register(job)
+    from nomad_tpu.scheduler import new_scheduler
+    from nomad_tpu.scheduler.testing import Harness
+    ev = server.state.evals_by_job("default", "busyjob")[0]
+    h = Harness(server.state.fork())
+    h.process(lambda state, planner: new_scheduler(
+        "service", state, planner), ev)
+    placed = [a for plan in h.plans
+              for allocs in plan.node_allocation.values() for a in allocs]
+    assert not placed
+    # a read-only request against the same volume is still feasible
+    job2 = mock.job()
+    job2.id = job2.name = "readjob"
+    tg2 = job2.task_groups[0]
+    tg2.volumes = {"data": VolumeRequest(name="data", type="csi",
+                                         source="busyvol", read_only=True)}
+    tg2.tasks[0].driver = "mock_driver"
+    tg2.tasks[0].resources.networks = []
+    server.job_register(job2)
+    ev2 = server.state.evals_by_job("default", "readjob")[0]
+    h2 = Harness(server.state.fork())
+    h2.process(lambda state, planner: new_scheduler(
+        "service", state, planner), ev2)
+    placed2 = [a for plan in h2.plans
+               for allocs in plan.node_allocation.values() for a in allocs]
+    assert placed2
+    # claims held by the scheduled job itself are exempt: a rolling update
+    # or reschedule of the claim holder must still place (ref feasible.go)
+    holder = mock.alloc()
+    holder.id = "some-alloc"
+    holder.namespace = "default"
+    holder.job_id = "busyjob"
+    server.state.upsert_allocs(99, [holder])
+    h3 = Harness(server.state.fork())
+    h3.process(lambda state, planner: new_scheduler(
+        "service", state, planner), ev)
+    placed3 = [a for plan in h3.plans
+               for allocs in plan.node_allocation.values() for a in allocs]
+    assert placed3

@@ -157,3 +157,22 @@ def test_job_status_computation():
     j.stop = True
     s.upsert_job(1, j)
     assert s.job_by_id("default", j.id).status == JOB_STATUS_DEAD
+
+
+def test_fork_copies_services_and_autopilot():
+    """fork() must carry every table: Job.Plan dry-runs observe the service
+    catalog and autopilot config (ADVICE r1 #5)."""
+    from nomad_tpu.integrations.services import ServiceInstance
+    s = StateStore()
+    inst = ServiceInstance(service_name="web", namespace="default",
+                           alloc_id="a1", address="10.0.0.1", port=80)
+    s.upsert_service_registrations(10, [inst])
+    s.set_autopilot_config(11, {"CleanupDeadServers": False})
+    f = s.fork()
+    assert [x.service_name for x in f.services.values()] == ["web"]
+    assert f.get_autopilot_config()["CleanupDeadServers"] is False
+    # mutating the fork leaves the original untouched
+    f.upsert_service_registrations(12, [ServiceInstance(
+        service_name="db", namespace="default", alloc_id="a2",
+        address="10.0.0.2", port=5432)])
+    assert len(s.services) == 1 and len(f.services) == 2
