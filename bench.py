@@ -1,18 +1,25 @@
 #!/usr/bin/env python
 """Headline benchmark (BASELINE.json): place a 50k-task batch job across a
-simulated 10k-node cluster on TPU; target <1s wall-clock.
+simulated 10k-node cluster THROUGH THE REAL SCHEDULER PATH on TPU;
+target <1s wall-clock.
+
+Measured region (the full worker path, VERDICT r1 next #1):
+  eval -> GenericScheduler.process -> reconciler -> SolverPlacer
+  (dense tensorize from the store's incremental usage index + TPU kernel +
+  batched alloc materialization) -> real serial Planner.apply_plan
+  (vectorized per-node re-check) -> FSM commit into the state store.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "s", "vs_baseline": target/value}
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": target/value, ...}
+extra keys: compile_s, rejection parity vs the host binpack oracle, and a
+measured host-path comparison (host is timed at 5k tasks — it is linear in
+placements, the extrapolation to 50k is reported separately).
 
-The measured region is the full solve path the tpu-batch scheduler algorithm
-runs per evaluation: host->device transfer of the node matrices, the
-feasibility-masked capacity + scoring + greedy placement kernel, and the
-placement-count readback. (Alloc-object materialization and Raft apply are
-the control plane's cost, unchanged from the reference design — see
-SURVEY.md north star: plan_apply stays untouched.)
+`--config 2..5` runs the BASELINE kernel micro-configs; `--kernel` runs the
+round-1 kernel-only solve for comparison.
 """
 import json
+import sys
 import time
 
 import numpy as np
@@ -22,33 +29,247 @@ N_TASKS = 50_000
 TARGET_S = 1.0
 
 
-def build_cluster(n_nodes: int, seed: int = 42):
-    """Synthetic heterogeneous fleet (the scheduler/benchmarks analog:
+# ---------------------------------------------------------------- cluster sim
+
+def _mk_node(i: int, rng):
+    """Heterogeneous fleet node (scheduler/benchmarks analog:
     ref scheduler/benchmarks/benchmarks_test.go:26 seeds 5k nodes)."""
+    from nomad_tpu import mock
+    n = mock.node()
+    n.name = f"bench-{i}"
+    n.node_class = f"c{int(rng.integers(0, 4))}"
+    n.node_resources.cpu.cpu_shares = int(
+        rng.choice([4_000, 8_000, 16_000, 32_000]))
+    n.node_resources.memory.memory_mb = int(
+        rng.choice([8_192, 16_384, 32_768, 65_536]))
+    n.node_resources.disk.disk_mb = 500_000
+    return n
+
+
+def _mk_batch_job(job_id: str, count: int, cpu=250, mem=512, disk=300):
+    from nomad_tpu import mock
+    job = mock.batch_job()
+    job.id = job.name = job_id
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.ephemeral_disk.size_mb = disk
+    task = tg.tasks[0]
+    task.resources.cpu = cpu
+    task.resources.memory_mb = mem
+    task.resources.networks = []
+    tg.networks = []
+    return job
+
+
+def _seed_fsm(n_nodes: int, algorithm: str, seed: int = 42):
+    from nomad_tpu.server.fsm import NomadFSM
+    from nomad_tpu.structs import SchedulerConfiguration
+    rng = np.random.default_rng(seed)
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=algorithm))
+    for i in range(n_nodes):
+        s.upsert_node(i + 2, _mk_node(i, rng))
+    return fsm
+
+
+class _WorkerShim:
+    """Planner-interface glue a server Worker provides (ref nomad/worker.go
+    SubmitPlan/UpdateEval/CreateEval), over the real serial applier."""
+
+    def __init__(self, planner, state):
+        self.planner = planner
+        self.state = state
+        self.submissions = []           # (plan, result) pairs
+
+    def submit_plan(self, plan):
+        result = self.planner.apply_plan(plan)
+        self.submissions.append((plan, result))
+        return result
+
+    def update_eval(self, ev):
+        self.state.upsert_evals(self.state.latest_index() + 1, [ev])
+
+    def create_eval(self, ev):
+        self.state.upsert_evals(self.state.latest_index() + 1, [ev])
+
+    def refresh_snapshot(self, old):
+        return self.state.snapshot()
+
+
+def _run_eval(fsm, planner, job, snap=None, sched_type="batch"):
+    """One eval through scheduler + real plan applier. Returns (shim, eval)."""
+    from nomad_tpu.scheduler import new_scheduler
+    from nomad_tpu.structs import Evaluation, new_id
+    s = fsm.state
+    ev = Evaluation(id=new_id(), namespace="default", job_id=job.id,
+                    type=sched_type, priority=50)
+    s.upsert_evals(s.latest_index() + 1, [ev])
+    shim = _WorkerShim(planner, s)
+    sched = new_scheduler(sched_type, snap or s.snapshot(), shim)
+    sched.process(ev)
+    return shim, sched
+
+
+def _register(fsm, job):
+    fsm.state.upsert_job(fsm.state.latest_index() + 1, job)
+
+
+def _validate(fsm, job_id: str, expect: int) -> None:
+    s = fsm.state
+    placed = [a for a in s.iter_allocs() if a.job_id == job_id]
+    assert len(placed) == expect, f"placed {len(placed)}/{expect}"
+    view = s.usage.view()
+    over = view.used > view.cap + 1e-3
+    assert not bool(over.any()), "overcommit detected in committed state"
+
+
+def _rejection_stats(shims) -> tuple[int, int]:
+    """(rejected nodes, total plan nodes) across all submissions."""
+    rejected = 0
+    total = 0
+    for shim in shims:
+        for plan, result in shim.submissions:
+            if result is None:
+                continue
+            total += len(plan.node_allocation)
+            rejected += len(result.rejected_nodes)
+    return rejected, total
+
+
+def _concurrent_rejection_rate(algorithm: str, n_jobs: int = 8,
+                               tasks_per: int = 2_000,
+                               n_nodes: int = 2_000) -> float:
+    """Optimistic-concurrency conflict sim: N workers schedule different
+    jobs from the SAME stale snapshot (the reference's per-core workers,
+    nomad/worker.go), plans land serially on the real applier which
+    re-checks against latest state (plan_apply.go:638). Measures the
+    plan-rejection rate BASELINE's second headline metric asks for."""
+    from nomad_tpu.server.fsm import RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+
+    fsm = _seed_fsm(n_nodes, algorithm, seed=7)
+    planner = Planner(RaftLog(fsm), fsm.state)
+    jobs = []
+    for j in range(n_jobs):
+        # asks sized so the combined load contends for the same best nodes
+        job = _mk_batch_job(f"conc-{j}", tasks_per, cpu=400, mem=700)
+        _register(fsm, job)
+        jobs.append(job)
+    stale = fsm.state.snapshot()          # every "worker" plans against this
+    shims = []
+    for job in jobs:
+        shim, _ = _run_eval(fsm, planner, job, snap=stale)
+        shims.append(shim)
+    rejected, total = _rejection_stats(shims)
+    return rejected / total if total else 0.0
+
+
+# ------------------------------------------------------------------ headline
+
+def main() -> None:
+    import random
+
+    import jax
+    from nomad_tpu.server.fsm import RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+    from nomad_tpu.structs import SCHED_ALG_TPU
+
+    # the placer decorrelates concurrent workers via random node shuffles;
+    # seed it so the reported rejection rates are reproducible run to run
+    random.seed(20260729)
+    platform = jax.devices()[0].platform
+
+    # warmup pass: same node count (=> same padded kernel bucket), tiny job;
+    # pays the one-time XLA compile so the measured run reflects steady state
+    t0 = time.perf_counter()
+    fsm_w = _seed_fsm(N_NODES, SCHED_ALG_TPU)
+    planner_w = Planner(RaftLog(fsm_w), fsm_w.state)
+    job_w = _mk_batch_job("warmup", 100)
+    _register(fsm_w, job_w)
+    _run_eval(fsm_w, planner_w, job_w)
+    _validate(fsm_w, "warmup", 100)
+    compile_s = time.perf_counter() - t0
+
+    # measured: fresh cluster, the BASELINE 50k/10k scenario, end to end
+    fsm = _seed_fsm(N_NODES, SCHED_ALG_TPU)
+    planner = Planner(RaftLog(fsm), fsm.state)
+    job = _mk_batch_job("c1m-batch", N_TASKS)
+    _register(fsm, job)
+    t0 = time.perf_counter()
+    shim, sched = _run_eval(fsm, planner, job)
+    value = time.perf_counter() - t0
+    _validate(fsm, "c1m-batch", N_TASKS)
+    rejected, total_nodes = _rejection_stats([shim])
+
+    # host-oracle comparison (same end-to-end path, binpack stack).
+    # The host path is linear in placements; timing it at 5k tasks keeps the
+    # bench runnable every round — the 50k extrapolation is reported as such.
+    host_tasks = 5_000
+    fsm_h = _seed_fsm(N_NODES, "binpack")
+    planner_h = Planner(RaftLog(fsm_h), fsm_h.state)
+    job_h = _mk_batch_job("host-batch", host_tasks)
+    _register(fsm_h, job_h)
+    t0 = time.perf_counter()
+    _run_eval(fsm_h, planner_h, job_h)
+    host_5k_s = time.perf_counter() - t0
+    _validate(fsm_h, "host-batch", host_tasks)
+    # tpu at the same scale for a measured like-for-like ratio
+    fsm_t5 = _seed_fsm(N_NODES, SCHED_ALG_TPU)
+    planner_t5 = Planner(RaftLog(fsm_t5), fsm_t5.state)
+    job_t5 = _mk_batch_job("tpu-5k", host_tasks)
+    _register(fsm_t5, job_t5)
+    t0 = time.perf_counter()
+    _run_eval(fsm_t5, planner_t5, job_t5)
+    tpu_5k_s = time.perf_counter() - t0
+
+    # plan-rejection parity under optimistic concurrency
+    rej_tpu = _concurrent_rejection_rate(SCHED_ALG_TPU)
+    rej_host = _concurrent_rejection_rate("binpack")
+
+    print(json.dumps({
+        "metric": f"end-to-end {N_TASKS//1000}k-task batch eval->plan-applied"
+                  f" on {N_NODES//1000}k-node sim ({platform})",
+        "value": round(value, 6),
+        "unit": "s",
+        "vs_baseline": round(TARGET_S / value, 2),
+        "compile_s": round(compile_s, 3),
+        "placed": N_TASKS,
+        "plan_nodes_rejected": rejected,
+        "plan_nodes_total": total_nodes,
+        "host_binpack_5k_tasks_s": round(host_5k_s, 4),
+        "tpu_5k_tasks_s": round(tpu_5k_s, 4),
+        "host_50k_extrapolated_s": round(host_5k_s * N_TASKS / host_tasks, 2),
+        "speedup_vs_host_measured_5k": round(host_5k_s / tpu_5k_s, 2),
+        "rejection_rate_tpu": round(rej_tpu, 4),
+        "rejection_rate_host_binpack": round(rej_host, 4),
+        "rejection_parity": bool(rej_tpu <= rej_host + 0.01),
+    }))
+
+
+# ------------------------------------------------- kernel-only micro configs
+
+def build_cluster(n_nodes: int, seed: int = 42):
+    """Synthetic matrices for the kernel-only micro configs."""
     from nomad_tpu.solver import NUM_XR
     rng = np.random.default_rng(seed)
     cap = np.zeros((n_nodes, NUM_XR), np.float32)
-    cap[:, 0] = rng.choice([4_000, 8_000, 16_000, 32_000], n_nodes)   # cpu MHz
-    cap[:, 1] = rng.choice([8_192, 16_384, 32_768, 65_536], n_nodes)  # mem MB
-    cap[:, 2] = 500_000                                               # disk MB
-    cap[:, 3] = 12_001                                                # dyn ports
+    cap[:, 0] = rng.choice([4_000, 8_000, 16_000, 32_000], n_nodes)   # cpu
+    cap[:, 1] = rng.choice([8_192, 16_384, 32_768, 65_536], n_nodes)  # mem
+    cap[:, 2] = 500_000                                               # disk
+    cap[:, 3] = 12_001                                                # ports
     cap[:, 4] = 10_000                                                # mbits
     used = np.zeros_like(cap)
-    # background utilization: ~30% of nodes run other work
     busy = rng.random(n_nodes) < 0.3
     used[busy, 0] = rng.integers(500, 3_000, busy.sum())
     used[busy, 1] = rng.integers(1_024, 6_000, busy.sum())
-    # irregular-constraint feasibility mask (pre-lowered host-side)
     feasible = rng.random(n_nodes) < 0.95
     return cap, used, feasible
 
 
-def _bench(fn, *host_args, reps: int = 5) -> tuple[float, "np.ndarray"]:
-    """Median wall-clock of transfer + solve + readback.
-
-    host_args stay on the host (numpy/python scalars); each timed rep pays
-    the device transfer via jnp.asarray, matching the per-evaluation cost
-    the scheduler path pays (module docstring)."""
+def _bench(fn, *host_args, reps: int = 5):
+    """Median wall-clock of transfer + solve + readback."""
     import jax.numpy as jnp
 
     def put():
@@ -63,6 +284,23 @@ def _bench(fn, *host_args, reps: int = 5) -> tuple[float, "np.ndarray"]:
         counts = np.asarray(out)
         times.append(time.perf_counter() - t0)
     return float(np.median(times)), counts
+
+
+def kernel_only() -> dict:
+    """Round-1 style kernel-only solve (transfer + kernel + readback)."""
+    import jax
+    import jax.numpy as jnp
+    from nomad_tpu.solver import NUM_XR, fill_greedy_binpack
+    cap, used, feas = build_cluster(N_NODES)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1], ask[2] = 250.0, 512.0, 300.0
+    solve = jax.jit(fill_greedy_binpack)
+    value, counts = _bench(solve, cap, used, ask, jnp.int32(N_TASKS), feas)
+    assert int(counts.sum()) == N_TASKS
+    return {"metric": f"kernel-only {N_TASKS//1000}k/{N_NODES//1000}k "
+            f"({jax.devices()[0].platform})",
+            "value": round(value, 6), "unit": "s",
+            "vs_baseline": round(TARGET_S / value, 2)}
 
 
 def config2() -> dict:
@@ -123,9 +361,6 @@ def config4() -> dict:
     svc_ask = np.zeros(NUM_XR, np.float32)
     svc_ask[0], svc_ask[1] = 2000.0, 4096.0
     # device asks enter the solver as a pre-lowered feasibility mask
-    # (SURVEY.md §7.4: irregular constraints and device groups tensorize to
-    # per-node bits; exact instance ids assigned host-side) — the service
-    # wave only fits on the ~20%% of nodes fingerprinting the device
     has_device = rng.random(n_nodes) < 0.2
 
     solve = jax.jit(fill_greedy_binpack)
@@ -135,11 +370,8 @@ def config4() -> dict:
         placed = solve(cap_j, used_j, jnp.asarray(batch_ask),
                        jnp.int32(15_000), feas_j)
         used2 = used_j + placed[:, None] * jnp.asarray(batch_ask)[None, :]
-        # high-priority service wave with device ask; preemption pass on
-        # the tightest node
         svc = solve(cap_j, used2, jnp.asarray(svc_ask), jnp.int32(500),
                     feas_j & dev_j)
-        # victims on node 0: its batch placements
         victims = jnp.tile(jnp.asarray(batch_ask)[None, :], (64, 1))
         vprio = jnp.full((64,), 50, jnp.int32)
         mask = preempt(victims, vprio, jnp.asarray(svc_ask),
@@ -157,14 +389,12 @@ def config4() -> dict:
 
 def config5() -> dict:
     """BASELINE config 5: C2M-style replay — 2M tasks across 10k nodes as
-    200 sequential 10k-task evals with running usage (multi-job stream,
-    the C2M 'containers scheduled' analog). Reports evals/sec."""
+    200 sequential 10k-task evals with running usage. Reports evals/sec."""
     import jax
     import jax.numpy as jnp
     from nomad_tpu.solver import NUM_XR, fill_greedy_binpack
     n_nodes, evals, tasks_per = 10_000, 200, 10_000
     cap, used, feas = build_cluster(n_nodes)
-    # C2M containers are tiny (the challenge used minimal redis containers)
     ask = np.zeros(NUM_XR, np.float32)
     ask[0], ask[1] = 1.0, 1.0
 
@@ -181,9 +411,6 @@ def config5() -> dict:
     value, counts = _bench(eval_stream, cap, used, feas, reps=3)
     total = int(counts.sum())
     assert total == evals * tasks_per, f"placed {total}"
-    # vs_baseline uses the same <1s-per-eval-stream convention as the other
-    # configs; the quota/federation parts of BASELINE cfg5 are control-plane
-    # behavior outside this solver microbench's scope
     return {"metric": "cfg5: C2M-style eval stream, 2M tasks / 10k nodes "
             f"({evals} evals)", "value": round(value, 6), "unit": "s",
             "evals_per_sec": round(evals / value, 1),
@@ -191,58 +418,14 @@ def config5() -> dict:
             "vs_baseline": round(TARGET_S / value, 2)}
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-    from nomad_tpu.solver import NUM_XR, fill_greedy_binpack
-
-    cap_np, used_np, feas_np = build_cluster(N_NODES)
-    ask_np = np.zeros(NUM_XR, np.float32)
-    ask_np[0], ask_np[1], ask_np[2] = 250.0, 512.0, 300.0   # batch task ask
-
-    solve = jax.jit(fill_greedy_binpack)
-
-    # warmup / compile (cached afterwards)
-    placed = solve(jnp.asarray(cap_np), jnp.asarray(used_np),
-                   jnp.asarray(ask_np), jnp.int32(N_TASKS),
-                   jnp.asarray(feas_np))
-    placed.block_until_ready()
-
-    # measured: transfer + solve + readback, median of 5
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        placed = solve(jnp.asarray(cap_np), jnp.asarray(used_np),
-                       jnp.asarray(ask_np), jnp.int32(N_TASKS),
-                       jnp.asarray(feas_np))
-        counts = np.asarray(placed)
-        times.append(time.perf_counter() - t0)
-    value = float(np.median(times))
-
-    # validity: full placement, no node overcommitted
-    total = int(counts.sum())
-    free = cap_np - used_np
-    ok_dims = (used_np + counts[:, None] * ask_np[None, :] <= cap_np + 1e-3)
-    assert total == N_TASKS, f"placed {total}/{N_TASKS}"
-    assert bool(ok_dims.all()), "overcommit detected"
-    assert int(counts[~feas_np].sum()) == 0, "placed on infeasible node"
-
-    print(json.dumps({
-        "metric": f"{N_TASKS//1000}k-task batch placement on "
-                  f"{N_NODES//1000}k-node sim ({jax.devices()[0].platform})",
-        "value": round(value, 6),
-        "unit": "s",
-        "vs_baseline": round(TARGET_S / value, 2),
-    }))
-
-
 if __name__ == "__main__":
-    import sys
     if len(sys.argv) > 1 and sys.argv[1] == "--config":
         which = sys.argv[2] if len(sys.argv) > 2 else "all"
         fns = {"2": config2, "3": config3, "4": config4, "5": config5}
         for key, fn in fns.items():
             if which in (key, "all"):
                 print(json.dumps(fn()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--kernel":
+        print(json.dumps(kernel_only()))
     else:
         main()   # driver contract: exactly one JSON line

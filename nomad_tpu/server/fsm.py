@@ -276,6 +276,7 @@ class NomadFSM:
                 s._index_alloc(alloc)
             for ev in s.evals.values():
                 s._index_eval(ev)
+            s.usage.rebuild(s.nodes.values(), s.allocs.values())
             s._cond.notify_all()
 
 

@@ -33,9 +33,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# extended resource axis layout (tensorize.py must match)
-XR_CPU, XR_MEM, XR_DISK, XR_PORTS, XR_MBITS = 0, 1, 2, 3, 4
-NUM_XR = 5
+# extended resource axis layout — single-sourced from the state-side usage
+# index so the incrementally-maintained matrices and the kernels agree
+from ..state.usage_index import (       # noqa: F401  (re-exported)
+    NUM_XR, XR_CPU, XR_DISK, XR_MBITS, XR_MEM, XR_PORTS,
+)
 
 BINPACK_MAX_SCORE = 18.0
 

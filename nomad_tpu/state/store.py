@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from .usage_index import UsageIndex
+
 from ..structs import (
     Allocation, Deployment, Evaluation, Job, Node, SchedulerConfiguration,
     ALLOC_CLIENT_LOST, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
@@ -75,6 +77,9 @@ class StateStore:
         self._allocs_by_job: dict[tuple[str, str], set[str]] = {}
         self._allocs_by_eval: dict[str, set[str]] = {}
         self._evals_by_job: dict[tuple[str, str], set[str]] = {}
+        # dense [N, R'] capacity/usage matrices, maintained incrementally —
+        # the solver's input (see usage_index.py module docstring)
+        self.usage = UsageIndex()
 
         # event sink (wired to the event broker by the server)
         self.event_sinks: list[Callable[[str, str, int, object], None]] = []
@@ -141,6 +146,7 @@ class StateStore:
             out.csi_plugins = dict(self.csi_plugins)
             out.services = dict(self.services)
             out.autopilot_config = dict(self.autopilot_config)
+            out.usage = self.usage.copy()
             out._allocs_by_node = {k: set(v)
                                    for k, v in self._allocs_by_node.items()}
             out._allocs_by_job = {k: set(v)
@@ -192,6 +198,7 @@ class StateStore:
                 node.create_index = index
             node.modify_index = self._bump("nodes", index)
             self.nodes[node.id] = node
+            self.usage.set_node(node)
             self._update_csi_plugins_from_node(index, node)
             self._emit("Node", "NodeRegistration", node.modify_index, node)
             self._commit()
@@ -200,6 +207,7 @@ class StateStore:
         with self._lock:
             for nid in node_ids:
                 self.nodes.pop(nid, None)
+                self.usage.drop_node(nid)
                 self._delete_node_from_csi_plugins(index, nid)
             self._bump("nodes", index)
             self._commit()
@@ -722,9 +730,15 @@ class StateStore:
                 self._upsert_alloc_locked(idx, alloc)
             self._commit()
 
-    def _upsert_alloc_locked(self, idx: int, alloc: Allocation) -> None:
+    def _upsert_alloc_locked(self, idx: int, alloc: Allocation,
+                             fresh: bool = False,
+                             summary_cache: Optional[dict] = None,
+                             skip_summary: bool = False) -> None:
         existing = self.allocs.get(alloc.id)
-        alloc = alloc.copy()
+        if not (fresh and existing is None):
+            # defensive copy; skipped for server-generated placements that
+            # are fresh objects already (plan apply fast path)
+            alloc = alloc.copy()
         if existing:
             alloc.create_index = existing.create_index
             # client-only fields are not clobbered by server-side upserts
@@ -741,7 +755,9 @@ class StateStore:
         alloc.modify_index = idx
         self.allocs[alloc.id] = alloc
         self._index_alloc(alloc)
-        self._reconcile_summary(idx, existing, alloc)
+        self.usage.set_alloc(alloc)
+        if not skip_summary:
+            self._reconcile_summary(idx, existing, alloc, summary_cache)
         self._emit("Allocation", "AllocationUpdated", idx, alloc)
 
     def _index_alloc(self, alloc: Allocation) -> None:
@@ -754,6 +770,7 @@ class StateStore:
         alloc = self.allocs.pop(alloc_id, None)
         if not alloc:
             return
+        self.usage.drop_alloc(alloc_id)
         for idx_map, key in ((self._allocs_by_node, alloc.node_id),
                              (self._allocs_by_job, (alloc.namespace, alloc.job_id)),
                              (self._allocs_by_eval, alloc.eval_id)):
@@ -771,14 +788,21 @@ class StateStore:
     }
 
     def _reconcile_summary(self, index: int, old: Optional[Allocation],
-                           new: Allocation) -> None:
+                           new: Allocation,
+                           cache: Optional[dict] = None) -> None:
         """Maintain per-TG client-status counts
-        (ref state_store.go updateSummaryWithAlloc)."""
+        (ref state_store.go updateSummaryWithAlloc). `cache` holds one
+        already-copied summary per job for batch writes (plan apply), so a
+        50k-alloc plan pays one summary copy, not 50k."""
         key = (new.namespace, new.job_id)
-        summ = self.job_summaries.get(key)
+        summ = cache.get(key) if cache is not None else None
         if summ is None:
-            return
-        summ = summ.copy()
+            summ = self.job_summaries.get(key)
+            if summ is None:
+                return
+            summ = summ.copy()
+            if cache is not None:
+                cache[key] = summ
         tg = summ.summary.setdefault(new.task_group, TaskGroupSummary())
         if old is not None:
             f = self._SUMMARY_FIELDS.get(old.client_status)
@@ -836,6 +860,7 @@ class StateStore:
                 alloc.modify_index = idx
                 alloc.modify_time_unix = update.modify_time_unix or time.time()
                 self.allocs[alloc.id] = alloc
+                self.usage.set_alloc(alloc)
                 self._reconcile_summary(idx, existing, alloc)
                 self._emit("Allocation", "AllocationUpdated", idx, alloc)
                 # job status may flip (e.g. batch job completes)
@@ -914,15 +939,43 @@ class StateStore:
         """
         with self._lock:
             idx = self._bump("allocs", index)
+            summary_cache: dict = {}
+            now = time.time()
             for alloc in result.alloc_updates:      # stopped/updated allocs
-                self._upsert_alloc_locked(idx, alloc)
+                self._upsert_alloc_locked(idx, alloc,
+                                          summary_cache=summary_cache)
+            # fresh placements (all client-status pending) aggregate into
+            # one summary bump per (job, tg) instead of 50k copies/updates
+            fresh_counts: dict[tuple, int] = {}
             for alloc in result.alloc_placements:   # new placements
                 if alloc.create_time_unix == 0.0:
-                    alloc.create_time_unix = time.time()
+                    alloc.create_time_unix = now
                 alloc.modify_time_unix = alloc.create_time_unix
-                self._upsert_alloc_locked(idx, alloc)
+                if alloc.id not in self.allocs and \
+                        alloc.client_status == ALLOC_CLIENT_PENDING:
+                    key = (alloc.namespace, alloc.job_id, alloc.task_group)
+                    fresh_counts[key] = fresh_counts.get(key, 0) + 1
+                    self._upsert_alloc_locked(idx, alloc, fresh=True,
+                                              skip_summary=True)
+                else:
+                    self._upsert_alloc_locked(idx, alloc, fresh=True,
+                                              summary_cache=summary_cache)
+            for (ns, job_id, tg_name), cnt in fresh_counts.items():
+                jkey = (ns, job_id)
+                summ = summary_cache.get(jkey)
+                if summ is None:
+                    summ = self.job_summaries.get(jkey)
+                    if summ is None:
+                        continue
+                    summ = summ.copy()
+                    summary_cache[jkey] = summ
+                tg = summ.summary.setdefault(tg_name, TaskGroupSummary())
+                tg.starting += cnt
+                summ.modify_index = idx
+                self.job_summaries[jkey] = summ
             for alloc in result.alloc_preemptions:
-                self._upsert_alloc_locked(idx, alloc)
+                self._upsert_alloc_locked(idx, alloc,
+                                          summary_cache=summary_cache)
             if result.deployment is not None:
                 self._upsert_deployment_locked(idx, result.deployment)
             for du in result.deployment_updates:
@@ -1253,6 +1306,7 @@ class StateSnapshot:
         self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
         self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
         self._evals_by_job = {k: set(v) for k, v in store._evals_by_job.items()}
+        self.usage = store.usage.view()
 
     # read API mirrors the scheduler State interface (ref scheduler/scheduler.go:66)
 

