@@ -332,13 +332,20 @@ def config3() -> dict:
     ask = np.zeros(NUM_XR, np.float32)
     ask[0], ask[1] = 100.0, 128.0
     racks = rng.integers(0, 100, n_nodes)          # spread property: rack
-    prop_counts = np.zeros(100, np.int32)
     solve = jax.jit(lambda *a: place_chunked(
         *a, max_per_node=8, max_steps=256))        # distinct-ish cap
     value, counts = _bench(
         solve, cap, used, ask, jnp.int32(n_tasks), feas,
         np.zeros(n_nodes, np.int32), jnp.int32(n_tasks),
-        racks.astype(np.int32), prop_counts, jnp.float32(50.0))
+        racks.astype(np.int32)[None, :],           # spread_ids [1, N]
+        np.pad(np.zeros((1, 100), np.int32),       # spread_counts, -1 pads
+               ((0, 0), (0, 28)), constant_values=-1),
+        np.full((1, 128), -1.0, np.float32),       # even mode: no targets
+        np.zeros(1, np.int32),                     # mode 0 = even
+        np.ones(1, np.float32),                    # weights
+        np.zeros(n_nodes, np.float32),             # affinity
+        np.full((1, n_nodes), -1, np.int32),       # distinct ids (pad)
+        np.full((1, 2), -1, np.int32))             # distinct remaining
     assert int(counts.sum()) == n_tasks, f"placed {counts.sum()}"
     assert int(counts.max()) <= 8
     return {"metric": "cfg3: 10k tasks / 2k nodes spread+anti-affinity",

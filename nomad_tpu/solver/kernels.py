@@ -108,49 +108,97 @@ def _mean_scores(parts: list[jnp.ndarray], present: list[jnp.ndarray]
     return total / jnp.maximum(n, 1.0)
 
 
+def _even_spread_boost_vec(node_pc, pcounts, valid_p):
+    """Vectorized evenSpreadScoreBoost (ref spread.go:178) over the node
+    axis, for one stanza. node_pc: i32[N] running count of each node's
+    value; pcounts: i32[P] running counts; valid_p: bool[P] live columns."""
+    min_c = jnp.min(jnp.where(valid_p, pcounts, 2 ** 30))
+    min_c = jnp.where(jnp.any(valid_p), min_c, 0)
+    max_c = jnp.max(jnp.where(valid_p, pcounts, 0))
+    any_placed = max_c > 0
+    at_min = node_pc == min_c
+    boost_nonmin = jnp.where(min_c == 0, -1.0,
+                             (min_c - node_pc) / jnp.maximum(min_c, 1))
+    boost_min = jnp.where(min_c == max_c, -1.0,
+                          jnp.where(min_c == 0, 1.0,
+                                    (max_c - min_c) / jnp.maximum(min_c, 1)))
+    boost = jnp.where(at_min, boost_min, boost_nonmin)
+    return jnp.where(any_placed, boost, 0.0)
+
+
 @functools.partial(jax.jit, static_argnames=("max_steps", "spread_algorithm"))
 def place_chunked(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
                   count: jnp.ndarray, feasible: jnp.ndarray,
                   job_collisions: jnp.ndarray, desired_count: jnp.ndarray,
-                  prop_ids: jnp.ndarray, prop_counts: jnp.ndarray,
-                  spread_weight: jnp.ndarray,
+                  spread_ids: jnp.ndarray, spread_counts: jnp.ndarray,
+                  spread_desired: jnp.ndarray, spread_mode: jnp.ndarray,
+                  spread_weights: jnp.ndarray,
+                  affinity_boost: jnp.ndarray,
+                  distinct_ids: jnp.ndarray,
+                  distinct_remaining: jnp.ndarray,
                   max_per_node: jnp.ndarray | int = 2 ** 30,
                   max_steps: int = 256,
                   spread_algorithm: bool = False) -> jnp.ndarray:
-    """Chunked greedy placement with interacting scores (spread stanza,
-    job anti-affinity, spread algorithm), as a lax.scan with running usage.
+    """Chunked greedy placement with the FULL interacting GenericStack score
+    model, as a lax.scan with running usage (VERDICT r1 next #2: every
+    host-only bail tensorized).
+
+    Score components (mean of present, ref rank.go:737):
+      base      ScoreFitBinPack/Spread (always present)
+      anti      -(collisions+1)/desired when collisions > 0 (rank.go:536)
+      affinity  static per-node boost, pre-lowered host-side (rank.go:650)
+      spread    sum over S stanzas: even-spread boost (spread.go:178,
+                unweighted) or targeted ((desired-(count+1))/desired *
+                weight/sum_weights); -1 per stanza for missing values
+
+    Feasibility beyond the mask: distinct_property value capacities
+    (feasible.go:604) as [D] stanzas of per-value remaining counts that
+    decrement as the scan places.
 
     Inputs:
-      cap/used: f32[N, R']; ask: f32[R']; count: i32[] instances to place
-      feasible: bool[N]
-      job_collisions: i32[N] existing same-job/TG allocs per node
-        (JobAntiAffinityIterator, rank.go:536)
-      desired_count: i32[] TG count for the anti-affinity denominator
-      prop_ids: i32[N] property-value id per node (-1 = missing) for the
-        spread attribute; prop_counts: i32[P] usage per value
-        (SpreadIterator even-spread, spread.go:178)
-      spread_weight: f32[] — 0 disables the spread component
-      spread_algorithm: use worst-fit base score (ScoreFitSpread)
+      cap/used: f32[N, R']; ask: f32[R']; count: i32[]; feasible: bool[N]
+      job_collisions: i32[N]; desired_count: i32[]
+      spread_ids: i32[S, N] value id per node (-1 missing)
+      spread_counts: i32[S, P] running usage (-1 = dead pad column)
+      spread_desired: f32[S, P] desired count per value (-1 = no target)
+      spread_mode: i32[S] 0=even, 1=targeted, -1=pad stanza
+      spread_weights: f32[S] weight/sum_weights (targeted stanzas)
+      affinity_boost: f32[N] (0 disables per node)
+      distinct_ids: i32[D, N] value id per node (-1 missing => infeasible)
+      distinct_remaining: i32[D, P] remaining per value (-1 row 0 = pad
+        stanza marker: distinct_remaining[d, 0] < 0 disables stanza d)
 
     Each scan step places `ceil(count/max_steps)` instances one-per-node on
-    the top-k scored nodes (k = chunk), which matches sequential greedy when
-    chunk divides the placement stream finely enough; chunk=1 is exact.
+    the top-k scored nodes; chunk=1 is exact sequential greedy.
     Returns i32[N] placements per node.
     """
     n_nodes = cap.shape[0]
     # top_k needs a static k; cap the per-step chunk at it. Coverage bound:
-    # max_steps * k instances (256 * 256 = 65k default) — callers route
-    # anything larger to the host path.
+    # max_steps * k instances (256 * 256 = 65k default) — callers split
+    # larger asks across repeated solves.
     k = min(n_nodes, 256)
     chunk = jnp.minimum(jnp.maximum((count + max_steps - 1) // max_steps, 1),
                         k)
-    n_props = prop_counts.shape[0]
+    n_s, n_props = spread_counts.shape[0], spread_counts.shape[1]
+    n_d, n_dvals = distinct_remaining.shape[0], distinct_remaining.shape[1]
+    s_active = spread_mode >= 0                             # bool[S]
+    d_active = distinct_remaining[:, 0] >= 0                # bool[D]
+    any_spread = jnp.any(s_active)
+    sid_safe = jnp.clip(spread_ids, 0, n_props - 1)         # [S, N]
+    did_safe = jnp.clip(distinct_ids, 0, n_dvals - 1)       # [D, N]
 
     def step(carry, _):
-        cur_used, placed, remaining, pcounts = carry
+        cur_used, placed, remaining, pcounts, drem = carry
 
         capacity = instance_capacity(cap, cur_used, ask, feasible)
         can_place = (capacity > 0) & (placed < max_per_node)
+
+        # distinct_property: value quota left AND value present
+        # (propertyset.go SatisfiesDistinctProperties: missing => fail)
+        for d in range(n_d):
+            ok_d = (distinct_ids[d] >= 0) & \
+                (jnp.take(drem[d], did_safe[d]) > 0)
+            can_place &= jnp.where(d_active[d], ok_d, True)
 
         base = score_fit(cap, cur_used, spread=spread_algorithm) / \
             BINPACK_MAX_SCORE
@@ -159,29 +207,30 @@ def place_chunked(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
         anti = -(collisions + 1.0) / jnp.maximum(desired_count, 1)
         anti_present = collisions > 0
 
-        # even-spread boost per property value (spread.go:178); padded
-        # pcounts entries are -1 sentinels and excluded from min/max
-        valid_p = pcounts >= 0
-        node_pc = jnp.where(prop_ids >= 0,
-                            pcounts[jnp.clip(prop_ids, 0, n_props - 1)], 0)
-        min_c = jnp.min(jnp.where(valid_p, pcounts, 2 ** 30))
-        min_c = jnp.where(jnp.any(valid_p), min_c, 0)
-        max_c = jnp.max(jnp.where(valid_p, pcounts, 0))
-        any_placed = (max_c > 0)
-        at_min = node_pc == min_c
-        boost_nonmin = jnp.where(min_c == 0, -1.0,
-                                 (min_c - node_pc) / jnp.maximum(min_c, 1))
-        boost_min = jnp.where(min_c == max_c, -1.0,
-                              jnp.where(min_c == 0, 1.0,
-                                        (max_c - min_c) / jnp.maximum(min_c, 1)))
-        boost = jnp.where(at_min, boost_min, boost_nonmin)
-        boost = jnp.where(any_placed, boost, 0.0)
-        boost = jnp.where(prop_ids >= 0, boost, -1.0) * spread_weight
-        spread_present = jnp.logical_and(spread_weight > 0, boost != 0.0)
+        # spread component: sum over stanzas (SpreadIterator.next)
+        spread_total = jnp.zeros((n_nodes,), jnp.float32)
+        for s in range(n_s):
+            ids_s = spread_ids[s]
+            pc_s = pcounts[s]
+            node_pc = jnp.where(ids_s >= 0, jnp.take(pc_s, sid_safe[s]), 0)
+            even = _even_spread_boost_vec(node_pc, pc_s, pc_s >= 0)
+            d_s = jnp.where(ids_s >= 0,
+                            jnp.take(spread_desired[s], sid_safe[s]), -1.0)
+            targeted = jnp.where(
+                d_s > 0,
+                ((d_s - (node_pc + 1.0)) / d_s) * spread_weights[s],
+                -1.0)                       # no target for value => -1
+            per_node = jnp.where(spread_mode[s] == 1, targeted, even)
+            per_node = jnp.where(ids_s >= 0, per_node, -1.0)  # missing value
+            spread_total += jnp.where(s_active[s], per_node, 0.0)
+        spread_present = any_spread & (spread_total != 0.0)
+
+        affinity_present = affinity_boost != 0.0
 
         score = _mean_scores(
-            [base, anti, boost],
-            [jnp.ones_like(base, dtype=bool), anti_present, spread_present])
+            [base, anti, affinity_boost, spread_total],
+            [jnp.ones_like(base, dtype=bool), anti_present,
+             affinity_present, spread_present])
         score = jnp.where(can_place, score, -jnp.inf)
 
         # place up to `chunk` instances, one per selected node
@@ -196,14 +245,27 @@ def place_chunked(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
         new_used = cur_used + add[:, None].astype(cap.dtype) * ask[None, :]
         new_placed = placed + add
         new_remaining = remaining - n_added
-        # property counts update
-        valid = prop_ids >= 0
-        pc_add = jnp.zeros((n_props,), pcounts.dtype).at[
-            jnp.where(valid, prop_ids, 0)].add(jnp.where(valid, add, 0))
-        return (new_used, new_placed, new_remaining, pcounts + pc_add), None
+        # running spread counts / distinct quotas update
+        new_pcounts = pcounts
+        if n_s:
+            valid = spread_ids >= 0                          # [S, N]
+            adds = jnp.where(valid, add[None, :], 0)
+            new_pcounts = pcounts + jax.vmap(
+                lambda ids, a: jnp.zeros((n_props,), pcounts.dtype)
+                .at[ids].add(a))(sid_safe, adds)
+        new_drem = drem
+        if n_d:
+            validd = distinct_ids >= 0
+            addsd = jnp.where(validd, add[None, :], 0)
+            new_drem = drem - jax.vmap(
+                lambda ids, a: jnp.zeros((n_dvals,), drem.dtype)
+                .at[ids].add(a))(did_safe, addsd)
+        return (new_used, new_placed, new_remaining, new_pcounts,
+                new_drem), None
 
-    init = (used, jnp.zeros((n_nodes,), jnp.int32), count, prop_counts)
-    (final_used, placed, remaining, _), _ = jax.lax.scan(
+    init = (used, jnp.zeros((n_nodes,), jnp.int32), count, spread_counts,
+            distinct_remaining)
+    (final_used, placed, remaining, _, _), _ = jax.lax.scan(
         step, init, None, length=max_steps)
     return placed
 
@@ -242,9 +304,10 @@ def preempt_top_k(victim_res: jnp.ndarray, victim_priority: jnp.ndarray,
     cum = jnp.cumsum(res_sorted, axis=0)
     deficit = jnp.maximum(ask - free, 0.0)                      # [R']
     enough = jnp.all(cum >= deficit[None, :], axis=1)           # [V]
-    # first index where cumulative reclaim covers the deficit
+    # first index where cumulative reclaim covers the deficit; no victims
+    # at all when the ask already fits in free capacity
     first = jnp.argmax(enough)
-    needed = jnp.where(jnp.any(enough), first + 1, 0)
+    needed = jnp.where(jnp.any(enough) & jnp.any(deficit > 0), first + 1, 0)
     take_sorted = jnp.arange(victim_res.shape[0]) < needed
     take_sorted = jnp.logical_and(take_sorted,
                                   jnp.isfinite(key[order]))

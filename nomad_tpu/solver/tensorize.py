@@ -30,9 +30,6 @@ class GroupTensors:
     feasible: np.ndarray               # bool[N] irregular-constraint verdicts
     ask: np.ndarray                    # f32[R'] per-instance claim
     job_collisions: np.ndarray         # i32[N] same job+tg proposed allocs
-    prop_ids: np.ndarray               # i32[N] spread-attribute value ids (-1 none)
-    prop_counts: np.ndarray            # i32[P] usage per value id
-    prop_values: list[str]             # id -> value
     distinct_hosts: bool
 
 
@@ -88,6 +85,164 @@ def group_ask_row(tg: TaskGroup) -> np.ndarray:
             row[XR_PORTS] += len(net.dynamic_ports)
             row[XR_MBITS] += net.mbits
     return row
+
+
+@dataclasses.dataclass
+class SpreadTensors:
+    """All spread stanzas lowered for the chunked kernel (ref
+    scheduler/spread.go SpreadIterator; SURVEY hard part 2)."""
+    ids: np.ndarray        # i32[S, N] value id per node (-1 missing)
+    counts: np.ndarray     # i32[S, P] running usage (-1 pad columns)
+    desired: np.ndarray    # f32[S, P] desired count per value (-1 none)
+    mode: np.ndarray       # i32[S] 0=even 1=targeted -1=pad
+    weights: np.ndarray    # f32[S] weight/sum_weights
+
+
+@dataclasses.dataclass
+class DistinctTensors:
+    """distinct_property constraints lowered to per-value quotas (ref
+    scheduler/feasible.go:604 + propertyset.go)."""
+    ids: np.ndarray        # i32[D, N] value id per node (-1 missing)
+    remaining: np.ndarray  # i32[D, P]; remaining[d, 0] < 0 marks pad stanza
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+def _lower_spreads(ctx, job, tg, spreads, nodes) -> SpreadTensors:
+    """Mirror SpreadIterator._compute_spread_info + next() inputs."""
+    from ..scheduler.feasible import resolve_target
+    from ..scheduler.propertyset import PropertySet
+    IMPLICIT = "*"
+    n = len(nodes)
+    s_count = _pow2(len(spreads))
+    if not spreads:
+        return SpreadTensors(
+            ids=np.full((1, n), -1, np.int32),
+            counts=np.full((1, 2), -1, np.int32),
+            desired=np.full((1, 2), -1.0, np.float32),
+            mode=np.full(1, -1, np.int32),
+            weights=np.zeros(1, np.float32))
+    # desired-count info per attribute; job spreads override tg spreads for
+    # duplicate attributes (SpreadIterator._compute_spread_info iteration
+    # order: tg first, job last-write-wins)
+    total = tg.count
+    sum_weights = sum(s.weight for s in spreads)
+    infos: dict[str, tuple[int, dict[str, float]]] = {}
+    for spread in spreads:
+        desired: dict[str, float] = {}
+        sum_desired = 0.0
+        for st in spread.spread_target:
+            d = (st.percent / 100.0) * total
+            desired[st.value] = d
+            sum_desired += d
+        if 0 < sum_desired < total:
+            desired[IMPLICIT] = total - sum_desired
+        infos[spread.attribute] = (spread.weight, desired)
+
+    per_stanza = []
+    max_p = 2
+    for spread in spreads:
+        ps = PropertySet(ctx, job)
+        ps.set_target_attribute(spread.attribute, tg.name)
+        counts_map = ps.used_counts()
+        _, desired = infos[spread.attribute]
+        node_vals = []
+        for node in nodes:
+            val, ok = resolve_target(spread.attribute, node)
+            node_vals.append(str(val) if ok and val is not None else None)
+        universe = sorted(set(counts_map)
+                          | {k for k in desired if k != IMPLICIT}
+                          | {v for v in node_vals if v is not None})
+        vid = {v: i for i, v in enumerate(universe)}
+        per_stanza.append((spread, counts_map, desired, node_vals, vid,
+                           universe))
+        max_p = max(max_p, len(universe))
+    p_count = _pow2(max_p, 2)
+
+    ids = np.full((s_count, n), -1, np.int32)
+    counts = np.full((s_count, p_count), -1, np.int32)
+    desired_arr = np.full((s_count, p_count), -1.0, np.float32)
+    mode = np.full(s_count, -1, np.int32)
+    weights = np.zeros(s_count, np.float32)
+    for s, (spread, counts_map, desired, node_vals, vid, universe) in \
+            enumerate(per_stanza):
+        for i, v in enumerate(node_vals):
+            if v is not None:
+                ids[s, i] = vid[v]
+        for p, v in enumerate(universe):
+            counts[s, p] = counts_map.get(v, 0)
+            if desired:
+                desired_arr[s, p] = desired.get(v, desired.get(IMPLICIT,
+                                                               -1.0))
+        mode[s] = 1 if desired else 0
+        weights[s] = (spread.weight / sum_weights) if sum_weights else 0.0
+    return SpreadTensors(ids=ids, counts=counts, desired=desired_arr,
+                         mode=mode, weights=weights)
+
+
+def _lower_distinct(ctx, property_sets, nodes) -> DistinctTensors:
+    from ..scheduler.feasible import resolve_target
+    n = len(nodes)
+    d_count = _pow2(len(property_sets))
+    ids = np.full((d_count, n), -1, np.int32)
+    remaining = np.full((d_count, 2), -1, np.int32)
+    if not property_sets:
+        return DistinctTensors(ids=ids, remaining=remaining)
+    max_p = 2
+    per = []
+    for ps in property_sets:
+        counts_map = ps.used_counts() if not ps.error else {}
+        node_vals = []
+        for node in nodes:
+            val, ok = resolve_target(ps.target_attribute, node)
+            node_vals.append(str(val) if ok and val is not None else None)
+        universe = sorted(set(counts_map)
+                          | {v for v in node_vals if v is not None})
+        per.append((ps, counts_map, node_vals,
+                    {v: i for i, v in enumerate(universe)}, universe))
+        max_p = max(max_p, len(universe))
+    p_count = _pow2(max_p, 2)
+    remaining = np.full((d_count, p_count), -1, np.int32)
+    for d, (ps, counts_map, node_vals, vid, universe) in enumerate(per):
+        if ps.error:
+            # invalid constraint: every node fails (propertyset.go error
+            # path) — active stanza, all ids -1
+            remaining[d, :] = 0
+            continue
+        for i, v in enumerate(node_vals):
+            if v is not None:
+                ids[d, i] = vid[v]
+        remaining[d, :] = 0
+        for p, v in enumerate(universe):
+            remaining[d, p] = max(0, ps.allowed_count
+                                  - counts_map.get(v, 0))
+    return DistinctTensors(ids=ids, remaining=remaining)
+
+
+def _lower_affinities(ctx, affinities, nodes) -> np.ndarray:
+    """Static per-node affinity boost (ref rank.go:650
+    NodeAffinityIterator): irregular operator matching resolves host-side
+    once per (eval, tg); the device only sees the f32[N] result."""
+    from ..scheduler.feasible import check_constraint, resolve_target
+    n = len(nodes)
+    out = np.zeros(n, np.float32)
+    if not affinities:
+        return out
+    sum_weight = sum(abs(a.weight) for a in affinities)
+    if not sum_weight:
+        return out
+    for i, node in enumerate(nodes):
+        total = 0.0
+        for aff in affinities:
+            lval, lok = resolve_target(aff.ltarget, node)
+            rval, rok = resolve_target(aff.rtarget, node)
+            if check_constraint(ctx, aff.operand, lval, rval, lok, rok):
+                total += float(aff.weight)
+        norm = total / sum_weight
+        out[i] = norm / 100.0 if abs(norm) > 1 else norm
+    return out
 
 
 def build_group_tensors(ctx, job, tg: TaskGroup, nodes: list[Node],
@@ -172,34 +327,9 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     if distinct_hosts:
         feasible &= collisions == 0
 
-    # spread attribute (first spread stanza; others fall back host-side)
-    spread_attr = None
-    for s in list(job.spreads) + list(tg.spreads):
-        spread_attr = s.attribute
-        break
-    prop_ids = np.full(n, -1, np.int32)
-    value_ids: dict[str, int] = {}
-    prop_counts_map: dict[int, int] = {}
-    if spread_attr is not None:
-        from ..scheduler.feasible import resolve_target
-        for i, node in enumerate(nodes):
-            val, ok = resolve_target(spread_attr, node)
-            if ok and val is not None:
-                vid = value_ids.setdefault(str(val), len(value_ids))
-                prop_ids[i] = vid
-                prop_counts_map[vid] = \
-                    prop_counts_map.get(vid, 0) + int(collisions[i])
-    n_props = max(1, len(value_ids))
-    prop_counts = np.zeros(n_props, np.int32)
-    for vid, cnt in prop_counts_map.items():
-        prop_counts[vid] = cnt
-
     return GroupTensors(
         nodes=nodes, cap=cap, used=used, feasible=feasible,
         ask=group_ask_row(tg), job_collisions=collisions,
-        prop_ids=prop_ids, prop_counts=prop_counts,
-        prop_values=[v for v, _ in sorted(value_ids.items(),
-                                          key=lambda kv: kv[1])],
         distinct_hosts=distinct_hosts,
     )
 
@@ -218,19 +348,8 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
     feasible = np.zeros(n, bool)
     collisions = np.zeros(n, np.int32)
 
-    # spread attribute (first spread stanza; others fall back host-side)
-    spread_attr = None
-    for s in list(job.spreads) + list(tg.spreads):
-        spread_attr = s.attribute
-        break
-    prop_ids = np.full(n, -1, np.int32)
-    value_ids: dict[str, int] = {}
-    prop_counts_map: dict[int, int] = {}
-
     distinct_hosts = any(c.operand == OP_DISTINCT_HOSTS
                          for c in list(job.constraints) + list(tg.constraints))
-
-    from ..scheduler.feasible import resolve_target
 
     for i, node in enumerate(nodes):
         cap[i] = node_capacity_row(node)
@@ -240,19 +359,8 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
             used[i] += alloc_usage_row(alloc)
             if alloc.job_id == job.id and alloc.task_group == tg.name:
                 collisions[i] += 1
-        if spread_attr is not None:
-            val, ok = resolve_target(spread_attr, node)
-            if ok and val is not None:
-                vid = value_ids.setdefault(str(val), len(value_ids))
-                prop_ids[i] = vid
-                prop_counts_map[vid] = prop_counts_map.get(vid, 0) + int(collisions[i])
         if distinct_hosts and collisions[i] > 0:
             feasible[i] = False
-
-    n_props = max(1, len(value_ids))
-    prop_counts = np.zeros(n_props, np.int32)
-    for vid, cnt in prop_counts_map.items():
-        prop_counts[vid] = cnt
 
     return GroupTensors(
         nodes=nodes,
@@ -261,9 +369,5 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
         feasible=feasible,
         ask=group_ask_row(tg),
         job_collisions=collisions,
-        prop_ids=prop_ids,
-        prop_counts=prop_counts,
-        prop_values=[v for v, _ in sorted(value_ids.items(),
-                                          key=lambda kv: kv[1])],
         distinct_hosts=distinct_hosts,
     )

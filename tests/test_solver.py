@@ -139,7 +139,14 @@ def test_place_chunked_spreads_evenly():
     placed = np.asarray(place_chunked(
         jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask), jnp.int32(8),
         jnp.ones(n, bool), jnp.zeros(n, jnp.int32), jnp.int32(8),
-        jnp.asarray(prop_ids), jnp.zeros(2, jnp.int32), jnp.float32(1.0),
+        jnp.asarray(prop_ids[None, :]),                  # spread_ids [1, N]
+        jnp.zeros((1, 2), jnp.int32),                    # spread_counts
+        jnp.full((1, 2), -1.0, jnp.float32),             # no targets
+        jnp.zeros(1, jnp.int32),                         # mode 0 = even
+        jnp.ones(1, jnp.float32),                        # weights
+        jnp.zeros(n, jnp.float32),                       # affinity boost
+        jnp.full((1, n), -1, jnp.int32),                 # distinct ids (pad)
+        jnp.full((1, 2), -1, jnp.int32),                 # distinct remaining
         max_steps=8))
     assert placed.sum() == 8
     assert placed[:4].sum() == 4 and placed[4:].sum() == 4
@@ -283,3 +290,233 @@ def test_pallas_fill_greedy_matches_xla():
         jnp.int32(3000), jnp.asarray(feas)))
     np.testing.assert_array_equal(got, want)
     assert got.sum() == 3000
+
+
+def _tpu_harness(n_nodes=8, dc_of=None):
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.name = f"tn{i}"
+        if dc_of:
+            n.datacenter = dc_of(i)
+        h.state.upsert_node(h.get_next_index(), n)
+        nodes.append(n)
+    return h, nodes
+
+
+def _simple_job(count, job_id="featjob"):
+    job = mock.job()
+    job.id = job.name = job_id
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 64
+    return job
+
+
+def _run(h, job):
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    return h.state.allocs_by_job("default", job.id)
+
+
+def test_tpu_path_targeted_spread():
+    """Targeted spread percentages steer the batched kernel
+    (ref spread.go targeted scoring; VERDICT r1 next #2)."""
+    from nomad_tpu.structs import SpreadTarget
+    h, nodes = _tpu_harness(
+        8, dc_of=lambda i: "dc1" if i < 4 else "dc2")
+    job = _simple_job(10)
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].spreads = [Spread(
+        attribute="${node.datacenter}", weight=100,
+        spread_target=[SpreadTarget(value="dc1", percent=80),
+                       SpreadTarget(value="dc2", percent=20)])]
+    allocs = _run(h, job)
+    assert len(allocs) == 10
+    by_dc = {"dc1": 0, "dc2": 0}
+    node_dc = {n.id: n.datacenter for n in nodes}
+    for a in allocs:
+        by_dc[node_dc[a.node_id]] += 1
+    assert by_dc["dc1"] == 8 and by_dc["dc2"] == 2, by_dc
+
+
+def test_tpu_path_multiple_spreads():
+    """Two spread stanzas at once (dc + rack) both influence placement."""
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    nodes = []
+    for i in range(8):
+        n = mock.node()
+        n.name = f"mn{i}"
+        n.datacenter = "dc1" if i < 4 else "dc2"
+        n.meta["rack"] = f"r{i % 2}"
+        h.state.upsert_node(h.get_next_index(), n)
+        nodes.append(n)
+    job = _simple_job(8, "multispread")
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].spreads = [
+        Spread(attribute="${node.datacenter}", weight=50),
+        Spread(attribute="${meta.rack}", weight=50),
+    ]
+    allocs = _run(h, job)
+    assert len(allocs) == 8
+    node_by_id = {n.id: n for n in nodes}
+    by_dc, by_rack = {}, {}
+    for a in allocs:
+        n = node_by_id[a.node_id]
+        by_dc[n.datacenter] = by_dc.get(n.datacenter, 0) + 1
+        by_rack[n.meta["rack"]] = by_rack.get(n.meta["rack"], 0) + 1
+    assert by_dc == {"dc1": 4, "dc2": 4}, by_dc
+    assert by_rack == {"r0": 4, "r1": 4}, by_rack
+
+
+def test_tpu_path_affinity():
+    """Node affinities bias the batched kernel toward matching nodes
+    (ref rank.go:650 NodeAffinityIterator)."""
+    from nomad_tpu.structs import Affinity
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    ssd_nodes = set()
+    for i in range(8):
+        n = mock.node()
+        n.name = f"an{i}"
+        n.attributes["storage.kind"] = "ssd" if i % 2 == 0 else "hdd"
+        if i % 2 == 0:
+            ssd_nodes.add(n.id)
+        h.state.upsert_node(h.get_next_index(), n)
+    job = _simple_job(4, "affjob")
+    job.task_groups[0].affinities = [Affinity(
+        ltarget="${attr.storage.kind}", rtarget="ssd", operand="=",
+        weight=100)]
+    allocs = _run(h, job)
+    assert len(allocs) == 4
+    assert all(a.node_id in ssd_nodes for a in allocs)
+
+
+def test_tpu_path_anti_affinity_negative_weight():
+    """Negative affinity weight steers away from matching nodes."""
+    from nomad_tpu.structs import Affinity
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    hdd_nodes = set()
+    for i in range(8):
+        n = mock.node()
+        n.name = f"negn{i}"
+        n.attributes["storage.kind"] = "ssd" if i % 2 == 0 else "hdd"
+        if i % 2 == 1:
+            hdd_nodes.add(n.id)
+        h.state.upsert_node(h.get_next_index(), n)
+    job = _simple_job(4, "negaffjob")
+    job.task_groups[0].affinities = [Affinity(
+        ltarget="${attr.storage.kind}", rtarget="ssd", operand="=",
+        weight=-100)]
+    allocs = _run(h, job)
+    assert len(allocs) == 4
+    assert all(a.node_id in hdd_nodes for a in allocs)
+
+
+def test_tpu_path_distinct_property():
+    """distinct_property limits allocs per property value in the batched
+    path (ref feasible.go:604); surplus beyond the value capacity fails."""
+    from nomad_tpu.structs import Constraint, OP_DISTINCT_PROPERTY
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    nodes = []
+    for i in range(6):
+        n = mock.node()
+        n.name = f"dn{i}"
+        n.meta["rack"] = f"r{i % 3}"       # 3 racks, 2 nodes each
+        h.state.upsert_node(h.get_next_index(), n)
+        nodes.append(n)
+    job = _simple_job(6, "distinctjob")
+    job.task_groups[0].constraints = [Constraint(
+        ltarget="${meta.rack}", rtarget="2", operand=OP_DISTINCT_PROPERTY)]
+    allocs = _run(h, job)
+    assert len(allocs) == 6
+    node_by_id = {n.id: n for n in nodes}
+    by_rack = {}
+    for a in allocs:
+        r = node_by_id[a.node_id].meta["rack"]
+        by_rack[r] = by_rack.get(r, 0) + 1
+    assert all(v <= 2 for v in by_rack.values()), by_rack
+    # asking beyond the total property capacity (3 racks x 2) blocks the rest
+    job2 = _simple_job(8, "distinctjob2")
+    job2.task_groups[0].constraints = [Constraint(
+        ltarget="${meta.rack}", rtarget="2", operand=OP_DISTINCT_PROPERTY)]
+    allocs2 = _run(h, job2)
+    assert len(allocs2) == 6          # 6 placed, 2 blocked
+    ev = h.evals[-1]
+    assert ev.failed_tg_allocs or h.created_evals
+
+
+def test_tpu_path_batched_preemption():
+    """A high-priority job preempts lower-priority allocs via the vmapped
+    preempt_top_k pass with exact host verification (SURVEY hard part 4;
+    VERDICT r1 next #2 'wire preempt_top_k into SolverPlacer')."""
+    from nomad_tpu.structs import PreemptionConfig
+    h = Harness()
+    cfg = SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU)
+    cfg.preemption_config = PreemptionConfig(
+        service_scheduler_enabled=True, batch_scheduler_enabled=True)
+    h.state.set_scheduler_config(h.get_next_index(), cfg)
+    nodes = []
+    for i in range(3):
+        n = mock.node()
+        n.name = f"pre{i}"
+        n.node_resources.cpu.cpu_shares = 4000
+        n.node_resources.memory.memory_mb = 8192
+        h.state.upsert_node(h.get_next_index(), n)
+        nodes.append(n)
+    # low-priority batch job fills the cluster
+    low = mock.batch_job()
+    low.id = low.name = "low-prio"
+    low.priority = 20
+    tg = low.task_groups[0]
+    tg.count = 9
+    tg.tasks[0].resources.cpu = 1200
+    tg.tasks[0].resources.memory_mb = 2048
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    h.state.upsert_job(h.get_next_index(), low)
+    ev = Evaluation(job_id=low.id, type="batch")
+    h.process(lambda s, p: new_scheduler("batch", s, p), ev)
+    assert len(h.state.allocs_by_job("default", low.id)) == 9
+
+    # high-priority service job needs room only preemption can make
+    high = mock.job()
+    high.id = high.name = "high-prio"
+    high.priority = 90
+    tg2 = high.task_groups[0]
+    tg2.count = 2
+    tg2.tasks[0].resources.cpu = 2000
+    tg2.tasks[0].resources.memory_mb = 4096
+    tg2.tasks[0].resources.networks = []
+    tg2.networks = []
+    h.state.upsert_job(h.get_next_index(), high)
+    ev2 = Evaluation(job_id=high.id, type="service")
+    h.process(lambda s, p: new_scheduler("service", s, p), ev2)
+
+    placed = h.state.allocs_by_job("default", high.id)
+    assert len(placed) == 2, [e.status for e in h.evals]
+    # victims entered the plan as preemptions
+    plan = h.plans[-1]
+    victims = [a for allocs in plan.node_preemptions.values()
+               for a in allocs]
+    assert victims, "no preemptions recorded"
+    assert all(a.job_id == low.id for a in victims)
