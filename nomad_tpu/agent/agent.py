@@ -132,7 +132,8 @@ class Agent:
         return f"http://{self.config.bind_addr}:{self.config.http_port}"
 
     def stats(self) -> dict:
-        out = {}
+        from ..metrics import metrics
+        out = {"telemetry": metrics.snapshot()}
         if self.server is not None:
             out["broker"] = dict(self.server.eval_broker.stats)
             out["blocked_evals"] = dict(self.server.blocked_evals.stats)

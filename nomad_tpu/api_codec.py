@@ -30,6 +30,8 @@ def to_api(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for f in dataclasses.fields(obj):
+            if f.name.startswith("_"):      # internal caches, not API shape
+                continue
             val = getattr(obj, f.name)
             out[pascal(f.name)] = to_api(val)
         return out
@@ -74,6 +76,8 @@ def from_api(cls, data: Any) -> Any:
         hints = get_type_hints(cls)
         lookup = {}
         for f in dataclasses.fields(cls):
+            if f.name.startswith("_") or not f.init:
+                continue
             lookup[pascal(f.name)] = f
             lookup[f.name] = f
         kwargs = {}

@@ -1,0 +1,95 @@
+"""In-process telemetry: counters + latency samples on the scheduler hot
+path (ref nomad/worker.go:461,553 `nomad.worker.invoke_scheduler_*`,
+nomad/plan_apply.go:185,204 `nomad.plan.evaluate`/`nomad.plan.submit`,
+armon/go-metrics used throughout the reference).
+
+A single process-global registry; the agent surfaces it at /v1/metrics and
+bench.py reads it for the per-phase breakdown. Lock-free fast path: CPython
+dict/float ops are atomic enough for monitoring data, and the hot loop
+(50k-alloc plans) must not take a lock per sample.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class _Sample:
+    __slots__ = ("count", "sum", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self.last = 0.0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        self.last = v
+
+    def as_dict(self) -> dict:
+        mean = self.sum / self.count if self.count else 0.0
+        return {"count": self.count, "sum": round(self.sum, 6),
+                "min": round(self.min, 6) if self.count else 0.0,
+                "max": round(self.max, 6), "mean": round(mean, 6),
+                "last": round(self.last, 6)}
+
+
+class Registry:
+    def __init__(self):
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.samples: dict[str, _Sample] = {}
+
+    # ------------------------------------------------------------- writers
+
+    def incr(self, name: str, v: float = 1.0) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + v
+
+    def set_gauge(self, name: str, v: float) -> None:
+        self.gauges[name] = v
+
+    def add_sample(self, name: str, seconds: float) -> None:
+        s = self.samples.get(name)
+        if s is None:
+            s = self.samples[name] = _Sample()
+        s.add(seconds)
+
+    @contextmanager
+    def measure(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_sample(name, time.perf_counter() - t0)
+
+    # ------------------------------------------------------------- readers
+
+    def timer_sum(self, name: str) -> float:
+        s = self.samples.get(name)
+        return s.sum if s else 0.0
+
+    def counter(self, name: str) -> float:
+        return self.counters.get(name, 0.0)
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": {k: self.counters[k] for k in sorted(self.counters)},
+            "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+            "samples": {k: self.samples[k].as_dict()
+                        for k in sorted(self.samples)},
+        }
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.gauges.clear()
+        self.samples.clear()
+
+
+metrics = Registry()

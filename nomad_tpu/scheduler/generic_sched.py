@@ -20,6 +20,7 @@ from ..structs import (
     JOB_TYPE_SERVICE, TRIGGER_MAX_PLANS, TRIGGER_PREEMPTION,
     TRIGGER_RETRY_FAILED_ALLOC, new_id, SCHED_ALG_TPU,
 )
+from ..metrics import metrics
 from .context import EvalContext
 from .reconcile import AllocReconciler, AllocPlaceResult
 from .stack import GenericStack, SelectOptions
@@ -187,7 +188,8 @@ class GenericScheduler:
             eval_id=eval.id,
             eval_priority=eval.priority,
             now=time.time())
-        results = reconciler.compute()
+        with metrics.measure("nomad.scheduler.reconcile"):
+            results = reconciler.compute()
         self.followup_evals = results.desired_followup_evals
 
         if eval.annotate_plan:

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Optional
 
+from ..metrics import metrics
 from ..state import StateStore
 from ..structs import (
     Allocation, NetworkIndex, Plan, PlanResult, allocs_fit,
@@ -121,6 +122,7 @@ class Planner:
     def apply_plan(self, plan: Plan) -> PlanResult:
         """Evaluate against latest state, then commit via the log
         (ref :204 applyPlan / :400 evaluatePlan)."""
+        t0 = time.perf_counter()
         snap = self.state.snapshot_min_index(plan.snapshot_index,
                                             timeout=5.0)
         result = PlanResult(
@@ -140,6 +142,8 @@ class Planner:
                         plan.node_preemptions[node_id]
             else:
                 result.rejected_nodes.append(node_id)
+        # ref plan_apply.go:185 `nomad.plan.evaluate`
+        metrics.add_sample("nomad.plan.evaluate", time.perf_counter() - t0)
 
         if plan.all_at_once and result.rejected_nodes:
             # all-or-nothing (ref structs.go Plan.AllAtOnce)
@@ -166,7 +170,9 @@ class Planner:
             deployment_updates=result.deployment_updates,
             eval_id=plan.eval_id,
         )
-        index = self.raft.apply(APPLY_PLAN_RESULTS, {"result": req})
+        # ref plan_apply.go:204 `nomad.plan.apply` (raft commit + FSM)
+        with metrics.measure("nomad.plan.apply"):
+            index = self.raft.apply(APPLY_PLAN_RESULTS, {"result": req})
         result.alloc_index = index
         return result
 

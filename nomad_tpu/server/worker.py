@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Optional
 
+from ..metrics import metrics
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_FAILED
 from .eval_broker import EvalBroker
@@ -47,10 +48,14 @@ class Worker:
 
     def run(self) -> None:
         while not self._stop.is_set():
+            t0 = time.perf_counter()
             ev, token = self.server.eval_broker.dequeue(
                 self.server.scheduler_types, timeout=DEQUEUE_TIMEOUT)
             if ev is None:
                 continue
+            # ref worker.go:461 `nomad.worker.dequeue_eval`
+            metrics.add_sample("nomad.worker.dequeue_eval",
+                               time.perf_counter() - t0)
             self._eval, self._eval_token = ev, token
             try:
                 self._invoke_scheduler(ev)
@@ -73,10 +78,13 @@ class Worker:
             self.server.core_scheduler.process(ev)
             return
         wait_index = max(ev.modify_index, ev.snapshot_index)
-        self._snapshot = self.server.state.snapshot_min_index(
-            wait_index, timeout=5.0)
+        with metrics.measure("nomad.worker.wait_for_index"):
+            self._snapshot = self.server.state.snapshot_min_index(
+                wait_index, timeout=5.0)
         sched = new_scheduler(ev.type, self._snapshot, self)
-        sched.process(ev)
+        # ref worker.go:553 `nomad.worker.invoke_scheduler_<type>`
+        with metrics.measure(f"nomad.worker.invoke_scheduler_{ev.type}"):
+            sched.process(ev)
 
     # ------------------------------------------------- Planner interface
 
@@ -86,7 +94,8 @@ class Worker:
         plan.snapshot_index = max(plan.snapshot_index,
                                   self._snapshot.latest_index()
                                   if self._snapshot else 0)
-        result = self.server.planner.submit_plan(plan)
+        with metrics.measure("nomad.worker.submit_plan"):
+            result = self.server.planner.submit_plan(plan)
         if result is None:
             return None
         # state refresh hint after rejections (ref worker.go shouldResubmit)

@@ -16,10 +16,11 @@ import random
 import numpy as np
 import jax.numpy as jnp
 
+from ..metrics import metrics
 from ..structs import (
     AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
-    Allocation, AllocDeploymentStatus, DesiredTransition, NetworkIndex,
-    new_id,
+    Allocation, AllocDeploymentStatus, NetworkIndex,
+    new_id, new_ids,
 )
 from ..scheduler.stack import SelectOptions
 from .kernels import fill_greedy_binpack, place_chunked
@@ -70,31 +71,37 @@ class SolverPlacer:
         nodes = sched._ready_nodes
         for tg_name, missings in by_tg.items():
             tg = sched.job.lookup_task_group(tg_name)
-            placed_map = self._solve_group(tg, nodes, len(missings))
+            with metrics.measure("nomad.solver.solve"):
+                placed_map = self._solve_group(tg, nodes, len(missings))
             node_iter = [(node, k) for node, k in placed_map if k > 0]
             # TGs with no sequential resources (ports/devices/cores) need no
             # per-alloc exact pass: stamp out the allocations in one batch
             # with shared (immutable-by-convention) resource/metric objects
-            if node_iter and self._is_simple(tg):
-                mi = self._place_batch_simple(missings, tg, node_iter,
-                                              deployment_id)
-            else:
-                # expand per-node counts into concrete allocations
-                mi = 0
-                for node, k in node_iter:
-                    for _ in range(int(k)):
-                        if mi >= len(missings):
-                            break
-                        missing = missings[mi]
-                        if self._place_one(missing, tg, node, deployment_id):
-                            mi += 1
-                        else:
-                            break  # node rejected exact assignment
+            with metrics.measure("nomad.solver.materialize"):
+                if node_iter and self._is_simple(tg):
+                    mi = self._place_batch_simple(missings, tg, node_iter,
+                                                  deployment_id)
+                else:
+                    # expand per-node counts into concrete allocations
+                    mi = 0
+                    for node, k in node_iter:
+                        for _ in range(int(k)):
+                            if mi >= len(missings):
+                                break
+                            missing = missings[mi]
+                            if self._place_one(missing, tg, node,
+                                               deployment_id):
+                                mi += 1
+                            else:
+                                break  # node rejected exact assignment
             rest = missings[mi:]
             if rest:
                 # capacity exhausted: batched preemption pass (masked
                 # top-k victim selection on device, exact host verify)
-                rest = self._preempt_batch(tg, rest, deployment_id)
+                with metrics.measure("nomad.solver.preempt"):
+                    rest = self._preempt_batch(tg, rest, deployment_id)
+            metrics.incr("nomad.solver.placements_batched",
+                         len(missings) - len(rest))
             leftovers.extend(rest)
 
         # host fallback for anything the batched pass couldn't place
@@ -103,6 +110,8 @@ class SolverPlacer:
         # can see how much work leaves the batched path (VERDICT r1 #2)
         total = len(list(destructive)) + len(list(place))
         sched.solver_stats = {"total": total, "host_fallback": len(leftovers)}
+        metrics.incr("nomad.solver.placements_total", total)
+        metrics.incr("nomad.solver.placements_host_fallback", len(leftovers))
         if leftovers and self.ctx.logger:
             self.ctx.logger(
                 f"solver: eval {sched.eval.id[:8]} fell back to the host "
@@ -176,6 +185,8 @@ class SolverPlacer:
             if aff is not None:
                 aff = np.pad(aff, (0, pad))
         max_per_node = 1 if gt.distinct_hosts else 2 ** 30
+        metrics.incr("nomad.solver.kernel.place_chunked" if use_chunked
+                     else "nomad.solver.kernel.fill_greedy_binpack")
         if use_chunked:
             placed = place_chunked(
                 jnp.asarray(gt.cap), jnp.asarray(gt.used),
@@ -440,51 +451,46 @@ class SolverPlacer:
             if oversub:
                 tr.memory_max_mb = task.resources.memory_max_mb
             total.tasks[task.name] = tr
-        metrics = self.ctx.metrics.copy()
+        metrics_obj = self.ctx.metrics.copy()
         node_allocation = self.plan.node_allocation
 
-        # prototype + per-instance __dict__ copy: a 25-field dataclass
-        # __init__ costs ~7us; stamping 50k allocs from a prototype costs
-        # ~2us each. Per-instance fields (id/name/node/links + the small
-        # mutable containers) are re-set on every copy.
-        proto = Allocation(
-            namespace=sched.eval.namespace,
-            eval_id=sched.eval.id,
-            job_id=sched.eval.job_id,
-            task_group=tg.name,
-            metrics=metrics,
-            deployment_id=deployment_id,
-            allocated_resources=total,
-            desired_status="run",
-            client_status="pending",
-        )
-        proto.job = self.plan.job
-        base = proto.__dict__
-        mi = 0
+        # Allocation is a slots dataclass: 50k instances are ~15MB of slot
+        # storage instead of ~100MB of per-instance dicts, and __init__ is
+        # a straight C-level slot-store loop. Ids are minted in one batch
+        # (one getrandom syscall); names/prev are pre-extracted so the hot
+        # loop does no isinstance checks.
         n_missing = len(missings)
+        ids = new_ids(n_missing)
+        names = [None] * n_missing
+        prevs = [None] * n_missing
+        for i, missing in enumerate(missings):
+            if isinstance(missing, AllocPlaceResult):
+                names[i] = missing.name
+            else:
+                names[i] = missing.place_name
+                prevs[i] = missing.stop_alloc
+        ns = sched.eval.namespace
+        eval_id = sched.eval.id
+        job_id = sched.eval.job_id
+        job = self.plan.job
+        tg_name = tg.name
+        A = Allocation
+        mi = 0
         for node, k in node_iter:
             if mi >= n_missing:
                 break
             bucket = node_allocation.setdefault(node.id, [])
             node_id, node_name = node.id, node.name
             for _ in range(min(int(k), n_missing - mi)):
-                missing = missings[mi]
+                prev = prevs[mi]
+                alloc = A(
+                    id=ids[mi], namespace=ns, eval_id=eval_id,
+                    name=names[mi], node_id=node_id, node_name=node_name,
+                    job_id=job_id, job=job, task_group=tg_name,
+                    allocated_resources=total, metrics=metrics_obj,
+                    deployment_id=deployment_id,
+                    previous_allocation=prev.id if prev is not None else "")
                 mi += 1
-                is_place = isinstance(missing, AllocPlaceResult)
-                alloc = Allocation.__new__(Allocation)
-                d = dict(base)
-                d["id"] = new_id()
-                d["name"] = (missing.name if is_place
-                             else missing.place_name)
-                d["node_id"] = node_id
-                d["node_name"] = node_name
-                d["task_states"] = {}
-                d["desired_transition"] = DesiredTransition()
-                d["preempted_allocations"] = []
-                alloc.__dict__ = d
-                prev = None if is_place else missing.stop_alloc
-                if prev is not None:
-                    alloc.previous_allocation = prev.id
                 bucket.append(alloc)
         return mi
 

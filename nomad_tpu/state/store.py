@@ -937,7 +937,8 @@ class StateStore:
         alloc_updates (stops), alloc_placements, alloc_preemptions,
         deployment, deployment_updates, eval_id, nodes_to_preempt.
         """
-        with self._lock:
+        from ..metrics import metrics
+        with self._lock, metrics.measure("nomad.state.upsert_plan_results"):
             idx = self._bump("allocs", index)
             summary_cache: dict = {}
             now = time.time()
@@ -945,18 +946,35 @@ class StateStore:
                 self._upsert_alloc_locked(idx, alloc,
                                           summary_cache=summary_cache)
             # fresh placements (all client-status pending) aggregate into
-            # one summary bump per (job, tg) instead of 50k copies/updates
+            # one summary bump per (job, tg) instead of 50k copies/updates;
+            # the store writes run inline (no per-alloc function call) with
+            # the index maps and sinks hoisted out of the loop
             fresh_counts: dict[tuple, int] = {}
+            allocs_map = self.allocs
+            by_node = self._allocs_by_node
+            by_job = self._allocs_by_job
+            by_eval = self._allocs_by_eval
+            usage = self.usage
+            sinks = self.event_sinks
             for alloc in result.alloc_placements:   # new placements
                 if alloc.create_time_unix == 0.0:
                     alloc.create_time_unix = now
                 alloc.modify_time_unix = alloc.create_time_unix
-                if alloc.id not in self.allocs and \
+                if alloc.id not in allocs_map and \
                         alloc.client_status == ALLOC_CLIENT_PENDING:
                     key = (alloc.namespace, alloc.job_id, alloc.task_group)
                     fresh_counts[key] = fresh_counts.get(key, 0) + 1
-                    self._upsert_alloc_locked(idx, alloc, fresh=True,
-                                              skip_summary=True)
+                    alloc.create_index = idx
+                    alloc.modify_index = idx
+                    allocs_map[alloc.id] = alloc
+                    by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+                    by_job.setdefault(
+                        (alloc.namespace, alloc.job_id), set()).add(alloc.id)
+                    by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+                    usage.set_alloc(alloc)
+                    if sinks:
+                        self._emit("Allocation", "AllocationUpdated", idx,
+                                   alloc)
                 else:
                     self._upsert_alloc_locked(idx, alloc, fresh=True,
                                               summary_cache=summary_cache)

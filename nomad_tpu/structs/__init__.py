@@ -40,7 +40,7 @@ from .alloc import (  # noqa: F401
     DESC_NODE_TAINTED, DESC_PREEMPTED, filter_terminal_allocs,
 )
 from .eval import (  # noqa: F401
-    Evaluation, new_id,
+    Evaluation, new_id, new_ids,
     EVAL_STATUS_BLOCKED, EVAL_STATUS_PENDING, EVAL_STATUS_COMPLETE,
     EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED,
     TRIGGER_JOB_REGISTER, TRIGGER_JOB_DEREGISTER, TRIGGER_PERIODIC_JOB,

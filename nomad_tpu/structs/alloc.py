@@ -36,7 +36,7 @@ TASK_STATE_RUNNING = "running"
 TASK_STATE_DEAD = "dead"
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocatedTaskResources:
     cpu_shares: int = 0
     reserved_cores: tuple[int, ...] = ()
@@ -55,7 +55,7 @@ class AllocatedTaskResources:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocatedDeviceResource:
     vendor: str = ""
     type: str = ""
@@ -63,19 +63,25 @@ class AllocatedDeviceResource:
     device_ids: list[str] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocatedSharedResources:
     disk_mb: int = 0
     networks: list[NetworkResource] = field(default_factory=list)
     ports: list[dict] = field(default_factory=list)   # AllocatedPortMapping
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocatedResources:
     """Per-task + shared resources actually granted (ref structs.go
     AllocatedResources)."""
     tasks: dict[str, AllocatedTaskResources] = field(default_factory=dict)
     shared: AllocatedSharedResources = field(default_factory=AllocatedSharedResources)
+    # usage-index caches (state/usage_index.py): allocs stamped from one
+    # task group share this object, so the XR row computes once per TG
+    _xr_usage: Optional[tuple] = field(default=None, init=False,
+                                       repr=False, compare=False)
+    _xr_seq: Optional[bool] = field(default=None, init=False,
+                                    repr=False, compare=False)
 
     def comparable(self) -> ComparableResources:
         c = ComparableResources(disk_mb=self.shared.disk_mb,
@@ -85,7 +91,7 @@ class AllocatedResources:
         return c
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskEvent:
     type: str = ""
     time_unix: float = 0.0
@@ -93,7 +99,7 @@ class TaskEvent:
     details: dict[str, str] = field(default_factory=dict)
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskState:
     state: str = TASK_STATE_PENDING
     failed: bool = False
@@ -107,7 +113,7 @@ class TaskState:
         return self.state == TASK_STATE_DEAD and not self.failed
 
 
-@dataclass
+@dataclass(slots=True)
 class RescheduleEvent:
     reschedule_time_unix: float = 0.0
     prev_alloc_id: str = ""
@@ -115,12 +121,12 @@ class RescheduleEvent:
     delay_sec: float = 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class RescheduleTracker:
     events: list[RescheduleEvent] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class DesiredTransition:
     """Server-suggested transitions applied by drainer/scheduler (ref
     structs.go DesiredTransition)."""
@@ -135,7 +141,7 @@ class DesiredTransition:
         return bool(self.force_reschedule)
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocDeploymentStatus:
     healthy: Optional[bool] = None
     timestamp_unix: float = 0.0
@@ -149,14 +155,14 @@ class AllocDeploymentStatus:
         return self.healthy is False
 
 
-@dataclass
+@dataclass(slots=True)
 class NetworkStatus:
     interface_name: str = ""
     address: str = ""
     dns: Optional[dict] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class Allocation:
     id: str = ""
     namespace: str = "default"

@@ -54,6 +54,16 @@ def new_id() -> str:
             f"{'89ab'[int(h[16], 16) & 3]}{h[17:20]}-{h[20:]}")
 
 
+def new_ids(n: int) -> list[str]:
+    """n random ids from ONE urandom read — the mass-placement path mints
+    ids in batch to avoid n getrandom syscalls."""
+    h = os.urandom(16 * n).hex()
+    vr = "89ab"
+    return [f"{s[:8]}-{s[8:12]}-4{s[13:16]}-"
+            f"{vr[int(s[16], 16) & 3]}{s[17:20]}-{s[20:]}"
+            for s in (h[i:i + 32] for i in range(0, 32 * n, 32))]
+
+
 @dataclass
 class Evaluation:
     id: str = field(default_factory=new_id)
