@@ -218,6 +218,8 @@ def main() -> None:
     total_pl = metrics.counter("nomad.solver.placements_total")
     kernel = ("place_chunked"
               if metrics.counter("nomad.solver.kernel.place_chunked")
+              else "fill_depth"
+              if metrics.counter("nomad.solver.kernel.fill_depth")
               else "fill_greedy_binpack")
 
     # host-oracle comparison (same end-to-end path, binpack stack).
@@ -354,7 +356,7 @@ def config3() -> dict:
     ask[0], ask[1] = 100.0, 128.0
     racks = rng.integers(0, 100, n_nodes)          # spread property: rack
     solve = jax.jit(lambda *a: place_chunked(
-        *a, max_per_node=8, max_steps=256))        # distinct-ish cap
+        *a, max_per_node=8, max_steps=256)[0])     # distinct-ish cap
     value, counts = _bench(
         solve, cap, used, ask, jnp.int32(n_tasks), feas,
         np.zeros(n_nodes, np.int32), jnp.int32(n_tasks),

@@ -79,11 +79,20 @@ class Registry:
         return self.counters.get(name, 0.0)
 
     def snapshot(self) -> dict:
+        # lock-free writers can insert a first-seen key mid-iteration;
+        # retry the copy rather than taking a lock on the hot path
+        for _ in range(16):
+            try:
+                counters = dict(self.counters)
+                gauges = dict(self.gauges)
+                samples = dict(self.samples)
+                break
+            except RuntimeError:
+                continue
         return {
-            "counters": {k: self.counters[k] for k in sorted(self.counters)},
-            "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
-            "samples": {k: self.samples[k].as_dict()
-                        for k in sorted(self.samples)},
+            "counters": {k: counters[k] for k in sorted(counters)},
+            "gauges": {k: gauges[k] for k in sorted(gauges)},
+            "samples": {k: samples[k].as_dict() for k in sorted(samples)},
         }
 
     def reset(self) -> None:

@@ -28,7 +28,7 @@ from .reconcile_util import (
 DESC_DEPLOYMENT_CANCELLED = "cancelled because job is stopped or newer version"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class AllocPlaceResult:
     """One placement the scheduler must make (ref reconcile_util.go
     allocPlaceResult)."""
@@ -42,7 +42,7 @@ class AllocPlaceResult:
     min_job_version: int = 0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class AllocStopResult:
     alloc: Allocation
     client_status: str = ""
@@ -50,7 +50,7 @@ class AllocStopResult:
     follow_up_eval_id: str = ""
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class AllocDestructiveResult:
     place_name: str
     place_task_group: TaskGroup

@@ -226,6 +226,12 @@ class AllocNameIndex:
 
     def next(self, n: int) -> list[str]:
         """Next n free names within [0, count), overflowing past count."""
+        if not self.used:
+            # fresh job: every index is free — mint in one comprehension
+            # (a 50k-instance job calls this once with n == count)
+            prefix = f"{self.job_id}.{self.task_group}["
+            self.used.update(range(n))
+            return [f"{prefix}{i}]" for i in range(n)]
         out: list[str] = []
         for idx in range(self.count):
             if len(out) == n:

@@ -44,9 +44,10 @@ def _score_capacity_kernel(cap_ref, used_ref, ask_ref, out_ref,
     capacity = jnp.max(jnp.min(per_dim, axis=0, keepdims=True), initial=0.0,
                        axis=0, keepdims=True)      # [1, TILE_N], clamp >= 0
 
-    # score from cpu (row 0) + mem (row 1) free fractions (funcs.go:236)
+    # score from cpu (row 0) + mem (row 1) free fractions with the
+    # candidate instance included (funcs.go:236, rank.go:479)
     safe_cap = jnp.where(cap[:2] > 0.0, cap[:2], 1.0)
-    free_pct = 1.0 - used[:2] / safe_cap
+    free_pct = 1.0 - (used[:2] + ask[:2]) / safe_cap
     total = jnp.sum(jnp.power(10.0, free_pct), axis=0, keepdims=True)
     raw = (total - 2.0) if spread else (20.0 - total)
     score = jnp.clip(raw, 0.0, BINPACK_MAX_SCORE)  # [1, TILE_N]
