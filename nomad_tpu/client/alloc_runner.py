@@ -315,6 +315,19 @@ class AllocRunner:
                 setup_error = f"device reservation failed: {e}"
                 self.client.logger(setup_error)
 
+        # artifact hook: download declared artifacts into the task dir
+        # before start; a failure fails setup like the reference's
+        # recoverable prestart error (ref taskrunner/artifact_hook.go)
+        if task.artifacts and not setup_error:
+            from .artifact import ArtifactError, fetch_artifact
+            for art in task.artifacts:
+                try:
+                    fetch_artifact(art, task_dir)
+                except ArtifactError as e:
+                    setup_error = f"artifact download failed: {e}"
+                    self.client.logger(setup_error)
+                    break
+
         rendered: list[tuple[str, str, str]] = []
         # vault hook: derive a task token, expose VAULT_TOKEN + the
         # secrets/vault_token file (ref taskrunner/vault_hook.go)

@@ -65,6 +65,12 @@ class Client:
         self._alloc_versions: dict[str, int] = {}   # alloc_id -> modify_index
         self._last_alloc_index = 0
         self._heartbeat_ttl = 10.0
+        # heartbeat-stop (ref client/heartbeatstop.go): allocs whose TG
+        # sets stop_after_client_disconnect are stopped LOCALLY when the
+        # client has been unable to heartbeat for that long — the client
+        # half of the server-side lost-alloc handling
+        # (reconcile_util.delay_by_stop_after_client_disconnect)
+        self._last_heartbeat_ok = time.time()
         self._shutdown = threading.Event()
         self._dirty_allocs: set[str] = set()
         self._dirty_cond = threading.Condition()
@@ -78,6 +84,8 @@ class Client:
         for target, name in ((self._heartbeat_loop, "client-heartbeat"),
                              (self._watch_allocations, "client-watch-allocs"),
                              (self._sync_allocs_loop, "client-alloc-sync"),
+                             (self._heartbeat_stop_loop,
+                              "client-heartbeat-stop"),
                              (self._gc_loop, "client-gc")):
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
@@ -122,6 +130,7 @@ class Client:
                                                    NODE_STATUS_READY)
                 self._heartbeat_ttl = resp.get("heartbeat_ttl",
                                                self._heartbeat_ttl)
+                self._last_heartbeat_ok = time.time()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: heartbeat failed: {e!r}")
                 # re-register: the server may have GC'd us
@@ -131,6 +140,36 @@ class Client:
                                                 NODE_STATUS_READY)
                 except Exception:       # noqa: BLE001
                     pass
+
+    def _heartbeat_stop_loop(self) -> None:
+        """Stop allocs locally after prolonged server disconnection (ref
+        client/heartbeatstop.go watch): a TG opting in via
+        stop_after_client_disconnect must not keep running on a
+        partitioned node past that grace — the server will have replaced
+        it, and two live copies of (say) a singleton service is exactly
+        what the knob exists to prevent."""
+        while not self._shutdown.wait(1.0):
+            silence = time.time() - self._last_heartbeat_ok
+            if silence <= self._heartbeat_ttl:
+                continue
+            with self._lock:
+                runners = list(self.alloc_runners.values())
+            for ar in runners:
+                alloc = ar.alloc
+                job = alloc.job
+                tg = job.lookup_task_group(alloc.task_group) if job else None
+                if tg is None or tg.stop_after_client_disconnect_sec is None:
+                    continue
+                if silence <= tg.stop_after_client_disconnect_sec:
+                    continue
+                if alloc.terminal_status():
+                    continue
+                self.logger(
+                    f"client: stopping alloc {alloc.id[:8]} after "
+                    f"{silence:.0f}s without a successful heartbeat "
+                    f"(stop_after_client_disconnect)")
+                for tr in list(ar.task_runners.values()):
+                    tr.kill("client disconnected from servers")
 
     # --------------------------------------------------------- alloc watch
 

@@ -42,14 +42,6 @@ def _memory_mb() -> int:
     return 1024
 
 
-def _disk_mb(path: str) -> int:
-    try:
-        usage = shutil.disk_usage(path)
-        return usage.free // (1024 * 1024)
-    except OSError:
-        return 10 * 1024
-
-
 def _host_ip() -> str:
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -61,43 +53,212 @@ def _host_ip() -> str:
         return "127.0.0.1"
 
 
+# --------------------------------------------------------- fingerprinters
+#
+# One function per concern, the reference's registry shape
+# (client/fingerprint/fingerprint.go hostFingerprinters): each takes the
+# node + a config dict and merges attributes/resources/links in. All are
+# best-effort — a fingerprinter that can't read its source contributes
+# nothing, it never fails node startup.
+
+def fp_arch(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/arch.go"""
+    node.attributes["arch"] = platform.machine()
+
+
+def fp_cpu(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/cpu.go"""
+    cpu_mhz, cores = _cpu_mhz_total()
+    node.attributes["cpu.numcores"] = str(cores)
+    node.attributes["cpu.totalcompute"] = str(cpu_mhz)
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    node.attributes["cpu.modelname"] = \
+                        line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    node.node_resources.cpu = NodeCpuResources(
+        cpu_shares=cpu_mhz, total_core_count=cores,
+        reservable_cores=list(range(cores)))
+
+
+def fp_memory(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/memory.go"""
+    mb = _memory_mb()
+    node.attributes["memory.totalbytes"] = str(mb * 1024 * 1024)
+    node.node_resources.memory = NodeMemoryResources(memory_mb=mb)
+
+
+def fp_storage(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/storage.go: free/total bytes of the alloc
+    dir's volume."""
+    path = cfg.get("data_dir", "/tmp")
+    try:
+        usage = shutil.disk_usage(path)
+    except OSError:
+        return
+    node.attributes["unique.storage.volume"] = path
+    node.attributes["unique.storage.bytestotal"] = str(usage.total)
+    node.attributes["unique.storage.bytesfree"] = str(usage.free)
+    node.node_resources.disk = NodeDiskResources(
+        disk_mb=usage.free // (1024 * 1024))
+
+
+def fp_host(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/host.go"""
+    node.attributes["kernel.name"] = platform.system().lower()
+    node.attributes["kernel.version"] = platform.release()
+    node.attributes["os.name"] = platform.system().lower()
+    node.attributes["os.version"] = platform.version()
+    node.attributes["unique.hostname"] = platform.node()
+
+
+def fp_nomad(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/nomad.go"""
+    node.attributes["nomad.version"] = __version__
+    node.attributes["nomad.revision"] = "tpu"
+
+
+def fp_signal(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/signal.go: signals drivers can deliver."""
+    import signal as _signal
+    names = sorted(s.name for s in _signal.Signals
+                   if s.name.startswith("SIG") and
+                   not s.name.startswith("SIGRT"))
+    node.attributes["os.signals"] = ",".join(names)
+
+
+def fp_cgroup(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/cgroup_linux.go: cgroup mount + version."""
+    if os.path.isdir("/sys/fs/cgroup"):
+        v2 = os.path.exists("/sys/fs/cgroup/cgroup.controllers")
+        node.attributes["unique.cgroup.mountpoint"] = "/sys/fs/cgroup"
+        node.attributes["unique.cgroup.version"] = "v2" if v2 else "v1"
+
+
+def fp_bridge(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/bridge_linux.go: bridge kernel module."""
+    for probe in ("/sys/module/bridge",
+                  "/proc/sys/net/bridge"):
+        if os.path.exists(probe):
+            node.attributes["plugins.cni.version.bridge"] = "host"
+            node.attributes["nomad.bridge.available"] = "true"
+            return
+
+
+def fp_network(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/network.go: default-route interface + all
+    link-up interfaces from /sys/class/net with speeds."""
+    ip = _host_ip()
+    node.attributes["unique.network.ip-address"] = ip
+    dev, speed = "eth0", 1000
+    try:
+        ifaces = sorted(os.listdir("/sys/class/net"))
+    except OSError:
+        ifaces = []
+    up = []
+    for i in ifaces:
+        if i == "lo":
+            continue
+        try:
+            with open(f"/sys/class/net/{i}/operstate") as f:
+                state = f.read().strip()
+        except OSError:
+            state = "unknown"
+        if state not in ("up", "unknown"):
+            continue
+        mbits = 1000
+        try:
+            with open(f"/sys/class/net/{i}/speed") as f:
+                mbits = max(int(f.read().strip()), 0) or 1000
+        except (OSError, ValueError):
+            pass
+        up.append((i, mbits))
+    if up:
+        dev, speed = up[0]
+    node.attributes["unique.network.interface"] = dev
+    node.node_resources.networks = [NetworkResource(
+        device=dev, ip=ip, cidr=f"{ip}/32", mbits=speed)]
+    node.node_resources.node_networks = [NodeNetworkResource(
+        mode="host", device=dev, speed=speed,
+        addresses=[{"alias": "default", "address": ip}])]
+
+
+def _metadata_get(url: str, headers: dict, timeout: float) -> str:
+    import urllib.request
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def fp_cloud_env(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/env_aws.go / env_gce.go / env_azure.go:
+    probe the cloud metadata service with a short timeout; absence is
+    normal (bare metal / air-gapped). `cfg['metadata_get']` is injectable
+    for tests."""
+    get = cfg.get("metadata_get", _metadata_get)
+    timeout = float(cfg.get("metadata_timeout", 0.2))
+    probes = [
+        ("aws", "http://169.254.169.254/latest/meta-data/",
+         {}, [("instance-type", "platform.aws.instance-type"),
+              ("placement/availability-zone", "platform.aws.placement.availability-zone"),
+              ("local-ipv4", "unique.platform.aws.local-ipv4")]),
+        ("gce", "http://169.254.169.254/computeMetadata/v1/instance/",
+         {"Metadata-Flavor": "Google"},
+         [("machine-type", "platform.gce.machine-type"),
+          ("zone", "platform.gce.zone"),
+          ("hostname", "unique.platform.gce.hostname")]),
+    ]
+    for name, base, headers, keys in probes:
+        try:
+            for path, attr in keys:
+                node.attributes[attr] = get(base + path, headers,
+                                            timeout).strip()
+            node.attributes["platform"] = name
+            return                       # first cloud that answers wins
+        except Exception:                # noqa: BLE001 - not on this cloud
+            for _, attr in keys:
+                node.attributes.pop(attr, None)
+
+
+FINGERPRINTERS = [
+    ("arch", fp_arch),
+    ("cpu", fp_cpu),
+    ("memory", fp_memory),
+    ("storage", fp_storage),
+    ("host", fp_host),
+    ("nomad", fp_nomad),
+    ("signal", fp_signal),
+    ("cgroup", fp_cgroup),
+    ("bridge", fp_bridge),
+    ("network", fp_network),
+    ("cloud_env", fp_cloud_env),
+]
+
+
 def fingerprint_node(data_dir: str = "/tmp", datacenter: str = "dc1",
                      node_class: str = "", name: str = "",
-                     node_id: str = "") -> Node:
-    """Assemble a Node from host fingerprints (ref
+                     node_id: str = "", cfg: dict | None = None) -> Node:
+    """Assemble a Node by running every fingerprinter (ref
     client/fingerprint_manager.go + client.go:1462
     updateNodeFromFingerprint)."""
-    cpu_mhz, cores = _cpu_mhz_total()
-    ip = _host_ip()
+    cfg = dict(cfg or {})
+    cfg.setdefault("data_dir", data_dir)
     node = Node(
         id=node_id or str(uuid.uuid4()),
         name=name or platform.node() or "node",
         datacenter=datacenter,
         node_class=node_class,
-        attributes={
-            "kernel.name": platform.system().lower(),
-            "kernel.version": platform.release(),
-            "arch": platform.machine(),
-            "os.name": platform.system().lower(),
-            "cpu.numcores": str(cores),
-            "cpu.totalcompute": str(cpu_mhz),
-            "memory.totalbytes": str(_memory_mb() * 1024 * 1024),
-            "nomad.version": __version__,
-            "unique.hostname": platform.node(),
-            "unique.network.ip-address": ip,
-        },
-        node_resources=NodeResources(
-            cpu=NodeCpuResources(cpu_shares=cpu_mhz, total_core_count=cores,
-                                 reservable_cores=list(range(cores))),
-            memory=NodeMemoryResources(memory_mb=_memory_mb()),
-            disk=NodeDiskResources(disk_mb=_disk_mb(data_dir)),
-            networks=[NetworkResource(device="eth0", ip=ip,
-                                      cidr=f"{ip}/32", mbits=1000)],
-            node_networks=[NodeNetworkResource(
-                mode="host", device="eth0", speed=1000,
-                addresses=[{"alias": "default", "address": ip}])],
-        ),
+        node_resources=NodeResources(),
     )
+    for fp_name, fp in FINGERPRINTERS:
+        try:
+            fp(node, cfg)
+        except Exception:                # noqa: BLE001 - best-effort
+            pass
     return node
 
 
