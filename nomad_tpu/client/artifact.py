@@ -33,6 +33,16 @@ _ARCHIVE_EXTS = (".tar.gz", ".tgz", ".tar.bz2", ".tbz2", ".tar.xz",
                  ".txz", ".tar", ".zip")
 
 
+def _confined(root: str, target: str) -> bool:
+    """True when realpath(target) stays inside realpath(root) — the one
+    sandbox rule for destinations, tar members/links and zip members
+    (sibling-prefix dirs like root + '-evil' must not pass)."""
+    root_real = os.path.realpath(root)
+    target_real = os.path.realpath(target)
+    return target_real == root_real or \
+        target_real.startswith(root_real + os.sep)
+
+
 def _verify_checksum(path: str, spec: str) -> None:
     """spec: '<algo>:<hexdigest>' (go-getter checksum option)."""
     try:
@@ -57,17 +67,13 @@ def _is_archive(name: str) -> bool:
 
 
 def _safe_extract_tar(tf: tarfile.TarFile, dest: str) -> None:
-    dest_real = os.path.realpath(dest)
     for member in tf.getmembers():
-        target = os.path.realpath(os.path.join(dest, member.name))
-        if not target.startswith(dest_real + os.sep) and target != dest_real:
+        if not _confined(dest, os.path.join(dest, member.name)):
             raise ArtifactError(f"archive member escapes dest: {member.name}")
         if member.islnk() or member.issym():
-            link_target = os.path.realpath(
-                os.path.join(dest, os.path.dirname(member.name),
-                             member.linkname))
-            if link_target != dest_real and \
-                    not link_target.startswith(dest_real + os.sep):
+            link = os.path.join(dest, os.path.dirname(member.name),
+                                member.linkname)
+            if not _confined(dest, link):
                 raise ArtifactError(
                     f"archive link escapes dest: {member.name}")
     tf.extractall(dest, filter="data")
@@ -77,11 +83,8 @@ def _unpack(path: str, dest: str) -> None:
     name = path.lower()
     if name.endswith(".zip"):
         with zipfile.ZipFile(path) as zf:
-            dest_real = os.path.realpath(dest)
             for member in zf.namelist():
-                target = os.path.realpath(os.path.join(dest, member))
-                if target != dest_real and \
-                        not target.startswith(dest_real + os.sep):
+                if not _confined(dest, os.path.join(dest, member)):
                     raise ArtifactError(
                         f"archive member escapes dest: {member}")
             zf.extractall(dest)
@@ -109,47 +112,53 @@ def fetch_artifact(artifact, task_dir: str, timeout: float = 30.0) -> str:
     # and ../ traversal must not write outside the sandbox
     dest = os.path.realpath(
         os.path.join(task_dir, dest_rel.lstrip("/")))
-    task_real = os.path.realpath(task_dir)
-    if dest != task_real and not dest.startswith(task_real + os.sep):
+    if not _confined(task_dir, dest):
         raise ArtifactError(
             f"artifact destination escapes the task dir: {dest_rel!r}")
-    os.makedirs(dest, exist_ok=True)
 
     parsed = urllib.parse.urlparse(source)
     fname = os.path.basename(parsed.path or source) or "artifact"
     staging = os.path.join(dest, fname)
 
-    if parsed.scheme in ("http", "https"):
-        try:
-            with urllib.request.urlopen(source, timeout=timeout) as resp, \
-                    open(staging, "wb") as out:
-                shutil.copyfileobj(resp, out)
-        except Exception as e:        # noqa: BLE001 - network/protocol
-            raise ArtifactError(f"fetch {source!r} failed: {e}") from e
-    elif parsed.scheme in ("", "file"):
-        src_path = parsed.path if parsed.scheme == "file" else source
-        if not os.path.exists(src_path):
-            raise ArtifactError(f"artifact source not found: {src_path}")
-        shutil.copy2(src_path, staging)
-    else:
-        raise ArtifactError(f"unsupported artifact scheme {parsed.scheme!r}")
-
-    checksum = opts.get("checksum", "")
-    if checksum:
-        _verify_checksum(staging, checksum)
-
-    unpack = _is_archive(fname) and \
-        str(opts.get("archive", "true")).lower() != "false"
-    if unpack:
-        try:
-            _unpack(staging, dest)
-        except (tarfile.TarError, zipfile.BadZipFile, OSError) as e:
-            raise ArtifactError(f"unpack {fname!r} failed: {e}") from e
-    else:
-        mode = opts.get("mode", "")
-        if mode:
+    try:
+        os.makedirs(dest, exist_ok=True)
+        if parsed.scheme in ("http", "https"):
             try:
-                os.chmod(staging, int(mode, 8))
-            except (ValueError, OSError):
-                pass
+                with urllib.request.urlopen(source, timeout=timeout) \
+                        as resp, open(staging, "wb") as out:
+                    shutil.copyfileobj(resp, out)
+            except Exception as e:    # noqa: BLE001 - network/protocol
+                raise ArtifactError(f"fetch {source!r} failed: {e}") from e
+        elif parsed.scheme in ("", "file"):
+            src_path = parsed.path if parsed.scheme == "file" else source
+            if not os.path.exists(src_path):
+                raise ArtifactError(f"artifact source not found: {src_path}")
+            shutil.copy2(src_path, staging)
+        else:
+            raise ArtifactError(
+                f"unsupported artifact scheme {parsed.scheme!r}")
+
+        checksum = opts.get("checksum", "")
+        if checksum:
+            _verify_checksum(staging, checksum)
+
+        unpack = _is_archive(fname) and \
+            str(opts.get("archive", "true")).lower() != "false"
+        if unpack:
+            try:
+                _unpack(staging, dest)
+            except (tarfile.TarError, zipfile.BadZipFile) as e:
+                raise ArtifactError(f"unpack {fname!r} failed: {e}") from e
+        else:
+            mode = opts.get("mode", "")
+            if mode:
+                try:
+                    os.chmod(staging, int(mode, 8))
+                except ValueError:
+                    pass
+    except OSError as e:
+        # directory-as-source, dest path collisions, ENOSPC, stale
+        # mounts ... all become recoverable setup failures — an escaped
+        # OSError would kill the alloc-runner thread and strand the alloc
+        raise ArtifactError(f"artifact io error: {e}") from e
     return dest

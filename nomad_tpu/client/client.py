@@ -70,7 +70,7 @@ class Client:
         # client has been unable to heartbeat for that long — the client
         # half of the server-side lost-alloc handling
         # (reconcile_util.delay_by_stop_after_client_disconnect)
-        self._last_heartbeat_ok = time.time()
+        self._last_heartbeat_ok = time.monotonic()
         self._shutdown = threading.Event()
         self._dirty_allocs: set[str] = set()
         self._dirty_cond = threading.Condition()
@@ -130,7 +130,7 @@ class Client:
                                                    NODE_STATUS_READY)
                 self._heartbeat_ttl = resp.get("heartbeat_ttl",
                                                self._heartbeat_ttl)
-                self._last_heartbeat_ok = time.time()
+                self._last_heartbeat_ok = time.monotonic()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: heartbeat failed: {e!r}")
                 # re-register: the server may have GC'd us
@@ -149,7 +149,7 @@ class Client:
         it, and two live copies of (say) a singleton service is exactly
         what the knob exists to prevent."""
         while not self._shutdown.wait(1.0):
-            silence = time.time() - self._last_heartbeat_ok
+            silence = time.monotonic() - self._last_heartbeat_ok
             if silence <= self._heartbeat_ttl:
                 continue
             with self._lock:

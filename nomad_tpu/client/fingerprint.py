@@ -98,13 +98,13 @@ def fp_storage(node: Node, cfg: dict) -> None:
     path = cfg.get("data_dir", "/tmp")
     try:
         usage = shutil.disk_usage(path)
+        free_mb = usage.free // (1024 * 1024)
+        node.attributes["unique.storage.volume"] = path
+        node.attributes["unique.storage.bytestotal"] = str(usage.total)
+        node.attributes["unique.storage.bytesfree"] = str(usage.free)
     except OSError:
-        return
-    node.attributes["unique.storage.volume"] = path
-    node.attributes["unique.storage.bytestotal"] = str(usage.total)
-    node.attributes["unique.storage.bytesfree"] = str(usage.free)
-    node.node_resources.disk = NodeDiskResources(
-        disk_mb=usage.free // (1024 * 1024))
+        free_mb = 10 * 1024     # keep the node schedulable (stale mount)
+    node.node_resources.disk = NodeDiskResources(disk_mb=free_mb)
 
 
 def fp_host(node: Node, cfg: dict) -> None:

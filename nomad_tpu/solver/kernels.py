@@ -176,24 +176,28 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     # decorrelate like the host's samplers while better nodes still
     # lead on average.
     if order_jitter is not None:
-        # Emulate the host's sampling dynamics with a geometric ARRIVAL
-        # model: a node becomes usable once one of the eval's `count`
-        # 2-way draws samples it — first-sample time ~ Geometric(2/n),
-        # i.e. arrival a = -log(U) * n / (2*count) in units of the whole
-        # eval. Order = score rank + arrival: when count >~ n every node
-        # arrives early and score order dominates (the reference is
-        # near-deterministic there); when n >> count arrivals spread
-        # wide and the order randomizes (sampling-limited), which is
-        # what decorrelates concurrent workers.
+        # Emulate the host's 2-way sampling (stack.go:71,84) with an
+        # Efraimidis-Spirakis weighted random order: key = log(U)/w_r,
+        # w_r = ((2(n-r)+1))^g over score rank r. g=1 is the exact
+        # best-of-2 single-draw law — the right model when each node is
+        # sampled at most ~once per eval (n >> count), which is what
+        # decorrelates concurrent workers planning from one snapshot.
+        # As count/n grows the host re-samples every node many times and
+        # its outcome concentrates on the true best nodes, so the placer
+        # raises g (sharper selection) with the expected samples-per-node
+        # m = 2*count/n. Depths stay density-optimal either way.
         fin = jnp.isfinite(d_star)
         rank = jnp.argsort(jnp.argsort(-d_star))        # 0 = best density
         n_fin = jnp.maximum(jnp.sum(fin), 1)
+        # E-S order: max u^(1/w), w = (2(n-r)+1)^g. Computed in LOG space
+        # — w itself overflows float32 beyond ~32k nodes at g=8, which
+        # would collapse every key to -0.0 and silently de-randomize the
+        # order: argmax u^(1/w) == argmin log(-log u) - g*log(2(n-r)+1).
+        base_w = 2.0 * (n_fin - rank).astype(jnp.float32) + 1.0
         u = jnp.clip(order_jitter, 1e-9, 1.0 - 1e-9)
-        arrival = -jnp.log(u) * n_fin.astype(jnp.float32) / \
-            (2.0 * jnp.maximum(count, 1))
-        key = rank.astype(jnp.float32) / n_fin + jitter_scale * arrival
+        key = jnp.log(-jnp.log(u)) - jitter_scale * jnp.log(base_w)
         key = jnp.where(fin, key, jnp.inf)
-        order = jnp.argsort(key)
+        order = jnp.argsort(key)                        # smaller = earlier
     else:
         order = jnp.argsort(-d_star)
     ks = k_star[order]

@@ -12,7 +12,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .kernels import fill_greedy_binpack
+from .kernels import (
+    fill_depth, fill_greedy_binpack, place_chunked, preempt_top_k,
+)
 
 
 def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
@@ -26,7 +28,7 @@ def sharded_fill_greedy(mesh: Mesh, axis: str = "nodes"):
 
     The argsort/cumsum over the node axis become XLA collectives; everything
     else stays node-local. Returns a function (cap, used, ask, count,
-    feasible) -> placements i32[N]."""
+    feasible, max_per_node) -> placements i32[N]."""
     node_sharded = NamedSharding(mesh, P(axis, None))
     vec_sharded = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
@@ -34,8 +36,68 @@ def sharded_fill_greedy(mesh: Mesh, axis: str = "nodes"):
     return jax.jit(
         fill_greedy_binpack,
         in_shardings=(node_sharded, node_sharded, replicated, replicated,
-                      vec_sharded),
+                      vec_sharded, replicated),
         out_shardings=vec_sharded)
+
+
+def sharded_place_chunked(mesh: Mesh, axis: str = "nodes",
+                          max_steps: int = 64):
+    """place_chunked with the node axis sharded: the lax.scan carries
+    node-sharded running usage/placement state; the per-step top_k and
+    scatter-add over the node axis lower to GSPMD collectives
+    (all-gather of the k winners, node-local updates otherwise)."""
+    nd = NamedSharding(mesh, P(axis, None))          # [N, R']
+    nv = NamedSharding(mesh, P(axis))                # [N]
+    sn = NamedSharding(mesh, P(None, axis))          # [S, N] / [D, N]
+    rep = NamedSharding(mesh, P())
+
+    def run(cap, used, ask, count, feasible, job_collisions, desired,
+            sp_ids, sp_counts, sp_desired, sp_mode, sp_weights, aff,
+            dp_ids, dp_remaining):
+        out, _, _, _ = place_chunked(
+            cap, used, ask, count, feasible, job_collisions, desired,
+            sp_ids, sp_counts, sp_desired, sp_mode, sp_weights, aff,
+            dp_ids, dp_remaining, max_steps=max_steps)
+        return out
+
+    return jax.jit(
+        run,
+        in_shardings=(nd, nd, rep, rep, nv, nv, rep,
+                      sn, rep, rep, rep, rep, nv, sn, rep),
+        out_shardings=nv)
+
+
+def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16):
+    """fill_depth with the node axis sharded: the [N, K] score-curve and
+    cumsum stay node-local; the density argsort + global cumsum over the
+    chosen depths become cross-shard collectives."""
+    nd = NamedSharding(mesh, P(axis, None))
+    nv = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    def run(cap, used, ask, count, feasible, job_collisions, desired, aff):
+        return fill_depth(cap, used, ask, count, feasible, job_collisions,
+                          desired, aff, k_max=k_max)
+
+    return jax.jit(run,
+                   in_shardings=(nd, nd, rep, rep, nv, nv, rep, nv),
+                   out_shardings=nv)
+
+
+def sharded_preempt_top_k(mesh: Mesh, axis: str = "nodes"):
+    """Batched preemption victim selection with the CANDIDATE-NODE axis
+    sharded: each shard runs its nodes' masked top-k victim scans
+    locally — embarrassingly parallel, no collectives beyond the final
+    gather of masks."""
+    cd = NamedSharding(mesh, P(axis, None, None))    # [C, V, R']
+    cv = NamedSharding(mesh, P(axis, None))          # [C, V]
+    cf = NamedSharding(mesh, P(axis, None))          # [C, R']
+    rep = NamedSharding(mesh, P())
+
+    batched = jax.vmap(preempt_top_k, in_axes=(0, 0, None, 0, None))
+    return jax.jit(batched,
+                   in_shardings=(cd, cv, rep, cf, rep),
+                   out_shardings=cv)
 
 
 def sharded_eval_batch_fill_greedy(mesh: Mesh, node_axis: str = "nodes",

@@ -204,7 +204,7 @@ def test_stop_after_client_disconnect_stops_alloc(cluster):
     client.rpc.node_update_status = \
         lambda *a, **k: (_ for _ in ()).throw(ConnectionError("partition"))
     client._heartbeat_ttl = 0.3
-    client._last_heartbeat_ok = time.time()
+    client._last_heartbeat_ok = time.monotonic()
     try:
         assert wait_until(lambda: all(
             ts.state == "dead" for ts in ar.alloc.task_states.values()),
@@ -225,7 +225,7 @@ def test_alloc_without_optin_survives_disconnect(cluster):
     client.rpc.node_update_status = \
         lambda *a, **k: (_ for _ in ()).throw(ConnectionError("partition"))
     client._heartbeat_ttl = 0.3
-    client._last_heartbeat_ok = time.time() - 30.0
+    client._last_heartbeat_ok = time.monotonic() - 30.0
     try:
         time.sleep(2.5)
         assert any(ts.state == "running"

@@ -259,7 +259,7 @@ def test_sharded_fill_greedy_on_8_device_mesh():
     count = 2000
     got = np.asarray(solve(jnp.asarray(cap), jnp.asarray(used),
                            jnp.asarray(ask), jnp.int32(count),
-                           jnp.asarray(feas)))
+                           jnp.asarray(feas), jnp.int32(2 ** 30)))
     want = np.asarray(fill_greedy_binpack(
         jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
         jnp.int32(count), jnp.asarray(feas)))
