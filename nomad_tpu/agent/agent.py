@@ -28,11 +28,15 @@ class AgentConfig:
     client_enabled: bool = True
     num_workers: int = 2
     region: str = "global"
+    authoritative_region: str = ""     # ACL replication source region
     datacenter: str = "dc1"
     node_class: str = ""
     node_name: str = ""
     dev_mode: bool = False
     acl_enabled: bool = False
+    gossip_port: int = -1              # -1 = gossip off; 0 = any port
+    join: tuple = ()                   # gossip seed "host:port" addrs
+    bootstrap: bool = True             # False: wait for raft adoption
 
     def key_bytes(self) -> bytes:
         from ..rpc.server import DEFAULT_KEY
@@ -59,9 +63,13 @@ class Agent:
 
         self._server_rpc = None
         if self.config.server_enabled:
-            self.server = Server(num_workers=self.config.num_workers,
-                                 logger=self.logger,
-                                 acl_enabled=self.config.acl_enabled)
+            self.server = Server(
+                num_workers=self.config.num_workers,
+                logger=self.logger,
+                acl_enabled=self.config.acl_enabled,
+                region=self.config.region,
+                authoritative_region=self.config.authoritative_region,
+                name=self.config.node_name or "")
         if self.config.client_enabled:
             if self.server is not None:
                 rpc = self.server       # in-process fast path (-dev)
@@ -91,11 +99,28 @@ class Agent:
                 # check by speaking RPC directly
                 raise ValueError(
                     "acl_enabled with network RPC requires encrypt_key")
-            self.server.start()
             if self.config.rpc_port >= 0:
                 self.server.rpc_listen(self.config.bind_addr,
                                        self.config.rpc_port,
                                        key=self.config.key_bytes())
+            if self.config.gossip_port >= 0:
+                # gossiping agents MUST run real consensus: without it
+                # every server is its own immediate leader and two
+                # same-region agents that discover each other split-brain
+                if self.server.rpc_server is None:
+                    raise ValueError("gossip requires rpc_port >= 0")
+                self.server.enable_raft(
+                    self.server.name,
+                    {self.server.name: self.server.rpc_addr},
+                    data_dir=os.path.join(self.config.data_dir, "raft"),
+                    bootstrap=self.config.bootstrap)
+            self.server.start()
+            if self.config.gossip_port >= 0:
+                self.server.gossip_listen(self.config.bind_addr,
+                                          self.config.gossip_port,
+                                          key=self.config.key_bytes())
+                if self.config.join:
+                    self.server.gossip_join(list(self.config.join))
         self.http = make_http_server(self.api, self.config.bind_addr,
                                      self.config.http_port)
         # pick up the OS-assigned port when asked for :0

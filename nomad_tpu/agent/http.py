@@ -619,6 +619,9 @@ class HTTPAPI:
                     "Error": err, "Warnings": ""}, None
 
         if parts == ["regions"]:
+            # federated regions discovered via gossip when enabled
+            if getattr(s, "gossip", None) is not None:
+                return s.regions(), None
             return [self.agent.config.region], None
         if parts == ["status", "peers"]:
             peers = getattr(s.raft, "peers", None)
@@ -635,6 +638,12 @@ class HTTPAPI:
                                "Version": self._version()},
                     "stats": self.agent.stats()}, None
         if parts == ["agent", "members"]:
+            if getattr(s, "gossip", None) is not None:
+                return {"Members": [{
+                    "Name": m["name"], "Addr": m["host"],
+                    "Port": m["port"], "Status": m["status"],
+                    "Tags": m["tags"],
+                } for m in s.members()]}, None
             cfg = s.operator_raft_configuration()
             return {"Members": [{
                 "Name": sv["ID"], "Addr": sv["Address"].rsplit(":", 1)[0],

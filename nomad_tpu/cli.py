@@ -74,7 +74,14 @@ def cmd_agent(args) -> None:
     cfg = AgentConfig(dev_mode=args.dev, http_port=args.port,
                       data_dir=args.data_dir or "",
                       num_workers=args.workers,
-                      acl_enabled=getattr(args, "acl_enabled", False))
+                      acl_enabled=getattr(args, "acl_enabled", False),
+                      region=getattr(args, "region", "global"),
+                      authoritative_region=getattr(
+                          args, "authoritative_region", ""),
+                      rpc_port=getattr(args, "rpc_port", -1),
+                      gossip_port=getattr(args, "gossip_port", -1),
+                      join=tuple(getattr(args, "join", []) or ()),
+                      bootstrap=getattr(args, "bootstrap_expect", 1) != 0)
     agent = Agent(cfg, logger=lambda m: print(f"    {m}", flush=True))
     agent.start()
     mode = []
@@ -590,6 +597,16 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-data-dir", dest="data_dir", default="")
     ag.add_argument("-workers", type=int, default=2)
     ag.add_argument("-acl-enabled", dest="acl_enabled", action="store_true")
+    ag.add_argument("-region", default="global")
+    ag.add_argument("-authoritative-region", dest="authoritative_region",
+                    default="")
+    ag.add_argument("-rpc-port", dest="rpc_port", type=int, default=-1)
+    ag.add_argument("-gossip-port", dest="gossip_port", type=int, default=-1)
+    ag.add_argument("-join", action="append", default=[],
+                    help="gossip seed host:port (repeatable)")
+    ag.add_argument("-bootstrap-expect", dest="bootstrap_expect", type=int,
+                    default=1, help="1: bootstrap a new cluster; "
+                    "0: wait to be adopted by an existing leader")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job")

@@ -68,7 +68,8 @@ class RpcClient:
             return self._seq
 
     def _call_addr(self, addr: str, method: str, args, kwargs,
-                   sock_timeout: Optional[float] = None):
+                   sock_timeout: Optional[float] = None,
+                   region: str = ""):
         resp = None
         for attempt in (0, 1):
             with self._lock:
@@ -77,8 +78,13 @@ class RpcClient:
             try:
                 sock.settimeout(sock_timeout or self.timeout)
                 seq = self._next_seq()
-                send_msg(sock, {"seq": seq, "method": method, "args": args,
-                                "kwargs": kwargs}, self.key)
+                env = {"seq": seq, "method": method, "args": args,
+                       "kwargs": kwargs}
+                if region:
+                    # cross-region routing stamp (ref nomad/rpc.go
+                    # forwardRegion; every reference RPC carries Region)
+                    env["region"] = region
+                send_msg(sock, env, self.key)
                 resp = recv_msg(sock, self.key)
                 break
             except BaseException as e:
@@ -104,9 +110,10 @@ class RpcClient:
         return self.call_timeout(None, method, *args, **kwargs)
 
     def call_timeout(self, sock_timeout: Optional[float], method: str,
-                     *args, **kwargs):
+                     *args, _region: str = "", **kwargs):
         """Like call(); sock_timeout overrides the per-connection socket
-        timeout for this call (long-polls must out-wait the server hold)."""
+        timeout for this call (long-polls must out-wait the server hold).
+        `_region` stamps the envelope for cross-region forwarding."""
         last_err: Optional[Exception] = None
         # deterministic preference for the first configured server keeps
         # -dev single-server behavior snappy; the shuffled remainder is the
@@ -117,13 +124,15 @@ class RpcClient:
         for addr in first + rest:
             try:
                 return self._call_addr(addr, method, args, kwargs,
-                                       sock_timeout=sock_timeout)
+                                       sock_timeout=sock_timeout,
+                                       region=_region)
             except NotLeaderError as e:
                 if e.leader_addr and e.leader_addr != addr:
                     try:
                         return self._call_addr(e.leader_addr, method, args,
                                                kwargs,
-                                               sock_timeout=sock_timeout)
+                                               sock_timeout=sock_timeout,
+                                               region=_region)
                     except RpcError as e2:
                         if e2.kind != "RetryableError":
                             raise

@@ -30,6 +30,11 @@ class RpcServer:
         self._handlers: dict[str, tuple[Callable, bool]] = {}
         # wired by the consensus layer: () -> (is_leader, leader_rpc_addr)
         self.leadership_fn: Callable[[], tuple[bool, str]] = lambda: (True, "")
+        # cross-region forwarding (ref nomad/rpc.go forwardRegion): wired
+        # by Server.gossip_listen — requests stamped with a different
+        # region are proxied to a known server of that region
+        self.region = ""
+        self.region_servers_fn: Callable[[], dict] = lambda: {}
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -80,6 +85,11 @@ class RpcServer:
                     "kind": "FrameError"}
         seq = req.get("seq")
         method = req["method"]
+        want_region = req.get("region", "")
+        if want_region and self.region and want_region != self.region:
+            fwd = self._forward_region(method, req, want_region)
+            fwd["seq"] = seq
+            return fwd
         entry = self._handlers.get(method)
         if entry is None:
             return {"seq": seq, "error": f"unknown rpc method {method!r}",
@@ -111,6 +121,37 @@ class RpcServer:
             return {"seq": seq, "error": e.leader_addr, "kind": "NotLeaderError"}
         except Exception as e:   # noqa: BLE001
             return {"seq": seq, "error": str(e), "kind": type(e).__name__}
+
+    def _forward_region(self, method: str, req, region: str) -> dict:
+        """Proxy to a server of the requested region (ref nomad/rpc.go
+        forwardRegion: pick a random known server there)."""
+        import random
+        servers = self.region_servers_fn().get(region, {})
+        addrs = [a for a in servers.values() if a]
+        if not addrs:
+            return {"error": f"no path to region {region!r}",
+                    "kind": "NoRegionPathError"}
+        from .client import RpcClient
+        from .codec import RpcError
+        random.shuffle(addrs)
+        last = None
+        for addr in addrs[:3]:
+            try:
+                with RpcClient([addr], key=self.key) as cli:
+                    # the target is in `region`, so it serves locally —
+                    # the stamp is kept for integrity, not re-forwarded
+                    return {"result": cli.call(
+                        method, *req.get("args", ()),
+                        _region=region, **req.get("kwargs", {}))}
+            except RpcError as e:
+                # the remote HANDLER answered (e.g. validation error):
+                # deterministic — pass it through verbatim, never replay
+                # a possibly non-idempotent write against another server
+                return {"error": str(e), "kind": e.kind}
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last = e                # transport failure: try another
+        return {"error": f"region {region!r} forward failed: {last}",
+                "kind": "RetryableError"}
 
     def _forward(self, method: str, req, leader_addr: str) -> Optional[dict]:
         """Proxy a leader-only call to the leader (ref nomad/rpc.go:450)."""

@@ -53,9 +53,15 @@ class RaftNode:
                  data_dir: Optional[str] = None, logger=None,
                  election_timeout: tuple[float, float] = (0.4, 0.8),
                  heartbeat_interval: float = 0.1,
-                 snapshot_threshold: int = 8192):
+                 snapshot_threshold: int = 8192,
+                 bootstrap: bool = True):
         self.fsm = fsm
         self.node_id = node_id
+        # bootstrap=False: an expansion server (gossip auto-join, ref
+        # bootstrap_expect) — it must NOT self-elect while its config is
+        # the trivial {self}; it waits to be adopted by a leader's
+        # _config_add and only then participates in elections
+        self.bootstrap = bootstrap
         self.rpc_server = rpc_server
         self.addr = rpc_server.addr
         self.logger = logger or (lambda msg: None)
@@ -460,6 +466,11 @@ class RaftNode:
                     deadline = self._last_contact + \
                         random.uniform(*self.election_timeout)
                     continue
+                # a non-bootstrap server with only itself in config is
+                # waiting for adoption, not for votes
+                if not self.bootstrap and len(self.peers) <= 1:
+                    deadline = self._election_deadline()
+                    continue
                 self.current_term += 1
                 self.voted_for = self.node_id
                 self._persist_meta()
@@ -525,6 +536,15 @@ class RaftNode:
             noop = _Entry(term, "_noop", {})
             self.log.append(noop)
             self._append_to_disk([noop])
+            # make membership fully log-described: re-append the current
+            # config so servers adopted later (gossip auto-join with a
+            # trivial {self} base config) learn EVERY member — including
+            # those only present in this leader's bootstrap config —
+            # purely from the log. Idempotent at adopt/apply time.
+            cfg_entries = [_Entry(term, "_config_add", (pid, addr))
+                           for pid, addr in self.peers.items()]
+            self.log.extend(cfg_entries)
+            self._append_to_disk(cfg_entries)
             self._match_index[self.node_id] = self._last_index()
             peers = {pid: addr for pid, addr in self.peers.items()
                      if pid != self.node_id}

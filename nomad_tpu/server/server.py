@@ -110,13 +110,27 @@ RPC_ENDPOINTS = {
     "Operator.AutopilotSetConfiguration": ("operator_autopilot_set_config",
                                            True),
     "Operator.ServerHealth": ("operator_server_health", False),
+    "ACL.ListPolicies": ("acl_list_policies_wire", False),
+    "ACL.ListTokens": ("acl_list_tokens_wire", False),
+    "Status.Members": ("members", False),
+    "Status.Regions": ("regions", False),
 }
 
 
 class Server:
     def __init__(self, num_workers: int = 2, logger: Optional[Callable] = None,
-                 gc_interval: float = 300.0, acl_enabled: bool = False):
+                 gc_interval: float = 300.0, acl_enabled: bool = False,
+                 region: str = "global", authoritative_region: str = "",
+                 name: str = ""):
         self.logger = logger or (lambda msg: None)
+        self.region = region
+        # cross-region ACL replication source (ref nomad/leader.go:1288);
+        # empty or equal to `region` means this region is authoritative
+        self.authoritative_region = authoritative_region or region
+        # management token of the authoritative region used by the ACL
+        # replication loop (ref config acl.replication_token)
+        self.replication_token = ""
+        self.name = name or f"server-{new_id()[:8]}"
         self.fsm = NomadFSM()
         self.state: StateStore = self.fsm.state
         self.raft = RaftLog(self.fsm)
@@ -150,6 +164,12 @@ class Server:
         # multi-server consensus (optional; wired by enable_raft). When set,
         # leadership is election-driven instead of immediate-on-start.
         self.raft_node = None
+        # gossip membership + federation (optional; wired by gossip_listen):
+        # same-region members drive Raft peer management, cross-region
+        # members populate the federation routing table (ref serf.go)
+        self.gossip = None
+        # region -> {server name -> rpc_addr} of ALIVE foreign servers
+        self.region_servers: dict[str, dict[str, str]] = {}
 
         # the FSM tells the leader about new evals (ref fsm.go:760)
         self.fsm.on_eval_update.append(self._on_eval_update)
@@ -214,7 +234,203 @@ class Server:
     def rpc_addr(self) -> str:
         return self.rpc_server.addr if self.rpc_server is not None else ""
 
+    # ------------------------------------------------- gossip / federation
+
+    def gossip_listen(self, bind: str = "127.0.0.1", port: int = 0,
+                      key: bytes = None) -> str:
+        """Join the gossip fabric (ref nomad/server.go:1388 setupSerf).
+        Requires rpc_listen() first — the rpc addr rides in our tags so
+        discovered servers are immediately routable."""
+        if self.rpc_server is None:
+            raise RuntimeError("gossip_listen requires rpc_listen() first")
+        from ..rpc.server import DEFAULT_KEY
+        from .gossip import Gossip
+        self.gossip = Gossip(
+            name=self.name, bind=bind, port=port,
+            key=key or DEFAULT_KEY, logger=self.logger,
+            tags={"role": "nomad-server", "region": self.region,
+                  "rpc_addr": self.rpc_server.addr, "id": self.name},
+            on_join=self._on_gossip_join,
+            on_leave=self._on_gossip_leave,
+            on_fail=self._on_gossip_fail)
+        self.gossip.start()
+        self.rpc_server.region = self.region
+        self.rpc_server.region_servers_fn = self._region_servers_snapshot
+        return self.gossip.addr
+
+    def gossip_join(self, seeds: list[str]) -> int:
+        """ref serf.Join via -join/retry_join"""
+        return self.gossip.join(seeds)
+
+    def _region_servers_snapshot(self) -> dict[str, dict[str, str]]:
+        return {r: dict(servers) for r, servers in
+                self.region_servers.items()}
+
+    def members(self) -> list[dict]:
+        """ref nomad/serf.go Members for `server members` / agent API"""
+        return self.gossip.members_snapshot() if self.gossip else []
+
+    def regions(self) -> list[str]:
+        out = {self.region} | set(self.region_servers)
+        return sorted(out)
+
+    def _on_gossip_join(self, member) -> None:
+        """ref nomad/serf.go:98 nodeJoin (+ maybeBootstrap)"""
+        tags = member.tags
+        if tags.get("role") != "nomad-server":
+            return
+        region = tags.get("region", "")
+        if region != self.region:
+            self.region_servers.setdefault(region, {})[member.name] = \
+                tags.get("rpc_addr", "")
+            self.logger(f"server: federated server {member.name} "
+                        f"joined region {region}")
+            return
+        # same region: adopt into consensus (leader-driven, the serf-join
+        # -> AddVoter path of the reference)
+        if self.raft_node is not None and self.is_leader and \
+                tags.get("id") and tags.get("rpc_addr"):
+            try:
+                self.raft_node.add_peer(tags["id"], tags["rpc_addr"])
+                self.logger(f"server: added raft peer {tags['id']}")
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"server: add_peer {tags['id']} failed: {e}")
+
+    def _on_gossip_fail(self, member) -> None:
+        """ref nomad/serf.go:163 nodeFailed + autopilot dead-server
+        cleanup: the leader drops failed same-region servers from Raft."""
+        tags = member.tags
+        if tags.get("role") != "nomad-server":
+            return
+        region = tags.get("region", "")
+        if region != self.region:
+            self.region_servers.get(region, {}).pop(member.name, None)
+            return
+        if self.raft_node is not None and self.is_leader and tags.get("id"):
+            try:
+                self.raft_node.remove_peer(tags["id"])
+                self.logger(f"server: removed failed peer {tags['id']}")
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"server: remove_peer failed: {e}")
+
+    def _on_gossip_leave(self, member) -> None:
+        self._on_gossip_fail(member)
+
+    def _reconcile_gossip_peers(self) -> None:
+        """Leader tick: converge raft membership onto the gossip view of
+        same-region servers (ref nomad/leader.go reconcileMember). Event
+        callbacks handle the common case instantly; this heals joins that
+        raced leadership establishment and any missed UDP event."""
+        if self.gossip is None or self.raft_node is None or \
+                not self.is_leader:
+            return
+        alive = {}
+        for m in self.gossip.alive_members():
+            tags = m.tags
+            if tags.get("role") == "nomad-server" and \
+                    tags.get("region", "") == self.region and \
+                    tags.get("id") and tags.get("rpc_addr"):
+                alive[tags["id"]] = tags["rpc_addr"]
+        peers = dict(self.raft_node.peers)
+        for pid, addr in alive.items():
+            if peers.get(pid) != addr:
+                self.raft_node.add_peer(pid, addr)
+                self.logger(f"server: reconciled raft peer {pid}")
+
+    # --------------------------------------------------- ACL replication
+
+    def _require_replication_token(self, secret: str) -> None:
+        """Token listings carry SecretIDs: with ACLs on, only a management
+        token may read them (ref acl_endpoint.go: replication endpoints
+        require the replication/management token)."""
+        if not self.acl.enabled:
+            return
+        acl = self.acl.resolve_token(secret)
+        if not acl.is_management():
+            from .acl_endpoint import PermissionDeniedError
+            raise PermissionDeniedError(
+                "ACL replication requires a management token")
+
+    def acl_list_policies_wire(self, secret: str = "") -> list[dict]:
+        """Replication source endpoint (ref acl_endpoint.go ListPolicies
+        with the replication token)."""
+        from ..api_codec import to_api
+        self._require_replication_token(secret)
+        return [to_api(p) for p in self.state.iter_acl_policies()]
+
+    def acl_list_tokens_wire(self, global_only: bool = True,
+                             secret: str = "") -> list[dict]:
+        from ..api_codec import to_api
+        self._require_replication_token(secret)
+        return [to_api(t) for t in self.state.iter_acl_tokens()
+                if t.global_ or not global_only]
+
+    def _acl_replication_loop(self, interval: float = 1.0) -> None:
+        """Mirror policies + global tokens from the authoritative region.
+        Pull-based full-set diff per cycle — the reference diffs by
+        modify_index; at control-plane ACL cardinality the full set is a
+        single small RPC either way."""
+        from ..api_codec import from_api
+        from ..structs.acl_structs import ACLPolicy, ACLToken
+        from .fsm import (
+            ACL_POLICY_DELETE, ACL_POLICY_UPSERT, ACL_TOKEN_DELETE,
+            ACL_TOKEN_UPSERT,
+        )
+        while not self._leader_stop.wait(interval):
+            servers = self.region_servers.get(self.authoritative_region, {})
+            addrs = [a for a in servers.values() if a]
+            if not addrs:
+                continue
+            try:
+                from ..rpc.client import RpcClient
+                with RpcClient(addrs, key=self.rpc_server.key) as cli:
+                    pol_wire = cli.call("ACL.ListPolicies",
+                                        secret=self.replication_token)
+                    tok_wire = cli.call("ACL.ListTokens", True,
+                                        secret=self.replication_token)
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"server: acl replication fetch failed: {e}")
+                continue
+            try:
+                want_pols = {p.name: p for p in
+                             (from_api(ACLPolicy, w) for w in pol_wire)}
+                want_toks = {t.accessor_id: t for t in
+                             (from_api(ACLToken, w) for w in tok_wire)}
+                have_pols = {p.name: p for p in
+                             self.state.iter_acl_policies()}
+                have_toks = {t.accessor_id: t for t in
+                             self.state.iter_acl_tokens() if t.global_}
+                up_p = [p for n, p in want_pols.items()
+                        if n not in have_pols or
+                        have_pols[n].rules != p.rules or
+                        have_pols[n].description != p.description]
+                del_p = [n for n in have_pols if n not in want_pols]
+                up_t = [t for a, t in want_toks.items()
+                        if a not in have_toks or
+                        have_toks[a].secret_id != t.secret_id or
+                        have_toks[a].policies != t.policies or
+                        have_toks[a].type != t.type]
+                del_t = [a for a in have_toks if a not in want_toks]
+                if up_p:
+                    self.raft.apply(ACL_POLICY_UPSERT, {"policies": up_p})
+                if del_p:
+                    self.raft.apply(ACL_POLICY_DELETE, {"names": del_p})
+                if up_t:
+                    self.raft.apply(ACL_TOKEN_UPSERT, {"tokens": up_t})
+                if del_t:
+                    self.raft.apply(ACL_TOKEN_DELETE,
+                                    {"accessor_ids": del_t})
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"server: acl replication apply failed: {e}")
+
     def shutdown(self) -> None:
+        if self.gossip is not None:
+            # broadcast LEFT and close the UDP socket — a shut-down
+            # server must not keep acking probes and looking alive
+            try:
+                self.gossip.leave()
+            except Exception:           # noqa: BLE001
+                self.gossip.shutdown()
         if self.raft_node is not None:
             self.raft_node.shutdown()
         if self.rpc_server is not None:
@@ -279,6 +495,12 @@ class Server:
         self._leader_thread = threading.Thread(
             target=self._leader_loop, daemon=True, name="leader-loop")
         self._leader_thread.start()
+        # non-authoritative region leaders mirror ACL state from the
+        # authoritative region (ref nomad/leader.go:1288
+        # replicateACLPolicies / :1368 replicateACLTokens)
+        if self.region != self.authoritative_region:
+            threading.Thread(target=self._acl_replication_loop, daemon=True,
+                             name="acl-replication").start()
 
     def _leader_loop(self) -> None:
         """Broker nack-timeout reaping + periodic core GC evals
@@ -295,6 +517,10 @@ class Server:
                 self._reap_stale_services()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"service reap: {e!r}")
+            try:
+                self._reconcile_gossip_peers()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"gossip reconcile: {e!r}")
             if time.time() - last_gc >= self.gc_interval:
                 last_gc = time.time()
                 for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,

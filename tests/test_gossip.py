@@ -1,0 +1,293 @@
+"""Gossip membership, auto-join, failure detection, regions, federation,
+ACL replication (ref nomad/server.go:1388 setupSerf, nomad/serf.go,
+nomad/rpc.go forwardRegion, nomad/leader.go:1288 replicateACLPolicies)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.server.gossip import ALIVE, DEAD, Gossip
+
+from test_raft import FAST, shutdown_all, wait_stable_leader, wait_until
+
+
+# ------------------------------------------------------------ gossip unit
+
+def test_gossip_membership_converges():
+    nodes = [Gossip(f"g{i}", interval=0.05, suspect_timeout=0.6,
+                    probe_timeout=0.2) for i in range(3)]
+    try:
+        for g in nodes:
+            g.start()
+        seed = nodes[0].addr
+        assert nodes[1].join([seed]) == 1
+        assert nodes[2].join([seed]) == 1
+        assert wait_until(lambda: all(
+            len(g.alive_members()) == 3 for g in nodes), timeout=5)
+    finally:
+        for g in nodes:
+            g.shutdown()
+
+
+def test_gossip_detects_failure():
+    nodes = [Gossip(f"f{i}", interval=0.05, suspect_timeout=0.5,
+                    probe_timeout=0.15) for i in range(3)]
+    failed = []
+    nodes[0].on_fail = lambda m: failed.append(m.name)
+    try:
+        for g in nodes:
+            g.start()
+        nodes[1].join([nodes[0].addr])
+        nodes[2].join([nodes[0].addr])
+        assert wait_until(lambda: all(
+            len(g.alive_members()) == 3 for g in nodes), timeout=5)
+        nodes[2].shutdown()             # hard kill, no goodbye
+        assert wait_until(
+            lambda: nodes[0].members["f2"].status == DEAD, timeout=8)
+        assert "f2" in failed
+        # survivors keep a consistent view
+        assert wait_until(
+            lambda: nodes[1].members["f2"].status == DEAD, timeout=8)
+    finally:
+        for g in nodes:
+            g.shutdown()
+
+
+def test_gossip_acl_listing_requires_management_token():
+    s = _mk_server(name="acl-gate")
+    s.acl.enabled = True
+    try:
+        s.start()
+        from nomad_tpu.server.acl_endpoint import PermissionDeniedError
+        with pytest.raises(Exception):
+            s.acl_list_tokens_wire(secret="not-a-token")
+        tok = s.acl.bootstrap()
+        toks = s.acl_list_tokens_wire(secret=tok.secret_id)
+        assert any(t["SecretID"] == tok.secret_id for t in toks)
+    finally:
+        s.shutdown()
+
+
+def test_gossip_dead_member_rejoins_after_partition_heals():
+    """Anti-entropy push-pull lets a node wrongly marked DEAD hear the
+    rumor about itself and refute with a higher incarnation."""
+    a = Gossip("pa", interval=0.05, suspect_timeout=0.4, probe_timeout=0.1,
+               sync_interval=0.3)
+    b = Gossip("pb", interval=0.05, suspect_timeout=0.4, probe_timeout=0.1,
+               sync_interval=0.3)
+    try:
+        a.start()
+        b.start()
+        b.join([a.addr])
+        assert wait_until(lambda: len(a.alive_members()) == 2)
+        # simulate a one-sided partition: a marks b dead directly (as if
+        # probes failed long enough), without b knowing
+        with a._lock:
+            m = a.members["pb"]
+            m.status = DEAD
+            m.status_time = 0.0
+            a._queue_update(m)
+        # b's periodic sync hits a, hears the DEAD rumor about itself,
+        # refutes with a bumped incarnation -> both sides converge ALIVE
+        assert wait_until(lambda: a.members["pb"].status == ALIVE,
+                          timeout=5)
+        assert b.members["pb"].incarnation > 1
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_gossip_graceful_leave():
+    a = Gossip("la", interval=0.05, suspect_timeout=0.8, probe_timeout=0.2)
+    b = Gossip("lb", interval=0.05, suspect_timeout=0.8, probe_timeout=0.2)
+    left = []
+    a.on_leave = lambda m: left.append(m.name)
+    try:
+        a.start()
+        b.start()
+        b.join([a.addr])
+        assert wait_until(lambda: len(a.alive_members()) == 2)
+        b.leave()
+        assert wait_until(lambda: "lb" in left, timeout=5)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_gossip_rejects_unauthenticated_packets():
+    a = Gossip("sa", key=b"right-key", interval=0.05)
+    b = Gossip("sb", key=b"wrong-key", interval=0.05)
+    try:
+        a.start()
+        b.start()
+        b.join([a.addr])
+        time.sleep(0.5)
+        assert len(a.alive_members()) == 1      # forged joins dropped
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+# ----------------------------------------------- server auto-join cluster
+
+def _mk_server(region="global", authoritative="", name="", workers=0):
+    s = Server(num_workers=workers, gc_interval=9999, region=region,
+               authoritative_region=authoritative, name=name)
+    s.rpc_listen()
+    return s
+
+
+def test_three_servers_auto_discover_and_survive_kill(tmp_path):
+    """VERDICT r2 next #5 'Done' criterion: a 3-server cluster discovers
+    itself via gossip (no operator add-peer) and survives a server kill
+    without operator action."""
+    servers = [_mk_server(name=f"g{i}") for i in range(3)]
+    try:
+        # the first server bootstraps a single-node cluster; the others
+        # start as non-bootstrap expansion servers knowing only
+        # themselves — gossip join triggers leader-driven adoption
+        # (serf -> AddVoter, the bootstrap_expect flow)
+        for i, s in enumerate(servers):
+            s.enable_raft(s.name, {s.name: s.rpc_addr},
+                          data_dir=str(tmp_path / f"g{i}"),
+                          bootstrap=(i == 0), **FAST)
+        # first server must win its own election before it can adopt
+        servers[0].start()
+        servers[0].gossip_listen()
+        assert wait_until(lambda: servers[0].raft_node.is_leader(),
+                          timeout=10)
+        seed = servers[0].gossip.addr
+        for s in servers[1:]:
+            s.start()
+            s.gossip_listen()
+            s.gossip_join([seed])
+        # all three end up voting members of one raft cluster
+        def peer_count():
+            try:
+                cfg = servers[0].operator_raft_configuration()
+                return len(cfg["Servers"])
+            except Exception:
+                return 0
+        assert wait_until(lambda: peer_count() == 3, timeout=15)
+        leader = wait_stable_leader(servers)
+
+        # replicate a write everywhere
+        job = mock.job()
+        leader.job_register(job)
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job.id) is not None
+            for s in servers), timeout=10)
+
+        # kill a FOLLOWER hard; gossip detects it and the leader drops it
+        victim = next(s for s in servers if not s.raft_node.is_leader())
+        victim.gossip.shutdown()
+        victim.shutdown()
+        rest = [s for s in servers if s is not victim]
+        assert wait_until(lambda: len(
+            [m for m in rest[0].gossip.alive_members()]) == 2, timeout=15)
+        assert wait_until(lambda: len(
+            rest[0].operator_raft_configuration()["Servers"]) == 2,
+            timeout=15)
+        # the surviving pair still commits writes
+        leader2 = wait_stable_leader(rest)
+        job2 = mock.job()
+        leader2.job_register(job2)
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job2.id) is not None
+            for s in rest), timeout=10)
+    finally:
+        shutdown_all(servers)
+        for s in servers:
+            if s.gossip:
+                s.gossip.shutdown()
+
+
+# -------------------------------------------------- regions / federation
+
+def test_two_region_federation_and_forwarding():
+    """Two single-server regions federate over gossip; a request stamped
+    for the other region is forwarded transparently (nomad/rpc.go
+    forwardRegion)."""
+    east = _mk_server(region="east", name="east-1")
+    west = _mk_server(region="west", name="west-1")
+    try:
+        east.start()
+        west.start()
+        east.gossip_listen()
+        west.gossip_listen()
+        west.gossip_join([east.gossip.addr])
+        assert wait_until(lambda: "west" in east.region_servers and
+                          "east" in west.region_servers, timeout=5)
+        assert sorted(east.regions()) == ["east", "west"]
+
+        # register a job in west THROUGH east's RPC endpoint
+        from nomad_tpu.api_codec import to_api
+        from nomad_tpu.rpc.client import RpcClient
+        from nomad_tpu.rpc.server import DEFAULT_KEY
+        job = mock.job()
+        with RpcClient([east.rpc_addr], key=DEFAULT_KEY) as cli:
+            # same-region call serves locally
+            regions = cli.call("Status.Regions")
+            assert sorted(regions) == ["east", "west"]
+            cli.call("Job.Register", job, _region="west")
+        assert wait_until(lambda: west.state.job_by_id(
+            "default", job.id) is not None, timeout=5)
+        assert east.state.job_by_id("default", job.id) is None
+
+        # unknown region errors cleanly
+        from nomad_tpu.rpc.codec import RpcError
+        with RpcClient([east.rpc_addr], key=DEFAULT_KEY) as cli:
+            with pytest.raises(RpcError):
+                cli.call("Status.Regions", _region="mars")
+    finally:
+        east.shutdown()
+        west.shutdown()
+        for s in (east, west):
+            if s.gossip:
+                s.gossip.shutdown()
+
+
+def test_acl_replication_from_authoritative_region():
+    """Non-authoritative region leaders mirror policies + global tokens
+    (ref nomad/leader.go:1288)."""
+    auth = _mk_server(region="east", authoritative="east", name="ae-1")
+    auth.acl.enabled = True
+    replica = _mk_server(region="west", authoritative="east", name="aw-1")
+    replica.acl.enabled = True
+    try:
+        auth.start()
+        replica.start()
+        auth.gossip_listen()
+        replica.gossip_listen()
+        replica.gossip_join([auth.gossip.addr])
+        assert wait_until(lambda: "east" in replica.region_servers,
+                          timeout=5)
+
+        from nomad_tpu.structs.acl_structs import ACLPolicy
+        auth.acl.upsert_policies([ACLPolicy(
+            name="readonly", rules='namespace "default" '
+                                   '{ policy = "read" }')])
+        bootstrap = auth.acl.bootstrap()        # management token, global
+        # the replica authenticates to the authoritative region with the
+        # replication (management) token — without it the source refuses
+        replica.replication_token = bootstrap.secret_id
+
+        assert wait_until(lambda: any(
+            p.name == "readonly"
+            for p in replica.state.iter_acl_policies()), timeout=10)
+        assert wait_until(lambda: any(
+            t.secret_id == bootstrap.secret_id
+            for t in replica.state.iter_acl_tokens()), timeout=10)
+
+        # deletes propagate too
+        auth.acl.delete_policies(["readonly"])
+        assert wait_until(lambda: not any(
+            p.name == "readonly"
+            for p in replica.state.iter_acl_policies()), timeout=10)
+    finally:
+        auth.shutdown()
+        replica.shutdown()
+        for s in (auth, replica):
+            if s.gossip:
+                s.gossip.shutdown()
