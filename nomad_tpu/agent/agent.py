@@ -36,7 +36,12 @@ class AgentConfig:
     acl_enabled: bool = False
     gossip_port: int = -1              # -1 = gossip off; 0 = any port
     join: tuple = ()                   # gossip seed "host:port" addrs
-    bootstrap: bool = True             # False: wait for raft adoption
+    # ref -bootstrap-expect: 1 = bootstrap immediately (single server or
+    # first of a cluster); 0 = never bootstrap, wait for adoption; N>1 =
+    # wait until gossip sees N same-region servers, then all bootstrap
+    # with the same config (safe to pass the same N to every server)
+    bootstrap_expect: int = 1
+    replication_token: str = ""        # ACL replication auth (federation)
 
     def key_bytes(self) -> bytes:
         from ..rpc.server import DEFAULT_KEY
@@ -109,11 +114,14 @@ class Agent:
                 # same-region agents that discover each other split-brain
                 if self.server.rpc_server is None:
                     raise ValueError("gossip requires rpc_port >= 0")
+                self.server.bootstrap_expect = self.config.bootstrap_expect
+                self.server.replication_token = \
+                    self.config.replication_token
                 self.server.enable_raft(
                     self.server.name,
                     {self.server.name: self.server.rpc_addr},
                     data_dir=os.path.join(self.config.data_dir, "raft"),
-                    bootstrap=self.config.bootstrap)
+                    bootstrap=(self.config.bootstrap_expect == 1))
             self.server.start()
             if self.config.gossip_port >= 0:
                 self.server.gossip_listen(self.config.bind_addr,

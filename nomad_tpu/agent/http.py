@@ -717,7 +717,8 @@ class HTTPAPI:
         # ACL: resolve through the server when present (client-only agents
         # resolve via server RPC in the reference; dev agents are combined)
         from ..acl import (
-            NS_ALLOC_LIFECYCLE, NS_READ_FS, NS_READ_JOB, NS_READ_LOGS,
+            NS_ALLOC_EXEC, NS_ALLOC_LIFECYCLE, NS_READ_FS, NS_READ_JOB,
+            NS_READ_LOGS,
         )
         if self.server is not None:
             acl = self.resolve_acl(token)
@@ -767,6 +768,44 @@ class HTTPAPI:
                     ns_require(alloc_id, NS_ALLOC_LIFECYCLE)
                     c.gc_alloc(alloc_id)
                     return {}, None
+                if rest == ["exec"] and method in ("PUT", "POST"):
+                    # interactive exec (ref api/allocations_exec.go; the
+                    # reference streams over websocket — here a session
+                    # API: open, then stdin/output round-trips)
+                    ns_require(alloc_id, NS_ALLOC_EXEC)
+                    sid = c.alloc_exec_start(
+                        alloc_id, body.get("Task", ""),
+                        body.get("Cmd", []) or body.get("Command", []),
+                        tty=bool(body.get("Tty", False)))
+                    return {"SessionID": sid}, None
+
+            if len(parts) >= 2 and parts[0] == "exec-session":
+                import base64
+                sid = parts[1]
+                # session ids are unguessable capabilities minted by an
+                # exec-capability-checked open; stream ops ride on that
+                if method == "DELETE":
+                    c.alloc_exec_close(sid)
+                    return {}, None
+                if method in ("PUT", "POST"):
+                    if "Stdin" in body:
+                        c.alloc_exec_stdin(
+                            sid, base64.b64decode(body["Stdin"]))
+                    if body.get("StdinEOF"):
+                        c.alloc_exec_stdin_close(sid)
+                    if "TTYSize" in body:
+                        sz = body["TTYSize"]
+                        c.alloc_exec_resize(sid, int(sz.get("Rows", 24)),
+                                            int(sz.get("Cols", 80)))
+                    return {}, None
+                out = c.alloc_exec_output(
+                    sid, wait=float(query.get("wait", 1.0) or 1.0))
+                return {"Stdout": base64.b64encode(
+                            out["stdout"]).decode(),
+                        "Stderr": base64.b64encode(
+                            out["stderr"]).decode(),
+                        "Exited": out["exited"],
+                        "ExitCode": out["exit_code"]}, None
 
             if len(parts) >= 2 and parts[0] == "fs":
                 op, alloc_id = parts[1], parts[2] if len(parts) > 2 else ""
@@ -787,6 +826,14 @@ class HTTPAPI:
                     return RawResponse(data), None
                 if op == "logs":
                     ns_require(alloc_id, NS_READ_LOGS)
+                    if str(query.get("follow", "")).lower() == "true":
+                        data, nxt = c.fs_logs_follow(
+                            alloc_id, query.get("task", ""),
+                            query.get("type", "stdout"), offset,
+                            wait=float(query.get("wait", 10.0) or 10.0))
+                        return {"Data": __import__("base64").b64encode(
+                                    data).decode(),
+                                "Offset": nxt}, None
                     data = c.fs_logs(
                         alloc_id, query.get("task", ""),
                         query.get("type", "stdout"), offset,

@@ -280,6 +280,75 @@ class Allocations(_Handle):
                              offset=str(offset))
         return data
 
+    def logs_follow(self, alloc_id: str, task: str,
+                    log_type: str = "stdout", offset: int = 0,
+                    wait: float = 10.0):
+        """Generator over long-polled log chunks (ref api/fs.go Logs with
+        follow=true). Yields bytes; the caller breaks when done."""
+        import base64
+        while True:
+            out, _ = self.c.get(f"/v1/client/fs/logs/{alloc_id}",
+                                task=task, type=log_type, follow="true",
+                                offset=str(offset), wait=str(wait))
+            data = base64.b64decode(out.get("Data", ""))
+            offset = int(out.get("Offset", offset))
+            yield data
+
+    # exec family (ref api/allocations_exec.go; session API over HTTP)
+    def exec_start(self, alloc_id: str, task: str, command: list,
+                   tty: bool = False) -> str:
+        out, _ = self.c.put(f"/v1/client/allocation/{alloc_id}/exec",
+                             {"Task": task, "Cmd": list(command),
+                              "Tty": tty})
+        return out["SessionID"]
+
+    def exec_stdin(self, session_id: str, data: bytes) -> None:
+        import base64
+        self.c.put(f"/v1/client/exec-session/{session_id}",
+                    {"Stdin": base64.b64encode(data).decode()})
+
+    def exec_stdin_close(self, session_id: str) -> None:
+        """EOF the remote stdin (lets `cat`-like commands finish)."""
+        self.c.put(f"/v1/client/exec-session/{session_id}",
+                   {"StdinEOF": True})
+
+    def exec_output(self, session_id: str, wait: float = 1.0) -> dict:
+        import base64
+        out, _ = self.c.get(f"/v1/client/exec-session/{session_id}",
+                            wait=str(wait))
+        return {"stdout": base64.b64decode(out.get("Stdout", "")),
+                "stderr": base64.b64decode(out.get("Stderr", "")),
+                "exited": out.get("Exited", False),
+                "exit_code": out.get("ExitCode")}
+
+    def exec_close(self, session_id: str) -> None:
+        self.c.delete(f"/v1/client/exec-session/{session_id}")
+
+    def exec_run(self, alloc_id: str, task: str, command: list,
+                 stdin: bytes = b"", timeout: float = 30.0) -> dict:
+        """Convenience round-trip: run command, feed stdin, collect all
+        output until exit. -> {stdout, stderr, exit_code}"""
+        import time as _time
+        sid = self.exec_start(alloc_id, task, command)
+        try:
+            if stdin:
+                self.exec_stdin(sid, stdin)
+            self.exec_stdin_close(sid)   # one-shot: no more input coming
+            out = b""
+            err = b""
+            deadline = _time.monotonic() + timeout
+            while _time.monotonic() < deadline:
+                chunk = self.exec_output(sid, wait=1.0)
+                out += chunk["stdout"]
+                err += chunk["stderr"]
+                if chunk["exited"] and not chunk["stdout"] and \
+                        not chunk["stderr"]:
+                    return {"stdout": out, "stderr": err,
+                            "exit_code": chunk["exit_code"]}
+            raise TimeoutError(f"exec did not exit within {timeout}s")
+        finally:
+            self.exec_close(sid)
+
 
 class Nodes(_Handle):
     """ref api/nodes.go"""

@@ -34,6 +34,21 @@ def _addr() -> str:
     return os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
 
 
+def _die(msg: str) -> None:
+    print(f"Error: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def api_raw(method: str, path: str) -> bytes:
+    """Non-JSON endpoints (log/file contents)."""
+    req = urllib.request.Request(_addr() + path, method=method)
+    token = os.environ.get("NOMAD_TOKEN", "")
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    with urllib.request.urlopen(req, timeout=35) as resp:
+        return resp.read()
+
+
 def api(method: str, path: str, body=None):
     url = _addr() + path
     data = json.dumps(body).encode() if body is not None else None
@@ -81,7 +96,9 @@ def cmd_agent(args) -> None:
                       rpc_port=getattr(args, "rpc_port", -1),
                       gossip_port=getattr(args, "gossip_port", -1),
                       join=tuple(getattr(args, "join", []) or ()),
-                      bootstrap=getattr(args, "bootstrap_expect", 1) != 0)
+                      bootstrap_expect=getattr(args, "bootstrap_expect", 1),
+                      replication_token=getattr(args, "replication_token",
+                                                ""))
     agent = Agent(cfg, logger=lambda m: print(f"    {m}", flush=True))
     agent.start()
     mode = []
@@ -392,6 +409,93 @@ def cmd_alloc_status(args) -> None:
             print(f"  {ev['Type']}: {ev['Message']}")
 
 
+def _alloc_task(alloc_id: str, task: str) -> tuple[str, str]:
+    """Resolve (full alloc id, task name) from a possibly-short id."""
+    a = api("GET", f"/v1/allocation/{alloc_id}")
+    if not task:
+        states = a.get("TaskStates") or {}
+        if len(states) == 1:
+            task = next(iter(states))
+        else:
+            _die(f"-task required (tasks: {', '.join(states) or '?'})")
+    return a["ID"], task
+
+
+def cmd_alloc_exec(args) -> None:
+    """Interactive exec into a running task (ref command/alloc_exec.go):
+    round-trips stdin/stdout through the session API until exit."""
+    import base64
+    import select
+    # argparse REMAINDER swallows flags placed after the alloc id
+    # (`alloc exec ID -task t -- cmd`); strip them out here
+    rest = list(args.command)
+    while rest and rest[0].startswith("-") and rest[0] != "--":
+        flag = rest.pop(0)
+        if flag == "-task" and rest:
+            args.task = rest.pop(0)
+        elif flag == "-tty":
+            args.tty = True
+    command = [c for c in rest if c != "--"]
+    if not command:
+        _die("command required, e.g.: alloc exec <id> -task web -- /bin/sh")
+    alloc_id, task = _alloc_task(args.alloc_id, args.task)
+    out = api("POST", f"/v1/client/allocation/{alloc_id}/exec",
+              {"Task": task, "Cmd": command, "Tty": args.tty})
+    sid = out["SessionID"]
+    try:
+        while True:
+            # pump any ready local stdin to the remote session
+            if select.select([sys.stdin], [], [], 0)[0]:
+                line = sys.stdin.buffer.readline()
+                if line:
+                    api("POST", f"/v1/client/exec-session/{sid}",
+                        {"Stdin": base64.b64encode(line).decode()})
+                else:                    # local EOF -> remote EOF
+                    api("POST", f"/v1/client/exec-session/{sid}",
+                        {"StdinEOF": True})
+            chunk = api("GET", f"/v1/client/exec-session/{sid}?wait=0.5")
+            data = base64.b64decode(chunk.get("Stdout", ""))
+            err = base64.b64decode(chunk.get("Stderr", ""))
+            if data:
+                sys.stdout.buffer.write(data)
+                sys.stdout.flush()
+            if err:
+                sys.stderr.buffer.write(err)
+                sys.stderr.flush()
+            if chunk.get("Exited") and not data and not err:
+                code = chunk.get("ExitCode") or 0
+                sys.exit(code)
+    finally:
+        try:
+            api("DELETE", f"/v1/client/exec-session/{sid}")
+        except Exception:               # noqa: BLE001
+            pass
+
+
+def cmd_alloc_logs(args) -> None:
+    """ref command/alloc_logs.go (-f follows)"""
+    import base64
+    alloc_id, task = _alloc_task(args.alloc_id, args.task)
+    log_type = "stderr" if args.stderr else "stdout"
+    if not args.follow:
+        data = api_raw("GET", f"/v1/client/fs/logs/{alloc_id}?task={task}"
+                       f"&type={log_type}")
+        sys.stdout.buffer.write(data)
+        return
+    offset = 0
+    try:
+        while True:
+            out = api("GET", f"/v1/client/fs/logs/{alloc_id}?task={task}"
+                      f"&type={log_type}&follow=true&offset={offset}&wait=5")
+            data = base64.b64decode(out.get("Data", ""))
+            offset = int(out.get("Offset", offset))
+            if data:
+                sys.stdout.buffer.write(data)
+                sys.stdout.flush()
+    except (BrokenPipeError, KeyboardInterrupt):
+        sys.exit(0)                     # downstream pipe closed / ^C
+
+
 def cmd_eval_status(args) -> None:
     ev = api("GET", f"/v1/evaluation/{args.eval_id}")
     for k in ("ID", "Type", "TriggeredBy", "JobID", "Status",
@@ -605,8 +709,12 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-join", action="append", default=[],
                     help="gossip seed host:port (repeatable)")
     ag.add_argument("-bootstrap-expect", dest="bootstrap_expect", type=int,
-                    default=1, help="1: bootstrap a new cluster; "
-                    "0: wait to be adopted by an existing leader")
+                    default=1, help="N>1: wait for N servers then "
+                    "bootstrap together; 1: bootstrap now; 0: wait to be "
+                    "adopted by an existing leader")
+    ag.add_argument("-replication-token", dest="replication_token",
+                    default="", help="management token of the "
+                    "authoritative region (ACL replication)")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job")
@@ -675,6 +783,18 @@ def build_parser() -> argparse.ArgumentParser:
     ast = asub.add_parser("status")
     ast.add_argument("alloc_id")
     ast.set_defaults(fn=cmd_alloc_status)
+    aex = asub.add_parser("exec")
+    aex.add_argument("alloc_id")
+    aex.add_argument("-task", default="")
+    aex.add_argument("-tty", action="store_true")
+    aex.add_argument("command", nargs=argparse.REMAINDER)
+    aex.set_defaults(fn=cmd_alloc_exec)
+    alg = asub.add_parser("logs")
+    alg.add_argument("alloc_id")
+    alg.add_argument("-task", default="")
+    alg.add_argument("-stderr", action="store_true")
+    alg.add_argument("-f", dest="follow", action="store_true")
+    alg.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval")
     esub = ev.add_subparsers(dest="eval_cmd", required=True)

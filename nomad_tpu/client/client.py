@@ -15,7 +15,7 @@ from typing import Optional
 
 from ..structs import (
     Allocation, Node, ALLOC_DESIRED_STOP, NODE_STATUS_DOWN,
-    NODE_STATUS_INIT, NODE_STATUS_READY,
+    NODE_STATUS_INIT, NODE_STATUS_READY, new_id,
 )
 from .alloc_runner import AllocRunner
 from .driver import BUILTIN_DRIVERS, Driver
@@ -74,6 +74,7 @@ class Client:
         self._shutdown = threading.Event()
         self._dirty_allocs: set[str] = set()
         self._dirty_cond = threading.Condition()
+        self._exec_sessions: dict[str, list] = {}  # sid -> [session, last]
         self._threads: list[threading.Thread] = []
 
     # ------------------------------------------------------------ lifecycle
@@ -382,6 +383,81 @@ class Client:
                 f.seek(offset)
             return f.read(limit if limit >= 0 else -1)
 
+    # ------------------------------------------------------- exec streams
+
+    def alloc_exec_start(self, alloc_id: str, task: str, command: list,
+                         tty: bool = False) -> str:
+        """Open an interactive exec session inside a running task (ref
+        client/alloc_endpoint.go exec + drivers ExecTaskStreaming).
+        Returns a session id for the stdin/output/close calls."""
+        ar = self._runner(alloc_id)
+        tr = ar.task_runners.get(task)
+        if tr is None or tr.handle is None:
+            raise ValueError(f"task {task!r} is not running")
+        session = tr.driver.exec_task(
+            tr.handle.task_id, list(command), tty=tty,
+            cwd=tr.task_dir, env=tr.env)
+        sid = new_id()
+        with self._lock:
+            self._exec_sessions[sid] = [session, time.monotonic()]
+        return sid
+
+    def _exec_session(self, sid: str):
+        with self._lock:
+            entry = self._exec_sessions.get(sid)
+            if entry is None:
+                raise KeyError(f"unknown exec session {sid!r}")
+            entry[1] = time.monotonic()      # any touch counts as activity
+            return entry[0]
+
+    def alloc_exec_stdin(self, sid: str, data: bytes) -> None:
+        self._exec_session(sid).write_stdin(data)
+
+    def alloc_exec_stdin_close(self, sid: str) -> None:
+        """EOF the session's stdin (stdin-consuming commands like `cat`
+        terminate on it; ref exec streaming close of the stdin frame)."""
+        self._exec_session(sid).close_stdin()
+
+    def alloc_exec_output(self, sid: str, wait: float = 1.0) -> dict:
+        return self._exec_session(sid).read_output(wait=min(wait, 30.0))
+
+    def alloc_exec_resize(self, sid: str, rows: int, cols: int) -> None:
+        self._exec_session(sid).resize(rows, cols)
+
+    def alloc_exec_close(self, sid: str) -> None:
+        with self._lock:
+            entry = self._exec_sessions.pop(sid, None)
+        if entry is not None:
+            entry[0].terminate()
+
+    def _reap_exec_sessions(self) -> None:
+        """Abandoned sessions are terminated by the GC tick. Idle is
+        measured from LAST ACTIVITY (any stdin/output/resize touch), so
+        a polling client never loses the tail output of a long command
+        and an active interactive shell is never reaped."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [sid for sid, (s, last) in self._exec_sessions.items()
+                     if (s.exit_code is not None and now - last > 300)
+                     or now - last > 3600]
+            for sid in stale:
+                s, _ = self._exec_sessions.pop(sid)
+                s.terminate()
+
+    def fs_logs_follow(self, alloc_id: str, task: str,
+                       log_type: str = "stdout", offset: int = 0,
+                       wait: float = 10.0) -> tuple[bytes, int]:
+        """Long-poll tail of a task log (ref fs_endpoint.go Logs with
+        follow=true): blocks until bytes exist past `offset` or the wait
+        expires; returns (data, next_offset)."""
+        deadline = time.monotonic() + min(wait, 30.0)
+        while True:
+            data = self.fs_logs(alloc_id, task, log_type, offset, "start",
+                                -1)
+            if data or time.monotonic() >= deadline:
+                return data, offset + len(data)
+            time.sleep(0.1)
+
     def fs_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
                 offset: int = 0, origin: str = "start",
                 limit: int = -1) -> bytes:
@@ -456,6 +532,7 @@ class Client:
         while not self._shutdown.wait(self.gc_interval_sec):
             try:
                 self._gc_check()
+                self._reap_exec_sessions()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: gc pass failed: {e!r}")
 

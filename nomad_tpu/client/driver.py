@@ -42,6 +42,132 @@ class ExitResult:
         return self.exit_code == 0 and self.signal == 0 and not self.err
 
 
+class ExecSession:
+    """One interactive exec-into-task stream (ref
+    plugins/drivers/driver.go:69 ExecTaskStreaming,
+    drivers/shared/executor ExecStreaming): a subprocess sharing the
+    task's dir/env, optionally under a PTY, with non-blocking output
+    drains feeding a bounded buffer."""
+
+    def __init__(self, argv: list[str], cwd: str, env: dict[str, str],
+                 tty: bool = False):
+        import subprocess as sp
+        self.tty = tty
+        self._lock = threading.Lock()
+        self._stdout = bytearray()
+        self._stderr = bytearray()
+        self._data = threading.Condition(self._lock)
+        self.exit_code: Optional[int] = None
+        full_env = dict(os.environ)
+        full_env.update(env)
+        if tty:
+            import pty
+            self._master, slave = pty.openpty()
+            self.proc = sp.Popen(argv, cwd=cwd, env=full_env,
+                                 stdin=slave, stdout=slave, stderr=slave,
+                                 start_new_session=True, close_fds=True)
+            os.close(slave)
+            threading.Thread(target=self._drain_pty, daemon=True).start()
+        else:
+            self._master = None
+            self.proc = sp.Popen(argv, cwd=cwd, env=full_env,
+                                 stdin=sp.PIPE, stdout=sp.PIPE,
+                                 stderr=sp.PIPE, start_new_session=True)
+            threading.Thread(target=self._drain, daemon=True,
+                             args=(self.proc.stdout, self._stdout)).start()
+            threading.Thread(target=self._drain, daemon=True,
+                             args=(self.proc.stderr, self._stderr)).start()
+        threading.Thread(target=self._reap, daemon=True).start()
+
+    def _drain(self, pipe, buf: bytearray) -> None:
+        while True:
+            chunk = pipe.read1(65536) if hasattr(pipe, "read1") else \
+                pipe.read(65536)
+            if not chunk:
+                break
+            with self._data:
+                buf.extend(chunk)
+                self._data.notify_all()
+
+    def _drain_pty(self) -> None:
+        while True:
+            try:
+                chunk = os.read(self._master, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            with self._data:
+                self._stdout.extend(chunk)
+                self._data.notify_all()
+
+    def _reap(self) -> None:
+        code = self.proc.wait()
+        # give the drain threads a beat to flush the tail
+        time.sleep(0.05)
+        with self._data:
+            self.exit_code = code if code >= 0 else 128 - code
+            self._data.notify_all()
+
+    def write_stdin(self, data: bytes) -> None:
+        if self.tty:
+            os.write(self._master, data)
+        elif self.proc.stdin:
+            try:
+                self.proc.stdin.write(data)
+                self.proc.stdin.flush()
+            except (BrokenPipeError, ValueError):
+                pass
+
+    def close_stdin(self) -> None:
+        if not self.tty and self.proc.stdin:
+            try:
+                self.proc.stdin.close()
+            except OSError:
+                pass
+
+    def resize(self, rows: int, cols: int) -> None:
+        """ref drivers/driver.go TaskResizeCh"""
+        if self._master is None:
+            return
+        import fcntl
+        import struct
+        import termios
+        fcntl.ioctl(self._master, termios.TIOCSWINSZ,
+                    struct.pack("HHHH", rows, cols, 0, 0))
+
+    def read_output(self, wait: float = 0.0) -> dict:
+        """Drain buffered output. Blocks up to `wait` seconds for new
+        data or exit. -> {stdout, stderr, exited, exit_code}"""
+        deadline = time.monotonic() + wait
+        with self._data:
+            while not self._stdout and not self._stderr and \
+                    self.exit_code is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._data.wait(left)
+            out = bytes(self._stdout)
+            err = bytes(self._stderr)
+            self._stdout.clear()
+            self._stderr.clear()
+            return {"stdout": out, "stderr": err,
+                    "exited": self.exit_code is not None,
+                    "exit_code": self.exit_code}
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if self._master is not None:
+            try:
+                os.close(self._master)
+            except OSError:
+                pass
+
+
 class Driver:
     name = "driver"
 
@@ -73,6 +199,19 @@ class Driver:
         """Point-in-time resource usage (ref DriverPlugin.TaskStats):
         {"cpu_percent": float, "memory_rss_bytes": int}."""
         return {"cpu_percent": 0.0, "memory_rss_bytes": 0}
+
+    def exec_task(self, task_id: str, command: list[str], tty: bool = False,
+                  cwd: str = "", env: Optional[dict] = None) -> ExecSession:
+        """Interactive exec inside the task's context (ref
+        plugins/drivers/driver.go:577 ExecTaskStreamingRaw). The base
+        implementation spawns a host process in the task dir with the
+        task env — correct for every host-process driver (raw_exec, mock,
+        exec-without-namespaces); containerized drivers override to enter
+        the task's isolation context."""
+        if not command:
+            raise ValueError("exec requires a command")
+        return ExecSession(list(command), cwd=cwd or os.getcwd(),
+                           env=env or {}, tty=tty)
 
     def inspect_task(self, task_id: str) -> Optional[TaskHandle]:
         return None

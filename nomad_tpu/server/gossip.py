@@ -221,41 +221,49 @@ class Gossip:
             msg = self._unpack(data)
             if msg is None:
                 continue
-            for upd in msg.get("updates", ()):
-                self._apply_update(upd)
-            t = msg.get("t")
-            if t == "ping":
-                self._send(addr, {"t": "ack", "seq": msg.get("seq")})
-            elif t == "ping-req":
-                # indirect probe on behalf of `from`
-                target = tuple(msg.get("target", ()))
-                seq = msg.get("seq")
-                origin = addr
+            try:
+                self._handle_msg(msg, addr)
+            except Exception as e:      # noqa: BLE001 - a malformed (but
+                # authenticated) message from a skewed peer must not kill
+                # the receive thread and leave this node deaf
+                self.logger(f"gossip: bad message from {addr}: {e!r}")
 
-                def relay(target=target, seq=seq, origin=origin):
-                    ok = self._ping(target)
-                    if ok:
-                        self._send(origin, {"t": "ack", "seq": seq})
-                threading.Thread(target=relay, daemon=True).start()
-            elif t == "ack":
-                ev = self._acks.get(msg.get("seq"))
-                if ev is not None:
-                    ev.set()
-            elif t == "push-pull":
-                for wire in msg.get("members", ()):
-                    self._apply_update(wire)
-                with self._lock:
-                    wire_members = [m.to_wire() for m in
-                                    self.members.values()]
-                self._send(addr, {"t": "push-pull-ack",
-                                  "seq": msg.get("seq"),
-                                  "members": wire_members})
-            elif t == "push-pull-ack":
-                for wire in msg.get("members", ()):
-                    self._apply_update(wire)
-                ev = self._acks.get(msg.get("seq"))
-                if ev is not None:
-                    ev.set()
+    def _handle_msg(self, msg: dict, addr: tuple) -> None:
+        for upd in msg.get("updates", ()):
+            self._apply_update(upd)
+        t = msg.get("t")
+        if t == "ping":
+            self._send(addr, {"t": "ack", "seq": msg.get("seq")})
+        elif t == "ping-req":
+            # indirect probe on behalf of `from`
+            target = tuple(msg.get("target", ()))
+            seq = msg.get("seq")
+            origin = addr
+
+            def relay(target=target, seq=seq, origin=origin):
+                ok = self._ping(target)
+                if ok:
+                    self._send(origin, {"t": "ack", "seq": seq})
+            threading.Thread(target=relay, daemon=True).start()
+        elif t == "ack":
+            ev = self._acks.get(msg.get("seq"))
+            if ev is not None:
+                ev.set()
+        elif t == "push-pull":
+            for wire in msg.get("members", ()):
+                self._apply_update(wire)
+            with self._lock:
+                wire_members = [m.to_wire() for m in
+                                self.members.values()]
+            self._send(addr, {"t": "push-pull-ack",
+                              "seq": msg.get("seq"),
+                              "members": wire_members})
+        elif t == "push-pull-ack":
+            for wire in msg.get("members", ()):
+                self._apply_update(wire)
+            ev = self._acks.get(msg.get("seq"))
+            if ev is not None:
+                ev.set()
 
     def _ping(self, addr: tuple, timeout: Optional[float] = None) -> bool:
         with self._lock:

@@ -311,6 +311,21 @@ class RaftNode:
                 raise NotLeaderError(self.leader_addr)
             return index
 
+    def bootstrap_with(self, peers: dict[str, str]) -> bool:
+        """One-shot cluster bootstrap with a full initial configuration
+        (ref serf maybeBootstrap -> raft.BootstrapCluster): every server
+        of a bootstrap_expect=N group calls this with the SAME sorted
+        member set once gossip has found N servers, then elections run
+        over that config. No-op unless this node is still pristine."""
+        with self._lock:
+            if self._last_index() > 0 or len(self.peers) > 1:
+                return False            # already part of a cluster
+            self.peers = dict(peers)
+            self._base_peers = dict(peers)
+            self.bootstrap = True
+            self._persist_meta()
+            return True
+
     def add_peer(self, peer_id: str, addr: str, timeout: float = 30.0) -> int:
         """Single-entry membership addition (ref raft AddVoter / agent
         join): replicate a _config_add entry; the leader starts replicating

@@ -130,6 +130,10 @@ class Server:
         # management token of the authoritative region used by the ACL
         # replication loop (ref config acl.replication_token)
         self.replication_token = ""
+        # serf-style bootstrap_expect: >1 means wait until gossip sees
+        # that many same-region servers, then all bootstrap with the
+        # same config (ref nomad/serf.go maybeBootstrap)
+        self.bootstrap_expect = 1
         self.name = name or f"server-{new_id()[:8]}"
         self.fsm = NomadFSM()
         self.state: StateStore = self.fsm.state
@@ -274,11 +278,35 @@ class Server:
         out = {self.region} | set(self.region_servers)
         return sorted(out)
 
+    def _maybe_bootstrap(self) -> None:
+        """ref nomad/serf.go maybeBootstrap: once bootstrap_expect
+        same-region servers are visible, every one of them bootstraps
+        raft with the identical (sorted) initial configuration."""
+        if self.raft_node is None or self.bootstrap_expect <= 1 or \
+                self.raft_node.bootstrap:
+            return
+        if self.gossip is None:
+            return
+        servers = {}
+        for m in self.gossip.alive_members():
+            t = m.tags
+            if t.get("role") == "nomad-server" and \
+                    t.get("region", "") == self.region and \
+                    t.get("id") and t.get("rpc_addr"):
+                servers[t["id"]] = t["rpc_addr"]
+        if len(servers) >= self.bootstrap_expect:
+            peers = dict(sorted(servers.items()))
+            if self.raft_node.bootstrap_with(peers):
+                self.logger(
+                    f"server: bootstrap_expect={self.bootstrap_expect} "
+                    f"reached; bootstrapping with {sorted(peers)}")
+
     def _on_gossip_join(self, member) -> None:
         """ref nomad/serf.go:98 nodeJoin (+ maybeBootstrap)"""
         tags = member.tags
         if tags.get("role") != "nomad-server":
             return
+        self._maybe_bootstrap()
         region = tags.get("region", "")
         if region != self.region:
             self.region_servers.setdefault(region, {})[member.name] = \

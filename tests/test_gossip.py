@@ -203,6 +203,39 @@ def test_three_servers_auto_discover_and_survive_kill(tmp_path):
                 s.gossip.shutdown()
 
 
+def test_bootstrap_expect_three_servers(tmp_path):
+    """The reference idiom: every server gets the SAME -bootstrap-expect N
+    and none self-elects until gossip has found N of them; then all
+    bootstrap with one identical config (serf.go maybeBootstrap)."""
+    servers = [_mk_server(name=f"be{i}") for i in range(3)]
+    try:
+        for i, s in enumerate(servers):
+            s.bootstrap_expect = 3
+            s.enable_raft(s.name, {s.name: s.rpc_addr},
+                          data_dir=str(tmp_path / f"be{i}"),
+                          bootstrap=False, **FAST)
+            s.start()
+            s.gossip_listen()
+        # nobody elects while alone
+        time.sleep(1.2)
+        assert not any(s.raft_node.is_leader() for s in servers)
+        seed = servers[0].gossip.addr
+        for s in servers[1:]:
+            s.gossip_join([seed])
+        leader = wait_stable_leader(servers, timeout=15)
+        assert sorted(leader.raft_node.peers) == ["be0", "be1", "be2"]
+        job = mock.job()
+        leader.job_register(job)
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", job.id) is not None
+            for s in servers), timeout=10)
+    finally:
+        shutdown_all(servers)
+        for s in servers:
+            if s.gossip:
+                s.gossip.shutdown()
+
+
 # -------------------------------------------------- regions / federation
 
 def test_two_region_federation_and_forwarding():
