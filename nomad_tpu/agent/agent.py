@@ -42,6 +42,7 @@ class AgentConfig:
     # with the same config (safe to pass the same N to every server)
     bootstrap_expect: int = 1
     replication_token: str = ""        # ACL replication auth (federation)
+    plugin_dir: str = ""               # external driver plugin executables
 
     def key_bytes(self) -> bytes:
         from ..rpc.server import DEFAULT_KEY
@@ -91,7 +92,8 @@ class Agent:
                 datacenter=self.config.datacenter,
                 node_class=self.config.node_class,
                 name=self.config.node_name,
-                logger=self.logger)
+                logger=self.logger,
+                plugin_dir=self.config.plugin_dir)
         self.api = HTTPAPI(self)
 
     def start(self) -> None:

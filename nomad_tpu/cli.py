@@ -98,7 +98,8 @@ def cmd_agent(args) -> None:
                       join=tuple(getattr(args, "join", []) or ()),
                       bootstrap_expect=getattr(args, "bootstrap_expect", 1),
                       replication_token=getattr(args, "replication_token",
-                                                ""))
+                                                ""),
+                      plugin_dir=getattr(args, "plugin_dir", ""))
     agent = Agent(cfg, logger=lambda m: print(f"    {m}", flush=True))
     agent.start()
     mode = []
@@ -411,7 +412,17 @@ def cmd_alloc_status(args) -> None:
 
 def _alloc_task(alloc_id: str, task: str) -> tuple[str, str]:
     """Resolve (full alloc id, task name) from a possibly-short id."""
-    a = api("GET", f"/v1/allocation/{alloc_id}")
+    try:
+        a = api("GET", f"/v1/allocation/{alloc_id}")
+    except SystemExit:
+        a = None
+    if not a:
+        matches = [x for x in (api("GET", "/v1/allocations") or [])
+                   if x["ID"].startswith(alloc_id)]
+        if len(matches) != 1:
+            _die(f"allocation {alloc_id!r} matched "
+                 f"{len(matches)} allocations")
+        a = api("GET", f"/v1/allocation/{matches[0]['ID']}")
     if not task:
         states = a.get("TaskStates") or {}
         if len(states) == 1:
@@ -435,24 +446,28 @@ def cmd_alloc_exec(args) -> None:
             args.task = rest.pop(0)
         elif flag == "-tty":
             args.tty = True
-    command = [c for c in rest if c != "--"]
+    if rest and rest[0] == "--":        # only the SEPARATOR is stripped:
+        rest = rest[1:]                 # later '--' belong to the command
+    command = rest
     if not command:
         _die("command required, e.g.: alloc exec <id> -task web -- /bin/sh")
     alloc_id, task = _alloc_task(args.alloc_id, args.task)
     out = api("POST", f"/v1/client/allocation/{alloc_id}/exec",
               {"Task": task, "Cmd": command, "Tty": args.tty})
     sid = out["SessionID"]
+    stdin_open = True
     try:
         while True:
             # pump any ready local stdin to the remote session
-            if select.select([sys.stdin], [], [], 0)[0]:
+            if stdin_open and select.select([sys.stdin], [], [], 0)[0]:
                 line = sys.stdin.buffer.readline()
                 if line:
                     api("POST", f"/v1/client/exec-session/{sid}",
                         {"Stdin": base64.b64encode(line).decode()})
-                else:                    # local EOF -> remote EOF
+                else:                    # local EOF -> remote EOF, once
                     api("POST", f"/v1/client/exec-session/{sid}",
                         {"StdinEOF": True})
+                    stdin_open = False
             chunk = api("GET", f"/v1/client/exec-session/{sid}?wait=0.5")
             data = base64.b64decode(chunk.get("Stdout", ""))
             err = base64.b64decode(chunk.get("Stderr", ""))
@@ -715,6 +730,8 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-replication-token", dest="replication_token",
                     default="", help="management token of the "
                     "authoritative region (ACL replication)")
+    ag.add_argument("-plugin-dir", dest="plugin_dir", default="",
+                    help="directory of external driver plugin executables")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job")

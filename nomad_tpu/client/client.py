@@ -27,7 +27,7 @@ class Client:
     def __init__(self, rpc, data_dir: str, datacenter: str = "dc1",
                  node_class: str = "", name: str = "",
                  drivers: Optional[dict[str, Driver]] = None,
-                 logger=None):
+                 logger=None, plugin_dir: str = ""):
         self.rpc = rpc
         self.data_dir = data_dir
         self.alloc_dir_root = os.path.join(data_dir, "allocs")
@@ -37,6 +37,14 @@ class Client:
         self.state_db = StateDB(os.path.join(data_dir, "client_state.db"))
         self.drivers: dict[str, Driver] = drivers if drivers is not None \
             else {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+        # external plugin drivers (ref client config plugin_dir +
+        # go-plugin Discover): subprocess drivers join the same registry
+        if plugin_dir:
+            from .plugin_host import discover_plugins
+            self.plugin_drivers = discover_plugins(plugin_dir, self.logger)
+            self.drivers.update(self.plugin_drivers)
+        else:
+            self.plugin_drivers = {}
 
         from .csimanager import CSIManager
         self.csi_manager = CSIManager(self)
@@ -101,6 +109,8 @@ class Client:
         for ar in runners:
             for tr in list(ar.task_runners.values()):
                 tr.kill("client shutting down")
+        for drv in self.plugin_drivers.values():
+            drv.shutdown()
 
     # ---------------------------------------------------------- registration
 
@@ -452,6 +462,15 @@ class Client:
         expires; returns (data, next_offset)."""
         deadline = time.monotonic() + min(wait, 30.0)
         while True:
+            # logmon copy-truncates on rotation: a shrunken file means
+            # our offset points past EOF of the NEW file — restart from
+            # its beginning instead of polling empty reads forever
+            try:
+                st = self.fs_stat(alloc_id, f"{task}/{task}.{log_type}.log")
+                if int(st.get("Size", 0)) < offset:
+                    offset = 0
+            except (ValueError, OSError, KeyError):
+                pass
             data = self.fs_logs(alloc_id, task, log_type, offset, "start",
                                 -1)
             if data or time.monotonic() >= deadline:

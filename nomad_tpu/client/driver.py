@@ -60,6 +60,7 @@ class ExecSession:
         self.exit_code: Optional[int] = None
         full_env = dict(os.environ)
         full_env.update(env)
+        self._drainers: list[threading.Thread] = []
         if tty:
             import pty
             self._master, slave = pty.openpty()
@@ -67,16 +68,20 @@ class ExecSession:
                                  stdin=slave, stdout=slave, stderr=slave,
                                  start_new_session=True, close_fds=True)
             os.close(slave)
-            threading.Thread(target=self._drain_pty, daemon=True).start()
+            t = threading.Thread(target=self._drain_pty, daemon=True)
+            t.start()
+            self._drainers.append(t)
         else:
             self._master = None
             self.proc = sp.Popen(argv, cwd=cwd, env=full_env,
                                  stdin=sp.PIPE, stdout=sp.PIPE,
                                  stderr=sp.PIPE, start_new_session=True)
-            threading.Thread(target=self._drain, daemon=True,
-                             args=(self.proc.stdout, self._stdout)).start()
-            threading.Thread(target=self._drain, daemon=True,
-                             args=(self.proc.stderr, self._stderr)).start()
+            for pipe, buf in ((self.proc.stdout, self._stdout),
+                              (self.proc.stderr, self._stderr)):
+                t = threading.Thread(target=self._drain, daemon=True,
+                                     args=(pipe, buf))
+                t.start()
+                self._drainers.append(t)
         threading.Thread(target=self._reap, daemon=True).start()
 
     def _drain(self, pipe, buf: bytearray) -> None:
@@ -103,8 +108,11 @@ class ExecSession:
 
     def _reap(self) -> None:
         code = self.proc.wait()
-        # give the drain threads a beat to flush the tail
-        time.sleep(0.05)
+        # exit_code is only published AFTER the drain threads hit EOF, so
+        # "exited with no pending output" really means all output was
+        # delivered (a fixed sleep would race large final bursts)
+        for t in self._drainers:
+            t.join(timeout=5.0)
         with self._data:
             self.exit_code = code if code >= 0 else 128 - code
             self._data.notify_all()
@@ -120,7 +128,14 @@ class ExecSession:
                 pass
 
     def close_stdin(self) -> None:
-        if not self.tty and self.proc.stdin:
+        if self.tty:
+            # a PTY has no half-close: deliver EOF as the line
+            # discipline's VEOF character (^D)
+            try:
+                os.write(self._master, b"\x04")
+            except OSError:
+                pass
+        elif self.proc.stdin:
             try:
                 self.proc.stdin.close()
             except OSError:

@@ -1,0 +1,248 @@
+"""Out-of-process driver plugin fabric (ref plugins/base/proto/base.proto,
+hashicorp/go-plugin): third-party task drivers run as SEPARATE PROCESSES
+speaking a socket RPC, so a crashing or misbehaving driver cannot take the
+client agent down, and drivers can be written/shipped independently.
+
+Protocol (the go-plugin handshake, re-designed for a zero-dependency
+stack):
+  1. The host launches the plugin executable with NOMAD_TPU_PLUGIN_MAGIC
+     in its environment (plugins refuse to run standalone without it, ref
+     go-plugin's magic cookie).
+  2. The plugin binds a unix socket and prints ONE handshake line on
+     stdout: ``NOMAD_TPU_PLUGIN|<proto-versions>|<socket-path>`` where
+     proto-versions is a comma list of protocol versions it speaks.
+  3. The host picks the highest common version (negotiation, ref
+     base.proto NegotiatedVersion) and connects.
+  4. RPC: length-prefixed JSON frames {"id", "method", "params"} ->
+     {"id", "result"} | {"id", "error"}. Driver structs cross the wire in
+     API shape (api_codec), exactly like the reference's protobuf DTOs.
+  5. PluginInfo / Fingerprint / the DriverPlugin method family dispatch
+     to the plugin author's Driver subclass (plugin_runtime.serve_driver).
+
+The host wraps each plugin in ExternalDriver, which implements the same
+in-process Driver interface the schedulers already use — callers cannot
+tell a subprocess driver from a built-in.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Optional
+
+from ..structs import DriverInfo
+from .driver import Driver, ExitResult, TaskHandle
+
+MAGIC_ENV = "NOMAD_TPU_PLUGIN_MAGIC"
+MAGIC_VALUE = "nomad-tpu-driver-plugin-v1"
+HANDSHAKE_PREFIX = "NOMAD_TPU_PLUGIN|"
+SUPPORTED_PROTOCOLS = (1,)
+
+
+class PluginError(Exception):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    raw = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    if n > 64 * 1024 * 1024:
+        raise PluginError(f"oversized plugin frame ({n} bytes)")
+    raw = b""
+    while len(raw) < n:
+        chunk = sock.recv(n - len(raw))
+        if not chunk:
+            return None
+        raw += chunk
+    return json.loads(raw.decode())
+
+
+class ExternalDriver(Driver):
+    """Host-side proxy for one plugin process: the in-process Driver
+    interface implemented by socket RPC to the subprocess."""
+
+    def __init__(self, command: list[str], logger=None,
+                 start_timeout: float = 10.0):
+        self.command = list(command)
+        self.logger = logger or (lambda msg: None)
+        self.start_timeout = start_timeout
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self._sock: Optional[socket.socket] = None
+        self.protocol_version = 0
+        self.info: dict = {}
+        self.name = os.path.basename(command[0])
+        self._launch()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _launch(self) -> None:
+        env = dict(os.environ)
+        env[MAGIC_ENV] = MAGIC_VALUE
+        self.proc = subprocess.Popen(
+            self.command, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        line = ""
+        deadline = time.monotonic() + self.start_timeout
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline().decode().strip()
+            if line:
+                break
+        if not line.startswith(HANDSHAKE_PREFIX):
+            self.shutdown()
+            raise PluginError(f"bad plugin handshake: {line!r}")
+        _, versions, sock_path = line.split("|", 2)
+        offered = {int(v) for v in versions.split(",") if v}
+        common = offered & set(SUPPORTED_PROTOCOLS)
+        if not common:
+            self.shutdown()
+            raise PluginError(
+                f"no common protocol version (plugin offers {sorted(offered)},"
+                f" host speaks {list(SUPPORTED_PROTOCOLS)})")
+        self.protocol_version = max(common)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(30.0)
+        self._sock.connect(sock_path)
+        self.sock_path = sock_path
+        # exchange PluginInfo (ref base.proto PluginInfo: type/version/name)
+        self.info = self._call("PluginInfo")
+        if self.info.get("type") != "driver":
+            self.shutdown()
+            raise PluginError(f"not a driver plugin: {self.info}")
+        self.name = self.info.get("name", self.name)
+
+    def shutdown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._call("Shutdown")
+            except Exception:           # noqa: BLE001
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    # ----------------------------------------------------------- transport
+
+    def _call(self, method: str, **params):
+        with self._lock:
+            if self._sock is None:
+                raise PluginError(f"plugin {self.name!r} not connected")
+            self._seq += 1
+            _send_frame(self._sock, {"id": self._seq, "method": method,
+                                     "params": params})
+            resp = _recv_frame(self._sock)
+        if resp is None:
+            raise PluginError(f"plugin {self.name!r} closed the connection")
+        if resp.get("error"):
+            kind = resp.get("kind", "")
+            if kind == "ValueError":
+                raise ValueError(resp["error"])
+            raise PluginError(resp["error"])
+        return resp.get("result")
+
+    # ------------------------------------------------------ Driver surface
+
+    def fingerprint(self) -> DriverInfo:
+        try:
+            out = self._call("Fingerprint")
+            return DriverInfo(detected=bool(out.get("detected")),
+                              healthy=bool(out.get("healthy")),
+                              attributes=dict(out.get("attributes", {})))
+        except Exception:               # noqa: BLE001 - dead plugin
+            return DriverInfo(detected=False, healthy=False)
+
+    def start_task(self, task_id, task, task_dir, env) -> TaskHandle:
+        from ..api_codec import to_api
+        out = self._call("StartTask", task_id=task_id, task=to_api(task),
+                         task_dir=task_dir, env=dict(env))
+        h = TaskHandle(task_id=task_id, driver=self.name,
+                       pid=int(out.get("pid", 0)),
+                       started_at=float(out.get("started_at", time.time())))
+        h.config["plugin_sock"] = self.sock_path
+        return h
+
+    def wait_task(self, task_id, timeout=None) -> Optional[ExitResult]:
+        out = self._call("WaitTask", task_id=task_id, timeout=timeout)
+        if out is None:
+            return None
+        return ExitResult(exit_code=int(out.get("exit_code", 0)),
+                          signal=int(out.get("signal", 0)),
+                          err=out.get("err", ""))
+
+    def stop_task(self, task_id, kill_timeout=5.0, sig="") -> None:
+        self._call("StopTask", task_id=task_id, kill_timeout=kill_timeout,
+                   sig=sig)
+
+    def destroy_task(self, task_id) -> None:
+        try:
+            self._call("DestroyTask", task_id=task_id)
+        except PluginError:
+            pass
+
+    def signal_task(self, task_id, sig) -> None:
+        self._call("SignalTask", task_id=task_id, sig=sig)
+
+    def task_stats(self, task_id) -> dict:
+        return self._call("TaskStats", task_id=task_id) or {}
+
+    def inspect_task(self, task_id) -> Optional[TaskHandle]:
+        out = self._call("InspectTask", task_id=task_id)
+        if out is None:
+            return None
+        return TaskHandle(task_id=task_id, driver=self.name,
+                          pid=int(out.get("pid", 0)))
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        try:
+            return bool(self._call("RecoverTask", task_id=handle.task_id,
+                                   pid=handle.pid))
+        except PluginError:
+            return False
+
+
+def discover_plugins(plugin_dir: str, logger=None) -> dict[str, ExternalDriver]:
+    """Launch every executable in plugin_dir as a driver plugin (ref
+    client config plugin_dir + go-plugin Discover). Failures are logged
+    and skipped — one bad plugin must not stop the client."""
+    log = logger or (lambda msg: None)
+    out: dict[str, ExternalDriver] = {}
+    if not plugin_dir or not os.path.isdir(plugin_dir):
+        return out
+    for entry in sorted(os.listdir(plugin_dir)):
+        path = os.path.join(plugin_dir, entry)
+        if not os.path.isfile(path) or not os.access(path, os.X_OK):
+            continue
+        try:
+            drv = ExternalDriver([path], logger=log)
+            out[drv.name] = drv
+            log(f"client: loaded external driver plugin {drv.name!r} "
+                f"(protocol v{drv.protocol_version})")
+        except Exception as e:          # noqa: BLE001
+            log(f"client: plugin {entry!r} failed to load: {e}")
+    return out

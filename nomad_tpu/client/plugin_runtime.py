@@ -1,0 +1,133 @@
+"""Driver plugin SDK: host a Driver implementation as an external plugin
+process (ref plugins/base/plugin.go Serve + plugins/drivers gRPC server).
+
+A third-party driver is a Python script:
+
+    from nomad_tpu.client.driver import Driver
+    from nomad_tpu.client.plugin_runtime import serve_driver
+
+    class MyDriver(Driver):
+        name = "my-driver"
+        ...
+
+    if __name__ == "__main__":
+        serve_driver(MyDriver())
+
+The host (client agent) launches it, reads the handshake line, and
+proxies the Driver interface over the unix socket (see plugin_host.py
+for the frame protocol)."""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import sys
+import tempfile
+import threading
+
+from .plugin_host import (
+    HANDSHAKE_PREFIX, MAGIC_ENV, MAGIC_VALUE, SUPPORTED_PROTOCOLS,
+    _recv_frame, _send_frame,
+)
+
+
+def serve_driver(driver, version: str = "0.1.0") -> None:
+    """Blocking: announce the handshake and serve driver RPCs until the
+    host disconnects or sends Shutdown."""
+    if os.environ.get(MAGIC_ENV) != MAGIC_VALUE:
+        print("This binary is a nomad_tpu driver plugin and must be "
+              "launched by the client agent, not run directly.",
+              file=sys.stderr)
+        sys.exit(1)
+
+    sock_path = os.path.join(
+        tempfile.mkdtemp(prefix="nomad-plugin-"), "plugin.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(4)
+    versions = ",".join(str(v) for v in SUPPORTED_PROTOCOLS)
+    print(f"{HANDSHAKE_PREFIX}{versions}|{sock_path}", flush=True)
+
+    stop = threading.Event()
+
+    def handle(conn: socket.socket) -> None:
+        from ..api_codec import from_api
+        from ..structs.job import Task
+        while not stop.is_set():
+            try:
+                req = _recv_frame(conn)
+            except (OSError, ValueError):
+                return
+            if req is None:
+                return
+            rid = req.get("id")
+            method = req.get("method", "")
+            params = req.get("params", {}) or {}
+            try:
+                if method == "PluginInfo":
+                    result = {"type": "driver", "name": driver.name,
+                              "version": version,
+                              "protocols": list(SUPPORTED_PROTOCOLS)}
+                elif method == "Shutdown":
+                    result = {}
+                    stop.set()
+                elif method == "Fingerprint":
+                    fp = driver.fingerprint()
+                    result = {"detected": fp.detected,
+                              "healthy": fp.healthy,
+                              "attributes": dict(fp.attributes)}
+                elif method == "StartTask":
+                    task = from_api(Task, params["task"])
+                    h = driver.start_task(params["task_id"], task,
+                                          params["task_dir"],
+                                          params.get("env", {}))
+                    result = {"pid": h.pid, "started_at": h.started_at}
+                elif method == "WaitTask":
+                    r = driver.wait_task(params["task_id"],
+                                         params.get("timeout"))
+                    result = None if r is None else {
+                        "exit_code": r.exit_code, "signal": r.signal,
+                        "err": r.err}
+                elif method == "StopTask":
+                    driver.stop_task(params["task_id"],
+                                     params.get("kill_timeout", 5.0),
+                                     params.get("sig", ""))
+                    result = {}
+                elif method == "DestroyTask":
+                    driver.destroy_task(params["task_id"])
+                    result = {}
+                elif method == "SignalTask":
+                    driver.signal_task(params["task_id"], params["sig"])
+                    result = {}
+                elif method == "TaskStats":
+                    result = driver.task_stats(params["task_id"])
+                elif method == "InspectTask":
+                    h = driver.inspect_task(params["task_id"])
+                    result = None if h is None else {"pid": h.pid}
+                elif method == "RecoverTask":
+                    from .driver import TaskHandle
+                    result = driver.recover_task(TaskHandle(
+                        task_id=params["task_id"], driver=driver.name,
+                        pid=int(params.get("pid", 0))))
+                else:
+                    raise ValueError(f"unknown plugin method {method!r}")
+                _send_frame(conn, {"id": rid, "result": result})
+            except Exception as e:      # noqa: BLE001 - report, keep serving
+                _send_frame(conn, {"id": rid, "error": str(e),
+                                   "kind": type(e).__name__})
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    while not stop.is_set():
+        try:
+            srv.settimeout(0.5)
+            conn, _ = srv.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        threading.Thread(target=handle, args=(conn,), daemon=True).start()
+    srv.close()
