@@ -97,34 +97,60 @@ class ExternalDriver(Driver):
         self.proc = subprocess.Popen(
             self.command, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, start_new_session=True)
-        line = ""
+        # ANY failure below must reap the subprocess — discover_plugins
+        # logs and continues, and an orphaned plugin would outlive the
+        # agent otherwise
+        try:
+            line = self._read_handshake()
+            if not line.startswith(HANDSHAKE_PREFIX):
+                raise PluginError(f"bad plugin handshake: {line!r}")
+            try:
+                _, versions, sock_path = line.split("|", 2)
+                offered = {int(v) for v in versions.split(",") if v}
+            except ValueError as e:
+                raise PluginError(f"malformed handshake {line!r}") from e
+            common = offered & set(SUPPORTED_PROTOCOLS)
+            if not common:
+                raise PluginError(
+                    f"no common protocol version (plugin offers "
+                    f"{sorted(offered)}, host speaks "
+                    f"{list(SUPPORTED_PROTOCOLS)})")
+            self.protocol_version = max(common)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(30.0)
+            self._sock.connect(sock_path)
+            self.sock_path = sock_path
+            # PluginInfo exchange (ref base.proto PluginInfo)
+            self.info = self._call("PluginInfo")
+            if self.info.get("type") != "driver":
+                raise PluginError(f"not a driver plugin: {self.info}")
+            self.name = self.info.get("name", self.name)
+        except BaseException:
+            self.shutdown()
+            raise
+
+    def _read_handshake(self) -> str:
+        """One stdout line within start_timeout: select-bounded so a
+        silent-but-alive executable can't hang the agent, and process
+        death (EOF) fails fast instead of spinning."""
+        import select
+        fd = self.proc.stdout
+        buf = b""
         deadline = time.monotonic() + self.start_timeout
         while time.monotonic() < deadline:
-            line = self.proc.stdout.readline().decode().strip()
-            if line:
-                break
-        if not line.startswith(HANDSHAKE_PREFIX):
-            self.shutdown()
-            raise PluginError(f"bad plugin handshake: {line!r}")
-        _, versions, sock_path = line.split("|", 2)
-        offered = {int(v) for v in versions.split(",") if v}
-        common = offered & set(SUPPORTED_PROTOCOLS)
-        if not common:
-            self.shutdown()
-            raise PluginError(
-                f"no common protocol version (plugin offers {sorted(offered)},"
-                f" host speaks {list(SUPPORTED_PROTOCOLS)})")
-        self.protocol_version = max(common)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(30.0)
-        self._sock.connect(sock_path)
-        self.sock_path = sock_path
-        # exchange PluginInfo (ref base.proto PluginInfo: type/version/name)
-        self.info = self._call("PluginInfo")
-        if self.info.get("type") != "driver":
-            self.shutdown()
-            raise PluginError(f"not a driver plugin: {self.info}")
-        self.name = self.info.get("name", self.name)
+            ready, _, _ = select.select([fd], [], [],
+                                        max(0.05, deadline -
+                                            time.monotonic()))
+            if not ready:
+                continue
+            chunk = os.read(fd.fileno(), 4096)
+            if not chunk:
+                raise PluginError("plugin exited before handshake")
+            buf += chunk
+            if b"\n" in buf:
+                return buf.split(b"\n", 1)[0].decode(errors="replace").strip()
+        raise PluginError(
+            f"no handshake within {self.start_timeout}s")
 
     def shutdown(self) -> None:
         if self._sock is not None:
@@ -154,9 +180,26 @@ class ExternalDriver(Driver):
             if self._sock is None:
                 raise PluginError(f"plugin {self.name!r} not connected")
             self._seq += 1
-            _send_frame(self._sock, {"id": self._seq, "method": method,
-                                     "params": params})
-            resp = _recv_frame(self._sock)
+            seq = self._seq
+            try:
+                _send_frame(self._sock, {"id": seq, "method": method,
+                                         "params": params})
+                # drain until OUR reply: a stale frame (from an earlier
+                # timed-out call) must not be mis-delivered
+                while True:
+                    resp = _recv_frame(self._sock)
+                    if resp is None or resp.get("id") == seq:
+                        break
+            except (socket.timeout, TimeoutError) as e:
+                # the stream is now desynchronized (our reply may arrive
+                # later): drop the connection rather than misattribute it
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise PluginError(
+                    f"plugin {self.name!r} rpc {method} timed out") from e
         if resp is None:
             raise PluginError(f"plugin {self.name!r} closed the connection")
         if resp.get("error"):
@@ -181,11 +224,10 @@ class ExternalDriver(Driver):
         from ..api_codec import to_api
         out = self._call("StartTask", task_id=task_id, task=to_api(task),
                          task_dir=task_dir, env=dict(env))
-        h = TaskHandle(task_id=task_id, driver=self.name,
-                       pid=int(out.get("pid", 0)),
-                       started_at=float(out.get("started_at", time.time())))
-        h.config["plugin_sock"] = self.sock_path
-        return h
+        return TaskHandle(
+            task_id=task_id, driver=self.name,
+            pid=int(out.get("pid", 0)),
+            started_at=float(out.get("started_at", time.time())))
 
     def wait_task(self, task_id, timeout=None) -> Optional[ExitResult]:
         out = self._call("WaitTask", task_id=task_id, timeout=timeout)
@@ -240,6 +282,11 @@ def discover_plugins(plugin_dir: str, logger=None) -> dict[str, ExternalDriver]:
             continue
         try:
             drv = ExternalDriver([path], logger=log)
+            if drv.name in out:
+                log(f"client: plugin {entry!r} duplicates driver name "
+                    f"{drv.name!r}; keeping the first")
+                drv.shutdown()
+                continue
             out[drv.name] = drv
             log(f"client: loaded external driver plugin {drv.name!r} "
                 f"(protocol v{drv.protocol_version})")

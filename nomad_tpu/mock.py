@@ -5,15 +5,16 @@ test corpus builds on nomad/mock.
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
 
 from .structs import (
-    Allocation, AllocatedResources, AllocatedSharedResources,
+    Affinity, Allocation, AllocatedResources, AllocatedSharedResources,
     AllocatedTaskResources, Constraint, DriverInfo, EphemeralDisk, Evaluation,
     Job, NetworkResource, Node, NodeCpuResources, NodeDiskResources,
     NodeMemoryResources, NodeReservedResources, NodeResources, Port,
-    ReschedulePolicy, Resources, RestartPolicy, Task, TaskGroup,
-    UpdateStrategy, new_id,
+    ReschedulePolicy, Resources, RestartPolicy, Spread, SpreadTarget, Task,
+    TaskGroup, TaskLifecycle, UpdateStrategy, new_id,
     JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM, NODE_STATUS_READY,
     OP_EQ, ALLOC_DESIRED_RUN, ALLOC_CLIENT_PENDING, alloc_name,
 )
@@ -147,6 +148,146 @@ def service_job_with_update() -> Job:
                                    healthy_deadline_sec=300,
                                    progress_deadline_sec=600)
     return j
+
+
+def multi_tg_job() -> Job:
+    """Three heterogeneous task groups incl. a multi-task group (ref
+    mock.go variants used across reconcile/generic_sched tests)."""
+    j = job()
+    j.id = f"mock-multitg-{new_id()[:8]}"
+    web = j.task_groups[0]
+    web.count = 4
+    api_tg = TaskGroup(
+        name="api",
+        count=6,
+        ephemeral_disk=EphemeralDisk(size_mb=100),
+        restart_policy=RestartPolicy(attempts=3, interval_sec=600,
+                                     delay_sec=60, mode="delay"),
+        reschedule_policy=ReschedulePolicy(unlimited=True, delay_sec=5),
+        tasks=[
+            Task(name="api", driver="exec",
+                 config={"command": "/bin/date"},
+                 resources=Resources(cpu=200, memory_mb=128)),
+            Task(name="sidecar", driver="exec",
+                 config={"command": "/bin/date"},
+                 resources=Resources(cpu=50, memory_mb=64)),
+        ])
+    cache = TaskGroup(
+        name="cache",
+        count=2,
+        ephemeral_disk=EphemeralDisk(size_mb=50),
+        restart_policy=RestartPolicy(attempts=3, interval_sec=600,
+                                     delay_sec=60, mode="delay"),
+        reschedule_policy=ReschedulePolicy(unlimited=True, delay_sec=5),
+        tasks=[Task(name="redis", driver="exec",
+                    config={"command": "/bin/date"},
+                    resources=Resources(cpu=100, memory_mb=256))])
+    j.task_groups = [web, api_tg, cache]
+    return j
+
+
+def canary_job(canaries: int = 2, auto_promote: bool = False,
+               auto_revert: bool = False) -> Job:
+    """Service job whose updates go through canaries (ref mock.go Job +
+    canary update blocks in deploymentwatcher tests)."""
+    j = job()
+    j.id = f"mock-canary-{new_id()[:8]}"
+    upd = UpdateStrategy(max_parallel=2, canary=canaries,
+                         health_check="task_states",
+                         min_healthy_time_sec=0.01,
+                         healthy_deadline_sec=30,
+                         progress_deadline_sec=60,
+                         auto_promote=auto_promote,
+                         auto_revert=auto_revert)
+    j.update = upd
+    for tg in j.task_groups:
+        tg.update = dataclasses.replace(upd)
+        tg.tasks[0].resources.networks = []
+    j.task_groups[0].count = 4
+    return j
+
+
+def affinity_job() -> Job:
+    j = job()
+    j.id = f"mock-affinity-{new_id()[:8]}"
+    j.affinities = [Affinity(ltarget="${node.datacenter}", rtarget="dc1",
+                             operand=OP_EQ, weight=50)]
+    j.task_groups[0].tasks[0].resources.networks = []
+    return j
+
+
+def spread_job(attribute: str = "${node.datacenter}",
+               targets: list = None) -> Job:
+    j = job()
+    j.id = f"mock-spread-{new_id()[:8]}"
+    j.task_groups[0].spreads = [Spread(
+        attribute=attribute, weight=100,
+        spread_target=[SpreadTarget(value=v, percent=p)
+                       for v, p in (targets or [])])]
+    j.task_groups[0].tasks[0].resources.networks = []
+    return j
+
+
+def lifecycle_job() -> Job:
+    """prestart (+sidecar) / main / poststop lifecycle shape (ref
+    mock.go LifecycleJob)."""
+    j = batch_job()
+    j.id = f"mock-lifecycle-{new_id()[:8]}"
+    tg = j.task_groups[0]
+    tg.count = 1
+    main = tg.tasks[0]
+    tg.tasks = [
+        Task(name="init", driver="mock_driver",
+             config={"run_for": "0.1s"},
+             lifecycle=TaskLifecycle(hook="prestart", sidecar=False),
+             resources=Resources(cpu=50, memory_mb=32)),
+        Task(name="side", driver="mock_driver",
+             config={"run_for": "60s"},
+             lifecycle=TaskLifecycle(hook="prestart", sidecar=True),
+             resources=Resources(cpu=50, memory_mb=32)),
+        main,
+        Task(name="cleanup", driver="mock_driver",
+             config={"run_for": "0.1s"},
+             lifecycle=TaskLifecycle(hook="poststop", sidecar=False),
+             resources=Resources(cpu=50, memory_mb=32)),
+    ]
+    return j
+
+
+def big_node() -> Node:
+    n = node()
+    n.name = f"big-{n.name}"
+    n.node_resources.cpu.cpu_shares = 32_000
+    n.node_resources.memory.memory_mb = 65_536
+    n.node_class = "large"
+    n.compute_class()
+    return n
+
+
+def batch_alloc(j: Job = None, n: Node = None) -> Allocation:
+    return alloc_for(j or batch_job(), n or node())
+
+
+def failed_alloc(j: Job = None, n: Node = None) -> Allocation:
+    a = alloc_for(j or job(), n or node())
+    a.client_status = "failed"
+    return a
+
+
+def running_alloc(j: Job = None, n: Node = None) -> Allocation:
+    a = alloc_for(j or job(), n or node())
+    a.client_status = "running"
+    return a
+
+
+def deployment_for(j: Job) -> "Deployment":
+    """Active deployment tracking job's groups (ref mock.go Deployment)."""
+    from .structs import Deployment, DeploymentState
+    return Deployment(
+        id=new_id(), job_id=j.id, namespace=j.namespace,
+        job_version=j.version, status="running",
+        task_groups={tg.name: DeploymentState(
+            desired_total=tg.count) for tg in j.task_groups})
 
 
 def eval() -> Evaluation:  # noqa: A001 - mirrors mock.Eval

@@ -148,6 +148,70 @@ def test_score_dominance_heterogeneous_cluster():
         f"aggregate: tpu {agg_tpu:.4f} < host {agg_host:.4f}"
 
 
+def test_fuzz_spread_jobs_host_vs_tpu():
+    """Chunked-path (scan kernel) differential coverage: spread-stanza
+    jobs through both schedulers — all placed, no overcommit, and the
+    TPU spread imbalance across racks is no worse than the host's +1
+    (the reference's even-spread boost itself only converges to within
+    one instance per value)."""
+    from nomad_tpu.structs import Spread
+
+    def add_spread(job):
+        job.task_groups[0].spreads = [Spread(
+            attribute="${meta.rack}", weight=100)]
+
+    rng = np.random.default_rng(7)
+    for trial in range(4):
+        seed = int(rng.integers(0, 2 ** 31))
+        n_nodes = int(rng.integers(8, 20))
+        count = int(rng.integers(4, 24))
+        racks = int(rng.integers(2, 5))
+
+        def shape(n, i, _rng, racks=racks):
+            n.meta["rack"] = f"r{i % racks}"
+            n.compute_class()
+
+        def run(algorithm):
+            random.seed(seed)
+            h = Harness()
+            h.state.set_scheduler_config(
+                h.get_next_index(),
+                SchedulerConfiguration(scheduler_algorithm=algorithm))
+            rng2 = np.random.default_rng(seed)
+            for i in range(n_nodes):
+                n = mock.node()
+                shape(n, i, rng2)
+                h.state.upsert_node(h.get_next_index(), n)
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = count
+            tg.networks = []
+            tg.tasks[0].resources.networks = []
+            tg.tasks[0].resources.cpu = 200
+            tg.tasks[0].resources.memory_mb = 128
+            add_spread(job)
+            h.state.upsert_job(h.get_next_index(), job)
+            ev = Evaluation(job_id=job.id, type=job.type)
+            h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+            return h, job
+
+        def imbalance(h, job):
+            per = {}
+            for a in h.state.allocs_by_job("default", job.id):
+                rack = h.state.node_by_id(a.node_id).meta["rack"]
+                per[rack] = per.get(rack, 0) + 1
+            counts = [per.get(f"r{r}", 0) for r in range(racks)]
+            return max(counts) - min(counts)
+
+        h_host, job_h = run("binpack")
+        h_tpu, job_t = run(SCHED_ALG_TPU)
+        check_committed(h_host, job_h, count)
+        check_committed(h_tpu, job_t, count)
+        assert imbalance(h_tpu, job_t) <= imbalance(h_host, job_h) + 1, \
+            f"trial {trial}: tpu spread imbalance " \
+            f"{imbalance(h_tpu, job_t)} vs host {imbalance(h_host, job_h)}"
+
+
 def test_fuzz_host_vs_tpu_random_scenarios():
     """Property fuzz: random cluster sizes/asks; both paths must place
     everything that fits and never overcommit.

@@ -174,4 +174,5 @@ def test_job_runs_on_external_plugin_driver(tmp_path, plugin_dir):
     finally:
         client.shutdown()
         server.shutdown()
-        assert not any(d.alive() for d in client.plugin_drivers.values())
+    # after the primary assertions (not in finally, which would mask them)
+    assert not any(d.alive() for d in client.plugin_drivers.values())

@@ -1,0 +1,711 @@
+"""Scheduler scenario corpus (VERDICT r2 next #3): translations of the
+key behaviors from scheduler/generic_sched_test.go (6,385 LoC) and
+scheduler/reconcile_test.go (5,021 LoC) — canaries (placement, gating,
+promotion, revert path), reschedule windows (now/delayed/exhausted),
+multi-TG jobs, drain + deployment interplay, update parallelism limits,
+lost-node handling, affinity/spread scoring, and preemption."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.structs import (
+    AllocDeploymentStatus, Constraint, DesiredTransition, DrainStrategy,
+    Evaluation, ReschedulePolicy, SchedulerConfiguration,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN, ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_COMPLETE, NODE_STATUS_DOWN, OP_DISTINCT_PROPERTY, OP_EQ,
+    TRIGGER_RETRY_FAILED_ALLOC, TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE,
+)
+
+from test_scheduler import make_eval, process
+
+
+def seed_nodes(h, n=10, fn=None):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        if fn:
+            fn(node, i)
+        h.state.upsert_node(h.get_next_index(), node)
+        nodes.append(node)
+    return nodes
+
+
+def register(h, job):
+    h.state.upsert_job(h.get_next_index(), job)
+
+
+def allocs_of(h, job, tg=None):
+    out = [a for a in h.state.allocs_by_job(job.namespace, job.id)
+           if tg is None or a.task_group == tg]
+    return out
+
+
+def live(allocs):
+    return [a for a in allocs if a.desired_status == ALLOC_DESIRED_RUN]
+
+
+# ------------------------------------------------------------- multi-TG
+
+def test_multi_tg_places_each_group():
+    """ref generic_sched_test.go TestServiceSched_JobRegister (multi-TG)"""
+    h = Harness()
+    seed_nodes(h, 10)
+    job = mock.multi_tg_job()
+    register(h, job)
+    process(h, job)
+    assert len(allocs_of(h, job, "web")) == 4
+    assert len(allocs_of(h, job, "api")) == 6
+    assert len(allocs_of(h, job, "cache")) == 2
+    # multi-task group: both task resources granted
+    api_alloc = allocs_of(h, job, "api")[0]
+    assert set(api_alloc.allocated_resources.tasks) == {"api", "sidecar"}
+
+
+def test_multi_tg_partial_infeasibility_blocks_only_that_group():
+    """One TG with an impossible constraint: the others still place and
+    the blocked eval carries only the failing TG (ref
+    TestServiceSched_JobRegister_FeasibleAndInfeasibleTG)."""
+    h = Harness()
+    seed_nodes(h, 10)
+    job = mock.multi_tg_job()
+    job.task_groups[1].constraints = [Constraint(
+        ltarget="${attr.kernel.name}", rtarget="plan9", operand=OP_EQ)]
+    register(h, job)
+    process(h, job)
+    assert len(allocs_of(h, job, "web")) == 4
+    assert len(allocs_of(h, job, "api")) == 0
+    assert len(allocs_of(h, job, "cache")) == 2
+    blocked = [e for e in h.created_evals
+               if e.status == EVAL_STATUS_BLOCKED]
+    assert blocked and "api" in blocked[0].failed_tg_allocs
+    assert "web" not in blocked[0].failed_tg_allocs
+
+
+# ------------------------------------------------------------- canaries
+
+def _run_update(h, job, version=1):
+    updated = job.copy()
+    updated.version = version
+    updated.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+    register(h, updated)
+    process(h, updated)
+    return updated
+
+
+def test_canary_update_places_canaries_keeps_old():
+    """A canaried update places exactly `canary` new-version allocs and
+    leaves every old-version alloc running (ref reconcile_test.go
+    'canary' cases + generic_sched_test.go TestServiceSched_JobModify
+    _Canaries)."""
+    h = Harness()
+    nodes = seed_nodes(h, 10)
+    job = mock.canary_job(canaries=2)
+    register(h, job)
+    process(h, job)
+    assert len(allocs_of(h, job)) == 4
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        a2.deployment_status = AllocDeploymentStatus(healthy=True)
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+
+    _run_update(h, job)
+    allocs = allocs_of(h, job)
+    old_live = [a for a in live(allocs) if a.job.version == 0]
+    canaries = [a for a in live(allocs)
+                if a.deployment_status and a.deployment_status.canary]
+    assert len(old_live) == 4            # nothing destroyed yet
+    assert len(canaries) == 2
+    # deployment tracks the canaries
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d is not None
+    assert d.task_groups["web"].desired_canaries == 2
+    assert len(d.task_groups["web"].placed_canaries) == 2
+
+
+def test_canary_update_gates_until_promotion():
+    """Re-running the eval before promotion must NOT replace old allocs
+    (ref reconcile_test.go: no destructive updates while canaries are
+    unpromoted)."""
+    h = Harness()
+    seed_nodes(h, 10)
+    job = mock.canary_job(canaries=1)
+    register(h, job)
+    process(h, job)
+    updated = _run_update(h, job)
+    before = {a.id for a in live(allocs_of(h, job))}
+    process(h, updated)                  # second pass, still unpromoted
+    after = {a.id for a in live(allocs_of(h, job))}
+    assert before == after
+
+
+def test_canary_promotion_rolls_remaining():
+    """After promotion the old-version allocs are replaced subject to
+    max_parallel (ref generic_sched_test.go TestServiceSched_Promote)."""
+    h = Harness()
+    seed_nodes(h, 10)
+    job = mock.canary_job(canaries=1)
+    job.task_groups[0].update.max_parallel = 2
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        a2.deployment_status = AllocDeploymentStatus(healthy=True)
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+    updated = _run_update(h, job)
+
+    # mark the canary healthy, then promote the deployment
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    for a in allocs_of(h, job):
+        if a.deployment_status and a.deployment_status.canary:
+            a2 = a.copy()
+            a2.client_status = ALLOC_CLIENT_RUNNING
+            a2.deployment_status.healthy = True
+            h.state.upsert_allocs(h.get_next_index(), [a2])
+    d2 = d.copy()
+    d2.task_groups["web"].promoted = True
+    h.state.upsert_deployment(h.get_next_index(), d2)
+
+    process(h, updated)
+    allocs = allocs_of(h, job)
+    stopped_old = [a for a in allocs if a.job.version == 0 and
+                   a.desired_status == ALLOC_DESIRED_STOP]
+    new_placed = [a for a in live(allocs) if a.job.version == 1 and not
+                  (a.deployment_status and a.deployment_status.canary)]
+    # the destructive wave is bounded by max_parallel=2; the promoted
+    # canary additionally displaces the old alloc holding its name slot
+    # (count stays 4), so 3 old allocs stop but only 2 new replacements
+    # place this pass
+    assert len(new_placed) == 2
+    assert len(stopped_old) == 3
+    assert len(live(allocs)) == 4        # canary + 1 old + 2 new
+
+
+# ------------------------------------------------------ reschedule windows
+
+def _fail_alloc(h, alloc):
+    a2 = alloc.copy()
+    a2.client_status = ALLOC_CLIENT_FAILED
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    return a2
+
+
+def test_reschedule_now_within_window():
+    """A failed batch alloc with delay elapsed reschedules immediately to
+    a replacement (ref generic_sched_test.go TestBatchSched_Run_Failed)."""
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=2, interval_sec=600, delay_sec=0.0,
+        delay_function="constant", unlimited=False)
+    register(h, job)
+    process(h, job)
+    orig = allocs_of(h, job)[0]
+    _fail_alloc(h, orig)
+    process(h, job, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    allocs = allocs_of(h, job)
+    replacements = [a for a in allocs if a.id != orig.id]
+    assert len(replacements) == 1
+    assert replacements[0].previous_allocation == orig.id
+    # reschedule tracking carries the event (ref RescheduleTracker)
+    assert replacements[0].reschedule_tracker is not None
+    assert len(replacements[0].reschedule_tracker.events) == 1
+
+
+def test_reschedule_delayed_creates_followup_eval():
+    """With a positive delay the replacement is deferred to a follow-up
+    eval in the future; the failed alloc records the follow-up id (ref
+    reconcile_test.go delayed reschedule cases)."""
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=2, interval_sec=600, delay_sec=60.0,
+        delay_function="constant", unlimited=False)
+    register(h, job)
+    process(h, job)
+    orig = allocs_of(h, job)[0]
+    _fail_alloc(h, orig)
+    process(h, job, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    # no immediate replacement...
+    assert len(live(allocs_of(h, job))) <= 1
+    followups = [e for e in h.created_evals if e.wait_until_unix > 0]
+    assert len(followups) == 1
+    assert followups[0].wait_until_unix > time.time() + 30
+    failed = h.state.alloc_by_id(orig.id)
+    assert failed.follow_up_eval_id == followups[0].id
+
+
+def test_reschedule_attempts_exhausted_no_replacement():
+    """Past the attempts-per-interval window the failed alloc is NOT
+    replaced (ref generic_sched_test.go TestBatchSched_ReschedulePolicy
+    exhaustion)."""
+    from nomad_tpu.structs import RescheduleEvent, RescheduleTracker
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=1, interval_sec=3600, delay_sec=0.0,
+        delay_function="constant", unlimited=False)
+    register(h, job)
+    process(h, job)
+    orig = allocs_of(h, job)[0]
+    a2 = orig.copy()
+    a2.client_status = ALLOC_CLIENT_FAILED
+    a2.reschedule_tracker = RescheduleTracker(events=[RescheduleEvent(
+        reschedule_time_unix=time.time() - 10,
+        prev_alloc_id="earlier", prev_node_id="n")])
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    n_before = len(allocs_of(h, job))
+    process(h, job, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    assert len(allocs_of(h, job)) == n_before      # no new placement
+
+
+def test_service_failed_alloc_reschedules_with_penalty_node():
+    """Service reschedules avoid the previous node when alternatives
+    exist (ref rank.go NodeReschedulingPenaltyIterator)."""
+    h = Harness()
+    nodes = seed_nodes(h, 5)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        unlimited=True, delay_sec=0.0, delay_function="constant")
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    orig = allocs_of(h, job)[0]
+    _fail_alloc(h, orig)
+    process(h, job, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    repl = [a for a in live(allocs_of(h, job)) if a.id != orig.id]
+    assert len(repl) == 1
+    assert repl[0].node_id != orig.node_id
+
+
+# ------------------------------------------------- drain + deployment
+
+def test_drain_migrates_and_deployment_survives():
+    """Draining a node mid-deployment migrates its allocs without failing
+    the deployment (ref reconcile_test.go drain cases +
+    drainer/watch_jobs_test.go semantics)."""
+    h = Harness()
+    nodes = seed_nodes(h, 4)
+    job = mock.canary_job(canaries=0)    # rolling update, no canaries
+    job.task_groups[0].count = 4
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        a2.deployment_status = AllocDeploymentStatus(healthy=True)
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+
+    victim_node = h.state.node_by_id(allocs_of(h, job)[0].node_id)
+    victim_node = victim_node.copy()
+    victim_node.drain_strategy = DrainStrategy(deadline_sec=60)
+    h.state.upsert_node(h.get_next_index(), victim_node)
+    # drainer marks the allocs for migration
+    for a in allocs_of(h, job):
+        if a.node_id == victim_node.id:
+            a2 = a.copy()
+            a2.desired_transition = DesiredTransition(migrate=True)
+            h.state.upsert_allocs(h.get_next_index(), [a2])
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+
+    allocs = allocs_of(h, job)
+    moved = [a for a in live(allocs) if a.node_id != victim_node.id]
+    assert len(moved) == 4               # full strength off the drained node
+    still_there = [a for a in live(allocs) if a.node_id == victim_node.id]
+    assert not still_there
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d is None or d.status in ("running", "successful")
+
+
+def test_lost_node_replaces_up_to_count():
+    """A down node's allocs are marked lost and replaced elsewhere, never
+    exceeding group count (ref generic_sched_test.go
+    TestServiceSched_NodeDown)."""
+    h = Harness()
+    nodes = seed_nodes(h, 6)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    down = h.state.node_by_id(allocs_of(h, job)[0].node_id).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    alive = [a for a in live(allocs) if a.node_id != down.id]
+    assert len(alive) == 6
+    lost = [a for a in allocs if a.node_id == down.id]
+    assert all(a.desired_status == ALLOC_DESIRED_STOP or
+               a.client_status == "lost" for a in lost)
+
+
+# ------------------------------------------------- update parallelism
+
+def test_destructive_update_bounded_by_max_parallel():
+    """Only max_parallel old allocs are replaced per pass once healthy
+    (ref reconcile_test.go TestReconciler_LimitedRolling)."""
+    h = Harness()
+    seed_nodes(h, 10)
+    job = mock.canary_job(canaries=0)
+    job.task_groups[0].count = 6
+    job.task_groups[0].update.max_parallel = 2
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        a2.deployment_status = AllocDeploymentStatus(healthy=True)
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+    updated = _run_update(h, job)
+    allocs = allocs_of(h, job)
+    stopped = [a for a in allocs if a.desired_status == ALLOC_DESIRED_STOP]
+    assert len(stopped) == 2             # bounded wave
+    fresh = [a for a in live(allocs) if a.job.version == 1]
+    assert len(fresh) == 2
+
+
+# ---------------------------------------------------- scoring features
+
+def test_affinity_prefers_matching_nodes():
+    """ref generic_sched_test.go TestServiceSched_NodeAffinity"""
+    h = Harness()
+
+    def shape(n, i):
+        n.datacenter = "dc1" if i < 3 else "dc2"
+        n.compute_class()
+    seed_nodes(h, 10, shape)
+    job = mock.affinity_job()
+    job.datacenters = ["dc1", "dc2"]
+    job.affinities[0].ltarget = "${node.datacenter}"
+    job.affinities[0].rtarget = "dc2"
+    job.affinities[0].weight = 100
+    job.task_groups[0].count = 4
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 4
+    in_dc2 = [a for a in allocs
+              if h.state.node_by_id(a.node_id).datacenter == "dc2"]
+    assert len(in_dc2) == 4              # plenty of room: affinity wins
+
+
+def test_targeted_spread_percentages():
+    """Targeted spread percentages drive the split (ref spread.go
+    TestSpreadOnLargeCluster targeted cases)."""
+    h = Harness()
+
+    def shape(n, i):
+        n.datacenter = "dc1" if i < 5 else "dc2"
+        n.compute_class()
+    seed_nodes(h, 10, shape)
+    job = mock.spread_job(attribute="${node.datacenter}",
+                          targets=[("dc1", 75), ("dc2", 25)])
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 8
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 8
+    dc1 = [a for a in allocs
+           if h.state.node_by_id(a.node_id).datacenter == "dc1"]
+    assert len(dc1) == 6                 # 75% of 8
+
+
+def test_distinct_property_limits_per_value():
+    """distinct_property with a limit caps instances per attribute value
+    (ref feasible_test.go TestDistinctPropertyIterator)."""
+    h = Harness()
+
+    def shape(n, i):
+        n.attributes["rack"] = f"r{i % 2}"
+        n.compute_class()
+    seed_nodes(h, 6, shape)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.networks = []
+    job.constraints.append(Constraint(
+        ltarget="${attr.rack}", rtarget="2", operand=OP_DISTINCT_PROPERTY))
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 4
+    per_rack = {}
+    for a in allocs:
+        rack = h.state.node_by_id(a.node_id).attributes["rack"]
+        per_rack[rack] = per_rack.get(rack, 0) + 1
+    assert all(v <= 2 for v in per_rack.values())
+
+
+# -------------------------------------------------------- preemption
+
+def test_service_preempts_lower_priority_batch():
+    """On a full cluster a high-priority service evicts low-priority
+    batch work (ref preemption_test.go TestPreemption happy path)."""
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration())        # preemption defaults on for system
+    cfg = SchedulerConfiguration()
+    cfg.preemption_config.service_scheduler_enabled = True
+    h.state.set_scheduler_config(h.get_next_index(), cfg)
+    seed_nodes(h, 2)
+    filler = mock.batch_job()
+    filler.priority = 10
+    tg = filler.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.cpu = 1500
+    tg.tasks[0].resources.memory_mb = 3000
+    register(h, filler)
+    process(h, filler)
+    assert len(allocs_of(h, filler)) == 2
+
+    svc = mock.job()
+    svc.priority = 90
+    stg = svc.task_groups[0]
+    stg.count = 2
+    stg.tasks[0].resources.networks = []
+    stg.tasks[0].resources.cpu = 3000
+    stg.tasks[0].resources.memory_mb = 4000
+    register(h, svc)
+    process(h, svc)
+    assert len(live(allocs_of(h, svc))) == 2
+    evicted = [a for a in allocs_of(h, filler)
+               if a.desired_status != ALLOC_DESIRED_RUN or
+               a.preempted_by_allocation]
+    assert evicted, "low-priority batch should have been preempted"
+
+
+# ----------------------------------------------------- lifecycle shapes
+
+def test_lifecycle_job_places_all_tasks_together():
+    h = Harness()
+    seed_nodes(h, 3)
+    job = mock.lifecycle_job()
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 1
+    assert set(allocs[0].allocated_resources.tasks) == \
+        {"init", "side", "worker", "cleanup"}
+
+
+# ---------------------------------------------------- second batch: edges
+
+def test_ineligible_node_receives_nothing():
+    """ref generic_sched_test.go TestServiceSched_NodeEligibility"""
+    from nomad_tpu.structs import NODE_SCHED_INELIGIBLE
+    h = Harness()
+    nodes = seed_nodes(h, 3)
+    marked = nodes[0].copy()
+    marked.scheduling_eligibility = NODE_SCHED_INELIGIBLE
+    h.state.upsert_node(h.get_next_index(), marked)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    assert len(allocs_of(h, job)) == 6
+    assert not any(a.node_id == marked.id for a in allocs_of(h, job))
+
+
+def test_count_zero_group_places_nothing_and_scales_down():
+    """ref reconcile_test.go TestReconciler_ScaleDown_Zero"""
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    assert len(allocs_of(h, job)) == 4
+    job2 = job.copy()
+    job2.version = 1
+    job2.task_groups[0].count = 0
+    register(h, job2)
+    process(h, job2)
+    assert len(live(allocs_of(h, job2))) == 0
+
+
+def test_stopped_job_stops_every_alloc():
+    """ref generic_sched_test.go TestServiceSched_JobDeregister"""
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.job()
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    assert len(live(allocs_of(h, job))) == 10
+    stopped = job.copy()
+    stopped.stop = True
+    register(h, stopped)
+    process(h, stopped)
+    assert len(live(allocs_of(h, job))) == 0
+
+
+def test_inplace_update_preserves_alloc_ids():
+    """Non-destructive changes (e.g. meta tweaks) update in place: same
+    alloc ids, bumped job version (ref TestServiceSched_JobModify
+    _InPlace)."""
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    before = {a.id for a in live(allocs_of(h, job))}
+    job2 = job.copy()
+    job2.version = 1
+    job2.meta = dict(job2.meta, tweak="only-meta")
+    register(h, job2)
+    process(h, job2)
+    after = {a.id for a in live(allocs_of(h, job2))}
+    assert before == after
+
+
+def test_sysbatch_runs_once_per_node_and_completes():
+    """ref scheduler_sysbatch_test.go basics"""
+    from nomad_tpu.structs import JOB_TYPE_SYSBATCH
+    h = Harness()
+    nodes = seed_nodes(h, 4)
+    job = mock.system_job()
+    job.type = JOB_TYPE_SYSBATCH
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 4
+    assert len({a.node_id for a in allocs}) == 4
+    # completed sysbatch allocs are NOT replaced on re-eval
+    for a in allocs:
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_COMPLETE
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    assert len(allocs_of(h, job)) == 4   # no new placements
+
+
+def test_system_job_skips_infeasible_nodes_without_blocking():
+    """ref scheduler_system_test.go TestSystemSched_JobRegister
+    _AddNode_Filtered"""
+    h = Harness()
+
+    def shape(n, i):
+        if i == 0:
+            n.attributes["kernel.name"] = "darwin"
+        n.compute_class()
+    nodes = seed_nodes(h, 4, shape)
+    job = mock.system_job()
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 3              # darwin node filtered
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+
+
+def test_blocked_eval_carries_class_eligibility():
+    """Exhausted placements produce a blocked eval with per-class
+    eligibility so capacity changes can unblock it (ref
+    blocked_evals.go + generic_sched.go:331)."""
+    h = Harness()
+    seed_nodes(h, 2)
+    job = mock.job()
+    job.task_groups[0].count = 50        # far beyond capacity
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    blocked = [e for e in h.created_evals
+               if e.status == EVAL_STATUS_BLOCKED]
+    assert len(blocked) == 1
+    assert blocked[0].failed_tg_allocs["web"].nodes_exhausted > 0
+    placed = len(allocs_of(h, job))
+    assert 0 < placed < 50
+
+
+def test_all_at_once_sets_plan_flag():
+    """ref generic_sched_test.go TestServiceSched_JobRegister_AllAtOnce"""
+    h = Harness()
+    seed_nodes(h, 5)
+    job = mock.job()
+    job.all_at_once = True
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    assert h.plans and h.plans[0].all_at_once is True
+
+
+def test_priority_carried_into_plan_and_allocs():
+    h = Harness()
+    seed_nodes(h, 3)
+    job = mock.job()
+    job.priority = 88
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    assert h.plans[0].priority == 88
+    a = allocs_of(h, job)[0]
+    assert a.job.priority == 88
+
+
+def test_failed_deployment_new_eval_starts_fresh_deployment():
+    """A failed (inactive) deployment freezes only its own in-flight
+    eval; a later eval drops it and continues the rollout under a FRESH
+    deployment (ref generic_sched.go: non-active deployments are not
+    adopted; reconcile creates a new one)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = mock.canary_job(canaries=0)
+    job.task_groups[0].count = 4
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        a2.deployment_status = AllocDeploymentStatus(healthy=True)
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+    updated = _run_update(h, job)
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    d2 = d.copy()
+    d2.status = "failed"
+    h.state.upsert_deployment(h.get_next_index(), d2)
+    process(h, updated)
+    d3 = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d3 is not None and d3.id != d.id      # fresh deployment
+    assert d3.status == "running"
+    # the rollout continues toward v1 under the new deployment
+    assert any(a.job.version == 1 for a in live(allocs_of(h, job)))
+
+
+def test_migrate_flag_moves_alloc_without_count_change():
+    """desired_transition.migrate relocates one alloc (ref
+    TestServiceSched_NodeDrain_UpdateStrategy)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    target = allocs_of(h, job)[0]
+    a2 = target.copy()
+    a2.desired_transition = DesiredTransition(migrate=True)
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    assert len(live(allocs)) == 3
+    old = h.state.alloc_by_id(target.id)
+    assert old.desired_status == ALLOC_DESIRED_STOP
+    repl = [a for a in live(allocs) if a.previous_allocation == target.id]
+    assert len(repl) == 1 and repl[0].node_id != target.node_id
