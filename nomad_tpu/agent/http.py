@@ -380,8 +380,14 @@ class HTTPAPI:
 
         # ---- deployments
         if parts == ["deployments"]:
-            require(acl.allow_namespace_operation(ns, NS_READ_JOB))
-            return [to_api(d) for d in s.deployment_list(ns)], \
+            # wildcard lists filter per item like evaluations/allocations
+            # (a namespaced read token may browse its own deployments)
+            if ns != "*":
+                require(acl.allow_namespace_operation(ns, NS_READ_JOB))
+            deps = [d for d in s.deployment_list(ns)
+                    if ns != "*" or acl.allow_namespace_operation(
+                        d.namespace, NS_READ_JOB)]
+            return [to_api(d) for d in deps], \
                 s.state.table_index("deployment")
         if parts and parts[0] == "deployment" and len(parts) >= 2:
             # authorize against the deployment's OWN namespace, not the

@@ -264,32 +264,6 @@ def big_node() -> Node:
     return n
 
 
-def batch_alloc(j: Job = None, n: Node = None) -> Allocation:
-    return alloc_for(j or batch_job(), n or node())
-
-
-def failed_alloc(j: Job = None, n: Node = None) -> Allocation:
-    a = alloc_for(j or job(), n or node())
-    a.client_status = "failed"
-    return a
-
-
-def running_alloc(j: Job = None, n: Node = None) -> Allocation:
-    a = alloc_for(j or job(), n or node())
-    a.client_status = "running"
-    return a
-
-
-def deployment_for(j: Job) -> "Deployment":
-    """Active deployment tracking job's groups (ref mock.go Deployment)."""
-    from .structs import Deployment, DeploymentState
-    return Deployment(
-        id=new_id(), job_id=j.id, namespace=j.namespace,
-        job_version=j.version, status="running",
-        task_groups={tg.name: DeploymentState(
-            desired_total=tg.count) for tg in j.task_groups})
-
-
 def eval() -> Evaluation:  # noqa: A001 - mirrors mock.Eval
     return Evaluation(
         id=new_id(),

@@ -1168,7 +1168,7 @@ class Server:
 
     def deployment_list(self, namespace: Optional[str] = None) -> list:
         return [d for d in self.state.iter_deployments()
-                if namespace is None or d.namespace == namespace]
+                if namespace in (None, "*") or d.namespace == namespace]
 
     def deployment_promote(self, deployment_id: str,
                            groups: Optional[list] = None) -> dict:

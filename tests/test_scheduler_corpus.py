@@ -709,3 +709,23 @@ def test_migrate_flag_moves_alloc_without_count_change():
     assert old.desired_status == ALLOC_DESIRED_STOP
     repl = [a for a in live(allocs) if a.previous_allocation == target.id]
     assert len(repl) == 1 and repl[0].node_id != target.node_id
+
+
+def test_oversized_task_lands_on_big_node():
+    """A task exceeding standard-node capacity places only on the large
+    node class (mock.big_node)."""
+    h = Harness()
+    seed_nodes(h, 3)
+    big = mock.big_node()
+    h.state.upsert_node(h.get_next_index(), big)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = 8000       # > 4000-cpu standard nodes
+    tg.tasks[0].resources.memory_mb = 16000
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 2
+    assert all(a.node_id == big.id for a in allocs)

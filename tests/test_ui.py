@@ -34,7 +34,118 @@ def test_root_redirects_to_ui(agent):
 def test_ui_api_contract(agent):
     """Every endpoint the UI fetches exists and returns JSON."""
     for path in ("/v1/jobs?namespace=*", "/v1/nodes",
-                 "/v1/services?namespace=*", "/v1/agent/members"):
+                 "/v1/services?namespace=*", "/v1/agent/members",
+                 "/v1/deployments?namespace=*"):
         with urllib.request.urlopen(agent.http_addr + path,
                                     timeout=10) as r:
             json.loads(r.read())
+
+
+def test_ui_references_all_views(agent):
+    with urllib.request.urlopen(agent.http_addr + "/ui", timeout=10) as r:
+        body = r.read().decode()
+    for view in ("jobs", "deployments", "nodes", "topology", "services",
+                 "events", "alloc", "tailLogs", "runExec", "depAction"):
+        assert view in body, f"UI missing view/function {view}"
+
+
+# ------------------------------------------- live-cluster UI data contract
+
+@pytest.fixture(scope="module")
+def live_agent(tmp_path_factory):
+    a = Agent(AgentConfig(dev_mode=True, http_port=0,
+                          data_dir=str(tmp_path_factory.mktemp("uiagent"))))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def _get(agent, path):
+    with urllib.request.urlopen(agent.http_addr + path, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def _post(agent, path, body):
+    req = urllib.request.Request(
+        agent.http_addr + path, data=json.dumps(body).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def _wait(fn, timeout=15.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except Exception:   # noqa: BLE001
+            pass
+        import time as _t
+        _t.sleep(0.2)
+    raise AssertionError("condition never became true")
+
+
+def test_ui_browses_running_cluster(live_agent):
+    """The data the UI renders is real: submit a job, then walk the
+    exact fetches the views make — job detail, alloc detail with task
+    states, log follow frames, deployments, topology, exec."""
+    import base64
+    job = {"Job": {
+        "ID": "ui-e2e", "Name": "ui-e2e", "Type": "service",
+        "Datacenters": ["dc1"],
+        "Update": {"MaxParallel": 1, "HealthCheck": "task_states",
+                   "MinHealthyTimeSec": 0.01},
+        "TaskGroups": [{
+            "Name": "g", "Count": 1,
+            "Update": {"MaxParallel": 1, "HealthCheck": "task_states",
+                       "MinHealthyTimeSec": 0.01},
+            "Tasks": [{
+                "Name": "t", "Driver": "raw_exec",
+                "Config": {"command": "/bin/sh",
+                           "args": ["-c",
+                                    "i=0; while true; do echo ui-line-$i;"
+                                    " i=$((i+1)); sleep 0.2; done"]},
+                "Resources": {"CPU": 50, "MemoryMB": 32}}]}]}}
+    _post(live_agent, "/v1/jobs", job)
+
+    allocs = _wait(lambda: [
+        a for a in _get(live_agent, "/v1/job/ui-e2e/allocations")
+        if a["ClientStatus"] == "running"])
+    alloc_id = allocs[0]["ID"]
+
+    # alloc view: task states present
+    a = _get(live_agent, f"/v1/allocation/{alloc_id}")
+    assert a["TaskStates"]["t"]["State"] == "running"
+
+    # log follow frame: base64 data + advancing offset
+    out = _wait(lambda: _get(
+        live_agent, f"/v1/client/fs/logs/{alloc_id}"
+                    f"?task=t&type=stdout&follow=true&offset=0&wait=5"))
+    data = base64.b64decode(out["Data"])
+    assert b"ui-line-0" in data
+    assert out["Offset"] > 0
+
+    # deployments view: the service job created one
+    deps = _get(live_agent, "/v1/deployments?namespace=*")
+    assert any(d["JobID"] == "ui-e2e" for d in deps)
+
+    # topology view: node allocations include ours
+    nodes = _get(live_agent, "/v1/nodes")
+    node_allocs = _get(live_agent,
+                       f"/v1/node/{nodes[0]['ID']}/allocations")
+    assert any(x["ID"] == alloc_id for x in node_allocs)
+
+    # exec panel round trip (the runExec fetch sequence)
+    sid = _post(live_agent, f"/v1/client/allocation/{alloc_id}/exec",
+                {"Task": "t", "Cmd": ["/bin/sh", "-c", "echo from-ui"]}
+                )["SessionID"]
+    collected = b""
+    for _ in range(20):
+        chunk = _get(live_agent, f"/v1/client/exec-session/{sid}?wait=1")
+        collected += base64.b64decode(chunk["Stdout"])
+        if chunk["Exited"] and not chunk["Stdout"]:
+            break
+    assert b"from-ui" in collected
