@@ -21,8 +21,16 @@
 //   stderr=/path/err.log
 //   memory_mb=256          (0 = unlimited)
 //   cpu_nice=5             (0-19)
+//   cpu_shares=500         (cgroup v2 cpu.weight source; 0 = default)
+//   cgroup_parent=/sys/fs/cgroup/nomad  (enables cgroup v2 isolation)
 //   result=/path/result.json
 //   pidfile=/path/executor.pid
+//
+// Isolation tiers (ref executor_linux.go): when cgroup_parent is given
+// and writable, the child runs in its own cgroup v2 leaf with memory.max
+// + cpu.weight and is reaped via cgroup.kill (catches daemonized
+// grandchildren that escape the process group); otherwise RLIMIT_AS +
+// nice is the degraded fallback.
 #include <cerrno>
 #include <csignal>
 #include <cstdio>
@@ -56,8 +64,10 @@ struct Spec {
   std::string stderr_path;
   std::string result_path;
   std::string pid_path;
+  std::string cgroup_parent;
   long memory_mb = 0;
   int cpu_nice = 0;
+  long cpu_shares = 0;
 };
 
 static bool parse_spec(const char *path, Spec *spec) {
@@ -80,6 +90,8 @@ static bool parse_spec(const char *path, Spec *spec) {
     else if (key == "pidfile") spec->pid_path = val;
     else if (key == "memory_mb") spec->memory_mb = atol(val.c_str());
     else if (key == "cpu_nice") spec->cpu_nice = atoi(val.c_str());
+    else if (key == "cpu_shares") spec->cpu_shares = atol(val.c_str());
+    else if (key == "cgroup_parent") spec->cgroup_parent = val;
   }
   return !spec->command.empty();
 }
@@ -100,6 +112,66 @@ static int open_log(const std::string &path) {
   return open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
 }
 
+// ------------------------------------------------------------- cgroup v2
+
+static bool write_file(const std::string &path, const std::string &val) {
+  int fd = open(path.c_str(), O_WRONLY | O_TRUNC);
+  if (fd < 0) return false;
+  ssize_t n = write(fd, val.c_str(), val.size());
+  close(fd);
+  return n == static_cast<ssize_t>(val.size());
+}
+
+static void cgroup_teardown(const std::string &leaf);
+
+// Create a cgroup v2 leaf for the task; returns its path or "" when
+// unavailable (no permission / not cgroup v2 / memory controller not
+// grantable while a memory limit is requested) so callers fall back to
+// rlimits. (ref executor_linux.go configureCgroups)
+static std::string setup_cgroup(const Spec &spec) {
+  if (spec.cgroup_parent.empty()) return "";
+  // enable the controllers for children (best effort: may already be on,
+  // or delegation may forbid it)
+  write_file(spec.cgroup_parent + "/cgroup.subtree_control", "+cpu +memory");
+  std::string leaf = spec.cgroup_parent + "/task-" +
+                     std::to_string(static_cast<long>(getpid()));
+  if (mkdir(leaf.c_str(), 0755) != 0 && errno != EEXIST) return "";
+  if (spec.memory_mb > 0) {
+    // a requested memory limit must actually land: silently running an
+    // unconfined task would be fail-open (the child skips RLIMIT_AS
+    // whenever a cgroup leaf is in play)
+    if (!write_file(leaf + "/memory.max",
+                    std::to_string(spec.memory_mb * 1024L * 1024L))) {
+      cgroup_teardown(leaf);
+      return "";
+    }
+  }
+  if (spec.cpu_shares > 0) {
+    // nomad cpu shares (MHz-ish, default 100-4000+) -> cgroup v2 weight
+    // [1, 10000], keeping the same relative ratios
+    long weight = spec.cpu_shares / 10;
+    if (weight < 1) weight = 1;
+    if (weight > 10000) weight = 10000;
+    write_file(leaf + "/cpu.weight", std::to_string(weight));
+  }
+  return leaf;
+}
+
+static bool cgroup_enter(const std::string &leaf, pid_t pid) {
+  return write_file(leaf + "/cgroup.procs", std::to_string(pid));
+}
+
+static void cgroup_teardown(const std::string &leaf) {
+  if (leaf.empty()) return;
+  // cgroup.kill reaps EVERYTHING in the subtree, including daemonized
+  // processes that re-parented out of the task's process group
+  write_file(leaf + "/cgroup.kill", "1");
+  for (int i = 0; i < 50; i++) {
+    if (rmdir(leaf.c_str()) == 0) return;
+    usleep(10 * 1000);                  // members still exiting
+  }
+}
+
 int main(int argc, char **argv) {
   if (argc != 2) {
     fprintf(stderr, "usage: nomad-executor <spec-file>\n");
@@ -114,6 +186,16 @@ int main(int argc, char **argv) {
   // our own session: the driver kills the executor's group as one unit
   setsid();
 
+  // cgroup leaf first so the child can be placed in it right after fork
+  std::string cgroup_leaf = setup_cgroup(spec);
+
+  // gate pipe: the child must not exec (and so must not spawn anything)
+  // until the parent confirms cgroup placement — otherwise an immediate
+  // daemonizing task could fork grandchildren into the WRONG cgroup,
+  // where neither cgroup.kill nor the process-group kill reaps them
+  int gate[2] = {-1, -1};
+  if (pipe(gate) != 0) gate[0] = gate[1] = -1;
+
   g_child = fork();
   if (g_child < 0) {
     write_result(spec, -1, 0, "fork failed");
@@ -122,6 +204,13 @@ int main(int argc, char **argv) {
   if (g_child == 0) {
     // child: new process group so the supervisor can signal the whole tree
     setpgid(0, 0);
+    if (gate[0] >= 0) {
+      close(gate[1]);
+      char ok = 0;
+      ssize_t n = read(gate[0], &ok, 1);   // parent: placed (or no cgroup)
+      close(gate[0]);
+      if (n != 1 || ok != 'g') _exit(125); // parent bailed: don't exec
+    }
     if (!spec.cwd.empty() && chdir(spec.cwd.c_str()) != 0) {
       fprintf(stderr, "chdir(%s): %s\n", spec.cwd.c_str(), strerror(errno));
       _exit(127);
@@ -131,9 +220,9 @@ int main(int argc, char **argv) {
     if (out_fd >= 0) dup2(out_fd, STDOUT_FILENO);
     if (err_fd >= 0) dup2(err_fd, STDERR_FILENO);
 
-    // resource isolation (ref executor_linux.go resource limits; cgroups
-    // arrive with the containerized driver)
-    if (spec.memory_mb > 0) {
+    // resource isolation (ref executor_linux.go): rlimit+nice is the
+    // fallback tier when no cgroup leaf was granted
+    if (cgroup_leaf.empty() && spec.memory_mb > 0) {
       struct rlimit rl;
       rl.rlim_cur = rl.rlim_max =
           static_cast<rlim_t>(spec.memory_mb) * 1024 * 1024;
@@ -156,6 +245,27 @@ int main(int argc, char **argv) {
     _exit(127);
   }
   setpgid(g_child, g_child);
+  if (gate[0] >= 0) close(gate[0]);
+  if (!cgroup_leaf.empty() && !cgroup_enter(cgroup_leaf, g_child)) {
+    // could not place the child: tear the leaf down, rlimits were
+    // skipped so fail closed rather than run unconfined over-memory
+    cgroup_teardown(cgroup_leaf);
+    cgroup_leaf.clear();
+    if (spec.memory_mb > 0) {
+      if (gate[1] >= 0) close(gate[1]);  // child sees EOF and exits 125
+      kill(-g_child, SIGKILL);
+      waitpid(g_child, nullptr, 0);
+      write_result(spec, -1, 0, "cgroup placement failed");
+      return 1;
+    }
+  }
+  if (gate[1] >= 0) {
+    // release the child: it is in its final cgroup (or confinement is
+    // rlimit-tier and was applied child-side)
+    ssize_t w = write(gate[1], "g", 1);
+    (void)w;
+    close(gate[1]);
+  }
 
   // pidfile: "<executor_pid> <child_pid>" — the driver SIGKILLs the child's
   // group directly if the executor itself is gone
@@ -183,8 +293,10 @@ int main(int argc, char **argv) {
   }
   int exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 0;
   int sig = WIFSIGNALED(status) ? WTERMSIG(status) : 0;
-  // reap any stragglers in the task's group
+  // reap any stragglers: cgroup.kill catches daemonized escapees the
+  // process group can't; the group kill is the fallback tier
   kill(-g_child, SIGKILL);
+  cgroup_teardown(cgroup_leaf);
   write_result(spec, exit_code, sig, nullptr);
   return 0;
 }

@@ -86,20 +86,35 @@ def _table(rows: list[list], headers: list[str]) -> None:
 
 def cmd_agent(args) -> None:
     from .agent import Agent, AgentConfig
-    cfg = AgentConfig(dev_mode=args.dev, http_port=args.port,
-                      data_dir=args.data_dir or "",
-                      num_workers=args.workers,
-                      acl_enabled=getattr(args, "acl_enabled", False),
-                      region=getattr(args, "region", "global"),
-                      authoritative_region=getattr(
-                          args, "authoritative_region", ""),
-                      rpc_port=getattr(args, "rpc_port", -1),
-                      gossip_port=getattr(args, "gossip_port", -1),
-                      join=tuple(getattr(args, "join", []) or ()),
-                      bootstrap_expect=getattr(args, "bootstrap_expect", 1),
-                      replication_token=getattr(args, "replication_token",
-                                                ""),
-                      plugin_dir=getattr(args, "plugin_dir", ""))
+    cfg = AgentConfig(dev_mode=args.dev)
+    # config files load first (ref agent.go: files merge in order)...
+    config_paths = list(getattr(args, "config", []) or [])
+    if config_paths:
+        from .agent.config_file import (
+            ConfigError, apply_to_agent_config, load_config,
+        )
+        try:
+            apply_to_agent_config(cfg, load_config(config_paths))
+        except (ConfigError, OSError) as e:
+            _die(str(e))
+    # ...then explicitly passed CLI flags override file values. Agent
+    # flags default to None (sentinel), so ANY value the operator typed
+    # wins — including typing a flag's documented default back — and
+    # AgentConfig's own defaults apply when neither source sets a field.
+    fields = {"port": "http_port", "data_dir": "data_dir",
+              "workers": "num_workers", "acl_enabled": "acl_enabled",
+              "region": "region",
+              "authoritative_region": "authoritative_region",
+              "rpc_port": "rpc_port", "gossip_port": "gossip_port",
+              "bootstrap_expect": "bootstrap_expect",
+              "replication_token": "replication_token",
+              "plugin_dir": "plugin_dir"}
+    for arg_name, cfg_field in fields.items():
+        val = getattr(args, arg_name, None)
+        if val is not None:
+            setattr(cfg, cfg_field, val)
+    if getattr(args, "join", None):
+        cfg.join = tuple(args.join)
     agent = Agent(cfg, logger=lambda m: print(f"    {m}", flush=True))
     agent.start()
     mode = []
@@ -711,27 +726,36 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser("agent")
+    # value flags default to None (sentinel): cmd_agent applies only
+    # explicitly passed flags over config files over AgentConfig defaults
     ag.add_argument("-dev", action="store_true")
-    ag.add_argument("-port", type=int, default=4646)
-    ag.add_argument("-data-dir", dest="data_dir", default="")
-    ag.add_argument("-workers", type=int, default=2)
-    ag.add_argument("-acl-enabled", dest="acl_enabled", action="store_true")
-    ag.add_argument("-region", default="global")
+    ag.add_argument("-port", type=int, default=None,
+                    help="HTTP port (default 4646)")
+    ag.add_argument("-data-dir", dest="data_dir", default=None)
+    ag.add_argument("-workers", type=int, default=None,
+                    help="scheduler workers (default 2)")
+    ag.add_argument("-acl-enabled", dest="acl_enabled",
+                    action="store_const", const=True, default=None)
+    ag.add_argument("-region", default=None)
     ag.add_argument("-authoritative-region", dest="authoritative_region",
-                    default="")
-    ag.add_argument("-rpc-port", dest="rpc_port", type=int, default=-1)
-    ag.add_argument("-gossip-port", dest="gossip_port", type=int, default=-1)
+                    default=None)
+    ag.add_argument("-rpc-port", dest="rpc_port", type=int, default=None)
+    ag.add_argument("-gossip-port", dest="gossip_port", type=int,
+                    default=None)
     ag.add_argument("-join", action="append", default=[],
                     help="gossip seed host:port (repeatable)")
     ag.add_argument("-bootstrap-expect", dest="bootstrap_expect", type=int,
-                    default=1, help="N>1: wait for N servers then "
+                    default=None, help="N>1: wait for N servers then "
                     "bootstrap together; 1: bootstrap now; 0: wait to be "
                     "adopted by an existing leader")
     ag.add_argument("-replication-token", dest="replication_token",
-                    default="", help="management token of the "
+                    default=None, help="management token of the "
                     "authoritative region (ACL replication)")
-    ag.add_argument("-plugin-dir", dest="plugin_dir", default="",
+    ag.add_argument("-plugin-dir", dest="plugin_dir", default=None,
                     help="directory of external driver plugin executables")
+    ag.add_argument("-config", action="append", default=[],
+                    help="HCL/JSON agent config file or directory "
+                    "(repeatable; merged in order, flags override)")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job")

@@ -47,6 +47,33 @@ def ensure_executor_binary(path: str = DEFAULT_BIN) -> Optional[str]:
         return path if os.path.exists(path) else None
 
 
+def _cgroup_parent() -> str:
+    """A writable cgroup v2 parent for task leaves, or "" (the executor
+    then falls back to rlimit/nice). Prefers a dedicated nomad-tpu group
+    under the root; inside a delegated container, the process's own
+    cgroup is the only writable subtree (ref cgutil.CgroupScope)."""
+    root = "/sys/fs/cgroup"
+    if not os.path.exists(os.path.join(root, "cgroup.controllers")):
+        return ""                        # not unified cgroup v2
+    dedicated = os.path.join(root, "nomad-tpu")
+    try:
+        os.makedirs(dedicated, exist_ok=True)
+        if os.access(dedicated, os.W_OK):    # a pre-existing root-owned
+            return dedicated                 # dir must not shadow the
+    except OSError:                          # delegated-cgroup fallback
+        pass
+    try:
+        with open("/proc/self/cgroup") as f:
+            for line in f:
+                if line.startswith("0::"):
+                    own = root + line.split("::", 1)[1].strip()
+                    if os.access(own, os.W_OK):
+                        return own
+    except OSError:
+        pass
+    return ""
+
+
 class ExecDriver(Driver):
     """config keys: command, args; resources drive the limits."""
 
@@ -118,7 +145,11 @@ class ExecDriver(Driver):
             f"pidfile={pid_path}",
             f"memory_mb={task.resources.memory_mb or 0}",
             f"cpu_nice={int(cfg.get('nice', 0))}",
+            f"cpu_shares={task.resources.cpu or 0}",
         ]
+        cg = _cgroup_parent()
+        if cg:
+            lines.append(f"cgroup_parent={cg}")
         with open(spec_path, "w") as f:
             f.write("\n".join(lines) + "\n")
 
