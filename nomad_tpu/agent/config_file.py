@@ -49,7 +49,13 @@ def _body_to_dict(body: Body, ev: EvalContext) -> dict:
     for block in body.items:
         if not hasattr(block, "body"):
             continue
-        out[block.type] = _body_to_dict(block.body, ev)
+        sub = _body_to_dict(block.body, ev)
+        # repeated blocks within ONE file deep-merge, matching how the
+        # same stanzas split across files merge via merge_config
+        if isinstance(out.get(block.type), dict):
+            out[block.type] = merge_config(out[block.type], sub)
+        else:
+            out[block.type] = sub
     return out
 
 
@@ -96,7 +102,15 @@ def load_config(paths: list[str]) -> dict:
 
 
 def apply_to_agent_config(cfg: AgentConfig, raw: dict) -> AgentConfig:
-    """Overlay a parsed config-file dict onto an AgentConfig."""
+    """Overlay a parsed config-file dict onto an AgentConfig. Bad scalar
+    values surface as ConfigError, not raw tracebacks."""
+    try:
+        return _apply(cfg, raw)
+    except (ValueError, TypeError) as e:
+        raise ConfigError(f"invalid config value: {e}") from e
+
+
+def _apply(cfg: AgentConfig, raw: dict) -> AgentConfig:
     top = {
         "region": "region", "datacenter": "datacenter",
         "data_dir": "data_dir", "bind_addr": "bind_addr",

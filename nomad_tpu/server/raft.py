@@ -83,11 +83,16 @@ class RaftNode:
         self.base_index = 0              # last index covered by the snapshot
         self.base_term = 0
         self.peers = dict(peers)         # id -> addr, includes self
+        # autopilot non-voting members (raft-autopilot AddNonvoter): fully
+        # replicated to, but excluded from elections and commit quorums
+        # until promoted after stabilizing
+        self.nonvoters: set[str] = set()
         # configuration as of base_index (snapshot point); the live config
         # is always _base_peers + the _config_* entries in the log, so a
         # truncated config entry can be rolled back (Raft §4.1: servers
         # adopt the latest configuration entry in their log at append time)
         self._base_peers = dict(peers)
+        self._base_nonvoters: set[str] = set()
 
         # volatile state
         self.state = FOLLOWER
@@ -147,7 +152,8 @@ class RaftNode:
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump({"term": self.current_term, "voted_for": self.voted_for,
-                         "peers": self.peers}, f)
+                         "peers": self.peers,
+                         "nonvoters": set(self.nonvoters)}, f)
         os.replace(tmp, self._meta_path())
 
     def _append_to_disk(self, entries: list[_Entry]) -> None:
@@ -182,7 +188,8 @@ class RaftNode:
         tmp = self._snap_path() + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump({"index": self.base_index, "term": self.base_term,
-                         "data": data, "peers": self._base_peers}, f)
+                         "data": data, "peers": self._base_peers,
+                         "nonvoters": set(self._base_nonvoters)}, f)
         os.replace(tmp, self._snap_path())
 
     def _restore_from_disk(self) -> None:
@@ -199,6 +206,8 @@ class RaftNode:
                 # merge — a merge would resurrect removed peers
                 self.peers = dict(snap["peers"])
                 self._base_peers = dict(snap["peers"])
+                self.nonvoters = set(snap.get("nonvoters", ()))
+                self._base_nonvoters = set(snap.get("nonvoters", ()))
             self.commit_index = self.last_applied = self.base_index
         if os.path.exists(self._meta_path()):
             with open(self._meta_path(), "rb") as f:
@@ -207,6 +216,7 @@ class RaftNode:
             self.voted_for = meta["voted_for"]
             if meta.get("peers"):
                 self.peers = dict(meta["peers"])
+                self.nonvoters = set(meta.get("nonvoters", ()))
         if os.path.exists(self._log_path()):
             with open(self._log_path(), "rb") as f:
                 raw = f.read()
@@ -266,6 +276,9 @@ class RaftNode:
 
     # ------------------------------------------------------- public: apply
 
+    def _voters(self) -> list[str]:
+        return [pid for pid in self.peers if pid not in self.nonvoters]
+
     def apply(self, msg_type: str, payload, timeout: float = 30.0):
         """Commit one message through the replicated log. Leader-only;
         raises NotLeaderError with a redirect hint on followers."""
@@ -285,7 +298,7 @@ class RaftNode:
             self._match_index[self.node_id] = index
             for ev in self._replicate_events.values():
                 ev.set()
-            if len(self.peers) == 1:
+            if len(self._voters()) == 1:
                 self._advance_commit_locked()
             if msg_type in ("_config_add", "_config_remove"):
                 # membership changes take effect at append (adopted above)
@@ -326,14 +339,28 @@ class RaftNode:
             self._persist_meta()
             return True
 
-    def add_peer(self, peer_id: str, addr: str, timeout: float = 30.0) -> int:
-        """Single-entry membership addition (ref raft AddVoter / agent
-        join): replicate a _config_add entry; the leader starts replicating
-        to the new peer on apply."""
+    def add_peer(self, peer_id: str, addr: str, timeout: float = 30.0,
+                 voter: bool = True) -> int:
+        """Single-entry membership addition (ref raft AddVoter /
+        AddNonvoter): replicate a _config_add entry; the leader starts
+        replicating to the new peer on apply. Non-voters receive the full
+        log but stay out of quorums until promote_peer."""
         with self._lock:
-            if peer_id in self.peers and self.peers[peer_id] == addr:
+            if peer_id in self.peers and self.peers[peer_id] == addr and \
+                    (peer_id not in self.nonvoters) == voter:
                 return self.last_applied
-        return self.apply("_config_add", (peer_id, addr), timeout=timeout)
+        return self.apply("_config_add", (peer_id, addr, voter),
+                          timeout=timeout)
+
+    def promote_peer(self, peer_id: str, timeout: float = 30.0) -> int:
+        """Non-voter -> voter (raft-autopilot promotion after the server
+        stabilization window)."""
+        with self._lock:
+            if peer_id not in self.nonvoters:
+                return self.last_applied
+            addr = self.peers.get(peer_id, "")
+        return self.apply("_config_add", (peer_id, addr, True),
+                          timeout=timeout)
 
     def remove_peer(self, peer_id: str, timeout: float = 30.0) -> int:
         """Single-entry membership change: replicate a _config_remove entry;
@@ -353,6 +380,7 @@ class RaftNode:
             return
         pid = entry.payload
         self.peers.pop(pid, None)
+        self.nonvoters.discard(pid)
         self._next_index.pop(pid, None)
         self._match_index.pop(pid, None)
         ev = self._replicate_events.pop(pid, None)
@@ -367,14 +395,22 @@ class RaftNode:
         on a follower: a conflicting leader may have removed an appended
         (never-committed) config entry, which must be rolled back."""
         peers = dict(self._base_peers)
+        nonvoters = set(self._base_nonvoters)
         for e in self.log:
             if e.type == "_config_add":
-                pid, addr = e.payload
+                pid, addr, voter = e.payload if len(e.payload) == 3 \
+                    else (*e.payload, True)
                 peers[pid] = addr
+                if voter:
+                    nonvoters.discard(pid)
+                else:
+                    nonvoters.add(pid)
             elif e.type == "_config_remove":
                 peers.pop(e.payload, None)
-        if peers != self.peers:
+                nonvoters.discard(e.payload)
+        if peers != self.peers or nonvoters != self.nonvoters:
             self.peers = peers
+            self.nonvoters = nonvoters
             self._persist_meta()
 
     def _apply_config_locked(self, payload) -> None:
@@ -384,12 +420,18 @@ class RaftNode:
             self._step_down_locked(self.current_term)
 
     def _apply_config_add_locked(self, payload) -> None:
-        pid, addr = payload
+        pid, addr, voter = payload if len(payload) == 3 else (*payload, True)
         if pid in self.peers:
             self.peers[pid] = addr
+            if voter:
+                self.nonvoters.discard(pid)
+            else:
+                self.nonvoters.add(pid)
             self._persist_meta()
             return
         self.peers[pid] = addr
+        if not voter:
+            self.nonvoters.add(pid)
         self._peer_added_at[pid] = time.monotonic()
         self._persist_meta()
         if self.state == LEADER:
@@ -429,7 +471,7 @@ class RaftNode:
                     "ID": pid, "Address": addr,
                     "Leader": pid == self.node_id and is_leader
                     or pid == self.leader_id,
-                    "Voter": True,
+                    "Voter": pid not in self.nonvoters,
                     "Healthy": healthy,
                     "LastContactSec": None
                     if age in (None, float("inf")) else age,
@@ -482,8 +524,12 @@ class RaftNode:
                         random.uniform(*self.election_timeout)
                     continue
                 # a non-bootstrap server with only itself in config is
-                # waiting for adoption, not for votes
+                # waiting for adoption, not for votes; a non-voter never
+                # campaigns at all (raft-autopilot nonvoter semantics)
                 if not self.bootstrap and len(self.peers) <= 1:
+                    deadline = self._election_deadline()
+                    continue
+                if self.node_id in self.nonvoters:
                     deadline = self._election_deadline()
                     continue
                 self.current_term += 1
@@ -495,7 +541,8 @@ class RaftNode:
                 last_idx = self._last_index()
                 last_term = self._term_at(last_idx)
                 peers = {pid: addr for pid, addr in self.peers.items()
-                         if pid != self.node_id}
+                         if pid != self.node_id and
+                         pid not in self.nonvoters}
                 deadline = self._election_deadline()
             if not peers:
                 self._become_leader(term)
@@ -522,7 +569,7 @@ class RaftNode:
                 return
             if resp["granted"]:
                 self._votes += 1
-                if self._votes * 2 > len(self.peers):
+                if self._votes * 2 > len(self._voters()):
                     # transition exactly once: later vote responses see
                     # state != CANDIDATE and bail above
                     self.state = LEADER
@@ -556,14 +603,17 @@ class RaftNode:
             # trivial {self} base config) learn EVERY member — including
             # those only present in this leader's bootstrap config —
             # purely from the log. Idempotent at adopt/apply time.
-            cfg_entries = [_Entry(term, "_config_add", (pid, addr))
+            cfg_entries = [_Entry(term, "_config_add",
+                                  (pid, addr, pid not in self.nonvoters))
                            for pid, addr in self.peers.items()]
             self.log.extend(cfg_entries)
             self._append_to_disk(cfg_entries)
             self._match_index[self.node_id] = self._last_index()
             peers = {pid: addr for pid, addr in self.peers.items()
                      if pid != self.node_id}
-            if not peers:
+            if len(self._voters()) == 1:
+                # sole voter: its own match IS the quorum — non-voter
+                # peers must not gate commitment of the term's entries
                 self._advance_commit_locked()
         self.logger(f"raft: {self.node_id} became leader (term {term})")
         for pid in peers:
@@ -628,7 +678,8 @@ class RaftNode:
                 # may include uncommitted config entries past base_index
                 snap = {"index": self.base_index, "term": self.base_term,
                         "data": self.fsm.snapshot_bytes(),
-                        "peers": dict(self._base_peers)}
+                        "peers": dict(self._base_peers),
+                        "nonvoters": sorted(self._base_nonvoters)}
                 commit = self.commit_index
             else:
                 snap = None
@@ -678,8 +729,10 @@ class RaftNode:
                     ev.set()
 
     def _advance_commit_locked(self) -> None:
-        """Majority-match commit rule (current-term entries only)."""
-        matches = sorted(self._match_index.get(pid, 0) for pid in self.peers)
+        """Majority-match commit rule over VOTERS (current-term entries
+        only; non-voters replicate but never count, raft §4.2.1)."""
+        matches = sorted(self._match_index.get(pid, 0)
+                         for pid in self._voters())
         majority_idx = matches[(len(matches) - 1) // 2]
         if majority_idx > self.commit_index and \
                 self._term_at(majority_idx) == self.current_term:
@@ -729,10 +782,16 @@ class RaftNode:
         # fold config entries covered by the snapshot into the base config
         for e in self.log[:keep_from]:
             if e.type == "_config_add":
-                pid, addr = e.payload
+                pid, addr, voter = e.payload if len(e.payload) == 3 \
+                    else (*e.payload, True)
                 self._base_peers[pid] = addr
+                if voter:
+                    self._base_nonvoters.discard(pid)
+                else:
+                    self._base_nonvoters.add(pid)
             elif e.type == "_config_remove":
                 self._base_peers.pop(e.payload, None)
+                self._base_nonvoters.discard(e.payload)
         self.log = self.log[keep_from:]
         self.base_index = snap_index
         self._persist_snapshot(data)
@@ -835,6 +894,8 @@ class RaftNode:
             if snap.get("peers"):
                 self.peers = dict(snap["peers"])
                 self._base_peers = dict(snap["peers"])
+                self.nonvoters = set(snap.get("nonvoters", ()))
+                self._base_nonvoters = set(snap.get("nonvoters", ()))
             self.commit_index = max(self.commit_index, snap["index"])
             self.last_applied = snap["index"]
             self._persist_snapshot(snap["data"])

@@ -314,15 +314,23 @@ class Server:
             self.logger(f"server: federated server {member.name} "
                         f"joined region {region}")
             return
-        # same region: adopt into consensus (leader-driven, the serf-join
-        # -> AddVoter path of the reference)
+        # same region: NEW servers are adopted as NON-VOTERS (leader-
+        # driven serf-join -> raft-autopilot AddNonvoter) and promoted by
+        # the autopilot tick after stabilizing. A member flapping
+        # SUSPECT->ALIVE re-fires this join and must KEEP its voter
+        # status — demoting an established voter would silently shrink
+        # the commit quorum.
         if self.raft_node is not None and self.is_leader and \
                 tags.get("id") and tags.get("rpc_addr"):
+            pid = tags["id"]
+            voter = (pid in self.raft_node.peers and
+                     pid not in self.raft_node.nonvoters)
             try:
-                self.raft_node.add_peer(tags["id"], tags["rpc_addr"])
-                self.logger(f"server: added raft peer {tags['id']}")
+                self.raft_node.add_peer(pid, tags["rpc_addr"], voter=voter)
+                self.logger(f"server: added raft peer {pid}"
+                            f"{'' if voter else ' (non-voter)'}")
             except Exception as e:      # noqa: BLE001
-                self.logger(f"server: add_peer {tags['id']} failed: {e}")
+                self.logger(f"server: add_peer {pid} failed: {e}")
 
     def _on_gossip_fail(self, member) -> None:
         """ref nomad/serf.go:163 nodeFailed + autopilot dead-server
@@ -362,7 +370,10 @@ class Server:
         peers = dict(self.raft_node.peers)
         for pid, addr in alive.items():
             if peers.get(pid) != addr:
-                self.raft_node.add_peer(pid, addr)
+                # keep the existing voter/non-voter status: reconcile must
+                # not promote ahead of the autopilot stabilization window
+                voter = pid in peers and pid not in self.raft_node.nonvoters
+                self.raft_node.add_peer(pid, addr, voter=voter)
                 self.logger(f"server: reconciled raft peer {pid}")
 
     # --------------------------------------------------- ACL replication
@@ -549,6 +560,10 @@ class Server:
                 self._reconcile_gossip_peers()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"gossip reconcile: {e!r}")
+            try:
+                self._autopilot_promote_stable_servers()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"autopilot promote: {e!r}")
             if time.time() - last_gc >= self.gc_interval:
                 last_gc = time.time()
                 for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
@@ -1250,6 +1265,25 @@ class Server:
                 "FailureTolerance": max(0, (sum(
                     1 for s in servers if s["Healthy"]) - 1) // 2),
                 "Servers": servers}
+
+    def _autopilot_promote_stable_servers(self) -> None:
+        """raft-autopilot stable-server promotion (ref nomad/autopilot.go
+        promoteStableServers): a non-voter that has replicated healthily
+        for ServerStabilizationTime becomes a voter."""
+        from .raft import RaftNode
+        if not isinstance(self.raft, RaftNode) or not self.is_leader:
+            return
+        cfg = self.state.get_autopilot_config()
+        stabilization = float(cfg.get("ServerStabilizationTimeSec", 10.0))
+        for s_h in self.raft.server_health():
+            if s_h["Voter"] or not s_h["Healthy"]:
+                continue
+            if s_h.get("KnownForSec", 0.0) >= stabilization:
+                # bounded: a promote racing the server's death must not
+                # stall the 1s leader housekeeping loop for 30s
+                self.raft.promote_peer(s_h["ID"], timeout=5.0)
+                self.logger(
+                    f"server: promoted stable server {s_h['ID']} to voter")
 
     def _autopilot_cleanup_dead_servers(self) -> None:
         """Leader-side dead-server reaping (ref nomad/autopilot.go

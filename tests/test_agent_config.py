@@ -116,3 +116,18 @@ def test_cli_flags_override_config_file(tmp_path):
     assert cfg.region == "flag-region"
     assert args.port is None             # -port untyped stays sentinel
     assert cfg.http_port == 5646         # file value kept for unset flag
+
+
+def test_bad_scalar_is_config_error(tmp_path):
+    p = tmp_path / "bad.hcl"
+    p.write_text('ports { http = "abc" }')
+    with pytest.raises(ConfigError, match="invalid config value"):
+        apply_to_agent_config(AgentConfig(), load_config([str(p)]))
+
+
+def test_repeated_blocks_in_one_file_merge(tmp_path):
+    p = tmp_path / "dup.hcl"
+    p.write_text('server { enabled = true }\n'
+                 'server { bootstrap_expect = 3 }')
+    raw = load_config([str(p)])
+    assert raw["server"] == {"enabled": True, "bootstrap_expect": 3}

@@ -324,3 +324,48 @@ def test_acl_replication_from_authoritative_region():
         for s in (auth, replica):
             if s.gossip:
                 s.gossip.shutdown()
+
+
+def test_autopilot_promotes_stable_nonvoter(tmp_path):
+    """raft-autopilot flow: a gossip-joined server enters as a NON-VOTER
+    and is promoted to voter after the stabilization window (ref
+    nomad/autopilot.go promoteStableServers)."""
+    servers = [_mk_server(name=f"pv{i}") for i in range(2)]
+    try:
+        for i, s in enumerate(servers):
+            s.enable_raft(s.name, {s.name: s.rpc_addr},
+                          data_dir=str(tmp_path / f"pv{i}"),
+                          bootstrap=(i == 0), **FAST)
+        servers[0].start()
+        servers[0].gossip_listen()
+        assert wait_until(lambda: servers[0].raft_node.is_leader(),
+                          timeout=10)
+        # fast stabilization window for the test
+        servers[0].state.set_autopilot_config(
+            servers[0].state.latest_index() + 1,
+            {"ServerStabilizationTimeSec": 0.5})
+        servers[1].start()
+        servers[1].gossip_listen()
+        servers[1].gossip_join([servers[0].gossip.addr])
+        # adopted as non-voter first...
+        assert wait_until(
+            lambda: "pv1" in servers[0].raft_node.peers, timeout=10)
+        health = {s["ID"]: s for s in servers[0].raft_node.server_health()}
+        assert health["pv1"]["Voter"] is False or \
+            "pv1" not in servers[0].raft_node.nonvoters  # (already fast)
+        # ...then promoted once stable
+        assert wait_until(
+            lambda: "pv1" not in servers[0].raft_node.nonvoters,
+            timeout=15)
+        health = {s["ID"]: s for s in servers[0].raft_node.server_health()}
+        assert health["pv1"]["Voter"] is True
+        # replication works throughout
+        job = mock.job()
+        servers[0].job_register(job)
+        assert wait_until(lambda: servers[1].state.job_by_id(
+            "default", job.id) is not None, timeout=10)
+    finally:
+        shutdown_all(servers)
+        for s in servers:
+            if s.gossip:
+                s.gossip.shutdown()
