@@ -27,6 +27,7 @@ import signal
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 
@@ -410,6 +411,63 @@ def cmd_node_eligibility(args) -> None:
 
 # ------------------------------------------------------------------ other
 
+def cmd_alloc_signal(args) -> None:
+    """ref command/alloc_signal.go"""
+    alloc_id, task = _alloc_task(args.alloc_id, args.task)
+    api("POST", f"/v1/client/allocation/{alloc_id}/signal",
+        {"Task": task, "Signal": args.signal})
+    print(f"Signalled {args.signal} to task {task!r} of {alloc_id[:8]}")
+
+
+def cmd_alloc_restart(args) -> None:
+    """ref command/alloc_restart.go"""
+    alloc_id, task = _alloc_task(args.alloc_id, args.task)
+    api("POST", f"/v1/client/allocation/{alloc_id}/restart",
+        {"Task": task})
+    print(f"Restarted task {task!r} of {alloc_id[:8]}")
+
+
+def cmd_alloc_stop(args) -> None:
+    """ref command/alloc_stop.go"""
+    alloc_id, _ = _alloc_task(args.alloc_id, "-")
+    out = api("POST", f"/v1/allocation/{alloc_id}/stop", {})
+    ev = out.get("eval_id") or out.get("EvalID") or ""
+    print(f"Stopped {alloc_id[:8]} (eval {ev[:8]})")
+
+
+def cmd_alloc_fs(args) -> None:
+    """ref command/alloc_fs.go: ls/stat/cat inside the alloc dir"""
+    alloc_id, _ = _alloc_task(args.alloc_id, "-")
+    path = urllib.parse.quote(args.path or "/")
+    st = api("GET", f"/v1/client/fs/stat/{alloc_id}?path={path}")
+    if args.stat:
+        print(json.dumps(st, indent=2))
+        return
+    if st.get("IsDir"):
+        listing = api("GET", f"/v1/client/fs/ls/{alloc_id}?path={path}")
+        _table([[e["Name"], "dir" if e["IsDir"] else e["Size"],
+                 e["FileMode"]] for e in listing],
+               ["Name", "Size", "Mode"])
+    else:
+        sys.stdout.buffer.write(api_raw(
+            "GET", f"/v1/client/fs/cat/{alloc_id}?path={path}"))
+
+
+def cmd_eval_list(args) -> None:
+    """ref command/eval_list.go"""
+    evs = api("GET", "/v1/evaluations")
+    _table([[e["ID"][:8], e["JobID"], e["Type"], e["TriggeredBy"],
+             e["Status"]] for e in evs[:args.limit]],
+           ["ID", "Job", "Type", "Triggered By", "Status"])
+
+
+def cmd_server_force_leave(args) -> None:
+    """ref command/server_force_leave.go"""
+    api("POST", "/v1/agent/force-leave?node="
+        + urllib.parse.quote(args.name))
+    print(f"Force-left {args.name}")
+
+
 def cmd_alloc_status(args) -> None:
     a = api("GET", f"/v1/allocation/{args.alloc_id}")
     print(f"ID            = {a['ID']}")
@@ -427,11 +485,9 @@ def cmd_alloc_status(args) -> None:
 
 def _alloc_task(alloc_id: str, task: str) -> tuple[str, str]:
     """Resolve (full alloc id, task name) from a possibly-short id."""
-    try:
+    if len(alloc_id) == 36:
         a = api("GET", f"/v1/allocation/{alloc_id}")
-    except SystemExit:
-        a = None
-    if not a:
+    else:
         matches = [x for x in (api("GET", "/v1/allocations") or [])
                    if x["ID"].startswith(alloc_id)]
         if len(matches) != 1:
@@ -830,6 +886,23 @@ def build_parser() -> argparse.ArgumentParser:
     aex.add_argument("-tty", action="store_true")
     aex.add_argument("command", nargs=argparse.REMAINDER)
     aex.set_defaults(fn=cmd_alloc_exec)
+    asg = asub.add_parser("signal")
+    asg.add_argument("alloc_id")
+    asg.add_argument("-task", default="")
+    asg.add_argument("-s", dest="signal", default="SIGUSR1")
+    asg.set_defaults(fn=cmd_alloc_signal)
+    ars = asub.add_parser("restart")
+    ars.add_argument("alloc_id")
+    ars.add_argument("-task", default="")
+    ars.set_defaults(fn=cmd_alloc_restart)
+    asp = asub.add_parser("stop")
+    asp.add_argument("alloc_id")
+    asp.set_defaults(fn=cmd_alloc_stop)
+    afs = asub.add_parser("fs")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?", default="/")
+    afs.add_argument("-stat", action="store_true")
+    afs.set_defaults(fn=cmd_alloc_fs)
     alg = asub.add_parser("logs")
     alg.add_argument("alloc_id")
     alg.add_argument("-task", default="")
@@ -842,6 +915,9 @@ def build_parser() -> argparse.ArgumentParser:
     est = esub.add_parser("status")
     est.add_argument("eval_id")
     est.set_defaults(fn=cmd_eval_status)
+    eli = esub.add_parser("list")
+    eli.add_argument("-limit", type=int, default=50)
+    eli.set_defaults(fn=cmd_eval_list)
 
     dep = sub.add_parser("deployment")
     dep.add_argument("action",
@@ -927,6 +1003,9 @@ def build_parser() -> argparse.ArgumentParser:
     srvsub = srv.add_subparsers(dest="srv_cmd", required=True)
     sm = srvsub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+    sfl = srvsub.add_parser("force-leave")
+    sfl.add_argument("name")
+    sfl.set_defaults(fn=cmd_server_force_leave)
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)

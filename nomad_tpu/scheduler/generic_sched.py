@@ -152,6 +152,12 @@ class GenericScheduler:
         if self.plan.is_no_op():
             return True
 
+        if self.plan.annotations is not None:
+            # resolved now that placement filled the plan (ref
+            # structs.go PlanAnnotations.PreemptedAllocs)
+            self.plan.annotations.preempted_allocs = [
+                a.id for allocs in self.plan.node_preemptions.values()
+                for a in allocs]
         result = self.planner.submit_plan(self.plan)
         self.plan_result = result
         if result is None:

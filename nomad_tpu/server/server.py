@@ -695,12 +695,19 @@ class Server:
                   ev)
         plan = h.plans[-1] if h.plans else None
         final_ev = h.evals[-1] if h.evals else ev
+        the_diff = job_diff(old, cand) if diff else None
+        if the_diff is not None and plan is not None and \
+                plan.annotations is not None:
+            # scheduling-consequence annotations (ref scheduler/annotate.go
+            # Annotate): what each change FORCES + per-group update counts
+            from ..scheduler.annotate import annotate_job_diff
+            annotate_job_diff(the_diff, plan.annotations)
         return {
             "Annotations": to_api(plan.annotations) if plan else None,
             "FailedTGAllocs": to_api(final_ev.failed_tg_allocs) or None,
             "JobModifyIndex": old.modify_index if old else 0,
             "CreatedEvals": [to_api(e) for e in h.created_evals],
-            "Diff": job_diff(old, cand) if diff else None,
+            "Diff": the_diff,
             "Index": self.state.latest_index(),
         }
 

@@ -729,3 +729,52 @@ def test_oversized_task_lands_on_big_node():
     allocs = allocs_of(h, job)
     assert len(allocs) == 2
     assert all(a.node_id == big.id for a in allocs)
+
+
+# ------------------------------------------------- plan annotations
+
+def test_job_plan_annotates_diff_with_consequences():
+    """`job plan` diffs carry what each change FORCES plus per-group
+    update counts (ref scheduler/annotate.go + structs/diff.go)."""
+    from nomad_tpu.server import Server
+    s = Server(num_workers=1, gc_interval=9999)
+    s.start()
+    try:
+        for _ in range(6):
+            s.state.upsert_node(s.state.latest_index() + 1, mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].resources.networks = []
+        s.job_register(job)
+        import time as _t
+        deadline = _t.time() + 10
+        while _t.time() < deadline and len(s.state.allocs_by_job(
+                "default", job.id)) < 2:
+            _t.sleep(0.05)
+        assert len(s.state.allocs_by_job("default", job.id)) == 2
+
+        # count increase + destructive task change
+        upd = job.copy()
+        upd.task_groups[0].count = 5
+        upd.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+        out = s.job_plan(upd)
+        tg_diff = out["Diff"]["TaskGroups"][0]
+        count_field = next(f for f in tg_diff["Fields"]
+                           if f["Name"] == "Count")
+        assert "forces create" in count_field.get("Annotations", [])
+        task_diff = tg_diff["Tasks"][0]
+        assert "forces create/destroy update" in \
+            task_diff.get("Annotations", [])
+        ups = tg_diff["Updates"]
+        assert ups["create/destroy update"] == 2     # existing pair rolls
+        assert ups["create"] == 3                    # count 2 -> 5
+
+        # scale down annotates forces destroy
+        down = job.copy()
+        down.task_groups[0].count = 1
+        out2 = s.job_plan(down)
+        tg2 = out2["Diff"]["TaskGroups"][0]
+        cf2 = next(f for f in tg2["Fields"] if f["Name"] == "Count")
+        assert "forces destroy" in cf2.get("Annotations", [])
+    finally:
+        s.shutdown()
