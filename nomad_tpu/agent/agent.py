@@ -43,10 +43,32 @@ class AgentConfig:
     bootstrap_expect: int = 1
     replication_token: str = ""        # ACL replication auth (federation)
     plugin_dir: str = ""               # external driver plugin executables
+    # tls { } stanza (ref structs/config/tls.go): mutual TLS over the
+    # RPC transport when all three files are set
+    tls_enabled: bool = False
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_verify_server_hostname: bool = False
 
     def key_bytes(self) -> bytes:
         from ..rpc.server import DEFAULT_KEY
         return self.encrypt_key.encode() if self.encrypt_key else DEFAULT_KEY
+
+    def tls_config(self):
+        """TLSConfig for the RPC transport, or None when disabled."""
+        if not self.tls_enabled:
+            return None
+        if not (self.tls_ca_file and self.tls_cert_file
+                and self.tls_key_file):
+            raise ValueError(
+                "tls enabled requires ca_file, cert_file and key_file")
+        from ..tlsutil import TLSConfig
+        return TLSConfig(
+            enable_rpc=True, ca_file=self.tls_ca_file,
+            cert_file=self.tls_cert_file, key_file=self.tls_key_file,
+            verify_server_hostname=self.tls_verify_server_hostname,
+            region=self.region)
 
 
 class Agent:
@@ -82,7 +104,8 @@ class Agent:
             elif self.config.servers:
                 from ..rpc import ServerRpc
                 self._server_rpc = ServerRpc(list(self.config.servers),
-                                             key=self.config.key_bytes())
+                                             key=self.config.key_bytes(),
+                                             tls=self.config.tls_config())
                 rpc = self._server_rpc
             else:
                 raise ValueError("client-only agents need config.servers")
@@ -109,7 +132,8 @@ class Agent:
             if self.config.rpc_port >= 0:
                 self.server.rpc_listen(self.config.bind_addr,
                                        self.config.rpc_port,
-                                       key=self.config.key_bytes())
+                                       key=self.config.key_bytes(),
+                                       tls=self.config.tls_config())
             if self.config.gossip_port >= 0:
                 # gossiping agents MUST run real consensus: without it
                 # every server is its own immediate leader and two

@@ -156,4 +156,17 @@ def _apply(cfg: AgentConfig, raw: dict) -> AgentConfig:
         cfg.acl_enabled = bool(acl.get("enabled", cfg.acl_enabled))
         if "replication_token" in acl:
             cfg.replication_token = acl["replication_token"]
+    tls = raw.get("tls", {})
+    if tls:
+        # ref structs/config/tls.go: `rpc = true` turns on mutual TLS
+        # for the RPC transport
+        cfg.tls_enabled = bool(tls.get("rpc", cfg.tls_enabled))
+        for key, field in (("ca_file", "tls_ca_file"),
+                           ("cert_file", "tls_cert_file"),
+                           ("key_file", "tls_key_file")):
+            if key in tls:
+                setattr(cfg, field, tls[key])
+        if "verify_server_hostname" in tls:
+            cfg.tls_verify_server_hostname = \
+                bool(tls["verify_server_hostname"])
     return cfg

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import signal
 import sys
 import time
@@ -219,7 +220,8 @@ def cmd_job_stop(args) -> None:
     print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
 
 
-def _render_field_diffs(fields: list, indent: str) -> None:
+def _render_field_diffs(fields: list, indent: str,
+                        verbose: bool = False) -> None:
     marks = {"Added": "+", "Deleted": "-", "Edited": "+/-", "None": " "}
     for f in fields or []:
         m = marks.get(f["Type"], " ")
@@ -229,34 +231,44 @@ def _render_field_diffs(fields: list, indent: str) -> None:
             print(f"{indent}{m} {f['Name']}: {f['New']!r}")
         elif f["Type"] == "Deleted":
             print(f"{indent}{m} {f['Name']}: {f['Old']!r}")
+        elif verbose:   # Type None: context, shown only under -verbose
+            print(f"{indent}{m} {f['Name']}: {f['New']!r}")
 
 
-def _render_object_diffs(objs: list, indent: str) -> None:
+def _render_object_diffs(objs: list, indent: str,
+                         verbose: bool = False) -> None:
     for o in objs or []:
+        if o["Type"] == "None" and not verbose:
+            continue
         print(f"{indent}{o['Type']} {o['Name']} {{")
-        _render_field_diffs(o.get("Fields"), indent + "  ")
-        _render_object_diffs(o.get("Objects"), indent + "  ")
+        _render_field_diffs(o.get("Fields"), indent + "  ", verbose)
+        _render_object_diffs(o.get("Objects"), indent + "  ", verbose)
         print(f"{indent}}}")
 
 
 def cmd_job_plan(args) -> None:
     spec = _load_spec(args.spec, getattr(args, "var", None))
     spec["Diff"] = True
+    verbose = bool(getattr(args, "verbose", False))
     resp = api("PUT", f"/v1/job/{spec['Job'].get('Id') or spec['Job'].get('ID')}/plan",
                spec)
     diff = resp.get("Diff") or {}
     if diff.get("Type", "None") != "None":
         print(f"{diff['Type']} job {diff.get('ID', '')!r}")
-        _render_field_diffs(diff.get("Fields"), "  ")
-        _render_object_diffs(diff.get("Objects"), "  ")
+        _render_field_diffs(diff.get("Fields"), "  ", verbose)
+        _render_object_diffs(diff.get("Objects"), "  ", verbose)
         for tg in diff.get("TaskGroups", []):
+            if tg["Type"] == "None" and not verbose:
+                continue
             print(f"  {tg['Type']} group {tg['Name']!r}")
-            _render_field_diffs(tg.get("Fields"), "    ")
-            _render_object_diffs(tg.get("Objects"), "    ")
+            _render_field_diffs(tg.get("Fields"), "    ", verbose)
+            _render_object_diffs(tg.get("Objects"), "    ", verbose)
             for t in tg.get("Tasks", []):
+                if t["Type"] == "None" and not verbose:
+                    continue
                 print(f"    {t['Type']} task {t['Name']!r}")
-                _render_field_diffs(t.get("Fields"), "      ")
-                _render_object_diffs(t.get("Objects"), "      ")
+                _render_field_diffs(t.get("Fields"), "      ", verbose)
+                _render_object_diffs(t.get("Objects"), "      ", verbose)
     else:
         print("No changes")
     ann = resp.get("Annotations") or {}
@@ -672,6 +684,91 @@ def cmd_operator_autopilot(args) -> None:
         print("==> Autopilot configuration updated")
 
 
+def cmd_operator_debug(args) -> None:
+    """Capture a debug bundle (ref command/operator_debug.go): cluster
+    state + agent internals + metrics sampled over a duration, written as
+    nomad-debug-<ts>.tar.gz for support handoff."""
+    import tarfile
+    import tempfile
+    import time as _time
+
+    duration = float(args.duration)
+    interval = max(float(args.interval), 0.25)
+    captures = {
+        "agent-self.json": ("GET", "/v1/agent/self"),
+        "members.json": ("GET", "/v1/agent/members"),
+        "nodes.json": ("GET", "/v1/nodes"),
+        "jobs.json": ("GET", "/v1/jobs"),
+        "allocations.json": ("GET", "/v1/allocations"),
+        "evaluations.json": ("GET", "/v1/evaluations"),
+        "deployments.json": ("GET", "/v1/deployments"),
+        "scheduler-configuration.json":
+            ("GET", "/v1/operator/scheduler/configuration"),
+        "autopilot-health.json": ("GET", "/v1/operator/autopilot/health"),
+        "raft-configuration.json":
+            ("GET", "/v1/operator/raft/configuration"),
+        "regions.json": ("GET", "/v1/regions"),
+        "status-leader.json": ("GET", "/v1/status/leader"),
+        "status-peers.json": ("GET", "/v1/status/peers"),
+    }
+    raw_captures = {
+        "pprof-goroutine.txt": "/v1/agent/pprof/goroutine",
+    }
+    tmp = tempfile.mkdtemp(prefix="nomad-debug-")
+    manifest = {"CapturedAt": _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             _time.gmtime()),
+                "Duration": duration, "Interval": interval,
+                "Files": [], "Errors": {}}
+
+    def _save(name: str, payload) -> None:
+        path = os.path.join(tmp, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            if isinstance(payload, str):
+                f.write(payload)
+            else:
+                json.dump(payload, f, indent=2, default=str)
+        manifest["Files"].append(name)
+
+    # api_raw() (not api()): the JSON helper sys.exit(1)s on HTTP errors,
+    # which would abort the whole bundle — a debug capture must record
+    # the failure in the manifest and keep going
+    for name, (method, path) in captures.items():
+        try:
+            _save(name, json.loads(api_raw(method, path) or b"null"))
+        except Exception as e:  # noqa: BLE001 — capture what we can
+            manifest["Errors"][name] = str(e)
+    for name, path in raw_captures.items():
+        try:
+            _save(name, api_raw("GET", path).decode(errors="replace"))
+        except Exception as e:  # noqa: BLE001
+            manifest["Errors"][name] = str(e)
+    # sampled captures: metrics at each interval tick over the duration
+    # (ref operator_debug.go collectPeriodic)
+    deadline = _time.time() + duration
+    tick = 0
+    while True:
+        try:
+            _save(f"metrics/metrics-{tick:03d}.json",
+                  json.loads(api_raw("GET", "/v1/metrics") or b"null"))
+        except Exception as e:  # noqa: BLE001
+            manifest["Errors"][f"metrics-{tick}"] = str(e)
+        tick += 1
+        if _time.time() + interval > deadline:
+            break
+        _time.sleep(interval)
+    _save("index.json", manifest)
+
+    stamp = _time.strftime("%Y%m%d-%H%M%S")
+    out = args.output or f"nomad-debug-{stamp}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        tar.add(tmp, arcname=f"nomad-debug-{stamp}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(f"==> Debug capture complete: {out} "
+          f"({len(manifest['Files'])} files, "
+          f"{len(manifest['Errors'])} errors)")
+
+
 def cmd_monitor(args) -> None:
     """Stream agent logs (ref command/monitor.go)."""
     from .api import Client
@@ -824,6 +921,8 @@ def build_parser() -> argparse.ArgumentParser:
     jp = jsub.add_parser("plan")
     jp.add_argument("spec")
     jp.add_argument("-var", action="append")
+    jp.add_argument("-verbose", action="store_true", dest="verbose",
+                    help="show unchanged context fields in the diff")
     jp.set_defaults(fn=cmd_job_plan)
     jv = jsub.add_parser("validate")
     jv.add_argument("spec")
@@ -993,6 +1092,14 @@ def build_parser() -> argparse.ArgumentParser:
     oap.add_argument("-cleanup-dead-servers", dest="cleanup_dead_servers",
                      choices=["true", "false"], default=None)
     oap.set_defaults(fn=cmd_operator_autopilot)
+    odbg = osub.add_parser("debug")
+    odbg.add_argument("-duration", default="2",
+                      help="seconds of periodic capture (default 2)")
+    odbg.add_argument("-interval", default="1",
+                      help="seconds between metric samples (default 1)")
+    odbg.add_argument("-output", default="",
+                      help="bundle path (default nomad-debug-<ts>.tar.gz)")
+    odbg.set_defaults(fn=cmd_operator_debug)
 
     system = sub.add_parser("system")
     ssub = system.add_subparsers(dest="sys_cmd", required=True)

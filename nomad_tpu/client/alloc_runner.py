@@ -42,6 +42,8 @@ class AllocRunner:
         self._vault_tokens: dict[str, str] = {}      # task -> token
         self._services_registered = False
         self._check_runners: list = []
+        # bridge-mode netns status ({"ip","netns","gateway"}) or None
+        self.network_status: Optional[dict] = None
 
         self.alloc_dir = os.path.join(client.alloc_dir_root, alloc.id)
 
@@ -57,8 +59,16 @@ class AllocRunner:
             self._run_impl()
         finally:
             # postrun hooks, whatever path we exited on: CSI unmount
-            # (csi_hook.go), service deregistration (the consul group
-            # services hook), vault token revocation (vault_hook.go Stop)
+            # (csi_hook.go), network namespace teardown (network_hook.go
+            # Postrun), service deregistration (the consul group services
+            # hook), vault token revocation (vault_hook.go Stop)
+            try:
+                job = self.alloc.job
+                tg = job.lookup_task_group(self.alloc.task_group) \
+                    if job else None
+                self.client.network_hook.postrun(self.alloc, tg)
+            except Exception as e:      # noqa: BLE001 — best effort
+                self.client.logger(f"network_hook: teardown: {e!r}")
             self.client.csi_manager.unmount_all(self.alloc)
             self._deregister_services()
             for token in self._vault_tokens.values():
@@ -229,6 +239,16 @@ class AllocRunner:
             except Exception as e:      # noqa: BLE001 — best-effort
                 self.client.logger(f"allocwatcher: migrate failed: {e!r}")
 
+        # bridge-mode network namespace before any task starts (ref
+        # client/allocrunner/network_hook.go Prerun); the netns status is
+        # exposed to tasks via NOMAD_ALLOC_IP / NOMAD_ALLOC_NETNS
+        try:
+            self.network_status = self.client.network_hook.prerun(alloc, tg)
+        except Exception as e:          # noqa: BLE001
+            self._set_client_status(ALLOC_CLIENT_FAILED,
+                                    f"network setup failed: {e}")
+            return
+
         # CSI volumes: claim + stage + publish before any task starts
         # (ref client/allocrunner/csi_hook.go Prerun)
         csi_reqs = [r for r in tg.volumes.values() if r.type == "csi"]
@@ -301,7 +321,8 @@ class AllocRunner:
         task_dir = os.path.join(self.alloc_dir, task.name)
         env = build_task_env(self.alloc, task, self.client.node, task_dir,
                              self.alloc_dir,
-                             os.path.join(task_dir, "secrets"))
+                             os.path.join(task_dir, "secrets"),
+                             network_status=self.network_status)
         # device hook: reserved device instances -> visibility env vars
         # (ref taskrunner/device_hook.go); a reservation failure fails the
         # task rather than launching it without its devices

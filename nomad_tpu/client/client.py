@@ -50,6 +50,10 @@ class Client:
         self.csi_manager = CSIManager(self)
         from .devicemanager import DeviceManager
         self.device_manager = DeviceManager(self)
+        # shared bridge-network hook: one IP allocator + one nomad bridge
+        # per client (ref client/allocrunner/networkmanager_linux.go)
+        from .network_hook import NetworkHook
+        self.network_hook = NetworkHook(logger=self.logger)
 
         node_id = self.state_db.get_node_id()
         self.node: Node = fingerprint_node(data_dir, datacenter, node_class,

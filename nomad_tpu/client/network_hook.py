@@ -1,0 +1,228 @@
+"""Alloc network hook: bridge-mode network namespaces with port mapping
+(behavioral ref client/allocrunner/network_hook.go +
+networking_bridge_linux.go + the CNI bridge plugin conf it drives).
+
+A task group with ``network { mode = "bridge" }`` gets its own network
+namespace joined to a shared ``nomad`` bridge, an IP from the bridge
+subnet, and DNAT rules mapping each reserved/dynamic host port to the
+group's ``to`` port inside the namespace — so tasks bind container-side
+ports while the scheduler keeps owning host ports.
+
+All privileged operations run through a Commander so the manager is
+fully testable without root: the default ExecCommander shells out to
+``ip``/``iptables`` (and requires CAP_NET_ADMIN), while tests inject a
+recording fake. On hosts without the tooling the hook degrades to
+host-mode networking with a logged warning, mirroring the reference's
+fingerprint-gated behavior (bridge networking only activates on nodes
+that fingerprint the kernel support).
+"""
+from __future__ import annotations
+
+import ipaddress
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+BRIDGE_NAME = "nomad"                     # ref nomadBridgeName
+# ref defaultNomadAllocSubnet (networking_bridge_linux.go)
+BRIDGE_SUBNET = "172.26.64.0/20"
+IPTABLES_CHAIN = "NOMAD-ADMIN"            # ref cniAdminChainName
+
+
+class Commander:
+    """Shell-out boundary (swap for a fake in tests)."""
+
+    def run(self, *argv: str) -> str:
+        raise NotImplementedError
+
+    def available(self) -> bool:
+        raise NotImplementedError
+
+
+class ExecCommander(Commander):
+    def run(self, *argv: str) -> str:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=10)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(argv)}: rc={out.returncode}: "
+                f"{out.stderr.strip()}")
+        return out.stdout
+
+    def available(self) -> bool:
+        import os
+        return bool(shutil.which("ip")) and bool(shutil.which("iptables")) \
+            and os.geteuid() == 0
+
+
+class BridgeNetworkManager:
+    """Creates/destroys per-alloc namespaces on the shared nomad bridge.
+
+    IP assignment is a simple in-process allocator over the bridge
+    subnet (the reference delegates this to the CNI host-local IPAM
+    plugin with the same subnet); .1 is the bridge gateway.
+    """
+
+    def __init__(self, commander: Optional[Commander] = None, logger=None):
+        self.cmd = commander or ExecCommander()
+        self.logger = logger or (lambda msg: None)
+        self._lock = threading.Lock()
+        self._bridge_ready = False
+        net = ipaddress.ip_network(BRIDGE_SUBNET)
+        self._gateway = str(net.network_address + 1)
+        self._prefix_len = net.prefixlen
+        self._ip_pool = iter(net.hosts())
+        next(self._ip_pool)               # skip the gateway
+        self._leases: dict[str, str] = {}   # alloc_id -> ip
+        self._free_ips: list[str] = []      # recycled leases, LIFO
+
+    # ------------------------------------------------------------- bridge
+    def _ensure_bridge(self) -> None:
+        """ref networking_bridge_linux.go ensureForwardingRules + the CNI
+        bridge plugin's lazy bridge creation."""
+        if self._bridge_ready:
+            return
+        try:
+            self.cmd.run("ip", "link", "show", BRIDGE_NAME)
+        except RuntimeError:
+            self.cmd.run("ip", "link", "add", BRIDGE_NAME, "type", "bridge")
+            self.cmd.run("ip", "addr", "add",
+                         f"{self._gateway}/{self._prefix_len}",
+                         "dev", BRIDGE_NAME)
+        self.cmd.run("ip", "link", "set", BRIDGE_NAME, "up")
+        # admin chain ensuring bridge traffic is forwarded (ref
+        # ensureForwardingRules): `-C` probes for the jump rule and
+        # exits non-zero when absent — that is the fresh-host case, so
+        # insert it then
+        try:
+            self.cmd.run("iptables", "-N", IPTABLES_CHAIN)
+        except RuntimeError:
+            pass                          # chain exists
+        try:
+            self.cmd.run("iptables", "-C", "FORWARD", "-j", IPTABLES_CHAIN)
+        except RuntimeError:
+            self.cmd.run("iptables", "-I", "FORWARD", "-j", IPTABLES_CHAIN)
+        self._bridge_ready = True
+
+    # -------------------------------------------------------------- setup
+    @staticmethod
+    def netns_name(alloc_id: str) -> str:
+        return f"nomad-{alloc_id[:8]}"
+
+    def setup(self, alloc_id: str, ports: list[dict]) -> dict:
+        """Create the alloc namespace; returns {"ip", "netns", "gateway"}.
+
+        ports: [{"label", "value" (host), "to" (container)}] — one DNAT
+        rule per mapped port (ref getPortMapping + the CNI portmap
+        plugin).
+        """
+        ns = self.netns_name(alloc_id)
+        veth_host = f"veth{alloc_id[:7]}"
+        veth_ns = "eth0"
+        with self._lock:
+            self._ensure_bridge()
+            ip = self._leases.get(alloc_id)
+            if ip is None:
+                # recycled leases first so a long-lived client never
+                # exhausts the subnet (the host-local IPAM plugin the
+                # reference drives recycles the same way)
+                ip = (self._free_ips.pop() if self._free_ips
+                      else str(next(self._ip_pool)))
+                self._leases[alloc_id] = ip
+        self.cmd.run("ip", "netns", "add", ns)
+        try:
+            self.cmd.run("ip", "link", "add", veth_host, "type", "veth",
+                         "peer", "name", veth_ns, "netns", ns)
+            self.cmd.run("ip", "link", "set", veth_host, "master",
+                         BRIDGE_NAME)
+            self.cmd.run("ip", "link", "set", veth_host, "up")
+            self.cmd.run("ip", "-n", ns, "addr", "add",
+                         f"{ip}/{self._prefix_len}", "dev", veth_ns)
+            self.cmd.run("ip", "-n", ns, "link", "set", veth_ns, "up")
+            self.cmd.run("ip", "-n", ns, "link", "set", "lo", "up")
+            self.cmd.run("ip", "-n", ns, "route", "add", "default", "via",
+                         self._gateway)
+            for p in ports:
+                to = int(p.get("to") or p.get("value") or 0)
+                host_port = int(p.get("value") or 0)
+                if host_port <= 0 or to <= 0:
+                    continue
+                self.cmd.run(
+                    "iptables", "-t", "nat", "-A", "PREROUTING",
+                    "-p", "tcp", "--dport", str(host_port),
+                    "-j", "DNAT", "--to-destination", f"{ip}:{to}",
+                    "-m", "comment", "--comment", f"nomad-alloc-{alloc_id[:8]}")
+        except RuntimeError:
+            self.teardown(alloc_id, ports)
+            raise
+        return {"ip": ip, "netns": ns, "gateway": self._gateway}
+
+    # ------------------------------------------------------------ teardown
+    def teardown(self, alloc_id: str, ports: list[dict]) -> None:
+        ns = self.netns_name(alloc_id)
+        with self._lock:
+            ip = self._leases.pop(alloc_id, None)
+            if ip is not None:
+                self._free_ips.append(ip)
+        for p in ports or []:
+            to = int(p.get("to") or p.get("value") or 0)
+            host_port = int(p.get("value") or 0)
+            if host_port <= 0 or to <= 0 or ip is None:
+                continue
+            try:
+                self.cmd.run(
+                    "iptables", "-t", "nat", "-D", "PREROUTING",
+                    "-p", "tcp", "--dport", str(host_port),
+                    "-j", "DNAT", "--to-destination", f"{ip}:{to}",
+                    "-m", "comment", "--comment", f"nomad-alloc-{alloc_id[:8]}")
+            except RuntimeError:
+                pass
+        try:
+            self.cmd.run("ip", "netns", "delete", ns)
+        except RuntimeError:
+            pass                          # already gone (idempotent stop)
+
+
+class NetworkHook:
+    """The alloc-runner-facing hook (ref network_hook.go): no-ops unless
+    the group requests bridge mode AND the host supports it."""
+
+    def __init__(self, manager: Optional[BridgeNetworkManager] = None,
+                 logger=None):
+        self.logger = logger or (lambda msg: None)
+        self.manager = manager or BridgeNetworkManager(logger=self.logger)
+        self.status: dict[str, dict] = {}    # alloc_id -> netns status
+
+    @staticmethod
+    def _bridge_requested(tg) -> bool:
+        return bool(tg and tg.networks
+                    and tg.networks[0].mode == "bridge")
+
+    @staticmethod
+    def _alloc_ports(alloc) -> list[dict]:
+        res = alloc.allocated_resources
+        if res is None or res.shared is None:
+            return []
+        return [dict(p) for p in (res.shared.ports or [])]
+
+    def prerun(self, alloc, tg) -> Optional[dict]:
+        if not self._bridge_requested(tg):
+            return None
+        if not self.manager.cmd.available():
+            # degrade to host networking, as the reference does on nodes
+            # whose fingerprint lacks bridge support
+            self.logger(
+                f"network_hook: bridge mode requested by alloc "
+                f"{alloc.id[:8]} but host tooling unavailable; using "
+                f"host networking")
+            return None
+        st = self.manager.setup(alloc.id, self._alloc_ports(alloc))
+        self.status[alloc.id] = st
+        return st
+
+    def postrun(self, alloc, tg) -> None:
+        if alloc.id not in self.status:
+            return
+        self.manager.teardown(alloc.id, self._alloc_ports(alloc))
+        self.status.pop(alloc.id, None)

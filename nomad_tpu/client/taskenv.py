@@ -8,11 +8,16 @@ from ..structs import Allocation, Node, Task, alloc_name_index
 
 
 def build_task_env(alloc: Allocation, task: Task, node: Node,
-                   task_dir: str, alloc_dir: str, secrets_dir: str
-                   ) -> dict[str, str]:
+                   task_dir: str, alloc_dir: str, secrets_dir: str,
+                   network_status: dict = None) -> dict[str, str]:
     env: dict[str, str] = {}
     job = alloc.job
     env["NOMAD_ALLOC_ID"] = alloc.id
+    if network_status:
+        # bridge-mode netns (ref network_hook.go: the alloc's network
+        # status feeds NOMAD_ALLOC_IP and friends)
+        env["NOMAD_ALLOC_IP"] = network_status.get("ip", "")
+        env["NOMAD_ALLOC_NETNS"] = network_status.get("netns", "")
     env["NOMAD_SHORT_ALLOC_ID"] = alloc.id[:8]
     env["NOMAD_ALLOC_NAME"] = alloc.name
     env["NOMAD_ALLOC_INDEX"] = str(max(0, alloc_name_index(alloc.name)))

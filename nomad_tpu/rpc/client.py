@@ -25,11 +25,16 @@ class RpcClient:
     """
 
     def __init__(self, servers: list[str], key: bytes = DEFAULT_KEY,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, tls=None):
         if not servers:
             raise ValueError("RpcClient needs at least one server address")
         self.key = key
         self.timeout = timeout
+        # TLSConfig (tlsutil.py) or None; when set every connection is
+        # wrapped before framing (ref helper/tlsutil OutgoingTLSConfig +
+        # optional VerifyServerHostname against server.<region>.nomad)
+        self.tls = tls
+        self._tls_ctx = tls.client_context() if tls else None
         self._lock = threading.Lock()
         self._servers = list(servers)
         self._pool: dict[str, list[socket.socket]] = {}
@@ -49,6 +54,9 @@ class RpcClient:
         host, _, port = addr.rpartition(":")
         sock = socket.create_connection((host, int(port)), timeout=self.timeout)
         sock.settimeout(self.timeout)
+        if self._tls_ctx is not None:
+            sock = self._tls_ctx.wrap_socket(
+                sock, server_hostname=self.tls.server_name)
         return sock
 
     def _checkout(self, addr: str) -> socket.socket:
@@ -179,8 +187,8 @@ class ServerRpc:
     Alloc.GetAlloc / Node.UpdateAlloc through its server list)."""
 
     def __init__(self, servers: list[str], key: bytes = DEFAULT_KEY,
-                 timeout: float = 30.0):
-        self.rpc = RpcClient(servers, key=key, timeout=timeout)
+                 timeout: float = 30.0, tls=None):
+        self.rpc = RpcClient(servers, key=key, timeout=timeout, tls=tls)
 
     def node_register(self, node):
         return self.rpc.call("Node.Register", node)

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import socket
 import socketserver
+import ssl
 import threading
 import time
 from typing import Callable, Optional
@@ -24,9 +25,15 @@ class RpcServer:
     """
 
     def __init__(self, bind: str = "127.0.0.1", port: int = 0,
-                 key: bytes = DEFAULT_KEY, logger=None):
+                 key: bytes = DEFAULT_KEY, logger=None, tls=None):
         self.key = key
         self.logger = logger or (lambda msg: None)
+        # TLSConfig (tlsutil.py) or None; when set, every accepted
+        # connection is wrapped in mutual TLS before framing begins (ref
+        # nomad/rpc.go listen → tlsutil IncomingTLSConfig), and outbound
+        # forwards dial with the client context
+        self.tls = tls
+        self._tls_server_ctx = tls.server_context() if tls else None
         self._handlers: dict[str, tuple[Callable, bool]] = {}
         # wired by the consensus layer: () -> (is_leader, leader_rpc_addr)
         self.leadership_fn: Callable[[], tuple[bool, str]] = lambda: (True, "")
@@ -43,6 +50,13 @@ class RpcServer:
                 # idle/trickle connections may not pin a thread (and up to
                 # MAX_FRAME of pre-auth buffer) forever
                 sock.settimeout(300.0)
+                if outer._tls_server_ctx is not None:
+                    try:
+                        sock = outer._tls_server_ctx.wrap_socket(
+                            sock, server_side=True)
+                    except (ssl.SSLError, OSError) as e:
+                        outer.logger(f"rpc: tls handshake failed: {e}")
+                        return
                 try:
                     while True:
                         try:
@@ -137,7 +151,7 @@ class RpcServer:
         last = None
         for addr in addrs[:3]:
             try:
-                with RpcClient([addr], key=self.key) as cli:
+                with RpcClient([addr], key=self.key, tls=self.tls) as cli:
                     # the target is in `region`, so it serves locally —
                     # the stamp is kept for integrity, not re-forwarded
                     return {"result": cli.call(
@@ -159,7 +173,8 @@ class RpcServer:
             return None
         from .client import RpcClient
         try:
-            with RpcClient([leader_addr], key=self.key) as cli:
+            with RpcClient([leader_addr], key=self.key,
+                           tls=self.tls) as cli:
                 return {"result": cli.call(method, *req.get("args", ()),
                                            **req.get("kwargs", {}))}
         except NotLeaderError as e:

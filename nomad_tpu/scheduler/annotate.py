@@ -33,8 +33,11 @@ def _annotate_task(task_diff: dict, destructive: bool) -> None:
     """ref annotate.go annotateTask: every non-terminal task change is
     either destructive or in-place, decided by what the reconciler
     actually planned for the group."""
-    if task_diff.get("Type") in ("Added", "Deleted"):
-        return                           # the group-level counts cover it
+    if task_diff.get("Type") in ("Added", "Deleted", "None"):
+        # Added/Deleted: the group-level counts cover it; None: an
+        # unchanged task carried as context by a contextual diff forces
+        # nothing (ref annotate.go skips DiffTypeNone)
+        return
     ann = ANN_FORCES_DESTRUCTIVE if destructive else ANN_FORCES_INPLACE
     task_diff.setdefault("Annotations", []).append(ann)
 

@@ -556,7 +556,7 @@ class RaftNode:
         from ..rpc.client import RpcClient
         try:
             with RpcClient([addr], key=self.rpc_server.key,
-                           timeout=1.0) as cli:
+                           timeout=1.0, tls=self.rpc_server.tls) as cli:
                 resp = cli.call("Raft.RequestVote", term, self.node_id,
                                 last_idx, last_term)
         except Exception:    # noqa: BLE001
@@ -647,7 +647,8 @@ class RaftNode:
         addr = self.peers.get(pid)
         if addr is None:
             return
-        cli = RpcClient([addr], key=self.rpc_server.key, timeout=2.0)
+        cli = RpcClient([addr], key=self.rpc_server.key, timeout=2.0,
+                        tls=self.rpc_server.tls)
         ev = self._replicate_events[pid]
         try:
             while not self._stop.is_set():

@@ -221,13 +221,13 @@ class Server:
             self._revoke_leadership()
 
     def rpc_listen(self, bind: str = "127.0.0.1", port: int = 0,
-                   key: bytes = None) -> str:
+                   key: bytes = None, tls=None) -> str:
         """Start serving the network RPC surface (ref nomad/rpc.go
         listen/handleConn). Returns the bound "host:port" address."""
         from ..rpc.server import DEFAULT_KEY, RpcServer
         self.rpc_server = RpcServer(bind=bind, port=port,
                                     key=key or DEFAULT_KEY,
-                                    logger=self.logger)
+                                    logger=self.logger, tls=tls)
         self.rpc_server.register_endpoints(self, RPC_ENDPOINTS)
         self.rpc_server.leadership_fn = \
             lambda: (self.is_leader, self.leader_rpc_addr)
@@ -422,7 +422,8 @@ class Server:
                 continue
             try:
                 from ..rpc.client import RpcClient
-                with RpcClient(addrs, key=self.rpc_server.key) as cli:
+                with RpcClient(addrs, key=self.rpc_server.key,
+                               tls=self.rpc_server.tls) as cli:
                     pol_wire = cli.call("ACL.ListPolicies",
                                         secret=self.replication_token)
                     tok_wire = cli.call("ACL.ListTokens", True,
@@ -695,7 +696,9 @@ class Server:
                   ev)
         plan = h.plans[-1] if h.plans else None
         final_ev = h.evals[-1] if h.evals else ev
-        the_diff = job_diff(old, cand) if diff else None
+        # contextual=True per ref job_endpoint.go Plan → Diff(job, true):
+        # unchanged fields ride along as Type None for `plan -verbose`
+        the_diff = job_diff(old, cand, contextual=True) if diff else None
         if the_diff is not None and plan is not None and \
                 plan.annotations is not None:
             # scheduling-consequence annotations (ref scheduler/annotate.go

@@ -108,7 +108,8 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
                k_max: int = 128,
                spread_algorithm: bool = False,
                order_jitter: Optional[jnp.ndarray] = None,
-               jitter_scale: float = 0.5) -> jnp.ndarray:
+               jitter_scale: float = 0.5,
+               jitter_samples: float = 0.0) -> jnp.ndarray:
     """Depth-optimal placement of identical instances under the full
     binpack + job-anti-affinity + affinity score model.
 
@@ -186,6 +187,29 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
         # its outcome concentrates on the true best nodes, so the placer
         # raises g (sharper selection) with the expected samples-per-node
         # m = 2*count/n. Depths stay density-optimal either way.
+        # Depth follows the same sampling law as the order: a host
+        # worker can stack a node only as often as it resurfaces in the
+        # shuffled iterator's windows — jitter_samples = width*count/n
+        # times per eval (width 2 for batch power-of-two-choices,
+        # ceil(log2(n)) for the service limit, stack.go:71-91) — so
+        # depth is capped at ceil(samples)+1. Without the cap,
+        # concurrent workers deep-fill their (few) E-S-chosen nodes to
+        # capacity and ANY overlap between two workers' plans
+        # overcommits and is rejected by the serial applier; host
+        # workers overlap just as often but lightly enough to co-fit.
+        # The RANKING deliberately stays on the UNCAPPED density: ranking
+        # by capped (shallow) density makes binpack favor the smallest
+        # nodes — the same few nodes for every concurrent worker — and
+        # measured plan rejections nearly double as the workers pile onto
+        # exactly the least-headroom machines. The uncapped rank keeps
+        # the preference field flatter, and the E-S draw then spreads
+        # workers across it. The leftover pass below still deepens to
+        # true capacity when the ask exceeds the capped coverage, so
+        # placement count is unaffected.
+        js = jnp.asarray(jitter_samples, jnp.float32)
+        jcap = jnp.where(js > 0.0, jnp.ceil(js) + 1.0,
+                         jnp.float32(2 ** 30)).astype(jnp.int32)
+        k_star = jnp.minimum(k_star, jnp.maximum(jcap, 1))
         fin = jnp.isfinite(d_star)
         rank = jnp.argsort(jnp.argsort(-d_star))        # 0 = best density
         n_fin = jnp.maximum(jnp.sum(fin), 1)

@@ -255,20 +255,36 @@ class SolverPlacer:
             # affinities the reference raises its sampling limit to
             # >= 100 (stack.go:170) — max-score, effectively
             # deterministic — so affinity evals skip the jitter.
-            if affinities:
+            # The host's per-placement sampling width (stack.go:71-91):
+            # best-of-2 for batch (power-of-two-choices), best-of-
+            # ceil(log2(n)) for service. m = width*count/n is the
+            # expected samples per node over the eval. Three regimes:
+            #   * affinities: the reference raises its limit to >= 100
+            #     (stack.go:170) — max-score, deterministic;
+            #   * m > 3: repeated draws hit already-filled nodes often
+            #     enough that the host's preferential attachment
+            #     concentrates on the best nodes — effectively
+            #     deterministic, so the density fill runs unjittered at
+            #     full depth (concurrent workers in this regime collide
+            #     host-side just the same);
+            #   * else: E-S weighted random order emulating best-of-w
+            #     (weight exponent g ~ w-1, sharpened as m grows), with
+            #     per-node depth capped at ceil(m)+1 — a host worker can
+            #     stack a node only once per pass over the shuffled list.
+            n_feas = max(int(np.asarray(gt.feasible).sum()), 1)
+            width = 2.0 if self.sched.batch else \
+                max(2.0, float(np.ceil(np.log2(max(n_feas, 2)))))
+            m = width * count / n_feas
+            if affinities or m > 3.0:
                 jitter = None
                 bias_g = 1.0
+                m = 0.0
             else:
                 rng = np.random.default_rng(random.getrandbits(64))
                 jitter = jnp.asarray(
                     rng.random(gt.cap.shape[0], dtype=np.float32))
-                # selection sharpness tracks the host's samples-per-node
-                # m = 2*count/n (see fill_depth): flat best-of-2 lottery
-                # when the cluster dwarfs the ask, concentrating on the
-                # true best nodes as repeated sampling would
-                n_feas = max(int(np.asarray(gt.feasible).sum()), 1)
-                m = 2.0 * count / n_feas
-                bias_g = float(np.clip(m - 1.0, 1.0, 8.0))
+                bias_g = float(np.clip((width - 1.0) + max(m - 1.0, 0.0),
+                                       1.0, 8.0))
             placed = fill_depth(
                 jnp.asarray(gt.cap), jnp.asarray(gt.used),
                 jnp.asarray(gt.ask), jnp.int32(count),
@@ -276,7 +292,8 @@ class SolverPlacer:
                 jnp.int32(tg.count), jnp.asarray(aff),
                 max_per_node=max_per_node, k_max=k_max,
                 spread_algorithm=spread_alg,
-                order_jitter=jitter, jitter_scale=bias_g)
+                order_jitter=jitter, jitter_scale=bias_g,
+                jitter_samples=m)
         elif use_scan:
             # one solve covers max_steps * k instances; split larger asks
             # across repeated solves, feeding the running state (usage,

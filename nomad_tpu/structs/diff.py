@@ -35,12 +35,17 @@ def _fmt(v) -> str:
     return _to_string(v)
 
 
-def _field_diff(name: str, old, new) -> Optional[dict]:
-    if old == new:
-        return None
-    if old in (None, "", 0, False, [], {}) and new in (None, "", 0, False,
-                                                       [], {}):
-        return None
+def _field_diff(name: str, old, new, contextual: bool = False
+                ) -> Optional[dict]:
+    if old == new or (old in (None, "", 0, False, [], {})
+                      and new in (None, "", 0, False, [], {})):
+        if not contextual:
+            return None
+        # contextual mode (ref diff.go fieldDiff w/ contextual=true):
+        # unchanged fields appear with Type None so `plan -verbose` can
+        # show the full object, not just the delta.
+        return {"Type": DIFF_NONE, "Name": name,
+                "Old": _fmt(old), "New": _fmt(new)}
     typ = DIFF_EDITED
     if old in (None, "", [], {}):
         typ = DIFF_ADDED
@@ -49,31 +54,40 @@ def _field_diff(name: str, old, new) -> Optional[dict]:
     return {"Type": typ, "Name": name, "Old": _fmt(old), "New": _fmt(new)}
 
 
-def _object_diff(name: str, old: Optional[dict], new: Optional[dict]
-                 ) -> Optional[dict]:
+def _object_diff(name: str, old: Optional[dict], new: Optional[dict],
+                 contextual: bool = False) -> Optional[dict]:
     """Diff two API dicts into {Type, Name, Fields, Objects}."""
     old = old or {}
     new = new or {}
     fields, objects = [], []
+    changed = False
     for key in sorted(set(old) | set(new)):
         if key in _IGNORED:
             continue
         ov, nv = old.get(key), new.get(key)
         if _scalar(ov) and _scalar(nv):
-            fd = _field_diff(key, ov, nv)
+            fd = _field_diff(key, ov, nv, contextual)
             if fd:
                 fields.append(fd)
+                changed = changed or fd["Type"] != DIFF_NONE
         elif isinstance(ov, dict) or isinstance(nv, dict):
             od = _object_diff(key, ov if isinstance(ov, dict) else None,
-                              nv if isinstance(nv, dict) else None)
+                              nv if isinstance(nv, dict) else None,
+                              contextual)
             if od:
                 objects.append(od)
+                changed = changed or od["Type"] != DIFF_NONE
         else:   # lists
-            od = _list_diff(key, ov or [], nv or [])
-            if od:
-                objects.extend(od)
-    if not fields and not objects:
-        return None
+            ods = _list_diff(key, ov or [], nv or [], contextual)
+            if ods:
+                objects.extend(ods)
+                changed = changed or any(
+                    o["Type"] != DIFF_NONE for o in ods)
+    if not changed:
+        if not (contextual and (fields or objects)):
+            return None
+        return {"Type": DIFF_NONE, "Name": name, "Fields": fields,
+                "Objects": objects}
     typ = DIFF_EDITED
     if not old:
         typ = DIFF_ADDED
@@ -92,7 +106,8 @@ def _list_key(item) -> str:
     return str(item)
 
 
-def _list_diff(name: str, old: list, new: list) -> list[dict]:
+def _list_diff(name: str, old: list, new: list,
+               contextual: bool = False) -> list[dict]:
     """Diff element lists keyed by a natural identity field."""
     out = []
     if all(_scalar(x) for x in old + new):
@@ -105,19 +120,26 @@ def _list_diff(name: str, old: list, new: list) -> list[dict]:
             out.append({"Type": DIFF_ADDED, "Name": name,
                         "Fields": [{"Type": DIFF_ADDED, "Name": name,
                                     "Old": "", "New": v}], "Objects": []})
+        if contextual:
+            for v in sorted(olds & news):
+                out.append({"Type": DIFF_NONE, "Name": name,
+                            "Fields": [{"Type": DIFF_NONE, "Name": name,
+                                        "Old": v, "New": v}],
+                            "Objects": []})
         return out
     om = {_list_key(x): x for x in old}
     nm = {_list_key(x): x for x in new}
     for key in sorted(set(om) | set(nm)):
-        od = _object_diff(name, om.get(key), nm.get(key))
+        od = _object_diff(name, om.get(key), nm.get(key), contextual)
         if od:
             out.append(od)
     return out
 
 
-def task_diff(old: Optional[dict], new: Optional[dict]) -> Optional[dict]:
+def task_diff(old: Optional[dict], new: Optional[dict],
+              contextual: bool = False) -> Optional[dict]:
     name = (new or old or {}).get("Name", "")
-    d = _object_diff("Task", old, new)
+    d = _object_diff("Task", old, new, contextual)
     if d is None:
         return None
     d["Name"] = name
@@ -125,45 +147,53 @@ def task_diff(old: Optional[dict], new: Optional[dict]) -> Optional[dict]:
     return d
 
 
-def task_group_diff(old: Optional[dict], new: Optional[dict]
-                    ) -> Optional[dict]:
+def task_group_diff(old: Optional[dict], new: Optional[dict],
+                    contextual: bool = False) -> Optional[dict]:
     name = (new or old or {}).get("Name", "")
     old, new = dict(old or {}), dict(new or {})
     old_tasks = {t.get("Name"): t for t in old.pop("Tasks", None) or []}
     new_tasks = {t.get("Name"): t for t in new.pop("Tasks", None) or []}
-    d = _object_diff("Group", old or None, new or None) or \
+    d = _object_diff("Group", old or None, new or None, contextual) or \
         {"Type": DIFF_NONE, "Name": "Group", "Fields": [], "Objects": []}
     tasks = []
     for tname in sorted(set(old_tasks) | set(new_tasks)):
-        td = task_diff(old_tasks.get(tname), new_tasks.get(tname))
+        td = task_diff(old_tasks.get(tname), new_tasks.get(tname),
+                       contextual)
         if td:
             tasks.append(td)
-    if d["Type"] == DIFF_NONE and not tasks:
+    if d["Type"] == DIFF_NONE and not contextual and \
+            not any(t["Type"] != DIFF_NONE for t in tasks):
         return None
     typ = d["Type"]
     if not old and new:
         typ = DIFF_ADDED
     elif old and not new:
         typ = DIFF_DELETED
-    elif tasks and typ == DIFF_NONE:
+    elif typ == DIFF_NONE and any(t["Type"] != DIFF_NONE for t in tasks):
         typ = DIFF_EDITED
     return {"Type": typ, "Name": name, "Fields": d["Fields"],
             "Objects": d["Objects"], "Tasks": tasks, "Updates": {}}
 
 
-def job_diff(old, new) -> dict:
+def job_diff(old, new, contextual: bool = False) -> dict:
     """Diff two Job dataclasses (either may be None) into the JobDiff API
-    shape consumed by `job plan` (ref structs/diff.go JobDiff)."""
+    shape consumed by `job plan` (ref structs/diff.go JobDiff).
+
+    With contextual=True (the plan endpoint's mode, ref
+    job_endpoint.go Plan → job.Diff(args.Job, true)), unchanged fields
+    and objects are included with Type "None" so the CLI can render the
+    full context under -verbose."""
     oapi = to_api(old) if old is not None else {}
     napi = to_api(new) if new is not None else {}
     job_id = (napi or oapi).get("Id") or (napi or oapi).get("ID", "")
     old_tgs = {g.get("Name"): g for g in oapi.pop("TaskGroups", None) or []}
     new_tgs = {g.get("Name"): g for g in napi.pop("TaskGroups", None) or []}
-    top = _object_diff("Job", oapi or None, napi or None) or \
+    top = _object_diff("Job", oapi or None, napi or None, contextual) or \
         {"Type": DIFF_NONE, "Fields": [], "Objects": []}
     tgs = []
     for name in sorted(set(old_tgs) | set(new_tgs)):
-        tgd = task_group_diff(old_tgs.get(name), new_tgs.get(name))
+        tgd = task_group_diff(old_tgs.get(name), new_tgs.get(name),
+                              contextual)
         if tgd:
             tgs.append(tgd)
     typ = top["Type"]
@@ -171,7 +201,7 @@ def job_diff(old, new) -> dict:
         typ = DIFF_ADDED
     elif not napi:
         typ = DIFF_DELETED
-    elif typ == DIFF_NONE and tgs:
+    elif typ == DIFF_NONE and any(t["Type"] != DIFF_NONE for t in tgs):
         typ = DIFF_EDITED
     return {"Type": typ, "ID": job_id, "Fields": top["Fields"],
             "Objects": top["Objects"], "TaskGroups": tgs}

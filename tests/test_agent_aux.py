@@ -127,3 +127,28 @@ def test_join_force_leave_cluster():
     finally:
         s2.shutdown()
         s1.shutdown()
+
+
+def test_operator_debug_bundle(agent, capsys, tmp_path, monkeypatch):
+    """`operator debug` captures a tar.gz bundle of cluster + agent state
+    (ref command/operator_debug.go)."""
+    import tarfile
+
+    from nomad_tpu import cli
+    monkeypatch.setenv("NOMAD_ADDR", agent.http_addr)
+    out_path = str(tmp_path / "bundle.tar.gz")
+    cli.main(["operator", "debug", "-duration", "0.6", "-interval", "0.3",
+              "-output", out_path])
+    out = capsys.readouterr().out
+    assert "Debug capture complete" in out
+    with tarfile.open(out_path) as tar:
+        names = tar.getnames()
+        base = names[0].split("/")[0]
+        for want in ("agent-self.json", "members.json", "nodes.json",
+                     "jobs.json", "index.json", "pprof-goroutine.txt",
+                     "metrics/metrics-000.json", "metrics/metrics-001.json"):
+            assert f"{base}/{want}" in names, f"missing {want}"
+        manifest = json.load(tar.extractfile(f"{base}/index.json"))
+        assert manifest["Errors"] == {}
+        members = json.load(tar.extractfile(f"{base}/members.json"))
+        assert members["Members"]

@@ -255,6 +255,29 @@ def test_job_diff_new_job():
     assert d2["Type"] == "Deleted"
 
 
+def test_job_diff_contextual_includes_unchanged():
+    """ref structs/diff.go contextual=true: unchanged fields ride along
+    as Type None so `plan -verbose` can show the full object."""
+    d = job_diff(_mk(count=2), _mk(count=5), contextual=True)
+    assert d["Type"] == "Edited"
+    tg = d["TaskGroups"][0]
+    by_name = {f["Name"]: f for f in tg["Fields"]}
+    assert by_name["Count"]["Type"] == "Edited"
+    # the unchanged group name appears as context
+    assert by_name["Name"]["Type"] == "None"
+    assert by_name["Name"]["Old"] == by_name["Name"]["New"] == "g"
+    # unchanged tasks appear with Type None too
+    assert tg["Tasks"] and tg["Tasks"][0]["Type"] == "None"
+
+
+def test_job_diff_contextual_unchanged_job_stays_none():
+    d = job_diff(_mk(), _mk(), contextual=True)
+    assert d["Type"] == "None"
+    # groups present as context but not marked changed
+    assert d["TaskGroups"] and all(
+        g["Type"] == "None" for g in d["TaskGroups"])
+
+
 def test_distinct_property_sugar():
     src = '''
     job "x" {

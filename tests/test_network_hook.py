@@ -1,0 +1,188 @@
+"""Bridge-mode alloc networking (ref client/allocrunner/network_hook.go +
+networking_bridge_linux.go): netns lifecycle, IP leasing, port DNAT,
+host-mode degradation — all against a recording fake commander."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.network_hook import (
+    BRIDGE_NAME, BridgeNetworkManager, Commander, NetworkHook,
+)
+from nomad_tpu.structs import (
+    AllocatedResources, AllocatedSharedResources, Allocation,
+    NetworkResource,
+)
+
+
+class FakeCommander(Commander):
+    def __init__(self, fail_on=()):
+        self.calls: list[tuple] = []
+        self.links = set()
+        self.netns = set()
+        self.fail_on = set(fail_on)
+
+    def available(self) -> bool:
+        return True
+
+    def run(self, *argv):
+        self.calls.append(argv)
+        joined = " ".join(argv)
+        for frag in self.fail_on:
+            if frag in joined:
+                raise RuntimeError(f"forced failure: {frag}")
+        if argv[:3] == ("ip", "link", "show"):
+            if argv[3] not in self.links:
+                raise RuntimeError("not found")
+        elif argv[:3] == ("ip", "link", "add"):
+            self.links.add(argv[3])
+        elif argv[:3] == ("ip", "netns", "add"):
+            self.netns.add(argv[3])
+        elif argv[:3] == ("ip", "netns", "delete"):
+            if argv[3] not in self.netns:
+                raise RuntimeError("no such netns")
+            self.netns.discard(argv[3])
+        elif argv[0] == "iptables" and argv[1] == "-N":
+            pass
+        return ""
+
+
+def _bridge_alloc(ports=None):
+    alloc = Allocation(id="11112222-aaaa", job=mock.job(), job_id="j",
+                       task_group="web")
+    alloc.allocated_resources = AllocatedResources(
+        shared=AllocatedSharedResources(ports=ports or []))
+    return alloc
+
+
+def _bridge_tg():
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.networks = [NetworkResource(mode="bridge")]
+    return tg
+
+
+def test_setup_creates_bridge_netns_and_dnat():
+    cmd = FakeCommander()
+    mgr = BridgeNetworkManager(commander=cmd)
+    ports = [{"label": "http", "value": 22000, "to": 8080}]
+    st = mgr.setup("11112222-aaaa", ports)
+    assert st["netns"] == "nomad-11112222"
+    assert st["ip"].startswith("172.26.")
+    assert st["ip"] != st["gateway"]
+    assert BRIDGE_NAME in cmd.links
+    assert "nomad-11112222" in cmd.netns
+    # one DNAT rule mapping host 22000 -> ns 8080
+    dnat = [c for c in cmd.calls if "DNAT" in c and "-A" in c]
+    assert len(dnat) == 1
+    assert "22000" in dnat[0] and f"{st['ip']}:8080" in dnat[0]
+
+
+def test_teardown_removes_netns_and_rules():
+    cmd = FakeCommander()
+    mgr = BridgeNetworkManager(commander=cmd)
+    ports = [{"label": "http", "value": 22000, "to": 8080}]
+    mgr.setup("11112222-aaaa", ports)
+    mgr.teardown("11112222-aaaa", ports)
+    assert "nomad-11112222" not in cmd.netns
+    deletes = [c for c in cmd.calls if "DNAT" in c and "-D" in c]
+    assert len(deletes) == 1
+    # idempotent: second teardown is a no-op, not an error
+    mgr.teardown("11112222-aaaa", ports)
+
+
+def test_fresh_host_inserts_forward_rule():
+    """On a host without the NOMAD-ADMIN jump, `iptables -C` fails and
+    the manager must insert the rule, not error out (ref
+    ensureForwardingRules)."""
+    class FreshHost(FakeCommander):
+        def run(self, *argv):
+            if argv[:2] == ("iptables", "-C") and \
+                    ("iptables", "-I", "FORWARD", "-j",
+                     "NOMAD-ADMIN") not in self.calls:
+                self.calls.append(argv)
+                raise RuntimeError("no such rule")
+            return super().run(*argv)
+
+    cmd = FreshHost()
+    mgr = BridgeNetworkManager(commander=cmd)
+    st = mgr.setup("11112222-aaaa", [])
+    assert st["ip"]
+    assert ("iptables", "-I", "FORWARD", "-j", "NOMAD-ADMIN") in cmd.calls
+
+
+def test_ip_lease_recycling():
+    """Freed leases are reused so a long-lived client never exhausts the
+    bridge subnet."""
+    mgr = BridgeNetworkManager(commander=FakeCommander())
+    a = mgr.setup("aaaa0000-1", [])
+    mgr.teardown("aaaa0000-1", [])
+    b = mgr.setup("bbbb0000-2", [])
+    assert b["ip"] == a["ip"]
+
+
+def test_ip_leases_are_unique_and_stable():
+    mgr = BridgeNetworkManager(commander=FakeCommander())
+    a = mgr.setup("aaaa0000-1", [])
+    b = mgr.setup("bbbb0000-2", [])
+    assert a["ip"] != b["ip"]
+    # re-setup of the same alloc reuses its lease
+    mgr.teardown("aaaa0000-1", [])
+    c = mgr.setup("cccc0000-3", [])
+    assert c["ip"] not in (b["ip"],)
+
+
+def test_setup_failure_rolls_back():
+    cmd = FakeCommander(fail_on=("route add default",))
+    mgr = BridgeNetworkManager(commander=cmd)
+    with pytest.raises(RuntimeError):
+        mgr.setup("11112222-aaaa", [])
+    assert "nomad-11112222" not in cmd.netns       # rolled back
+
+
+def test_hook_noop_for_host_mode():
+    cmd = FakeCommander()
+    hook = NetworkHook(manager=BridgeNetworkManager(commander=cmd))
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.networks = []
+    assert hook.prerun(_bridge_alloc(), tg) is None
+    assert cmd.calls == []
+
+
+def test_hook_bridge_mode_lifecycle():
+    cmd = FakeCommander()
+    hook = NetworkHook(manager=BridgeNetworkManager(commander=cmd))
+    alloc = _bridge_alloc(ports=[{"label": "http", "value": 25000,
+                                  "to": 9090}])
+    tg = _bridge_tg()
+    st = hook.prerun(alloc, tg)
+    assert st and st["netns"] == "nomad-11112222"
+    assert alloc.id in hook.status
+    hook.postrun(alloc, tg)
+    assert alloc.id not in hook.status
+    assert "nomad-11112222" not in cmd.netns
+
+
+def test_hook_degrades_without_tooling():
+    class Unavailable(FakeCommander):
+        def available(self):
+            return False
+
+    msgs = []
+    hook = NetworkHook(
+        manager=BridgeNetworkManager(commander=Unavailable()),
+        logger=msgs.append)
+    hook.manager.cmd = Unavailable()
+    st = hook.prerun(_bridge_alloc(), _bridge_tg())
+    assert st is None
+    assert any("host networking" in m for m in msgs)
+
+
+def test_taskenv_exports_network_status():
+    from nomad_tpu.client.taskenv import build_task_env
+    alloc = _bridge_alloc()
+    task = alloc.job.task_groups[0].tasks[0]
+    env = build_task_env(alloc, task, mock.node(), "/t", "/a", "/s",
+                         network_status={"ip": "172.26.64.5",
+                                         "netns": "nomad-11112222"})
+    assert env["NOMAD_ALLOC_IP"] == "172.26.64.5"
+    assert env["NOMAD_ALLOC_NETNS"] == "nomad-11112222"

@@ -148,3 +148,139 @@ def test_remote_client_agent_runs_job(tmp_path):
             client_agent.shutdown()
     finally:
         server_agent.shutdown()
+
+
+# --------------------------------------------------------------------- TLS
+
+class TestTLS:
+    """Mutual-TLS RPC transport (ref helper/tlsutil/config.go +
+    nomad/rpc.go TLS listener)."""
+
+    @pytest.fixture()
+    def tls_dir(self, tmp_path):
+        from nomad_tpu.tlsutil import TLSConfig, generate_ca, generate_cert
+        d = str(tmp_path)
+        ca, cakey = generate_ca(d)
+        cert, key = generate_cert(d, ca, cakey, "server.global.nomad")
+        return TLSConfig(enable_rpc=True, ca_file=ca, cert_file=cert,
+                         key_file=key, region="global"), d, (ca, cakey)
+
+    def test_tls_roundtrip(self, tls_dir):
+        tls, _, _ = tls_dir
+        srv = RpcServer(port=0, tls=tls)
+        srv.register("Echo.Echo", lambda x: {"got": x})
+        srv.start()
+        try:
+            with RpcClient([srv.addr], tls=tls) as cli:
+                assert cli.call("Echo.Echo", 42) == {"got": 42}
+        finally:
+            srv.shutdown()
+
+    def test_plaintext_client_rejected(self, tls_dir):
+        tls, _, _ = tls_dir
+        srv = RpcServer(port=0, tls=tls)
+        srv.register("Echo.Echo", lambda x: x)
+        srv.start()
+        try:
+            plain = RpcClient([srv.addr], timeout=1.0)
+            with pytest.raises((ConnectionError, OSError, TimeoutError,
+                                RpcError)):
+                plain.call("Echo.Echo", 1)
+            plain.close()
+        finally:
+            srv.shutdown()
+
+    def test_client_without_cert_rejected(self, tls_dir):
+        # mutual TLS: the server requires a CA-signed client cert
+        import ssl
+        tls, d, _ = tls_dir
+        srv = RpcServer(port=0, tls=tls)
+        srv.register("Echo.Echo", lambda x: x)
+        srv.start()
+        try:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            host, _, port = srv.addr.rpartition(":")
+            raw = socket.create_connection((host, int(port)), timeout=2.0)
+            wrapped = ctx.wrap_socket(raw)
+            with pytest.raises((ConnectionError, OSError, ssl.SSLError)):
+                send_msg(wrapped, {"seq": 1, "method": "Echo.Echo",
+                                   "args": (1,)}, DEFAULT_KEY)
+                recv_msg(wrapped, DEFAULT_KEY)
+            wrapped.close()
+        finally:
+            srv.shutdown()
+
+    def test_untrusted_ca_rejected(self, tls_dir, tmp_path):
+        from nomad_tpu.tlsutil import TLSConfig, generate_ca, generate_cert
+        tls, _, _ = tls_dir
+        srv = RpcServer(port=0, tls=tls)
+        srv.register("Echo.Echo", lambda x: x)
+        srv.start()
+        # a client with certs from a DIFFERENT CA must be refused
+        d2 = str(tmp_path / "other")
+        ca2, cakey2 = generate_ca(d2, name="rogue-ca")
+        cert2, key2 = generate_cert(d2, ca2, cakey2, "server.global.nomad")
+        rogue = TLSConfig(enable_rpc=True, ca_file=ca2, cert_file=cert2,
+                          key_file=key2, region="global")
+        try:
+            cli = RpcClient([srv.addr], tls=rogue, timeout=1.0)
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                cli.call("Echo.Echo", 1)
+            cli.close()
+        finally:
+            srv.shutdown()
+
+    def test_verify_server_hostname(self, tls_dir):
+        from nomad_tpu.tlsutil import TLSConfig, generate_cert
+        tls, d, (ca, cakey) = tls_dir
+        # server presents a cert for the WRONG region name
+        bad_cert, bad_key = generate_cert(d, ca, cakey,
+                                          "server.other.nomad")
+        bad_tls = TLSConfig(enable_rpc=True, ca_file=ca,
+                            cert_file=bad_cert, key_file=bad_key,
+                            region="other")
+        srv = RpcServer(port=0, tls=bad_tls)
+        srv.register("Echo.Echo", lambda x: x)
+        srv.start()
+        try:
+            strict = TLSConfig(enable_rpc=True, ca_file=ca,
+                               cert_file=tls.cert_file,
+                               key_file=tls.key_file,
+                               verify_server_hostname=True,
+                               region="global")
+            cli = RpcClient([srv.addr], tls=strict, timeout=1.0)
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                cli.call("Echo.Echo", 1)
+            cli.close()
+            # without hostname verification the same chain is accepted
+            lax = TLSConfig(enable_rpc=True, ca_file=ca,
+                            cert_file=tls.cert_file, key_file=tls.key_file,
+                            region="global")
+            with RpcClient([srv.addr], tls=lax) as cli2:
+                assert cli2.call("Echo.Echo", 7) == 7
+        finally:
+            srv.shutdown()
+
+    def test_agent_config_tls_stanza(self, tls_dir, tmp_path):
+        from nomad_tpu.agent.agent import AgentConfig
+        from nomad_tpu.agent.config_file import (apply_to_agent_config,
+                                                 parse_config_file)
+        tls, d, _ = tls_dir
+        p = tmp_path / "agent.hcl"
+        p.write_text(f'''
+        tls {{
+          rpc = true
+          ca_file = "{tls.ca_file}"
+          cert_file = "{tls.cert_file}"
+          key_file = "{tls.key_file}"
+          verify_server_hostname = true
+        }}
+        ''')
+        cfg = apply_to_agent_config(AgentConfig(),
+                                    parse_config_file(str(p)))
+        assert cfg.tls_enabled
+        tc = cfg.tls_config()
+        assert tc is not None and tc.verify_server_hostname
+        assert tc.server_name == "server.global.nomad"

@@ -769,12 +769,17 @@ def test_job_plan_annotates_diff_with_consequences():
         assert ups["create/destroy update"] == 2     # existing pair rolls
         assert ups["create"] == 3                    # count 2 -> 5
 
-        # scale down annotates forces destroy
+        # scale down annotates forces destroy; the UNCHANGED task rides
+        # along as contextual Type None and must NOT be stamped with a
+        # forces-update annotation (ref annotate.go skips DiffTypeNone)
         down = job.copy()
         down.task_groups[0].count = 1
         out2 = s.job_plan(down)
         tg2 = out2["Diff"]["TaskGroups"][0]
         cf2 = next(f for f in tg2["Fields"] if f["Name"] == "Count")
         assert "forces destroy" in cf2.get("Annotations", [])
+        for td in tg2["Tasks"]:
+            if td["Type"] == "None":
+                assert not td.get("Annotations")
     finally:
         s.shutdown()

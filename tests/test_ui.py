@@ -47,6 +47,10 @@ def test_ui_references_all_views(agent):
     for view in ("jobs", "deployments", "nodes", "topology", "services",
                  "events", "alloc", "tailLogs", "runExec", "depAction"):
         assert view in body, f"UI missing view/function {view}"
+    # topology utilization meters + ACL token plumbing
+    for frag in ("NodeResources", "X-Nomad-Token", "tokenbox",
+                 "class=\"meter\""):
+        assert frag in body, f"UI missing {frag}"
 
 
 # ------------------------------------------- live-cluster UI data contract
