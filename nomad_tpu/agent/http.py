@@ -219,7 +219,14 @@ class HTTPAPI:
                 summ = s.state.job_summary(ns, job_id)
                 if summ is None:
                     raise HTTPError(404, f"job {job_id!r} not found")
-                return to_api(summ), s.state.table_index("jobs")
+                # blocking index must move on every path that rewrites
+                # summaries: job registration ("jobs"), per-alloc status
+                # maintenance (rides "allocs"), and the
+                # reconcile-summaries repair path ("job_summary")
+                return to_api(summ), max(
+                    s.state.table_index("jobs"),
+                    s.state.table_index("allocs"),
+                    s.state.table_index("job_summary"))
             elif rest == ["versions"]:
                 return [to_api(j)
                         for j in s.state.job_versions_by_id(ns, job_id)], \
@@ -249,6 +256,19 @@ class HTTPAPI:
                     return s.job_dispatch(ns, job_id, payload, meta), None
                 except ValueError as e:
                     raise HTTPError(400, str(e))
+            elif rest == ["evaluate"] and method in ("PUT", "POST"):
+                # ref job_endpoint.go Evaluate / PUT /v1/job/<id>/evaluate
+                opts = body.get("EvalOptions", {}) or {}
+                try:
+                    out = s.job_evaluate(
+                        ns, job_id,
+                        force_reschedule=bool(opts.get("ForceReschedule")))
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+                return {"EvalID": out["eval_id"],
+                        "EvalCreateIndex": out["eval_create_index"],
+                        "JobModifyIndex": out["job_modify_index"],
+                        "Index": out["index"]}, None
             elif rest == ["periodic", "force"] and method in ("PUT", "POST"):
                 job = s.state.job_by_id(ns, job_id)
                 if job is None or not job.is_periodic():
@@ -661,15 +681,21 @@ class HTTPAPI:
             } for sv in cfg["Servers"]]}, None
         if parts == ["agent", "join"] and method in ("PUT", "POST"):
             require(acl.allow_agent_write())
-            address = query.get("address", "")
-            name = query.get("name", address)
-            if not address:
+            addresses = query.get("address", [])
+            if isinstance(addresses, str):     # direct callers pass one
+                addresses = [addresses] if addresses else []
+            if not addresses:
                 raise HTTPError(400, "missing address")
-            try:
-                s.operator_raft_add_peer(name, address)
-                return {"num_joined": 1, "error": ""}, None
-            except ValueError as e:
-                return {"num_joined": 0, "error": str(e)}, None
+            joined = 0
+            errs = []
+            for address in addresses:
+                try:
+                    s.operator_raft_add_peer(query.get("name", address),
+                                             address)
+                    joined += 1
+                except ValueError as e:
+                    errs.append(str(e))
+            return {"num_joined": joined, "error": "; ".join(errs)}, None
         if parts == ["agent", "force-leave"] and method in ("PUT", "POST"):
             require(acl.allow_agent_write())
             node = query.get("node", "")
@@ -694,6 +720,10 @@ class HTTPAPI:
                 secs = float(query.get("seconds", 1) or 1)
                 return RawResponse(sample_stacks(secs).encode()), None
             raise HTTPError(404, f"unknown profile {which!r}")
+        if parts == ["system", "reconcile", "summaries"] and \
+                method in ("PUT", "POST"):
+            require(acl.is_management())
+            return s.reconcile_summaries(), None
         if parts == ["system", "gc"] and method in ("PUT", "POST"):
             require(acl.is_management())
             s.run_gc()
@@ -978,6 +1008,14 @@ class HTTPAPI:
         }
 
     def _alloc_stub(self, a) -> dict:
+        # AllocatedCPU/AllocatedMemoryMB: rollups the reference's stub
+        # carries via AllocatedResources on the full alloc; the topology
+        # view needs per-node utilization without N full-alloc fetches
+        cpu = mem = 0
+        if a.allocated_resources is not None:
+            for tr in a.allocated_resources.tasks.values():
+                cpu += tr.cpu_shares
+                mem += tr.memory_mb
         return {
             "ID": a.id, "Name": a.name, "Namespace": a.namespace,
             "EvalID": a.eval_id, "NodeID": a.node_id, "NodeName": a.node_name,
@@ -989,6 +1027,7 @@ class HTTPAPI:
             "DeploymentID": a.deployment_id,
             "FollowupEvalID": a.follow_up_eval_id,
             "TaskStates": to_api(a.task_states),
+            "AllocatedCPU": cpu, "AllocatedMemoryMB": mem,
             "CreateIndex": a.create_index, "ModifyIndex": a.modify_index,
             "CreateTime": a.create_time_unix, "ModifyTime": a.modify_time_unix,
         }
@@ -1025,7 +1064,9 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             if parsed.path == "/v1/agent/monitor" and method == "GET":
                 self._monitor_stream(parsed)
                 return
-            query = {k: v[0] for k, v in
+            # single-value collapse, except repeatable params (the
+            # reference accepts ?address=...&address=... on agent/join)
+            query = {k: (v if k == "address" else v[0]) for k, v in
                      urllib.parse.parse_qs(parsed.query).items()}
             body = None
             length = int(self.headers.get("Content-Length", 0) or 0)

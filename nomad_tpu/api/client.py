@@ -214,6 +214,13 @@ class Jobs(_Handle):
             f"/v1/job/{urllib.parse.quote(job_id)}/periodic/force")
         return out
 
+    def evaluate(self, job_id: str, force_reschedule: bool = False):
+        """ref api/jobs.go EvaluateWithOpts"""
+        out, _ = self.c.put(
+            f"/v1/job/{urllib.parse.quote(job_id)}/evaluate",
+            {"EvalOptions": {"ForceReschedule": force_reschedule}})
+        return out
+
     def parse(self, hcl: str, canonicalize: bool = True):
         out, _ = self.c.put("/v1/jobs/parse",
                             {"JobHCL": hcl, "Canonicalize": canonicalize})
@@ -584,6 +591,11 @@ class Services(_Handle):
 class System(_Handle):
     def gc(self):
         out, _ = self.c.put("/v1/system/gc")
+        return out
+
+    def reconcile_summaries(self):
+        """ref api/system.go ReconcileSummaries"""
+        out, _ = self.c.put("/v1/system/reconcile/summaries")
         return out
 
 

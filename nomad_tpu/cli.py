@@ -328,6 +328,28 @@ def cmd_job_history(args) -> None:
            ["Version", "Stable", "Status"])
 
 
+def cmd_job_eval(args) -> None:
+    """ref command/job_eval.go: force a fresh evaluation of the job."""
+    resp = api("PUT", f"/v1/job/{args.job_id}/evaluate",
+               {"EvalOptions":
+                {"ForceReschedule": bool(args.force_reschedule)}})
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+
+
+def cmd_job_periodic_force(args) -> None:
+    """ref command/job_periodic_force.go"""
+    resp = api("PUT", f"/v1/job/{args.job_id}/periodic/force", {})
+    print(f"==> Dispatched periodic child {resp['dispatched_job_id']}")
+
+
+def cmd_job_deployments(args) -> None:
+    """ref command/job_deployments.go"""
+    ds = api("GET", f"/v1/job/{args.job_id}/deployments")
+    _table([[d["ID"][:8], d["JobVersion"], d["Status"],
+             d["StatusDescription"]] for d in ds],
+           ["ID", "Version", "Status", "Description"])
+
+
 # ---------------------------------------------------------------- volumes
 
 def cmd_volume_status(args) -> None:
@@ -616,6 +638,12 @@ def cmd_deployment(args) -> None:
     elif args.action == "fail":
         api("PUT", f"/v1/deployment/fail/{args.id}", {})
         print("==> Deployment marked failed")
+    elif args.action == "pause":
+        api("PUT", f"/v1/deployment/pause/{args.id}", {"Pause": True})
+        print("==> Deployment paused")
+    elif args.action == "resume":
+        api("PUT", f"/v1/deployment/pause/{args.id}", {"Pause": False})
+        print("==> Deployment resumed")
 
 
 def cmd_operator_scheduler(args) -> None:
@@ -782,6 +810,12 @@ def cmd_system_gc(args) -> None:
     print("==> GC triggered")
 
 
+def cmd_system_reconcile_summaries(args) -> None:
+    """ref command/system_reconcile_summaries.go"""
+    api("PUT", "/v1/system/reconcile/summaries", {})
+    print("==> Job summaries reconciled")
+
+
 def cmd_acl_bootstrap(args) -> None:
     tok = api("POST", "/v1/acl/bootstrap")
     print(f"Accessor ID  = {tok['AccessorID']}")
@@ -865,6 +899,32 @@ def cmd_server_members(args) -> None:
     m = api("GET", "/v1/agent/members")
     _table([[x["Name"], x["Status"]] for x in m["Members"]],
            ["Name", "Status"])
+
+
+def cmd_server_join(args) -> None:
+    """ref command/server_join.go: gossip-join this agent to peers."""
+    q = "&".join(f"address={urllib.parse.quote(a)}" for a in args.address)
+    resp = api("PUT", f"/v1/agent/join?{q}")
+    print(f"==> Joined {resp.get('num_joined', 0)} server(s)")
+
+
+def cmd_scaling_policy(args) -> None:
+    """ref command/scaling_policy_list.go / _info.go"""
+    if args.policy_id:
+        p = api("GET", f"/v1/scaling/policy/{args.policy_id}")
+        print(json.dumps(p, indent=2))
+    else:
+        pols = api("GET", "/v1/scaling/policies")
+        _table([[p["ID"][:8], (p.get("Target") or {}).get("Job", ""),
+                 (p.get("Target") or {}).get("Group", ""),
+                 "true" if p.get("Enabled") else "false"]
+                for p in pols],
+               ["ID", "Job", "Group", "Enabled"])
+
+
+def cmd_version(args) -> None:
+    from . import __version__
+    print(f"nomad-tpu v{__version__}")
 
 
 def cmd_status(args) -> None:
@@ -954,6 +1014,19 @@ def build_parser() -> argparse.ArgumentParser:
     jh = jsub.add_parser("history")
     jh.add_argument("job_id")
     jh.set_defaults(fn=cmd_job_history)
+    je = jsub.add_parser("eval")
+    je.add_argument("job_id")
+    je.add_argument("-force-reschedule", dest="force_reschedule",
+                    action="store_true")
+    je.set_defaults(fn=cmd_job_eval)
+    jpf = jsub.add_parser("periodic")
+    jpfsub = jpf.add_subparsers(dest="periodic_cmd", required=True)
+    jpff = jpfsub.add_parser("force")
+    jpff.add_argument("job_id")
+    jpff.set_defaults(fn=cmd_job_periodic_force)
+    jdps = jsub.add_parser("deployments")
+    jdps.add_argument("job_id")
+    jdps.set_defaults(fn=cmd_job_deployments)
 
     node = sub.add_parser("node")
     nsub = node.add_subparsers(dest="node_cmd", required=True)
@@ -1020,7 +1093,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     dep = sub.add_parser("deployment")
     dep.add_argument("action",
-                     choices=["list", "status", "promote", "fail"])
+                     choices=["list", "status", "promote", "fail",
+                              "pause", "resume"])
     dep.add_argument("id", nargs="?", default="")
     dep.set_defaults(fn=cmd_deployment)
 
@@ -1050,11 +1124,11 @@ def build_parser() -> argparse.ArgumentParser:
     atc.set_defaults(fn=cmd_acl_token_create)
     atl = atoksub.add_parser("list")
     atl.set_defaults(fn=cmd_acl_token_list)
+    ats = atoksub.add_parser("self")
+    ats.set_defaults(fn=cmd_acl_token_self)
     atd = atoksub.add_parser("delete")
     atd.add_argument("accessor_id")
     atd.set_defaults(fn=cmd_acl_token_delete)
-    ats = atoksub.add_parser("self")
-    ats.set_defaults(fn=cmd_acl_token_self)
 
     nsp = sub.add_parser("namespace")
     nssub = nsp.add_subparsers(dest="ns_cmd", required=True)
@@ -1105,6 +1179,10 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = system.add_subparsers(dest="sys_cmd", required=True)
     sgc = ssub.add_parser("gc")
     sgc.set_defaults(fn=cmd_system_gc)
+    srs = ssub.add_parser("reconcile")
+    srssub = srs.add_subparsers(dest="reconcile_cmd", required=True)
+    srss = srssub.add_parser("summaries")
+    srss.set_defaults(fn=cmd_system_reconcile_summaries)
 
     srv = sub.add_parser("server")
     srvsub = srv.add_subparsers(dest="srv_cmd", required=True)
@@ -1113,6 +1191,18 @@ def build_parser() -> argparse.ArgumentParser:
     sfl = srvsub.add_parser("force-leave")
     sfl.add_argument("name")
     sfl.set_defaults(fn=cmd_server_force_leave)
+    sj = srvsub.add_parser("join")
+    sj.add_argument("address", nargs="+")
+    sj.set_defaults(fn=cmd_server_join)
+
+    scal = sub.add_parser("scaling")
+    scalsub = scal.add_subparsers(dest="scaling_cmd", required=True)
+    scp = scalsub.add_parser("policy")
+    scp.add_argument("policy_id", nargs="?", default="")
+    scp.set_defaults(fn=cmd_scaling_policy)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)

@@ -130,8 +130,8 @@ class BridgeNetworkManager:
                 ip = (self._free_ips.pop() if self._free_ips
                       else str(next(self._ip_pool)))
                 self._leases[alloc_id] = ip
-        self.cmd.run("ip", "netns", "add", ns)
         try:
+            self.cmd.run("ip", "netns", "add", ns)
             self.cmd.run("ip", "link", "add", veth_host, "type", "veth",
                          "peer", "name", veth_ns, "netns", ns)
             self.cmd.run("ip", "link", "set", veth_host, "master",
@@ -165,19 +165,37 @@ class BridgeNetworkManager:
             ip = self._leases.pop(alloc_id, None)
             if ip is not None:
                 self._free_ips.append(ip)
-        for p in ports or []:
-            to = int(p.get("to") or p.get("value") or 0)
-            host_port = int(p.get("value") or 0)
-            if host_port <= 0 or to <= 0 or ip is None:
-                continue
+        if ip is not None:
+            for p in ports or []:
+                to = int(p.get("to") or p.get("value") or 0)
+                host_port = int(p.get("value") or 0)
+                if host_port <= 0 or to <= 0:
+                    continue
+                try:
+                    self.cmd.run(
+                        "iptables", "-t", "nat", "-D", "PREROUTING",
+                        "-p", "tcp", "--dport", str(host_port),
+                        "-j", "DNAT", "--to-destination", f"{ip}:{to}",
+                        "-m", "comment", "--comment",
+                        f"nomad-alloc-{alloc_id[:8]}")
+                except RuntimeError:
+                    pass
+        else:
+            # no lease (client restarted since setup): find this alloc's
+            # rules by their comment tag in iptables-save output and
+            # delete each by exact spec
             try:
-                self.cmd.run(
-                    "iptables", "-t", "nat", "-D", "PREROUTING",
-                    "-p", "tcp", "--dport", str(host_port),
-                    "-j", "DNAT", "--to-destination", f"{ip}:{to}",
-                    "-m", "comment", "--comment", f"nomad-alloc-{alloc_id[:8]}")
+                saved = self.cmd.run("iptables-save", "-t", "nat")
             except RuntimeError:
-                pass
+                saved = ""
+            tag = f"nomad-alloc-{alloc_id[:8]}"
+            for line in (saved or "").splitlines():
+                if tag in line and line.startswith("-A "):
+                    spec = line.split()[1:]     # drop the -A
+                    try:
+                        self.cmd.run("iptables", "-t", "nat", "-D", *spec)
+                    except RuntimeError:
+                        pass
         try:
             self.cmd.run("ip", "netns", "delete", ns)
         except RuntimeError:
@@ -223,6 +241,13 @@ class NetworkHook:
 
     def postrun(self, alloc, tg) -> None:
         if alloc.id not in self.status:
-            return
+            # a bridge alloc restored after a client restart has no
+            # in-memory status (restore never re-runs prerun) — still
+            # tear down the namespace so it isn't orphaned on the host;
+            # teardown is idempotent when nothing exists
+            if not (self._bridge_requested(tg)
+                    and self.manager.cmd.available()):
+                return
         self.manager.teardown(alloc.id, self._alloc_ports(alloc))
         self.status.pop(alloc.id, None)
+

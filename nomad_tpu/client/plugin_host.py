@@ -267,6 +267,70 @@ class ExternalDriver(Driver):
         except PluginError:
             return False
 
+    def exec_task(self, task_id, command, tty: bool = False, cwd: str = "",
+                  env=None):
+        """Streaming exec proxied over the plugin socket (ref
+        plugins/drivers/driver.go:577 ExecTaskStreamingRaw): ExecOpen
+        mints a session in the plugin process; stdin/output/resize ride
+        ExecIO/ExecResize round-trips."""
+        out = self._call("ExecOpen", task_id=task_id,
+                         command=list(command or []), tty=bool(tty),
+                         cwd=cwd, env=dict(env or {}))
+        return _RemoteExecSession(self, out["session"])
+
+
+class _RemoteExecSession:
+    """Host-side view of a plugin exec session, shaped like
+    driver.ExecSession so the client HTTP exec endpoints can't tell a
+    plugin task from a built-in one."""
+
+    def __init__(self, drv: ExternalDriver, session_id: str):
+        self._drv = drv
+        self._sid = session_id
+        self._out = bytearray()
+        self._err = bytearray()
+        self.exit_code: Optional[int] = None
+
+    def _io(self, wait: float = 0.0, stdin: bytes = b"",
+            close_stdin: bool = False) -> None:
+        import base64
+        r = self._drv._call(
+            "ExecIO", session=self._sid, wait=wait,
+            stdin=base64.b64encode(stdin).decode() if stdin else "",
+            close_stdin=close_stdin) or {}
+        self._out += base64.b64decode(r.get("stdout") or "")
+        self._err += base64.b64decode(r.get("stderr") or "")
+        if r.get("exited"):
+            self.exit_code = r.get("exit_code")
+
+    def write_stdin(self, data: bytes) -> None:
+        self._io(stdin=data)
+
+    def close_stdin(self) -> None:
+        self._io(close_stdin=True)
+
+    def resize(self, rows: int, cols: int) -> None:
+        self._drv._call("ExecResize", session=self._sid, rows=rows,
+                        cols=cols)
+
+    def read_output(self, wait: float = 0.0) -> dict:
+        # locally buffered chunks (from stdin round-trips) serve first;
+        # otherwise poll the plugin, letting IT do the blocking wait
+        if not self._out and not self._err and self.exit_code is None:
+            self._io(wait=wait)
+        out = {"stdout": bytes(self._out), "stderr": bytes(self._err),
+               "exited": self.exit_code is not None,
+               "exit_code": self.exit_code}
+        self._out.clear()
+        self._err.clear()
+        return out
+
+    def terminate(self) -> None:
+        try:
+            self._drv._call("ExecClose", session=self._sid)
+        except PluginError:
+            pass
+
 
 def discover_plugins(plugin_dir: str, logger=None) -> dict[str, ExternalDriver]:
     """Launch every executable in plugin_dir as a driver plugin (ref

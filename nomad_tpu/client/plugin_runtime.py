@@ -50,6 +50,11 @@ def serve_driver(driver, version: str = "0.1.0") -> None:
     print(f"{HANDSHAKE_PREFIX}{versions}|{sock_path}", flush=True)
 
     stop = threading.Event()
+    # exec sessions are process-global: the host may open a session on
+    # one connection and poll it from another (ref the reference's
+    # per-stream gRPC exec living beside unary task RPCs)
+    sessions: dict[str, object] = {}
+    sessions_lock = threading.Lock()
 
     def handle(conn: socket.socket) -> None:
         from ..api_codec import from_api
@@ -110,6 +115,49 @@ def serve_driver(driver, version: str = "0.1.0") -> None:
                     result = driver.recover_task(TaskHandle(
                         task_id=params["task_id"], driver=driver.name,
                         pid=int(params.get("pid", 0))))
+                elif method == "ExecOpen":
+                    # streaming exec across the plugin boundary (ref
+                    # plugins/drivers/driver.go:577 ExecTaskStreamingRaw)
+                    import uuid
+                    sess = driver.exec_task(
+                        params["task_id"], params.get("command") or [],
+                        tty=bool(params.get("tty")),
+                        cwd=params.get("cwd", ""),
+                        env=params.get("env") or {})
+                    sid = uuid.uuid4().hex
+                    with sessions_lock:
+                        sessions[sid] = sess
+                    result = {"session": sid}
+                elif method in ("ExecIO", "ExecResize", "ExecClose"):
+                    import base64
+                    with sessions_lock:
+                        sess = sessions.get(params["session"])
+                    if sess is None:
+                        raise ValueError("unknown exec session")
+                    if method == "ExecResize":
+                        sess.resize(int(params.get("rows", 24)),
+                                    int(params.get("cols", 80)))
+                        result = {}
+                    elif method == "ExecClose":
+                        with sessions_lock:
+                            sessions.pop(params["session"], None)
+                        sess.terminate()
+                        result = {}
+                    else:
+                        if params.get("stdin"):
+                            sess.write_stdin(
+                                base64.b64decode(params["stdin"]))
+                        if params.get("close_stdin"):
+                            sess.close_stdin()
+                        out = sess.read_output(
+                            float(params.get("wait", 0.0)))
+                        result = {
+                            "stdout": base64.b64encode(
+                                out["stdout"]).decode(),
+                            "stderr": base64.b64encode(
+                                out["stderr"]).decode(),
+                            "exited": out["exited"],
+                            "exit_code": out["exit_code"]}
                 else:
                     raise ValueError(f"unknown plugin method {method!r}")
                 _send_frame(conn, {"id": rid, "result": result})

@@ -53,6 +53,7 @@ NAMESPACE_UPSERT = "NamespaceUpsertRequestType"
 NAMESPACE_DELETE = "NamespaceDeleteRequestType"
 SCALING_EVENT_REGISTER = "ScalingEventRegisterRequestType"
 JOB_STABILITY = "JobStabilityRequestType"
+RECONCILE_SUMMARIES = "ReconcileJobSummariesRequestType"
 CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequestType"
 CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequestType"
 CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
@@ -179,6 +180,8 @@ class NomadFSM:
             s.upsert_scaling_event(index, payload["namespace"],
                                    payload["job_id"], payload["group"],
                                    payload["event"])
+        elif msg_type == RECONCILE_SUMMARIES:
+            s.reconcile_job_summaries(index)
         elif msg_type == JOB_STABILITY:
             s.update_job_stability(index, payload["namespace"],
                                    payload["job_id"], payload["version"],

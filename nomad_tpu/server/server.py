@@ -63,6 +63,7 @@ RPC_ENDPOINTS = {
     "Job.Deregister": ("job_deregister", True),
     "Job.Plan": ("job_plan", True),
     "Job.Dispatch": ("job_dispatch", True),
+    "Job.Evaluate": ("job_evaluate", True),
     "Job.Scale": ("job_scale", True),
     "Job.ScaleStatus": ("job_scale_status", False),
     "Job.Revert": ("job_revert", True),
@@ -730,6 +731,30 @@ class Server:
         self.blocked_evals.untrack(namespace, job_id)
         return {"eval_id": ev.id, "index": index}
 
+    def job_evaluate(self, namespace: str, job_id: str,
+                     force_reschedule: bool = False) -> dict:
+        """Force a new evaluation of an existing job (ref
+        nomad/job_endpoint.go Evaluate): no spec change, just re-run the
+        scheduler — used to kick a job after node capacity changes or to
+        force failed-alloc reschedules."""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        if job.is_periodic():
+            raise ValueError("can't evaluate periodic job")
+        if job.is_parameterized():
+            raise ValueError("can't evaluate parameterized job")
+        ev = Evaluation(
+            namespace=namespace, priority=job.priority, type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER, job_id=job_id,
+            status=EVAL_STATUS_PENDING)
+        if force_reschedule:
+            ev.triggered_by = TRIGGER_RETRY_FAILED_ALLOC
+        # the FSM's on_eval_update hook enqueues it on the leader
+        index = self.raft.apply(EVAL_UPDATE, {"evals": [ev]})
+        return {"eval_id": ev.id, "eval_create_index": index,
+                "job_modify_index": job.modify_index, "index": index}
+
     def job_dispatch(self, namespace: str, job_id: str,
                      payload: bytes = b"", meta: Optional[dict] = None) -> dict:
         """Parameterized job dispatch (ref nomad/job_endpoint.go Dispatch)."""
@@ -1350,6 +1375,13 @@ class Server:
         """Force a full GC pass (the `nomad system gc` analog)."""
         self.core_scheduler.process(Evaluation(
             type=JOB_TYPE_CORE, job_id=CORE_JOB_FORCE_GC))
+
+    def reconcile_summaries(self) -> dict:
+        """Rebuild job summaries from allocs, replicated through Raft
+        (ref nomad/system_endpoint.go ReconcileJobSummaries)."""
+        from .fsm import RECONCILE_SUMMARIES
+        index = self.raft.apply(RECONCILE_SUMMARIES, {})
+        return {"index": index}
 
     def snapshot_save(self) -> bytes:
         return self.raft.snapshot()

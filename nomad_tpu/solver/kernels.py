@@ -176,54 +176,60 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     # proportional to the host's per-placement choice law. Workers
     # decorrelate like the host's samplers while better nodes still
     # lead on average.
-    if order_jitter is not None:
-        # Emulate the host's 2-way sampling (stack.go:71,84) with an
-        # Efraimidis-Spirakis weighted random order: key = log(U)/w_r,
-        # w_r = ((2(n-r)+1))^g over score rank r. g=1 is the exact
-        # best-of-2 single-draw law — the right model when each node is
-        # sampled at most ~once per eval (n >> count), which is what
-        # decorrelates concurrent workers planning from one snapshot.
-        # As count/n grows the host re-samples every node many times and
-        # its outcome concentrates on the true best nodes, so the placer
-        # raises g (sharper selection) with the expected samples-per-node
-        # m = 2*count/n. Depths stay density-optimal either way.
-        # Depth follows the same sampling law as the order: a host
-        # worker can stack a node only as often as it resurfaces in the
-        # shuffled iterator's windows — jitter_samples = width*count/n
-        # times per eval (width 2 for batch power-of-two-choices,
-        # ceil(log2(n)) for the service limit, stack.go:71-91) — so
-        # depth is capped at ceil(samples)+1. Without the cap,
-        # concurrent workers deep-fill their (few) E-S-chosen nodes to
-        # capacity and ANY overlap between two workers' plans
-        # overcommits and is rejected by the serial applier; host
-        # workers overlap just as often but lightly enough to co-fit.
-        # The RANKING deliberately stays on the UNCAPPED density: ranking
-        # by capped (shallow) density makes binpack favor the smallest
-        # nodes — the same few nodes for every concurrent worker — and
-        # measured plan rejections nearly double as the workers pile onto
-        # exactly the least-headroom machines. The uncapped rank keeps
-        # the preference field flatter, and the E-S draw then spreads
-        # workers across it. The leftover pass below still deepens to
-        # true capacity when the ask exceeds the capped coverage, so
-        # placement count is unaffected.
-        js = jnp.asarray(jitter_samples, jnp.float32)
-        jcap = jnp.where(js > 0.0, jnp.ceil(js) + 1.0,
-                         jnp.float32(2 ** 30)).astype(jnp.int32)
-        k_star = jnp.minimum(k_star, jnp.maximum(jcap, 1))
-        fin = jnp.isfinite(d_star)
-        rank = jnp.argsort(jnp.argsort(-d_star))        # 0 = best density
-        n_fin = jnp.maximum(jnp.sum(fin), 1)
-        # E-S order: max u^(1/w), w = (2(n-r)+1)^g. Computed in LOG space
-        # — w itself overflows float32 beyond ~32k nodes at g=8, which
-        # would collapse every key to -0.0 and silently de-randomize the
-        # order: argmax u^(1/w) == argmin log(-log u) - g*log(2(n-r)+1).
-        base_w = 2.0 * (n_fin - rank).astype(jnp.float32) + 1.0
-        u = jnp.clip(order_jitter, 1e-9, 1.0 - 1e-9)
-        key = jnp.log(-jnp.log(u)) - jitter_scale * jnp.log(base_w)
-        key = jnp.where(fin, key, jnp.inf)
-        order = jnp.argsort(key)                        # smaller = earlier
-    else:
-        order = jnp.argsort(-d_star)
+    # Emulate the host's 2-way sampling (stack.go:71,84) with an
+    # Efraimidis-Spirakis weighted random order: key = log(U)/w_r,
+    # w_r = ((2(n-r)+1))^g over score rank r. g=1 is the exact
+    # best-of-2 single-draw law — the right model when each node is
+    # sampled at most ~once per eval (n >> count), which is what
+    # decorrelates concurrent workers planning from one snapshot.
+    # As count/n grows the host re-samples every node many times and
+    # its outcome concentrates on the true best nodes, so the placer
+    # raises g (sharper selection) with the expected samples-per-node
+    # m = width*count/n, and above m>3 disables the jitter entirely.
+    # Depth follows the same sampling law as the order: a host worker
+    # can stack a node only as often as it resurfaces in the shuffled
+    # iterator's windows — jitter_samples = width*count/n times per
+    # eval (width 2 for batch power-of-two-choices, ceil(log2(n)) for
+    # the service limit, stack.go:71-91) — so depth is capped at
+    # ceil(samples)+1. Without the cap, concurrent workers deep-fill
+    # their (few) E-S-chosen nodes to capacity and ANY overlap between
+    # two workers' plans overcommits and is rejected by the serial
+    # applier; host workers overlap just as often but lightly enough to
+    # co-fit. The RANKING deliberately stays on the UNCAPPED density:
+    # ranking by capped (shallow) density makes binpack favor the
+    # smallest nodes — the same few nodes for every concurrent worker —
+    # and measured plan rejections nearly double as the workers pile
+    # onto exactly the least-headroom machines. The leftover pass below
+    # still deepens to true capacity when the ask exceeds the capped
+    # coverage, so placement count is unaffected.
+    #
+    # jitter_samples <= 0 selects the DETERMINISTIC regime (affinities,
+    # or m>3 where the host's preferential attachment is effectively
+    # deterministic): gumbel noise off, depth uncapped. The selection is
+    # a traced `where`, NOT a python branch, so one compiled artifact
+    # covers both regimes — a python branch here made the 50k headline
+    # run recompile inside the measured region when the warmup job's
+    # small m landed in the other branch.
+    js = jnp.asarray(jitter_samples, jnp.float32)
+    det = js <= 0.0
+    jcap = jnp.where(det, jnp.float32(2 ** 30),
+                     jnp.ceil(js) + 1.0).astype(jnp.int32)
+    k_star = jnp.minimum(k_star, jnp.maximum(jcap, 1))
+    fin = jnp.isfinite(d_star)
+    rank = jnp.argsort(jnp.argsort(-d_star))        # 0 = best density
+    n_fin = jnp.maximum(jnp.sum(fin), 1)
+    # E-S order: max u^(1/w), w = (2(n-r)+1)^g. Computed in LOG space
+    # — w itself overflows float32 beyond ~32k nodes at g=8, which
+    # would collapse every key to -0.0 and silently de-randomize the
+    # order: argmax u^(1/w) == argmin log(-log u) - g*log(2(n-r)+1).
+    base_w = 2.0 * (n_fin - rank).astype(jnp.float32) + 1.0
+    if order_jitter is None:
+        order_jitter = jnp.full((n,), 0.5, jnp.float32)
+    u = jnp.clip(order_jitter, 1e-9, 1.0 - 1e-9)
+    gumbel = jnp.where(det, 0.0, jnp.log(-jnp.log(u)))
+    key = gumbel - jitter_scale * jnp.log(base_w)
+    key = jnp.where(fin, key, jnp.inf)
+    order = jnp.argsort(key)                        # smaller = earlier
     ks = k_star[order]
     prior = jnp.cumsum(ks) - ks
     take = jnp.clip(count - prior, 0, ks)

@@ -275,14 +275,16 @@ class SolverPlacer:
             width = 2.0 if self.sched.batch else \
                 max(2.0, float(np.ceil(np.log2(max(n_feas, 2)))))
             m = width * count / n_feas
+            # the jitter array is ALWAYS passed — the kernel gates it on
+            # jitter_samples<=0 with a traced where, so the deterministic
+            # and jittered regimes share one compiled artifact
+            rng = np.random.default_rng(random.getrandbits(64))
+            jitter = jnp.asarray(
+                rng.random(gt.cap.shape[0], dtype=np.float32))
             if affinities or m > 3.0:
-                jitter = None
                 bias_g = 1.0
                 m = 0.0
             else:
-                rng = np.random.default_rng(random.getrandbits(64))
-                jitter = jnp.asarray(
-                    rng.random(gt.cap.shape[0], dtype=np.float32))
                 bias_g = float(np.clip((width - 1.0) + max(m - 1.0, 0.0),
                                        1.0, 8.0))
             placed = fill_depth(

@@ -814,6 +814,43 @@ class StateStore:
         summ.modify_index = index
         self.job_summaries[key] = summ
 
+    def reconcile_job_summaries(self, index: int) -> None:
+        """Rebuild every job summary from the live alloc set (ref
+        state_store.go ReconcileJobSummaries, driven by
+        PUT /v1/system/reconcile/summaries) — the repair path for
+        summaries that drifted through bugs or partial restores."""
+        with self._lock:
+            idx = self._bump("job_summary", index)
+            rebuilt: dict[tuple, JobSummary] = {}
+            for (ns, job_id), job in self.jobs.items():
+                summ = JobSummary(job_id=job_id, namespace=ns,
+                                  create_index=idx, modify_index=idx)
+                for tg in job.task_groups:
+                    summ.summary.setdefault(tg.name, TaskGroupSummary())
+                rebuilt[(ns, job_id)] = summ
+            for alloc in self.allocs.values():
+                summ = rebuilt.get((alloc.namespace, alloc.job_id))
+                if summ is None:
+                    continue
+                tg = summ.summary.setdefault(alloc.task_group,
+                                             TaskGroupSummary())
+                f = self._SUMMARY_FIELDS.get(alloc.client_status)
+                if f:
+                    setattr(tg, f, getattr(tg, f) + 1)
+            # queued counts are eval-owned state, not derivable from
+            # allocs — carry them over from the old summaries
+            for key, summ in rebuilt.items():
+                old = self.job_summaries.get(key)
+                if old is None:
+                    continue
+                summ.create_index = old.create_index
+                for name, tgs in summ.summary.items():
+                    old_tg = old.summary.get(name)
+                    if old_tg is not None:
+                        tgs.queued = old_tg.queued
+            self.job_summaries = rebuilt
+            self._commit()
+
     def update_allocs_from_client(self, index: int,
                                   allocs: list[Allocation]) -> None:
         """Client status updates: merge client-owned fields onto stored allocs

@@ -241,3 +241,49 @@ job "hello-hcl" {
     assert wait_until(
         lambda: agent.server.state.job_by_id("default", "hello-hcl"))
     cli.main(["job", "stop", "-purge", "hello-hcl"])
+
+
+def test_http_job_evaluate(agent):
+    """PUT /v1/job/<id>/evaluate forces a fresh eval without a spec
+    change (ref nomad/job_endpoint.go Evaluate)."""
+    spec, job_id = _spec(run_for=0.2)
+    call(agent, "PUT", "/v1/jobs", spec)
+    assert wait_until(
+        lambda: agent.server.state.job_by_id("default", job_id))
+    before = {e.id for e in
+              agent.server.state.evals_by_job("default", job_id)}
+    resp, _ = call(agent, "PUT", f"/v1/job/{job_id}/evaluate",
+                   {"EvalOptions": {}})
+    assert resp["EvalID"] and resp["EvalID"] not in before
+    assert wait_until(lambda: any(
+        e.id == resp["EvalID"]
+        for e in agent.server.state.evals_by_job("default", job_id)))
+    # periodic jobs are rejected (ref Evaluate: "can't evaluate periodic")
+    pjob = mock.periodic_job() if hasattr(mock, "periodic_job") else None
+    if pjob is not None:
+        call(agent, "PUT", "/v1/jobs", {"Job": to_api(pjob)})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            call(agent, "PUT", f"/v1/job/{pjob.id}/evaluate", {})
+        assert exc.value.code == 400
+    call(agent, "DELETE", f"/v1/job/{job_id}?purge=true")
+
+
+def test_cli_new_commands(agent, capsys, monkeypatch):
+    """job eval / job deployments / scaling policy / server members /
+    version against a live agent."""
+    from nomad_tpu import cli
+    monkeypatch.setenv("NOMAD_ADDR", agent.http_addr)
+    spec, job_id = _spec(run_for=0.2)
+    call(agent, "PUT", "/v1/jobs", spec)
+    assert wait_until(
+        lambda: agent.server.state.job_by_id("default", job_id))
+    cli.main(["job", "eval", job_id])
+    out = capsys.readouterr().out
+    assert "Evaluation" in out
+    cli.main(["job", "deployments", job_id])
+    capsys.readouterr()
+    cli.main(["scaling", "policy"])
+    capsys.readouterr()
+    cli.main(["version"])
+    assert "nomad-tpu v" in capsys.readouterr().out
+    call(agent, "DELETE", f"/v1/job/{job_id}?purge=true")

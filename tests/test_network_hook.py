@@ -186,3 +186,47 @@ def test_taskenv_exports_network_status():
                                          "netns": "nomad-11112222"})
     assert env["NOMAD_ALLOC_IP"] == "172.26.64.5"
     assert env["NOMAD_ALLOC_NETNS"] == "nomad-11112222"
+
+
+def test_lease_not_leaked_on_netns_add_failure():
+    cmd = FakeCommander(fail_on=("netns add",))
+    mgr = BridgeNetworkManager(commander=cmd)
+    with pytest.raises(RuntimeError):
+        mgr.setup("11112222-aaaa", [])
+    # the lease was recycled by the rollback teardown
+    assert "11112222-aaaa" not in mgr._leases
+    ok = BridgeNetworkManager(commander=FakeCommander())
+    # fresh manager sanity: pool not consumed by the failure path
+    assert ok.setup("bbbb0000-1", [])["ip"].endswith(".2")
+
+
+def test_postrun_after_restart_cleans_by_comment_tag():
+    """A client restart loses the in-memory lease; teardown must still
+    remove the netns and find DNAT rules via their comment tag."""
+    cmd = FakeCommander()
+    mgr = BridgeNetworkManager(commander=cmd)
+    ports = [{"label": "http", "value": 23000, "to": 8080}]
+    st = mgr.setup("11112222-aaaa", ports)
+    # simulate restart: leases gone, netns survives in the kernel
+    mgr._leases.clear()
+
+    save_line = (f"-A PREROUTING -p tcp -m tcp --dport 23000 "
+                 f"-m comment --comment nomad-alloc-11112222 "
+                 f"-j DNAT --to-destination {st['ip']}:8080")
+
+    class SaveAware(FakeCommander):
+        def run(self, *argv):
+            if argv[0] == "iptables-save":
+                self.calls.append(argv)
+                return save_line + "\n-A PREROUTING -j OTHER\n"
+            return FakeCommander.run(self, *argv)
+
+    mgr.cmd = sa = SaveAware()
+    sa.netns = cmd.netns              # share the surviving netns set
+    hook = NetworkHook(manager=mgr)
+    alloc = _bridge_alloc(ports=ports)
+    hook.postrun(alloc, _bridge_tg())     # no status entry: restart path
+    assert "nomad-11112222" not in sa.netns
+    deletes = [c for c in sa.calls if c[:4] ==
+               ("iptables", "-t", "nat", "-D")]
+    assert len(deletes) == 1 and "23000" in deletes[0]

@@ -176,3 +176,44 @@ def test_job_runs_on_external_plugin_driver(tmp_path, plugin_dir):
         server.shutdown()
     # after the primary assertions (not in finally, which would mask them)
     assert not any(d.alive() for d in client.plugin_drivers.values())
+
+
+def test_conformance_streaming_exec(ext, tmp_path):
+    """Interactive exec rides the plugin socket (ExecOpen/ExecIO/
+    ExecClose, ref plugins/drivers/driver.go:577): round-trip stdin ->
+    stdout through a shell running inside the plugin process's task
+    context."""
+    task = _task(tmp_path, "sleep 5")
+    task_dir = tmp_path / "t-exec"
+    task_dir.mkdir()
+    ext.start_task("t-exec", task, str(task_dir), {})
+    sess = ext.exec_task("t-exec", ["/bin/sh", "-c", "read line; "
+                                    "echo got:$line; echo err-side >&2"])
+    sess.write_stdin(b"hello-plugin\n")
+    out = err = b""
+    deadline = time.time() + 10
+    while time.time() < deadline and (b"got:hello-plugin" not in out
+                                      or b"err-side" not in err):
+        chunk = sess.read_output(wait=0.5)
+        out += chunk["stdout"]
+        err += chunk["stderr"]
+        if chunk["exited"] and b"got:hello-plugin" in out:
+            break
+    assert b"got:hello-plugin" in out
+    assert b"err-side" in err
+    # exit propagates
+    deadline = time.time() + 5
+    exited = False
+    while time.time() < deadline:
+        chunk = sess.read_output(wait=0.5)
+        if chunk["exited"]:
+            exited = True
+            break
+    assert exited
+    sess.terminate()
+    # closed sessions are gone plugin-side (the remote ValueError
+    # crosses the boundary with its original kind)
+    with pytest.raises((PluginError, ValueError)):
+        sess._io(wait=0.1)
+    ext.stop_task("t-exec")
+    ext.destroy_task("t-exec")

@@ -176,3 +176,27 @@ def test_fork_copies_services_and_autopilot():
         service_name="db", namespace="default", alloc_id="a2",
         address="10.0.0.2", port=5432)])
     assert len(s.services) == 1 and len(f.services) == 2
+
+
+def test_reconcile_job_summaries_repairs_drift():
+    """ref state_store.go ReconcileJobSummaries (PUT
+    /v1/system/reconcile/summaries): rebuild counts from the alloc set,
+    preserving eval-owned queued counts."""
+    s = StateStore()
+    n = mock.node()
+    j = mock.job()
+    s.upsert_node(1, n)
+    s.upsert_job(2, j)
+    a1, a2 = mock.alloc_for(j, n), mock.alloc_for(j, n)
+    a2.client_status = ALLOC_CLIENT_RUNNING
+    s.upsert_allocs(3, [a1, a2])
+    # inject drift: corrupt the maintained summary + queued marker
+    summ = s.job_summary("default", j.id).copy()
+    summ.summary["web"].starting = 99
+    summ.summary["web"].queued = 7
+    s.job_summaries[("default", j.id)] = summ
+    s.reconcile_job_summaries(4)
+    fixed = s.job_summary("default", j.id)
+    assert fixed.summary["web"].starting == 1     # a1 pending
+    assert fixed.summary["web"].running == 1      # a2 running
+    assert fixed.summary["web"].queued == 7       # eval-owned, carried over
