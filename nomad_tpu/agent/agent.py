@@ -50,6 +50,9 @@ class AgentConfig:
     tls_cert_file: str = ""
     tls_key_file: str = ""
     tls_verify_server_hostname: bool = False
+    # telemetry { } stanza (ref command/agent/config.go:638 Telemetry)
+    telemetry_prometheus: bool = True
+    telemetry_collection_interval: float = 1.0
 
     def key_bytes(self) -> bytes:
         from ..rpc.server import DEFAULT_KEY
@@ -175,8 +178,39 @@ class Agent:
                     adv = "127.0.0.1"
             self.client.node.http_addr = f"{adv}:{self.config.http_port}"
             self.client.start()
+        self._start_runtime_sampler()
+
+    def _start_runtime_sampler(self) -> None:
+        """Publish runtime gauges (RSS, thread count, GC counts) every
+        telemetry.collection_interval (ref command/agent config.go:638
+        Telemetry.CollectionInterval driving go-metrics runtime stats)."""
+        from ..metrics import metrics
+        interval = max(self.config.telemetry_collection_interval, 0.1)
+        self._sampler_stop = threading.Event()
+
+        def sample():
+            import gc
+            import resource
+            while not self._sampler_stop.wait(interval):
+                try:
+                    ru = resource.getrusage(resource.RUSAGE_SELF)
+                    metrics.set_gauge("nomad.runtime.rss_kb",
+                                      float(ru.ru_maxrss))
+                    metrics.set_gauge("nomad.runtime.threads",
+                                      float(threading.active_count()))
+                    counts = gc.get_count()
+                    metrics.set_gauge("nomad.runtime.gc_gen0",
+                                      float(counts[0]))
+                except Exception:   # noqa: BLE001 — monitoring only
+                    pass
+
+        self._sampler_thread = threading.Thread(
+            target=sample, daemon=True, name="telemetry-sampler")
+        self._sampler_thread.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "_sampler_stop", None) is not None:
+            self._sampler_stop.set()
         if self.http is not None:
             self.http.shutdown()
         if self.client is not None:

@@ -101,6 +101,17 @@ def load_config(paths: list[str]) -> dict:
     return merged
 
 
+def _duration(v) -> float:
+    """Go-style duration literal -> seconds ("500ms", "30s", "5m", "1h",
+    or a bare number of seconds)."""
+    s = str(v).strip()
+    for suffix, mult in (("ms", 0.001), ("h", 3600.0), ("m", 60.0),
+                         ("s", 1.0)):
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)]) * mult
+    return float(s)
+
+
 def apply_to_agent_config(cfg: AgentConfig, raw: dict) -> AgentConfig:
     """Overlay a parsed config-file dict onto an AgentConfig. Bad scalar
     values surface as ConfigError, not raw tracebacks."""
@@ -156,6 +167,14 @@ def _apply(cfg: AgentConfig, raw: dict) -> AgentConfig:
         cfg.acl_enabled = bool(acl.get("enabled", cfg.acl_enabled))
         if "replication_token" in acl:
             cfg.replication_token = acl["replication_token"]
+    telemetry = raw.get("telemetry", {})
+    if telemetry:
+        # ref config.go:638 Telemetry (subset)
+        if "prometheus_metrics" in telemetry:
+            cfg.telemetry_prometheus = bool(telemetry["prometheus_metrics"])
+        if "collection_interval" in telemetry:
+            cfg.telemetry_collection_interval = _duration(
+                telemetry["collection_interval"])
     tls = raw.get("tls", {})
     if tls:
         # ref structs/config/tls.go: `rpc = true` turns on mutual TLS

@@ -258,7 +258,8 @@ class HTTPAPI:
                     raise HTTPError(400, str(e))
             elif rest == ["evaluate"] and method in ("PUT", "POST"):
                 # ref job_endpoint.go Evaluate / PUT /v1/job/<id>/evaluate
-                opts = body.get("EvalOptions", {}) or {}
+                # (an empty request body means default EvalOptions)
+                opts = (body or {}).get("EvalOptions", {}) or {}
                 try:
                     out = s.job_evaluate(
                         ns, job_id,
@@ -462,10 +463,13 @@ class HTTPAPI:
             return s.operator_raft_configuration(), None
         if parts == ["operator", "raft", "peer"] and method == "DELETE":
             require(acl.allow_operator_write())
+            addr = query.get("address", "")
+            if isinstance(addr, list):     # "address" stays a list for join
+                addr = addr[0] if addr else ""
             try:
                 return s.operator_raft_remove_peer(
                     peer_id=query.get("id", ""),
-                    address=query.get("address", "")), None
+                    address=addr), None
             except ValueError as e:
                 raise HTTPError(400, str(e))
         if parts == ["operator", "autopilot", "configuration"]:
@@ -586,6 +590,28 @@ class HTTPAPI:
                 out.pop("Secrets", None)
                 return out, s.state.table_index("csi_volumes")
             require(acl.allow_namespace_operation(ns, NS_CSI_WRITE_VOLUME))
+            if parts[3:] == ["detach"] and method in ("PUT", "POST", "DELETE"):
+                # ref csi_endpoint.go CSIVolume.Unpublish / DELETE
+                # /v1/volume/csi/<id>/detach?node=<node_id>: release every
+                # claim the volume holds for allocs on that node
+                node_id = query.get("node", "")
+                if not node_id:
+                    raise HTTPError(400, "missing node")
+                vol = s.csi_volume_get(ns, vol_id)
+                if vol is None:
+                    raise HTTPError(404, f"volume {vol_id!r} not found")
+                from ..structs.csi import (CLAIM_STATE_READY_TO_FREE,
+                                           CSIVolumeClaim)
+                released = 0
+                for aid in list(vol.read_claims) + list(vol.write_claims):
+                    alloc = s.state.alloc_by_id(aid)
+                    if alloc is not None and alloc.node_id != node_id:
+                        continue
+                    s.csi_volume_claim(ns, vol_id, CSIVolumeClaim(
+                        alloc_id=aid, node_id=node_id,
+                        state=CLAIM_STATE_READY_TO_FREE))
+                    released += 1
+                return {"NumReleased": released}, None
             if method in ("PUT", "POST") and parts[3:] == []:
                 vol = from_api(CSIVolume, body.get("Volume", body))
                 vol.id = vol.id or vol_id
@@ -688,10 +714,14 @@ class HTTPAPI:
                 raise HTTPError(400, "missing address")
             joined = 0
             errs = []
+            # `name` applies only to a single-address join; with several
+            # addresses every peer must get a distinct raft id or later
+            # adds overwrite earlier ones
+            name_q = query.get("name", "")
             for address in addresses:
+                name = name_q if name_q and len(addresses) == 1 else address
                 try:
-                    s.operator_raft_add_peer(query.get("name", address),
-                                             address)
+                    s.operator_raft_add_peer(name, address)
                     joined += 1
                 except ValueError as e:
                     errs.append(str(e))
@@ -730,6 +760,20 @@ class HTTPAPI:
             return {}, None
         if parts == ["metrics"]:
             require(acl.allow_agent_read())
+            if query.get("format") == "prometheus":
+                # ref command/agent/http.go MetricsRequest: prometheus
+                # exposition is opt-in via telemetry.prometheus_metrics
+                if not self.agent.config.telemetry_prometheus:
+                    raise HTTPError(
+                        415, "prometheus format disabled "
+                        "(telemetry.prometheus_metrics = false)")
+                from ..metrics import metrics as reg
+                stats = self.agent.stats()
+                extra = {f"nomad_{k}": v for k, v in stats.items()
+                         if isinstance(v, (int, float))}
+                return RawResponse(
+                    reg.prometheus(extra_gauges=extra).encode(),
+                    "text/plain; version=0.0.4"), None
             return self.agent.stats(), None
 
         raise HTTPError(404, f"no handler for {method} {path}")

@@ -385,6 +385,14 @@ def cmd_volume_deregister(args) -> None:
     print(f"==> Deregistered volume {args.volume_id}")
 
 
+def cmd_volume_detach(args) -> None:
+    """ref command/volume_detach.go"""
+    out = api("DELETE",
+              f"/v1/volume/csi/{args.volume_id}/detach?node={args.node_id}")
+    print(f"==> Released {out.get('NumReleased', 0)} claim(s) on "
+          f"{args.volume_id}")
+
+
 def cmd_plugin_status(args) -> None:
     """ref command/plugin_status.go"""
     if not args.plugin_id:
@@ -435,6 +443,36 @@ def cmd_node_drain(args) -> None:
     api("PUT", f"/v1/node/{args.node_id}/drain", body)
     print(f"==> Node {args.node_id[:8]} drain "
           f"{'enabled' if args.enable else 'disabled'}")
+    if args.enable and getattr(args, "monitor", False):
+        # ref command/node_drain.go -monitor: poll until every non-system
+        # alloc on the node reaches a terminal or replaced state
+        seen = set()
+        while True:
+            node = api("GET", f"/v1/node/{args.node_id}")
+            allocs = api("GET", f"/v1/node/{args.node_id}/allocations")
+            remaining = [a for a in allocs
+                         if a["DesiredStatus"] == "run"
+                         and a["ClientStatus"] in ("pending", "running")]
+            for a in allocs:
+                key = (a["ID"], a["DesiredStatus"], a["ClientStatus"])
+                if key not in seen and a["DesiredStatus"] != "run":
+                    seen.add(key)
+                    print(f"    alloc {a['ID'][:8]} ({a['JobID']}) -> "
+                          f"{a['DesiredStatus']}/{a['ClientStatus']}")
+            if not node.get("Drain"):
+                # drain strategy removed: done — system-job allocs may
+                # legitimately keep running (-ignore-system), so don't
+                # wait on `remaining` once the drainer has finished
+                # (ref node_drain.go monitor exits on drain completion)
+                print("==> Drain complete" if not remaining else
+                      f"==> Drain complete ({len(remaining)} alloc(s) "
+                      "left running)")
+                return
+            if not remaining:
+                print("==> All allocations drained "
+                      "(node still marked draining)")
+                return
+            time.sleep(1.0)
 
 
 def cmd_node_eligibility(args) -> None:
@@ -1040,6 +1078,8 @@ def build_parser() -> argparse.ArgumentParser:
     nd.add_argument("-deadline", type=float, default=3600.0)
     nd.add_argument("-ignore-system", dest="ignore_system",
                     action="store_true")
+    nd.add_argument("-monitor", action="store_true",
+                    help="block and stream drain progress until done")
     nd.set_defaults(fn=cmd_node_drain)
     ne = nsub.add_parser("eligibility")
     ne.add_argument("node_id")
@@ -1223,6 +1263,10 @@ def build_parser() -> argparse.ArgumentParser:
     vd.add_argument("volume_id")
     vd.add_argument("-force", action="store_true")
     vd.set_defaults(fn=cmd_volume_deregister)
+    vdt = vsub.add_parser("detach")
+    vdt.add_argument("volume_id")
+    vdt.add_argument("node_id")
+    vdt.set_defaults(fn=cmd_volume_detach)
 
     plug = sub.add_parser("plugin")
     psub = plug.add_subparsers(dest="plugin_cmd", required=True)

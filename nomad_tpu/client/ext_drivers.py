@@ -112,16 +112,138 @@ class QemuDriver(RawExecDriver):
         return super().start_task(task_id, wrapped, task_dir, env)
 
 
+class ImageCoordinator:
+    """Refcounted image pulls (ref drivers/docker/coordinator.go
+    dockerCoordinator): concurrent tasks asking for the same image share
+    ONE pull (per-image lock, others wait on it); each task holds a
+    reference, and when the last reference drops the image is removed —
+    if cleanup is enabled — after a delay that lets rapid reschedules
+    reuse the warm image."""
+
+    def __init__(self, pull_fn, remove_fn, cleanup: bool = False,
+                 remove_delay: float = 0.0):
+        import threading
+        self._pull = pull_fn
+        self._remove = remove_fn
+        self.cleanup = cleanup
+        self.remove_delay = remove_delay
+        self._lock = threading.Lock()
+        self._pulls: dict[str, threading.Event] = {}    # in-flight
+        self._pull_err: dict[str, str] = {}
+        self._refs: dict[str, set] = {}                 # image -> task ids
+        self._remove_timers: dict[str, object] = {}     # delayed removes
+        self.stats = {"pulls": 0, "pull_waits": 0, "removes": 0}
+
+    def pull(self, image: str, task_id: str) -> None:
+        import threading
+        while True:
+            with self._lock:
+                # a re-reference cancels any pending delayed remove (ref
+                # coordinator.go: IncrementImageReference stops the
+                # removal timer) — otherwise the timer fires into the
+                # new user's warm-reuse window
+                timer = self._remove_timers.pop(image, None)
+                if timer is not None:
+                    timer.cancel()
+                inflight = self._pulls.get(image)
+                if inflight is None:
+                    if image in self._refs:              # already present
+                        self._refs[image].add(task_id)
+                        return
+                    ev = self._pulls[image] = threading.Event()
+                    self.stats["pulls"] += 1
+                    leader = True
+                else:
+                    ev = inflight
+                    leader = False
+                    self.stats["pull_waits"] += 1
+            if leader:
+                try:
+                    self._pull(image)
+                    with self._lock:
+                        self._refs[image] = {task_id}
+                        self._pull_err.pop(image, None)
+                except Exception as e:  # noqa: BLE001
+                    with self._lock:
+                        self._pull_err[image] = str(e)
+                finally:
+                    with self._lock:
+                        self._pulls.pop(image, None)
+                    ev.set()
+                err = self._pull_err.get(image)
+                if err:
+                    raise RuntimeError(f"image pull failed: {err}")
+                return
+            ev.wait(timeout=600.0)
+            with self._lock:
+                err = self._pull_err.get(image)
+                if err is None and image in self._refs:
+                    self._refs[image].add(task_id)
+                    return
+            if err:
+                raise RuntimeError(f"image pull failed: {err}")
+            # leader failed or raced a remove: retry as a fresh leader
+
+    def release(self, image: str, task_id: str) -> None:
+        """ref coordinator.go RemoveImage: drop the task's reference;
+        remove the image when the last reference goes (cleanup on)."""
+        import threading
+        with self._lock:
+            refs = self._refs.get(image)
+            if refs is None:
+                return
+            refs.discard(task_id)
+            if refs or not self.cleanup:
+                return
+            self._refs.pop(image, None)
+
+        def _do_remove():
+            with self._lock:
+                self._remove_timers.pop(image, None)
+                # re-referenced since scheduling, or a fresh pull is
+                # in flight (leader sets _refs only after the pull
+                # returns) — either way the image is wanted again
+                if image in self._refs or image in self._pulls:
+                    return
+            try:
+                self._remove(image)
+                self.stats["removes"] += 1
+            except Exception:  # noqa: BLE001 — image may be in use
+                pass
+        if self.remove_delay > 0:
+            t = threading.Timer(self.remove_delay, _do_remove)
+            with self._lock:
+                self._remove_timers[image] = t
+            t.start()
+        else:
+            _do_remove()
+
+
 class DockerDriver:
     """ref drivers/docker: engine lifecycle via the docker CLI — run with
-    labels/resource limits, stop with configurable timeout, logs captured
-    through `docker logs` into the task log files."""
+    labels/resource limits, refcount-coordinated image pulls, port maps
+    from the scheduler's allocated host ports, stop with configurable
+    timeout, `docker exec` sessions, logs captured through `docker logs`
+    into the task log files."""
 
     name = "docker"
 
-    def __init__(self, docker_bin: str = "docker"):
+    def __init__(self, docker_bin: str = "docker",
+                 image_cleanup: bool = False,
+                 image_remove_delay: float = 0.0):
         self.docker_bin = docker_bin
         self._containers: dict[str, dict] = {}
+        self.coordinator = ImageCoordinator(
+            self._pull_image, self._remove_image,
+            cleanup=image_cleanup, remove_delay=image_remove_delay)
+
+    def _pull_image(self, image: str) -> None:
+        out = self._docker("pull", image, timeout=600.0)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.decode(errors="replace"))
+
+    def _remove_image(self, image: str) -> None:
+        self._docker("rmi", image)
 
     # ------------------------------------------------------------ plumbing
 
@@ -154,6 +276,10 @@ class DockerDriver:
         image = cfg.get("image", "")
         if not image:
             raise ValueError("docker driver requires config.image")
+        # coordinated pull: N tasks of one job pulling the same image on
+        # one node share a single `docker pull` (ref coordinator.go)
+        if not cfg.get("skip_pull"):
+            self.coordinator.pull(image, task_id)
         cname = "nomad-" + task_id.replace("/", "-")
         argv = ["run", "-d", "--name", cname,
                 "--label", f"nomad_task_id={task_id}"]
@@ -161,12 +287,28 @@ class DockerDriver:
             argv += ["--memory", f"{task.resources.memory_mb}m"]
         if task.resources.cpu:
             argv += ["--cpu-shares", str(task.resources.cpu)]
+        if cfg.get("network_mode"):
+            argv += ["--network", cfg["network_mode"]]
+        for dns in cfg.get("dns_servers", []):
+            argv += ["--dns", dns]
+        if cfg.get("work_dir"):
+            argv += ["-w", cfg["work_dir"]]
+        if cfg.get("entrypoint"):
+            argv += ["--entrypoint", cfg["entrypoint"]]
         for k, v in env.items():
             argv += ["-e", f"{k}={v}"]
         for vol in cfg.get("volumes", []):
             argv += ["-v", vol]
         for port in cfg.get("ports", []):
             argv += ["-p", str(port)]
+        # port_map {label: container_port}: bind the scheduler-allocated
+        # host port (from the task env) to the container port (ref
+        # drivers/docker port mapping off AllocatedPorts)
+        for label, cport in (cfg.get("port_map", {}) or {}).items():
+            hp = env.get(f"NOMAD_HOST_PORT_{label}") or \
+                env.get(f"NOMAD_PORT_{label}")
+            if hp:
+                argv += ["-p", f"{hp}:{cport}"]
         argv.append(image)
         command = cfg.get("command", "")
         if command:
@@ -175,18 +317,25 @@ class DockerDriver:
             if isinstance(args, str):
                 args = shlex.split(args)
             argv += list(args)
-        out = self._docker(*argv, timeout=120.0)
+        try:
+            out = self._docker(*argv, timeout=120.0)
+        except Exception:
+            # a hung daemon (TimeoutExpired/OSError) must still drop the
+            # image reference or the refcount never reaches zero
+            self.coordinator.release(image, task_id)
+            raise
         if out.returncode != 0:
+            self.coordinator.release(image, task_id)
             raise RuntimeError(
                 f"docker run failed: {out.stderr.decode(errors='replace')}")
         container_id = out.stdout.decode().strip()
         self._containers[task_id] = {
             "id": container_id, "name": cname, "task_dir": task_dir,
-            "task_name": task.name,
+            "task_name": task.name, "image": image,
         }
         return TaskHandle(task_id=task_id, driver=self.name,
                           config={"container_id": container_id,
-                                  "name": cname},
+                                  "name": cname, "image": image},
                           started_at=time.time())
 
     def wait_task(self, task_id, timeout=None):
@@ -228,6 +377,27 @@ class DockerDriver:
         rec = self._containers.pop(task_id, None)
         if rec is not None:
             self._docker("rm", "-f", rec["id"])
+            if rec.get("image"):
+                self.coordinator.release(rec["image"], task_id)
+
+    def exec_task(self, task_id, command, tty: bool = False, cwd: str = "",
+                  env=None):
+        """`docker exec` session (ref drivers/docker ExecTaskStreaming)."""
+        from .driver import ExecSession
+        rec = self._containers.get(task_id)
+        if rec is None:
+            raise ValueError("unknown task")
+        argv = [self.docker_bin, "exec", "-i"]
+        if tty:
+            argv.append("-t")
+        if cwd:
+            argv += ["-w", cwd]
+        for k, v in (env or {}).items():
+            argv += ["-e", f"{k}={v}"]
+        argv.append(rec["id"])
+        argv += list(command or [])
+        return ExecSession(argv, cwd=os.getcwd(), env=dict(os.environ),
+                           tty=tty)
 
     def signal_task(self, task_id, sig):
         rec = self._containers.get(task_id)
@@ -266,7 +436,8 @@ class DockerDriver:
             return False
         self._containers[handle.task_id] = {
             "id": cid, "name": handle.config.get("name", ""),
-            "task_dir": "", "task_name": ""}
+            "task_dir": "", "task_name": "",
+            "image": handle.config.get("image", "")}
         return True
 
 

@@ -191,7 +191,9 @@ class BridgeNetworkManager:
             tag = f"nomad-alloc-{alloc_id[:8]}"
             for line in (saved or "").splitlines():
                 if tag in line and line.startswith("-A "):
-                    spec = line.split()[1:]     # drop the -A
+                    # iptables-save quotes comment values; the live rule
+                    # has no quotes, so strip them or -D never matches
+                    spec = [tok.strip('"') for tok in line.split()[1:]]
                     try:
                         self.cmd.run("iptables", "-t", "nat", "-D", *spec)
                     except RuntimeError:

@@ -95,6 +95,34 @@ class Registry:
             "samples": {k: samples[k].as_dict() for k in sorted(samples)},
         }
 
+    def prometheus(self, extra_gauges: dict = None) -> str:
+        """Prometheus text exposition of the registry (ref
+        telemetry.prometheus_metrics + armon/go-metrics' prometheus
+        sink): counters as counters, gauges as gauges, samples as
+        _count/_sum summaries — names sanitized to the metric charset."""
+        def san(name: str) -> str:
+            return "".join(c if c.isalnum() or c == "_" else "_"
+                           for c in name)
+
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap["counters"].items():
+            n = san(k)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {v}")
+        gauges = dict(snap["gauges"])
+        gauges.update(extra_gauges or {})
+        for k, v in sorted(gauges.items()):
+            n = san(k)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {v}")
+        for k, s in snap["samples"].items():
+            n = san(k)
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_count {s['count']}")
+            lines.append(f"{n}_sum {s['sum']}")
+        return "\n".join(lines) + "\n"
+
     def reset(self) -> None:
         self.counters.clear()
         self.gauges.clear()

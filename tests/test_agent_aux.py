@@ -152,3 +152,38 @@ def test_operator_debug_bundle(agent, capsys, tmp_path, monkeypatch):
         assert manifest["Errors"] == {}
         members = json.load(tar.extractfile(f"{base}/members.json"))
         assert members["Members"]
+
+
+def test_metrics_prometheus_format(agent):
+    """/v1/metrics?format=prometheus (ref telemetry.prometheus_metrics +
+    the go-metrics prometheus sink)."""
+    body = call(agent, "GET", "/v1/metrics?format=prometheus",
+                raw=True).decode()
+    assert "# TYPE" in body
+    assert "nomad_state_index" in body
+    # agent-level rollups ride as gauges
+    assert "nomad_nodes 1" in body
+
+
+def test_metrics_prometheus_disabled(monkeypatch, agent):
+    monkeypatch.setattr(agent.config, "telemetry_prometheus", False)
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        call(agent, "GET", "/v1/metrics?format=prometheus", raw=True)
+    assert exc.value.code == 415
+
+
+def test_telemetry_config_stanza(tmp_path):
+    from nomad_tpu.agent.agent import AgentConfig
+    from nomad_tpu.agent.config_file import (apply_to_agent_config,
+                                             parse_config_file)
+    p = tmp_path / "t.hcl"
+    p.write_text('''
+    telemetry {
+      prometheus_metrics  = false
+      collection_interval = "5s"
+    }
+    ''')
+    cfg = apply_to_agent_config(AgentConfig(), parse_config_file(str(p)))
+    assert cfg.telemetry_prometheus is False
+    assert cfg.telemetry_collection_interval == 5.0

@@ -131,3 +131,12 @@ def test_repeated_blocks_in_one_file_merge(tmp_path):
                  'server { bootstrap_expect = 3 }')
     raw = load_config([str(p)])
     assert raw["server"] == {"enabled": True, "bootstrap_expect": 3}
+
+
+def test_duration_literals():
+    from nomad_tpu.agent.config_file import _duration
+    assert _duration("500ms") == 0.5
+    assert _duration("30s") == 30.0
+    assert _duration("5m") == 300.0
+    assert _duration("1h") == 3600.0
+    assert _duration("2") == 2.0

@@ -287,3 +287,41 @@ def test_scheduler_rejects_claimed_single_writer_volume(server):
     placed3 = [a for plan in h3.plans
                for allocs in plan.node_allocation.values() for a in allocs]
     assert placed3
+
+
+def test_volume_detach_releases_node_claims(server):
+    """DELETE /v1/volume/csi/<id>/detach?node=N releases every claim held
+    by allocs on that node (ref csi_endpoint.go CSIVolume.Unpublish +
+    command/volume_detach.go)."""
+    import urllib.request
+
+    from nomad_tpu import mock
+    from nomad_tpu.agent import Agent, AgentConfig
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=0))
+    a.start()
+    try:
+        s = a.server
+        node = _csi_node()
+        s.node_register(node)
+        s.csi_volume_register([_vol("det0")])
+        job = mock.job()
+        alloc = mock.alloc_for(job, node)
+        s.state.upsert_job(s.state.latest_index() + 1, job)
+        s.state.upsert_allocs(s.state.latest_index() + 1, [alloc])
+        s.csi_volume_claim("default", "det0", CSIVolumeClaim(
+            alloc_id=alloc.id, node_id=node.id, mode=CLAIM_WRITE))
+        vol = s.state.csi_volume_by_id("default", "det0")
+        assert alloc.id in vol.write_claims
+        req = urllib.request.Request(
+            a.http_addr + f"/v1/volume/csi/det0/detach?node={node.id}",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            import json as _json
+            out = _json.loads(resp.read())
+        assert out["NumReleased"] == 1
+        vol = s.state.csi_volume_by_id("default", "det0")
+        # the claim is released (freed now or parked for the reaper)
+        assert alloc.id not in vol.write_claims or \
+            vol.write_claims[alloc.id].state != "taken"
+    finally:
+        a.shutdown()

@@ -105,6 +105,9 @@ FAKE_DOCKER = r'''
 cmd="$1"; shift
 case "$cmd" in
   version) echo "24.0.7"; exit 0 ;;
+  pull)    echo "PULL $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
+  rmi)     echo "RMI $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
+  exec)    shift_done=""; echo "EXEC $@" >> "$FAKE_DOCKER_LOG"; cat; echo "exec-out"; exit 0 ;;
   run)     echo "deadbeefcafe"; echo "RUN $@" >> "$FAKE_DOCKER_LOG"; exit 0 ;;
   wait)    sleep 0.1; echo "0"; exit 0 ;;
   logs)    echo "container-stdout"; exit 0 ;;
@@ -184,3 +187,92 @@ def test_registered_in_builtin_drivers():
         assert name in BUILTIN_DRIVERS
         drv = BUILTIN_DRIVERS[name]()
         assert drv.name == name
+
+
+def test_docker_image_coordinator_refcounted_pulls(fakepath, tmp_path,
+                                                   monkeypatch):
+    """ref drivers/docker/coordinator.go: N tasks, one image -> one
+    pull; image removed only after the LAST reference drops (cleanup)."""
+    import threading
+    log = tmp_path / "docker.log"
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    _fake_bin(fakepath, "docker", FAKE_DOCKER)
+    drv = DockerDriver(image_cleanup=True)
+    task_dir = str(tmp_path / "task")
+    os.makedirs(task_dir)
+
+    def start(tid):
+        task = _task(driver="docker", config={"image": "shared:1"})
+        drv.start_task(tid, task, task_dir, {})
+
+    threads = [threading.Thread(target=start, args=(f"a/t{i}",))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    pulls = [ln for ln in log.read_text().splitlines()
+             if ln.startswith("PULL")]
+    assert len(pulls) == 1, f"expected one coordinated pull, got {pulls}"
+    assert drv.coordinator.stats["pulls"] == 1
+    # releases: image survives until the last task is destroyed
+    for i in range(5):
+        drv.destroy_task(f"a/t{i}")
+        assert "RMI" not in log.read_text()
+    drv.destroy_task("a/t5")
+    assert "RMI shared:1" in log.read_text()
+
+
+def test_docker_port_map_binds_allocated_host_port(fakepath, tmp_path,
+                                                   monkeypatch):
+    log = tmp_path / "docker.log"
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    _fake_bin(fakepath, "docker", FAKE_DOCKER)
+    drv = DockerDriver()
+    task_dir = str(tmp_path / "task")
+    os.makedirs(task_dir)
+    task = _task(driver="docker", config={
+        "image": "web:1", "port_map": {"http": 8080}})
+    drv.start_task("a/p", task, task_dir,
+                   {"NOMAD_HOST_PORT_http": "22345"})
+    assert "-p 22345:8080" in log.read_text()
+
+
+def test_docker_exec_task(fakepath, tmp_path, monkeypatch):
+    log = tmp_path / "docker.log"
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    _fake_bin(fakepath, "docker", FAKE_DOCKER)
+    drv = DockerDriver()
+    task_dir = str(tmp_path / "task")
+    os.makedirs(task_dir)
+    task = _task(driver="docker", config={"image": "web:1"})
+    drv.start_task("a/e", task, task_dir, {})
+    sess = drv.exec_task("a/e", ["/bin/ls", "/tmp"])
+    sess.close_stdin()
+    out = b""
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        chunk = sess.read_output(wait=0.5)
+        out += chunk["stdout"]
+        if chunk["exited"]:
+            break
+    assert b"exec-out" in out
+    assert "EXEC -i deadbeefcafe /bin/ls /tmp" in \
+        (tmp_path / "docker.log").read_text()
+
+
+def test_image_coordinator_cancels_delayed_remove_on_reuse():
+    """ref coordinator.go: re-referencing an image inside the removal
+    delay cancels the scheduled remove."""
+    from nomad_tpu.client.ext_drivers import ImageCoordinator
+    removed = []
+    coord = ImageCoordinator(lambda img: None, removed.append,
+                             cleanup=True, remove_delay=0.3)
+    coord.pull("img:1", "t1")
+    coord.release("img:1", "t1")            # schedules delayed remove
+    coord.pull("img:1", "t2")               # reuse inside the window
+    time.sleep(0.6)
+    assert removed == [], "delayed remove fired despite re-reference"
+    coord.release("img:1", "t2")            # last ref: now it may remove
+    time.sleep(0.6)
+    assert removed == ["img:1"]

@@ -210,8 +210,9 @@ def test_postrun_after_restart_cleans_by_comment_tag():
     # simulate restart: leases gone, netns survives in the kernel
     mgr._leases.clear()
 
+    # real iptables-save quotes comment values
     save_line = (f"-A PREROUTING -p tcp -m tcp --dport 23000 "
-                 f"-m comment --comment nomad-alloc-11112222 "
+                 f'-m comment --comment "nomad-alloc-11112222" '
                  f"-j DNAT --to-destination {st['ip']}:8080")
 
     class SaveAware(FakeCommander):

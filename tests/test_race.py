@@ -127,34 +127,41 @@ def test_store_concurrent_alloc_upserts_keep_usage_consistent():
 
 def test_broker_no_double_dispatch_under_contention():
     """N consumers + nack/requeue churn: every eval is outstanding at
-    most once at any moment, and all evals eventually ack exactly once."""
+    most once at any moment, and every eval completes exactly once —
+    either acked by a worker or, after delivery_limit nacks, reaped off
+    the dead-letter queue the way the leader does (ref
+    nomad/leader.go:782 reapFailedEvaluations; without the reaper,
+    repeatedly-unlucky evals dead-letter and the run livelocks)."""
+    from nomad_tpu.server.eval_broker import FAILED_QUEUE
     broker = EvalBroker()
     broker.set_enabled(True)
     total = N_THREADS * 25
     for i in range(total):
         broker.enqueue(Evaluation(id=new_id(), type="service",
                                   priority=50, status="pending"))
-    acked = []
-    acked_lock = threading.Lock()
+    done = []                    # acked or reaped, exactly once each
+    done_lock = threading.Lock()
     outstanding = set()
     out_lock = threading.Lock()
     errors = []
 
-    def consumer(cid):
+    def consumer(cid, queues):
         def run():
             try:
                 while True:
-                    with acked_lock:
-                        if len(acked) >= total:
+                    with done_lock:
+                        if len(done) >= total:
                             return
-                    ev, token = broker.dequeue(["service"], timeout=0.2)
+                    ev, token = broker.dequeue(queues, timeout=0.2)
                     if ev is None:
                         continue
                     with out_lock:
                         assert ev.id not in outstanding, \
                             "double dispatch of an outstanding eval"
                         outstanding.add(ev.id)
-                    if (hash(ev.id) + cid) % 5 == 0:
+                    nack = (queues == ["service"]
+                            and (hash(ev.id) + cid) % 5 == 0)
+                    if nack:
                         with out_lock:
                             outstanding.discard(ev.id)
                         broker.nack(ev.id, token)      # requeue
@@ -162,16 +169,18 @@ def test_broker_no_double_dispatch_under_contention():
                         broker.ack(ev.id, token)
                         with out_lock:
                             outstanding.discard(ev.id)
-                        with acked_lock:
-                            acked.append(ev.id)
+                        with done_lock:
+                            done.append(ev.id)
             except Exception as e:      # noqa: BLE001
                 errors.append(e)
         return run
 
-    _run_all([consumer(c) for c in range(N_THREADS)])
+    workers = [consumer(c, ["service"]) for c in range(N_THREADS)]
+    reaper = consumer(N_THREADS, [FAILED_QUEUE])
+    _run_all(workers + [reaper])
     assert not errors, errors[:3]
-    assert len(acked) == total
-    assert len(set(acked)) == total, "an eval was acked twice"
+    assert len(done) == total
+    assert len(set(done)) == total, "an eval completed twice"
 
 
 # --------------------------------------------------------------- metrics

@@ -783,3 +783,157 @@ def test_job_plan_annotates_diff_with_consequences():
                 assert not td.get("Annotations")
     finally:
         s.shutdown()
+
+
+# ------------------------------------------------- additional scenarios
+
+def test_auto_promote_canaries(mkcluster=None):
+    """update { auto_promote = true }: once every canary is healthy, the
+    deployment watcher promotes without an operator (ref
+    deploymentwatcher/deployments_watcher.go autoPromoteDeployment)."""
+    from nomad_tpu.server import Server
+    from nomad_tpu.api_codec import to_api  # noqa: F401 (parity w/ ref)
+    s = Server(num_workers=1, gc_interval=9999)
+    s.deployment_watcher.poll_interval = 0.05
+    s.start()
+    try:
+        for _ in range(4):
+            n = mock.node()
+            s.state.upsert_node(s.state.latest_index() + 1, n)
+        job = mock.canary_job(canaries=1)
+        job.task_groups[0].update.auto_promote = True
+        job.task_groups[0].update.min_healthy_time_sec = 0.01
+        s.job_register(job)
+
+        def healthy_all():
+            # health rides the client-update path (ref
+            # UpdateAllocsFromClient) so deployment counters accrue
+            allocs = s.state.allocs_by_job(job.namespace, job.id)
+            for a in allocs:
+                if a.client_status != ALLOC_CLIENT_RUNNING or \
+                        a.deployment_status is None or \
+                        not a.deployment_status.healthy:
+                    a2 = a.copy()
+                    a2.client_status = ALLOC_CLIENT_RUNNING
+                    a2.deployment_status = AllocDeploymentStatus(
+                        healthy=True,
+                        canary=bool(a.deployment_status and
+                                    a.deployment_status.canary))
+                    s.state.update_allocs_from_client(
+                        s.state.latest_index() + 1, [a2])
+            return allocs
+
+        deadline = time.time() + 10
+        while time.time() < deadline and not healthy_all():
+            time.sleep(0.05)
+
+        upd = job.copy()
+        upd.task_groups[0].tasks[0].config = {"run_for": 9}
+        s.job_register(upd)
+        # keep marking allocs healthy; auto-promote should fire and the
+        # deployment eventually succeeds with version-1 allocs placed
+        deadline = time.time() + 15
+        promoted = False
+        while time.time() < deadline:
+            healthy_all()
+            d = s.state.latest_deployment_by_job(job.namespace, job.id)
+            if d is not None and d.task_groups["web"].promoted:
+                promoted = True
+                break
+            time.sleep(0.05)
+        assert promoted, "auto_promote never promoted the deployment"
+    finally:
+        s.shutdown()
+
+
+def test_reschedule_exponential_delay_growth():
+    """delay_function=exponential doubles the delay per attempt up to
+    max_delay (ref structs.go ReschedulePolicy + NextRescheduleTime)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.reschedule_policy = ReschedulePolicy(
+        attempts=0, unlimited=True, delay_sec=10,
+        delay_function="exponential", max_delay_sec=40)
+    register(h, job)
+    process(h, job)
+    alloc = allocs_of(h, job)[0]
+
+    # simulate repeated failures carrying the reschedule tracker forward
+    from nomad_tpu.structs import RescheduleEvent, RescheduleTracker
+    delays = []
+    prev = alloc
+    now = time.time()
+    for attempt in range(4):
+        failed = prev.copy()
+        failed.client_status = ALLOC_CLIENT_FAILED
+        h.state.upsert_allocs(h.get_next_index(), [failed])
+        process(h, job, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+        replacements = [a for a in allocs_of(h, job)
+                        if a.previous_allocation == failed.id]
+        followups = [e for e in h.created_evals
+                     if e.wait_until_unix > now]
+        if replacements:
+            prev = replacements[0]
+            tr = prev.reschedule_tracker
+            assert tr is not None and tr.events
+            delays.append(tr.events[-1].delay_sec)
+        elif followups:
+            delays.append(followups[-1].wait_until_unix - now)
+            break
+        else:
+            break
+    assert delays, "no reschedule delay observed"
+    # exponential: strictly non-decreasing, capped at max_delay
+    assert all(b >= a - 1e-6 for a, b in zip(delays, delays[1:]))
+    assert max(delays) <= 40 + 1
+
+
+def test_new_version_mid_deployment_supersedes():
+    """Registering v2 while v1's deployment is still running cancels the
+    v1 deployment (ref deploymentwatcher: newer job version supersedes)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = mock.service_job_with_update() if hasattr(
+        mock, "service_job_with_update") else mock.canary_job(canaries=0)
+    register(h, job)
+    process(h, job)
+    v1 = _run_update(h, job)
+    d1 = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d1 is not None and d1.job_version == 1
+    v2 = v1.copy()
+    v2.task_groups[0].tasks[0].config = {"run_for": 3}
+    v2.version = 2
+    register(h, v2)
+    process(h, v2)
+    d2 = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d2 is not None and d2.job_version == 2
+    assert d2.id != d1.id
+
+
+def test_alloc_name_indexes_reused_on_scale_cycle():
+    """Scale 5 -> 3 -> 5: the reused names are the LOWEST free indexes
+    (ref scheduler/reconcile_util.go allocNameIndex.Next bitmap)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 5
+    tg.tasks[0].resources.networks = []
+    tg.networks = []
+    register(h, job)
+    process(h, job)
+    job2 = job.copy()
+    job2.task_groups[0].count = 3
+    register(h, job2)
+    process(h, job2)
+    names = sorted(a.name for a in live(allocs_of(h, job2)))
+    assert names == [f"{job.id}.web[{i}]" for i in range(3)]
+    job3 = job2.copy()
+    job3.task_groups[0].count = 5
+    register(h, job3)
+    process(h, job3)
+    names = sorted(a.name for a in live(allocs_of(h, job3)))
+    assert names == [f"{job.id}.web[{i}]" for i in range(5)]
