@@ -1,0 +1,120 @@
+// nomad-logmon — out-of-process task log collector with size rotation
+// (behavioral ref client/logmon/logmon.go + lib/fifo: the reference runs
+// one logmon subprocess per task, pumping the task's output FIFO into
+// size-capped rotated files so the client agent never holds task IO and
+// a client restart never loses or blocks task output).
+//
+// Usage: nomad-logmon <base-path> <max_bytes> <max_files>
+//
+//   Reads stdin until EOF and writes <base-path> (e.g. web.stdout.log),
+//   rotating by rename when the live file exceeds max_bytes:
+//       web.stdout.log -> web.stdout.log.1 -> ... -> .<max_files-1>
+//   The oldest file past max_files is unlinked. Writers upstream hold
+//   the pipe, not the file, so rotation is invisible to the task.
+//
+// Exit codes: 0 on EOF, 2 on usage error, 3 on unrecoverable IO error.
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Rotator {
+  std::string base;
+  long long max_bytes;
+  int max_files;
+  int fd = -1;
+  long long written = 0;
+
+  bool open_live() {
+    fd = ::open(base.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (fd < 0) return false;
+    struct stat st {};
+    written = (::fstat(fd, &st) == 0) ? st.st_size : 0;
+    return true;
+  }
+
+  void rotate() {
+    ::close(fd);
+    fd = -1;
+    // shift: .<n-1> unlinked, .k -> .k+1, live -> .1
+    std::string oldest = base + "." + std::to_string(max_files - 1);
+    ::unlink(oldest.c_str());
+    for (int k = max_files - 2; k >= 1; --k) {
+      std::string from = base + "." + std::to_string(k);
+      std::string to = base + "." + std::to_string(k + 1);
+      ::rename(from.c_str(), to.c_str());  // ENOENT is fine
+    }
+    std::string first = base + ".1";
+    ::rename(base.c_str(), first.c_str());
+    open_live();
+  }
+
+  bool write_all(const char* buf, ssize_t n) {
+    while (n > 0) {
+      // split writes at the rotation boundary so one large pipe read
+      // can still produce correctly capped files
+      long long room = max_bytes - written;
+      if (room <= 0) room = max_bytes;
+      ssize_t chunk = n < room ? n : static_cast<ssize_t>(room);
+      ssize_t w = ::write(fd, buf, static_cast<size_t>(chunk));
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      buf += w;
+      n -= w;
+      written += w;
+      if (written >= max_bytes && max_files > 1) rotate();
+      if (fd < 0) return false;
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: nomad-logmon <base-path> <max_bytes> <max_files>\n");
+    return 2;
+  }
+  // the task closing its pipe must not kill logmon mid-buffer
+  ::signal(SIGPIPE, SIG_IGN);
+
+  Rotator r;
+  r.base = argv[1];
+  r.max_bytes = std::atoll(argv[2]);
+  r.max_files = std::atoi(argv[3]);
+  if (r.max_bytes <= 0) r.max_bytes = 10LL * 1024 * 1024;
+  if (r.max_files <= 0) r.max_files = 10;
+  if (!r.open_live()) {
+    std::perror("nomad-logmon: open");
+    return 3;
+  }
+
+  char buf[64 * 1024];
+  for (;;) {
+    ssize_t n = ::read(STDIN_FILENO, buf, sizeof buf);
+    if (n == 0) break;  // EOF: task closed its end
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      std::perror("nomad-logmon: read");
+      return 3;
+    }
+    if (!r.write_all(buf, n)) {
+      std::perror("nomad-logmon: write");
+      return 3;
+    }
+  }
+  ::close(r.fd);
+  return 0;
+}

@@ -102,14 +102,16 @@ def load_config(paths: list[str]) -> dict:
 
 
 def _duration(v) -> float:
-    """Go-style duration literal -> seconds ("500ms", "30s", "5m", "1h",
-    or a bare number of seconds)."""
+    """Go-style duration literal -> seconds; delegates to the jobspec
+    parser's full implementation (compound literals like "1m30s",
+    sub-ms units) and treats a bare number as seconds."""
     s = str(v).strip()
-    for suffix, mult in (("ms", 0.001), ("h", 3600.0), ("m", 60.0),
-                         ("s", 1.0)):
-        if s.endswith(suffix):
-            return float(s[:-len(suffix)]) * mult
-    return float(s)
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    from ..jobspec.parse import duration
+    return duration(s)
 
 
 def apply_to_agent_config(cfg: AgentConfig, raw: dict) -> AgentConfig:

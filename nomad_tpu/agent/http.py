@@ -603,9 +603,13 @@ class HTTPAPI:
                 from ..structs.csi import (CLAIM_STATE_READY_TO_FREE,
                                            CSIVolumeClaim)
                 released = 0
-                for aid in list(vol.read_claims) + list(vol.write_claims):
-                    alloc = s.state.alloc_by_id(aid)
-                    if alloc is not None and alloc.node_id != node_id:
+                # each claim records the node it was taken for — compare
+                # THAT, not a live-alloc lookup: GC'd allocs' claims must
+                # only release when their own node matches
+                all_claims = dict(vol.read_claims)
+                all_claims.update(vol.write_claims)
+                for aid, claim in all_claims.items():
+                    if claim.node_id != node_id:
                         continue
                     s.csi_volume_claim(ns, vol_id, CSIVolumeClaim(
                         alloc_id=aid, node_id=node_id,

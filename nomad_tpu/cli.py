@@ -459,7 +459,7 @@ def cmd_node_drain(args) -> None:
                     seen.add(key)
                     print(f"    alloc {a['ID'][:8]} ({a['JobID']}) -> "
                           f"{a['DesiredStatus']}/{a['ClientStatus']}")
-            if not node.get("Drain"):
+            if not node.get("DrainStrategy"):
                 # drain strategy removed: done — system-job allocs may
                 # legitimately keep running (-ignore-system), so don't
                 # wait on `remaining` once the drainer has finished

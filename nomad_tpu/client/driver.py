@@ -333,6 +333,43 @@ class MockDriver(Driver):
             return handle.task_id in self._tasks
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+# native out-of-process log collector (ref client/logmon: a subprocess
+# per task stream so the agent never holds task IO); built by
+# `make -C native`, absent -> drivers append directly and the Python
+# LogRotator handles rotation
+LOGMON_BIN = os.path.join(_REPO_ROOT, "native", "nomad-logmon")
+
+
+def logmon_available() -> bool:
+    return os.access(LOGMON_BIN, os.X_OK)
+
+
+def _open_log_sinks(task_dir: str, task):
+    """(stdout_sink, stderr_sink, logmon_procs): pipes into per-stream
+    nomad-logmon subprocesses when the native binary is built, plain
+    O_APPEND files otherwise. Callers close the returned sinks after
+    handing them to the task process."""
+    lc = getattr(task, "log_config", None)
+    max_bytes = (getattr(lc, "max_file_size_mb", 10) or 10) * 1024 * 1024
+    max_files = getattr(lc, "max_files", 10) or 10
+    if logmon_available():
+        procs = []
+        sinks = []
+        for stream in ("stdout", "stderr"):
+            base = os.path.join(task_dir, f"{task.name}.{stream}.log")
+            p = subprocess.Popen(
+                [LOGMON_BIN, base, str(max_bytes), str(max_files)],
+                stdin=subprocess.PIPE, start_new_session=True)
+            procs.append(p)
+            sinks.append(p.stdin)
+        return sinks[0], sinks[1], procs
+    stdout = open(os.path.join(task_dir, f"{task.name}.stdout.log"), "ab")
+    stderr = open(os.path.join(task_dir, f"{task.name}.stderr.log"), "ab")
+    return stdout, stderr, []
+
+
 class RawExecDriver(Driver):
     """Fork/exec without isolation (ref drivers/rawexec): config keys
     command, args."""
@@ -342,6 +379,12 @@ class RawExecDriver(Driver):
     def __init__(self):
         self._lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
+        self._logmons: dict[str, list] = {}
+
+    def uses_logmon(self) -> bool:
+        """True when this driver routes task output through the native
+        nomad-logmon sidecar (which then owns rotation)."""
+        return logmon_available()
 
     def start_task(self, task_id, task, task_dir, env):
         cfg = task.config
@@ -353,16 +396,51 @@ class RawExecDriver(Driver):
             args = shlex.split(args)
         full_env = dict(os.environ)
         full_env.update(env)
-        stdout = open(os.path.join(task_dir, f"{task.name}.stdout.log"), "ab")
-        stderr = open(os.path.join(task_dir, f"{task.name}.stderr.log"), "ab")
-        proc = subprocess.Popen(
-            [command] + list(args), cwd=task_dir, env=full_env,
-            stdout=stdout, stderr=stderr,
-            start_new_session=True)   # own process group for clean kill
+        stdout, stderr, logmons = _open_log_sinks(task_dir, task)
+
+        def _close_sinks():
+            # the parent's copies of the pipe write-ends must close so
+            # each logmon sees EOF when the TASK exits
+            for f in (stdout, stderr):
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+        try:
+            proc = subprocess.Popen(
+                [command] + list(args), cwd=task_dir, env=full_env,
+                stdout=stdout, stderr=stderr,
+                start_new_session=True)  # own process group for clean kill
+        except BaseException:
+            # Popen raised (bad command): close the write-ends so the
+            # sidecars see EOF and exit instead of leaking on read()
+            _close_sinks()
+            for p in logmons:
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            raise
+        _close_sinks()
         with self._lock:
             self._procs[task_id] = proc
+            if logmons:
+                self._logmons[task_id] = logmons
         return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid,
                           started_at=time.time())
+
+    def _drain_logmons(self, task_id) -> None:
+        """After task exit, wait briefly for the logmon sidecars to see
+        EOF and flush, so callers reading the log files observe all
+        output (the reference's logmon shutdown barrier)."""
+        with self._lock:
+            logmons = self._logmons.pop(task_id, [])
+        for p in logmons:
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
     def wait_task(self, task_id, timeout=None):
         with self._lock:
@@ -375,6 +453,7 @@ class RawExecDriver(Driver):
             return None
         if code is None:
             return None
+        self._drain_logmons(task_id)
         if code < 0:
             return ExitResult(exit_code=0, signal=-code)
         return ExitResult(exit_code=code)
@@ -401,6 +480,7 @@ class RawExecDriver(Driver):
 
     def destroy_task(self, task_id):
         self.stop_task(task_id, kill_timeout=0.1)
+        self._drain_logmons(task_id)
         with self._lock:
             self._procs.pop(task_id, None)
 

@@ -224,18 +224,92 @@ def fp_cloud_env(node: Node, cfg: dict) -> None:
                 node.attributes.pop(attr, None)
 
 
+def fp_os(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/host.go os.name/os.version via os-release."""
+    try:
+        with open("/etc/os-release") as f:
+            kv = dict(line.strip().split("=", 1)
+                      for line in f if "=" in line)
+    except OSError:
+        return
+    name = kv.get("ID", kv.get("NAME", "")).strip('"')
+    version = kv.get("VERSION_ID", "").strip('"')
+    if name:
+        node.attributes["os.name"] = name
+    if version:
+        node.attributes["os.version"] = version
+
+
+def fp_virtual(node: Node, cfg: dict) -> None:
+    """Virtualization detection (ref client/fingerprint: the reference
+    tags cloud instances via env_*; the generic host analog reads DMI +
+    the cpu hypervisor flag)."""
+    try:
+        with open("/sys/class/dmi/id/product_name") as f:
+            product = f.read().strip()
+        if product:
+            node.attributes["unique.platform.product-name"] = product
+    except OSError:
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            if " hypervisor" in f.read():
+                node.attributes["cpu.arch.virtual"] = "true"
+                node.attributes["virtualization"] = "guest"
+    except OSError:
+        pass
+
+
+def fp_consul(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/consul.go: probe the local Consul agent
+    (or the analog service-catalog endpoint) and tag its presence."""
+    addr = cfg.get("consul_addr", os.environ.get(
+        "CONSUL_HTTP_ADDR", "http://127.0.0.1:8500"))
+    try:
+        body = _metadata_get(addr.rstrip("/") + "/v1/agent/self", {}, 0.5)
+    except Exception:       # noqa: BLE001 — absent is the common case
+        return
+    node.attributes["consul.available"] = "true"
+    import json as _json
+    try:
+        info = _json.loads(body)
+        node.attributes["consul.version"] = \
+            info.get("Config", {}).get("Version", "")
+        node.attributes["consul.datacenter"] = \
+            info.get("Config", {}).get("Datacenter", "")
+    except ValueError:
+        pass
+
+
+def fp_vault(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/vault.go: probe the Vault (analog
+    secrets provider) health endpoint."""
+    addr = cfg.get("vault_addr", os.environ.get("VAULT_ADDR", ""))
+    if not addr:
+        return
+    try:
+        _metadata_get(addr.rstrip("/") + "/v1/sys/health", {}, 0.5)
+    except Exception:       # noqa: BLE001
+        return
+    node.attributes["vault.accessible"] = "true"
+
+
 FINGERPRINTERS = [
     ("arch", fp_arch),
     ("cpu", fp_cpu),
     ("memory", fp_memory),
     ("storage", fp_storage),
     ("host", fp_host),
+    ("os", fp_os),
+    ("virtual", fp_virtual),
     ("nomad", fp_nomad),
     ("signal", fp_signal),
     ("cgroup", fp_cgroup),
     ("bridge", fp_bridge),
     ("network", fp_network),
     ("cloud_env", fp_cloud_env),
+    ("consul", fp_consul),
+    ("vault", fp_vault),
 ]
 
 

@@ -131,11 +131,18 @@ class TaskRunner:
             with os.fdopen(fd, "w") as f:
                 f.write(content)
             os.chmod(path, mode)   # existing file: tighten to the ask
-        # log rotation per the task's log stanza (ref logmon_hook.go)
+        # log rotation per the task's log stanza (ref logmon_hook.go).
+        # When THIS task's driver pipes output through the native
+        # nomad-logmon sidecar, the sidecar owns rotation — running the
+        # copy-truncate rotator on top would race its rename rotation.
+        # Drivers that write files directly (exec's executor, docker's
+        # log collection) still need the in-process rotator.
         from .logmon import LogRotator
-        self._logmon = LogRotator(self.task_dir, self.task.name,
-                                  self.task.log_config)
-        self._logmon.start()
+        uses_sidecar = getattr(self.driver, "uses_logmon", None)
+        if not (uses_sidecar is not None and uses_sidecar()):
+            self._logmon = LogRotator(self.task_dir, self.task.name,
+                                      self.task.log_config)
+            self._logmon.start()
 
     def _wait_for_exit(self) -> Optional[ExitResult]:
         while not self._kill.is_set():

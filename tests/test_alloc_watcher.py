@@ -131,9 +131,13 @@ def test_log_rotator(tmp_path):
     assert rot.rotated_files("stdout") == [live + ".1", live + ".2"]
 
 
-def test_log_rotation_live_task(agent):
+def test_log_rotation_live_task(agent, monkeypatch):
     """End to end: a chatty raw_exec task's stdout rotates without the
-    process noticing."""
+    process noticing — on the PYTHON fallback rotator (the native
+    nomad-logmon sidecar path is covered in test_client.py; forcing the
+    fallback here keeps both mechanisms exercised)."""
+    import nomad_tpu.client.driver as driver_mod
+    monkeypatch.setattr(driver_mod, "logmon_available", lambda: False)
     job = mock.job()
     job.id = job.name = "chattyjob"
     job.type = "service"

@@ -186,3 +186,94 @@ def test_client_restart_reattaches_raw_exec(tmp_path):
         for a in server.state.allocs_by_job("default", job.id)), timeout=10)
     client2.shutdown()
     server.shutdown()
+
+
+def test_native_logmon_rotation(tmp_path):
+    """nomad-logmon (native/logmon.cc): size-capped rename rotation with
+    oldest-file pruning (ref client/logmon/logmon.go + lib/fifo)."""
+    import subprocess
+
+    from nomad_tpu.client.driver import LOGMON_BIN, logmon_available
+    if not logmon_available():
+        pytest.skip("nomad-logmon not built")
+    base = str(tmp_path / "t.stdout.log")
+    p = subprocess.Popen([LOGMON_BIN, base, "1000", "3"],
+                         stdin=subprocess.PIPE)
+    for i in range(100):
+        p.stdin.write(f"line-{i:04d} ".encode() * 10 + b"\n")
+    p.stdin.close()
+    assert p.wait(timeout=10) == 0
+    import os as _os
+    files = sorted(_os.listdir(tmp_path))
+    assert "t.stdout.log" in files
+    assert "t.stdout.log.1" in files and "t.stdout.log.2" in files
+    assert "t.stdout.log.3" not in files          # pruned at max_files=3
+    assert _os.path.getsize(base) <= 2200          # capped-ish live file
+    # the newest data is in the live file
+    with open(base, "rb") as f:
+        assert b"line-0099" in f.read()
+
+
+def test_raw_exec_logs_via_native_logmon(tmp_path):
+    """raw_exec pipes task output through the logmon sidecar and all
+    output is flushed by wait_task's drain barrier."""
+    from nomad_tpu.client.driver import RawExecDriver, logmon_available
+    if not logmon_available():
+        pytest.skip("nomad-logmon not built")
+    job = mock.job()
+    task = job.task_groups[0].tasks[0]
+    task.name = "lm"
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", "seq 1 500; echo done-marker"]}
+    drv = RawExecDriver()
+    task_dir = str(tmp_path)
+    drv.start_task("a/lm", task, task_dir, {})
+    res = drv.wait_task("a/lm", timeout=10)
+    assert res is not None and res.exit_code == 0
+    with open(os.path.join(task_dir, "lm.stdout.log"), "rb") as f:
+        body = f.read()
+    assert b"done-marker" in body and b"\n500\n" in body
+    drv.destroy_task("a/lm")
+
+
+def test_fingerprint_os_virtual_and_probes(tmp_path, monkeypatch):
+    """New fingerprinters: os-release, virtualization, consul/vault
+    probes (ref client/fingerprint/{host,consul,vault}.go) — probes
+    no-op when nothing is listening."""
+    from nomad_tpu.client.fingerprint import fingerprint_node
+    monkeypatch.setenv("CONSUL_HTTP_ADDR", "http://127.0.0.1:1")  # closed
+    monkeypatch.delenv("VAULT_ADDR", raising=False)
+    n = fingerprint_node()
+    assert n.attributes.get("os.name")          # os-release present on CI
+    assert "consul.available" not in n.attributes
+    assert "vault.accessible" not in n.attributes
+
+    # a live "consul" endpoint flips the attribute
+    import http.server
+    import json as _json
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = _json.dumps({"Config": {"Version": "1.15.0",
+                                           "Datacenter": "dcx"}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        n2 = fingerprint_node(cfg={
+            "consul_addr": f"http://127.0.0.1:{srv.server_address[1]}"})
+        assert n2.attributes["consul.available"] == "true"
+        assert n2.attributes["consul.version"] == "1.15.0"
+        assert n2.attributes["consul.datacenter"] == "dcx"
+    finally:
+        srv.shutdown()
