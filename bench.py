@@ -256,6 +256,25 @@ def main() -> None:
     _run_eval(fsm_t5, planner_t5, job_t5)
     tpu_5k_s = time.perf_counter() - t0
 
+    # sustained throughput (BASELINE's stated metric shape: "evals/sec +
+    # p50 plan-submit latency"): a stream of K separate 1k-task evals
+    # through scheduler -> serial applier -> FSM on the warm 10k-node
+    # cluster, timing each eval's submit-to-applied individually
+    k_stream = 16
+    fsm_s = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=11)
+    planner_s = Planner(RaftLog(fsm_s), fsm_s.state)
+    submit_times = []
+    t_stream0 = time.perf_counter()
+    for j in range(k_stream):
+        job_s = _mk_batch_job(f"stream-{j}", 1_000)
+        _register(fsm_s, job_s)
+        t0 = time.perf_counter()
+        _run_eval(fsm_s, planner_s, job_s)
+        submit_times.append(time.perf_counter() - t0)
+    stream_s = time.perf_counter() - t_stream0
+    submit_times.sort()
+    p50_submit = submit_times[len(submit_times) // 2]
+
     # plan-rejection parity under optimistic concurrency: same-seed
     # apples-to-apples sims (VERDICT r2 weak #7: one fixed seed is not
     # evidence — a second seed is reported for stability)
@@ -283,6 +302,8 @@ def main() -> None:
         "rejection_parity": bool(rej_tpu <= rej_host + 0.01),
         "rejection_alloc_rate_tpu": round(rej_tpu_alloc, 4),
         "rejection_alloc_rate_host": round(rej_host_alloc, 4),
+        "evals_per_sec_1k_stream": round(k_stream / stream_s, 2),
+        "p50_plan_submit_s": round(p50_submit, 4),
         **phases,
         "solver_kernel": kernel,
         "solver_batched_fraction": round(batched / total_pl, 4)

@@ -44,6 +44,13 @@ struct Rotator {
   void rotate() {
     ::close(fd);
     fd = -1;
+    if (max_files <= 1) {
+      // single-file config: truncate-in-place (matches the Python
+      // LogRotator's keep=0 behavior) — never grow without bound
+      fd = ::open(base.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+      written = 0;
+      return;
+    }
     // shift: .<n-1> unlinked, .k -> .k+1, live -> .1
     std::string oldest = base + "." + std::to_string(max_files - 1);
     ::unlink(oldest.c_str());
@@ -59,10 +66,14 @@ struct Rotator {
 
   bool write_all(const char* buf, ssize_t n) {
     while (n > 0) {
-      // split writes at the rotation boundary so one large pipe read
-      // can still produce correctly capped files
+      // rotate BEFORE writing once the cap is reached — covers both a
+      // live file already oversized at open (client-restart reattach)
+      // and exact capping across large pipe reads
+      if (written >= max_bytes) {
+        rotate();
+        if (fd < 0) return false;
+      }
       long long room = max_bytes - written;
-      if (room <= 0) room = max_bytes;
       ssize_t chunk = n < room ? n : static_cast<ssize_t>(room);
       ssize_t w = ::write(fd, buf, static_cast<size_t>(chunk));
       if (w < 0) {
@@ -72,8 +83,6 @@ struct Rotator {
       buf += w;
       n -= w;
       written += w;
-      if (written >= max_bytes && max_files > 1) rotate();
-      if (fd < 0) return false;
     }
     return true;
   }

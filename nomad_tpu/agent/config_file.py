@@ -110,8 +110,13 @@ def _duration(v) -> float:
         return float(s)
     except ValueError:
         pass
-    from ..jobspec.parse import duration
-    return duration(s)
+    from ..jobspec.parse import ParseError, duration
+    try:
+        return duration(s)
+    except ParseError as e:
+        # apply_to_agent_config converts ValueError to ConfigError; a
+        # jobspec ParseError would escape as a raw traceback
+        raise ValueError(str(e)) from e
 
 
 def apply_to_agent_config(cfg: AgentConfig, raw: dict) -> AgentConfig:

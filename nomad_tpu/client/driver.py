@@ -357,13 +357,28 @@ def _open_log_sinks(task_dir: str, task):
     if logmon_available():
         procs = []
         sinks = []
-        for stream in ("stdout", "stderr"):
-            base = os.path.join(task_dir, f"{task.name}.{stream}.log")
-            p = subprocess.Popen(
-                [LOGMON_BIN, base, str(max_bytes), str(max_files)],
-                stdin=subprocess.PIPE, start_new_session=True)
-            procs.append(p)
-            sinks.append(p.stdin)
+        try:
+            for stream in ("stdout", "stderr"):
+                base = os.path.join(task_dir, f"{task.name}.{stream}.log")
+                p = subprocess.Popen(
+                    [LOGMON_BIN, base, str(max_bytes), str(max_files)],
+                    stdin=subprocess.PIPE, start_new_session=True)
+                procs.append(p)
+                sinks.append(p.stdin)
+        except BaseException:
+            # second spawn failed: close the first sidecar's pipe so it
+            # sees EOF and exits rather than leaking on read()
+            for f in sinks:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            raise
         return sinks[0], sinks[1], procs
     stdout = open(os.path.join(task_dir, f"{task.name}.stdout.log"), "ab")
     stderr = open(os.path.join(task_dir, f"{task.name}.stderr.log"), "ab")

@@ -277,3 +277,48 @@ def test_fingerprint_os_virtual_and_probes(tmp_path, monkeypatch):
         assert n2.attributes["consul.datacenter"] == "dcx"
     finally:
         srv.shutdown()
+
+
+def test_native_logmon_single_file_truncates(tmp_path):
+    """max_files=1: the sidecar truncates in place (matching the Python
+    LogRotator's keep=0) instead of growing without bound."""
+    import subprocess
+
+    from nomad_tpu.client.driver import LOGMON_BIN, logmon_available
+    if not logmon_available():
+        pytest.skip("nomad-logmon not built")
+    base = str(tmp_path / "one.log")
+    p = subprocess.Popen([LOGMON_BIN, base, "500", "1"],
+                         stdin=subprocess.PIPE)
+    for i in range(50):
+        p.stdin.write(f"row-{i:03d} ".encode() * 5 + b"\n")
+    p.stdin.close()
+    assert p.wait(timeout=10) == 0
+    import os as _os
+    assert _os.listdir(tmp_path) == ["one.log"]
+    assert _os.path.getsize(base) <= 500 + 64
+    with open(base, "rb") as f:
+        assert b"row-049" in f.read()     # newest data retained
+
+
+def test_native_logmon_oversized_reattach_rotates_first(tmp_path):
+    """A live file already over the cap at open (client restart) rotates
+    BEFORE new data lands, keeping the cap exact."""
+    import subprocess
+
+    from nomad_tpu.client.driver import LOGMON_BIN, logmon_available
+    if not logmon_available():
+        pytest.skip("nomad-logmon not built")
+    base = str(tmp_path / "re.log")
+    with open(base, "wb") as f:
+        f.write(b"x" * 2000)              # pre-existing oversize (cap 1k)
+    p = subprocess.Popen([LOGMON_BIN, base, "1000", "3"],
+                         stdin=subprocess.PIPE)
+    p.stdin.write(b"fresh-after-restart\n")
+    p.stdin.close()
+    assert p.wait(timeout=10) == 0
+    import os as _os
+    assert _os.path.getsize(base) <= 1000
+    with open(base, "rb") as f:
+        assert b"fresh-after-restart" in f.read()
+    assert _os.path.exists(base + ".1")   # the oversized original rotated

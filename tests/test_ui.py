@@ -35,7 +35,8 @@ def test_ui_api_contract(agent):
     """Every endpoint the UI fetches exists and returns JSON."""
     for path in ("/v1/jobs?namespace=*", "/v1/nodes",
                  "/v1/services?namespace=*", "/v1/agent/members",
-                 "/v1/deployments?namespace=*"):
+                 "/v1/deployments?namespace=*",
+                 "/v1/evaluations?namespace=*"):
         with urllib.request.urlopen(agent.http_addr + path,
                                     timeout=10) as r:
             json.loads(r.read())
@@ -45,7 +46,8 @@ def test_ui_references_all_views(agent):
     with urllib.request.urlopen(agent.http_addr + "/ui", timeout=10) as r:
         body = r.read().decode()
     for view in ("jobs", "deployments", "nodes", "topology", "services",
-                 "events", "alloc", "tailLogs", "runExec", "depAction"):
+                 "events", "evals", "alloc", "tailLogs", "runExec",
+                 "depAction", "Versions"):
         assert view in body, f"UI missing view/function {view}"
     # topology utilization meters + ACL token plumbing
     for frag in ("NodeResources", "X-Nomad-Token", "tokenbox",
