@@ -74,6 +74,12 @@ struct Rotator {
         if (fd < 0) return false;
       }
       long long room = max_bytes - written;
+      if (room <= 0) {
+        // rotate() failed to free the live file (rename target blocked,
+        // permissions changed): keep draining stdin anyway — an
+        // over-cap file beats a wedged task blocked on a full pipe
+        room = max_bytes;
+      }
       ssize_t chunk = n < room ? n : static_cast<ssize_t>(room);
       ssize_t w = ::write(fd, buf, static_cast<size_t>(chunk));
       if (w < 0) {

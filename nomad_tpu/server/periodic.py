@@ -31,9 +31,11 @@ def parse_cron_field(field: str, lo: int, hi: int) -> set[int]:
     return out
 
 
-def cron_next(spec: str, after: float) -> Optional[float]:
+def cron_next(spec: str, after: float, tz: str = "UTC") -> Optional[float]:
     """Next fire time strictly after `after` for a 5-field cron spec, or
-    '@every <seconds>s' shorthand. UTC."""
+    '@every <seconds>s' shorthand. The cron fields are interpreted in
+    `tz` (ref structs.PeriodicConfig.TimeZone + GetLocation:
+    "3 am every day" means 3 am IN THAT ZONE, across DST shifts)."""
     spec = spec.strip()
     if spec.startswith("@every"):
         arg = spec.split(None, 1)[1].strip()
@@ -57,7 +59,14 @@ def cron_next(spec: str, after: float) -> Optional[float]:
     months = parse_cron_field(fields[3], 1, 12)
     # cron DOW: Sun=0 (and 7 as the common Sunday alias)
     dows = {v % 7 for v in parse_cron_field(fields[4], 0, 7)}
-    t = datetime.fromtimestamp(after, tz=timezone.utc).replace(
+    zone = timezone.utc
+    if tz and tz.upper() != "UTC":
+        try:
+            from zoneinfo import ZoneInfo
+            zone = ZoneInfo(tz)
+        except Exception:   # noqa: BLE001 — unknown zone: fall back UTC
+            pass
+    t = datetime.fromtimestamp(after, tz=zone).replace(
         second=0, microsecond=0) + timedelta(minutes=1)
     for _ in range(366 * 24 * 60):   # bounded search: one year of minutes
         cron_dow = (t.weekday() + 1) % 7   # Python Mon=0 -> cron Sun=0
@@ -126,13 +135,14 @@ class PeriodicDispatch:
         state = self.server.state
         launch = state.periodic_launch_by_id(job.namespace, job.id)
         last = launch["launch"] if launch else 0.0
-        nxt = cron_next(job.periodic.spec, last or now - 1.0)
+        tz = job.periodic.timezone or "UTC"
+        nxt = cron_next(job.periodic.spec, last or now - 1.0, tz)
         if nxt is None or nxt > now:
             return
         # fast-forward past windows missed while down: launch at most once,
         # at the latest elapsed boundary (ref periodic.go nextLaunch)
         while True:
-            after = cron_next(job.periodic.spec, nxt)
+            after = cron_next(job.periodic.spec, nxt, tz)
             if after is None or after > now:
                 break
             nxt = after

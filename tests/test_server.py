@@ -360,3 +360,23 @@ def test_failed_eval_reaped_by_leader():
         assert follow and follow[0].triggered_by == "failed-follow-up"
     finally:
         s.shutdown()
+
+
+def test_cron_timezone():
+    """PeriodicConfig.time_zone: '0 3 * * *' means 3 am IN the zone (ref
+    structs.go PeriodicConfig.GetLocation)."""
+    import datetime
+
+    from nomad_tpu.server.periodic import cron_next
+    # 2026-01-15 00:00 UTC; next 03:00 New York == 08:00 UTC (EST)
+    after = datetime.datetime(2026, 1, 15, tzinfo=datetime.timezone.utc)
+    nxt = cron_next("0 3 * * *", after.timestamp(), "America/New_York")
+    fired = datetime.datetime.fromtimestamp(nxt, tz=datetime.timezone.utc)
+    assert (fired.hour, fired.minute) == (8, 0)
+    # same spec in UTC fires at 03:00 UTC
+    nxt_utc = cron_next("0 3 * * *", after.timestamp(), "UTC")
+    fired_utc = datetime.datetime.fromtimestamp(
+        nxt_utc, tz=datetime.timezone.utc)
+    assert (fired_utc.hour, fired_utc.minute) == (3, 0)
+    # unknown zone falls back to UTC instead of failing the dispatcher
+    assert cron_next("0 3 * * *", after.timestamp(), "Not/AZone") == nxt_utc
