@@ -11,7 +11,8 @@ from typing import Callable, Optional
 from ..structs import (
     Allocation, Deployment, DeploymentState, DeploymentStatusUpdate,
     DesiredUpdates, Evaluation, Job, Node, TaskGroup, new_deployment,
-    ALLOC_CLIENT_LOST, DESC_CANARY, DESC_MIGRATING, DESC_NOT_NEEDED,
+    ALLOC_CLIENT_LOST, ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_UNKNOWN,
+    DESC_CANARY, DESC_MIGRATING, DESC_NOT_NEEDED,
     DESC_RESCHEDULED, DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_PAUSED,
     DEPLOYMENT_STATUS_PENDING, DEPLOYMENT_STATUS_RUNNING,
     DEPLOYMENT_STATUS_SUCCESSFUL, DEPLOYMENT_STATUS_CANCELLED,
@@ -22,10 +23,14 @@ from .reconcile_util import (
     AllocNameIndex, AllocSet, DelayedRescheduleInfo, alloc_matrix, difference,
     delay_by_stop_after_client_disconnect, filter_by_deployment,
     filter_by_rescheduleable, filter_by_tainted, filter_by_terminal, from_keys,
-    name_order, name_set, union,
+    name_order, name_set, split_disconnecting, split_reconnecting, union,
 )
 
 DESC_DEPLOYMENT_CANCELLED = "cancelled because job is stopped or newer version"
+DESC_UNKNOWN = "alloc is unknown since its node is disconnected"
+DESC_RECONNECTED = "replacement stopped: original alloc reconnected"
+DESC_RECONNECT_EXPIRED = "alloc reconnected after max_client_disconnect"
+DESC_RECONNECT_OK = "alloc reconnected within max_client_disconnect"
 
 
 @dataclasses.dataclass(slots=True)
@@ -222,6 +227,18 @@ class AllocReconciler:
         canaries, all_allocs = self._handle_group_canaries(all_allocs, desired)
 
         untainted, migrate, lost = filter_by_tainted(all_allocs, self.tainted)
+
+        # graceful client disconnection (ref 1.3 reconcile_util.go
+        # disconnecting/reconnecting + reconcile.go reconcileReconnecting):
+        # with max_client_disconnect, a running alloc on a down node rides
+        # out the window as `unknown` (replacement placed alongside);
+        # if the client returns inside the window the original wins and
+        # the replacement stops.
+        disconnecting, lost = split_disconnecting(tg, lost, self.now)
+        reconnecting, untainted = split_reconnecting(untainted)
+        self._handle_disconnecting(tg, group, disconnecting)
+        untainted = self._handle_reconnecting(tg, group, reconnecting,
+                                              untainted)
 
         untainted, reschedule_now, reschedule_later = filter_by_rescheduleable(
             untainted, self.batch, self.now, self.eval_id, self.deployment)
@@ -565,11 +582,95 @@ class AllocReconciler:
         self._create_followup_evals(infos, tg_name, mark_followup=True)
 
     def _create_timeout_later_evals(self, infos: list[DelayedRescheduleInfo],
-                                    tg_name: str) -> dict[str, str]:
-        return self._create_followup_evals(infos, tg_name, mark_followup=False)
+                                    tg_name: str,
+                                    trigger: str = TRIGGER_FAILED_FOLLOW_UP
+                                    ) -> dict[str, str]:
+        return self._create_followup_evals(infos, tg_name,
+                                           mark_followup=False,
+                                           trigger=trigger)
+
+    # ------------------------------------ graceful client disconnection
+
+    def _handle_disconnecting(self, tg, group: str,
+                              disconnecting: dict) -> None:
+        """Mark newly-disconnected allocs `unknown` (plan attribute
+        update stamping disconnected_at) and schedule the expiry eval
+        that turns them lost if the client never returns (ref 1.3
+        reconcile.go appendUnknownUpdates + createTimeoutLaterEvals)."""
+        if not disconnecting:
+            return
+        window = tg.max_client_disconnect_sec or 0.0
+        infos = []
+        for aid, alloc in disconnecting.items():
+            since = alloc.disconnected_at
+            if alloc.client_status != ALLOC_CLIENT_UNKNOWN or not since:
+                updated = alloc.copy()
+                updated.client_status = ALLOC_CLIENT_UNKNOWN
+                updated.client_description = DESC_UNKNOWN
+                updated.disconnected_at = since = self.now
+                self.result.attribute_updates[aid] = updated
+                # expiry eval only on the FIRST (marking) pass —
+                # re-evals during the window would pile up duplicates
+                infos.append(DelayedRescheduleInfo(
+                    alloc_id=aid, alloc=alloc,
+                    reschedule_time=since + window))
+        self._create_timeout_later_evals(infos, group,
+                                         trigger=TRIGGER_MAX_DISCONNECT)
+        desired = self.result.desired_tg_updates.setdefault(
+            group, DesiredUpdates())
+        desired.ignore += len(disconnecting)
+
+    def _handle_reconnecting(self, tg, group: str, reconnecting: dict,
+                             untainted: dict) -> dict:
+        """The client returned: inside the window the ORIGINAL alloc
+        wins its name slot back and any replacement stops; PAST the
+        window the original is expired — it stops and the replacement
+        keeps the slot (ref 1.3 reconcile.go reconcileReconnecting,
+        which stops Expired originals rather than churning the workload
+        back onto a flapping node)."""
+        if not reconnecting:
+            return untainted
+        desired = self.result.desired_tg_updates.setdefault(
+            group, DesiredUpdates())
+        window = tg.max_client_disconnect_sec or 0.0
+        fresh: dict = {}
+        for aid, alloc in reconnecting.items():
+            since = alloc.disconnected_at
+            if since and self.now >= since + window:
+                # reconnected too late: the replacement won
+                self.result.stop.append(AllocStopResult(
+                    alloc=alloc, client_status=ALLOC_CLIENT_LOST,
+                    status_description=DESC_RECONNECT_EXPIRED))
+                desired.stop += 1
+            else:
+                fresh[aid] = alloc
+        originals_by_name = {a.name: aid for aid, a in fresh.items()}
+        for aid, alloc in list(untainted.items()):
+            orig = originals_by_name.get(alloc.name)
+            if orig is None or aid == orig:
+                continue
+            # a replacement placed during the disconnect: stop it
+            self.result.stop.append(AllocStopResult(
+                alloc=alloc, client_status="",
+                status_description=DESC_RECONNECTED))
+            desired.stop += 1
+            del untainted[aid]
+        for aid, alloc in fresh.items():
+            # flip back to running: the client's change-driven sync won't
+            # re-push an unchanged status, and the alloc was running when
+            # it went unknown (a task that actually died surfaces as a
+            # NEW failed update, which does sync)
+            updated = alloc.copy()
+            updated.client_status = ALLOC_CLIENT_RUNNING
+            updated.client_description = DESC_RECONNECT_OK
+            updated.disconnected_at = 0.0
+            self.result.attribute_updates[aid] = updated
+            untainted[aid] = updated
+        return untainted
 
     def _create_followup_evals(self, infos: list[DelayedRescheduleInfo],
-                               tg_name: str, mark_followup: bool
+                               tg_name: str, mark_followup: bool,
+                               trigger: str = TRIGGER_FAILED_FOLLOW_UP
                                ) -> dict[str, str]:
         if not infos:
             return {}
@@ -586,7 +687,7 @@ class AllocReconciler:
                     namespace=self.job.namespace if self.job else "default",
                     priority=self.eval_priority,
                     type=self.job.type if self.job else "service",
-                    triggered_by=TRIGGER_FAILED_FOLLOW_UP,
+                    triggered_by=trigger,
                     job_id=self.job_id,
                     status=EVAL_STATUS_PENDING,
                     wait_until_unix=info.reschedule_time)

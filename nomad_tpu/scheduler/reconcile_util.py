@@ -8,6 +8,7 @@ from typing import Optional
 from ..structs import (
     Allocation, Deployment, Job, Node, TaskGroup,
     ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
+    ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_UNKNOWN,
     ALLOC_DESIRED_EVICT, ALLOC_DESIRED_STOP, alloc_name, alloc_name_index,
 )
 
@@ -85,6 +86,46 @@ def filter_by_tainted(a: AllocSet, tainted: dict[str, Optional[Node]]
             continue
         untainted[aid] = alloc
     return untainted, migrate, lost
+
+
+def split_disconnecting(tg, lost: AllocSet, now: float
+                        ) -> tuple[AllocSet, AllocSet]:
+    """(disconnecting, still_lost) — graceful client disconnection (ref
+    1.3 reconcile_util.go filterByTainted 'disconnecting' + Allocation.
+    Expired): with max_client_disconnect set, a running alloc on a down
+    node rides out the window as `unknown` instead of being lost."""
+    window = getattr(tg, "max_client_disconnect_sec", None)
+    if not window:
+        return {}, lost
+    disconnecting: AllocSet = {}
+    still_lost: AllocSet = {}
+    for aid, alloc in lost.items():
+        if alloc.client_status not in (ALLOC_CLIENT_RUNNING,
+                                       ALLOC_CLIENT_PENDING,
+                                       ALLOC_CLIENT_UNKNOWN):
+            still_lost[aid] = alloc
+            continue
+        since = alloc.disconnected_at or now
+        if now < since + window:
+            disconnecting[aid] = alloc
+        else:
+            still_lost[aid] = alloc          # window expired -> lost
+    return disconnecting, still_lost
+
+
+def split_reconnecting(untainted: AllocSet) -> tuple[AllocSet, AllocSet]:
+    """(reconnecting, rest) — allocs still marked `unknown` whose node is
+    no longer tainted: the client came back inside the window (ref 1.3
+    reconcile.go reconcileReconnecting)."""
+    reconnecting: AllocSet = {}
+    rest: AllocSet = {}
+    for aid, alloc in untainted.items():
+        if alloc.client_status == ALLOC_CLIENT_UNKNOWN and \
+                not alloc.server_terminal_status():
+            reconnecting[aid] = alloc
+        else:
+            rest[aid] = alloc
+    return reconnecting, rest
 
 
 def should_filter(alloc: Allocation, is_batch: bool) -> tuple[bool, bool]:

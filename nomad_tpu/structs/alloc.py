@@ -189,6 +189,11 @@ class Allocation:
     deployment_status: Optional[AllocDeploymentStatus] = None
     reschedule_tracker: Optional[RescheduleTracker] = None
     follow_up_eval_id: str = ""
+    # graceful client disconnection (ref 1.3 structs.Allocation
+    # AllocStates / Expired): when the reconciler marks this alloc
+    # `unknown` it stamps the disconnect time; expiry is measured
+    # against the group's max_client_disconnect window
+    disconnected_at: float = 0.0
     preempted_by_allocation: str = ""
     preempted_allocations: list[str] = field(default_factory=list)
 

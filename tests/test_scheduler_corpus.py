@@ -937,3 +937,170 @@ def test_alloc_name_indexes_reused_on_scale_cycle():
     process(h, job3)
     names = sorted(a.name for a in live(allocs_of(h, job3)))
     assert names == [f"{job.id}.web[{i}]" for i in range(5)]
+
+
+# ------------------------------------ graceful client disconnection (1.3)
+
+def _disc_job(window=60.0):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.max_client_disconnect_sec = window
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    return job
+
+
+def _run_all_running(h, job):
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+
+
+def test_disconnect_marks_unknown_and_places_replacements():
+    """max_client_disconnect: a down node's running allocs go `unknown`
+    (not lost), replacements are placed alongside, and an expiry eval is
+    scheduled (ref 1.3 reconcile_util.go disconnecting)."""
+    h = Harness()
+    nodes = seed_nodes(h, 4)
+    job = _disc_job()
+    _run_all_running(h, job)
+    victim_node = allocs_of(h, job)[0].node_id
+    down = h.state.node_by_id(victim_node).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    unknown = [a for a in allocs
+               if a.client_status == "unknown"]
+    on_victim = [a for a in allocs if a.node_id == victim_node]
+    assert unknown and all(a.node_id == victim_node for a in unknown)
+    assert all(a.desired_status == ALLOC_DESIRED_RUN for a in unknown)
+    assert all(a.disconnected_at > 0 for a in unknown)
+    # replacements placed on healthy nodes, same name slots
+    live_elsewhere = [a for a in live(allocs)
+                      if a.node_id != victim_node]
+    assert len(live_elsewhere) == 2
+    # expiry follow-up eval scheduled at disconnect + window
+    followups = [e for e in h.created_evals if e.wait_until_unix > 0]
+    assert followups and \
+        followups[-1].wait_until_unix <= time.time() + 61
+
+
+def test_disconnect_expiry_turns_unknown_lost():
+    """Past the window the unknown allocs become lost; the replacements
+    already cover the count (ref 1.3 Allocation.Expired)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = _disc_job(window=0.05)
+    _run_all_running(h, job)
+    victim_node = allocs_of(h, job)[0].node_id
+    n_victim = len([a for a in allocs_of(h, job)
+                    if a.node_id == victim_node])
+    down = h.state.node_by_id(victim_node).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    time.sleep(0.1)                       # window expires
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    expired = [a for a in allocs if a.node_id == victim_node
+               and a.desired_status == ALLOC_DESIRED_STOP]
+    assert len(expired) == n_victim, "unknown allocs not reaped at expiry"
+    assert len(live(allocs)) == 2          # replacements cover the count
+
+
+def test_reconnect_keeps_original_stops_replacement():
+    """The client returns inside the window: the original alloc keeps
+    its slot, the replacement stops (ref 1.3 reconcileReconnecting)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = _disc_job()
+    _run_all_running(h, job)
+    victim_node = allocs_of(h, job)[0].node_id
+    originals = {a.id for a in allocs_of(h, job)
+                 if a.node_id == victim_node}
+    down = h.state.node_by_id(victim_node).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+
+    # node comes back inside the window
+    up = h.state.node_by_id(victim_node).copy()
+    up.status = "ready"
+    h.state.upsert_node(h.get_next_index(), up)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+
+    allocs = allocs_of(h, job)
+    kept = [a for a in live(allocs) if a.id in originals]
+    stopped_repl = [a for a in allocs if a.id not in originals
+                    and a.desired_status == ALLOC_DESIRED_STOP
+                    and a.node_id != victim_node]
+    assert len(kept) == len(originals), "original allocs were not kept"
+    assert stopped_repl, "replacement was not stopped on reconnect"
+    for a in kept:
+        assert a.disconnected_at == 0.0    # stamp cleared
+    assert len(live(allocs)) == 2
+
+
+def test_reconnect_flips_status_back_to_running():
+    """Reconnected originals return to client running via the plan's
+    attribute update (the client's change-driven sync won't re-push an
+    unchanged status)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = _disc_job()
+    _run_all_running(h, job)
+    victim_node = allocs_of(h, job)[0].node_id
+    originals = {a.id for a in allocs_of(h, job)
+                 if a.node_id == victim_node}
+    down = h.state.node_by_id(victim_node).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    up = h.state.node_by_id(victim_node).copy()
+    up.status = "ready"
+    h.state.upsert_node(h.get_next_index(), up)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    for a in allocs_of(h, job):
+        if a.id in originals:
+            assert a.client_status == ALLOC_CLIENT_RUNNING
+    # further evals are quiescent: no new attribute updates pile up
+    before_idx = h.state.latest_index()
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    assert all(a.client_status != "unknown" for a in allocs)
+
+
+def test_reconnect_after_expiry_keeps_replacement():
+    """A node returning AFTER the window loses: its original allocs stop
+    and the replacements keep the slots (ref 1.3 reconcileReconnecting
+    stops Expired originals)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = _disc_job(window=0.05)
+    _run_all_running(h, job)
+    victim_node = allocs_of(h, job)[0].node_id
+    originals = {a.id for a in allocs_of(h, job)
+                 if a.node_id == victim_node}
+    down = h.state.node_by_id(victim_node).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    time.sleep(0.1)                        # window expires while down
+    up = h.state.node_by_id(victim_node).copy()
+    up.status = "ready"
+    h.state.upsert_node(h.get_next_index(), up)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    for a in allocs:
+        if a.id in originals:
+            assert a.desired_status == ALLOC_DESIRED_STOP, \
+                "expired original must not reclaim its slot"
+    assert len(live(allocs)) == 2
+    assert all(a.node_id != victim_node or a.id not in originals
+               for a in live(allocs))
