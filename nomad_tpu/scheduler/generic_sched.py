@@ -180,7 +180,8 @@ class GenericScheduler:
         allocs = self.state.allocs_by_job(eval.namespace, eval.job_id)
         tainted = tainted_nodes(self.state, allocs)
 
-        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs,
+                                           job=self.job)
 
         update_fn = generic_alloc_update_fn(self.ctx, eval, self.job)
         reconciler = AllocReconciler(

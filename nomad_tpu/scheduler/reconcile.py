@@ -89,7 +89,7 @@ class AllocReconciler:
                  job: Optional[Job], deployment: Optional[Deployment],
                  existing_allocs: list[Allocation],
                  tainted_nodes: dict[str, Optional[Node]], eval_id: str,
-                 eval_priority: int, now: float, supports_disconnected=False):
+                 eval_priority: int, now: float):
         self.alloc_update_fn = alloc_update_fn
         self.batch = batch
         self.job_id = job_id
@@ -647,8 +647,8 @@ class AllocReconciler:
         originals_by_name = {a.name: aid for aid, a in fresh.items()}
         for aid, alloc in list(untainted.items()):
             orig = originals_by_name.get(alloc.name)
-            if orig is None or aid == orig:
-                continue
+            if orig is None or aid == orig or alloc.terminal_status():
+                continue       # terminal same-name allocs need no stop
             # a replacement placed during the disconnect: stop it
             self.result.stop.append(AllocStopResult(
                 alloc=alloc, client_status="",

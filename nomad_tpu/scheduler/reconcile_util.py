@@ -8,7 +8,7 @@ from typing import Optional
 from ..structs import (
     Allocation, Deployment, Job, Node, TaskGroup,
     ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST,
-    ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_UNKNOWN,
+    ALLOC_CLIENT_RUNNING, ALLOC_CLIENT_UNKNOWN,
     ALLOC_DESIRED_EVICT, ALLOC_DESIRED_STOP, alloc_name, alloc_name_index,
 )
 
@@ -100,8 +100,10 @@ def split_disconnecting(tg, lost: AllocSet, now: float
     disconnecting: AllocSet = {}
     still_lost: AllocSet = {}
     for aid, alloc in lost.items():
+        # only RUNNING work rides out the window: a pending alloc (tasks
+        # never started) reschedules normally, and restoring it to
+        # "running" on reconnect would misstate its health
         if alloc.client_status not in (ALLOC_CLIENT_RUNNING,
-                                       ALLOC_CLIENT_PENDING,
                                        ALLOC_CLIENT_UNKNOWN):
             still_lost[aid] = alloc
             continue

@@ -97,7 +97,13 @@ class SystemScheduler:
                 if node is None or node.terminal_status():
                     self.plan.append_stopped_alloc(
                         a, DESC_NODE_TAINTED, client_status=ALLOC_CLIENT_LOST)
-                else:
+                elif a.desired_transition.should_migrate():
+                    # draining or ineligible but alive: only the drainer's
+                    # desired_transition stops system allocs, so
+                    # ignore_system_jobs is honored and toggling node
+                    # eligibility doesn't kill system workloads (ref
+                    # scheduler_system.go diffSystemAllocs defers every
+                    # non-terminal tainted node to ShouldMigrate)
                     self.plan.append_stopped_alloc(a, DESC_NODE_TAINTED)
                 continue
             if node_id not in node_ids:

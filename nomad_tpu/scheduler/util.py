@@ -7,7 +7,8 @@ from typing import Optional
 
 from ..structs import (
     Allocation, AllocatedResources, AllocatedTaskResources, Job, Node,
-    TaskGroup, ALLOC_CLIENT_LOST, ALLOC_DESIRED_STOP, DESC_NODE_TAINTED,
+    TaskGroup, ALLOC_CLIENT_LOST, ALLOC_CLIENT_RUNNING,
+    ALLOC_CLIENT_UNKNOWN, ALLOC_DESIRED_STOP, DESC_NODE_TAINTED,
 )
 
 
@@ -162,9 +163,18 @@ def generic_alloc_update_fn(ctx, eval_obj, job: Job):
 
 
 def update_non_terminal_allocs_to_lost(plan, tainted: dict[str, Optional[Node]],
-                                       allocs: list[Allocation]) -> None:
+                                       allocs: list[Allocation],
+                                       job=None, now: float = 0.0) -> None:
     """Mark non-terminal allocs on down nodes as lost in the plan
-    (ref generic_sched.go:350 updateNonTerminalAllocsToLost via util)."""
+    (ref generic_sched.go:350 updateNonTerminalAllocsToLost via util).
+
+    Disconnect-eligible allocs (group sets max_client_disconnect and the
+    window hasn't expired) are skipped — the reconciler rides them out
+    as `unknown` instead; stopping them here would race the attribute
+    update in the same plan (ref Nomad gates this on
+    supportsDisconnectedClients)."""
+    import time as _time
+    now = now or _time.time()
     for alloc in allocs:
         node = tainted.get(alloc.node_id, "absent")
         if node == "absent":
@@ -173,5 +183,13 @@ def update_non_terminal_allocs_to_lost(plan, tainted: dict[str, Optional[Node]],
             continue  # only down/GC'd nodes strand allocs as lost
         if alloc.terminal_status():
             continue
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        window = getattr(tg, "max_client_disconnect_sec", None) if tg \
+            else None
+        if window and alloc.client_status in (ALLOC_CLIENT_RUNNING,
+                                              ALLOC_CLIENT_UNKNOWN):
+            since = alloc.disconnected_at or now
+            if now < since + window:
+                continue          # the reconciler handles the window
         plan.append_stopped_alloc(alloc, DESC_NODE_TAINTED,
                                   client_status=ALLOC_CLIENT_LOST)

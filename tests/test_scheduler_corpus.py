@@ -1069,11 +1069,16 @@ def test_reconnect_flips_status_back_to_running():
     for a in allocs_of(h, job):
         if a.id in originals:
             assert a.client_status == ALLOC_CLIENT_RUNNING
-    # further evals are quiescent: no new attribute updates pile up
-    before_idx = h.state.latest_index()
+    # further evals are quiescent: reconnected allocs are not rewritten
+    # by redundant attribute updates on every pass
+    before_mods = {a.id: a.modify_index for a in allocs_of(h, job)}
     process(h, job, trigger=TRIGGER_NODE_UPDATE)
     allocs = allocs_of(h, job)
     assert all(a.client_status != "unknown" for a in allocs)
+    for a in allocs:
+        if a.id in originals:
+            assert a.modify_index == before_mods[a.id], \
+                "reconnected alloc rewritten on a quiescent eval"
 
 
 def test_reconnect_after_expiry_keeps_replacement():
@@ -1104,3 +1109,185 @@ def test_reconnect_after_expiry_keeps_replacement():
     assert len(live(allocs)) == 2
     assert all(a.node_id != victim_node or a.id not in originals
                for a in live(allocs))
+
+
+# ------------------------------------------------ additional translations
+
+def test_system_job_respects_constraints_per_node():
+    """ref scheduler_system_test.go: a system job places only on nodes
+    matching its constraint, one alloc per eligible node."""
+    h = Harness()
+    def classify(node, i):
+        node.attributes["flavor"] = "big" if i % 2 == 0 else "small"
+        node.compute_class()
+    seed_nodes(h, 6, classify)
+    job = mock.system_job() if hasattr(mock, "system_job") else None
+    if job is None:
+        job = mock.job()
+        job.type = "system"
+        job.task_groups[0].count = 0
+    job.constraints = list(job.constraints) + [Constraint(
+        ltarget="${attr.flavor}", rtarget="big", operand=OP_EQ)]
+    tg = job.task_groups[0]
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 3
+    for a in allocs:
+        assert h.state.node_by_id(a.node_id).attributes["flavor"] == "big"
+
+
+def test_drain_ignore_system_jobs_leaves_system_allocs():
+    """ref drainer: ignore_system_jobs drains service allocs but leaves
+    system-job allocs running on the node."""
+    from nomad_tpu.server import Server
+    s = Server(num_workers=1, gc_interval=9999)
+    s.start()
+    try:
+        nodes = [mock.node() for _ in range(2)]
+        for n in nodes:
+            s.node_register(n)
+        sysjob = mock.job()
+        sysjob.id = sysjob.name = "sysj"
+        sysjob.type = "system"
+        sysjob.task_groups[0].count = 0
+        sysjob.task_groups[0].networks = []
+        sysjob.task_groups[0].tasks[0].resources.networks = []
+        svcjob = mock.job()
+        svcjob.id = svcjob.name = "svcj"
+        svcjob.task_groups[0].count = 2
+        svcjob.task_groups[0].networks = []
+        svcjob.task_groups[0].tasks[0].resources.networks = []
+        s.job_register(sysjob)
+        s.job_register(svcjob)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(s.state.allocs_by_job("default", "sysj")) >= 2 and \
+               len(s.state.allocs_by_job("default", "svcj")) >= 2:
+                break
+            time.sleep(0.1)
+        victim = nodes[0].id
+        s.node_update_drain(victim, DrainStrategy(
+            deadline_sec=60, ignore_system_jobs=True))
+        deadline = time.time() + 10
+        drained = False
+        while time.time() < deadline:
+            svc_on_victim = [
+                a for a in s.state.allocs_by_job("default", "svcj")
+                if a.node_id == victim and a.desired_status == "run"]
+            if not svc_on_victim:
+                drained = True
+                break
+            time.sleep(0.1)
+        assert drained, "service allocs not drained"
+        sys_on_victim = [
+            a for a in s.state.allocs_by_job("default", "sysj")
+            if a.node_id == victim and a.desired_status == "run"]
+        assert sys_on_victim, "system alloc should survive ignore_system"
+    finally:
+        s.shutdown()
+
+
+def test_affinity_weight_negative_avoids_nodes():
+    """Negative-weight affinities push placements AWAY from matching
+    nodes (ref scheduler/rank.go NodeAffinityIterator negative weights)."""
+    from nomad_tpu.structs import Affinity
+    h = Harness()
+    def classify(node, i):
+        node.attributes["zone"] = "hot" if i < 3 else "cold"
+        node.compute_class()
+    seed_nodes(h, 8, classify)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    job.affinities = [Affinity(ltarget="${attr.zone}", rtarget="hot",
+                               operand=OP_EQ, weight=-80)]
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 4
+    hot = [a for a in allocs
+           if h.state.node_by_id(a.node_id).attributes["zone"] == "hot"]
+    assert len(hot) == 0, "negative affinity ignored"
+
+
+def test_dispatch_payload_reaches_task_meta():
+    """Parameterized dispatch: meta merges into the child job and the
+    payload is carried (ref job_endpoint.go Dispatch + dispatch hook)."""
+    from nomad_tpu.server import Server
+    s = Server(num_workers=0, gc_interval=9999)
+    s.start()
+    try:
+        job = mock.job()
+        job.id = job.name = "paramd"
+        from nomad_tpu.structs import ParameterizedJobConfig
+        job.parameterized = ParameterizedJobConfig(
+            payload="optional", meta_required=["env"],
+            meta_optional=["extra"])
+        s.job_register(job)
+        out = s.job_dispatch("default", "paramd", payload=b"hello-payload",
+                             meta={"env": "prod"})
+        child = s.state.job_by_id("default", out["dispatched_job_id"])
+        assert child is not None
+        assert child.meta.get("env") == "prod"
+        assert child.parent_id == "paramd"
+        # required meta enforced
+        try:
+            s.job_dispatch("default", "paramd", meta={})
+            assert False, "missing required meta accepted"
+        except ValueError:
+            pass
+    finally:
+        s.shutdown()
+
+
+def test_spread_with_missing_target_attr_nodes_excluded():
+    """Nodes missing the spread attribute score worst and are used only
+    as a last resort (ref spread.go: missing property penalized)."""
+    from nomad_tpu.structs import Spread, SpreadTarget
+    h = Harness()
+    def classify(node, i):
+        if i < 6:
+            node.meta["rack"] = f"r{i % 2}"
+        # nodes 6,7: no rack attribute at all
+        node.compute_class()
+    seed_nodes(h, 8, classify)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 6
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    job.spreads = [Spread(attribute="${meta.rack}", weight=100)]
+    register(h, job)
+    process(h, job)
+    allocs = allocs_of(h, job)
+    assert len(allocs) == 6
+    rackless = [a for a in allocs
+                if "rack" not in h.state.node_by_id(a.node_id).meta]
+    assert len(rackless) == 0, "spread placed on attribute-less nodes"
+
+
+def test_batch_job_ignores_completed_on_rerun():
+    """Re-evaluating a finished batch job must not re-place completed
+    allocs (ref generic_sched_test.go TestBatchSched_Run_CompleteAllocs)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 3
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_COMPLETE
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+    before = {a.id for a in allocs_of(h, job)}
+    process(h, job)
+    after = {a.id for a in allocs_of(h, job)}
+    assert before == after, "completed batch allocs were replaced"
