@@ -721,6 +721,8 @@ def cmd_operator_raft(args) -> None:
 
 def cmd_operator_snapshot(args) -> None:
     """ref command/operator_snapshot_save.go / _restore.go"""
+    if args.action == "inspect":
+        return cmd_operator_snapshot_inspect(args)
     from .api import Client
     sdk = Client(timeout=60)
     if args.action == "save":
@@ -733,6 +735,35 @@ def cmd_operator_snapshot(args) -> None:
             data = f.read()
         sdk.operator.snapshot_restore(data)
         print("==> Snapshot restored")
+
+
+def cmd_operator_snapshot_inspect(args) -> None:
+    """Offline snapshot summary — no server needed (ref
+    helper/raftutil + command/operator_snapshot_inspect.go). Decodes
+    through the restricted unpickler: snapshots are often handed around
+    in support bundles, and a crafted pickle must not execute code."""
+    from .rpc.codec import FrameError, decode
+    with open(args.file, "rb") as f:
+        try:
+            blob = decode(f.read())
+        except FrameError as e:
+            _die(f"not a nomad-tpu snapshot: {e}")
+    rows = []
+    for table in ("nodes", "jobs", "job_versions", "job_summaries",
+                  "evals", "allocs", "deployments", "periodic_launches",
+                  "namespaces", "acl_policies", "acl_tokens",
+                  "csi_volumes", "csi_plugins", "scaling_policies",
+                  "services"):
+        v = blob.get(table)
+        if v is not None:
+            rows.append([table, len(v)])
+    print(f"Index         = {blob.get('index', 0)}")
+    sc = blob.get("scheduler_config")
+    if sc is not None:
+        print(f"SchedulerAlg  = "
+              f"{getattr(sc, 'scheduler_algorithm', '')}")
+    print()
+    _table(rows, ["Table", "Count"])
 
 
 def cmd_operator_autopilot(args) -> None:
@@ -1198,7 +1229,7 @@ def build_parser() -> argparse.ArgumentParser:
     oraft.add_argument("-peer-address", dest="peer_address", default="")
     oraft.set_defaults(fn=cmd_operator_raft)
     osnap = osub.add_parser("snapshot")
-    osnap.add_argument("action", choices=["save", "restore"])
+    osnap.add_argument("action", choices=["save", "restore", "inspect"])
     osnap.add_argument("file")
     osnap.set_defaults(fn=cmd_operator_snapshot)
     oap = osub.add_parser("autopilot")

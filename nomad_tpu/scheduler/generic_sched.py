@@ -180,8 +180,9 @@ class GenericScheduler:
         allocs = self.state.allocs_by_job(eval.namespace, eval.job_id)
         tainted = tainted_nodes(self.state, allocs)
 
+        now = time.time()
         update_non_terminal_allocs_to_lost(self.plan, tainted, allocs,
-                                           job=self.job)
+                                           job=self.job, now=now)
 
         update_fn = generic_alloc_update_fn(self.ctx, eval, self.job)
         reconciler = AllocReconciler(
@@ -194,7 +195,7 @@ class GenericScheduler:
             tainted_nodes=tainted,
             eval_id=eval.id,
             eval_priority=eval.priority,
-            now=time.time())
+            now=now)
         with metrics.measure("nomad.scheduler.reconcile"):
             results = reconciler.compute()
         self.followup_evals = results.desired_followup_evals

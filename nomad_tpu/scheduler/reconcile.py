@@ -647,8 +647,13 @@ class AllocReconciler:
         originals_by_name = {a.name: aid for aid, a in fresh.items()}
         for aid, alloc in list(untainted.items()):
             orig = originals_by_name.get(alloc.name)
-            if orig is None or aid == orig or alloc.terminal_status():
-                continue       # terminal same-name allocs need no stop
+            if orig is None or aid == orig or \
+                    alloc.server_terminal_status():
+                # already desired-stop needs nothing; a client-FAILED
+                # replacement still needs the stop so it can't flow into
+                # reschedule_now beside the reconnected original (ref
+                # gates on ServerTerminalStatus)
+                continue
             # a replacement placed during the disconnect: stop it
             self.result.stop.append(AllocStopResult(
                 alloc=alloc, client_status="",

@@ -172,7 +172,9 @@ def update_non_terminal_allocs_to_lost(plan, tainted: dict[str, Optional[Node]],
     window hasn't expired) are skipped — the reconciler rides them out
     as `unknown` instead; stopping them here would race the attribute
     update in the same plan (ref Nomad gates this on
-    supportsDisconnectedClients)."""
+    supportsDisconnectedClients). `now` is the eval's clock — callers
+    pass the same timestamp the reconciler uses so both ends of the
+    disconnect window agree (0 falls back to wall clock)."""
     import time as _time
     now = now or _time.time()
     for alloc in allocs:

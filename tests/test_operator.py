@@ -146,3 +146,26 @@ def test_autopilot_http_routes():
         assert raft_cfg["Servers"]
     finally:
         a.shutdown()
+
+
+def test_snapshot_inspect_cli(tmp_path, capsys, monkeypatch):
+    """`operator snapshot inspect <file>` summarizes offline (ref
+    helper/raftutil + command/operator_snapshot_inspect.go)."""
+    from nomad_tpu import cli, mock
+    from nomad_tpu.server import Server
+    s = Server(num_workers=0, gc_interval=9999)
+    s.start()
+    try:
+        for _ in range(3):
+            s.state.upsert_node(s.state.latest_index() + 1, mock.node())
+        s.state.upsert_job(s.state.latest_index() + 1, mock.job())
+        snap = s.snapshot_save()
+    finally:
+        s.shutdown()
+    path = tmp_path / "state.snap"
+    path.write_bytes(snap)
+    cli.main(["operator", "snapshot", "inspect", str(path)])
+    out = capsys.readouterr().out
+    assert "Index" in out
+    assert "nodes" in out and "3" in out
+    assert "jobs" in out

@@ -1291,3 +1291,38 @@ def test_batch_job_ignores_completed_on_rerun():
     process(h, job)
     after = {a.id for a in allocs_of(h, job)}
     assert before == after, "completed batch allocs were replaced"
+
+
+def test_reconnect_with_failed_replacement_stops_it():
+    """A replacement that FAILED during the disconnect must still be
+    desired-stopped on reconnect so it can't reschedule beside the
+    reconnected original (ref gates on ServerTerminalStatus)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = _disc_job()
+    _run_all_running(h, job)
+    victim_node = allocs_of(h, job)[0].node_id
+    originals = {a.id for a in allocs_of(h, job)
+                 if a.node_id == victim_node}
+    down = h.state.node_by_id(victim_node).copy()
+    down.status = NODE_STATUS_DOWN
+    h.state.upsert_node(h.get_next_index(), down)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    # the replacement fails on its node
+    for a in allocs_of(h, job):
+        if a.id not in originals and a.desired_status == ALLOC_DESIRED_RUN \
+                and a.node_id != victim_node and a.name in {
+                    al.name for al in allocs_of(h, job)
+                    if al.id in originals}:
+            f = a.copy()
+            f.client_status = ALLOC_CLIENT_FAILED
+            h.state.upsert_allocs(h.get_next_index(), [f])
+    up = h.state.node_by_id(victim_node).copy()
+    up.status = "ready"
+    h.state.upsert_node(h.get_next_index(), up)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    # exactly `count` live allocs; the reconnected original holds its slot
+    assert len(live(allocs)) == 2
+    kept = [a for a in live(allocs) if a.id in originals]
+    assert kept, "reconnected original lost its slot to a reschedule"
