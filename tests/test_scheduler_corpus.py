@@ -1,9 +1,12 @@
 """Scheduler scenario corpus (VERDICT r2 next #3): translations of the
 key behaviors from scheduler/generic_sched_test.go (6,385 LoC) and
 scheduler/reconcile_test.go (5,021 LoC) — canaries (placement, gating,
-promotion, revert path), reschedule windows (now/delayed/exhausted),
-multi-TG jobs, drain + deployment interplay, update parallelism limits,
-lost-node handling, affinity/spread scoring, and preemption."""
+promotion, auto-promote, revert path), reschedule windows (now/delayed/
+exhausted/exponential), multi-TG jobs, drain + deployment interplay
+(ignore_system_jobs), update parallelism limits, lost-node handling,
+graceful client disconnection (max_client_disconnect mark/replace/
+reconnect/expiry), affinity/spread scoring, name-index reuse,
+parameterized dispatch, and preemption."""
 import time
 
 import pytest
