@@ -175,8 +175,8 @@ def cmd_job_run(args) -> None:
         ev = api("GET", f"/v1/evaluation/{eval_id}")
         if ev["Status"] in ("complete", "failed", "canceled"):
             print(f"==> Evaluation status: {ev['Status']}")
-            if ev.get("FailedTgAllocs"):
-                for tg, m in ev["FailedTgAllocs"].items():
+            if ev.get("FailedTGAllocs"):
+                for tg, m in ev["FailedTGAllocs"].items():
                     print(f"    group {tg!r}: placement failed "
                           f"(filtered {m.get('NodesFiltered', 0)}, "
                           f"exhausted {m.get('NodesExhausted', 0)})")
@@ -655,10 +655,37 @@ def cmd_alloc_logs(args) -> None:
 
 
 def cmd_eval_status(args) -> None:
+    """ref command/eval_status.go: summary + per-group placement
+    failure metrics + related allocations."""
     ev = api("GET", f"/v1/evaluation/{args.eval_id}")
-    for k in ("ID", "Type", "TriggeredBy", "JobID", "Status",
+    for k in ("ID", "Type", "TriggeredBy", "JobID", "Priority", "Status",
               "StatusDescription"):
         print(f"{k:<18}= {ev.get(k)}")
+    if ev.get("WaitUntilUnix"):
+        print(f"{'WaitUntil':<18}= {ev['WaitUntilUnix']}")
+    if ev.get("BlockedEval"):
+        # full id: eval lookups are exact-match, a truncated id can't be
+        # fed back into `eval status`
+        print(f"{'BlockedEval':<18}= {ev['BlockedEval']}")
+    failed = ev.get("FailedTGAllocs") or {}
+    for tg, m in failed.items():
+        print(f"\nTask Group {tg!r} (failed to place):")
+        print(f"  * Nodes evaluated: {m.get('NodesEvaluated', 0)}, "
+              f"filtered: {m.get('NodesFiltered', 0)}, "
+              f"exhausted: {m.get('NodesExhausted', 0)}")
+        for reason, n in (m.get("ConstraintFiltered") or {}).items():
+            print(f"  * Constraint {reason!r} filtered {n} node(s)")
+        for dim, n in (m.get("DimensionExhausted") or {}).items():
+            print(f"  * Resources exhausted on {n} node(s): {dim}")
+        for klass, n in (m.get("ClassExhausted") or {}).items():
+            print(f"  * Class {klass!r} exhausted on {n} node(s)")
+    allocs = api("GET", f"/v1/evaluation/{args.eval_id}/allocations")
+    if allocs:
+        print("\nAllocations")
+        _table([[a["ID"][:8], a["TaskGroup"],
+                 a["NodeName"] or a["NodeID"][:8],
+                 a["DesiredStatus"], a["ClientStatus"]] for a in allocs],
+               ["ID", "Group", "Node", "Desired", "Status"])
 
 
 def cmd_deployment(args) -> None:
