@@ -491,7 +491,8 @@ def config5() -> dict:
 def backend_compare() -> dict:
     """Time the greedy-fill backends (plain XLA vs pallas fused vs
     GSPMD-sharded when devices allow) at production node-axis size —
-    the evidence behind the placer's _greedy_backend thresholds."""
+    the evidence behind the selector thresholds (backend.PALLAS_MIN_NODES
+    / backend.SHARD_MIN_NODES in nomad_tpu/solver/backend.py)."""
     import jax
     import jax.numpy as jnp
     from nomad_tpu.solver import NUM_XR, fill_greedy_binpack

@@ -160,8 +160,7 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     density = F / j[None, :]                                     # [N, K]
     d_star = jnp.max(density, axis=1)                            # [N]
     k_star = (jnp.argmax(density, axis=1) + 1).astype(jnp.int32)
-    k_star = jnp.where(jnp.isfinite(d_star), k_star, 0)
-    d_star = jnp.where(jnp.isfinite(d_star), d_star, -jnp.inf)
+    # non-finite zeroing happens in _depth_order_take (shared with pallas)
 
     # Optimistic-concurrency decorrelation (SURVEY hard part 1): workers
     # planning from one stale snapshot must not all deep-fill the same
@@ -210,12 +209,27 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     # covers both regimes — a python branch here made the 50k headline
     # run recompile inside the measured region when the warmup job's
     # small m landed in the other branch.
+    k_cap = jnp.sum(fits, axis=1).astype(jnp.int32)              # max depth
+    return _depth_order_take(d_star, k_star, k_cap, count, order_jitter,
+                             jitter_scale, jitter_samples)
+
+
+def _depth_order_take(d_star: jnp.ndarray, k_star: jnp.ndarray,
+                      k_cap: jnp.ndarray, count: jnp.ndarray,
+                      order_jitter: Optional[jnp.ndarray],
+                      jitter_scale, jitter_samples) -> jnp.ndarray:
+    """Shared tail of the depth solver: Efraimidis-Spirakis ordering, depth
+    take, and leftover deepening over the per-node (density, depth, cap)
+    summaries. Both the XLA and the pallas [N, K]-curve producers feed this
+    (the pallas variant computes d_star/k_star/k_cap tile-wise in VMEM)."""
+    n = d_star.shape[0]
     js = jnp.asarray(jitter_samples, jnp.float32)
     det = js <= 0.0
     jcap = jnp.where(det, jnp.float32(2 ** 30),
                      jnp.ceil(js) + 1.0).astype(jnp.int32)
     k_star = jnp.minimum(k_star, jnp.maximum(jcap, 1))
     fin = jnp.isfinite(d_star)
+    k_star = jnp.where(fin, k_star, 0)
     rank = jnp.argsort(jnp.argsort(-d_star))        # 0 = best density
     n_fin = jnp.maximum(jnp.sum(fin), 1)
     # E-S order: max u^(1/w), w = (2(n-r)+1)^g. Computed in LOG space
@@ -239,7 +253,6 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     # feasible max, best density first (cap-bound asks where the density
     # argmax sits below node capacity)
     leftover = count - jnp.sum(placed)
-    k_cap = jnp.sum(fits, axis=1).astype(jnp.int32)              # max depth
     room = jnp.where(take > 0, k_cap[order] - take, 0)
     prior_r = jnp.cumsum(room) - room
     extra = jnp.clip(leftover - prior_r, 0, room)

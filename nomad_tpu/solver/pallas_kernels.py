@@ -121,3 +121,132 @@ def fill_greedy_binpack_fused(cap, used, ask, count, feasible,
     prior = jnp.cumsum(cap_sorted) - cap_sorted
     take_sorted = jnp.clip(count - prior, 0, cap_sorted)
     return jnp.zeros_like(capacity).at[order].set(take_sorted)
+
+
+# --------------------------------------------------------- depth solver
+#
+# The fill_depth [N, K] score-curve producer as a pallas pass. The XLA
+# path materializes used_j [N, K, R'] (80MB at the 16k-node/64-depth
+# headline), fits, two pow() temporaries and the cumsum input in HBM
+# between fusions; here each node tile computes its depth curve entirely
+# in VMEM — one HBM read of cap/used/aux, one [R8, N] write of
+# (d_star, k_star, k_cap). The K-axis prefix sum runs as a lower-
+# triangular [K, K] x [K, TILE] matmul on the MXU. The cheap [N]-vector
+# tail (E-S ordering + take) is shared with the XLA kernel
+# (kernels._depth_order_take).
+
+TILE_D = 128      # nodes per grid step for the depth kernel
+
+
+def _depth_curve_kernel(cap_ref, used_ref, ask_ref, aux_ref, scal_ref,
+                        out_ref, *, k_max: int, spread: bool):
+    """One node tile: out row 0 = d_star, row 1 = k_star, row 2 = k_cap."""
+    cap = cap_ref[:]                    # [R8, T]
+    used = used_ref[:]
+    feas = aux_ref[0:1, :] > 0.0        # [1, T]
+    coll = aux_ref[1:2, :]              # [1, T] job collisions (f32)
+    aff = aux_ref[2:3, :]               # [1, T] affinity boost
+    desired = scal_ref[0, 0]
+    max_per_node = scal_ref[1, 0]
+
+    # mosaic's tpu.iota is integer-only; build the depth axis as i32
+    j = (jax.lax.broadcasted_iota(jnp.int32, (k_max, TILE_D), 0) + 1
+         ).astype(jnp.float32)
+
+    # fits[k, t] = all resources r: used_r + j*ask_r <= cap_r  (static R loop
+    # keeps the [K, T, R] tensor out of memory entirely)
+    fits = feas & (j <= max_per_node)
+    for r in range(NUM_XR):
+        fits &= used[r:r + 1, :] + j * ask_ref[r, 0] <= cap[r:r + 1, :] + 1e-6
+
+    # binpack/spread base score at depth j (cpu row 0, mem row 1)
+    safe0 = jnp.where(cap[0:1, :] > 0.0, cap[0:1, :], 1.0)
+    safe1 = jnp.where(cap[1:2, :] > 0.0, cap[1:2, :], 1.0)
+    fp0 = 1.0 - (used[0:1, :] + j * ask_ref[0, 0]) / safe0
+    fp1 = 1.0 - (used[1:2, :] + j * ask_ref[1, 0]) / safe1
+    tot = jnp.power(10.0, fp0) + jnp.power(10.0, fp1)
+    raw = (tot - 2.0) if spread else (20.0 - tot)
+    base = jnp.clip(raw, 0.0, BINPACK_MAX_SCORE) / BINPACK_MAX_SCORE
+
+    coll_before = coll + (j - 1.0)
+    anti = -(coll_before + 1.0) / jnp.maximum(desired, 1.0)
+    anti_on = coll_before > 0.0
+    aff_on = aff != 0.0
+    s = (base + jnp.where(anti_on, anti, 0.0) +
+         jnp.where(aff_on, aff, 0.0)) / \
+        (1.0 + anti_on.astype(jnp.float32) + aff_on.astype(jnp.float32))
+
+    # prefix sum over the depth axis as a lower-triangular matmul (MXU)
+    ri = jax.lax.broadcasted_iota(jnp.int32, (k_max, k_max), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (k_max, k_max), 1)
+    tril = (ri >= ci).astype(jnp.float32)
+    F = jax.lax.dot(tril, jnp.where(fits, s, 0.0),
+                    precision=jax.lax.Precision.HIGHEST)
+    # mask AFTER the divide: -_BIG/j varies with j, which would make the
+    # argmax of an all-infeasible node land on k_max instead of depth 0
+    density = jnp.where(fits, F / j, -_BIG)
+
+    d_star = jnp.max(density, axis=0, keepdims=True)        # [1, T]
+    k_star = (jnp.argmax(density, axis=0).astype(jnp.float32)
+              .reshape(1, TILE_D) + 1.0)
+    k_cap = jnp.sum(fits.astype(jnp.float32), axis=0, keepdims=True)
+
+    out_ref[0:1, :] = d_star
+    out_ref[1:2, :] = k_star
+    out_ref[2:3, :] = k_cap
+    out_ref[3:, :] = jnp.zeros_like(cap[3:])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k_max", "spread_algorithm", "interpret"))
+def fill_depth_fused(cap, used, ask, count, feasible, job_collisions,
+                     desired_count, affinity_boost,
+                     max_per_node=2 ** 30, order_jitter=None,
+                     jitter_scale=0.5, jitter_samples=0.0,
+                     k_max: int = 128, spread_algorithm: bool = False,
+                     interpret=False):
+    """fill_depth with the pallas [N, K] curve producer — same signature and
+    semantics as kernels.fill_depth (the E-S order/take tail is literally
+    shared)."""
+    from jax.experimental import pallas as pl
+
+    from .kernels import _depth_order_take
+
+    n = cap.shape[0]
+    n_pad = -(-n // TILE_D) * TILE_D
+
+    def to_tiles(x):
+        return jnp.pad(x, ((0, n_pad - n), (0, R8 - NUM_XR))).T
+
+    aux = jnp.stack([
+        jnp.pad(feasible.astype(jnp.float32), (0, n_pad - n)),
+        jnp.pad(job_collisions.astype(jnp.float32), (0, n_pad - n)),
+        jnp.pad(affinity_boost.astype(jnp.float32), (0, n_pad - n)),
+    ] + [jnp.zeros((n_pad,), jnp.float32)] * (R8 - 3))
+    ask_col = jnp.pad(ask, (0, R8 - NUM_XR)).reshape(R8, 1)
+    mpn = jnp.minimum(jnp.asarray(max_per_node, jnp.float32),
+                      jnp.float32(2 ** 30))
+    scal = jnp.stack([jnp.asarray(desired_count, jnp.float32), mpn] +
+                     [jnp.float32(0)] * (R8 - 2)).reshape(R8, 1)
+
+    out = pl.pallas_call(
+        functools.partial(_depth_curve_kernel, k_max=k_max,
+                          spread=spread_algorithm),
+        out_shape=jax.ShapeDtypeStruct((R8, n_pad), jnp.float32),
+        grid=(n_pad // TILE_D,),
+        in_specs=[
+            pl.BlockSpec((R8, TILE_D), lambda i: (0, i)),
+            pl.BlockSpec((R8, TILE_D), lambda i: (0, i)),
+            pl.BlockSpec((R8, 1), lambda i: (0, 0)),
+            pl.BlockSpec((R8, TILE_D), lambda i: (0, i)),
+            pl.BlockSpec((R8, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((R8, TILE_D), lambda i: (0, i)),
+        interpret=interpret,
+    )(to_tiles(cap), to_tiles(used), ask_col, aux, scal)
+
+    d_star = jnp.where(out[0, :n] <= -_BIG / 2.0, -jnp.inf, out[0, :n])
+    k_star = out[1, :n].astype(jnp.int32)
+    k_cap = out[2, :n].astype(jnp.int32)
+    return _depth_order_take(d_star, k_star, k_cap, count, order_jitter,
+                             jitter_scale, jitter_samples)

@@ -23,43 +23,10 @@ from ..structs import (
     new_id, new_ids,
 )
 from ..scheduler.stack import SelectOptions
-from .kernels import fill_depth, fill_greedy_binpack, place_chunked
+from . import backend
 from .tensorize import (
     build_group_tensors, _lower_affinities, _lower_distinct, _lower_spreads,
 )
-
-
-# -------------------------------------------- greedy-kernel backend select
-#
-# Size/platform-thresholded backend for the greedy fill (VERDICT r2 weak
-# #5: the pallas + sharded variants must be production call sites, not
-# showcase code). Plain XLA wins below these sizes; the pallas fused
-# capacity/score pass amortizes only on large node axes on real TPU; the
-# GSPMD-sharded variant needs multiple devices and a node axis big enough
-# to cover the collective cost.
-_PALLAS_MIN_NODES = 8192
-_SHARD_MIN_NODES = 32768
-_greedy_cache: dict = {}
-
-
-def _greedy_backend(n_padded: int):
-    """-> (name, fn(cap, used, ask, count, feasible, max_per_node))"""
-    import jax
-    cached = _greedy_cache.get(n_padded)
-    if cached is not None:
-        return cached
-    devs = jax.devices()
-    if len(devs) > 1 and n_padded >= _SHARD_MIN_NODES and \
-            n_padded % len(devs) == 0:
-        from .sharding import make_mesh, sharded_fill_greedy
-        out = ("sharded", sharded_fill_greedy(make_mesh(devs)))
-    elif devs[0].platform == "tpu" and n_padded >= _PALLAS_MIN_NODES:
-        from .pallas_kernels import fill_greedy_binpack_fused
-        out = ("pallas", fill_greedy_binpack_fused)
-    else:
-        out = ("xla", fill_greedy_binpack)
-    _greedy_cache[n_padded] = out
-    return out
 
 
 class SolverPlacer:
@@ -287,23 +254,29 @@ class SolverPlacer:
             else:
                 bias_g = float(np.clip((width - 1.0) + max(m - 1.0, 0.0),
                                        1.0, 8.0))
-            placed = fill_depth(
+            bname, depth_fn = backend.select(
+                "depth", gt.cap.shape[0], k_max=k_max,
+                spread_algorithm=spread_alg)
+            backend.record("depth", bname)
+            placed = depth_fn(
                 jnp.asarray(gt.cap), jnp.asarray(gt.used),
                 jnp.asarray(gt.ask), jnp.int32(count),
                 jnp.asarray(gt.feasible), jnp.asarray(gt.job_collisions),
                 jnp.int32(tg.count), jnp.asarray(aff),
-                max_per_node=max_per_node, k_max=k_max,
-                spread_algorithm=spread_alg,
-                order_jitter=jitter, jitter_scale=bias_g,
-                jitter_samples=m)
+                jnp.int32(max_per_node), jitter,
+                jnp.float32(bias_g), jnp.float32(m))
         elif use_scan:
             # one solve covers max_steps * k instances; split larger asks
             # across repeated solves, feeding the running state (usage,
             # placements, spread counts, distinct quotas) back in
             max_steps = 256
             cover = max_steps * min(gt.cap.shape[0], 256)
+            bname, chunked_fn = backend.select(
+                "chunked", gt.cap.shape[0], max_steps=max_steps,
+                spread_algorithm=spread_alg)
+            backend.record("chunked", bname)
             used_dev = jnp.asarray(gt.used)
-            placed_dev = None
+            placed_dev = jnp.zeros((gt.cap.shape[0],), jnp.int32)
             sp_counts = jnp.asarray(sp.counts)
             d_rem = jnp.asarray(dp.remaining)
             cap_dev = jnp.asarray(gt.cap)
@@ -319,14 +292,13 @@ class SolverPlacer:
             left = int(count)
             last_total = 0
             while True:
-                placed_dev, used_dev, sp_counts, d_rem = place_chunked(
+                placed_dev, used_dev, sp_counts, d_rem = chunked_fn(
                     cap_dev, used_dev, ask_dev,
                     jnp.int32(min(left, cover)), feas_dev, coll_dev,
                     jnp.int32(tg.count),
                     sp_ids, sp_counts, sp_desired, sp_mode, sp_weights,
-                    aff_dev, dp_ids, d_rem,
-                    max_per_node=max_per_node, max_steps=max_steps,
-                    spread_algorithm=spread_alg, placed_init=placed_dev)
+                    aff_dev, dp_ids, d_rem, placed_dev,
+                    jnp.int32(max_per_node))
                 if left <= cover:
                     break           # one solve covered the whole ask
                 total = int(jnp.sum(placed_dev))    # device sync: rare path
@@ -336,8 +308,8 @@ class SolverPlacer:
                 last_total = total
             placed = placed_dev
         else:
-            backend, greedy = _greedy_backend(gt.cap.shape[0])
-            metrics.incr(f"nomad.solver.backend.{backend}")
+            bname, greedy = backend.select("greedy", gt.cap.shape[0])
+            backend.record("greedy", bname)
             placed = greedy(
                 jnp.asarray(gt.cap), jnp.asarray(gt.used),
                 jnp.asarray(gt.ask), jnp.int32(count),

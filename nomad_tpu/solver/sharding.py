@@ -41,11 +41,16 @@ def sharded_fill_greedy(mesh: Mesh, axis: str = "nodes"):
 
 
 def sharded_place_chunked(mesh: Mesh, axis: str = "nodes",
-                          max_steps: int = 64):
+                          max_steps: int = 256,
+                          spread_algorithm: bool = False):
     """place_chunked with the node axis sharded: the lax.scan carries
     node-sharded running usage/placement state; the per-step top_k and
     scatter-add over the node axis lower to GSPMD collectives
-    (all-gather of the k winners, node-local updates otherwise)."""
+    (all-gather of the k winners, node-local updates otherwise).
+
+    Full production signature (the backend selector hands this to the
+    placer interchangeably with the XLA kernel): returns the same
+    (placed, final_used, spread_counts, distinct_remaining) tuple."""
     nd = NamedSharding(mesh, P(axis, None))          # [N, R']
     nv = NamedSharding(mesh, P(axis))                # [N]
     sn = NamedSharding(mesh, P(None, axis))          # [S, N] / [D, N]
@@ -53,34 +58,45 @@ def sharded_place_chunked(mesh: Mesh, axis: str = "nodes",
 
     def run(cap, used, ask, count, feasible, job_collisions, desired,
             sp_ids, sp_counts, sp_desired, sp_mode, sp_weights, aff,
-            dp_ids, dp_remaining):
-        out, _, _, _ = place_chunked(
+            dp_ids, dp_remaining, placed_init, max_per_node):
+        return place_chunked(
             cap, used, ask, count, feasible, job_collisions, desired,
             sp_ids, sp_counts, sp_desired, sp_mode, sp_weights, aff,
-            dp_ids, dp_remaining, max_steps=max_steps)
-        return out
+            dp_ids, dp_remaining, max_per_node=max_per_node,
+            max_steps=max_steps, spread_algorithm=spread_algorithm,
+            placed_init=placed_init)
 
     return jax.jit(
         run,
         in_shardings=(nd, nd, rep, rep, nv, nv, rep,
-                      sn, rep, rep, rep, rep, nv, sn, rep),
-        out_shardings=nv)
+                      sn, rep, rep, rep, rep, nv, sn, rep, nv, rep),
+        out_shardings=(nv, nd, rep, rep))
 
 
-def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16):
+def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16,
+                       spread_algorithm: bool = False):
     """fill_depth with the node axis sharded: the [N, K] score-curve and
     cumsum stay node-local; the density argsort + global cumsum over the
-    chosen depths become cross-shard collectives."""
+    chosen depths become cross-shard collectives.
+
+    Full production signature, including the E-S order-jitter inputs —
+    the jitter array is node-sharded alongside the score curves."""
     nd = NamedSharding(mesh, P(axis, None))
     nv = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
 
-    def run(cap, used, ask, count, feasible, job_collisions, desired, aff):
+    def run(cap, used, ask, count, feasible, job_collisions, desired, aff,
+            max_per_node, order_jitter, jitter_scale, jitter_samples):
         return fill_depth(cap, used, ask, count, feasible, job_collisions,
-                          desired, aff, k_max=k_max)
+                          desired, aff, max_per_node=max_per_node,
+                          k_max=k_max, spread_algorithm=spread_algorithm,
+                          order_jitter=order_jitter,
+                          jitter_scale=jitter_scale,
+                          jitter_samples=jitter_samples)
 
     return jax.jit(run,
-                   in_shardings=(nd, nd, rep, rep, nv, nv, rep, nv),
+                   in_shardings=(nd, nd, rep, rep, nv, nv, rep, nv,
+                                 rep, nv, rep, rep),
                    out_shardings=nv)
 
 

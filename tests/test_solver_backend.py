@@ -1,0 +1,261 @@
+"""Backend-selector tests (VERDICT r3 #1): ONE routing point for all three
+production kernels, full-signature sharded tiers on the 8-device CPU mesh,
+pallas fill_depth in interpreter mode, and the PLACER path (not bare
+kernels) driven sharded through a real scheduler run."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.solver import backend
+from nomad_tpu.solver.kernels import NUM_XR, fill_depth, place_chunked
+from nomad_tpu.structs import Evaluation, SchedulerConfiguration, Spread
+
+SCHED_ALG_TPU = "tpu-batch"
+
+
+@pytest.fixture(autouse=True)
+def _reset_backend():
+    backend.reset()
+    yield
+    backend.reset()
+
+
+def _cluster(n, seed=0):
+    rng = np.random.default_rng(seed)
+    cap = np.zeros((n, NUM_XR), np.float32)
+    cap[:, 0] = rng.choice([2000, 4000, 8000], n)
+    cap[:, 1] = rng.choice([4096, 8192, 16384], n)
+    cap[:, 2] = 100_000
+    cap[:, 3] = 12_001
+    cap[:, 4] = 1_000
+    used = np.zeros_like(cap)
+    used[:, 0] = rng.integers(0, 1000, n)
+    used[:, 1] = rng.integers(0, 2048, n)
+    return cap, used
+
+
+def _depth_args(n, count, seed=0, jitter_samples=0.0):
+    cap, used = _cluster(n, seed)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 500, 256
+    feas = np.ones(n, bool)
+    feas[:: 7] = False
+    coll = np.zeros(n, np.int32)
+    coll[: n // 4] = 1
+    aff = np.zeros(n, np.float32)
+    rng = np.random.default_rng(seed + 1)
+    jitter = rng.random(n, dtype=np.float32)
+    return (jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+            jnp.int32(count), jnp.asarray(feas), jnp.asarray(coll),
+            jnp.int32(count), jnp.asarray(aff), jnp.int32(2 ** 30),
+            jnp.asarray(jitter), jnp.float32(1.5),
+            jnp.float32(jitter_samples))
+
+
+# ------------------------------------------------------------- routing
+
+def test_small_axes_route_to_xla():
+    for kernel in ("greedy", "depth", "chunked"):
+        name, fn = backend.select(kernel, 1024)
+        assert name == "xla", kernel
+        assert callable(fn)
+
+
+def test_large_axes_route_to_sharded_on_multidevice():
+    assert len(jax.devices()) == 8
+    for kernel in ("greedy", "depth", "chunked"):
+        name, _ = backend.select(kernel, backend.SHARD_MIN_NODES)
+        assert name == "sharded", kernel
+
+
+def test_env_override_forces_tier(monkeypatch):
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "sharded")
+    backend.reset()
+    name, _ = backend.select("depth", 64)      # far below the threshold
+    assert name == "sharded"
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "xla")
+    backend.reset()
+    name, _ = backend.select("greedy", backend.SHARD_MIN_NODES)
+    assert name == "xla"
+
+
+def test_chunked_never_routes_pallas(monkeypatch):
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "pallas")
+    backend.reset()
+    name, _ = backend.select("chunked", backend.PALLAS_MIN_NODES)
+    assert name == "xla"
+
+
+def test_selection_is_cached():
+    n1 = backend.select("depth", 2048, k_max=16)
+    n2 = backend.select("depth", 2048, k_max=16)
+    assert n1[1] is n2[1]
+    n3 = backend.select("depth", 2048, k_max=32)
+    assert n3[1] is not n1[1]       # static params key the cache
+
+
+# ------------------------------------------- sharded parity (full signature)
+
+def test_sharded_depth_matches_single_device_deterministic():
+    args = _depth_args(512, 300, seed=3, jitter_samples=0.0)
+    name, fn = backend.select("depth", 512, k_max=16)
+    assert name == "xla"
+    backend.SHARD_MIN_NODES, saved = 8, backend.SHARD_MIN_NODES
+    try:
+        backend.reset()
+        sname, sfn = backend.select("depth", 512, k_max=16)
+    finally:
+        backend.SHARD_MIN_NODES = saved
+    assert sname == "sharded"
+    want = np.asarray(fn(*args))
+    got = np.asarray(sfn(*args))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == 300
+
+
+def test_sharded_depth_matches_single_device_jittered():
+    """The E-S jittered regime is deterministic GIVEN the jitter array, so
+    sharded-vs-single parity holds exactly there too."""
+    args = _depth_args(512, 40, seed=5, jitter_samples=1.2)
+    _, fn = backend.select("depth", 512, k_max=16)
+    backend.SHARD_MIN_NODES, saved = 8, backend.SHARD_MIN_NODES
+    try:
+        backend.reset()
+        sname, sfn = backend.select("depth", 512, k_max=16)
+    finally:
+        backend.SHARD_MIN_NODES = saved
+    assert sname == "sharded"
+    want = np.asarray(fn(*args))
+    got = np.asarray(sfn(*args))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == 40
+
+
+def test_sharded_chunked_matches_single_device():
+    n, count = 256, 64
+    cap, used = _cluster(n, seed=9)
+    used[:] = 0.0            # equal scores -> exactly even rack spread
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 250, 512
+    feas = np.ones(n, bool)
+    coll = np.zeros(n, np.int32)
+    aff = np.zeros(n, np.float32)
+    racks = (np.arange(n) % 4).astype(np.int32)
+    sp = (jnp.asarray(racks[None, :]), jnp.zeros((1, 4), jnp.int32),
+          jnp.full((1, 4), -1.0, jnp.float32), jnp.zeros(1, jnp.int32),
+          jnp.ones(1, jnp.float32))
+    dp = (jnp.full((1, n), -1, jnp.int32), jnp.full((1, 2), -1, jnp.int32))
+    args = (jnp.asarray(cap), jnp.asarray(used), jnp.asarray(ask),
+            jnp.int32(count), jnp.asarray(feas), jnp.asarray(coll),
+            jnp.int32(count), *sp, jnp.asarray(aff), *dp,
+            jnp.zeros((n,), jnp.int32), jnp.int32(2 ** 30))
+    _, fn = backend.select("chunked", n, max_steps=64)
+    backend.SHARD_MIN_NODES, saved = 8, backend.SHARD_MIN_NODES
+    try:
+        backend.reset()
+        sname, sfn = backend.select("chunked", n, max_steps=64)
+    finally:
+        backend.SHARD_MIN_NODES = saved
+    assert sname == "sharded"
+    want = fn(*args)
+    got = sfn(*args)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]))
+    np.testing.assert_array_equal(np.asarray(got[2]), np.asarray(want[2]))
+    p = np.asarray(got[0])
+    assert p.sum() == count
+    # spread stanza keeps racks near-even under sharding (binpack still
+    # differentiates nodes by capacity, so exact evenness isn't guaranteed)
+    per_rack = [p[racks == r].sum() for r in range(4)]
+    assert max(per_rack) - min(per_rack) <= 2, per_rack
+
+
+# ------------------------------------------------------- pallas depth tier
+
+def test_pallas_fill_depth_matches_xla_deterministic():
+    from nomad_tpu.solver.pallas_kernels import fill_depth_fused
+    args = _depth_args(300, 200, seed=11, jitter_samples=0.0)
+    want = np.asarray(fill_depth(
+        args[0], args[1], args[2], args[3], args[4], args[5], args[6],
+        args[7], max_per_node=args[8], k_max=16,
+        order_jitter=args[9], jitter_scale=args[10], jitter_samples=args[11]))
+    got = np.asarray(fill_depth_fused(
+        *args, k_max=16, interpret=True))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == 200
+
+
+def test_pallas_fill_depth_matches_xla_jittered():
+    from nomad_tpu.solver.pallas_kernels import fill_depth_fused
+    args = _depth_args(300, 25, seed=13, jitter_samples=0.8)
+    want = np.asarray(fill_depth(
+        args[0], args[1], args[2], args[3], args[4], args[5], args[6],
+        args[7], max_per_node=args[8], k_max=16,
+        order_jitter=args[9], jitter_scale=args[10], jitter_samples=args[11]))
+    got = np.asarray(fill_depth_fused(*args, k_max=16, interpret=True))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == 25
+
+
+def test_pallas_fill_depth_respects_max_per_node():
+    from nomad_tpu.solver.pallas_kernels import fill_depth_fused
+    args = list(_depth_args(64, 30, seed=17))
+    args[8] = jnp.int32(1)                      # distinct_hosts
+    got = np.asarray(fill_depth_fused(*args, k_max=16, interpret=True))
+    assert got.max() <= 1
+    assert got.sum() == 30
+
+
+# --------------------------------------------- placer path, sharded, e2e
+
+def _run_tpu_eval(count, spreads=False):
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for i in range(16):
+        n = mock.node()
+        n.datacenter = "dc1" if i % 2 == 0 else "dc2"
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].resources.networks = []
+    if spreads:
+        job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    return h, job
+
+
+def test_placer_runs_depth_kernel_sharded(monkeypatch):
+    """The scheduler's production solve — not a bare kernel — executes on
+    the 8-device mesh when the node axis crosses the shard threshold."""
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    backend.reset()
+    before = metrics.counter("nomad.solver.kernel.depth.sharded")
+    h, job = _run_tpu_eval(12)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 12
+    assert h.evals[-1].status == "complete"
+    assert metrics.counter("nomad.solver.kernel.depth.sharded") > before
+
+
+def test_placer_runs_chunked_kernel_sharded(monkeypatch):
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    backend.reset()
+    before = metrics.counter("nomad.solver.kernel.chunked.sharded")
+    h, job = _run_tpu_eval(8, spreads=True)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 8
+    assert metrics.counter("nomad.solver.kernel.chunked.sharded") > before
+    by_dc = {"dc1": 0, "dc2": 0}
+    nodes = {n.id: n for n in h.state.iter_nodes()}
+    for a in allocs:
+        by_dc[nodes[a.node_id].datacenter] += 1
+    assert by_dc["dc1"] == by_dc["dc2"] == 4
