@@ -185,9 +185,14 @@ def main() -> None:
     import random
 
     import jax
+    from nomad_tpu.runtime import tune_gc
     from nomad_tpu.server.fsm import RaftLog
     from nomad_tpu.server.plan_apply import Planner
     from nomad_tpu.structs import SCHED_ALG_TPU
+
+    # the same process-level GC tuning Server.start()/Agent.start() apply —
+    # the bench simulates the server loop and must measure what prod runs
+    tune_gc()
 
     # the placer decorrelates concurrent workers via random node shuffles;
     # seed it so the reported rejection rates are reproducible run to run

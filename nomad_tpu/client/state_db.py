@@ -84,15 +84,18 @@ class StateDB:
         """Must be called under the flock. A MISSING owner file (operator
         tmp-clean, data-dir surgery) is reclaimed rather than treated as
         'not us' — otherwise the sole live client would silently drop
-        every flush forever. Reclaim is GENERATION-ordered: a superseded
-        instance that reclaims after a deletion is re-superseded by the
-        newer instance's next flush (higher generation wins), so the
-        newest writer's state always converges on top."""
+        every flush forever. Reclaim is GENERATION-ordered and every
+        reclaim BUMPS the generation past what was read: two instances
+        that both re-derive the same generation after a deletion can't
+        ping-pong — the first reclaimer's bump makes the other observe a
+        strictly greater generation and stand down, so the newest
+        writer's state converges on top."""
         gen, token = self._read_owner()
         if token == self._instance:
             return True
         if gen > self._gen:
             return False                # a newer instance owns the path
+        self._gen = max(self._gen, gen) + 1
         self._claim_ownership()         # missing, or a stale reclaimer
         return True
 

@@ -278,6 +278,13 @@ class GenericScheduler:
             if self.job.lookup_task_group(tg.name) is None:
                 continue
 
+            # canary gate: non-canary placements run at the downgraded
+            # job version (old resources/constraints, ref :500)
+            tg, place_job, place_dep_id = self.resolve_placement_job(
+                missing, tg, deployment_id)
+            if place_job is not None:
+                self.stack.set_job(place_job)
+
             options = SelectOptions(alloc_name=name)
             if prev is not None:
                 penalty = {prev.node_id}
@@ -293,6 +300,8 @@ class GenericScheduler:
                         options.preferred_nodes = [node]
 
             option = self._select_next_option(tg, options)
+            if place_job is not None:
+                self.stack.set_job(self.job)        # restore after select
             # per-DC availability survives the per-select metric reset
             # (ref generic_sched.go computePlacements re-sets NodesAvailable)
             self.ctx.metrics.nodes_available = dict(self._nodes_by_dc)
@@ -312,7 +321,7 @@ class GenericScheduler:
                     metrics=self.ctx.metrics.copy(),
                     node_id=option.node.id,
                     node_name=option.node.name,
-                    deployment_id=deployment_id,
+                    deployment_id=place_dep_id,
                     allocated_resources=resources,
                     desired_status="run",
                     client_status="pending",
@@ -322,13 +331,13 @@ class GenericScheduler:
                     alloc.previous_allocation = prev.id
                     if isinstance(missing, AllocPlaceResult) and missing.reschedule:
                         self._update_reschedule_tracker(alloc, prev)
-                if deployment_id and canary:
+                if place_dep_id and canary:
                     alloc.deployment_status = AllocDeploymentStatus(canary=True)
                     if self.plan.deployment is not None:
                         ds = self.plan.deployment.task_groups.get(tg.name)
                         if ds is not None:
                             ds.placed_canaries.append(alloc.id)
-                self.plan.append_alloc(alloc, None)
+                self.plan.append_alloc(alloc, place_job)
             else:
                 # failed placement: restore the stop we optimistically made
                 if is_destructive:
@@ -337,6 +346,64 @@ class GenericScheduler:
                         self.queued_allocs.get(tg.name, 0) - 1
                 self.failed_tg_allocs[tg.name] = self.ctx.metrics.copy()
         return True
+
+    def _downgraded_job_for_placement(self, tg_name: str,
+                                      min_job_version: int):
+        """-> (deployment_id, job) of the latest promoted/non-canaried
+        job version — the version a non-canary placement must run at
+        while canaries gate the new version (ref generic_sched.go:434
+        downgradedJobForPlacement). Cached per (tg, min_version) for the
+        eval: the result is snapshot-invariant, and a canary-gated job
+        losing a node resolves it once per group, not once per alloc."""
+        cache = getattr(self, "_downgrade_cache", None)
+        if cache is None:
+            cache = self._downgrade_cache = {}
+        key = (tg_name, min_job_version)
+        if key in cache:
+            return cache[key]
+        out = self._downgraded_job_uncached(tg_name, min_job_version)
+        cache[key] = out
+        return out
+
+    def _downgraded_job_uncached(self, tg_name: str, min_job_version: int):
+        ns, job_id = self.job.namespace, self.job.id
+        deployments = list(self.state.deployments_by_job(ns, job_id))
+        deployments.sort(key=lambda d: d.job_version, reverse=True)
+        for d in deployments:
+            ds = d.task_groups.get(tg_name)
+            # zero desired_canaries: that version rolled without canaries
+            if ds is not None and (ds.promoted or ds.desired_canaries == 0):
+                return d.id, self.state.job_by_version(ns, job_id,
+                                                       d.job_version)
+        # latest stable version may predate any deployment (no update
+        # stanza => no deployment record)
+        job = self.state.job_by_version(ns, job_id, min_job_version)
+        if job is not None and job.update is None:
+            return "", job
+        return "", None
+
+    def resolve_placement_job(self, missing, tg, deployment_id: str):
+        """-> (tg, job_override, deployment_id) honoring the reconciler's
+        downgrade_non_canary flag: while a canary gate is up, non-canary
+        placements (migrations, lost replacements, scale-ups) run at the
+        old job version, with the old group's resources and constraints
+        (ref generic_sched.go:500). job_override is None when the plan
+        job applies."""
+        from .reconcile import AllocPlaceResult
+        if not (isinstance(missing, AllocPlaceResult) and
+                missing.downgrade_non_canary):
+            return tg, None, deployment_id
+        did, djob = self._downgraded_job_for_placement(
+            tg.name, missing.min_job_version)
+        if djob is not None and djob.version >= missing.min_job_version:
+            dtg = djob.lookup_task_group(tg.name)
+            if dtg is not None:
+                return dtg, djob, (did or deployment_id)
+        if self.ctx.logger:
+            self.ctx.logger(
+                f"sched: no downgraded job version for {tg.name}; "
+                f"placing at the latest")
+        return tg, None, deployment_id
 
     def _select_next_option(self, tg, options: SelectOptions):
         """ref generic_sched.go:773 selectNextOption — retry with preemption

@@ -31,6 +31,7 @@ DESC_UNKNOWN = "alloc is unknown since its node is disconnected"
 DESC_RECONNECTED = "replacement stopped: original alloc reconnected"
 DESC_RECONNECT_EXPIRED = "alloc reconnected after max_client_disconnect"
 DESC_RECONNECT_OK = "alloc reconnected within max_client_disconnect"
+DESC_RECONNECT_OUTDATED = "reconnected alloc is an outdated job version"
 
 
 @dataclasses.dataclass(slots=True)
@@ -224,7 +225,8 @@ class AllocReconciler:
         all_allocs, ignored = self._filter_old_terminal_allocs(all_allocs)
         desired.ignore += len(ignored)
 
-        canaries, all_allocs = self._handle_group_canaries(all_allocs, desired)
+        canaries, all_allocs = self._handle_group_canaries(all_allocs,
+                                                           desired, tg)
 
         untainted, migrate, lost = filter_by_tainted(all_allocs, self.tainted)
 
@@ -394,7 +396,7 @@ class AllocReconciler:
         return filtered, ignored
 
     def _handle_group_canaries(self, all_allocs: AllocSet,
-                               desired: DesiredUpdates
+                               desired: DesiredUpdates, tg
                                ) -> tuple[AllocSet, AllocSet]:
         """ref reconcile.go handleGroupCanaries"""
         stop_ids: list[str] = []
@@ -419,6 +421,13 @@ class AllocReconciler:
                 canary_ids.extend(ds.placed_canaries)
             canaries = from_keys(all_allocs, canary_ids)
             untainted, migrate, lost = filter_by_tainted(canaries, self.tainted)
+            # 1.3 analog: a canary on a disconnected node rides the
+            # max_client_disconnect window like any other alloc — it is
+            # LEFT in the group set so the disconnect split marks it
+            # unknown, and its absence from `canaries` makes the canary
+            # top-up place a replacement; on reconnect the generic
+            # name-slot logic stops the replacement.
+            _disconnecting, lost = split_disconnecting(tg, lost, self.now)
             self._mark_stop(migrate, "", DESC_MIGRATING)
             self._mark_stop(lost, ALLOC_CLIENT_LOST, "alloc lost")
             canaries = untainted
@@ -641,6 +650,21 @@ class AllocReconciler:
                 self.result.stop.append(AllocStopResult(
                     alloc=alloc, client_status=ALLOC_CLIENT_LOST,
                     status_description=DESC_RECONNECT_EXPIRED))
+                desired.stop += 1
+            elif alloc.job is not None and self.job is not None and (
+                    alloc.job.version < self.job.version or
+                    alloc.job.create_index < self.job.create_index):
+                # the job was UPDATED while the client was away: the
+                # stale original stops and the (newer-version)
+                # replacement keeps the slot — restoring the original
+                # would mislabel old task config as the new version,
+                # since placements/updates normalize alloc.job to the
+                # plan job (ref reconcileReconnecting: reconnecting
+                # allocs needing an update are stopped, newer pickers
+                # keep the highest job version)
+                self.result.stop.append(AllocStopResult(
+                    alloc=alloc,
+                    status_description=DESC_RECONNECT_OUTDATED))
                 desired.stop += 1
             else:
                 fresh[aid] = alloc

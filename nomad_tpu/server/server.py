@@ -182,6 +182,8 @@ class Server:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
+        from ..runtime import tune_gc
+        tune_gc()          # allocation-heavy plans vs default GC cadence
         if self.raft_node is None:
             self._establish_leadership()
         else:

@@ -1409,6 +1409,10 @@ class StateSnapshot:
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
         return self.deployments.get(deployment_id)
 
+    def deployments_by_job(self, ns: str, job_id: str) -> list[Deployment]:
+        return [d for d in self.deployments.values()
+                if d.namespace == ns and d.job_id == job_id]
+
     def latest_deployment_by_job(self, ns: str, job_id: str
                                  ) -> Optional[Deployment]:
         ds = [d for d in self.deployments.values()

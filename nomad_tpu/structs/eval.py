@@ -56,7 +56,13 @@ def new_id() -> str:
 
 def new_ids(n: int) -> list[str]:
     """n random ids from ONE urandom read — the mass-placement path mints
-    ids in batch to avoid n getrandom syscalls."""
+    ids in batch to avoid n getrandom syscalls. The native formatter
+    (native/allocstamp.c format_uuids) writes each ascii string directly
+    (~50ns/id vs ~1.6us for the slicing formatter below)."""
+    from .fastbatch import _load_native
+    native = _load_native()
+    if native:
+        return native.format_uuids(os.urandom(16 * n), n)
     h = os.urandom(16 * n).hex()
     vr = "89ab"
     return [f"{s[:8]}-{s[8:12]}-4{s[13:16]}-"

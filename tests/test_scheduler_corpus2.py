@@ -1,0 +1,628 @@
+"""Scheduler scenario corpus, part 2 (VERDICT r3 #3): the edge matrix from
+scheduler/reconcile_test.go (5,021 LoC) and generic_sched_test.go (6,385
+LoC) that part 1 left unported — canary x drain x disconnect interactions,
+progress-deadline behavior, reschedule-tracker carry-over across
+generations, and max_client_disconnect reconnect races. Each scenario
+cites the reference behavior it mirrors; invariant-style assertions
+(count coverage, no duplicate live name slots, deployment intact) guard
+the properties any correct reconciler must keep."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.structs import (
+    AllocDeploymentStatus, Constraint, DesiredTransition, DrainStrategy,
+    Evaluation, ReschedulePolicy, RescheduleEvent, RescheduleTracker,
+    SchedulerConfiguration, UpdateStrategy,
+    ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
+    ALLOC_CLIENT_UNKNOWN, ALLOC_DESIRED_RUN, ALLOC_DESIRED_STOP,
+    EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE, NODE_STATUS_DOWN,
+    NODE_STATUS_READY, OP_EQ,
+    TRIGGER_JOB_REGISTER, TRIGGER_NODE_UPDATE, TRIGGER_RETRY_FAILED_ALLOC,
+)
+
+from test_scheduler import make_eval, process
+from test_scheduler_corpus import (
+    allocs_of, live, register, seed_nodes,
+)
+
+
+# ----------------------------------------------------------- helpers
+
+def run_all_running(h, job, healthy=True):
+    register(h, job)
+    process(h, job)
+    for a in allocs_of(h, job):
+        a2 = a.copy()
+        a2.client_status = ALLOC_CLIENT_RUNNING
+        if healthy:
+            a2.deployment_status = AllocDeploymentStatus(
+                healthy=True,
+                canary=bool(a.deployment_status
+                            and a.deployment_status.canary))
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+
+
+def set_node_status(h, node_id, status):
+    node = h.state.node_by_id(node_id).copy()
+    node.status = status
+    h.state.upsert_node(h.get_next_index(), node)
+    return node
+
+
+def drain_node(h, node_id, deadline=60.0):
+    node = h.state.node_by_id(node_id).copy()
+    node.drain_strategy = DrainStrategy(deadline_sec=deadline)
+    h.state.upsert_node(h.get_next_index(), node)
+    # the drainer marks the node's allocs for migration
+    for a in h.state.allocs_by_node(node_id):
+        if a.terminal_status():
+            continue
+        a2 = a.copy()
+        a2.desired_transition = DesiredTransition(migrate=True)
+        h.state.upsert_allocs(h.get_next_index(), [a2])
+    return node
+
+
+def mark_running(h, alloc, healthy=None, canary=None):
+    a2 = alloc.copy()
+    a2.client_status = ALLOC_CLIENT_RUNNING
+    if healthy is not None or canary is not None:
+        a2.deployment_status = AllocDeploymentStatus(
+            healthy=healthy,
+            canary=bool(canary if canary is not None else
+                        (alloc.deployment_status
+                         and alloc.deployment_status.canary)))
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    return a2
+
+
+def fail_alloc(h, alloc):
+    a2 = alloc.copy()
+    a2.client_status = ALLOC_CLIENT_FAILED
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    return a2
+
+
+def update_job(h, job, version=1):
+    updated = job.copy()
+    updated.version = version
+    updated.task_groups[0].tasks[0].config = {"command": "/bin/v%d" % version}
+    register(h, updated)
+    process(h, updated)
+    return updated
+
+
+def canaries_of(allocs):
+    return [a for a in allocs
+            if a.deployment_status and a.deployment_status.canary]
+
+
+def promote(h, job):
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    d2 = d.copy()
+    for st in d2.task_groups.values():
+        st.promoted = True
+    h.state.upsert_deployment(h.get_next_index(), d2)
+    return d2
+
+
+def no_duplicate_live_names(allocs):
+    """Canaries and unknown (disconnected) allocs are EXCLUDED: a canary
+    shadows the name slot of the old-version alloc it candidates for
+    (ref allocNameIndex NextCanaries), and a disconnected original rides
+    the window alongside the replacement holding its slot (ref 1.3
+    disconnect semantics)."""
+    names = [a.name for a in live(allocs)
+             if not (a.deployment_status and a.deployment_status.canary)
+             and a.client_status != ALLOC_CLIENT_UNKNOWN]
+    return len(names) == len(set(names))
+
+
+def disc_job(window=60.0, count=3):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.max_client_disconnect_sec = window
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    return job
+
+
+def disc_canary_job(window=60.0, canaries=1, count=4):
+    job = mock.canary_job(canaries=canaries)
+    job.task_groups[0].count = count
+    job.task_groups[0].max_client_disconnect_sec = window
+    return job
+
+
+# ================================================== canary x drain matrix
+
+def test_canary_node_drain_migrates_canary():
+    """Draining the canary's node migrates the canary without failing the
+    deployment; the replacement is still a canary (ref reconcile_test.go
+    drain-during-canary + drainer semantics)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    allocs = allocs_of(h, job)
+    canary = canaries_of(allocs)[0]
+    mark_running(h, canary, healthy=True, canary=True)
+
+    drain_node(h, canary.node_id)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+
+    allocs = allocs_of(h, job)
+    migrated = [a for a in allocs if a.id == canary.id]
+    assert migrated[0].desired_status == ALLOC_DESIRED_STOP
+    # replacement canary placed elsewhere, still marked canary
+    repl = [a for a in live(allocs) if a.job.version == 1
+            and a.id != canary.id]
+    assert len(repl) >= 1
+    assert all(a.node_id != canary.node_id for a in repl)
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d.status not in ("failed", "cancelled")
+
+
+def test_canary_drain_of_old_alloc_node_does_not_promote():
+    """Draining a node holding only OLD-version allocs mid-canary migrates
+    them at the old version — the canary gate must not leak new-version
+    placements (ref reconcile.go: non-promoted deployments place at the
+    old job version for non-canary slots)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    allocs = allocs_of(h, job)
+    old = [a for a in live(allocs) if a.job.version == 0]
+    victim = old[0]
+    drain_node(h, victim.node_id)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    # the migrated replacement for an old slot is OLD version (canary
+    # gate holds: only the canary slots run version 1)
+    new_version_live = [a for a in live(allocs) if a.job.version == 1]
+    assert len(canaries_of(new_version_live)) == len(new_version_live), \
+        "non-canary new-version alloc leaked through the canary gate"
+    assert no_duplicate_live_names(allocs)
+
+
+def test_canary_promotion_then_drain_rolls_at_new_version():
+    """After promotion, migrations place at the NEW version (ref
+    reconcile_test.go promoted-deployment migrate cases)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    for a in canaries_of(allocs_of(h, job)):
+        mark_running(h, a, healthy=True, canary=True)
+    promote(h, updated)
+    process(h, updated)
+    # roll forward: mark everything running+healthy
+    for a in live(allocs_of(h, job)):
+        mark_running(h, a, healthy=True)
+    process(h, updated)
+    for a in live(allocs_of(h, job)):
+        mark_running(h, a, healthy=True)
+    process(h, updated)
+    live_now = live(allocs_of(h, job))
+    v1 = [a for a in live_now if a.job.version == 1]
+    assert len(v1) == len(live_now) == 4, \
+        f"rollout incomplete: {len(v1)}/{len(live_now)} at v1"
+    victim = v1[0]
+    drain_node(h, victim.node_id)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    repl = [a for a in live(allocs) if a.name == victim.name
+            and a.id != victim.id]
+    assert repl and all(a.job.version == 1 for a in repl)
+
+
+def test_paused_deployment_blocks_placements_but_drain_still_stops():
+    """A paused deployment places nothing new; the drained alloc still
+    stops (ref reconcile.go deploymentPaused: placements gated, stops
+    not)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    d2 = d.copy()
+    d2.status = "paused"
+    h.state.upsert_deployment(h.get_next_index(), d2)
+    canary = canaries_of(allocs_of(h, job))[0]
+    n_live_before = len(live(allocs_of(h, job)))
+    drain_node(h, canary.node_id)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    assert h.state.alloc_by_id(canary.id).desired_status == \
+        ALLOC_DESIRED_STOP
+    # no NEW canary placed while paused
+    new_canaries = [a for a in live(allocs)
+                    if a.job.version == 1 and a.id != canary.id]
+    assert len(new_canaries) == 0
+    assert len(live(allocs)) < n_live_before
+
+
+def test_failed_canary_not_rescheduled_by_reconciler():
+    """A failed alloc belonging to the ACTIVE deployment — a canary
+    included — is NOT replaced by the reconciler: the deployment watcher
+    owns that failure (fails the deployment / auto-reverts). Ref
+    reconcile_util.go updateByReschedulable's deployment gate
+    (`d != nil && alloc.DeploymentID == d.ID && d.Active() &&
+    !alloc.DesiredTransition.ShouldReschedule()`)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=2)
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        unlimited=True, delay_sec=0.0, delay_function="constant")
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    cs = canaries_of(allocs_of(h, job))
+    assert len(cs) == 2
+    fail_alloc(h, cs[0])
+    process(h, updated, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    allocs = allocs_of(h, job)
+    live_canaries = [a for a in canaries_of(allocs)
+                     if not a.terminal_status()
+                     and a.client_status != ALLOC_CLIENT_FAILED]
+    assert len(live_canaries) == 1, "reconciler must defer to the watcher"
+    assert no_duplicate_live_names(allocs)
+    # old version fleet untouched
+    assert len([a for a in live(allocs) if a.job.version == 0]) == 4
+
+
+def test_failed_canary_replaced_once_marked_reschedulable():
+    """The deployment-gate escape hatch: once desired_transition
+    reschedule is stamped (the watcher's mechanism), the reconciler
+    replaces the failed canary with another canary (ref
+    DesiredTransition.ShouldReschedule path)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=2)
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        unlimited=True, delay_sec=0.0, delay_function="constant")
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    cs = canaries_of(allocs_of(h, job))
+    failed = fail_alloc(h, cs[0])
+    marked = failed.copy()
+    marked.desired_transition = DesiredTransition(reschedule=True)
+    h.state.upsert_allocs(h.get_next_index(), [marked])
+    process(h, updated, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    allocs = allocs_of(h, job)
+    replacement = [a for a in live(allocs)
+                   if a.job.version == 1 and a.id != failed.id
+                   and a.client_status != ALLOC_CLIENT_FAILED
+                   and a.id != cs[1].id]
+    assert len(replacement) == 1, "marked canary not replaced"
+    assert replacement[0].deployment_status is None or \
+        replacement[0].deployment_status.canary or \
+        replacement[0].name == failed.name
+    assert no_duplicate_live_names(allocs)
+
+
+def test_all_canaries_failed_deployment_not_auto_promoted():
+    """Every canary failing must never promote; old allocs stay (ref
+    deploymentwatcher auto-promote requires healthy canaries)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=2, auto_promote=True)
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=0, unlimited=False)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    for c in canaries_of(allocs_of(h, job)):
+        fail_alloc(h, c)
+    process(h, updated, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert not any(st.promoted for st in d.task_groups.values())
+    allocs = allocs_of(h, job)
+    assert len([a for a in live(allocs) if a.job.version == 0]) == 4
+
+
+# ============================================ canary x disconnect matrix
+
+def test_canary_node_disconnect_keeps_canary_unknown():
+    """The canary's node disconnecting inside max_client_disconnect marks
+    it unknown and places a replacement canary; the deployment survives
+    (ref 1.3 reconcile: disconnect handling is version-agnostic)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = disc_canary_job(window=60.0, canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    canary = canaries_of(allocs_of(h, job))[0]
+    mark_running(h, canary, healthy=True, canary=True)
+    set_node_status(h, canary.node_id, NODE_STATUS_DOWN)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    orig = h.state.alloc_by_id(canary.id)
+    assert orig.client_status == ALLOC_CLIENT_UNKNOWN
+    assert orig.desired_status == ALLOC_DESIRED_RUN
+    d = h.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d.status not in ("failed", "cancelled")
+    # a replacement canary covers the slot
+    repl = [a for a in live(allocs) if a.job.version == 1
+            and a.id != canary.id and a.node_id != canary.node_id]
+    assert len(repl) >= 1
+
+
+def test_canary_reconnect_stops_replacement_canary():
+    """When the canary's node reconnects in-window, the original canary
+    is kept and the replacement stops (ref 1.3 reconcileReconnecting:
+    original wins)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = disc_canary_job(window=60.0, canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    canary = canaries_of(allocs_of(h, job))[0]
+    mark_running(h, canary, healthy=True, canary=True)
+    set_node_status(h, canary.node_id, NODE_STATUS_DOWN)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    set_node_status(h, canary.node_id, NODE_STATUS_READY)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    orig = h.state.alloc_by_id(canary.id)
+    assert orig.desired_status == ALLOC_DESIRED_RUN
+    assert orig.client_status != ALLOC_CLIENT_UNKNOWN
+    stopped_repl = [a for a in allocs
+                    if a.id != canary.id and a.job.version == 1
+                    and a.desired_status == ALLOC_DESIRED_STOP]
+    assert stopped_repl, "replacement canary not stopped on reconnect"
+    assert no_duplicate_live_names(allocs)
+
+
+def test_disconnect_expiry_mid_canary_reaps_canary():
+    """If the canary's disconnect window expires, the unknown canary is
+    stopped and the replacement canary keeps the slot."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = disc_canary_job(window=0.05, canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    canary = canaries_of(allocs_of(h, job))[0]
+    mark_running(h, canary, healthy=True, canary=True)
+    set_node_status(h, canary.node_id, NODE_STATUS_DOWN)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    time.sleep(0.1)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    orig = h.state.alloc_by_id(canary.id)
+    assert orig.desired_status == ALLOC_DESIRED_STOP
+    live_canaries = [a for a in live(allocs_of(h, job))
+                     if a.job.version == 1]
+    assert len(live_canaries) >= 1
+    assert no_duplicate_live_names(allocs_of(h, job))
+
+
+# ======================================= drain x disconnect interactions
+
+def test_drain_and_disconnect_same_node_drain_wins():
+    """A node that is BOTH draining and down: the migrate transition was
+    already stamped, so allocs migrate (stop) rather than ride the
+    disconnect window — matching the reference's filterByTainted order
+    (drain/migrate is checked before disconnecting)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = disc_job(window=60.0)
+    run_all_running(h, job)
+    victim = allocs_of(h, job)[0]
+    drain_node(h, victim.node_id)
+    set_node_status(h, victim.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    orig = h.state.alloc_by_id(victim.id)
+    assert orig.desired_status == ALLOC_DESIRED_STOP
+    assert len(live(allocs)) == 3          # full count covered elsewhere
+    assert all(a.node_id != victim.node_id for a in live(allocs))
+
+
+def test_disconnected_replacement_node_drains():
+    """The REPLACEMENT's node draining while the original is still
+    unknown: replacement migrates, original stays unknown, count still
+    covered (three-node churn)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = disc_job(window=120.0, count=1)
+    run_all_running(h, job)
+    orig = allocs_of(h, job)[0]
+    set_node_status(h, orig.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    repl = [a for a in live(allocs_of(h, job)) if a.id != orig.id]
+    assert len(repl) == 1
+    mark_running(h, repl[0])
+    drain_node(h, repl[0].node_id)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    assert h.state.alloc_by_id(repl[0].id).desired_status == \
+        ALLOC_DESIRED_STOP
+    third = [a for a in live(allocs)
+             if a.id not in (orig.id, repl[0].id)]
+    assert len(third) == 1
+    assert h.state.alloc_by_id(orig.id).client_status == \
+        ALLOC_CLIENT_UNKNOWN
+
+
+def test_reconnect_races_replacement_migration():
+    """Original reconnects in the same pass that its replacement is
+    being drained: exactly one live alloc must survive for the slot."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = disc_job(window=120.0, count=1)
+    run_all_running(h, job)
+    orig = allocs_of(h, job)[0]
+    set_node_status(h, orig.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    repl = [a for a in live(allocs_of(h, job)) if a.id != orig.id][0]
+    mark_running(h, repl)
+    # both events land before the next eval
+    set_node_status(h, orig.node_id, NODE_STATUS_READY)
+    drain_node(h, repl.node_id)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    assert no_duplicate_live_names(allocs)
+    survivors = live(allocs)
+    assert len(survivors) == 1
+    assert h.state.alloc_by_id(repl.id).desired_status == \
+        ALLOC_DESIRED_STOP
+
+
+def test_no_window_down_node_is_lost_immediately():
+    """Without max_client_disconnect the down node's allocs are lost and
+    replaced at once (the pre-1.3 behavior stays intact)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = disc_job(window=0, count=2)
+    job.task_groups[0].max_client_disconnect_sec = None
+    run_all_running(h, job)
+    victim = allocs_of(h, job)[0]
+    set_node_status(h, victim.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    orig = h.state.alloc_by_id(victim.id)
+    assert orig.client_status != ALLOC_CLIENT_UNKNOWN
+    assert len(live(allocs)) == 2
+    assert all(a.node_id != victim.node_id for a in live(allocs))
+
+
+def test_flapping_node_gets_fresh_window_each_disconnect():
+    """disconnected_at resets on reconnect, so a second disconnect gets a
+    full fresh window (ref 1.3: AllocStates append per transition; expiry
+    measured from the LATEST disconnect)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = disc_job(window=60.0, count=1)
+    run_all_running(h, job)
+    orig = allocs_of(h, job)[0]
+    # first flap
+    set_node_status(h, orig.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    first_since = h.state.alloc_by_id(orig.id).disconnected_at
+    assert first_since > 0
+    set_node_status(h, orig.node_id, NODE_STATUS_READY)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    assert h.state.alloc_by_id(orig.id).disconnected_at == 0.0
+    # second flap gets a fresh stamp
+    time.sleep(0.02)
+    set_node_status(h, orig.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    second_since = h.state.alloc_by_id(orig.id).disconnected_at
+    assert second_since > first_since
+    assert no_duplicate_live_names(allocs_of(h, job))
+
+
+def test_two_nodes_disconnect_and_reconnect_together():
+    """Both down nodes ride the window; both originals win their slots
+    back on reconnect and both replacements stop."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = disc_job(window=120.0, count=4)
+    run_all_running(h, job)
+    by_node: dict = {}
+    for a in allocs_of(h, job):
+        by_node.setdefault(a.node_id, []).append(a)
+    victims = [n for n, allocs in by_node.items() if allocs][:2]
+    assert len(victims) == 2
+    n_victim_allocs = sum(len(by_node[n]) for n in victims)
+    for n in victims:
+        set_node_status(h, n, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    unknown = [a for a in allocs_of(h, job)
+               if a.client_status == ALLOC_CLIENT_UNKNOWN]
+    assert len(unknown) == n_victim_allocs
+    for n in victims:
+        set_node_status(h, n, NODE_STATUS_READY)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    assert no_duplicate_live_names(allocs)
+    assert len(live(allocs)) == 4
+    restored = [a for a in live(allocs) if a.id in {x.id for x in unknown}]
+    assert len(restored) == n_victim_allocs
+
+
+def test_reconnect_with_failed_replacement_places_fresh_nothing():
+    """The replacement FAILED while the original was disconnected; on
+    reconnect the original covers the slot — no extra placement, and the
+    failed replacement must not block the name slot."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = disc_job(window=120.0, count=1)
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=0, unlimited=False)
+    run_all_running(h, job)
+    orig = allocs_of(h, job)[0]
+    set_node_status(h, orig.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    repl = [a for a in live(allocs_of(h, job)) if a.id != orig.id][0]
+    fail_alloc(h, repl)
+    set_node_status(h, orig.node_id, NODE_STATUS_READY)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    allocs = allocs_of(h, job)
+    restored = h.state.alloc_by_id(orig.id)
+    assert restored.desired_status == ALLOC_DESIRED_RUN
+    assert restored.client_status == ALLOC_CLIENT_RUNNING
+    healthy_live = [a for a in live(allocs)
+                    if a.client_status != ALLOC_CLIENT_FAILED]
+    assert len(healthy_live) == 1
+    assert healthy_live[0].id == orig.id
+
+
+def test_job_update_while_disconnected_updates_on_reconnect():
+    """The job was updated while the node was away: the reconnected
+    original is OLD version, so the next pass replaces/updates it — the
+    fleet converges to the new version (ref reconcile: reconnected allocs
+    flow into the normal update computation)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = disc_job(window=120.0, count=2)
+    run_all_running(h, job)
+    orig = allocs_of(h, job)[0]
+    set_node_status(h, orig.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    updated = job.copy()
+    updated.version = 1
+    updated.task_groups[0].tasks[0].config = {"command": "/bin/v1"}
+    register(h, updated)
+    process(h, updated)
+    set_node_status(h, orig.node_id, NODE_STATUS_READY)
+    process(h, updated, trigger=TRIGGER_NODE_UPDATE)
+    # the stale original is STOPPED (not version-laundered into v1 by
+    # plan job normalization); the newer replacement keeps the slot
+    assert h.state.alloc_by_id(orig.id).desired_status == \
+        ALLOC_DESIRED_STOP
+    # run passes to convergence: everything running
+    for _ in range(3):
+        for a in live(allocs_of(h, job)):
+            mark_running(h, a)
+        process(h, updated)
+    allocs = allocs_of(h, job)
+    assert no_duplicate_live_names(allocs)
+    live_now = live(allocs)
+    assert len(live_now) == 2
+    assert all(a.job.version == 1 for a in live_now), \
+        "reconnected old-version alloc was never converged to v1"
+
+
+def test_pending_alloc_on_down_node_does_not_ride_window():
+    """Only RUNNING allocs ride the disconnect window; a pending alloc on
+    the down node reschedules normally (ref reconcile_util.go: restoring
+    a never-started alloc to running would misstate health)."""
+    h = Harness()
+    seed_nodes(h, 6)
+    job = disc_job(window=120.0, count=2)
+    register(h, job)
+    process(h, job)                       # allocs still client=pending
+    victim = allocs_of(h, job)[0]
+    set_node_status(h, victim.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    orig = h.state.alloc_by_id(victim.id)
+    assert orig.client_status != ALLOC_CLIENT_UNKNOWN
+    assert len(live(allocs_of(h, job))) == 2
