@@ -398,7 +398,11 @@ class GenericScheduler:
         if djob is not None and djob.version >= missing.min_job_version:
             dtg = djob.lookup_task_group(tg.name)
             if dtg is not None:
-                return dtg, djob, (did or deployment_id)
+                # `did` verbatim, INCLUDING empty (ref :500 assigns dID
+                # as-is): attaching an old-version placement to the
+                # current canary deployment would pollute its
+                # placed/healthy accounting and progress deadline
+                return dtg, djob, did
         if self.ctx.logger:
             self.ctx.logger(
                 f"sched: no downgraded job version for {tg.name}; "

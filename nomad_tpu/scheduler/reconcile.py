@@ -32,6 +32,18 @@ DESC_RECONNECTED = "replacement stopped: original alloc reconnected"
 DESC_RECONNECT_EXPIRED = "alloc reconnected after max_client_disconnect"
 DESC_RECONNECT_OK = "alloc reconnected within max_client_disconnect"
 DESC_RECONNECT_OUTDATED = "reconnected alloc is an outdated job version"
+DESC_DUP_NAME = "duplicate name slot holder"
+
+
+def _rank_name_slot_holders(group: list) -> list:
+    """Order duplicate holders of one name slot best-first: live before
+    terminal, then highest job version, then the earliest-created (the
+    true original). Shared by the reconnect same-pass dedup and the
+    computeStop convergent cleanup so the keeper policy can't diverge."""
+    return sorted(group, key=lambda p: (
+        p[1].terminal_status(),
+        -(p[1].job.version if p[1].job else 0),
+        p[1].create_index))
 
 
 @dataclasses.dataclass(slots=True)
@@ -473,9 +485,20 @@ class AllocReconciler:
                     alloc.deployment_status and alloc.deployment_status.canary)),
                 min_job_version=alloc.job.version if alloc.job else 0))
         existing = len(untainted) + len(migrate) + len(reschedule)
+        # a lost alloc's name slot may ALREADY be covered: an unknown
+        # original that rode the max_client_disconnect window got a
+        # same-name replacement placed beside it — when it finally goes
+        # lost (window expiry / repeat node-down), replacing it again
+        # would double-fill the slot (two live non-canary holders). Only
+        # possible through the 1.3 disconnect flow; plain lost names are
+        # never held by untainted allocs.
+        held = {a.name for s in (untainted, migrate, reschedule)
+                for a in s.values()}
         for alloc in lost.values():
             if existing >= tg.count:
                 break
+            if alloc.name in held:
+                continue
             existing += 1
             place.append(AllocPlaceResult(
                 name=alloc.name, task_group=tg, previous_alloc=alloc,
@@ -504,6 +527,29 @@ class AllocReconciler:
 
         if canary_state:
             untainted = difference(untainted, canaries)
+
+        # convergent duplicate-name cleanup: historical churn (disconnect
+        # replacements, same-pass reconnects, lost-of-unknown) can leave
+        # two live holders of one name slot even when the total is within
+        # count — and once present, a duplicate self-propagates (each
+        # holder gets its own migrate/lost replacement). Stop the extras
+        # (keep highest job version, then the earliest-created) so every
+        # pass strictly reduces duplication; the freed coverage is placed
+        # under a FRESH name by computePlacements.
+        by_name: dict = {}
+        for aid, alloc in untainted.items():
+            by_name.setdefault(alloc.name, []).append((aid, alloc))
+        dups = [g for g in by_name.values() if len(g) > 1]
+        if dups:
+            untainted = dict(untainted)
+            for group in dups:
+                for aid, alloc in _rank_name_slot_holders(group)[1:]:
+                    if alloc.terminal_status():
+                        continue
+                    stop[aid] = alloc
+                    self.result.stop.append(AllocStopResult(
+                        alloc=alloc, status_description=DESC_DUP_NAME))
+                    untainted.pop(aid, None)
 
         remove = len(untainted) + len(migrate) - tg.count
         if remove <= 0:
@@ -668,6 +714,23 @@ class AllocReconciler:
                 desired.stop += 1
             else:
                 fresh[aid] = alloc
+        # the original AND its window-replacement can both have gone
+        # unknown (second node-down) and reconnect in the SAME pass —
+        # each looks like "the original", so without a per-name pick
+        # both restore and double-fill the slot. Keep one per name:
+        # highest job version, then the earliest-created (the true
+        # original) — the reference's reconnecting picker default.
+        by_name: dict = {}
+        for aid, alloc in fresh.items():
+            by_name.setdefault(alloc.name, []).append((aid, alloc))
+        for name, group in by_name.items():
+            if len(group) == 1:
+                continue
+            for aid, alloc in _rank_name_slot_holders(group)[1:]:
+                self.result.stop.append(AllocStopResult(
+                    alloc=alloc, status_description=DESC_RECONNECTED))
+                desired.stop += 1
+                del fresh[aid]
         originals_by_name = {a.name: aid for aid, a in fresh.items()}
         for aid, alloc in list(untainted.items()):
             orig = originals_by_name.get(alloc.name)

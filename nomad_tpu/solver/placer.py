@@ -756,6 +756,12 @@ class SolverPlacer:
                 desired_status="run", client_status="pending")
             if prev is not None:
                 alloc.previous_allocation = prev.id
+                if isinstance(missing, AllocPlaceResult) and \
+                        missing.reschedule:
+                    # the tracker must carry across generations on the
+                    # solver path too, or attempts never exhaust and the
+                    # penalty set forgets prior failed nodes
+                    sched._update_reschedule_tracker(alloc, prev)
             if place_dep_id and isinstance(missing, AllocPlaceResult) and \
                     missing.canary:
                 alloc.deployment_status = AllocDeploymentStatus(canary=True)

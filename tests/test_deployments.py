@@ -166,3 +166,112 @@ def test_auto_revert_rolls_back_to_stable(cluster):
         (cur := server.state.job_by_id("default", job.id)) is not None
         and cur.task_groups[0].tasks[0].config.get("run_for") == 300.0),
         timeout=30)
+
+
+def test_progress_deadline_expiry_fails_deployment(cluster):
+    """No alloc turns healthy before progress_deadline: the watcher fails
+    the deployment with the deadline description (ref
+    deploymentwatcher progress deadline; VERDICT r3 corpus ask)."""
+    server, clients = cluster
+    job = _service_job(count=1)
+    server.job_register(job)
+    assert wait_until(lambda: sum(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)) == 1)
+
+    v2 = job.copy()
+    task = v2.task_groups[0].tasks[0]
+    task.env = {"V": "2"}
+    # runs forever but NEVER becomes healthy inside the deadline
+    v2.task_groups[0].update.min_healthy_time_sec = 600
+    v2.task_groups[0].update.progress_deadline_sec = 0.5
+    server.job_register(v2)
+    assert wait_until(lambda: any(
+        d.status == DEPLOYMENT_STATUS_FAILED and
+        "progress deadline" in (d.status_description or "").lower()
+        for d in server.state.deployments_by_job("default", job.id)),
+        timeout=30), "deployment did not fail on progress deadline"
+
+
+def test_healthy_alloc_extends_progress_deadline(cluster):
+    """Each healthy alloc RESETS the progress clock: a rolling update
+    whose per-alloc time is under the deadline completes even though the
+    total exceeds it (ref deploymentwatcher: deadline is per-progress,
+    not per-deployment)."""
+    server, clients = cluster
+    job = _service_job(count=3)
+    server.job_register(job)
+    assert wait_until(lambda: sum(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)) == 3)
+
+    v2 = job.copy()
+    task = v2.task_groups[0].tasks[0]
+    task.env = {"V": "2"}
+    # per-alloc healthy time ~0.3s; deadline 2s; total rollout ~1s+ per
+    # wave x 3 waves (max_parallel=1) — succeeds only if progress resets
+    v2.task_groups[0].update.min_healthy_time_sec = 0.3
+    v2.task_groups[0].update.progress_deadline_sec = 2.0
+    server.job_register(v2)
+    assert wait_until(lambda: any(
+        d.status == DEPLOYMENT_STATUS_SUCCESSFUL and d.job_version >= 1
+        for d in server.state.deployments_by_job("default", job.id)),
+        timeout=30), "rolling update failed despite steady progress"
+
+
+def test_progress_deadline_failure_auto_reverts(cluster):
+    """Progress-deadline failure triggers auto-revert to the stable
+    version just like unhealthy-alloc failure."""
+    server, clients = cluster
+    job = _service_job(count=1)
+    job.task_groups[0].update.auto_revert = True
+    server.job_register(job)
+    assert wait_until(lambda: (
+        (d := server.state.latest_deployment_by_job("default", job.id))
+        is not None and d.status == DEPLOYMENT_STATUS_SUCCESSFUL),
+        timeout=30)
+    assert server.state.job_by_id("default", job.id).stable
+
+    v2 = job.copy()
+    task = v2.task_groups[0].tasks[0]
+    task.env = {"V": "2"}
+    v2.task_groups[0].update.auto_revert = True
+    v2.task_groups[0].update.min_healthy_time_sec = 600
+    v2.task_groups[0].update.progress_deadline_sec = 0.5
+    server.job_register(v2)
+    assert wait_until(lambda: any(
+        d.status == DEPLOYMENT_STATUS_FAILED
+        for d in server.state.deployments_by_job("default", job.id)),
+        timeout=30)
+    # reverted spec: the original long-running config
+    assert wait_until(lambda: (
+        (cur := server.state.job_by_id("default", job.id)) is not None
+        and cur.task_groups[0].tasks[0].env.get("V") != "2"), timeout=30)
+
+
+def test_manual_promote_rejected_with_unhealthy_canaries(cluster):
+    """Promotion requires every canary healthy (ref deploymentwatcher
+    PromoteDeployment: error when canaries are not healthy)."""
+    server, clients = cluster
+    job = _service_job(count=2)
+    job.task_groups[0].update.canary = 1
+    job.task_groups[0].update.min_healthy_time_sec = 600   # never healthy
+    server.job_register(job)
+    assert wait_until(lambda: sum(
+        a.client_status == ALLOC_CLIENT_RUNNING
+        for a in server.state.allocs_by_job("default", job.id)) == 2)
+
+    v2 = job.copy()
+    v2.task_groups[0].tasks[0].env = {"V": "2"}
+    server.job_register(v2)
+    assert wait_until(lambda: (
+        (d := server.state.latest_deployment_by_job("default", job.id))
+        is not None and d.job_version >= 1 and
+        any(st.placed_canaries for st in d.task_groups.values())),
+        timeout=30)
+    d = server.state.latest_deployment_by_job("default", job.id)
+    with pytest.raises(ValueError, match="canaries healthy"):
+        server.deployment_watcher.promote(d.id)
+    # deployment is untouched: not promoted, still active
+    d2 = server.state.deployment_by_id(d.id)
+    assert not any(st.promoted for st in d2.task_groups.values())
