@@ -30,6 +30,7 @@ class AllocRunner:
         self.alloc = alloc
         self._lock = threading.Lock()
         self.task_runners: dict[str, TaskRunner] = {}
+        self._template_watchers: dict[str, object] = {}
         self.task_states: dict[str, TaskState] = {}
         self._thread: Optional[threading.Thread] = None
         self._destroyed = threading.Event()
@@ -369,6 +370,7 @@ class AllocRunner:
 
         # template hook: render embedded templates against env + secrets +
         # the service catalog (ref taskrunner/template_hook.go)
+        tmpl_rendered: list = []
         if task.templates and not setup_error:
             from ..integrations.template import TemplateError, render_template
             for tmpl in task.templates:
@@ -378,18 +380,35 @@ class AllocRunner:
                         secret_reader=self.client.rpc.secret_read,
                         service_lookup=lambda name: self.client.rpc
                         .service_instances(self.alloc.namespace, name))
-                    rendered.append((tmpl.dest_path or "local/template",
-                                     content, tmpl.perms))
+                    tmpl_rendered.append((tmpl.dest_path or "local/template",
+                                          content, tmpl.perms))
                 except TemplateError as e:
                     setup_error = f"template render failed: {e}"
                     self.client.logger(setup_error)
                     break
+            rendered.extend(tmpl_rendered)
 
         tr = TaskRunner(self.alloc, task, driver, task_dir, env,
                         self._on_task_state, setup_error=setup_error,
                         rendered_files=rendered)
         with self._lock:
             self.task_runners[task.name] = tr
+
+        # template watch loop: re-render on service/KV/secret change and
+        # deliver change_mode (ref template.go handleTemplateRerenders)
+        if task.templates and not setup_error:
+            from ..integrations.template import TemplateWatcher
+            watcher = TemplateWatcher(
+                tr, task.templates, env,
+                secret_reader=self.client.rpc.secret_read,
+                service_lookup=lambda name: self.client.rpc
+                .service_instances(self.alloc.namespace, name),
+                interval=self.client.template_interval_sec,
+                logger=self.client.logger)
+            watcher.prime(tmpl_rendered)
+            watcher.start()
+            with self._lock:
+                self._template_watchers[task.name] = watcher
         return tr
 
     # --------------------------------------------------------------- state
@@ -398,6 +417,13 @@ class AllocRunner:
         """ref alloc_runner.go:486 handleTaskStateUpdates"""
         with self._lock:
             self.task_states[task_name] = state
+            # a task that reached a terminal state takes its template
+            # watcher with it — otherwise completed tasks leak a thread
+            # polling the catalog and firing doomed change_mode restarts
+            if state.state == TASK_STATE_DEAD:
+                watcher = self._template_watchers.pop(task_name, None)
+                if watcher is not None:
+                    watcher.stop()
             # a failed leader/main task takes the others down
             if state.state == TASK_STATE_DEAD and state.failed:
                 for name, tr in self.task_runners.items():
@@ -478,6 +504,9 @@ class AllocRunner:
     def stop(self) -> None:
         with self._lock:
             runners = list(self.task_runners.values())
+            watchers = list(self._template_watchers.values())
+        for w in watchers:
+            w.stop()
         for tr in runners:
             tr.kill("alloc stopped by server")
         self._dirty.set()

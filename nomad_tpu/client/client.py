@@ -45,6 +45,11 @@ class Client:
             self.drivers.update(self.plugin_drivers)
         else:
             self.plugin_drivers = {}
+        for d in self.drivers.values():
+            # catalog access (connect proxy); ext drivers are duck-typed
+            bind = getattr(d, "bind_client", None)
+            if bind is not None:
+                bind(self)
 
         from .csimanager import CSIManager
         self.csi_manager = CSIManager(self)
@@ -69,6 +74,8 @@ class Client:
         # GC knobs (ref client/config gc_interval, gc_disk_usage_threshold,
         # gc_max_allocs)
         self.gc_interval_sec = 60.0
+        # template watch cadence (consul-template's re-render loop analog)
+        self.template_interval_sec = 2.0
         self.gc_max_allocs = 50
         self.gc_disk_usage_threshold = 80.0
 
@@ -556,6 +563,8 @@ class Client:
             try:
                 self._gc_check()
                 self._reap_exec_sessions()
+                # the client half of the volume watcher's detach machine
+                self.csi_manager.reconcile_claims()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: gc pass failed: {e!r}")
 
@@ -637,11 +646,16 @@ class Client:
         except Exception as e:          # noqa: BLE001
             self.logger(f"client: device fingerprint update failed: {e!r}")
 
-    def register_csi_plugin(self, plugin_id: str, plugin) -> None:
-        """Attach a CSI node plugin and refresh the node fingerprint (ref
-        client/pluginmanager/csimanager fingerprint loop)."""
-        self.csi_manager.register_plugin(plugin_id, plugin)
+    def register_csi_plugin(self, plugin_id: str, plugin,
+                            controller: bool = False) -> None:
+        """Attach a CSI node (and optionally controller) plugin and
+        refresh the node fingerprint (ref client/pluginmanager/csimanager
+        fingerprint loop)."""
+        self.csi_manager.register_plugin(plugin_id, plugin,
+                                         controller=controller)
         self.node.csi_node_plugins = self.csi_manager.fingerprint()
+        self.node.csi_controller_plugins = \
+            self.csi_manager.fingerprint_controllers()
         try:
             self.rpc.node_register(self.node)
         except Exception as e:          # noqa: BLE001

@@ -38,6 +38,12 @@ class CSIPluginClient:
                               target_path: str) -> None:
         raise NotImplementedError
 
+    def controller_unpublish_volume(self, volume_id: str,
+                                    node_id: str) -> None:
+        """Detach the volume from the node at the storage backend (ref
+        plugins/csi ControllerUnpublishVolume). Only meaningful for
+        plugins with requires_controller; default no-op."""
+
 
 class HostPathCSIPlugin(CSIPluginClient):
     """Node-local directory-backed volumes (the csi-driver-host-path
@@ -76,16 +82,24 @@ class CSIManager:
     def __init__(self, client):
         self.client = client
         self.plugins: dict[str, CSIPluginClient] = {}
+        self.controller_plugins: dict[str, CSIPluginClient] = {}
         # (alloc_id, vol_id) -> (plugin_id, target_path)
         self._mounts: dict[tuple[str, str], tuple[str, str]] = {}
 
-    def register_plugin(self, plugin_id: str,
-                        plugin: CSIPluginClient) -> None:
+    def register_plugin(self, plugin_id: str, plugin: CSIPluginClient,
+                        controller: bool = False) -> None:
         self.plugins[plugin_id] = plugin
+        if controller or plugin.requires_controller:
+            self.controller_plugins[plugin_id] = plugin
 
     def fingerprint(self) -> dict[str, dict]:
         """node.csi_node_plugins payload."""
         return {pid: p.fingerprint() for pid, p in self.plugins.items()}
+
+    def fingerprint_controllers(self) -> dict[str, dict]:
+        """node.csi_controller_plugins payload."""
+        return {pid: p.fingerprint()
+                for pid, p in self.controller_plugins.items()}
 
     # ------------------------------------------------------------- mounts
 
@@ -114,6 +128,77 @@ class CSIManager:
                                    vol.context)
         return target
 
+    # ---------------------------------------------- watcher-driven detach
+
+    def reconcile_claims(self) -> int:
+        """The client half of the volume watcher's unpublish state machine
+        (ref volumewatcher/volume_watcher.go + csi_hook): the server marks
+        which claims need node/controller detach; this node performs the
+        plugin RPCs it can serve and confirms via claim updates. Pull
+        model — the client polls, matching the alloc-watch design — so no
+        server->client channel is needed. Returns detaches performed."""
+        from ..structs.csi import (
+            CLAIM_STATE_CONTROLLER_DETACHED, CLAIM_STATE_NODE_DETACHED,
+        )
+        done = 0
+        node_id = self.client.node.id
+        try:
+            pending = self.client.rpc.csi_node_detach_pending(node_id)
+        except Exception:           # noqa: BLE001 — servers unreachable
+            return done
+        for item in pending:
+            plugin = self.plugins.get(item["plugin_id"])
+            if plugin is None:
+                continue
+            target = self._detach_target(item["alloc_id"], item["volume_id"])
+            try:
+                plugin.node_unpublish_volume(item["volume_id"], target)
+                self.client.rpc.csi_volume_claim(
+                    item["namespace"], item["volume_id"],
+                    CSIVolumeClaim(alloc_id=item["alloc_id"],
+                                   node_id=node_id,
+                                   state=CLAIM_STATE_NODE_DETACHED))
+                done += 1
+            except Exception as e:  # noqa: BLE001 — retried next pass
+                self.client.logger(f"csi: node detach failed: {e!r}")
+        try:
+            pending = self.client.rpc.csi_controller_detach_pending(
+                list(self.controller_plugins), node_id)
+        except Exception:           # noqa: BLE001
+            return done
+        for item in pending:
+            plugin = self.controller_plugins.get(item["plugin_id"])
+            if plugin is None:
+                continue
+            try:
+                plugin.controller_unpublish_volume(item["volume_id"],
+                                                   item["node_id"])
+                self.client.rpc.csi_volume_claim(
+                    item["namespace"], item["volume_id"],
+                    CSIVolumeClaim(alloc_id=item["alloc_id"],
+                                   node_id=item["node_id"],
+                                   state=CLAIM_STATE_CONTROLLER_DETACHED))
+                done += 1
+            except Exception as e:  # noqa: BLE001 — retried next pass
+                self.client.logger(f"csi: controller detach failed: {e!r}")
+        return done
+
+    def _detach_target(self, alloc_id: str, vol_id: str) -> str:
+        """Mount target for a claim — from the live mount record, or the
+        conventional path when this client restarted and lost the map."""
+        rec = self._mounts.get((alloc_id, vol_id))
+        if rec is not None:
+            return rec[1]
+        vol_dir = os.path.join(self.client.alloc_dir_root, alloc_id,
+                               "volumes")
+        if os.path.isdir(vol_dir):
+            for name in os.listdir(vol_dir):
+                path = os.path.join(vol_dir, name)
+                if os.path.islink(path) and \
+                        os.path.basename(os.readlink(path)) == vol_id:
+                    return path
+        return os.path.join(vol_dir, vol_id)
+
     def unmount_all(self, alloc) -> None:
         """Unpublish + release every claim this alloc holds (ref
         csimanager UnmountVolume + csi_hook Postrun)."""
@@ -127,12 +212,21 @@ class CSIManager:
                     plugin.node_unpublish_volume(vol_id, target)
                 except Exception as e:  # noqa: BLE001 — must keep releasing
                     self.client.logger(f"csi: unpublish failed: {e!r}")
+            # a requires_controller plugin still owes the CONTROLLER
+            # unpublish round: release only to node-detached and let the
+            # volume watcher drive the controller RPC (free would leave
+            # the volume attached at the storage backend). Controller-
+            # less plugins free directly — the common fast path.
+            from ..structs.csi import CLAIM_STATE_NODE_DETACHED
+            state = CLAIM_STATE_READY_TO_FREE
+            if plugin is not None and plugin.requires_controller:
+                state = CLAIM_STATE_NODE_DETACHED
             try:
                 self.client.rpc.csi_volume_claim(
                     alloc.namespace, vol_id,
                     CSIVolumeClaim(alloc_id=alloc.id,
                                    node_id=self.client.node.id,
-                                   state=CLAIM_STATE_READY_TO_FREE))
+                                   state=state))
             except Exception as e:      # noqa: BLE001 — server may be gone
                 self.client.logger(f"csi: release claim failed: {e!r}")
             del self._mounts[(alloc_id, vol_id)]

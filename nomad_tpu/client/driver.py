@@ -189,6 +189,10 @@ class Driver:
     def fingerprint(self) -> DriverInfo:
         return DriverInfo(detected=True, healthy=True)
 
+    def bind_client(self, client) -> None:
+        """Drivers needing cluster access (catalog resolution etc.) get
+        the owning client after construction; default no-op."""
+
     def start_task(self, task_id: str, task, task_dir: str,
                    env: dict[str, str]) -> TaskHandle:
         raise NotImplementedError
@@ -383,6 +387,106 @@ def _open_log_sinks(task_dir: str, task):
     stdout = open(os.path.join(task_dir, f"{task.name}.stdout.log"), "ab")
     stderr = open(os.path.join(task_dir, f"{task.name}.stderr.log"), "ab")
     return stdout, stderr, []
+
+
+class ConnectProxyDriver(Driver):
+    """The sidecar data plane for connect_admission-injected proxy tasks
+    (ref envoy in the reference; here an in-process threaded TCP proxy —
+    see integrations/connect.py for the mesh wiring). Ingress listener:
+    allocated dynamic port -> 127.0.0.1:<local service port>. Upstream
+    listeners: 127.0.0.1:<local_bind_port> -> a healthy catalog instance
+    of the destination, resolved PER CONNECTION through the client's RPC
+    (instances move; the mesh follows)."""
+
+    name = "connect_proxy"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: dict[str, dict] = {}
+        self._client = None
+
+    def bind_client(self, client) -> None:
+        self._client = client
+
+    def _resolver(self, namespace: str, destination: str):
+        def resolve():
+            client = self._client
+            if client is None:
+                return None
+            try:
+                instances = client.rpc.service_instances(namespace,
+                                                         destination)
+            except Exception:           # noqa: BLE001 — servers away
+                return None
+            healthy = [i for i in instances
+                       if getattr(i, "status", "passing") == "passing"]
+            if not healthy:
+                return None
+            inst = healthy[int(time.time() * 1000) % len(healthy)]
+            return (inst.address, inst.port)
+        return resolve
+
+    def start_task(self, task_id, task, task_dir, env):
+        from ..integrations.connect import _Forwarder
+        cfg = task.config
+        logger = (self._client.logger if self._client is not None
+                  else (lambda m: None))
+        from .taskenv import _env_key
+        forwarders: list = []
+        ingress_label = _env_key(cfg.get("ingress_port_label", ""))
+        ingress_port = int(env.get(f"NOMAD_PORT_{ingress_label}", 0) or 0)
+        svc_label = _env_key(cfg.get("local_service_port_label", ""))
+        svc_port = int(env.get(f"NOMAD_PORT_{svc_label}", 0) or 0)
+        if ingress_port and svc_port:
+            forwarders.append(_Forwarder(
+                ("0.0.0.0", ingress_port),
+                lambda: ("127.0.0.1", svc_port), logger,
+                name=f"connect-ingress-{task_id[:8]}"))
+        ns = cfg.get("namespace", "default")
+        for up in cfg.get("upstreams", []):
+            forwarders.append(_Forwarder(
+                ("127.0.0.1", int(up["local_bind_port"])),
+                self._resolver(ns, up["destination"]), logger,
+                name=f"connect-up-{up['destination']}-{task_id[:8]}"))
+        for f in forwarders:
+            f.start()
+        rec = {"forwarders": forwarders, "stopped": threading.Event(),
+               "started_at": time.time()}
+        with self._lock:
+            self._tasks[task_id] = rec
+        return TaskHandle(task_id=task_id, driver=self.name,
+                          started_at=rec["started_at"])
+
+    def wait_task(self, task_id, timeout=None):
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            return ExitResult(err="unknown task")
+        if rec["stopped"].wait(timeout):
+            return ExitResult(exit_code=0)
+        return None
+
+    def stop_task(self, task_id, kill_timeout=5.0, sig=""):
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            return
+        for f in rec["forwarders"]:
+            f.stop()
+        rec["stopped"].set()
+
+    def destroy_task(self, task_id):
+        self.stop_task(task_id)
+        with self._lock:
+            self._tasks.pop(task_id, None)
+
+    def inspect_task(self, task_id):
+        with self._lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            raise KeyError(task_id)
+        return {"connections": sum(f.connections
+                                   for f in rec["forwarders"])}
 
 
 class RawExecDriver(Driver):
@@ -584,6 +688,7 @@ def _docker_driver():
 BUILTIN_DRIVERS = {
     "mock_driver": MockDriver,
     "raw_exec": RawExecDriver,
+    "connect_proxy": ConnectProxyDriver,   # the sidecar data plane
     "exec": _exec_driver,       # native C++ executor supervisor
     "java": _java_driver,
     "qemu": _qemu_driver,       # gated: fingerprints only with qemu present

@@ -194,34 +194,109 @@ def _metadata_get(url: str, headers: dict, timeout: float) -> str:
         return resp.read().decode()
 
 
-def fp_cloud_env(node: Node, cfg: dict) -> None:
-    """ref client/fingerprint/env_aws.go / env_gce.go / env_azure.go:
-    probe the cloud metadata service with a short timeout; absence is
-    normal (bare metal / air-gapped). `cfg['metadata_get']` is injectable
-    for tests."""
+def _probe_cloud(node: Node, cfg: dict, name: str, base: str,
+                 headers: dict, gate: str, keys: list) -> bool:
+    """Shared metadata prober for the per-cloud fingerprinters. The GATE
+    key must answer (that's the platform-detection signal, ref
+    env_aws.go isAWS / env_gce.go isGCE); remaining keys are collected
+    best-effort, each behind the same short timeout. Returns detected."""
+    if node.attributes.get("platform"):
+        return False                     # an earlier cloud already won
     get = cfg.get("metadata_get", _metadata_get)
     timeout = float(cfg.get("metadata_timeout", 0.2))
-    probes = [
-        ("aws", "http://169.254.169.254/latest/meta-data/",
-         {}, [("instance-type", "platform.aws.instance-type"),
-              ("placement/availability-zone", "platform.aws.placement.availability-zone"),
-              ("local-ipv4", "unique.platform.aws.local-ipv4")]),
-        ("gce", "http://169.254.169.254/computeMetadata/v1/instance/",
-         {"Metadata-Flavor": "Google"},
-         [("machine-type", "platform.gce.machine-type"),
-          ("zone", "platform.gce.zone"),
-          ("hostname", "unique.platform.gce.hostname")]),
-    ]
-    for name, base, headers, keys in probes:
+    try:
+        gate_val = get(base + gate, headers, timeout).strip()
+    except Exception:                    # noqa: BLE001 — not on this cloud
+        return False
+    collected = {}
+    for path, attr in keys:
+        if path == gate:
+            collected[attr] = gate_val
+            continue
         try:
-            for path, attr in keys:
-                node.attributes[attr] = get(base + path, headers,
-                                            timeout).strip()
-            node.attributes["platform"] = name
-            return                       # first cloud that answers wins
-        except Exception:                # noqa: BLE001 - not on this cloud
-            for _, attr in keys:
-                node.attributes.pop(attr, None)
+            collected[attr] = get(base + path, headers, timeout).strip()
+        except Exception:                # noqa: BLE001 — best-effort key
+            pass
+    node.attributes.update(collected)
+    node.attributes["platform"] = name
+    return True
+
+
+def fp_env_aws(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/env_aws.go: EC2 IMDS attribute set."""
+    keys = [
+        ("instance-type", "platform.aws.instance-type"),
+        ("ami-id", "platform.aws.ami-id"),
+        ("placement/availability-zone",
+         "platform.aws.placement.availability-zone"),
+        ("local-ipv4", "unique.platform.aws.local-ipv4"),
+        ("local-hostname", "unique.platform.aws.local-hostname"),
+        ("public-ipv4", "unique.platform.aws.public-ipv4"),
+        ("public-hostname", "unique.platform.aws.public-hostname"),
+        ("mac", "unique.platform.aws.mac"),
+        ("instance-life-cycle", "platform.aws.instance-life-cycle"),
+    ]
+    _probe_cloud(node, cfg, "aws",
+                 "http://169.254.169.254/latest/meta-data/", {},
+                 "instance-type", keys)
+
+
+def fp_env_gce(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/env_gce.go: GCE metadata attribute set."""
+    keys = [
+        ("machine-type", "platform.gce.machine-type"),
+        ("zone", "platform.gce.zone"),
+        ("hostname", "unique.platform.gce.hostname"),
+        ("id", "unique.platform.gce.id"),
+        ("network-interfaces/0/ip", "unique.platform.gce.network.ip"),
+        ("network-interfaces/0/access-configs/0/external-ip",
+         "unique.platform.gce.network.external-ip"),
+        ("scheduling/automatic-restart", "platform.gce.scheduling.automatic-restart"),
+        ("scheduling/preemptible", "platform.gce.scheduling.preemptible"),
+    ]
+    _probe_cloud(node, cfg, "gce",
+                 "http://169.254.169.254/computeMetadata/v1/instance/",
+                 {"Metadata-Flavor": "Google"}, "machine-type", keys)
+
+
+def fp_env_azure(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/env_azure.go: Azure IMDS attribute set."""
+    q = "?api-version=2019-06-04&format=text"
+    keys = [
+        ("vmSize" + q, "platform.azure.compute.vm-size"),
+        ("location" + q, "platform.azure.compute.location"),
+        ("name" + q, "unique.platform.azure.compute.name"),
+        ("resourceGroupName" + q,
+         "platform.azure.compute.resource-group-name"),
+        ("vmId" + q, "unique.platform.azure.compute.vm-id"),
+        ("zone" + q, "platform.azure.compute.zone"),
+        ("vmScaleSetName" + q, "platform.azure.compute.scale-set-name"),
+    ]
+    _probe_cloud(node, cfg, "azure",
+                 "http://169.254.169.254/metadata/instance/compute/",
+                 {"Metadata": "true"}, "vmSize" + q, keys)
+
+
+def fp_cni(node: Node, cfg: dict) -> None:
+    """ref client/fingerprint/cni.go: scan the CNI config dir for
+    .conf/.conflist networks -> plugins.cni.network.<name>."""
+    import json as _json
+    cni_dir = cfg.get("cni_config_dir", "/opt/cni/config")
+    if not os.path.isdir(cni_dir):
+        return
+    for fn in sorted(os.listdir(cni_dir)):
+        if not (fn.endswith(".conf") or fn.endswith(".conflist")
+                or fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(cni_dir, fn)) as f:
+                conf = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        name = conf.get("name")
+        if name:
+            node.attributes[f"plugins.cni.network.{name}"] = \
+                str(conf.get("cniVersion", "unknown"))
 
 
 def fp_os(node: Node, cfg: dict) -> None:
@@ -307,7 +382,10 @@ FINGERPRINTERS = [
     ("cgroup", fp_cgroup),
     ("bridge", fp_bridge),
     ("network", fp_network),
-    ("cloud_env", fp_cloud_env),
+    ("env_aws", fp_env_aws),
+    ("env_gce", fp_env_gce),
+    ("env_azure", fp_env_azure),
+    ("cni", fp_cni),
     ("consul", fp_consul),
     ("vault", fp_vault),
 ]

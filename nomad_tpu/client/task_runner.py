@@ -119,18 +119,7 @@ class TaskRunner:
         os.makedirs(os.path.join(self.task_dir, "local"), exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "secrets"), exist_ok=True)
         for rel, content, perms in self.rendered_files:
-            path = os.path.join(self.task_dir, rel.lstrip("/"))
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            try:
-                mode = int(perms, 8)
-            except (ValueError, TypeError):
-                mode = 0o600
-            # create with the final mode from the start: secrets must never
-            # transit through a umask-default world-readable window
-            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
-            with os.fdopen(fd, "w") as f:
-                f.write(content)
-            os.chmod(path, mode)   # existing file: tighten to the ask
+            self.write_rendered_file(rel, content, perms)
         # log rotation per the task's log stanza (ref logmon_hook.go).
         # When THIS task's driver pipes output through the native
         # nomad-logmon sidecar, the sidecar owns rotation — running the
@@ -197,6 +186,24 @@ class TaskRunner:
         return True
 
     # ---------------------------------------------------------------- kill
+
+    def write_rendered_file(self, rel: str, content: str,
+                            perms: str = "0644") -> str:
+        """Write a rendered template/secret into the task dir. Also the
+        re-render path of the template watcher (change_mode flow)."""
+        path = os.path.join(self.task_dir, rel.lstrip("/"))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            mode = int(perms, 8)
+        except (ValueError, TypeError):
+            mode = 0o600
+        # create with the final mode from the start: secrets must never
+        # transit through a umask-default world-readable window
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        with os.fdopen(fd, "w") as f:
+            f.write(content)
+        os.chmod(path, mode)   # existing file: tighten to the ask
+        return path
 
     def kill(self, reason: str = "") -> None:
         self._emit(EVENT_KILLING, reason or "task is being killed")

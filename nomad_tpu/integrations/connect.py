@@ -1,0 +1,167 @@
+"""Service-mesh analog: Connect sidecar injection + the proxy itself
+(ref nomad/job_endpoint_hooks.go jobConnectHook — admission-time sidecar
+task/port injection — and client/allocrunner/taskrunner/
+envoy_bootstrap_hook.go; the envoy data plane is replaced by an in-process
+TCP proxy driver, the framework-native equivalent).
+
+Mesh wiring:
+  * every `connect.sidecar_service` service gets a dynamic ingress port
+    and a `connect-proxy-<service>` prestart-sidecar task; the service is
+    REGISTERED at the proxy's ingress port, so mesh traffic always enters
+    through the sidecar (ingress -> 127.0.0.1:<service port>);
+  * each declared upstream gets a local listener in the downstream's
+    sidecar (127.0.0.1:<local_bind_port> -> a healthy catalog instance of
+    the destination, which is itself that instance's sidecar ingress);
+    tasks find it via NOMAD_UPSTREAM_ADDR_<dest> env, like the reference.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..structs import (
+    NetworkResource, Port, Resources, Task, TaskLifecycle,
+)
+
+PROXY_PREFIX = "connect-proxy-"
+
+
+def _sanitize(name: str) -> str:
+    return name.replace("-", "_").upper()
+
+
+def connect_admission(job) -> None:
+    """Admission mutator (ref job_endpoint_hooks.go:1): expand
+    sidecar_service stanzas into proxy tasks + ports + upstream env.
+    Idempotent — re-registering an already-expanded job injects nothing."""
+    for tg in job.task_groups:
+        sidecars = [s for s in tg.services
+                    if s.connect and s.connect.get("SidecarService")
+                    is not None]
+        if not sidecars:
+            continue
+        existing = {t.name for t in tg.tasks}
+        if tg.networks:
+            net = tg.networks[0]
+        else:
+            net = NetworkResource()
+            tg.networks.append(net)
+        upstream_env: dict[str, str] = {}
+        for svc in sidecars:
+            proxy_task = PROXY_PREFIX + svc.name
+            port_label = proxy_task
+            sc = svc.connect["SidecarService"]
+            upstreams = (sc.get("Proxy") or {}).get("Upstreams") or []
+            for up in upstreams:
+                upstream_env[
+                    f"NOMAD_UPSTREAM_ADDR_{_sanitize(up['DestinationName'])}"
+                ] = f"127.0.0.1:{up['LocalBindPort']}"
+            if proxy_task in existing:
+                continue            # already expanded (job re-register)
+            if not any(p.label == port_label for p in net.dynamic_ports):
+                net.dynamic_ports.append(Port(label=port_label))
+            tg.tasks.append(Task(
+                name=proxy_task,
+                driver="connect_proxy",
+                lifecycle=TaskLifecycle(hook="prestart", sidecar=True),
+                config={
+                    "service": svc.name,
+                    "namespace": job.namespace,
+                    "ingress_port_label": port_label,
+                    "local_service_port_label": svc.port_label,
+                    "upstreams": [
+                        {"destination": up["DestinationName"],
+                         "local_bind_port": int(up["LocalBindPort"])}
+                        for up in upstreams],
+                },
+                resources=Resources(cpu=50, memory_mb=32),
+            ))
+            # the mesh entry point IS the proxy: register the service at
+            # the ingress port (ref job_endpoint_hooks: sidecar service
+            # port rewrite)
+            svc.port_label = port_label
+        if upstream_env:
+            for task in tg.tasks:
+                if task.name.startswith(PROXY_PREFIX):
+                    continue
+                for k, v in upstream_env.items():
+                    task.env.setdefault(k, v)
+
+
+class _Forwarder(threading.Thread):
+    """One listener: accept -> resolve target -> bidirectional splice."""
+
+    def __init__(self, bind: tuple, resolve, logger, name: str):
+        super().__init__(daemon=True, name=name)
+        self.bind = bind
+        self.resolve = resolve              # () -> (host, port) or None
+        self.logger = logger
+        self._stop = threading.Event()
+        self.sock: socket.socket | None = None
+        self.connections = 0
+
+    def run(self) -> None:
+        try:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(self.bind)
+            srv.listen(16)
+            srv.settimeout(0.5)
+            self.sock = srv
+        except OSError as e:
+            self.logger(f"connect-proxy: bind {self.bind} failed: {e!r}")
+            return
+        while not self._stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            target = self.resolve()
+            if target is None:
+                conn.close()
+                continue
+            self.connections += 1
+            threading.Thread(target=self._splice, args=(conn, target),
+                             daemon=True).start()
+        try:
+            srv.close()
+        except OSError:
+            pass
+
+    def _splice(self, conn: socket.socket, target: tuple) -> None:
+        try:
+            out = socket.create_connection(target, timeout=5.0)
+        except OSError as e:
+            self.logger(f"connect-proxy: dial {target} failed: {e!r}")
+            conn.close()
+            return
+
+        def pump(a, b):
+            try:
+                while True:
+                    data = a.recv(65536)
+                    if not data:
+                        break
+                    b.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (a, b):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+        t = threading.Thread(target=pump, args=(out, conn), daemon=True)
+        t.start()
+        pump(conn, out)
+        for s in (conn, out):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -9,7 +9,9 @@ on, resolved against framework-native sources:
   {{ key "path" }}                  service-catalog KV -> secrets provider
   {{ secret "path" "field" }}       secrets provider read (field optional)
   {{ service "name" }}              -> "addr:port" of first healthy instance
-  {{ range service "name" }}...{{ end }} is NOT supported (static subset)
+  {{ range service "name" }}        iterate healthy instances; the body may
+      {{ .Address }} {{ .Port }} {{ .Name }}
+  {{ end }}
 """
 from __future__ import annotations
 
@@ -20,6 +22,10 @@ from typing import Callable, Optional
 _FUNC = re.compile(
     r"\{\{\s*(env|key|secret|service)\s+\"([^\"]+)\"(?:\s+\"([^\"]+)\")?"
     r"\s*\}\}")
+_RANGE = re.compile(
+    r"\{\{\s*range\s+service\s+\"([^\"]+)\"\s*\}\}(.*?)\{\{\s*end\s*\}\}",
+    re.DOTALL)
+_FIELD = re.compile(r"\{\{\s*\.(Address|Port|Name)\s*\}\}")
 
 
 class TemplateError(Exception):
@@ -65,4 +71,104 @@ def render_template(tmpl: str, env: dict[str, str],
             return f"{inst.address}:{inst.port}"
         raise TemplateError(f"unknown function {fn!r}")
 
-    return _FUNC.sub(sub, tmpl)
+    def sub_range(m: re.Match) -> str:
+        name, body = m.group(1), m.group(2)
+        if service_lookup is None:
+            raise TemplateError("no service catalog configured")
+        healthy = [i for i in service_lookup(name)
+                   if getattr(i, "status", "passing") == "passing"]
+        out = []
+        for inst in healthy:
+            out.append(_FIELD.sub(
+                lambda fm, inst=inst: str({
+                    "Address": inst.address, "Port": inst.port,
+                    "Name": getattr(inst, "name", name),
+                }[fm.group(1)]), body))
+        return "".join(out)
+
+    return _FUNC.sub(sub, _RANGE.sub(sub_range, tmpl))
+
+
+class TemplateWatcher:
+    """Watch -> re-render -> change_mode, the consul-template runner loop
+    (ref client/allocrunner/taskrunner/template/template.go:
+    handleTemplateRerenders). Poll-and-compare against the framework-native
+    sources: each tick re-renders every template; when the output changes
+    the file is rewritten in the task dir and the task receives its
+    configured change_mode (signal / restart / noop). A render error mid-
+    watch (a dependency vanished) keeps the LAST rendered content — the
+    reference blocks rather than clobbering a running task's config."""
+
+    def __init__(self, task_runner, templates, env: dict,
+                 secret_reader=None, service_lookup=None,
+                 interval: float = 2.0, logger=None):
+        import threading
+        self.tr = task_runner
+        self.templates = list(templates)
+        self.env = env
+        self.secret_reader = secret_reader
+        self.service_lookup = service_lookup
+        self.interval = interval
+        self.logger = logger or (lambda msg: None)
+        self._last: dict[int, str] = {}
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.rerenders = 0          # observability + tests
+
+    def prime(self, rendered: list) -> None:
+        """Record the initial render (list of (rel, content, perms)) so
+        the first tick doesn't re-fire change_mode."""
+        for i, (_, content, _) in enumerate(rendered):
+            self._last[i] = content
+
+    def start(self) -> None:
+        import threading
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"template-watch-{self.tr.task.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"template watch: {e!r}")
+
+    def tick(self) -> int:
+        """One re-render pass; returns how many templates changed."""
+        changed = 0
+        for i, tmpl in enumerate(self.templates):
+            try:
+                content = render_template(
+                    tmpl.embedded_tmpl, self.env,
+                    secret_reader=self.secret_reader,
+                    service_lookup=self.service_lookup)
+            except TemplateError:
+                continue                # keep last content; retry next tick
+            if content == self._last.get(i):
+                continue
+            self._last[i] = content
+            self.tr.write_rendered_file(tmpl.dest_path or "local/template",
+                                        content, tmpl.perms)
+            changed += 1
+            self.rerenders += 1
+            self._fire_change_mode(tmpl)
+        return changed
+
+    def _fire_change_mode(self, tmpl) -> None:
+        mode = tmpl.change_mode or "restart"
+        if mode == "noop":
+            return
+        try:
+            if mode == "signal":
+                self.tr.signal(tmpl.change_signal or "SIGHUP",
+                               reason="template re-rendered")
+            else:
+                self.tr.restart(reason="template re-rendered")
+        except Exception as e:          # noqa: BLE001
+            self.logger(f"template change_mode {mode}: {e!r}")

@@ -255,7 +255,18 @@ def _parse_service(sec: _Section, blk: Block) -> Service:
         sp = c.block("sidecar_service")
         if sp is not None:
             sps = sec.sub(sp)
-            connect["SidecarService"] = {"Port": sps.get("port", "")}
+            sc: dict = {"Port": sps.get("port", "")}
+            pblk = sp.body.blocks("proxy") if hasattr(sp, "body") else []
+            for pb in pblk:
+                ups = []
+                for ub in pb.body.blocks("upstreams"):
+                    u = sec.sub(ub)
+                    ups.append({
+                        "DestinationName": u.get("destination_name", ""),
+                        "LocalBindPort": int(u.get("local_bind_port", 0)),
+                    })
+                sc["Proxy"] = {"Upstreams": ups}
+            connect["SidecarService"] = sc
     return Service(name=s.get("name", ""),
                    port_label=str(s.get("port", "")),
                    tags=[str(t) for t in (s.get("tags", []) or [])],

@@ -56,6 +56,7 @@ def node() -> Node:
             "exec": DriverInfo(detected=True, healthy=True),
             "mock_driver": DriverInfo(detected=True, healthy=True),
             "raw_exec": DriverInfo(detected=True, healthy=True),
+            "connect_proxy": DriverInfo(detected=True, healthy=True),
         },
     )
     n.compute_class()

@@ -216,6 +216,14 @@ class ServerRpc:
     def csi_volume_claim(self, namespace: str, volume_id: str, claim):
         return self.rpc.call("CSIVolume.Claim", namespace, volume_id, claim)
 
+    def csi_node_detach_pending(self, node_id: str):
+        return self.rpc.call("CSIVolume.NodeDetachPending", node_id)
+
+    def csi_controller_detach_pending(self, plugin_ids: list,
+                                      node_id: str = ""):
+        return self.rpc.call("CSIVolume.ControllerDetachPending",
+                             plugin_ids, node_id)
+
     def vault_derive_token(self, alloc_id: str, task: str):
         return self.rpc.call("Vault.DeriveToken", alloc_id, task)
 

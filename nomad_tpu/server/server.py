@@ -77,6 +77,9 @@ RPC_ENDPOINTS = {
     "CSIVolume.Claim": ("csi_volume_claim", True),
     "CSIVolume.List": ("csi_volume_list", False),
     "CSIVolume.Get": ("csi_volume_get", False),
+    "CSIVolume.NodeDetachPending": ("csi_node_detach_pending", False),
+    "CSIVolume.ControllerDetachPending":
+        ("csi_controller_detach_pending", False),
     "CSIPlugin.List": ("csi_plugin_list", False),
     "CSIPlugin.Get": ("csi_plugin_get", False),
     "Service.Register": ("service_register", True),
@@ -610,8 +613,11 @@ class Server:
     # ------------------------------------------------------- Job endpoints
 
     def job_register(self, job: Job) -> dict:
-        """ref nomad/job_endpoint.go:80 Job.Register (admission hooks are the
-        jobspec layer's validate/canonicalize)."""
+        """ref nomad/job_endpoint.go:80 Job.Register (admission hooks:
+        connect sidecar expansion + the jobspec layer's
+        validate/canonicalize)."""
+        from ..integrations.connect import connect_admission
+        connect_admission(job)
         err = self._validate_job(job)
         if err:
             raise ValueError(err)
@@ -999,11 +1005,16 @@ class Server:
         """Claim (or release, via claim.state) a volume for an alloc
         (ref csi_endpoint.go CSIVolume.Claim)."""
         from .fsm import CSI_VOLUME_CLAIM
-        from ..structs.csi import CLAIM_STATE_READY_TO_FREE
+        from ..structs.csi import (
+            CLAIM_STATE_CONTROLLER_DETACHED, CLAIM_STATE_NODE_DETACHED,
+            CLAIM_STATE_READY_TO_FREE,
+        )
         vol = self.state.csi_volume_by_id(namespace, volume_id)
         if vol is None:
             raise ValueError(f"volume {volume_id!r} not found")
-        if claim.state != CLAIM_STATE_READY_TO_FREE:
+        if claim.state not in (CLAIM_STATE_READY_TO_FREE,
+                               CLAIM_STATE_NODE_DETACHED,
+                               CLAIM_STATE_CONTROLLER_DETACHED):
             if not vol.schedulable:
                 raise ValueError(f"volume {volume_id!r} is not schedulable")
             # enforce claim limits BEFORE the raft round-trip: the clustered
@@ -1025,6 +1036,66 @@ class Server:
     def csi_volume_list(self, namespace: Optional[str] = None,
                         plugin_id: Optional[str] = None) -> list:
         return self.state.iter_csi_volumes(namespace, plugin_id)
+
+    def _claim_alloc_gone(self, claim) -> bool:
+        alloc = self.state.alloc_by_id(claim.alloc_id)
+        return alloc is None or alloc.terminal_status()
+
+    def csi_node_detach_pending(self, node_id: str) -> list[dict]:
+        """Claims on `node_id` awaiting NODE unpublish: alloc terminal or
+        gone, claim still in the taken state. The node's csimanager polls
+        this and confirms each detach with a node-detached claim update
+        (the pull-model half of volumewatcher/volume_watcher.go)."""
+        from ..structs.csi import CLAIM_STATE_TAKEN
+        out = []
+        for vol in self.state.iter_csi_volumes():
+            for claim in list(vol.read_claims.values()) + \
+                    list(vol.write_claims.values()):
+                if claim.node_id != node_id or \
+                        claim.state != CLAIM_STATE_TAKEN:
+                    continue
+                if not self._claim_alloc_gone(claim):
+                    continue
+                out.append({"namespace": vol.namespace,
+                            "volume_id": vol.id,
+                            "alloc_id": claim.alloc_id,
+                            "plugin_id": vol.plugin_id})
+        return out
+
+    def csi_controller_detach_pending(self, plugin_ids: list[str],
+                                      node_id: str = "") -> list[dict]:
+        """Claims awaiting CONTROLLER unpublish for plugins this caller
+        hosts a controller for: node detach done, plugin requires a
+        controller round before the claim can free. The round is LEASED
+        to one controller node (lowest healthy id) so concurrent
+        controller hosts don't issue duplicate backend unpublishes — the
+        reference serializes this through the server-side volumewatcher."""
+        from ..structs.csi import CLAIM_STATE_NODE_DETACHED
+        wanted = set(plugin_ids)
+        out = []
+        for vol in self.state.iter_csi_volumes():
+            if vol.plugin_id not in wanted:
+                continue
+            plug = self.state.csi_plugin_by_id(vol.plugin_id)
+            if plug is None or not plug.controller_required:
+                continue
+            if node_id:
+                healthy = sorted(nid for nid, ok in plug.controllers.items()
+                                 if ok)
+                if healthy and node_id != healthy[0]:
+                    continue        # another node holds the lease
+            for claim in list(vol.read_claims.values()) + \
+                    list(vol.write_claims.values()):
+                if claim.state != CLAIM_STATE_NODE_DETACHED:
+                    continue
+                if not self._claim_alloc_gone(claim):
+                    continue
+                out.append({"namespace": vol.namespace,
+                            "volume_id": vol.id,
+                            "alloc_id": claim.alloc_id,
+                            "node_id": claim.node_id,
+                            "plugin_id": vol.plugin_id})
+        return out
 
     def csi_volume_get(self, namespace: str, volume_id: str):
         return self.state.csi_volume_by_id(namespace, volume_id)

@@ -5,6 +5,10 @@ routes through `select(kernel, n_padded, ...)`, which picks between:
 
   xla      single-device jit (the kernels.py programs) — the floor; wins
            at small node axes where pallas/collective overheads dominate.
+  host     the same XLA programs jitted for the HOST cpu backend: on
+           remote-attached TPU (dispatch round trip >> compute) a small
+           eval's solve is latency-bound, so counts at or below
+           HOST_MAX_COUNT run host-side while big solves keep the chip.
   pallas   hand-fused VMEM kernels (pallas_kernels.py) on real TPU at
            large node axes: one HBM read of the node matrix per solve
            instead of XLA's materialized [N, K(, R')] temporaries.
@@ -47,6 +51,7 @@ from ..metrics import metrics
 # can force routing; see tests/test_solver_backend.py.
 PALLAS_MIN_NODES = 8192
 SHARD_MIN_NODES = 32768
+HOST_MAX_COUNT = 2048
 
 _cache: dict = {}
 _mesh_cache: dict = {}
@@ -67,7 +72,7 @@ def _mesh(devs):
     return m
 
 
-def _tier(n_padded: int):
+def _tier(n_padded: int, count=None):
     """-> (tier_name, devices) under thresholds + env override."""
     import jax
     devs = jax.devices()
@@ -80,37 +85,68 @@ def _tier(n_padded: int):
         # override off-TPU would crash the first eval inside pallas_call
         if forced == "pallas" and devs[0].platform == "tpu":
             return "pallas", devs
+        if forced == "host":
+            return "host", devs
         return "xla", devs
     if len(devs) > 1 and n_padded >= SHARD_MIN_NODES and \
             n_padded % len(devs) == 0:
         return "sharded", devs
+    if devs[0].platform == "tpu" and count is not None and \
+            0 < count <= HOST_MAX_COUNT:
+        # small eval on an accelerator: the dispatch round trip dwarfs
+        # the compute — solve host-side (the eval-stream throughput path)
+        return "host", devs
     if devs[0].platform == "tpu" and n_padded >= PALLAS_MIN_NODES:
         return "pallas", devs
     return "xla", devs
 
 
-def select(kernel: str, n_padded: int, *, k_max: int = 128,
-           max_steps: int = 256, spread_algorithm: bool = False):
-    """-> (backend_name, fn) for `kernel` in {greedy, depth, chunked}."""
+def select(kernel: str, n_padded: int, *, count=None, k_max: int = 128,
+           max_steps: int = 256, spread_algorithm: bool = False,
+           depth_grid=None):
+    """-> (backend_name, fn) for `kernel` in {greedy, depth, chunked}.
+    `count` (instances asked) feeds the small-solve host routing;
+    `depth_grid` selects the sampled-curve depth variant."""
+    tier, devs = _tier(n_padded, count)
+    if kernel == "chunked" and tier == "pallas":
+        tier = "xla"                # scan-bound: no pallas tier (above)
+    if depth_grid is not None and tier == "pallas":
+        tier = "xla"                # the pallas curve is dense-K only
     # thresholds are part of the key so runtime mutation (tests, operator
-    # monkeypatch) takes effect without an explicit reset()
-    key = (kernel, n_padded, k_max, max_steps, spread_algorithm,
-           PALLAS_MIN_NODES, SHARD_MIN_NODES,
+    # monkeypatch) takes effect without an explicit reset(); the resolved
+    # tier (not raw count) keys the cache so counts don't fan it out
+    key = (kernel, n_padded, k_max, max_steps, spread_algorithm, tier,
+           depth_grid, PALLAS_MIN_NODES, SHARD_MIN_NODES, HOST_MAX_COUNT,
            os.environ.get("NOMAD_SOLVER_BACKEND", ""))
     cached = _cache.get(key)
     if cached is not None:
         return cached
-    tier, devs = _tier(n_padded)
-    if kernel == "chunked" and tier == "pallas":
-        tier = "xla"                # scan-bound: no pallas tier (above)
     out = _cache[key] = (tier, _build(kernel, tier, devs, k_max, max_steps,
-                                      spread_algorithm))
+                                      spread_algorithm, depth_grid))
     return out
 
 
+def _on_host(fn):
+    """Run an XLA kernel on the host cpu backend. Inputs must be
+    UNCOMMITTED (numpy) so jax.default_device places them host-side —
+    the placer hands backends numpy arrays for exactly this reason."""
+    import jax
+    cpu = jax.devices("cpu")[0]
+
+    def run(*args, **kwargs):
+        with jax.default_device(cpu):
+            return fn(*args, **kwargs)
+    return run
+
+
 def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
-           spread_algorithm: bool):
+           spread_algorithm: bool, depth_grid=None):
     from .kernels import fill_depth, fill_greedy_binpack, place_chunked
+
+    if tier == "host":
+        inner = _build(kernel, "xla", devs, k_max, max_steps,
+                       spread_algorithm, depth_grid)
+        return _on_host(inner)
 
     if kernel == "greedy":
         if tier == "sharded":
@@ -140,7 +176,8 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
                               spread_algorithm=spread_algorithm,
                               order_jitter=order_jitter,
                               jitter_scale=jitter_scale,
-                              jitter_samples=jitter_samples)
+                              jitter_samples=jitter_samples,
+                              depth_grid=depth_grid)
         return depth_xla
 
     if kernel == "chunked":

@@ -99,7 +99,17 @@ def fill_greedy_binpack(cap: jnp.ndarray, used: jnp.ndarray,
     return placed
 
 
-@functools.partial(jax.jit, static_argnames=("k_max", "spread_algorithm"))
+# geometric depth grid for the sampled curve: exact at shallow depths
+# (the jittered regime's take is capped at ceil(m)+1 <= 4) and
+# log-spaced above, so full-depth density RANKING survives at ~1/8 the
+# [N, K] work. One static grid -> one compiled artifact.
+DEPTH_GRID = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192,
+              256, 384, 512)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k_max", "spread_algorithm",
+                                    "depth_grid"))
 def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
                count: jnp.ndarray, feasible: jnp.ndarray,
                job_collisions: jnp.ndarray, desired_count: jnp.ndarray,
@@ -109,7 +119,8 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
                spread_algorithm: bool = False,
                order_jitter: Optional[jnp.ndarray] = None,
                jitter_scale: float = 0.5,
-               jitter_samples: float = 0.0) -> jnp.ndarray:
+               jitter_samples: float = 0.0,
+               depth_grid: Optional[tuple] = None) -> jnp.ndarray:
     """Depth-optimal placement of identical instances under the full
     binpack + job-anti-affinity + affinity score model.
 
@@ -135,14 +146,32 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     Returns i32[N] placements per node.
     """
     n = cap.shape[0]
-    j = jnp.arange(1, k_max + 1, dtype=jnp.float32)          # [K]
-    used_j = used[:, None, :] + j[None, :, None] * ask[None, None, :]
-    fits = jnp.all(used_j <= cap[:, None, :] + 1e-6, axis=-1)   # [N, K]
+    if depth_grid is not None:
+        # sampled curve: score at the grid depths only; the prefix sum
+        # becomes a trapezoid integral across the gaps (s is smooth in
+        # depth). The density RANKING stays full-depth — truncating the
+        # curve instead measurably doubles concurrent plan rejections.
+        j = jnp.asarray(depth_grid, jnp.float32)             # [G]
+    else:
+        j = jnp.arange(1, k_max + 1, dtype=jnp.float32)      # [K]
+    # depth feasibility WITHOUT the [N, K, R'] tensor: resources are
+    # linear in depth, so "k instances fit" == k <= per-node instance
+    # capacity (one [N, R'] masked floor-divide — the same reduction
+    # instance_capacity does, and what the pallas producer streams)
+    ask_pos = ask > 0
+    free = cap - used
+    per_dim = jnp.where(ask_pos[None, :],
+                        jnp.floor((free + 1e-6) /
+                                  jnp.where(ask_pos, ask, 1.0)[None, :]),
+                        jnp.inf)
+    capacity = jnp.maximum(jnp.min(per_dim, axis=1), 0.0)    # [N]
+    fits = j[None, :] <= capacity[:, None]                   # [N, K]
     fits &= feasible[:, None]
     fits &= (j[None, :] <= max_per_node)
 
     safe_cap = jnp.where(cap[:, :2] > 0, cap[:, :2], 1.0)       # [N, 2]
-    free_pct = 1.0 - used_j[:, :, :2] / safe_cap[:, None, :]    # [N, K, 2]
+    used_j2 = used[:, None, :2] + j[None, :, None] * ask[None, None, :2]
+    free_pct = 1.0 - used_j2 / safe_cap[:, None, :]             # [N, K, 2]
     tot = jnp.sum(jnp.power(10.0, free_pct), axis=-1)           # [N, K]
     raw = jnp.where(spread_algorithm, tot - 2.0, 20.0 - tot)
     base = jnp.clip(raw, 0.0, BINPACK_MAX_SCORE) / BINPACK_MAX_SCORE
@@ -155,11 +184,22 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     s = (base + jnp.where(anti_on, anti, 0.0)
          + jnp.where(aff_on, affinity_boost[:, None], 0.0)) / \
         (1.0 + anti_on + aff_on)
-    F = jnp.cumsum(jnp.where(fits, s, 0.0), axis=1)
+    sz = jnp.where(fits, s, 0.0)
+    if depth_grid is not None:
+        # trapezoid prefix: F(g_t) = F(g_{t-1}) + gap * mean(s endpoints)
+        gaps = j[1:] - j[:-1]                                    # [G-1]
+        trap = (sz[:, 1:] + sz[:, :-1]) * 0.5 * gaps[None, :]
+        F = jnp.concatenate(
+            [sz[:, :1], sz[:, :1] + jnp.cumsum(trap, axis=1)], axis=1)
+        k_of = j                                                 # [G]
+    else:
+        F = jnp.cumsum(sz, axis=1)
+        k_of = j
     F = jnp.where(fits, F, -jnp.inf)
     density = F / j[None, :]                                     # [N, K]
     d_star = jnp.max(density, axis=1)                            # [N]
-    k_star = (jnp.argmax(density, axis=1) + 1).astype(jnp.int32)
+    k_star = jnp.take(k_of, jnp.argmax(density, axis=1)
+                      ).astype(jnp.int32)
     # non-finite zeroing happens in _depth_order_take (shared with pallas)
 
     # Optimistic-concurrency decorrelation (SURVEY hard part 1): workers
@@ -209,7 +249,14 @@ def fill_depth(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     # covers both regimes — a python branch here made the 50k headline
     # run recompile inside the measured region when the warmup job's
     # small m landed in the other branch.
-    k_cap = jnp.sum(fits, axis=1).astype(jnp.int32)              # max depth
+    # max depth from EXACT capacity (not the K-truncated curve): the
+    # leftover pass deepens to true node capacity even when k_max is
+    # truncated (the jittered regime runs a tiny curve — depth take is
+    # capped at ceil(m)+1 there, so the curve only needs that horizon)
+    k_cap = jnp.where(feasible,
+                      jnp.minimum(capacity,
+                                  jnp.asarray(max_per_node, jnp.float32)),
+                      0.0).astype(jnp.int32)
     return _depth_order_take(d_star, k_star, k_cap, count, order_jitter,
                              jitter_scale, jitter_samples)
 
@@ -221,7 +268,12 @@ def _depth_order_take(d_star: jnp.ndarray, k_star: jnp.ndarray,
     """Shared tail of the depth solver: Efraimidis-Spirakis ordering, depth
     take, and leftover deepening over the per-node (density, depth, cap)
     summaries. Both the XLA and the pallas [N, K]-curve producers feed this
-    (the pallas variant computes d_star/k_star/k_cap tile-wise in VMEM)."""
+    (the pallas variant computes d_star/k_star/k_cap tile-wise in VMEM).
+
+    Ranking is FULL-DEPTH density in both regimes: ranking by a depth-
+    truncated density or by single-instance score concentrates every
+    concurrent worker on the smallest nodes and measurably doubles plan
+    rejections (the sampled-grid curve keeps full-depth ranking cheap)."""
     n = d_star.shape[0]
     js = jnp.asarray(jitter_samples, jnp.float32)
     det = js <= 0.0

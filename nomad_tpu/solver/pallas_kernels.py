@@ -153,11 +153,18 @@ def _depth_curve_kernel(cap_ref, used_ref, ask_ref, aux_ref, scal_ref,
     j = (jax.lax.broadcasted_iota(jnp.int32, (k_max, TILE_D), 0) + 1
          ).astype(jnp.float32)
 
-    # fits[k, t] = all resources r: used_r + j*ask_r <= cap_r  (static R loop
-    # keeps the [K, T, R] tensor out of memory entirely)
-    fits = feas & (j <= max_per_node)
+    # exact instance capacity per node (resources are linear in depth):
+    # fits[k, t] = k <= capacity_t — no [K, T, R] work at all
+    capacity = jnp.full((1, TILE_D), _BIG, jnp.float32)
     for r in range(NUM_XR):
-        fits &= used[r:r + 1, :] + j * ask_ref[r, 0] <= cap[r:r + 1, :] + 1e-6
+        a = ask_ref[r, 0]
+        per = jnp.where(a > 0.0,
+                        jnp.floor((cap[r:r + 1, :] - used[r:r + 1, :]
+                                   + 1e-6) / jnp.where(a > 0.0, a, 1.0)),
+                        _BIG)
+        capacity = jnp.minimum(capacity, per)
+    capacity = jnp.maximum(capacity, 0.0)
+    fits = feas & (j <= max_per_node) & (j <= capacity)
 
     # binpack/spread base score at depth j (cpu row 0, mem row 1)
     safe0 = jnp.where(cap[0:1, :] > 0.0, cap[0:1, :], 1.0)
@@ -189,7 +196,12 @@ def _depth_curve_kernel(cap_ref, used_ref, ask_ref, aux_ref, scal_ref,
     d_star = jnp.max(density, axis=0, keepdims=True)        # [1, T]
     k_star = (jnp.argmax(density, axis=0).astype(jnp.float32)
               .reshape(1, TILE_D) + 1.0)
-    k_cap = jnp.sum(fits.astype(jnp.float32), axis=0, keepdims=True)
+    # exact capacity (not curve-truncated): the leftover pass deepens
+    # past k_max — same semantics as the XLA producer
+    k_cap = jnp.where(feas,
+                      jnp.minimum(jnp.minimum(capacity, max_per_node),
+                                  jnp.float32(2 ** 30)),
+                      0.0)
 
     out_ref[0:1, :] = d_star
     out_ref[1:2, :] = k_star

@@ -146,8 +146,12 @@ class SolverPlacer:
         # serial applier rejects their overlapping plans (SURVEY hard part
         # 1 — plan-rejection parity). The kernel's stable argsort follows
         # this order for score ties, exactly like the host stack's shuffle.
-        nodes = list(nodes)
-        random.shuffle(nodes)
+        # numpy permutation (C loop) — random.shuffle costs ~7ms at 10k
+        # nodes, a real slice of small-eval latency; seeding from the
+        # global random stream keeps test reproducibility.
+        perm = np.random.default_rng(
+            random.getrandbits(64)).permutation(len(nodes))
+        nodes = [nodes[i] for i in perm]
 
         feasible_fn = self._feasibility_fn(tg)
         gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn)
@@ -249,25 +253,38 @@ class SolverPlacer:
             # jitter_samples<=0 with a traced where, so the deterministic
             # and jittered regimes share one compiled artifact
             rng = np.random.default_rng(random.getrandbits(64))
-            jitter = jnp.asarray(
-                rng.random(gt.cap.shape[0], dtype=np.float32))
+            jitter = rng.random(gt.cap.shape[0], dtype=np.float32)
+            depth_grid = None
             if affinities or m > 3.0:
                 bias_g = 1.0
                 m = 0.0
             else:
                 bias_g = float(np.clip((width - 1.0) + max(m - 1.0, 0.0),
                                        1.0, 8.0))
+                # jittered regime: the take is capped at ceil(m)+1 (<= 4)
+                # but the density RANKING must stay full-depth (a
+                # truncated curve doubles concurrent plan rejections) —
+                # the static geometric grid keeps full-depth ranking at
+                # ~1/8 the [N, K] work, the small-eval latency lever.
+                # Regime selection here is a python branch on HOST data
+                # (m, affinities), so each regime is its own compiled
+                # artifact — warm both (bench does).
+                from .kernels import DEPTH_GRID
+                depth_grid = tuple(g for g in DEPTH_GRID if g <= k_max) \
+                    or (1,)
             bname, depth_fn = backend.select(
-                "depth", gt.cap.shape[0], k_max=k_max,
-                spread_algorithm=spread_alg)
+                "depth", gt.cap.shape[0], count=count, k_max=k_max,
+                spread_algorithm=spread_alg, depth_grid=depth_grid)
             backend.record("depth", bname)
+            # inputs stay numpy (uncommitted): each tier's jit places
+            # them on ITS device — pre-committing to the default device
+            # would drag host-tier solves back to the accelerator
             placed = depth_fn(
-                jnp.asarray(gt.cap), jnp.asarray(gt.used),
-                jnp.asarray(gt.ask), jnp.int32(count),
-                jnp.asarray(gt.feasible), jnp.asarray(gt.job_collisions),
-                jnp.int32(tg.count), jnp.asarray(aff),
-                jnp.int32(max_per_node), jitter,
-                jnp.float32(bias_g), jnp.float32(m))
+                gt.cap, gt.used, gt.ask, np.int32(count),
+                gt.feasible, gt.job_collisions,
+                np.int32(tg.count), aff,
+                np.int32(max_per_node), jitter,
+                np.float32(bias_g), np.float32(m))
         elif use_scan:
             # one solve covers max_steps * k instances; split larger asks
             # across repeated solves, feeding the running state (usage,
@@ -275,33 +292,26 @@ class SolverPlacer:
             max_steps = 256
             cover = max_steps * min(gt.cap.shape[0], 256)
             bname, chunked_fn = backend.select(
-                "chunked", gt.cap.shape[0], max_steps=max_steps,
-                spread_algorithm=spread_alg)
+                "chunked", gt.cap.shape[0], count=count,
+                max_steps=max_steps, spread_algorithm=spread_alg)
             backend.record("chunked", bname)
-            used_dev = jnp.asarray(gt.used)
-            placed_dev = jnp.zeros((gt.cap.shape[0],), jnp.int32)
-            sp_counts = jnp.asarray(sp.counts)
-            d_rem = jnp.asarray(dp.remaining)
-            cap_dev = jnp.asarray(gt.cap)
-            ask_dev = jnp.asarray(gt.ask)
-            feas_dev = jnp.asarray(gt.feasible)
-            coll_dev = jnp.asarray(gt.job_collisions)
-            sp_ids = jnp.asarray(sp.ids)
-            sp_desired = jnp.asarray(sp.desired)
-            sp_mode = jnp.asarray(sp.mode)
-            sp_weights = jnp.asarray(sp.weights)
-            aff_dev = jnp.asarray(aff)
-            dp_ids = jnp.asarray(dp.ids)
+            # numpy inputs (see the depth call site); the carried state
+            # arrays come back committed to the chosen tier's device and
+            # stay there across refill iterations
+            used_dev = gt.used
+            placed_dev = np.zeros((gt.cap.shape[0],), np.int32)
+            sp_counts = sp.counts
+            d_rem = dp.remaining
             left = int(count)
             last_total = 0
             while True:
                 placed_dev, used_dev, sp_counts, d_rem = chunked_fn(
-                    cap_dev, used_dev, ask_dev,
-                    jnp.int32(min(left, cover)), feas_dev, coll_dev,
-                    jnp.int32(tg.count),
-                    sp_ids, sp_counts, sp_desired, sp_mode, sp_weights,
-                    aff_dev, dp_ids, d_rem, placed_dev,
-                    jnp.int32(max_per_node))
+                    gt.cap, used_dev, gt.ask,
+                    np.int32(min(left, cover)), gt.feasible,
+                    gt.job_collisions, np.int32(tg.count),
+                    sp.ids, sp_counts, sp.desired, sp.mode, sp.weights,
+                    aff, dp.ids, d_rem, placed_dev,
+                    np.int32(max_per_node))
                 if left <= cover:
                     break           # one solve covered the whole ask
                 total = int(jnp.sum(placed_dev))    # device sync: rare path
@@ -311,12 +321,12 @@ class SolverPlacer:
                 last_total = total
             placed = placed_dev
         else:
-            bname, greedy = backend.select("greedy", gt.cap.shape[0])
+            bname, greedy = backend.select("greedy", gt.cap.shape[0],
+                                           count=count)
             backend.record("greedy", bname)
             placed = greedy(
-                jnp.asarray(gt.cap), jnp.asarray(gt.used),
-                jnp.asarray(gt.ask), jnp.int32(count),
-                jnp.asarray(gt.feasible), jnp.int32(max_per_node))
+                gt.cap, gt.used, gt.ask, np.int32(count),
+                gt.feasible, np.int32(max_per_node))
         placed = np.array(np.asarray(placed)[:n])   # writable host copy
         if use_scan and distincts:
             # chunk > 1 places several instances per scan step, which can

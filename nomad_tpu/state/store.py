@@ -535,7 +535,10 @@ class StateStore:
     def csi_volume_claim(self, index: int, ns: str, vol_id: str,
                          claim) -> None:
         """Take or update one claim (ref state_store.go CSIVolumeClaim)."""
-        from ..structs.csi import CLAIM_WRITE, CLAIM_STATE_READY_TO_FREE
+        from ..structs.csi import (
+            CLAIM_WRITE, CLAIM_STATE_CONTROLLER_DETACHED,
+            CLAIM_STATE_NODE_DETACHED, CLAIM_STATE_READY_TO_FREE,
+        )
         with self._lock:
             vol = self.csi_volumes.get((ns, vol_id))
             if vol is None:
@@ -544,6 +547,16 @@ class StateStore:
             if claim.state == CLAIM_STATE_READY_TO_FREE:
                 vol.read_claims.pop(claim.alloc_id, None)
                 vol.write_claims.pop(claim.alloc_id, None)
+            elif claim.state in (CLAIM_STATE_NODE_DETACHED,
+                                 CLAIM_STATE_CONTROLLER_DETACHED):
+                # detach progress: advance the EXISTING claim's state —
+                # no mode/claim_ok checks (the slot is already held)
+                for claims in (vol.read_claims, vol.write_claims):
+                    cur = claims.get(claim.alloc_id)
+                    if cur is not None:
+                        cur = cur.copy()
+                        cur.state = claim.state
+                        claims[claim.alloc_id] = cur
             elif claim.mode == CLAIM_WRITE:
                 if not vol.claim_ok(claim.mode) and \
                         claim.alloc_id not in vol.write_claims:

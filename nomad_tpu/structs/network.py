@@ -115,12 +115,20 @@ class NetworkIndex:
             if alloc.server_terminal_status():
                 continue
             res = alloc.allocated_resources
-            for port in res.shared.ports:
-                if self._reserve_port(port.get("host_ip", ""), port.get("value", 0)):
-                    collide = True
-            for net in res.shared.networks:
-                if self.add_reserved(net):
-                    collide = True
+            # shared.ports is the flattened view OF shared.networks'
+            # offer — reserve from one or the other, never both, or a
+            # group-network alloc collides with itself (ref
+            # structs/network.go AddAllocs: AllocatedPorts preferred,
+            # networks as the pre-0.12 fallback)
+            if res.shared.ports:
+                for port in res.shared.ports:
+                    if self._reserve_port(port.get("host_ip", ""),
+                                          port.get("value", 0)):
+                        collide = True
+            else:
+                for net in res.shared.networks:
+                    if self.add_reserved(net):
+                        collide = True
             for tr in res.tasks.values():
                 for net in tr.networks:
                     if self.add_reserved(net):

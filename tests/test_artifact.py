@@ -277,3 +277,84 @@ def test_fingerprint_no_cloud_is_clean(tmp_path):
                             cfg={"metadata_get": fake_get})
     assert "platform.aws.instance-type" not in node.attributes
     assert "platform.gce.machine-type" not in node.attributes
+
+
+def test_fingerprint_gce_canned_metadata(tmp_path):
+    base = "http://169.254.169.254/computeMetadata/v1/instance/"
+    answers = {
+        base + "machine-type": "projects/1/machineTypes/n2-standard-8",
+        base + "zone": "projects/1/zones/us-central1-a",
+        base + "hostname": "vm1.c.proj.internal",
+        base + "id": "123456",
+    }
+
+    def fake_get(url, headers, timeout):
+        if url in answers:
+            assert headers.get("Metadata-Flavor") == "Google"
+            return answers[url]
+        raise OSError("404")
+
+    node = fingerprint_node(data_dir=str(tmp_path),
+                            cfg={"metadata_get": fake_get})
+    assert node.attributes["platform"] == "gce"
+    assert node.attributes["platform.gce.machine-type"].endswith(
+        "n2-standard-8")
+    assert node.attributes["unique.platform.gce.hostname"] == \
+        "vm1.c.proj.internal"
+    # aws attributes must not leak in
+    assert not any(k.startswith("platform.aws") for k in node.attributes)
+
+
+def test_fingerprint_azure_canned_metadata(tmp_path):
+    base = "http://169.254.169.254/metadata/instance/compute/"
+    q = "?api-version=2019-06-04&format=text"
+    answers = {
+        base + "vmSize" + q: "Standard_D4s_v3",
+        base + "location" + q: "eastus",
+        base + "name" + q: "vm-7",
+        base + "vmId" + q: "abc-123",
+    }
+
+    def fake_get(url, headers, timeout):
+        if url in answers:
+            assert headers.get("Metadata") == "true"
+            return answers[url]
+        raise OSError("404")
+
+    node = fingerprint_node(data_dir=str(tmp_path),
+                            cfg={"metadata_get": fake_get})
+    assert node.attributes["platform"] == "azure"
+    assert node.attributes["platform.azure.compute.vm-size"] == \
+        "Standard_D4s_v3"
+    assert node.attributes["platform.azure.compute.location"] == "eastus"
+    assert node.attributes["unique.platform.azure.compute.vm-id"] == \
+        "abc-123"
+
+
+def test_fingerprint_first_cloud_wins(tmp_path):
+    """Only one platform is published even if several probes would
+    answer (fingerprinters run in order; later clouds see the gate)."""
+    def fake_get(url, headers, timeout):
+        return "anything"
+    node = fingerprint_node(data_dir=str(tmp_path),
+                            cfg={"metadata_get": fake_get})
+    assert node.attributes["platform"] == "aws"
+    assert not any(k.startswith("platform.gce") for k in node.attributes)
+    assert not any(k.startswith("platform.azure") for k in node.attributes)
+
+
+def test_fingerprint_cni_config_dir(tmp_path):
+    cni = tmp_path / "cni"
+    cni.mkdir()
+    (cni / "10-bridge.conflist").write_text(
+        '{"name": "mynet", "cniVersion": "1.0.0", "plugins": []}')
+    (cni / "ignored.txt").write_text("nope")
+    (cni / "bad.conf").write_text("{not json")
+
+    def no_cloud(url, headers, timeout):
+        raise OSError("air-gapped")
+
+    node = fingerprint_node(data_dir=str(tmp_path),
+                            cfg={"metadata_get": no_cloud,
+                                 "cni_config_dir": str(cni)})
+    assert node.attributes["plugins.cni.network.mynet"] == "1.0.0"

@@ -1,0 +1,185 @@
+"""Connect-analog tests (VERDICT r3 #6): admission-time sidecar injection
+(ref nomad/job_endpoint_hooks.go) and the mesh data path through the
+proxy driver (ref envoy_bootstrap_hook.go; data plane is the in-process
+TCP proxy)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.integrations.connect import PROXY_PREFIX, connect_admission
+from nomad_tpu.structs import NetworkResource, Port, Service
+
+
+def wait_until(fn, timeout=20.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _connect_job(job_id, svc_name, port_label="http", upstreams=()):
+    job = mock.job()
+    job.id = job.name = job_id
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.networks = [NetworkResource(dynamic_ports=[Port(label=port_label)])]
+    tg.services = [Service(
+        name=svc_name, port_label=port_label,
+        connect={"SidecarService": {
+            "Proxy": {"Upstreams": [
+                {"DestinationName": d, "LocalBindPort": p}
+                for d, p in upstreams]}}})]
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    return job
+
+
+# ------------------------------------------------------------- admission
+
+def test_admission_injects_proxy_task_and_port():
+    job = _connect_job("adm", "api-svc")
+    connect_admission(job)
+    tg = job.task_groups[0]
+    names = [t.name for t in tg.tasks]
+    assert PROXY_PREFIX + "api-svc" in names
+    proxy = tg.lookup_task(PROXY_PREFIX + "api-svc")
+    assert proxy.driver == "connect_proxy"
+    assert proxy.lifecycle.hook == "prestart" and proxy.lifecycle.sidecar
+    # dynamic ingress port added; service re-pointed at the proxy
+    labels = [p.label for p in tg.networks[0].dynamic_ports]
+    assert PROXY_PREFIX + "api-svc" in labels
+    assert tg.services[0].port_label == PROXY_PREFIX + "api-svc"
+    assert proxy.config["local_service_port_label"] == "http"
+
+
+def test_admission_is_idempotent():
+    job = _connect_job("idem", "api-svc")
+    connect_admission(job)
+    before = len(job.task_groups[0].tasks)
+    connect_admission(job)          # job re-register path
+    assert len(job.task_groups[0].tasks) == before
+    labels = [p.label for p in job.task_groups[0].networks[0].dynamic_ports]
+    assert labels.count(PROXY_PREFIX + "api-svc") == 1
+
+
+def test_admission_wires_upstream_env():
+    job = _connect_job("ups", "web-svc", upstreams=[("api-svc", 21105)])
+    connect_admission(job)
+    tg = job.task_groups[0]
+    web = [t for t in tg.tasks if not t.name.startswith(PROXY_PREFIX)][0]
+    assert web.env["NOMAD_UPSTREAM_ADDR_API_SVC"] == "127.0.0.1:21105"
+    proxy = tg.lookup_task(PROXY_PREFIX + "web-svc")
+    assert proxy.config["upstreams"] == [
+        {"destination": "api-svc", "local_bind_port": 21105}]
+
+
+def test_jobspec_parses_sidecar_upstreams():
+    from nomad_tpu.jobspec import parse as parse_job
+    hcl = '''
+job "mesh" {
+  group "web" {
+    network { port "http" {} }
+    service {
+      name = "web-svc"
+      port = "http"
+      connect {
+        sidecar_service {
+          proxy {
+            upstreams {
+              destination_name = "api-svc"
+              local_bind_port  = 21106
+            }
+          }
+        }
+      }
+    }
+    task "web" {
+      driver = "raw_exec"
+      config { command = "/bin/true" }
+    }
+  }
+}
+'''
+    job = parse_job(hcl)
+    svc = job.task_groups[0].services[0]
+    assert svc.connect["SidecarService"]["Proxy"]["Upstreams"] == [
+        {"DestinationName": "api-svc", "LocalBindPort": 21106}]
+
+
+# ------------------------------------------------------------ mesh e2e
+
+def test_two_service_connect_job_mesh_path(tmp_path):
+    """The verdict's acceptance: a two-service connect job in the dev
+    agent — the downstream reaches the upstream THROUGH the sidecars
+    (downstream local bind -> downstream proxy -> upstream ingress proxy
+    -> upstream service)."""
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    try:
+        assert wait_until(
+            lambda: a.server.state.node_by_id(a.client.node.id) is not None
+            and a.server.state.node_by_id(a.client.node.id).ready())
+
+        api = _connect_job("api", "api-svc")
+        api.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "cd local && echo hello-mesh > index.html && "
+                     "exec python3 -m http.server $NOMAD_PORT_http "
+                     "--bind 127.0.0.1"]}
+        a.server.job_register(api)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "api")))
+        # the catalog entry points at the PROXY ingress, not the service
+        assert wait_until(lambda: bool(
+            a.server.service_instances("default", "api-svc")))
+        inst = a.server.service_instances("default", "api-svc")[0]
+        api_alloc = [al for al in a.server.state.allocs_by_job(
+            "default", "api") if al.client_status == "running"][0]
+        tr = api_alloc.allocated_resources.tasks
+        proxy_ports = [p.value
+                       for t in tr.values() for n in t.networks
+                       for p in n.dynamic_ports
+                       if p.label == PROXY_PREFIX + "api-svc"]
+        shared = api_alloc.allocated_resources.shared
+        for n in shared.networks or []:
+            proxy_ports += [p.value for p in n.dynamic_ports
+                            if p.label == PROXY_PREFIX + "api-svc"]
+        assert inst.port in proxy_ports, \
+            "service must register at the sidecar ingress port"
+
+        out = str(tmp_path / "mesh-out.txt")
+        web = _connect_job("web", "web-svc",
+                           upstreams=[("api-svc", 21107)])
+        web.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "for i in $(seq 1 100); do "
+                     "python3 -c \"import urllib.request,os,sys;"
+                     "addr=os.environ['NOMAD_UPSTREAM_ADDR_API_SVC'];"
+                     "open('%s','w').write(urllib.request.urlopen("
+                     "'http://'+addr+'/index.html',timeout=2)"
+                     ".read().decode())\" && break; sleep 0.2; done; "
+                     "sleep 60" % out]}
+        a.server.job_register(web)
+        assert wait_until(lambda: os.path.exists(out)
+                          and "hello-mesh" in open(out).read(), timeout=30), \
+            "downstream could not reach upstream through the sidecars"
+
+        # the bytes actually traversed BOTH proxies
+        from nomad_tpu.client.driver import ConnectProxyDriver
+        proxy_driver = a.client.drivers["connect_proxy"]
+        stats = [proxy_driver.inspect_task(tid)
+                 for tid in list(proxy_driver._tasks)]
+        assert sum(s["connections"] for s in stats) >= 2, stats
+    finally:
+        a.shutdown()

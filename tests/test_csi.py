@@ -8,6 +8,7 @@ import pytest
 
 from nomad_tpu import mock
 from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.client import Client
 from nomad_tpu.client.csimanager import HostPathCSIPlugin
 from nomad_tpu.server import Server
 from nomad_tpu.structs import (
@@ -119,7 +120,9 @@ def test_volume_watcher_reaps_terminal_alloc_claims(server):
     server.state.upsert_allocs(server.raft.barrier() + 1, [alloc])
     server.csi_volume_claim("default", "reap", CSIVolumeClaim(
         alloc_id=alloc.id, mode=CLAIM_WRITE))
-    assert server.volume_watcher.reap_once() == 1
+    # claim has no live node: the watcher force-chains the detach machine
+    # (taken -> node-detached -> ready-to-free) in one pass
+    assert server.volume_watcher.reap_once() >= 1
     vol = server.csi_volume_get("default", "reap")
     assert not vol.in_use()
     # claims of live allocs survive
@@ -325,3 +328,214 @@ def test_volume_detach_releases_node_claims(server):
             vol.write_claims[alloc.id].state != "taken"
     finally:
         a.shutdown()
+
+
+# ---------------- unpublish state machine (VERDICT r3 #5) ----------------
+
+class _FakeCSIPlugin(HostPathCSIPlugin):
+    """Records every unpublish RPC; can inject failures."""
+
+    name = "fake"
+    requires_controller = True
+
+    def __init__(self, base_dir):
+        super().__init__(base_dir)
+        self.node_unpublished: list = []
+        self.controller_unpublished: list = []
+        self.fail_node = 0
+        self.fail_controller = 0
+
+    def node_unpublish_volume(self, volume_id, target_path):
+        if self.fail_node > 0:
+            self.fail_node -= 1
+            raise RuntimeError("injected node unpublish failure")
+        self.node_unpublished.append(volume_id)
+        super().node_unpublish_volume(volume_id, target_path)
+
+    def controller_unpublish_volume(self, volume_id, node_id):
+        if self.fail_controller > 0:
+            self.fail_controller -= 1
+            raise RuntimeError("injected controller unpublish failure")
+        self.controller_unpublished.append((volume_id, node_id))
+
+
+def _cluster_with_fake_plugin(tmp_path, fail_node=0, fail_controller=0):
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    client = Client(server, data_dir=str(tmp_path / "c0"))
+    plugin = _FakeCSIPlugin(str(tmp_path / "csi"))
+    plugin.fail_node = fail_node
+    plugin.fail_controller = fail_controller
+    client.start()
+    client.register_csi_plugin("fake", plugin, controller=True)
+    assert wait_until(lambda: (
+        (p := server.csi_plugin_get("fake")) is not None
+        and p.nodes_healthy == 1 and p.controllers_healthy == 1))
+    server.csi_volume_register([_vol("data", plugin="fake")])
+    return server, client, plugin
+
+
+def _terminal_claim(server, client, vol="data"):
+    """A write claim whose alloc is already terminal (the client died
+    before releasing — the exact case the watcher exists for)."""
+    alloc = mock.alloc()
+    alloc.node_id = client.node.id
+    alloc.client_status = "complete"
+    alloc.desired_status = "stop"
+    server.state.upsert_allocs(server.raft.barrier() + 1, [alloc])
+    server.state.csi_volume_claim(
+        server.raft.barrier() + 1, "default", vol,
+        CSIVolumeClaim(alloc_id=alloc.id, node_id=client.node.id,
+                       mode=CLAIM_WRITE))
+    return alloc
+
+
+def test_unpublish_node_then_controller_then_free(tmp_path):
+    """Full detach machine: node unpublish on the claimed node, then
+    controller unpublish, then the claim frees — each step gated on the
+    plugin RPC succeeding (ref volume_watcher.go + csi/client.go)."""
+    server, client, plugin = _cluster_with_fake_plugin(tmp_path)
+    try:
+        _terminal_claim(server, client)
+        assert server.csi_volume_get("default", "data").in_use()
+
+        # watcher alone can't free it: node round not confirmed yet
+        server.volume_watcher.reap_once()
+        vol = server.csi_volume_get("default", "data")
+        assert vol.in_use()
+        claim = list(vol.write_claims.values())[0]
+        assert claim.state == "taken"
+
+        # client pull performs node unpublish then (same node hosts the
+        # controller) the controller round — order is enforced by the
+        # pending queries gating on claim state
+        assert client.csi_manager.reconcile_claims() >= 1
+        assert plugin.node_unpublished == ["data"]
+        if not plugin.controller_unpublished:
+            assert client.csi_manager.reconcile_claims() >= 1
+        assert plugin.controller_unpublished == [("data", client.node.id)]
+        claim = list(server.csi_volume_get(
+            "default", "data").write_claims.values())[0]
+        assert claim.state == "controller-detached"
+
+        # watcher frees only now
+        assert server.volume_watcher.reap_once() >= 1
+        assert not server.csi_volume_get("default", "data").in_use()
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_unpublish_failure_leaves_claim_recoverable(tmp_path):
+    """Failure injection: a failing node unpublish leaves the claim in
+    `taken` (volume still unschedulable for new writers); the retry on
+    the next pull succeeds and the machine completes."""
+    server, client, plugin = _cluster_with_fake_plugin(tmp_path,
+                                                       fail_node=1)
+    try:
+        _terminal_claim(server, client)
+        # first pull: injected failure -> claim unchanged
+        client.csi_manager.reconcile_claims()
+        claim = list(server.csi_volume_get(
+            "default", "data").write_claims.values())[0]
+        assert claim.state == "taken", "failed unpublish must not advance"
+        assert plugin.node_unpublished == []
+
+        # retry succeeds and the machine runs to completion
+        client.csi_manager.reconcile_claims()     # node round
+        client.csi_manager.reconcile_claims()     # controller round
+        server.volume_watcher.reap_once()
+        assert not server.csi_volume_get("default", "data").in_use()
+        assert plugin.node_unpublished == ["data"]
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_unpublish_skips_node_round_when_node_gone(tmp_path):
+    """The claimed node left the cluster: the watcher force-advances past
+    the node round, but the CONTROLLER round still requires its RPC."""
+    server, client, plugin = _cluster_with_fake_plugin(tmp_path)
+    try:
+        alloc = _terminal_claim(server, client)
+        gone_node = alloc.node_id
+        # re-point the claim at a node that does not exist
+        vol = server.csi_volume_get("default", "data")
+        server.state.csi_volume_claim(
+            server.raft.barrier() + 1, "default", "data",
+            CSIVolumeClaim(alloc_id=alloc.id, node_id="no-such-node",
+                           mode=CLAIM_WRITE))
+        server.volume_watcher.reap_once()
+        claim = list(server.csi_volume_get(
+            "default", "data").write_claims.values())[0]
+        assert claim.state == "node-detached"
+        assert plugin.node_unpublished == []      # no node RPC possible
+        # controller confirmation still gates the free
+        assert server.csi_volume_get("default", "data").in_use()
+        client.csi_manager.reconcile_claims()
+        assert plugin.controller_unpublished
+        server.volume_watcher.reap_once()
+        assert not server.csi_volume_get("default", "data").in_use()
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_controllerless_plugin_frees_after_node_round(tmp_path):
+    """Plugins without requires_controller skip the controller round."""
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    client = Client(server, data_dir=str(tmp_path / "c0"))
+    plugin = HostPathCSIPlugin(str(tmp_path / "csi"))
+    client.start()
+    client.register_csi_plugin("hostpath", plugin)
+    try:
+        assert wait_until(lambda: (
+            (p := server.csi_plugin_get("hostpath")) is not None
+            and p.nodes_healthy == 1))
+        server.csi_volume_register([_vol("hp")])
+        _terminal_claim(server, client, vol="hp")
+        client.csi_manager.reconcile_claims()     # node round
+        server.volume_watcher.reap_once()         # -> free, no controller
+        assert not server.csi_volume_get("default", "hp").in_use()
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_normal_stop_of_controller_volume_keeps_controller_round(tmp_path):
+    """The COMMON path (alloc stops, client releases) must not skip the
+    controller unpublish for requires_controller plugins: unmount_all
+    releases to node-detached; the claim frees only after the controller
+    RPC runs."""
+    server, client, plugin = _cluster_with_fake_plugin(tmp_path)
+    try:
+        alloc = mock.alloc()
+        alloc.node_id = client.node.id
+        server.state.upsert_allocs(server.raft.barrier() + 1, [alloc])
+
+        class Req:
+            name = "data"
+            source = "data"
+            read_only = False
+        path = client.csi_manager.mount_volume(alloc, Req())
+        assert os.path.islink(path)
+
+        # alloc stops normally -> postrun unmounts + releases
+        done = alloc.copy()
+        done.client_status = "complete"
+        done.desired_status = "stop"
+        server.state.upsert_allocs(server.raft.barrier() + 1, [done])
+        client.csi_manager.unmount_all(alloc)
+        vol = server.csi_volume_get("default", "data")
+        assert vol.in_use(), "claim must NOT free before the controller round"
+        claim = list(vol.write_claims.values())[0]
+        assert claim.state == "node-detached"
+        # controller round completes it
+        client.csi_manager.reconcile_claims()
+        server.volume_watcher.reap_once()
+        assert not server.csi_volume_get("default", "data").in_use()
+        assert plugin.controller_unpublished
+    finally:
+        client.shutdown()
+        server.shutdown()

@@ -243,3 +243,122 @@ def test_service_catalog_end_to_end(agent):
     agent.server.job_deregister("default", "svcjob")
     assert wait_until(lambda: api.services.instances("web-svc")[0] == [],
                       timeout=20)
+
+
+def test_template_range_service():
+    """{{ range service }} iterates healthy instances with .Address/.Port
+    (consul-template's range form, ref template.go funcs)."""
+    class Inst:
+        def __init__(self, address, port, status="passing"):
+            self.address, self.port, self.status = address, port, status
+            self.name = "api"
+    insts = [Inst("10.0.0.1", 8080), Inst("10.0.0.2", 8081),
+             Inst("10.0.0.3", 9999, status="critical")]
+    out = render_template(
+        'upstream api {\n'
+        '{{ range service "api" }}  server {{ .Address }}:{{ .Port }};\n'
+        '{{ end }}}\n',
+        {}, service_lookup=lambda name: insts)
+    assert out == ('upstream api {\n'
+                   '  server 10.0.0.1:8080;\n'
+                   '  server 10.0.0.2:8081;\n'
+                   '}\n')
+
+
+def test_template_rerender_on_secret_change_signals_task(agent):
+    """Watch -> re-render -> change_mode=signal (VERDICT r3 #7): a KV
+    change re-renders the file in place and the task receives the
+    configured signal (ref template.go handleTemplateRerenders)."""
+    agent.client.template_interval_sec = 0.2
+    agent.server.secrets.put("rw/config", {"color": "blue"})
+    job = mock.job()
+    job.id = job.name = "rerender"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.templates = [Template(
+        embedded_tmpl='color={{ secret "rw/config" "color" }}\n',
+        dest_path="local/color.conf", change_mode="signal",
+        change_signal="SIGHUP")]
+    # the script reports SIGHUP receipt so the signal delivery is observable
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c",
+                            "trap 'echo got-hup' HUP; "
+                            "while true; do sleep 0.1; done"]}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    agent.server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in agent.server.state.allocs_by_job("default", "rerender")))
+    alloc = [a for a in agent.server.state.allocs_by_job("default", "rerender")
+             if a.client_status == "running"][0]
+    conf = os.path.join(agent.client.alloc_dir_root, alloc.id,
+                        task.name, "local", "color.conf")
+    assert wait_until(lambda: os.path.exists(conf))
+    assert open(conf).read() == "color=blue\n"
+
+    # KV change -> watcher re-renders + signals
+    agent.server.secrets.put("rw/config", {"color": "green"})
+    assert wait_until(lambda: os.path.exists(conf)
+                      and open(conf).read() == "color=green\n", timeout=10), \
+        "template was not re-rendered on KV change"
+    log = os.path.join(agent.client.alloc_dir_root, alloc.id,
+                       task.name, f"{task.name}.stdout.log")
+    assert wait_until(lambda: os.path.exists(log)
+                      and b"got-hup" in open(log, "rb").read(), timeout=10), \
+        "task did not receive the change_mode signal"
+    agent.server.job_deregister("default", "rerender")
+
+
+def test_template_rerender_on_service_change_restarts_task(agent):
+    """change_mode=restart: a catalog change restarts the task with the
+    new rendering."""
+    from nomad_tpu.integrations.services import ServiceInstance
+    agent.client.template_interval_sec = 0.2
+    job = mock.job()
+    job.id = job.name = "svcrender"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.templates = [Template(
+        embedded_tmpl='db={{ service "db" }}\n',
+        dest_path="local/db.conf", change_mode="restart")]
+    task.config = {"command": "/bin/sh",
+                   "args": ["-c", "cat local/db.conf; sleep 60"]}
+    task.resources.networks = []
+    task.resources.cpu = 50
+    task.resources.memory_mb = 32
+    # the template blocks until "db" resolves, so register it first,
+    # attached to a LIVE alloc of another job (the reaper drops
+    # registrations of vanished allocs)
+    holder = [a for a in agent.server.state.iter_allocs()
+              if a.client_status == "running"]
+    anchor = holder[0].id if holder else ""
+    agent.server.service_register([ServiceInstance(
+        service_name="db", address="10.1.1.1", port=5432,
+        namespace="default", alloc_id=anchor, task="db1")])
+    agent.server.job_register(job)
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in agent.server.state.allocs_by_job("default", "svcrender")))
+    alloc = [a for a in agent.server.state.allocs_by_job(
+        "default", "svcrender") if a.client_status == "running"][0]
+    log = os.path.join(agent.client.alloc_dir_root, alloc.id,
+                       task.name, f"{task.name}.stdout.log")
+    assert wait_until(lambda: os.path.exists(log)
+                      and b"db=10.1.1.1:5432" in open(log, "rb").read())
+
+    # move the service -> re-render + restart; task logs the NEW address
+    agent.server.service_deregister(
+        keys=[["default", "db", anchor, "db1"]])
+    agent.server.service_register([ServiceInstance(
+        service_name="db", address="10.2.2.2", port=5433,
+        namespace="default", alloc_id=anchor, task="db2")])
+    assert wait_until(lambda: b"db=10.2.2.2:5433" in open(log, "rb").read(),
+                      timeout=15), \
+        "task was not restarted with the re-rendered config"
+    agent.server.job_deregister("default", "svcrender")
