@@ -149,14 +149,17 @@ class _Forwarder(threading.Thread):
             except OSError:
                 pass
             finally:
-                for s in (a, b):
-                    try:
-                        s.shutdown(socket.SHUT_RDWR)
-                    except OSError:
-                        pass
+                # asymmetric half-close: EOF from `a` ends only OUR write
+                # direction on `b` — the reverse pump may still be
+                # streaming a response (nc -q0 style half-close clients)
+                try:
+                    b.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
         t = threading.Thread(target=pump, args=(out, conn), daemon=True)
         t.start()
         pump(conn, out)
+        t.join(timeout=30)
         for s in (conn, out):
             try:
                 s.close()

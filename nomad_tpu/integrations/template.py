@@ -152,12 +152,15 @@ class TemplateWatcher:
                 continue                # keep last content; retry next tick
             if content == self._last.get(i):
                 continue
-            self._last[i] = content
+            # write + notify BEFORE recording: a transient write failure
+            # (ENOSPC et al) must stay retryable on the next tick, not
+            # silently strand the task on stale config forever
             self.tr.write_rendered_file(tmpl.dest_path or "local/template",
                                         content, tmpl.perms)
+            self._fire_change_mode(tmpl)
+            self._last[i] = content
             changed += 1
             self.rerenders += 1
-            self._fire_change_mode(tmpl)
         return changed
 
     def _fire_change_mode(self, tmpl) -> None:

@@ -122,7 +122,12 @@ class AllocReconciler:
 
     def compute(self) -> ReconcileResults:
         """ref reconcile.go:189 Compute"""
-        stopped = self.job is None or self.job.stopped()
+        # parameterized/periodic PARENTS never place — children do. The
+        # register path already skips eval creation for parents (ref
+        # job_endpoint.go:365); treating a stray parent eval as stopped
+        # makes that invariant defensive rather than upstream-only.
+        stopped = self.job is None or self.job.stopped() or \
+            self.job.is_parameterized() or self.job.is_periodic()
         if not stopped:
             self._cancel_unneeded_deployments()
 

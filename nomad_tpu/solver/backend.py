@@ -88,14 +88,16 @@ def _tier(n_padded: int, count=None):
         if forced == "host":
             return "host", devs
         return "xla", devs
-    if len(devs) > 1 and n_padded >= SHARD_MIN_NODES and \
-            n_padded % len(devs) == 0:
-        return "sharded", devs
     if devs[0].platform == "tpu" and count is not None and \
             0 < count <= HOST_MAX_COUNT:
         # small eval on an accelerator: the dispatch round trip dwarfs
-        # the compute — solve host-side (the eval-stream throughput path)
+        # the compute — solve host-side (the eval-stream throughput
+        # path). Checked BEFORE sharding: a small eval is latency-bound
+        # regardless of how many chips the big solves shard over.
         return "host", devs
+    if len(devs) > 1 and n_padded >= SHARD_MIN_NODES and \
+            n_padded % len(devs) == 0:
+        return "sharded", devs
     if devs[0].platform == "tpu" and n_padded >= PALLAS_MIN_NODES:
         return "pallas", devs
     return "xla", devs
@@ -161,7 +163,8 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
         if tier == "sharded":
             from .sharding import sharded_fill_depth
             return sharded_fill_depth(_mesh(devs), k_max=k_max,
-                                      spread_algorithm=spread_algorithm)
+                                      spread_algorithm=spread_algorithm,
+                                      depth_grid=depth_grid)
         if tier == "pallas":
             from .pallas_kernels import fill_depth_fused
             return functools.partial(fill_depth_fused, k_max=k_max,

@@ -74,7 +74,7 @@ def sharded_place_chunked(mesh: Mesh, axis: str = "nodes",
 
 
 def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16,
-                       spread_algorithm: bool = False):
+                       spread_algorithm: bool = False, depth_grid=None):
     """fill_depth with the node axis sharded: the [N, K] score-curve and
     cumsum stay node-local; the density argsort + global cumsum over the
     chosen depths become cross-shard collectives.
@@ -92,7 +92,8 @@ def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16,
                           k_max=k_max, spread_algorithm=spread_algorithm,
                           order_jitter=order_jitter,
                           jitter_scale=jitter_scale,
-                          jitter_samples=jitter_samples)
+                          jitter_samples=jitter_samples,
+                          depth_grid=depth_grid)
 
     return jax.jit(run,
                    in_shardings=(nd, nd, rep, rep, nv, nv, rep, nv,
