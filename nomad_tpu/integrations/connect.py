@@ -134,6 +134,9 @@ class _Forwarder(threading.Thread):
     def _splice(self, conn: socket.socket, target: tuple) -> None:
         try:
             out = socket.create_connection(target, timeout=5.0)
+            # the connect timeout must not become a 5s idle-read timeout
+            # on the spliced stream
+            out.settimeout(None)
         except OSError as e:
             self.logger(f"connect-proxy: dial {target} failed: {e!r}")
             conn.close()
@@ -159,7 +162,10 @@ class _Forwarder(threading.Thread):
         t = threading.Thread(target=pump, args=(out, conn), daemon=True)
         t.start()
         pump(conn, out)
-        t.join(timeout=30)
+        # close only after BOTH directions finished: the reverse pump may
+        # stream a long response after the client's half-close, and each
+        # pump terminates on EOF/error by itself (no read timeouts)
+        t.join()
         for s in (conn, out):
             try:
                 s.close()

@@ -166,6 +166,11 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
                                       spread_algorithm=spread_algorithm,
                                       depth_grid=depth_grid)
         if tier == "pallas":
+            if depth_grid is not None:
+                # the pallas curve producer is dense-K only; select()
+                # remaps this, the branch is defense for direct callers
+                return _build(kernel, "xla", devs, k_max, max_steps,
+                              spread_algorithm, depth_grid)
             from .pallas_kernels import fill_depth_fused
             return functools.partial(fill_depth_fused, k_max=k_max,
                                      spread_algorithm=spread_algorithm)
