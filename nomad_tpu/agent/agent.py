@@ -53,6 +53,9 @@ class AgentConfig:
     # telemetry { } stanza (ref command/agent/config.go:638 Telemetry)
     telemetry_prometheus: bool = True
     telemetry_collection_interval: float = 1.0
+    # vault { } analog: path of the durable secrets/KV store (empty =
+    # in-memory dev provider)
+    secrets_file: str = ""
 
     def key_bytes(self) -> bytes:
         from ..rpc.server import DEFAULT_KEY
@@ -100,7 +103,8 @@ class Agent:
                 acl_enabled=self.config.acl_enabled,
                 region=self.config.region,
                 authoritative_region=self.config.authoritative_region,
-                name=self.config.node_name or "")
+                name=self.config.node_name or "",
+                secrets_file=self.config.secrets_file)
         if self.config.client_enabled:
             if self.server is not None:
                 rpc = self.server       # in-process fast path (-dev)

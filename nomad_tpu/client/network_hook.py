@@ -204,14 +204,175 @@ class BridgeNetworkManager:
             pass                          # already gone (idempotent stop)
 
 
+class CNINetworkManager:
+    """Execute a CNI plugin chain from a .conflist (ref
+    client/allocrunner/networking_cni.go + the CNI spec's exec protocol):
+    a group with ``network { mode = "cni/<name>" }`` runs every plugin in
+    the named conflist with CNI_COMMAND=ADD at alloc start and DEL (in
+    reverse order) at stop. Plugin invocation goes through an injectable
+    runner so the chain is testable without CNI binaries; the default
+    runner executes ``<cni_bin_dir>/<type>`` with the conf on stdin, per
+    the spec."""
+
+    def __init__(self, config_dir: str = "/opt/cni/config",
+                 bin_dir: str = "/opt/cni/bin", runner=None, logger=None,
+                 netns=None):
+        self.config_dir = config_dir
+        self.bin_dir = bin_dir
+        self.logger = logger or (lambda msg: None)
+        self.runner = runner or self._exec_runner
+        # netns lifecycle is NOMAD's job, not the plugins' (ref
+        # networking_bridge_linux.go: the runtime creates the sandbox,
+        # CNI wires it). Injectable alongside the runner for tests; when
+        # a custom runner is supplied without a netns fn, default to
+        # no-op (the fake plugin world has no kernel namespaces).
+        if netns is not None:
+            self.netns = netns
+        elif runner is not None:
+            self.netns = lambda action, name: None
+        else:
+            self.netns = self._exec_netns
+        # (alloc, net) -> (ADD result, conflist used) — DEL must run the
+        # SAME config ADD ran even if the file was removed meanwhile
+        self._results: dict[tuple, tuple] = {}
+
+    @staticmethod
+    def _exec_netns(action: str, name: str) -> None:
+        out = subprocess.run(["ip", "netns", action, name],
+                             capture_output=True, text=True, timeout=10)
+        if out.returncode != 0 and action == "add":
+            raise RuntimeError(f"ip netns {action} {name}: "
+                               f"{out.stderr.strip()}")
+
+    def _exec_runner(self, plugin_type: str, env: dict,
+                     conf_json: str) -> str:
+        import os
+        binary = f"{self.bin_dir}/{plugin_type}"
+        out = subprocess.run([binary], input=conf_json, env={
+            **os.environ, **env}, capture_output=True, text=True,
+            timeout=30)
+        if out.returncode != 0:
+            raise RuntimeError(f"CNI {plugin_type} "
+                               f"{env.get('CNI_COMMAND')}: "
+                               f"{out.stderr.strip() or out.stdout.strip()}")
+        return out.stdout
+
+    def available(self, net_name: str) -> bool:
+        return self._load_conflist(net_name) is not None
+
+    def _load_conflist(self, net_name: str):
+        import json
+        import os
+        try:
+            names = sorted(os.listdir(self.config_dir))
+        except OSError:
+            return None
+        for fn in names:
+            if not (fn.endswith(".conflist") or fn.endswith(".conf")):
+                continue
+            try:
+                with open(os.path.join(self.config_dir, fn)) as f:
+                    conf = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if conf.get("name") == net_name:
+                if "plugins" not in conf:       # bare .conf -> one-plugin
+                    conf = {"name": conf.get("name"),
+                            "cniVersion": conf.get("cniVersion", "1.0.0"),
+                            "plugins": [conf]}
+                return conf
+        return None
+
+    def _env(self, command: str, alloc_id: str, ports: list[dict]) -> dict:
+        import json
+        return {
+            "CNI_COMMAND": command,
+            "CNI_CONTAINERID": alloc_id,
+            "CNI_NETNS": f"/var/run/netns/nomad-{alloc_id[:8]}",
+            "CNI_IFNAME": "eth0",
+            "CNI_PATH": self.bin_dir,
+            # the portmap plugin's runtime config rides CNI_ARGS-adjacent
+            # capability args (ref getPortMapping)
+            "CAP_ARGS": json.dumps({"portMappings": [
+                {"hostPort": p.get("value"), "containerPort":
+                 p.get("to") or p.get("value"), "protocol": "tcp"}
+                for p in ports]}),
+        }
+
+    def setup(self, alloc_id: str, net_name: str,
+              ports: list[dict]):
+        """Run the ADD chain; returns the netns status dict, or None when
+        the named network has no conflist (caller falls back to host
+        networking — returning None instead of raising closes the
+        available()/setup() TOCTOU window)."""
+        import json
+        conf = self._load_conflist(net_name)
+        if conf is None:
+            return None
+        ns = f"nomad-{alloc_id[:8]}"
+        self.netns("add", ns)
+        env = self._env("ADD", alloc_id, ports)
+        prev = None
+        for plugin in conf["plugins"]:
+            pconf = {**plugin, "name": conf["name"],
+                     "cniVersion": conf.get("cniVersion", "1.0.0")}
+            if prev is not None:
+                pconf["prevResult"] = prev
+            out = self.runner(plugin.get("type", ""), env,
+                              json.dumps(pconf))
+            try:
+                prev = json.loads(out) if out.strip() else prev
+            except ValueError:
+                pass                     # plugins may emit empty output
+        result = prev or {}
+        ips = result.get("ips") or []
+        status = {"mode": f"cni/{net_name}", "netns": ns,
+                  "ip": (ips[0].get("address", "").split("/")[0]
+                         if ips else ""),
+                  "result": result}
+        self._results[(alloc_id, net_name)] = (result, conf)
+        return status
+
+    def teardown(self, alloc_id: str, net_name: str,
+                 ports: list[dict]) -> None:
+        import json
+        cached = self._results.pop((alloc_id, net_name), None)
+        if cached is not None:
+            prev, conf = cached
+        else:
+            # client restarted since ADD: fall back to the on-disk conf
+            prev, conf = None, self._load_conflist(net_name)
+        ns = f"nomad-{alloc_id[:8]}"
+        if conf is not None:
+            env = self._env("DEL", alloc_id, ports)
+            # DEL runs the chain in REVERSE (CNI spec §4), with the SAME
+            # config ADD used even if the file changed/vanished meanwhile
+            for plugin in reversed(conf["plugins"]):
+                pconf = {**plugin, "name": conf["name"],
+                         "cniVersion": conf.get("cniVersion", "1.0.0")}
+                if prev is not None:
+                    pconf["prevResult"] = prev
+                try:
+                    self.runner(plugin.get("type", ""), env,
+                                json.dumps(pconf))
+                except Exception as e:  # noqa: BLE001 — keep deleting
+                    self.logger(f"CNI DEL {plugin.get('type')}: {e!r}")
+        try:
+            self.netns("delete", ns)
+        except Exception as e:          # noqa: BLE001 — already gone
+            self.logger(f"CNI netns delete {ns}: {e!r}")
+
+
 class NetworkHook:
     """The alloc-runner-facing hook (ref network_hook.go): no-ops unless
-    the group requests bridge mode AND the host supports it."""
+    the group requests bridge or cni/<name> mode AND the host supports
+    it."""
 
     def __init__(self, manager: Optional[BridgeNetworkManager] = None,
-                 logger=None):
+                 logger=None, cni: Optional[CNINetworkManager] = None):
         self.logger = logger or (lambda msg: None)
         self.manager = manager or BridgeNetworkManager(logger=self.logger)
+        self.cni = cni or CNINetworkManager(logger=self.logger)
         self.status: dict[str, dict] = {}    # alloc_id -> netns status
 
     @staticmethod
@@ -226,7 +387,23 @@ class NetworkHook:
             return []
         return [dict(p) for p in (res.shared.ports or [])]
 
+    @staticmethod
+    def _cni_net(tg) -> str:
+        mode = (tg.networks[0].mode if tg and tg.networks else "") or ""
+        return mode[4:] if mode.startswith("cni/") else ""
+
     def prerun(self, alloc, tg) -> Optional[dict]:
+        net = self._cni_net(tg)
+        if net:
+            st = self.cni.setup(alloc.id, net, self._alloc_ports(alloc))
+            if st is None:
+                self.logger(
+                    f"network_hook: cni/{net} requested by alloc "
+                    f"{alloc.id[:8]} but no conflist found; using host "
+                    f"networking")
+                return None
+            self.status[alloc.id] = st
+            return st
         if not self._bridge_requested(tg):
             return None
         if not self.manager.cmd.available():
@@ -242,6 +419,11 @@ class NetworkHook:
         return st
 
     def postrun(self, alloc, tg) -> None:
+        net = self._cni_net(tg)
+        if net:
+            self.cni.teardown(alloc.id, net, self._alloc_ports(alloc))
+            self.status.pop(alloc.id, None)
+            return
         if alloc.id not in self.status:
             # a bridge alloc restored after a client restart has no
             # in-memory status (restore never re-runs prerun) — still

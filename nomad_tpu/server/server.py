@@ -125,7 +125,7 @@ class Server:
     def __init__(self, num_workers: int = 2, logger: Optional[Callable] = None,
                  gc_interval: float = 300.0, acl_enabled: bool = False,
                  region: str = "global", authoritative_region: str = "",
-                 name: str = ""):
+                 name: str = "", secrets_file: str = ""):
         self.logger = logger or (lambda msg: None)
         self.region = region
         # cross-region ACL replication source (ref nomad/leader.go:1288);
@@ -157,8 +157,12 @@ class Server:
         self.drainer = NodeDrainer(self)
         from .volume_watcher import VolumeWatcher
         self.volume_watcher = VolumeWatcher(self)
-        from ..integrations.secrets import InMemorySecretsProvider
-        self.secrets = InMemorySecretsProvider()
+        if secrets_file:
+            from ..integrations.secrets import FileSecretsProvider
+            self.secrets = FileSecretsProvider(secrets_file)
+        else:
+            from ..integrations.secrets import InMemorySecretsProvider
+            self.secrets = InMemorySecretsProvider()
         self.scheduler_types = SCHEDULER_TYPES
         self.workers = [Worker(self, i) for i in range(num_workers)]
         self.gc_interval = gc_interval

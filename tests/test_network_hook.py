@@ -231,3 +231,92 @@ def test_postrun_after_restart_cleans_by_comment_tag():
     deletes = [c for c in sa.calls if c[:4] ==
                ("iptables", "-t", "nat", "-D")]
     assert len(deletes) == 1 and "23000" in deletes[0]
+
+
+# --------------------------------------------- CNI exec path (r3 Missing #4)
+
+class _FakeCNIRunner:
+    """Records every plugin invocation; returns a CNI result JSON from
+    the ipam-bearing plugin, empty otherwise; can inject failures."""
+
+    def __init__(self):
+        self.calls = []                 # (type, command, conf)
+        self.fail_types = set()
+
+    def __call__(self, plugin_type, env, conf_json):
+        import json
+        conf = json.loads(conf_json)
+        self.calls.append((plugin_type, env["CNI_COMMAND"], conf, dict(env)))
+        if plugin_type in self.fail_types:
+            raise RuntimeError("injected CNI failure")
+        if env["CNI_COMMAND"] == "ADD" and plugin_type == "bridge":
+            return json.dumps({"cniVersion": "1.0.0", "ips": [
+                {"address": "10.88.0.5/16", "gateway": "10.88.0.1"}]})
+        return ""
+
+
+def _cni_dir(tmp_path):
+    import json
+    d = tmp_path / "cni"
+    d.mkdir()
+    (d / "50-mynet.conflist").write_text(json.dumps({
+        "name": "mynet", "cniVersion": "1.0.0",
+        "plugins": [{"type": "bridge", "bridge": "cni0",
+                     "ipam": {"type": "host-local"}},
+                    {"type": "portmap",
+                     "capabilities": {"portMappings": True}}]}))
+    return str(d)
+
+
+def test_cni_add_chain_order_env_and_result(tmp_path):
+    from nomad_tpu.client.network_hook import CNINetworkManager
+    runner = _FakeCNIRunner()
+    mgr = CNINetworkManager(config_dir=_cni_dir(tmp_path), runner=runner)
+    assert mgr.available("mynet") and not mgr.available("other")
+    st = mgr.setup("alloc1234", "mynet",
+                   [{"label": "http", "value": 20100, "to": 8080}])
+    # chain order + env protocol
+    assert [(c[0], c[1]) for c in runner.calls] == \
+        [("bridge", "ADD"), ("portmap", "ADD")]
+    env = runner.calls[0][3]
+    assert env["CNI_CONTAINERID"] == "alloc1234"
+    assert env["CNI_IFNAME"] == "eth0"
+    assert "20100" in env["CAP_ARGS"] and "8080" in env["CAP_ARGS"]
+    # the second plugin receives the first's result (spec chaining)
+    assert runner.calls[1][2].get("prevResult", {}).get("ips")
+    assert st["ip"] == "10.88.0.5"
+    assert st["mode"] == "cni/mynet"
+
+
+def test_cni_del_runs_reverse_and_survives_failures(tmp_path):
+    from nomad_tpu.client.network_hook import CNINetworkManager
+    runner = _FakeCNIRunner()
+    mgr = CNINetworkManager(config_dir=_cni_dir(tmp_path), runner=runner)
+    mgr.setup("alloc1234", "mynet", [])
+    runner.calls.clear()
+    runner.fail_types.add("portmap")     # first DEL plugin fails
+    mgr.teardown("alloc1234", "mynet", [])
+    # reverse order, and the bridge DEL still ran after portmap failed
+    assert [(c[0], c[1]) for c in runner.calls] == \
+        [("portmap", "DEL"), ("bridge", "DEL")]
+
+
+def test_network_hook_routes_cni_mode(tmp_path):
+    from nomad_tpu import mock
+    from nomad_tpu.client.network_hook import (CNINetworkManager,
+                                               NetworkHook)
+    from nomad_tpu.structs import NetworkResource
+    runner = _FakeCNIRunner()
+    hook = NetworkHook(cni=CNINetworkManager(
+        config_dir=_cni_dir(tmp_path), runner=runner))
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.networks = [NetworkResource(mode="cni/mynet")]
+    alloc = mock.alloc_for(job, mock.node())
+    st = hook.prerun(alloc, tg)
+    assert st and st["mode"] == "cni/mynet"
+    hook.postrun(alloc, tg)
+    assert any(c[1] == "DEL" for c in runner.calls)
+    # unknown network degrades to host networking, not a crash
+    tg.networks = [NetworkResource(mode="cni/ghost")]
+    assert hook.prerun(alloc, tg) is None
