@@ -529,6 +529,35 @@ class HTTPAPI:
                                                   NS_READ_SCALING_POLICY))
             return to_api(p), s.state.table_index("scaling_policy")
 
+        # ---- mesh intentions (the consul intentions API face)
+        if parts == ["intentions"]:
+            from ..integrations.services import ServiceIntention
+            if method == "GET":
+                require(ns == "*" or
+                        acl.allow_namespace_operation(ns, NS_READ_JOB))
+                out = []
+                for i in s.intention_list(None if ns == "*" else ns):
+                    # wildcard listing filters per item, like /v1/services
+                    if ns == "*" and not acl.allow_namespace_operation(
+                            i.namespace, NS_READ_JOB):
+                        continue
+                    out.append(to_api(i))
+                return out, s.state.table_index("intentions")
+            if method in ("PUT", "POST"):
+                it = from_api(ServiceIntention, body)
+                require(acl.allow_namespace_operation(
+                    it.namespace or "default", NS_SUBMIT_JOB))
+                try:
+                    return s.intention_upsert(it), None
+                except ValueError as e:
+                    raise HTTPError(400, str(e))
+        if parts and parts[0] == "intention" and len(parts) == 3 and \
+                method == "DELETE":
+            require(acl.allow_namespace_operation(ns, NS_SUBMIT_JOB))
+            from urllib.parse import unquote
+            return s.intention_delete(ns, unquote(parts[1]),
+                                      unquote(parts[2])), None
+
         # ---- native service catalog (the consul integration's API face)
         if parts == ["services"]:
             require(ns == "*" or acl.allow_namespace_operation(ns,

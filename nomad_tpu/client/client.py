@@ -120,6 +120,16 @@ class Client:
         for ar in runners:
             for tr in list(ar.task_runners.values()):
                 tr.kill("client shutting down")
+        # kill() only signals; wait for the runner threads to actually
+        # stop their drivers so subprocesses and proxy listeners are gone
+        # when shutdown returns — a fresh client on this host may be
+        # assigned the same dynamic ports immediately
+        for ar in runners:
+            for tr in list(ar.task_runners.values()):
+                try:
+                    tr.wait_done(timeout=5.0)
+                except Exception:       # noqa: BLE001 — best-effort
+                    pass
         for drv in self.plugin_drivers.values():
             drv.shutdown()
 

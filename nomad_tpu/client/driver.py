@@ -408,12 +408,21 @@ class ConnectProxyDriver(Driver):
     def bind_client(self, client) -> None:
         self._client = client
 
-    def _resolver(self, namespace: str, destination: str):
+    def _resolver(self, namespace: str, source: str, destination: str):
         def resolve():
             client = self._client
             if client is None:
                 return None
             try:
+                # mesh authorization: the proxy enforces intentions per
+                # connection (the envoy-RBAC analog; ref Consul
+                # intentions). Default allow with no matching rule.
+                if not client.rpc.intention_allowed(namespace, source,
+                                                    destination):
+                    client.logger(
+                        f"connect-proxy: intention denies "
+                        f"{source} -> {destination}")
+                    return None
                 instances = client.rpc.service_instances(namespace,
                                                          destination)
             except Exception:           # noqa: BLE001 — servers away
@@ -446,7 +455,8 @@ class ConnectProxyDriver(Driver):
         for up in cfg.get("upstreams", []):
             forwarders.append(_Forwarder(
                 ("127.0.0.1", int(up["local_bind_port"])),
-                self._resolver(ns, up["destination"]), logger,
+                self._resolver(ns, cfg.get("service", ""),
+                               up["destination"]), logger,
                 name=f"connect-up-{up['destination']}-{task_id[:8]}"))
         for f in forwarders:
             f.start()

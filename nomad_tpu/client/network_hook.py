@@ -284,20 +284,35 @@ class CNINetworkManager:
         return None
 
     def _env(self, command: str, alloc_id: str, ports: list[dict]) -> dict:
-        import json
         return {
             "CNI_COMMAND": command,
             "CNI_CONTAINERID": alloc_id,
             "CNI_NETNS": f"/var/run/netns/nomad-{alloc_id[:8]}",
             "CNI_IFNAME": "eth0",
             "CNI_PATH": self.bin_dir,
-            # the portmap plugin's runtime config rides CNI_ARGS-adjacent
-            # capability args (ref getPortMapping)
-            "CAP_ARGS": json.dumps({"portMappings": [
-                {"hostPort": p.get("value"), "containerPort":
-                 p.get("to") or p.get("value"), "protocol": "tcp"}
-                for p in ports]}),
         }
+
+    @staticmethod
+    def _port_mappings(ports: list[dict]) -> list[dict]:
+        return [{"hostPort": p.get("value"),
+                 "containerPort": p.get("to") or p.get("value"),
+                 "protocol": "tcp"} for p in ports]
+
+    def _plugin_conf(self, plugin: dict, conf: dict, prev,
+                     ports: list[dict]) -> dict:
+        """Per-plugin stdin config: name/version injection, prevResult
+        chaining, and capability args delivered as runtimeConfig — the
+        ONLY channel real plugins read them from (libcni injects
+        runtimeConfig for each capability the plugin declares; ref
+        getPortMapping + the CNI conventions doc)."""
+        pconf = {**plugin, "name": conf["name"],
+                 "cniVersion": conf.get("cniVersion", "1.0.0")}
+        if prev is not None:
+            pconf["prevResult"] = prev
+        if (plugin.get("capabilities") or {}).get("portMappings"):
+            pconf["runtimeConfig"] = {
+                "portMappings": self._port_mappings(ports)}
+        return pconf
 
     def setup(self, alloc_id: str, net_name: str,
               ports: list[dict]):
@@ -313,17 +328,35 @@ class CNINetworkManager:
         self.netns("add", ns)
         env = self._env("ADD", alloc_id, ports)
         prev = None
-        for plugin in conf["plugins"]:
-            pconf = {**plugin, "name": conf["name"],
-                     "cniVersion": conf.get("cniVersion", "1.0.0")}
-            if prev is not None:
-                pconf["prevResult"] = prev
-            out = self.runner(plugin.get("type", ""), env,
-                              json.dumps(pconf))
+        added: list = []
+        try:
+            for plugin in conf["plugins"]:
+                pconf = self._plugin_conf(plugin, conf, prev, ports)
+                out = self.runner(plugin.get("type", ""), env,
+                                  json.dumps(pconf))
+                added.append(plugin)
+                try:
+                    prev = json.loads(out) if out.strip() else prev
+                except ValueError:
+                    pass                 # plugins may emit empty output
+        except Exception:
+            # mid-chain failure: unwind what DID run (reverse DEL) and
+            # drop the netns, or every scheduler retry leaks an IPAM
+            # lease + namespace
+            del_env = self._env("DEL", alloc_id, ports)
+            for plugin in reversed(added):
+                try:
+                    self.runner(plugin.get("type", ""), del_env,
+                                json.dumps(self._plugin_conf(
+                                    plugin, conf, prev, ports)))
+                except Exception as e:  # noqa: BLE001
+                    self.logger(f"CNI rollback {plugin.get('type')}: "
+                                f"{e!r}")
             try:
-                prev = json.loads(out) if out.strip() else prev
-            except ValueError:
-                pass                     # plugins may emit empty output
+                self.netns("delete", ns)
+            except Exception:           # noqa: BLE001
+                pass
+            raise
         result = prev or {}
         ips = result.get("ips") or []
         status = {"mode": f"cni/{net_name}", "netns": ns,
@@ -348,13 +381,10 @@ class CNINetworkManager:
             # DEL runs the chain in REVERSE (CNI spec §4), with the SAME
             # config ADD used even if the file changed/vanished meanwhile
             for plugin in reversed(conf["plugins"]):
-                pconf = {**plugin, "name": conf["name"],
-                         "cniVersion": conf.get("cniVersion", "1.0.0")}
-                if prev is not None:
-                    pconf["prevResult"] = prev
                 try:
                     self.runner(plugin.get("type", ""), env,
-                                json.dumps(pconf))
+                                json.dumps(self._plugin_conf(
+                                    plugin, conf, prev, ports)))
                 except Exception as e:  # noqa: BLE001 — keep deleting
                     self.logger(f"CNI DEL {plugin.get('type')}: {e!r}")
         try:

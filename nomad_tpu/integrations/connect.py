@@ -102,15 +102,35 @@ class _Forwarder(threading.Thread):
         self.connections = 0
 
     def run(self) -> None:
-        try:
-            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            srv.bind(self.bind)
-            srv.listen(16)
-            srv.settimeout(0.5)
-            self.sock = srv
-        except OSError as e:
-            self.logger(f"connect-proxy: bind {self.bind} failed: {e!r}")
+        # bind with retry: a dying alloc's proxy (or any process on a
+        # recycled dynamic port) may hold the address for a moment at
+        # start — giving up permanently would leave the sidecar deaf for
+        # the alloc's whole life
+        srv = None
+        warned = False
+        while not self._stop.is_set():
+            try:
+                srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                srv.bind(self.bind)
+                srv.listen(16)
+                srv.settimeout(0.5)
+                self.sock = srv
+                break
+            except OSError as e:
+                if srv is not None:     # socket() itself may have raised
+                    try:
+                        srv.close()
+                    except OSError:
+                        pass
+                srv = None
+                if not warned:
+                    self.logger(f"connect-proxy: bind {self.bind} failed "
+                                f"({e!r}); retrying")
+                    warned = True
+                if self._stop.wait(1.0):
+                    return
+        if srv is None:
             return
         while not self._stop.is_set():
             try:

@@ -20,6 +20,49 @@ from typing import Callable, Optional
 CHECK_PASSING = "passing"
 CHECK_CRITICAL = "critical"
 
+INTENTION_ALLOW = "allow"
+INTENTION_DENY = "deny"
+
+
+@dataclasses.dataclass
+class ServiceIntention:
+    """Mesh authorization rule (ref Consul intentions, consumed by the
+    connect admission in the reference): may `source` open connections to
+    `destination` through the sidecar data plane? "*" wildcards match any
+    service; exact entries outrank wildcards (Consul's precedence)."""
+    source: str = "*"
+    destination: str = "*"
+    action: str = INTENTION_ALLOW        # allow | deny
+    namespace: str = "default"
+    description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.namespace, self.source, self.destination)
+
+    def copy(self) -> "ServiceIntention":
+        return dataclasses.replace(self)
+
+
+def intention_allowed(intentions, namespace: str, source: str,
+                      destination: str) -> bool:
+    """Most-specific-match decision (Consul precedence: exact/exact >
+    exact/* > */exact > */*), default ALLOW with no matching rule."""
+    best = None
+    best_rank = -1
+    for it in intentions:
+        if it.namespace != namespace:
+            continue
+        if it.source not in ("*", source) or \
+                it.destination not in ("*", destination):
+            continue
+        rank = (2 if it.source != "*" else 0) + \
+               (1 if it.destination != "*" else 0)
+        if rank > best_rank:
+            best, best_rank = it, rank
+    return best is None or best.action == INTENTION_ALLOW
+
 
 @dataclasses.dataclass
 class ServiceInstance:

@@ -216,6 +216,11 @@ class ServerRpc:
     def csi_volume_claim(self, namespace: str, volume_id: str, claim):
         return self.rpc.call("CSIVolume.Claim", namespace, volume_id, claim)
 
+    def intention_allowed(self, namespace: str, source: str,
+                          destination: str) -> bool:
+        return self.rpc.call("Intention.Allowed", namespace, source,
+                             destination)
+
     def csi_node_detach_pending(self, node_id: str):
         return self.rpc.call("CSIVolume.NodeDetachPending", node_id)
 

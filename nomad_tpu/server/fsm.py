@@ -60,6 +60,8 @@ CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
 AUTOPILOT_CONFIG = "AutopilotRequestType"
 SERVICE_REGISTER = "ServiceRegistrationUpsertRequestType"
 SERVICE_DEREGISTER = "ServiceRegistrationDeleteRequestType"
+INTENTION_UPSERT = "ServiceIntentionUpsertRequestType"
+INTENTION_DELETE = "ServiceIntentionDeleteRequestType"
 
 
 @dataclasses.dataclass
@@ -203,6 +205,11 @@ class NomadFSM:
         elif msg_type == SERVICE_DEREGISTER:
             s.delete_service_registrations(
                 index, payload.get("alloc_id", ""), payload.get("keys"))
+        elif msg_type == INTENTION_UPSERT:
+            s.upsert_intention(index, payload["intention"])
+        elif msg_type == INTENTION_DELETE:
+            s.delete_intention(index, payload["namespace"],
+                               payload["source"], payload["destination"])
         else:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
@@ -237,6 +244,7 @@ class NomadFSM:
                 "csi_plugins": s.csi_plugins,
                 "autopilot_config": s.autopilot_config,
                 "services": s.services,
+                "intentions": s.intentions,
             }
             return pickle.dumps(blob)
 
@@ -268,6 +276,7 @@ class NomadFSM:
             s.autopilot_config = dict(
                 blob.get("autopilot_config", s.autopilot_config))
             s.services = dict(blob.get("services", {}))
+            s.intentions = dict(blob.get("intentions", {}))
             s._acl_token_by_secret = {
                 t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes

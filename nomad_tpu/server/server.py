@@ -86,6 +86,10 @@ RPC_ENDPOINTS = {
     "Service.Deregister": ("service_deregister", True),
     "Service.List": ("service_list", False),
     "Service.Instances": ("service_instances", False),
+    "Intention.Upsert": ("intention_upsert", True),
+    "Intention.Delete": ("intention_delete", True),
+    "Intention.List": ("intention_list", False),
+    "Intention.Allowed": ("intention_allowed", False),
     "Vault.DeriveToken": ("vault_derive_token", True),
     "Vault.RenewToken": ("vault_renew_token", True),
     "Vault.RevokeToken": ("vault_revoke_token", True),
@@ -941,6 +945,34 @@ class Server:
 
     def service_instances(self, namespace: str, name: str) -> list:
         return self.state.services_by_name(namespace, name)
+
+    # mesh authorization (Consul intentions analog): rules are raft-
+    # replicated; the connect proxies consult IntentionAllowed per
+    # connection
+    def intention_upsert(self, intention) -> dict:
+        from .fsm import INTENTION_UPSERT
+        from ..integrations.services import INTENTION_ALLOW, INTENTION_DENY
+        if intention.action not in (INTENTION_ALLOW, INTENTION_DENY):
+            raise ValueError(f"invalid action {intention.action!r}")
+        if not intention.source or not intention.destination:
+            raise ValueError("intention requires source and destination")
+        index = self.raft.apply(INTENTION_UPSERT, {"intention": intention})
+        return {"index": index}
+
+    def intention_delete(self, namespace: str, source: str,
+                         destination: str) -> dict:
+        from .fsm import INTENTION_DELETE
+        index = self.raft.apply(INTENTION_DELETE, {
+            "namespace": namespace, "source": source,
+            "destination": destination})
+        return {"index": index}
+
+    def intention_list(self, namespace: Optional[str] = None) -> list:
+        return self.state.iter_intentions(namespace)
+
+    def intention_allowed(self, namespace: str, source: str,
+                          destination: str) -> bool:
+        return self.state.intention_allowed(namespace, source, destination)
 
     def _reap_stale_services(self) -> None:
         """Registrations of terminal/vanished allocs are removed by the

@@ -65,6 +65,8 @@ class StateStore:
         # native service catalog (the consul-integration redesign;
         # ref nomad/state service_registration table in later lines)
         self.services: dict[tuple[str, str, str], object] = {}
+        # mesh authorization rules keyed (ns, source, destination)
+        self.intentions: dict[tuple[str, str, str], object] = {}
         # autopilot (ref nomad/state/autopilot.go AutopilotConfig)
         self.autopilot_config: dict = {
             "CleanupDeadServers": True,
@@ -145,6 +147,7 @@ class StateStore:
             out.csi_volumes = dict(self.csi_volumes)
             out.csi_plugins = dict(self.csi_plugins)
             out.services = dict(self.services)
+            out.intentions = dict(self.intentions)
             out.autopilot_config = dict(self.autopilot_config)
             out.usage = self.usage.copy()
             out._allocs_by_node = {k: set(v)
@@ -634,6 +637,38 @@ class StateStore:
             if doomed:
                 self._bump("services", index)
             self._commit()
+
+    # ----------------------------------------------------------- intentions
+
+    def upsert_intention(self, index: int, intention) -> None:
+        with self._lock:
+            idx = self._bump("intentions", index)
+            it = intention.copy()
+            existing = self.intentions.get(it.key())
+            it.create_index = existing.create_index if existing else idx
+            it.modify_index = idx
+            self.intentions[it.key()] = it
+            self._commit()
+
+    def delete_intention(self, index: int, namespace: str, source: str,
+                         destination: str) -> None:
+        with self._lock:
+            if self.intentions.pop((namespace, source, destination),
+                                   None) is not None:
+                self._bump("intentions", index)
+                self._commit()
+
+    def iter_intentions(self, namespace: Optional[str] = None) -> list:
+        with self._lock:
+            return [i for i in self.intentions.values()
+                    if namespace in (None, i.namespace)]
+
+    def intention_allowed(self, namespace: str, source: str,
+                          destination: str) -> bool:
+        from ..integrations.services import intention_allowed
+        with self._lock:
+            return intention_allowed(self.intentions.values(), namespace,
+                                     source, destination)
 
     def services_by_name(self, ns: str, name: str) -> list:
         with self._lock:

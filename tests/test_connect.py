@@ -166,9 +166,11 @@ def test_two_service_connect_job_mesh_path(tmp_path):
                      "for i in $(seq 1 100); do "
                      "python3 -c \"import urllib.request,os,sys;"
                      "addr=os.environ['NOMAD_UPSTREAM_ADDR_API_SVC'];"
-                     "open('%s','w').write(urllib.request.urlopen("
+                     "d=urllib.request.urlopen("
                      "'http://'+addr+'/index.html',timeout=2)"
-                     ".read().decode())\" && break; sleep 0.2; done; "
+                     ".read().decode();"
+                     "assert 'hello-mesh' in d;"
+                     "open('%s','w').write(d)\" && break; sleep 0.2; done; "
                      "sleep 60" % out]}
         a.server.job_register(web)
         assert wait_until(lambda: os.path.exists(out)
@@ -181,5 +183,137 @@ def test_two_service_connect_job_mesh_path(tmp_path):
         stats = [proxy_driver.inspect_task(tid)
                  for tid in list(proxy_driver._tasks)]
         assert sum(s["connections"] for s in stats) >= 2, stats
+    finally:
+        a.shutdown()
+
+
+# ------------------------------------------- intentions (mesh authz)
+
+def test_intention_precedence_and_default_allow():
+    from nomad_tpu.integrations.services import (
+        ServiceIntention, intention_allowed)
+    rules = [
+        ServiceIntention(source="*", destination="*", action="deny"),
+        ServiceIntention(source="web-svc", destination="*", action="allow"),
+        ServiceIntention(source="web-svc", destination="db-svc",
+                         action="deny"),
+    ]
+    # exact/exact outranks exact/* outranks */*
+    assert not intention_allowed(rules, "default", "web-svc", "db-svc")
+    assert intention_allowed(rules, "default", "web-svc", "api-svc")
+    assert not intention_allowed(rules, "default", "other", "api-svc")
+    # no rules at all -> default allow
+    assert intention_allowed([], "default", "a", "b")
+    # namespace isolation
+    assert intention_allowed(rules, "team-a", "other", "api-svc")
+
+
+def test_intentions_replicate_and_survive_snapshot():
+    from nomad_tpu.server import Server
+    from nomad_tpu.integrations.services import ServiceIntention
+    s = Server(num_workers=0)
+    s.start()
+    try:
+        s.intention_upsert(ServiceIntention(
+            source="web-svc", destination="db-svc", action="deny"))
+        assert not s.intention_allowed("default", "web-svc", "db-svc")
+        assert s.intention_allowed("default", "web-svc", "cache-svc")
+        blob = s.snapshot_save()
+        s2 = Server(num_workers=0)
+        s2.start()
+        try:
+            s2.snapshot_restore(blob)
+            assert not s2.intention_allowed("default", "web-svc", "db-svc")
+            assert len(s2.intention_list()) == 1
+            s2.intention_delete("default", "web-svc", "db-svc")
+            assert s2.intention_allowed("default", "web-svc", "db-svc")
+        finally:
+            s2.shutdown()
+    finally:
+        s.shutdown()
+
+
+def test_mesh_denied_by_intention(tmp_path):
+    """End to end: a deny intention makes the downstream's sidecar refuse
+    the upstream connection; deleting it restores the mesh path. The
+    fetch loop verifies CONTENT before accepting success, so an unrelated
+    listener on a recycled port can't satisfy it."""
+    from nomad_tpu.integrations.services import ServiceIntention
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    try:
+        assert wait_until(
+            lambda: a.server.state.node_by_id(a.client.node.id) is not None
+            and a.server.state.node_by_id(a.client.node.id).ready())
+        api = _connect_job("api2", "api-svc2")
+        api.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "cd local && echo mesh-ok > index.html && "
+                     "exec python3 -m http.server $NOMAD_PORT_http "
+                     "--bind 127.0.0.1"]}
+        a.server.job_register(api)
+        assert wait_until(lambda: bool(
+            a.server.service_instances("default", "api-svc2")))
+
+        a.server.intention_upsert(ServiceIntention(
+            source="web-svc2", destination="api-svc2", action="deny"))
+
+        out = str(tmp_path / "deny-out.txt")
+        web = _connect_job("web2", "web-svc2",
+                           upstreams=[("api-svc2", 21119)])
+        web.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "for i in $(seq 1 200); do "
+                     "python3 -c \"import urllib.request,os;"
+                     "addr=os.environ['NOMAD_UPSTREAM_ADDR_API_SVC2'];"
+                     "d=urllib.request.urlopen("
+                     "'http://'+addr+'/index.html',timeout=1)"
+                     ".read().decode();"
+                     "assert 'mesh-ok' in d;"
+                     "open('%s','w').write(d)\" && break; sleep 0.2; done; "
+                     "sleep 60" % out]}
+        a.server.job_register(web)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "web2")))
+        import time as _t
+        _t.sleep(2.5)
+        assert not os.path.exists(out), \
+            "mesh connection succeeded despite a deny intention"
+
+        # lift the intention: the retry loop gets through
+        a.server.intention_delete("default", "web-svc2", "api-svc2")
+        assert wait_until(lambda: os.path.exists(out)
+                          and "mesh-ok" in open(out).read(), timeout=40), \
+            "mesh did not recover after the intention was removed"
+    finally:
+        a.shutdown()
+
+
+def test_intentions_http_api():
+    import json
+    import urllib.request
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=0,
+                          client_enabled=False))
+    a.start()
+    try:
+        def call(method, path, body=None):
+            req = urllib.request.Request(a.http_addr + path,
+                data=json.dumps(body).encode() if body is not None
+                else None, method=method,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read() or "null")
+        call("POST", "/v1/intentions", {"Source": "a", "Destination": "b",
+                                        "Action": "deny"})
+        rules = call("GET", "/v1/intentions")
+        assert [(r["Source"], r["Destination"], r["Action"])
+                for r in rules] == [("a", "b", "deny")]
+        assert not a.server.intention_allowed("default", "a", "b")
+        call("DELETE", "/v1/intention/a/b")
+        assert call("GET", "/v1/intentions") == []
+        assert a.server.intention_allowed("default", "a", "b")
     finally:
         a.shutdown()

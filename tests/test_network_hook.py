@@ -281,7 +281,12 @@ def test_cni_add_chain_order_env_and_result(tmp_path):
     env = runner.calls[0][3]
     assert env["CNI_CONTAINERID"] == "alloc1234"
     assert env["CNI_IFNAME"] == "eth0"
-    assert "20100" in env["CAP_ARGS"] and "8080" in env["CAP_ARGS"]
+    # capability args ride runtimeConfig on the capability-declaring
+    # plugin's stdin conf (the channel real plugins read)
+    pm_conf = runner.calls[1][2]
+    assert pm_conf["runtimeConfig"]["portMappings"] == [
+        {"hostPort": 20100, "containerPort": 8080, "protocol": "tcp"}]
+    assert "runtimeConfig" not in runner.calls[0][2]
     # the second plugin receives the first's result (spec chaining)
     assert runner.calls[1][2].get("prevResult", {}).get("ips")
     assert st["ip"] == "10.88.0.5"
@@ -320,3 +325,23 @@ def test_network_hook_routes_cni_mode(tmp_path):
     # unknown network degrades to host networking, not a crash
     tg.networks = [NetworkResource(mode="cni/ghost")]
     assert hook.prerun(alloc, tg) is None
+
+
+def test_cni_mid_chain_failure_rolls_back(tmp_path):
+    """A failing plugin mid-ADD unwinds the already-added prefix (reverse
+    DEL) and deletes the netns — retries must not leak IPAM leases."""
+    from nomad_tpu.client.network_hook import CNINetworkManager
+    runner = _FakeCNIRunner()
+    runner.fail_types.add("portmap")
+    netns_calls = []
+    mgr = CNINetworkManager(config_dir=_cni_dir(tmp_path), runner=runner,
+                            netns=lambda a, n: netns_calls.append((a, n)))
+    import pytest as _pt
+    with _pt.raises(RuntimeError):
+        mgr.setup("alloc1234", "mynet", [])
+    kinds = [(c[0], c[1]) for c in runner.calls]
+    assert kinds == [("bridge", "ADD"), ("portmap", "ADD"),
+                     ("bridge", "DEL")]
+    assert ("add", "nomad-alloc123") in netns_calls
+    assert ("delete", "nomad-alloc123") in netns_calls
+    assert mgr._results == {}
