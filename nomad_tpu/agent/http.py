@@ -545,6 +545,10 @@ class HTTPAPI:
                 return out, s.state.table_index("intentions")
             if method in ("PUT", "POST"):
                 it = from_api(ServiceIntention, body)
+                if "Namespace" not in body:
+                    # like the CSI endpoints: the ?namespace= query param
+                    # scopes objects whose body omits it
+                    it.namespace = ns
                 require(acl.allow_namespace_operation(
                     it.namespace or "default", NS_SUBMIT_JOB))
                 try:

@@ -328,6 +328,20 @@ class AllocRunner:
         # (ref taskrunner/device_hook.go); a reservation failure fails the
         # task rather than launching it without its devices
         setup_error = ""
+        # driver config schema validation (the hclspec analog, ref
+        # plugins/shared/hclspec): a malformed config fails the task with
+        # a decode-style error instead of a mid-start crash
+        schema = None
+        if driver is not None:
+            get_schema = getattr(driver, "config_schema", None)
+            schema = get_schema() if get_schema else None
+        if schema is not None:
+            from .driver import validate_config
+            err = validate_config(task.config or {}, schema)
+            if err:
+                setup_error = f"driver config validation failed: {err}"
+                self.client.logger(
+                    f"task {task.name!r}: {setup_error}")
         tres = self.alloc.allocated_resources.tasks.get(task.name)
         for ad in (tres.devices if tres else []):
             try:

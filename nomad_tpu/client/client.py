@@ -123,11 +123,14 @@ class Client:
         # kill() only signals; wait for the runner threads to actually
         # stop their drivers so subprocesses and proxy listeners are gone
         # when shutdown returns — a fresh client on this host may be
-        # assigned the same dynamic ports immediately
+        # assigned the same dynamic ports immediately. ONE shared
+        # deadline: many slow-dying tasks must not serialize into
+        # minutes of shutdown
+        deadline = time.time() + 5.0
         for ar in runners:
             for tr in list(ar.task_runners.values()):
                 try:
-                    tr.wait_done(timeout=5.0)
+                    tr.wait_done(timeout=max(0.0, deadline - time.time()))
                 except Exception:       # noqa: BLE001 — best-effort
                     pass
         for drv in self.plugin_drivers.values():

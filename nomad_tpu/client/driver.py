@@ -183,11 +183,46 @@ class ExecSession:
                 pass
 
 
+def validate_config(config: dict, schema: dict) -> str:
+    """Validate a task's driver config against the driver's declared
+    schema (the hclspec analog, ref plugins/shared/hclspec + each
+    driver's TaskConfig spec). Returns "" or an error string.
+
+    schema: {key: {"type": "string"|"number"|"bool"|"list"|"map",
+                   "required": bool, "default": any}}; unknown keys are
+    rejected — the reference's hcl decoding errors the same way."""
+    TYPES = {"string": str, "number": (int, float), "bool": bool,
+             "list": (list, tuple), "map": dict}
+    for key in config:
+        if key not in schema:
+            return (f"unknown driver config key {key!r} "
+                    f"(known: {', '.join(sorted(schema)) or 'none'})")
+    for key, spec in schema.items():
+        if key not in config:
+            if spec.get("required"):
+                return f"missing required driver config key {key!r}"
+            continue
+        want = TYPES.get(spec.get("type", ""), object)
+        val = config[key]
+        # bools are ints in python; keep number/bool distinct like hcl
+        if spec.get("type") == "number" and isinstance(val, bool):
+            return f"driver config {key!r}: expected number, got bool"
+        if not isinstance(val, want):
+            return (f"driver config {key!r}: expected "
+                    f"{spec.get('type')}, got {type(val).__name__}")
+    return ""
+
+
 class Driver:
     name = "driver"
 
     def fingerprint(self) -> DriverInfo:
         return DriverInfo(detected=True, healthy=True)
+
+    def config_schema(self) -> Optional[dict]:
+        """Declared task-config schema (hclspec analog); None skips
+        validation (plugin drivers may validate internally)."""
+        return None
 
     def bind_client(self, client) -> None:
         """Drivers needing cluster access (catalog resolution etc.) get
@@ -273,6 +308,14 @@ class MockDriver(Driver):
     run_for (sec or duration string), exit_code, start_error, kill_after."""
 
     name = "mock_driver"
+
+    def config_schema(self):
+        # run_for/kill_after accept seconds OR duration strings -> no
+        # type constraint (hclspec would model this as a union)
+        return {"run_for": {}, "kill_after": {},
+                "exit_code": {"type": "number"},
+                "start_error": {"type": "string"},
+                "signal_error": {"type": "string"}}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -408,17 +451,27 @@ class ConnectProxyDriver(Driver):
     def bind_client(self, client) -> None:
         self._client = client
 
+    _AUTHZ_TTL = 2.0
+
     def _resolver(self, namespace: str, source: str, destination: str):
+        authz_cache = [0.0, True]       # (expiry, allowed)
+
         def resolve():
             client = self._client
             if client is None:
                 return None
             try:
-                # mesh authorization: the proxy enforces intentions per
-                # connection (the envoy-RBAC analog; ref Consul
-                # intentions). Default allow with no matching rule.
-                if not client.rpc.intention_allowed(namespace, source,
-                                                    destination):
+                # mesh authorization: the proxy enforces intentions (the
+                # envoy-RBAC analog; ref Consul intentions — which pushes
+                # cached intentions to proxies). A short-TTL cache keeps
+                # the data plane at ~one authz RPC per TTL instead of one
+                # per connection; default allow with no matching rule.
+                now = time.monotonic()
+                if now >= authz_cache[0]:
+                    authz_cache[1] = client.rpc.intention_allowed(
+                        namespace, source, destination)
+                    authz_cache[0] = now + self._AUTHZ_TTL
+                if not authz_cache[1]:
                     client.logger(
                         f"connect-proxy: intention denies "
                         f"{source} -> {destination}")
@@ -504,6 +557,10 @@ class RawExecDriver(Driver):
     command, args."""
 
     name = "raw_exec"
+
+    def config_schema(self):
+        return {"command": {"type": "string", "required": True},
+                "args": {"type": "list"}}
 
     def __init__(self):
         self._lock = threading.Lock()

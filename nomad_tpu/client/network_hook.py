@@ -283,7 +283,7 @@ class CNINetworkManager:
                 return conf
         return None
 
-    def _env(self, command: str, alloc_id: str, ports: list[dict]) -> dict:
+    def _env(self, command: str, alloc_id: str) -> dict:
         return {
             "CNI_COMMAND": command,
             "CNI_CONTAINERID": alloc_id,
@@ -326,7 +326,7 @@ class CNINetworkManager:
             return None
         ns = f"nomad-{alloc_id[:8]}"
         self.netns("add", ns)
-        env = self._env("ADD", alloc_id, ports)
+        env = self._env("ADD", alloc_id)
         prev = None
         added: list = []
         try:
@@ -343,7 +343,7 @@ class CNINetworkManager:
             # mid-chain failure: unwind what DID run (reverse DEL) and
             # drop the netns, or every scheduler retry leaks an IPAM
             # lease + namespace
-            del_env = self._env("DEL", alloc_id, ports)
+            del_env = self._env("DEL", alloc_id)
             for plugin in reversed(added):
                 try:
                     self.runner(plugin.get("type", ""), del_env,
@@ -377,7 +377,7 @@ class CNINetworkManager:
             prev, conf = None, self._load_conflist(net_name)
         ns = f"nomad-{alloc_id[:8]}"
         if conf is not None:
-            env = self._env("DEL", alloc_id, ports)
+            env = self._env("DEL", alloc_id)
             # DEL runs the chain in REVERSE (CNI spec §4), with the SAME
             # config ADD used even if the file changed/vanished meanwhile
             for plugin in reversed(conf["plugins"]):

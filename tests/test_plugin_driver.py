@@ -217,3 +217,74 @@ def test_conformance_streaming_exec(ext, tmp_path):
         sess._io(wait=0.1)
     ext.stop_task("t-exec")
     ext.destroy_task("t-exec")
+
+
+# -------------------- driver config schema (hclspec analog, r3 partial)
+
+def test_validate_config_matrix():
+    from nomad_tpu.client.driver import validate_config
+    schema = {"command": {"type": "string", "required": True},
+              "args": {"type": "list"},
+              "count": {"type": "number"},
+              "debug": {"type": "bool"},
+              "free": {}}
+    assert validate_config({"command": "/bin/x"}, schema) == ""
+    assert validate_config({"command": "/bin/x", "args": ["a"],
+                            "count": 2, "debug": True, "free": object()},
+                           schema) == ""
+    assert "missing required" in validate_config({}, schema)
+    assert "unknown driver config key" in validate_config(
+        {"command": "x", "bogus": 1}, schema)
+    assert "expected list" in validate_config(
+        {"command": "x", "args": "not-a-list"}, schema)
+    assert "expected number, got bool" in validate_config(
+        {"command": "x", "count": True}, schema)
+
+
+def test_bad_driver_config_fails_task_with_decode_error(tmp_path):
+    """A typo'd config key fails the task at setup with an hclspec-style
+    error, not a mid-start crash (ref drivers TaskConfig decoding)."""
+    import time as _t
+
+    from nomad_tpu.client import Client
+    from nomad_tpu.server import Server
+    from nomad_tpu import mock
+    server = Server(num_workers=2, gc_interval=9999)
+    server.start()
+    client = Client(server, data_dir=str(tmp_path / "c"))
+    client.start()
+    try:
+        deadline = _t.time() + 10
+        while _t.time() < deadline and \
+                server.state.node_by_id(client.node.id) is None:
+            _t.sleep(0.1)
+        job = mock.batch_job()
+        job.id = job.name = "badcfg"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.restart_policy.attempts = 0
+        tg.restart_policy.mode = "fail"
+        tg.reschedule_policy = None
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"comand": "/bin/true"}          # typo
+        task.resources.networks = []
+        tg.networks = []
+        server.job_register(job)
+        deadline = _t.time() + 15
+        failed = None
+        while _t.time() < deadline:
+            allocs = server.state.allocs_by_job("default", "badcfg")
+            failed = next((a for a in allocs
+                           if a.client_status == "failed"), None)
+            if failed:
+                break
+            _t.sleep(0.1)
+        assert failed is not None, "bad config did not fail the task"
+        events = [e.message for st in failed.task_states.values()
+                  for e in st.events]
+        assert any("unknown driver config key 'comand'" in m
+                   for m in events), events
+    finally:
+        client.shutdown()
+        server.shutdown()
