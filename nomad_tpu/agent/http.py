@@ -545,9 +545,15 @@ class HTTPAPI:
                 return out, s.state.table_index("intentions")
             if method in ("PUT", "POST"):
                 it = from_api(ServiceIntention, body)
-                if "Namespace" not in body:
+                if "Namespace" not in body and "namespace" not in body:
                     # like the CSI endpoints: the ?namespace= query param
                     # scopes objects whose body omits it
+                    if ns == "*":
+                        # a literal "*" namespace would never match any
+                        # authz check (namespaces don't wildcard) —
+                        # reject instead of storing an inert rule
+                        raise HTTPError(
+                            400, "wildcard namespace invalid for writes")
                     it.namespace = ns
                 require(acl.allow_namespace_operation(
                     it.namespace or "default", NS_SUBMIT_JOB))

@@ -559,8 +559,10 @@ class RawExecDriver(Driver):
     name = "raw_exec"
 
     def config_schema(self):
+        # args accepts a list OR a shell-style string (start_task
+        # shlex-splits strings) -> no type constraint
         return {"command": {"type": "string", "required": True},
-                "args": {"type": "list"}}
+                "args": {}}
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -35,6 +35,15 @@ class JavaDriver(RawExecDriver):
 
     name = "java"
 
+    def config_schema(self):
+        # overrides the inherited raw_exec schema, which would reject
+        # every legitimate java config key
+        return {"jar_path": {"type": "string"},
+                "class": {"type": "string"},
+                "class_path": {"type": "string"},
+                "jvm_options": {"type": "list"},
+                "args": {}}
+
     def fingerprint(self) -> DriverInfo:
         if shutil.which("java") is None:
             return DriverInfo(detected=False, healthy=False,
@@ -76,6 +85,13 @@ class QemuDriver(RawExecDriver):
 
     name = "qemu"
     binary = "qemu-system-x86_64"
+
+    def config_schema(self):
+        return {"image_path": {"type": "string", "required": True},
+                "accelerator": {"type": "string"},
+                "memory_mb": {"type": "number"},
+                "port_map": {"type": "list"},
+                "args": {}}
 
     def fingerprint(self) -> DriverInfo:
         if shutil.which(self.binary) is None:

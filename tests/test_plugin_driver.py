@@ -288,3 +288,22 @@ def test_bad_driver_config_fails_task_with_decode_error(tmp_path):
     finally:
         client.shutdown()
         server.shutdown()
+
+
+def test_ext_driver_schemas_accept_their_own_keys():
+    """java/qemu override the inherited raw_exec schema — their own
+    config keys must validate (regression: inherited schema rejected
+    every java/qemu config)."""
+    from nomad_tpu.client.driver import validate_config
+    from nomad_tpu.client.ext_drivers import JavaDriver, QemuDriver
+    assert validate_config({"jar_path": "app.jar",
+                            "jvm_options": ["-Xmx64m"]},
+                           JavaDriver().config_schema()) == ""
+    assert validate_config({"image_path": "vm.img", "memory_mb": 256},
+                           QemuDriver().config_schema()) == ""
+    assert "missing required" in validate_config(
+        {}, QemuDriver().config_schema())
+    # raw_exec string args stay valid (shlex-split by start_task)
+    from nomad_tpu.client.driver import RawExecDriver
+    assert validate_config({"command": "/bin/sh", "args": "-c 'echo'"},
+                           RawExecDriver().config_schema()) == ""

@@ -21,7 +21,8 @@ from nomad_tpu.structs import (
 from test_scheduler import make_eval, process
 from test_scheduler_corpus import allocs_of, live, register, seed_nodes
 from test_scheduler_corpus2 import (
-    fail_alloc, mark_running, run_all_running, set_node_status, drain_node,
+    _resched_job, drain_node, fail_alloc, mark_running, run_all_running,
+    set_node_status, update_job,
 )
 
 
@@ -1083,3 +1084,439 @@ def test_count_zero_group_stops_everything_keeps_job():
     process(h, zero)
     assert live(allocs_of(h, job)) == []
     assert h.state.job_by_id("default", job.id) is not None
+
+
+# ============================== final edge batch (corpus >= 150)
+
+def test_service_complete_alloc_is_replaced():
+    """SERVICE semantics: a client-complete alloc does not satisfy the
+    count — it is replaced (batch keeps it; ref shouldFilter service vs
+    batch rules)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    done = allocs_of(h, job)[0]
+    a2 = done.copy()
+    a2.client_status = ALLOC_CLIENT_COMPLETE
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    process(h, job)
+    live_now = [a for a in live(allocs_of(h, job))
+                if a.client_status != ALLOC_CLIENT_COMPLETE]
+    assert len(live_now) == 2, "service count not restored after complete"
+
+
+def test_batch_incomplete_lost_alloc_is_replaced():
+    """A RUNNING batch alloc lost to a node failure re-runs (only
+    completed work is final)."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    run_all_running(h, job)
+    victim = allocs_of(h, job)[0]
+    set_node_status(h, victim.node_id, NODE_STATUS_DOWN)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    repl = [a for a in live(allocs_of(h, job))
+            if a.node_id != victim.node_id]
+    assert len(repl) == 2
+
+
+def test_exhausted_limited_policy_creates_no_followup():
+    """attempts exhausted + unlimited=False: no delayed follow-up eval
+    spins forever (ref updateByReschedulable eligibility)."""
+    from nomad_tpu.structs import RescheduleEvent, RescheduleTracker
+    h = Harness()
+    seed_nodes(h, 4)
+    job = _resched_job(unlimited=False, attempts=1, delay_sec=30.0,
+                       interval_sec=3600)
+    register(h, job)
+    process(h, job)
+    orig = allocs_of(h, job)[0]
+    a2 = orig.copy()
+    a2.client_status = ALLOC_CLIENT_FAILED
+    a2.reschedule_tracker = RescheduleTracker(events=[RescheduleEvent(
+        reschedule_time_unix=time.time() - 5,
+        prev_alloc_id="x", prev_node_id="n")])
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    before = len([e for e in h.created_evals if e.wait_until_unix > 0])
+    process(h, job, trigger=TRIGGER_RETRY_FAILED_ALLOC)
+    after = len([e for e in h.created_evals if e.wait_until_unix > 0])
+    assert after == before, "exhausted policy scheduled a follow-up"
+
+
+def test_reschedule_delay_respects_max_delay_ceiling():
+    from nomad_tpu.structs import (ReschedulePolicy, RescheduleEvent,
+                                   RescheduleTracker)
+    pol = ReschedulePolicy(unlimited=True, delay_sec=30.0,
+                           delay_function="exponential",
+                           max_delay_sec=120.0)
+    a = mock.alloc()
+    a.client_status = ALLOC_CLIENT_FAILED
+    a.reschedule_tracker = RescheduleTracker(events=[RescheduleEvent(
+        reschedule_time_unix=time.time(), prev_alloc_id="p",
+        prev_node_id="n")] * 6)          # 30 * 2^6 >> ceiling
+    assert a.reschedule_delay(pol) == 120.0
+
+
+def test_distinct_property_value_quota():
+    """distinct_property with a numeric quota: at most N instances per
+    attribute value (ref propertyset.go)."""
+    from nomad_tpu.structs import OP_DISTINCT_PROPERTY
+    h = Harness()
+    seed_nodes(h, 6, fn=lambda n, i: n.meta.update(
+        {"rack": f"r{i % 3}"}) or n.compute_class())
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 6
+    tg.tasks[0].resources.networks = []
+    job.constraints = [Constraint(ltarget="${meta.rack}", rtarget="2",
+                                  operand=OP_DISTINCT_PROPERTY)]
+    register(h, job)
+    process(h, job)
+    nodes = {n.id: n for n in h.state.iter_nodes()}
+    per_rack = {}
+    for a in live(allocs_of(h, job)):
+        r = nodes[a.node_id].meta["rack"]
+        per_rack[r] = per_rack.get(r, 0) + 1
+    assert all(v <= 2 for v in per_rack.values()), per_rack
+    assert sum(per_rack.values()) == 6
+
+
+def test_distinct_hosts_partial_then_blocked():
+    """distinct_hosts with count > nodes: place one per node, block the
+    remainder (ref feasible.go DistinctHostsIterator)."""
+    from nomad_tpu.structs import OP_DISTINCT_HOSTS
+    h = Harness()
+    seed_nodes(h, 3)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 5
+    tg.tasks[0].resources.networks = []
+    tg.constraints = [Constraint(operand=OP_DISTINCT_HOSTS)]
+    register(h, job)
+    process(h, job)
+    allocs = live(allocs_of(h, job))
+    assert len(allocs) == 3
+    assert len({a.node_id for a in allocs}) == 3
+    blocked = [e for e in h.created_evals
+               if e.status == EVAL_STATUS_BLOCKED]
+    assert blocked
+
+
+def test_spread_missing_attribute_penalized():
+    """Nodes missing the spread attribute score -1 per stanza and are
+    chosen only when nothing better exists (ref spread.go)."""
+    from nomad_tpu.structs import Spread
+    h = Harness()
+    seed_nodes(h, 4, fn=lambda n, i: (
+        n.meta.update({"zone": f"z{i}"}) if i < 2 else None
+    ) or n.compute_class())
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.networks = []
+    tg.spreads = [Spread(attribute="${meta.zone}", weight=100)]
+    register(h, job)
+    process(h, job)
+    nodes = {n.id: n for n in h.state.iter_nodes()}
+    allocs = live(allocs_of(h, job))
+    assert len(allocs) == 2
+    assert all("zone" in nodes[a.node_id].meta for a in allocs), \
+        "placed on attribute-less nodes with zoned nodes free"
+
+
+def test_system_job_creates_no_deployment():
+    h = Harness()
+    seed_nodes(h, 3)
+    job = mock.system_job()
+    register(h, job)
+    process_system(h, job)
+    assert h.state.latest_deployment_by_job(job.namespace, job.id) is None
+
+
+def test_name_index_format_past_ten():
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 12
+    tg.tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    names = sorted(a.name for a in live(allocs_of(h, job)))
+    assert f"{job.id}.web[10]" in names and f"{job.id}.web[11]" in names
+    assert len(set(names)) == 12
+
+
+def test_canary_strategy_removed_mid_flight_rolls_normally():
+    """Dropping canary=N from the update stanza mid-gate: the next
+    version rolls without canaries; old unpromoted canaries stop (ref
+    handleGroupCanaries old-deployment cleanup)."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)          # canary gate up (v1)
+    v2 = updated.copy()
+    v2.version = 2
+    v2.task_groups[0].update.canary = 0
+    v2.task_groups[0].tasks[0].config = {"command": "/bin/v2"}
+    register(h, v2)
+    process(h, v2)
+    for _ in range(4):
+        for a in live(allocs_of(h, job)):
+            mark_running(h, a, healthy=True)
+        process(h, v2)
+    live_now = live(allocs_of(h, job))
+    assert len(live_now) == 4
+    assert all(a.job.version == 2 for a in live_now)
+    # the v1 canary is gone
+    assert not [a for a in live_now
+                if a.deployment_status and a.deployment_status.canary
+                and a.job.version == 1]
+
+
+def test_count_reduction_during_canary_gate():
+    """Scaling down while gated stops old allocs (highest names) without
+    leaking new-version placements."""
+    h = Harness()
+    seed_nodes(h, 8)
+    job = mock.canary_job(canaries=1)
+    run_all_running(h, job)
+    updated = update_job(h, job)
+    v2 = updated.copy()
+    v2.version = 2
+    v2.task_groups[0].count = 2           # 4 -> 2 while gated
+    register(h, v2)
+    process(h, v2)
+    allocs = allocs_of(h, job)
+    old_live = [a for a in live(allocs) if a.job.version == 0]
+    assert len(old_live) <= 2 + 1          # count + tolerated churn
+    non_canary_new = [a for a in live(allocs) if a.job.version >= 1
+                      and not (a.deployment_status
+                               and a.deployment_status.canary)]
+    assert not non_canary_new, "gate leaked new-version placements"
+
+
+def test_node_update_trigger_is_noop_when_converged():
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    job.task_groups[0].tasks[0].resources.networks = []
+    run_all_running(h, job)
+    before = {a.id for a in live(allocs_of(h, job))}
+    n_plans = len(h.plans)
+    process(h, job, trigger=TRIGGER_NODE_UPDATE)
+    assert {a.id for a in live(allocs_of(h, job))} == before
+    # converged eval submits no mutating plan (or an empty one)
+    for plan in h.plans[n_plans:]:
+        assert not plan.node_allocation
+
+
+def test_task_level_affinity_applies():
+    from nomad_tpu.structs import Affinity
+    h = Harness()
+    seed_nodes(h, 4, fn=lambda n, i: setattr(
+        n, "datacenter", "dc1" if i < 2 else "dc2"))
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].affinities = [Affinity(ltarget="${node.datacenter}",
+                                       rtarget="dc2", operand=OP_EQ,
+                                       weight=80)]
+    register(h, job)
+    process(h, job)
+    nodes = {n.id: n for n in h.state.iter_nodes()}
+    assert all(nodes[a.node_id].datacenter == "dc2"
+               for a in live(allocs_of(h, job)))
+
+
+def test_invalid_regexp_constraint_filters_not_crashes():
+    from nomad_tpu.structs import OP_REGEX
+    h = Harness()
+    seed_nodes(h, 3)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = []
+    job.constraints = [Constraint(ltarget="${attr.kernel.name}",
+                                  rtarget="[invalid(regex",
+                                  operand=OP_REGEX)]
+    register(h, job)
+    process(h, job)                      # must not raise
+    assert live(allocs_of(h, job)) == []
+    assert h.evals[-1].status == "complete"
+
+
+def test_job_and_group_constraints_both_apply():
+    h = Harness()
+    seed_nodes(h, 4, fn=lambda n, i: n.meta.update(
+        {"a": str(i % 2), "b": str(i // 2)}) or n.compute_class())
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = []
+    job.constraints = [Constraint(ltarget="${meta.a}", rtarget="1",
+                                  operand=OP_EQ)]
+    tg.constraints = list(tg.constraints) + [Constraint(
+        ltarget="${meta.b}", rtarget="1", operand=OP_EQ)]
+    register(h, job)
+    process(h, job)
+    allocs = live(allocs_of(h, job))
+    assert len(allocs) == 1
+    n = h.state.node_by_id(allocs[0].node_id)
+    assert n.meta["a"] == "1" and n.meta["b"] == "1"
+
+
+def test_version_constraint_on_nonversion_attribute_filters():
+    from nomad_tpu.structs import OP_VERSION
+    h = Harness()
+    seed_nodes(h, 2, fn=lambda n, i: n.attributes.update(
+        {"weird": "not-a-version"}) or n.compute_class())
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = []
+    job.constraints = [Constraint(ltarget="${attr.weird}",
+                                  rtarget=">= 1.0", operand=OP_VERSION)]
+    register(h, job)
+    process(h, job)
+    assert live(allocs_of(h, job)) == []
+
+
+def test_class_eligibility_cache_is_per_job():
+    """Two jobs with opposite constraints over one node class must not
+    poison each other's class-eligibility cache."""
+    h = Harness()
+    seed_nodes(h, 3, fn=lambda n, i: (setattr(n, "node_class", "pool"),
+                                      n.compute_class()))
+    a = mock.job()
+    a.task_groups[0].count = 1
+    a.task_groups[0].tasks[0].resources.networks = []
+    a.constraints = [Constraint(ltarget="${node.class}", rtarget="pool",
+                                operand=OP_EQ)]
+    b = mock.job()
+    b.task_groups[0].count = 1
+    b.task_groups[0].tasks[0].resources.networks = []
+    b.constraints = [Constraint(ltarget="${node.class}", rtarget="other",
+                                operand=OP_EQ)]
+    register(h, a)
+    register(h, b)
+    process(h, a)
+    process(h, b)
+    assert len(live(allocs_of(h, a))) == 1
+    assert live(allocs_of(h, b)) == []
+
+
+def test_eval_for_deleted_job_stops_strays():
+    """An eval racing a purge: the scheduler treats a missing job as
+    stopped and completes, stopping any strays."""
+    h = Harness()
+    seed_nodes(h, 3)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    h.state.delete_job(h.get_next_index(), job.namespace, job.id)
+    ev = make_eval(job)
+    h.state.upsert_evals(h.get_next_index(), [ev])
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    assert h.evals[-1].status == "complete"
+    assert live(allocs_of(h, job)) == []
+
+
+def test_service_job_no_nodes_blocks():
+    h = Harness()
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    assert live(allocs_of(h, job)) == []
+    blocked = [e for e in h.created_evals
+               if e.status == EVAL_STATUS_BLOCKED]
+    assert blocked
+
+
+def test_system_job_empty_cluster_completes_quietly():
+    h = Harness()
+    job = mock.system_job()
+    register(h, job)
+    process_system(h, job)
+    assert h.evals[-1].status == "complete"
+    assert allocs_of(h, job) == []
+
+
+def test_spread_implicit_remainder_target():
+    """Targets covering part of the distribution: the untargeted values
+    share the remainder (ref spread.go implicit target)."""
+    from nomad_tpu.structs import Spread, SpreadTarget
+    h = Harness()
+    seed_nodes(h, 8, fn=lambda n, i: setattr(
+        n, "datacenter", ["dc1", "dc2"][i % 2]))
+    job = mock.spread_job(targets=[("dc1", 50)])
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 8
+    register(h, job)
+    process(h, job)
+    nodes = {n.id: n for n in h.state.iter_nodes()}
+    by_dc = {}
+    for a in live(allocs_of(h, job)):
+        by_dc[nodes[a.node_id].datacenter] = \
+            by_dc.get(nodes[a.node_id].datacenter, 0) + 1
+    assert by_dc.get("dc1", 0) == 4, by_dc    # 50% of 8
+    assert by_dc.get("dc2", 0) == 4, by_dc    # the implicit remainder
+
+
+def test_two_spread_stanzas_combine():
+    from nomad_tpu.structs import Spread
+    h = Harness()
+    seed_nodes(h, 8, fn=lambda n, i: (
+        setattr(n, "datacenter", "dc1" if i < 4 else "dc2"),
+        n.meta.update({"rack": f"r{i % 2}"}), n.compute_class()))
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.count = 8
+    tg.tasks[0].resources.networks = []
+    tg.spreads = [Spread(attribute="${node.datacenter}", weight=100),
+                  Spread(attribute="${meta.rack}", weight=100)]
+    register(h, job)
+    process(h, job)
+    nodes = {n.id: n for n in h.state.iter_nodes()}
+    by_dc, by_rack = {}, {}
+    for a in live(allocs_of(h, job)):
+        n = nodes[a.node_id]
+        by_dc[n.datacenter] = by_dc.get(n.datacenter, 0) + 1
+        by_rack[n.meta["rack"]] = by_rack.get(n.meta["rack"], 0) + 1
+    assert max(by_dc.values()) - min(by_dc.values()) <= 2, by_dc
+    assert max(by_rack.values()) - min(by_rack.values()) <= 2, by_rack
+
+
+def test_alloc_stop_endpoint_semantics_reschedules():
+    """`nomad alloc stop`-style: stopping one alloc (desired stop) makes
+    the next eval place a replacement for the hole."""
+    h = Harness()
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].resources.networks = []
+    register(h, job)
+    process(h, job)
+    victim = allocs_of(h, job)[0]
+    a2 = victim.copy()
+    a2.desired_status = ALLOC_DESIRED_STOP
+    a2.client_status = ALLOC_CLIENT_COMPLETE
+    h.state.upsert_allocs(h.get_next_index(), [a2])
+    process(h, job)
+    live_now = live(allocs_of(h, job))
+    assert len(live_now) == 2
+    assert victim.id not in {a.id for a in live_now}
