@@ -192,7 +192,10 @@ def validate_config(config: dict, schema: dict) -> str:
                    "required": bool, "default": any}}; unknown keys are
     rejected — the reference's hcl decoding errors the same way."""
     TYPES = {"string": str, "number": (int, float), "bool": bool,
-             "list": (list, tuple), "map": dict}
+             "list": (list, tuple), "map": dict,
+             # args-style fields: a list OR a shell string the driver
+             # shlex-splits
+             "list_or_string": (list, tuple, str)}
     for key in config:
         if key not in schema:
             return (f"unknown driver config key {key!r} "
@@ -559,10 +562,8 @@ class RawExecDriver(Driver):
     name = "raw_exec"
 
     def config_schema(self):
-        # args accepts a list OR a shell-style string (start_task
-        # shlex-splits strings) -> no type constraint
         return {"command": {"type": "string", "required": True},
-                "args": {}}
+                "args": {"type": "list_or_string"}}
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -42,7 +42,7 @@ class JavaDriver(RawExecDriver):
                 "class": {"type": "string"},
                 "class_path": {"type": "string"},
                 "jvm_options": {"type": "list"},
-                "args": {}}
+                "args": {"type": "list_or_string"}}
 
     def fingerprint(self) -> DriverInfo:
         if shutil.which("java") is None:
@@ -89,9 +89,8 @@ class QemuDriver(RawExecDriver):
     def config_schema(self):
         return {"image_path": {"type": "string", "required": True},
                 "accelerator": {"type": "string"},
-                "memory_mb": {"type": "number"},
                 "port_map": {"type": "list"},
-                "args": {}}
+                "args": {"type": "list_or_string"}}
 
     def fingerprint(self) -> DriverInfo:
         if shutil.which(self.binary) is None:

@@ -956,6 +956,10 @@ class Server:
             raise ValueError(f"invalid action {intention.action!r}")
         if not intention.source or not intention.destination:
             raise ValueError("intention requires source and destination")
+        if not intention.namespace or intention.namespace == "*":
+            # namespaces match exactly in intention_allowed (no
+            # wildcarding) — a "*" namespace rule would be inert
+            raise ValueError("intention requires a concrete namespace")
         index = self.raft.apply(INTENTION_UPSERT, {"intention": intention})
         return {"index": index}
 

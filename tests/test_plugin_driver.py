@@ -299,8 +299,13 @@ def test_ext_driver_schemas_accept_their_own_keys():
     assert validate_config({"jar_path": "app.jar",
                             "jvm_options": ["-Xmx64m"]},
                            JavaDriver().config_schema()) == ""
-    assert validate_config({"image_path": "vm.img", "memory_mb": 256},
+    assert validate_config({"image_path": "vm.img",
+                            "accelerator": "tcg"},
                            QemuDriver().config_schema()) == ""
+    # args rejects non-list/non-string shapes
+    assert "expected list_or_string" in validate_config(
+        {"image_path": "vm.img", "args": 42},
+        QemuDriver().config_schema())
     assert "missing required" in validate_config(
         {}, QemuDriver().config_schema())
     # raw_exec string args stay valid (shlex-split by start_task)
