@@ -127,6 +127,14 @@ class Agent:
         self.api = HTTPAPI(self)
 
     def start(self) -> None:
+        # compiled sidecars (executor, logmon, allocstamp) are built from
+        # source at startup, not committed (ADVICE r4); quiet no-op when
+        # current, pure-Python fallbacks when no toolchain — but say so,
+        # because the fallbacks cost ~20x on the materialize hot path
+        from ..runtime import ensure_native
+        if not ensure_native():
+            self.logger("agent: native sidecars unavailable (no toolchain?);"
+                        " using pure-Python fallbacks")
         if self.server is not None:
             if self.config.rpc_port >= 0 and self.config.acl_enabled and \
                     not self.config.encrypt_key:

@@ -108,7 +108,10 @@ class BridgeNetworkManager:
     # -------------------------------------------------------------- setup
     @staticmethod
     def netns_name(alloc_id: str) -> str:
-        return f"nomad-{alloc_id[:8]}"
+        # full alloc id (ADVICE r4): netns names allow 255 chars, and an
+        # 8-hex prefix collides across live allocs often enough that the
+        # failure mode (cross-alloc teardown) is worth avoiding outright
+        return f"nomad-{alloc_id}"
 
     def setup(self, alloc_id: str, ports: list[dict]) -> dict:
         """Create the alloc namespace; returns {"ip", "netns", "gateway"}.
@@ -118,7 +121,9 @@ class BridgeNetworkManager:
         plugin).
         """
         ns = self.netns_name(alloc_id)
-        veth_host = f"veth{alloc_id[:7]}"
+        # IFNAMSIZ caps interface names at 15 chars: "veth" + 11 id chars
+        # (dashes stripped) is the most entropy that fits
+        veth_host = f"veth{alloc_id.replace('-', '')[:11]}"
         veth_ns = "eth0"
         with self._lock:
             self._ensure_bridge()
@@ -152,7 +157,7 @@ class BridgeNetworkManager:
                     "iptables", "-t", "nat", "-A", "PREROUTING",
                     "-p", "tcp", "--dport", str(host_port),
                     "-j", "DNAT", "--to-destination", f"{ip}:{to}",
-                    "-m", "comment", "--comment", f"nomad-alloc-{alloc_id[:8]}")
+                    "-m", "comment", "--comment", f"nomad-alloc-{alloc_id}")
         except RuntimeError:
             self.teardown(alloc_id, ports)
             raise
@@ -177,20 +182,24 @@ class BridgeNetworkManager:
                         "-p", "tcp", "--dport", str(host_port),
                         "-j", "DNAT", "--to-destination", f"{ip}:{to}",
                         "-m", "comment", "--comment",
-                        f"nomad-alloc-{alloc_id[:8]}")
+                        f"nomad-alloc-{alloc_id}")
                 except RuntimeError:
                     pass
         else:
             # no lease (client restarted since setup): find this alloc's
             # rules by their comment tag in iptables-save output and
-            # delete each by exact spec
+            # delete each by exact spec. Rules stamped by a pre-upgrade
+            # client carry the legacy short tag, so match both formats
+            # (quoted exactly — a bare prefix match could hit another
+            # alloc sharing the 8-char id prefix)
             try:
                 saved = self.cmd.run("iptables-save", "-t", "nat")
             except RuntimeError:
                 saved = ""
-            tag = f"nomad-alloc-{alloc_id[:8]}"
+            tags = (f'"nomad-alloc-{alloc_id}"',
+                    f'"nomad-alloc-{alloc_id[:8]}"')
             for line in (saved or "").splitlines():
-                if tag in line and line.startswith("-A "):
+                if line.startswith("-A ") and any(t in line for t in tags):
                     # iptables-save quotes comment values; the live rule
                     # has no quotes, so strip them or -D never matches
                     spec = [tok.strip('"') for tok in line.split()[1:]]
@@ -198,10 +207,13 @@ class BridgeNetworkManager:
                         self.cmd.run("iptables", "-t", "nat", "-D", *spec)
                     except RuntimeError:
                         pass
-        try:
-            self.cmd.run("ip", "netns", "delete", ns)
-        except RuntimeError:
-            pass                          # already gone (idempotent stop)
+        # also reap the legacy short-named namespace a pre-upgrade client
+        # may have created for this alloc
+        for name in {ns, f"nomad-{alloc_id[:8]}"}:
+            try:
+                self.cmd.run("ip", "netns", "delete", name)
+            except RuntimeError:
+                pass                      # already gone (idempotent stop)
 
 
 class CNINetworkManager:
@@ -287,7 +299,7 @@ class CNINetworkManager:
         return {
             "CNI_COMMAND": command,
             "CNI_CONTAINERID": alloc_id,
-            "CNI_NETNS": f"/var/run/netns/nomad-{alloc_id[:8]}",
+            "CNI_NETNS": f"/var/run/netns/nomad-{alloc_id}",
             "CNI_IFNAME": "eth0",
             "CNI_PATH": self.bin_dir,
         }
@@ -324,7 +336,7 @@ class CNINetworkManager:
         conf = self._load_conflist(net_name)
         if conf is None:
             return None
-        ns = f"nomad-{alloc_id[:8]}"
+        ns = f"nomad-{alloc_id}"
         self.netns("add", ns)
         env = self._env("ADD", alloc_id)
         prev = None
@@ -375,7 +387,7 @@ class CNINetworkManager:
         else:
             # client restarted since ADD: fall back to the on-disk conf
             prev, conf = None, self._load_conflist(net_name)
-        ns = f"nomad-{alloc_id[:8]}"
+        ns = f"nomad-{alloc_id}"
         if conf is not None:
             env = self._env("DEL", alloc_id)
             # DEL runs the chain in REVERSE (CNI spec §4), with the SAME

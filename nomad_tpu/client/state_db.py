@@ -34,10 +34,14 @@ class StateDB:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
         self._instance = uuid.uuid4().hex
         self._allocs: dict[str, Allocation] = {}
         self._handles: dict[str, dict[str, dict]] = {}
         self._node_id: str = ""
+        self._superseded = False
+        self._seq = 0             # snapshot sequence, under self._lock
+        self._written_seq = 0     # last flushed sequence, under _flush_lock
         with self._flocked():
             self._load()
             self._sweep_tmps()
@@ -89,12 +93,19 @@ class StateDB:
         that both re-derive the same generation after a deletion can't
         ping-pong — the first reclaimer's bump makes the other observe a
         strictly greater generation and stand down, so the newest
-        writer's state converges on top."""
+        writer's state converges on top. Supersession is STICKY (ADVICE
+        r4): once this instance has ever observed a higher generation it
+        refuses reclaim forever — otherwise deleting the owner file lets
+        a superseded instance that flushes first overwrite the newer
+        instance's db with its stale snapshot."""
+        if self._superseded:
+            return False
         gen, token = self._read_owner()
         if token == self._instance:
             return True
         if gen > self._gen:
-            return False                # a newer instance owns the path
+            self._superseded = True     # a newer instance owns the path
+            return False
         self._gen = max(self._gen, gen) + 1
         self._claim_ownership()         # missing, or a stale reclaimer
         return True
@@ -130,23 +141,40 @@ class StateDB:
             # corrupt state: start fresh (the reference logs + recovers too)
             self._allocs, self._handles = {}, {}
 
-    def _flush_locked(self) -> None:
-        # Tmp-per-writer + fsync + atomic rename, all inside the flock.
-        # The ownership re-check makes a superseded instance's flush a
-        # no-op instead of a stale overwrite (completeness without
-        # freshness would still lose the new client's reattach state).
+    def _snapshot(self) -> tuple:
+        """Consistent copy of the persisted maps + a sequence number.
+        Must be called under self._lock (the shallow dict copies are the
+        write-isolation boundary — Allocation values are replaced, never
+        mutated, by the client's update paths)."""
+        self._seq += 1
+        return (self._seq, dict(self._allocs), dict(self._handles),
+                self._node_id)
+
+    def _flush_snapshot(self, snap: tuple) -> None:
+        """Persist a snapshot OUTSIDE self._lock (ADVICE r4: awaiting the
+        inter-process flock while holding the thread lock lets a
+        contending sidecar process stall every StateDB API call). The
+        flush mutex serializes same-process flushers; the sequence check
+        drops a snapshot that lost the race to a newer one, so writes
+        can't go back in time. Tmp-per-writer + fsync + atomic rename,
+        all inside the flock. The ownership re-check makes a superseded
+        instance's flush a no-op instead of a stale overwrite."""
+        seq, allocs, handles, node_id = snap
         d = os.path.dirname(self.path) or "."
-        with self._flocked():
+        with self._flush_lock, self._flocked():
+            if seq <= self._written_seq:
+                return              # a newer snapshot already landed
             if not self._is_owner():
                 return              # superseded by a newer instance
+            self._written_seq = seq
             fd, tmp = tempfile.mkstemp(
                 prefix=os.path.basename(self.path) + ".", suffix=".tmp",
                 dir=d)
             try:
                 with os.fdopen(fd, "wb") as f:
-                    pickle.dump({"allocs": self._allocs,
-                                 "handles": self._handles,
-                                 "node_id": self._node_id}, f)
+                    pickle.dump({"allocs": allocs,
+                                 "handles": handles,
+                                 "node_id": node_id}, f)
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self.path)
@@ -169,7 +197,8 @@ class StateDB:
     def put_node_id(self, node_id: str) -> None:
         with self._lock:
             self._node_id = node_id
-            self._flush_locked()
+            snap = self._snapshot()
+        self._flush_snapshot(snap)
 
     def get_node_id(self) -> str:
         with self._lock:
@@ -178,7 +207,8 @@ class StateDB:
     def put_allocation(self, alloc: Allocation) -> None:
         with self._lock:
             self._allocs[alloc.id] = alloc
-            self._flush_locked()
+            snap = self._snapshot()
+        self._flush_snapshot(snap)
 
     def get_all_allocations(self) -> list[Allocation]:
         with self._lock:
@@ -188,7 +218,8 @@ class StateDB:
                          handles: dict[str, dict]) -> None:
         with self._lock:
             self._handles[alloc_id] = handles
-            self._flush_locked()
+            snap = self._snapshot()
+        self._flush_snapshot(snap)
 
     def get_task_handles(self, alloc_id: str) -> dict[str, dict]:
         with self._lock:
@@ -198,4 +229,5 @@ class StateDB:
         with self._lock:
             self._allocs.pop(alloc_id, None)
             self._handles.pop(alloc_id, None)
-            self._flush_locked()
+            snap = self._snapshot()
+        self._flush_snapshot(snap)

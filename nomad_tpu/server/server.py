@@ -1120,8 +1120,26 @@ class Server:
             if plug is None or not plug.controller_required:
                 continue
             if node_id:
+                from ..structs import NODE_STATUS_DOWN
                 healthy = sorted(nid for nid, ok in plug.controllers.items()
                                  if ok)
+                if not healthy:
+                    # no controller reports healthy (ADVICE r4): lease on
+                    # a registered id whose NODE is still alive rather
+                    # than dropping the gate — an open gate hands the
+                    # same claim to every polling host and the backend
+                    # sees duplicate ControllerUnpublishVolume rounds.
+                    # Dead-node registrations are excluded (leasing on a
+                    # SIGKILL'd host would stall detach forever); if NO
+                    # registered controller is provably alive, grant the
+                    # caller (it is polling, therefore alive) — progress
+                    # over dedup in the double-failure corner.
+                    def _alive(nid: str) -> bool:
+                        n = self.state.node_by_id(nid)
+                        return (n is not None
+                                and n.status != NODE_STATUS_DOWN)
+                    healthy = sorted(nid for nid in plug.controllers
+                                     if _alive(nid))
                 if healthy and node_id != healthy[0]:
                     continue        # another node holds the lease
             for claim in list(vol.read_claims.values()) + \

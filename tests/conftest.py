@@ -16,3 +16,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# compiled native sidecars are not committed; build them (no-op when
+# current, silent skip when no toolchain — pure-Python fallbacks cover)
+from nomad_tpu.runtime import ensure_native  # noqa: E402
+
+ensure_native()

@@ -45,14 +45,27 @@ def test_stamp_matches_constructor(native, monkeypatch):
 
 
 def test_stamped_allocs_copy_on_write_safe():
-    """The sharing contract: stamped instances share default containers,
-    and Allocation.copy() (the store's update discipline) un-shares them."""
+    """The sharing contract (ADVICE r4): caller-supplied shared objects
+    are one object batch-wide, but unsupplied MUTABLE defaults are fresh
+    per instance (lazily materialized) — a direct in-place mutation on a
+    stored alloc can no longer corrupt its batch siblings."""
     _, _, _, shared, varying = _mk(4)
     allocs = stamp_batch(Allocation, 4, shared, varying)
-    assert allocs[0].task_states is allocs[1].task_states     # shared
-    c = allocs[0].copy()
+    # unsupplied mutable defaults: per-instance fresh products
+    assert allocs[0].task_states is not allocs[1].task_states
+    assert allocs[0].desired_transition is not allocs[1].desired_transition
+    assert allocs[0].preempted_allocations is not allocs[1].preempted_allocations
+    allocs[0].task_states["web"] = "dirty"        # direct mutation...
+    assert allocs[1].task_states == {}            # ...stays local
+    allocs[0].desired_transition.migrate = True
+    assert allocs[1].desired_transition.migrate is None
+    # caller-supplied shared objects remain intentionally shared
+    if "allocated_resources" in shared:
+        assert (allocs[0].allocated_resources
+                is allocs[1].allocated_resources)
+    c = allocs[2].copy()
     c.task_states["web"] = "dirty"
-    assert allocs[1].task_states == {}                        # isolated
+    assert allocs[3].task_states == {}            # copy() still isolates
 
 
 def test_varying_too_short_raises():

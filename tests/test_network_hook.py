@@ -65,11 +65,11 @@ def test_setup_creates_bridge_netns_and_dnat():
     mgr = BridgeNetworkManager(commander=cmd)
     ports = [{"label": "http", "value": 22000, "to": 8080}]
     st = mgr.setup("11112222-aaaa", ports)
-    assert st["netns"] == "nomad-11112222"
+    assert st["netns"] == "nomad-11112222-aaaa"
     assert st["ip"].startswith("172.26.")
     assert st["ip"] != st["gateway"]
     assert BRIDGE_NAME in cmd.links
-    assert "nomad-11112222" in cmd.netns
+    assert "nomad-11112222-aaaa" in cmd.netns
     # one DNAT rule mapping host 22000 -> ns 8080
     dnat = [c for c in cmd.calls if "DNAT" in c and "-A" in c]
     assert len(dnat) == 1
@@ -82,7 +82,7 @@ def test_teardown_removes_netns_and_rules():
     ports = [{"label": "http", "value": 22000, "to": 8080}]
     mgr.setup("11112222-aaaa", ports)
     mgr.teardown("11112222-aaaa", ports)
-    assert "nomad-11112222" not in cmd.netns
+    assert "nomad-11112222-aaaa" not in cmd.netns
     deletes = [c for c in cmd.calls if "DNAT" in c and "-D" in c]
     assert len(deletes) == 1
     # idempotent: second teardown is a no-op, not an error
@@ -135,7 +135,7 @@ def test_setup_failure_rolls_back():
     mgr = BridgeNetworkManager(commander=cmd)
     with pytest.raises(RuntimeError):
         mgr.setup("11112222-aaaa", [])
-    assert "nomad-11112222" not in cmd.netns       # rolled back
+    assert "nomad-11112222-aaaa" not in cmd.netns       # rolled back
 
 
 def test_hook_noop_for_host_mode():
@@ -155,11 +155,11 @@ def test_hook_bridge_mode_lifecycle():
                                   "to": 9090}])
     tg = _bridge_tg()
     st = hook.prerun(alloc, tg)
-    assert st and st["netns"] == "nomad-11112222"
+    assert st and st["netns"] == "nomad-11112222-aaaa"
     assert alloc.id in hook.status
     hook.postrun(alloc, tg)
     assert alloc.id not in hook.status
-    assert "nomad-11112222" not in cmd.netns
+    assert "nomad-11112222-aaaa" not in cmd.netns
 
 
 def test_hook_degrades_without_tooling():
@@ -183,9 +183,9 @@ def test_taskenv_exports_network_status():
     task = alloc.job.task_groups[0].tasks[0]
     env = build_task_env(alloc, task, mock.node(), "/t", "/a", "/s",
                          network_status={"ip": "172.26.64.5",
-                                         "netns": "nomad-11112222"})
+                                         "netns": "nomad-11112222-aaaa"})
     assert env["NOMAD_ALLOC_IP"] == "172.26.64.5"
-    assert env["NOMAD_ALLOC_NETNS"] == "nomad-11112222"
+    assert env["NOMAD_ALLOC_NETNS"] == "nomad-11112222-aaaa"
 
 
 def test_lease_not_leaked_on_netns_add_failure():
@@ -212,7 +212,7 @@ def test_postrun_after_restart_cleans_by_comment_tag():
 
     # real iptables-save quotes comment values
     save_line = (f"-A PREROUTING -p tcp -m tcp --dport 23000 "
-                 f'-m comment --comment "nomad-alloc-11112222" '
+                 f'-m comment --comment "nomad-alloc-11112222-aaaa" '
                  f"-j DNAT --to-destination {st['ip']}:8080")
 
     class SaveAware(FakeCommander):
@@ -227,7 +227,7 @@ def test_postrun_after_restart_cleans_by_comment_tag():
     hook = NetworkHook(manager=mgr)
     alloc = _bridge_alloc(ports=ports)
     hook.postrun(alloc, _bridge_tg())     # no status entry: restart path
-    assert "nomad-11112222" not in sa.netns
+    assert "nomad-11112222-aaaa" not in sa.netns
     deletes = [c for c in sa.calls if c[:4] ==
                ("iptables", "-t", "nat", "-D")]
     assert len(deletes) == 1 and "23000" in deletes[0]
@@ -342,6 +342,6 @@ def test_cni_mid_chain_failure_rolls_back(tmp_path):
     kinds = [(c[0], c[1]) for c in runner.calls]
     assert kinds == [("bridge", "ADD"), ("portmap", "ADD"),
                      ("bridge", "DEL")]
-    assert ("add", "nomad-alloc123") in netns_calls
-    assert ("delete", "nomad-alloc123") in netns_calls
+    assert ("add", "nomad-alloc1234") in netns_calls
+    assert ("delete", "nomad-alloc1234") in netns_calls
     assert mgr._results == {}
