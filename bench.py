@@ -19,6 +19,7 @@ placements, the extrapolation to 50k is reported separately).
 round-1 kernel-only solve for comparison.
 """
 import json
+import os
 import sys
 import time
 
@@ -181,35 +182,24 @@ def _concurrent_rejection_rate(algorithm: str, n_jobs: int = 8,
 
 # ------------------------------------------------------------------ headline
 
-def main() -> None:
-    import random
-
-    import jax
-    from nomad_tpu.runtime import ensure_native, tune_gc
+def _warmup_compile() -> float:
+    """Pay every one-time XLA compile the measured paths use; -> seconds.
+    Same node count as the measured runs (=> same padded kernel bucket).
+    BOTH depth regimes are warmed — the tiny job hits the jittered
+    sampled-grid artifact (host tier), the 16k job the deterministic
+    full-curve artifact on the accelerator (m = 2*16k/10k > 3), which is
+    what the measured 50k run uses."""
     from nomad_tpu.server.fsm import RaftLog
     from nomad_tpu.server.plan_apply import Planner
     from nomad_tpu.structs import SCHED_ALG_TPU
-
-    # the same process-level GC tuning Server.start()/Agent.start() apply —
-    # the bench simulates the server loop and must measure what prod runs
-    tune_gc()
-    # compiled sidecars are built, not committed (ADVICE r4); no-op when current
-    ensure_native()
-
-    # the placer decorrelates concurrent workers via random node shuffles;
-    # seed it so the reported rejection rates are reproducible run to run
-    random.seed(20260729)
-    platform = jax.devices()[0].platform
-
-    # warmup pass: same node count (=> same padded kernel bucket); pays the
-    # one-time XLA compiles so the measured run reflects steady state. BOTH
-    # depth regimes are warmed — the tiny job hits the jittered sampled-
-    # grid artifact (host tier), the 16k job the deterministic full-curve
-    # artifact on the accelerator (m = 2*16k/10k > 3), which is what the
-    # measured 50k run uses.
     t0 = time.perf_counter()
     fsm_w = _seed_fsm(N_NODES, SCHED_ALG_TPU)
     planner_w = Planner(RaftLog(fsm_w), fsm_w.state)
+    _warmup_evals(fsm_w, planner_w)
+    return time.perf_counter() - t0
+
+
+def _warmup_evals(fsm_w, planner_w) -> None:
     # three artifacts: jittered-grid on the host tier (tiny count),
     # jittered-grid on the accelerator (mid count), deterministic full
     # curve on the accelerator (m > 3)
@@ -219,7 +209,80 @@ def main() -> None:
         _register(fsm_w, job_w)
         _run_eval(fsm_w, planner_w, job_w)
         _validate(fsm_w, wname, wcount)
-    compile_s = time.perf_counter() - t0
+
+
+def warm_probe() -> None:
+    """Subprocess mode: a RESTARTED scheduler process with the persistent
+    compile cache populated (VERDICT r4 #3 done-when: warm jit <2s).
+    Reports the restart blackout split into its parts: device attach
+    (hardware session, cache-independent), state seeding (the FSM
+    restore analog, cache-independent), and the jit warmup itself —
+    the only part the compile cache can remove."""
+    import random
+
+    import jax
+    from nomad_tpu.runtime import enable_compile_cache, tune_gc
+    from nomad_tpu.server.fsm import RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+    from nomad_tpu.structs import SCHED_ALG_TPU
+    enable_compile_cache()      # NOMAD_COMPILE_CACHE from the parent
+    tune_gc()
+    random.seed(20260729)
+    t0 = time.perf_counter()
+    jax.devices()
+    attach_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fsm_w = _seed_fsm(N_NODES, SCHED_ALG_TPU)
+    planner_w = Planner(RaftLog(fsm_w), fsm_w.state)
+    seed_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _warmup_evals(fsm_w, planner_w)
+    jit_s = time.perf_counter() - t0
+    # second pass on a fresh cluster = pure steady-state execution; the
+    # compile/cache-load overhead of a warm restart is the difference
+    fsm_2 = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=7)
+    planner_2 = Planner(RaftLog(fsm_2), fsm_2.state)
+    t0 = time.perf_counter()
+    _warmup_evals(fsm_2, planner_2)
+    steady_s = time.perf_counter() - t0
+    print(json.dumps({"warm_compile_s": round(max(0.0, jit_s - steady_s),
+                                              3),
+                      "warm_first_pass_s": round(jit_s, 3),
+                      "steady_pass_s": round(steady_s, 3),
+                      "device_attach_s": round(attach_s, 3),
+                      "state_seed_s": round(seed_s, 3)}))
+
+
+def main() -> None:
+    import random
+
+    import jax
+    from nomad_tpu.runtime import (
+        enable_compile_cache, ensure_native, tune_gc,
+    )
+    from nomad_tpu.server.fsm import RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+    from nomad_tpu.structs import SCHED_ALG_TPU
+
+    # the same process-level GC tuning Server.start()/Agent.start() apply —
+    # the bench simulates the server loop and must measure what prod runs
+    tune_gc()
+    # compiled sidecars are built, not committed (ADVICE r4); no-op when current
+    ensure_native()
+    # persistent compile cache in a FRESH dir: compile_s below stays an
+    # honest cold number, and the warm-restart probe at the end re-runs
+    # the warmup in a child process against the now-populated cache
+    import tempfile
+    cache_dir = os.environ.get("NOMAD_COMPILE_CACHE") or tempfile.mkdtemp(
+        prefix="nomad-bench-xla-cache-")
+    enable_compile_cache(cache_dir)
+
+    # the placer decorrelates concurrent workers via random node shuffles;
+    # seed it so the reported rejection rates are reproducible run to run
+    random.seed(20260729)
+    platform = jax.devices()[0].platform
+
+    compile_s = _warmup_compile()
 
     # measured: fresh cluster, the BASELINE 50k/10k scenario, end to end
     from nomad_tpu.metrics import metrics
@@ -251,6 +314,28 @@ def main() -> None:
               if metrics.counter("nomad.solver.kernel.fill_depth")
               else "fill_greedy_binpack")
 
+    # which backend tier actually served the headline solves (VERDICT r4
+    # weak #1: routing was correct by construction but unproven in the
+    # bench JSON; these are backend.record's counters verbatim)
+    def _tier_counters(base: dict = None) -> dict:
+        out = {}
+        for k, v in metrics.snapshot()["counters"].items():
+            if k.startswith("nomad.solver.backend.") or \
+                    k.startswith("nomad.solver.kernel."):
+                d = v - (base or {}).get(k, 0)
+                if d:
+                    out[k] = int(d)
+        return out
+    headline_tiers = _tier_counters()
+    accel_fired = any(
+        k.startswith("nomad.solver.backend.") and
+        k.split(".")[-1] in ("pallas", "sharded", "xla")
+        for k in headline_tiers)
+    if platform == "tpu":
+        # on the real chip the 50k deterministic solve MUST ride an
+        # accelerator tier (pallas for dense-K depth; xla for chunked)
+        assert accel_fired, f"no accelerator tier fired: {headline_tiers}"
+
     # host-oracle comparison (same end-to-end path, binpack stack).
     # The host path is linear in placements; timing it at 5k tasks keeps the
     # bench runnable every round — the 50k extrapolation is reported as such.
@@ -280,6 +365,7 @@ def main() -> None:
     fsm_s = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=11)
     planner_s = Planner(RaftLog(fsm_s), fsm_s.state)
     submit_times = []
+    stream_base = dict(metrics.snapshot()["counters"])
     t_stream0 = time.perf_counter()
     for j in range(k_stream):
         job_s = _mk_batch_job(f"stream-{j}", 1_000)
@@ -290,6 +376,12 @@ def main() -> None:
     stream_s = time.perf_counter() - t_stream0
     submit_times.sort()
     p50_submit = submit_times[len(submit_times) // 2]
+    stream_tiers = _tier_counters(stream_base)
+    if platform == "tpu":
+        # 1k-task evals are latency-bound: the selector must route them
+        # host-side, not across the dispatch round-trip
+        assert stream_tiers.get("nomad.solver.backend.host"), \
+            f"stream evals did not ride the host tier: {stream_tiers}"
 
     # plan-rejection parity under optimistic concurrency: same-seed
     # apples-to-apples sims (VERDICT r2 weak #7: one fixed seed is not
@@ -298,6 +390,25 @@ def main() -> None:
     rej_tpu2, _ = _concurrent_rejection_rate(SCHED_ALG_TPU, seed=1)
     rej_host, rej_host_alloc = _concurrent_rejection_rate("binpack")
 
+    # warm-restart probe (VERDICT r4 #3): a CHILD process re-runs the
+    # full warmup against the compile cache this process just populated
+    # — the placement blackout a real scheduler restart would pay
+    import subprocess
+    warm_compile_s = -1.0
+    warm_extra = {}
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--warm-probe"],
+            env=dict(os.environ, NOMAD_COMPILE_CACHE=cache_dir),
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                warm_extra = json.loads(line)
+                warm_compile_s = warm_extra.get("warm_compile_s", -1.0)
+    except Exception:                   # noqa: BLE001 — probe is optional
+        pass
+
     print(json.dumps({
         "metric": f"end-to-end {N_TASKS//1000}k-task batch eval->plan-applied"
                   f" on {N_NODES//1000}k-node sim ({platform})",
@@ -305,6 +416,8 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(TARGET_S / value, 2),
         "compile_s": round(compile_s, 3),
+        "compile_s_warm_restart": warm_compile_s,
+        "warm_restart_detail": warm_extra,
         "placed": N_TASKS,
         "plan_nodes_rejected": rejected,
         "plan_nodes_total": total_nodes,
@@ -324,6 +437,8 @@ def main() -> None:
         "solver_kernel": kernel,
         "solver_batched_fraction": round(batched / total_pl, 4)
         if total_pl else 1.0,
+        "backend_tiers_headline": headline_tiers,
+        "backend_tiers_stream": stream_tiers,
     }))
 
 
@@ -632,5 +747,7 @@ if __name__ == "__main__":
                 print(json.dumps(fn()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--kernel":
         print(json.dumps(kernel_only()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
+        warm_probe()
     else:
         main()   # driver contract: exactly one JSON line

@@ -136,6 +136,13 @@ class Agent:
             self.logger("agent: native sidecars unavailable (no toolchain?);"
                         " using pure-Python fallbacks")
         if self.server is not None:
+            # persistent XLA compile cache: a restarted server replays
+            # serialized solver executables instead of paying the ~14s
+            # cold compile as placement blackout (VERDICT r4 #3)
+            from ..runtime import enable_compile_cache
+            enable_compile_cache(
+                os.path.join(self.config.data_dir, "xla_cache")
+                if self.config.data_dir else "")
             if self.config.rpc_port >= 0 and self.config.acl_enabled and \
                     not self.config.encrypt_key:
                 # the RPC surface trusts the HMAC key as its auth boundary

@@ -166,12 +166,20 @@ class AllocRunner:
         for svc, task_name in (
                 [(s, "") for s in tg.services]
                 + [(s, t.name) for t in tg.tasks for s in t.services]):
+            checks = [dict(c) for c in svc.checks]
+            for c in checks:
+                # an exposed check targets its own proxy listener port
+                # (connect._expose_admission rewrote its port_label);
+                # resolve it here where the allocation's ports are known
+                lbl = c.get("port_label") or c.get("PortLabel") or ""
+                if lbl:
+                    c["port"] = port_for(lbl, task_name)
             out.append((ServiceInstance(
                 service_name=svc.name, namespace=alloc.namespace,
                 job_id=alloc.job_id, alloc_id=alloc.id,
                 node_id=alloc.node_id, task=task_name, address=address,
                 port=port_for(svc.port_label, task_name),
-                tags=tuple(svc.tags)), list(svc.checks)))
+                tags=tuple(svc.tags)), checks))
         return out
 
     def _register_services(self) -> None:

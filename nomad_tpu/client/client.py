@@ -37,14 +37,18 @@ class Client:
         self.state_db = StateDB(os.path.join(data_dir, "client_state.db"))
         self.drivers: dict[str, Driver] = drivers if drivers is not None \
             else {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
-        # external plugin drivers (ref client config plugin_dir +
-        # go-plugin Discover): subprocess drivers join the same registry
+        # external plugins (ref client config plugin_dir + go-plugin
+        # Discover): subprocess drivers join the same registry; CSI
+        # plugins register with the csimanager below once it exists
         if plugin_dir:
-            from .plugin_host import discover_plugins
-            self.plugin_drivers = discover_plugins(plugin_dir, self.logger)
+            from .plugin_host import discover_all
+            found = discover_all(plugin_dir, self.logger)
+            self.plugin_drivers = found["driver"]
+            self._plugin_csi = found["csi"]
             self.drivers.update(self.plugin_drivers)
         else:
             self.plugin_drivers = {}
+            self._plugin_csi = {}
         for d in self.drivers.values():
             # catalog access (connect proxy); ext drivers are duck-typed
             bind = getattr(d, "bind_client", None)
@@ -53,6 +57,12 @@ class Client:
 
         from .csimanager import CSIManager
         self.csi_manager = CSIManager(self)
+        for plug_id, plug in self._plugin_csi.items():
+            # discovered subprocess CSI plugins (ref plugins/csi/client.go:
+            # external processes behind the node/controller contract); the
+            # node fingerprint picks them up below
+            self.csi_manager.register_plugin(
+                plug_id, plug, controller=plug.requires_controller)
         from .devicemanager import DeviceManager
         self.device_manager = DeviceManager(self)
         # shared bridge-network hook: one IP allocator + one nomad bridge
@@ -68,6 +78,10 @@ class Client:
         for dname, info in self.node.drivers.items():
             if info.detected:
                 self.node.attributes[f"driver.{dname}"] = "1"
+        if self._plugin_csi:
+            self.node.csi_node_plugins = self.csi_manager.fingerprint()
+            self.node.csi_controller_plugins = \
+                self.csi_manager.fingerprint_controllers()
         self.node.status = NODE_STATUS_INIT
         self.node.compute_class()
 
@@ -135,6 +149,8 @@ class Client:
                     pass
         for drv in self.plugin_drivers.values():
             drv.shutdown()
+        for plug in self._plugin_csi.values():
+            plug.shutdown()
 
     # ---------------------------------------------------------- registration
 

@@ -514,6 +514,25 @@ class ConnectProxyDriver(Driver):
                 self._resolver(ns, cfg.get("service", ""),
                                up["destination"]), logger,
                 name=f"connect-up-{up['destination']}-{task_id[:8]}"))
+        # expose-path listeners (ref job_endpoint_hook_expose_check.go +
+        # envoy expose paths): health-check paths served on their own
+        # ports through the sidecar, everything else 403'd
+        from ..integrations.connect import ExposeForwarder
+        for ex in cfg.get("expose", []) or []:
+            ex_label = _env_key(ex.get("listener_port_label", ""))
+            ex_port = int(env.get(f"NOMAD_PORT_{ex_label}", 0) or 0)
+            # the reference allows a check's local path port to differ
+            # from the service port (expose.path local_path_port); honor
+            # the entry's own label and fall back to the service port
+            lp_label = _env_key(ex.get("local_path_port_label", ""))
+            lp_port = int(env.get(f"NOMAD_PORT_{lp_label}", 0) or 0) \
+                or svc_port
+            if ex_port and lp_port:
+                forwarders.append(ExposeForwarder(
+                    ("0.0.0.0", ex_port),
+                    lambda lp=lp_port: ("127.0.0.1", lp), logger,
+                    name=f"connect-expose-{task_id[:8]}",
+                    path=ex.get("path", "/")))
         for f in forwarders:
             f.start()
         rec = {"forwarders": forwarders, "stopped": threading.Event(),

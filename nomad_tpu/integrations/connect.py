@@ -31,6 +31,39 @@ def _sanitize(name: str) -> str:
     return name.replace("-", "_").upper()
 
 
+def _expose_admission(svc, net) -> list[dict]:
+    """Expose-check mutator (ref nomad/job_endpoint_hook_expose_check.go:21
+    jobExposeCheckHook): an http/grpc check with ``expose = true`` on a
+    connect service gets its own dynamic listener port on the sidecar —
+    the proxy serves ONLY that check's path there — and the check is
+    rewritten to probe through the proxy listener instead of the (mesh-
+    private) service port. Returns the proxy task's expose listener
+    config. Idempotent: an already-rewritten check is left alone."""
+    out: list[dict] = []
+    local_label = svc.port_label        # the service's REAL port, pre-
+    for i, chk in enumerate(svc.checks):    # ingress rewrite
+        if not (chk.get("expose") or chk.get("Expose")):
+            continue
+        ctype = (chk.get("type") or chk.get("Type") or "").lower()
+        if ctype not in ("http", "grpc"):
+            continue                    # ref: only http/grpc are exposable
+        existing_label = chk.get("port_label") or chk.get("PortLabel") \
+            or ""
+        if existing_label.startswith("svc_expose_check_"):
+            label = existing_label      # re-registration of expanded job
+        else:
+            label = f"svc_expose_check_{svc.name}_{i}"
+            # both shapes: HCL-parsed checks are PascalCase, API/test
+            # dicts snake_case
+            chk["port_label"] = chk["PortLabel"] = label
+        if not any(p.label == label for p in net.dynamic_ports):
+            net.dynamic_ports.append(Port(label=label))
+        out.append({"path": chk.get("path") or chk.get("Path") or "/",
+                    "listener_port_label": label,
+                    "local_path_port_label": local_label})
+    return out
+
+
 def connect_admission(job) -> None:
     """Admission mutator (ref job_endpoint_hooks.go:1): expand
     sidecar_service stanzas into proxy tasks + ports + upstream env.
@@ -59,6 +92,7 @@ def connect_admission(job) -> None:
                 ] = f"127.0.0.1:{up['LocalBindPort']}"
             if proxy_task in existing:
                 continue            # already expanded (job re-register)
+            expose = _expose_admission(svc, net)
             if not any(p.label == port_label for p in net.dynamic_ports):
                 net.dynamic_ports.append(Port(label=port_label))
             tg.tasks.append(Task(
@@ -74,6 +108,7 @@ def connect_admission(job) -> None:
                         {"destination": up["DestinationName"],
                          "local_bind_port": int(up["LocalBindPort"])}
                         for up in upstreams],
+                    "expose": expose,
                 },
                 resources=Resources(cpu=50, memory_mb=32),
             ))
@@ -151,12 +186,15 @@ class _Forwarder(threading.Thread):
         except OSError:
             pass
 
-    def _splice(self, conn: socket.socket, target: tuple) -> None:
+    def _splice(self, conn: socket.socket, target: tuple,
+                preamble: bytes = b"") -> None:
         try:
             out = socket.create_connection(target, timeout=5.0)
             # the connect timeout must not become a 5s idle-read timeout
             # on the spliced stream
             out.settimeout(None)
+            if preamble:
+                out.sendall(preamble)   # bytes a screening subclass read
         except OSError as e:
             self.logger(f"connect-proxy: dial {target} failed: {e!r}")
             conn.close()
@@ -194,3 +232,97 @@ class _Forwarder(threading.Thread):
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class ExposeForwarder(_Forwarder):
+    """Expose-path listener (ref envoy's exposed path listeners, driven
+    by job_endpoint_hook_expose_check.go): serves ONLY the configured
+    HTTP path (exact, subpath, or query) and answers 403 to anything
+    else — external health checkers get the check endpoint through the
+    sidecar without the rest of the service leaking around the mesh."""
+
+    def __init__(self, bind: tuple, resolve, logger, name: str,
+                 path: str):
+        super().__init__(bind, resolve, logger, name)
+        self.path = path or "/"
+
+    def _path_allowed(self, req_path: str) -> bool:
+        base = self.path.rstrip("/") or "/"
+        return (req_path == self.path or req_path == base
+                or req_path.startswith(base + "/")
+                or req_path.startswith(base + "?"))
+
+    def _splice(self, conn: socket.socket, target: tuple,
+                preamble: bytes = b"") -> None:
+        # One screened request per connection: the FULL first request
+        # (headers + declared body) is read, stamped `connection: close`,
+        # and forwarded alone; the client half is never spliced raw, so
+        # keep-alive or pipelined follow-ups can never ride a screened
+        # connection past the path filter.
+        try:
+            conn.settimeout(3.0)
+            buf = b""
+            while b"\r\n\r\n" not in buf and len(buf) < 65536:
+                chunk = conn.recv(8192)
+                if not chunk:
+                    break
+                buf += chunk
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+            parts = line.split()
+            req_path = parts[1] if len(parts) >= 2 else ""
+            if not self._path_allowed(req_path):
+                conn.sendall(b"HTTP/1.1 403 Forbidden\r\n"
+                             b"content-length: 0\r\n"
+                             b"connection: close\r\n\r\n")
+                conn.close()
+                return
+            clen = 0
+            keep: list[bytes] = []
+            for h in head.split(b"\r\n")[1:]:
+                name = h.split(b":", 1)[0].strip().lower()
+                if name == b"content-length":
+                    try:
+                        clen = int(h.split(b":", 1)[1])
+                    except ValueError:
+                        clen = 0
+                if name != b"connection":
+                    keep.append(h)
+            body = rest[:clen]
+            while len(body) < clen:
+                chunk = conn.recv(min(65536, clen - len(body)))
+                if not chunk:
+                    break
+                body += chunk
+            request = (head.split(b"\r\n", 1)[0] + b"\r\n"
+                       + b"\r\n".join(keep)
+                       + (b"\r\n" if keep else b"")
+                       + b"connection: close\r\n\r\n" + body)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        try:
+            out = socket.create_connection(target, timeout=5.0)
+            out.settimeout(None)
+            out.sendall(request)
+            out.shutdown(socket.SHUT_WR)
+        except OSError as e:
+            self.logger(f"connect-expose: dial {target} failed: {e!r}")
+            conn.close()
+            return
+        try:
+            while True:                 # response only: backend -> client
+                data = out.recv(65536)
+                if not data:
+                    break
+                conn.sendall(data)
+        except OSError:
+            pass
+        for s in (conn, out):
+            try:
+                s.close()
+            except OSError:
+                pass

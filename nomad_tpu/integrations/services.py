@@ -89,11 +89,22 @@ class ServiceInstance:
         return dataclasses.replace(self)
 
 
+def _ck(check: dict, key: str, default=""):
+    """Check dicts arrive in snake_case (API/tests) or PascalCase (the
+    HCL parser emits the reference's wire shape); read both."""
+    v = check.get(key)
+    if v is None:
+        v = check.get(key[:1].upper() + key[1:])
+    return default if v in (None, "") else v
+
+
 def check_service(check: dict, address: str, port: int,
                   timeout: float = 3.0) -> bool:
     """Execute one health check definition (ref command/agent/consul
-    check types: http/tcp)."""
-    ctype = check.get("type", "tcp")
+    check types: http/tcp). A check carrying its own resolved ``port``
+    (expose listeners) probes that instead of the instance port."""
+    port = int(_ck(check, "port", 0) or port)
+    ctype = str(_ck(check, "type", "tcp")).lower()
     if ctype == "tcp":
         try:
             with socket.create_connection((address, port), timeout=timeout):
@@ -101,10 +112,10 @@ def check_service(check: dict, address: str, port: int,
         except OSError:
             return False
     if ctype == "http":
-        path = check.get("path", "/")
+        path = _ck(check, "path", "/")
         try:
             conn = http.client.HTTPConnection(address, port, timeout=timeout)
-            conn.request(check.get("method", "GET"), path)
+            conn.request(_ck(check, "method", "GET"), path)
             resp = conn.getresponse()
             resp.read()
             conn.close()
@@ -116,7 +127,7 @@ def check_service(check: dict, address: str, port: int,
         import subprocess
         try:
             return subprocess.run(
-                shlex.split(check.get("command", "/bin/true")),
+                shlex.split(_ck(check, "command", "/bin/true")),
                 timeout=timeout, capture_output=True).returncode == 0
         except (OSError, ValueError, subprocess.TimeoutExpired):
             return False

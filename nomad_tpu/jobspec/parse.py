@@ -246,6 +246,9 @@ def _parse_service(sec: _Section, blk: Block) -> Service:
             "Method": c.get("method", ""),
             "InitialStatus": c.get("initial_status", ""),
             "AddressMode": c.get("address_mode", ""),
+            # ref job_endpoint_hook_expose_check.go: route this check
+            # through a dedicated sidecar expose listener
+            "Expose": bool(c.get("expose", False)),
         })
     connect = None
     cblk = s.block("connect")

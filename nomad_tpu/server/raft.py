@@ -485,6 +485,29 @@ class RaftNode:
         with self._lock:
             return self.last_applied
 
+    def wait_barrier(self, timeout: float = 30.0) -> int:
+        """Block until every entry in the log as of THIS call is applied
+        to the FSM (ref hashicorp/raft Barrier, used by leader.go:224
+        establishLeadership). A new leader's log already ends with its
+        election no-op (§8), so waiting for the current last index
+        guarantees all entries committed under previous terms are
+        visible in state before the leader restores broker/watcher
+        bookkeeping from it — without this, a freshly-elected leader can
+        re-enqueue an eval whose plan it has not applied yet and place
+        DUPLICATE allocations (caught by the multi-process e2e tier)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            target = self._last_index()
+            while self.last_applied < target and not self._stop.is_set():
+                if self.state != LEADER:
+                    raise NotLeaderError(self.leader_addr)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"leadership barrier timed out at {target}")
+                self._apply_cond.wait(min(remaining, 0.5))
+            return self.last_applied
+
     def snapshot(self) -> bytes:
         return self.fsm.snapshot_bytes()
 

@@ -527,6 +527,24 @@ class Server:
         """ref nomad/leader.go:224"""
         if self.is_leader:
             return
+        # Barrier FIRST (ref leader.go:236 raft.Barrier): the restore
+        # below reads the FSM, which must reflect every entry committed
+        # under previous terms — otherwise a just-elected leader can
+        # re-enqueue an already-planned eval and double-place it. A slow
+        # apply (big replay) RETRIES rather than returning: bailing out
+        # would leave a live raft leader with every leader subsystem
+        # permanently disabled. Only losing leadership ends the wait.
+        wait_barrier = getattr(self.raft, "wait_barrier", None)
+        while wait_barrier is not None:
+            try:
+                wait_barrier(timeout=30.0)
+                break
+            except TimeoutError as e:
+                self.logger(f"server: leadership barrier slow, "
+                            f"retrying: {e!r}")
+            except Exception as e:      # noqa: BLE001 — lost lead mid-wait
+                self.logger(f"server: leadership barrier failed: {e!r}")
+                return
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.planner.start()

@@ -1041,28 +1041,46 @@ class StateStore:
             by_eval = self._allocs_by_eval
             usage = self.usage
             sinks = self.event_sinks
+            # index-map membership is accumulated per key and bulk-merged
+            # after the loop (set.update beats 50k .add calls), and the
+            # usage matrix takes the whole batch at once — together the
+            # largest slice of the 50k-plan commit (VERDICT r4 #5)
+            fresh: list = []
+            node_acc: dict[str, list] = {}
+            job_acc: dict[tuple, list] = {}
+            eval_acc: dict[str, list] = {}
             for alloc in result.alloc_placements:   # new placements
                 if alloc.create_time_unix == 0.0:
                     alloc.create_time_unix = now
                 alloc.modify_time_unix = alloc.create_time_unix
-                if alloc.id not in allocs_map and \
+                aid = alloc.id
+                if aid not in allocs_map and \
                         alloc.client_status == ALLOC_CLIENT_PENDING:
                     key = (alloc.namespace, alloc.job_id, alloc.task_group)
                     fresh_counts[key] = fresh_counts.get(key, 0) + 1
                     alloc.create_index = idx
                     alloc.modify_index = idx
-                    allocs_map[alloc.id] = alloc
-                    by_node.setdefault(alloc.node_id, set()).add(alloc.id)
-                    by_job.setdefault(
-                        (alloc.namespace, alloc.job_id), set()).add(alloc.id)
-                    by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
-                    usage.set_alloc(alloc)
+                    allocs_map[aid] = alloc
+                    node_acc.setdefault(alloc.node_id, []).append(aid)
+                    job_acc.setdefault(
+                        (alloc.namespace, alloc.job_id), []).append(aid)
+                    eval_acc.setdefault(alloc.eval_id, []).append(aid)
+                    fresh.append(alloc)
                     if sinks:
                         self._emit("Allocation", "AllocationUpdated", idx,
                                    alloc)
                 else:
                     self._upsert_alloc_locked(idx, alloc, fresh=True,
                                               summary_cache=summary_cache)
+            for acc, index_map in ((node_acc, by_node), (job_acc, by_job),
+                                   (eval_acc, by_eval)):
+                for k, ids in acc.items():
+                    members = index_map.get(k)
+                    if members is None:
+                        index_map[k] = set(ids)
+                    else:
+                        members.update(ids)
+            usage.add_fresh_batch(fresh)
             for (ns, job_id, tg_name), cnt in fresh_counts.items():
                 jkey = (ns, job_id)
                 summ = summary_cache.get(jkey)

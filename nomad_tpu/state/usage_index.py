@@ -200,6 +200,33 @@ class UsageIndex:
         if seq:
             self.seq_rows[r] = self.seq_rows.get(r, 0) + 1
 
+    def add_fresh_batch(self, allocs) -> None:
+        """set_alloc for a batch of FRESH placements: no prior
+        contribution to retire, known non-terminal (the store's fast
+        path checked client_status). A 50k-alloc plan shares a handful
+        of resources objects, so u/seq resolve through their on-object
+        caches; the loop body is just dict stores (VERDICT r4 #5 —
+        this was the largest host phase)."""
+        row = self.row
+        pend = self._pending
+        contrib = self._contrib
+        seq_rows = self.seq_rows
+        for alloc in allocs:
+            res = alloc.allocated_resources
+            u = getattr(res, "_xr_usage", None)
+            if u is None:
+                u = _resources_usage_tuple(res)
+            seq = getattr(res, "_xr_seq", None)
+            if seq is None:
+                seq = resources_sequential(res)
+            r = row.get(alloc.node_id)
+            if r is None:
+                continue            # alloc on an unknown/removed node
+            pend.append((r, u))
+            contrib[alloc.id] = (r, u, seq)
+            if seq:
+                seq_rows[r] = seq_rows.get(r, 0) + 1
+
     def drop_alloc(self, alloc_id: str) -> None:
         old = self._contrib.pop(alloc_id, None)
         if old is not None:

@@ -1,0 +1,354 @@
+"""Multi-PROCESS e2e harness: real ``nomad_tpu agent`` OS processes over
+TCP RPC + gossip, driven through the HTTP API (ref testutil/server.go:126
+TestServer, which execs the nomad binary; e2e/framework/framework.go).
+
+Everything the in-process tier can't exercise lives here: interpreter
+death (kill -9) and restart, cross-process gossip/raft, client state-db
+recovery from disk, executor reattach to orphaned task processes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_ports(n: int) -> list[int]:
+    """n distinct ephemeral ports. The close()->reuse window is racy in
+    principle; agents that lose the race fail to bind loudly and the
+    test retries at the cluster level."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_until(fn, timeout: float = 20.0, interval: float = 0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = fn()
+            if last:
+                return last
+        except Exception as e:       # noqa: BLE001 — polling probe
+            last = e
+        time.sleep(interval)
+    return False
+
+
+class AgentProc:
+    """One real agent OS process + its HTTP driving surface."""
+
+    def __init__(self, name: str, argv: list[str], log_path: str,
+                 http_port: int, env: dict | None = None):
+        self.name = name
+        self.argv = argv
+        self.log_path = log_path
+        self.http_port = http_port
+        self._env = dict(os.environ,
+                         PYTHONPATH=REPO,
+                         JAX_PLATFORMS="cpu",     # never grab the TPU chip
+                         **(env or {}))
+        self.proc: subprocess.Popen | None = None
+
+    def start(self) -> "AgentProc":
+        log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            self.argv, cwd=os.path.dirname(self.log_path), env=self._env,
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)     # own pgid: kill -9 hits agent only
+        return self
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid if self.proc else -1
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill9(self) -> None:
+        """SIGKILL — no shutdown handlers run, like a kernel OOM kill."""
+        if self.alive():
+            os.kill(self.proc.pid, signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def terminate(self) -> None:
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.kill9()
+
+    def restart(self) -> "AgentProc":
+        """Same argv + data_dir: the disk-state recovery path."""
+        self.terminate()
+        return self.start()
+
+    # ------------------------------------------------------- HTTP driving
+
+    def url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.http_port}{path}"
+
+    def get(self, path: str, timeout: float = 5.0):
+        with urllib.request.urlopen(self.url(path), timeout=timeout) as r:
+            return json.load(r)
+
+    def send(self, path: str, body: dict, method: str = "PUT",
+             timeout: float = 10.0):
+        req = urllib.request.Request(
+            self.url(path), data=json.dumps(body).encode(), method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            raw = r.read()
+            return json.loads(raw) if raw else None
+
+    def wait_http(self, timeout: float = 30.0) -> bool:
+        # /v1/agent/health is the one route every agent flavor serves
+        return bool(wait_until(
+            lambda: self.get("/v1/agent/health") is not None, timeout))
+
+    def tail(self, nbytes: int = 4000) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - nbytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+
+class Cluster:
+    """N server + M client agent processes on localhost.
+
+    Servers speak raft over the network RPC transport and discover each
+    other via gossip (-join); clients are client-only agents pointed at
+    every server RPC address, so leader failover is exercised on the
+    client path too.
+    """
+
+    ENCRYPT_KEY = "e2e-harness-shared-key"
+
+    def __init__(self, base_dir: str, n_servers: int = 3,
+                 n_clients: int = 2):
+        self.base = base_dir
+        self.servers: list[AgentProc] = []
+        self.clients: list[AgentProc] = []
+        n = n_servers
+        ports = free_ports(3 * n + n_clients)
+        self._http = ports[:n]
+        self._rpc = ports[n:2 * n]
+        self._gossip = ports[2 * n:3 * n]
+        self._client_http = ports[3 * n:]
+        self.n_servers = n
+        self.n_clients = n_clients
+
+    # ----------------------------------------------------------- topology
+
+    def _agent_argv(self, cfg_path: str, http_port: int,
+                    extra: list[str]) -> list[str]:
+        return [sys.executable, "-m", "nomad_tpu.cli", "agent",
+                "-config", cfg_path, "-port", str(http_port)] + extra
+
+    def start_server(self, i: int) -> AgentProc:
+        d = os.path.join(self.base, f"server{i}")
+        os.makedirs(d, exist_ok=True)
+        cfg = {
+            "data_dir": d,
+            "name": f"e2e-server{i}",   # raft node ids must be distinct
+            "server": {"enabled": True, "bootstrap_expect": self.n_servers,
+                       "encrypt": self.ENCRYPT_KEY},
+            "client": {"enabled": False},
+            "ports": {"rpc": self._rpc[i], "serf": self._gossip[i]},
+        }
+        cfg_path = os.path.join(d, "agent.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        join = [f"-join=127.0.0.1:{self._gossip[j]}"
+                for j in range(self.n_servers) if j != i]
+        p = AgentProc(f"server{i}",
+                      self._agent_argv(cfg_path, self._http[i], join),
+                      os.path.join(d, "agent.log"), self._http[i])
+        p.start()
+        self.servers.append(p)
+        return p
+
+    def start_client(self, i: int, node_name: str = "") -> AgentProc:
+        d = os.path.join(self.base, f"client{i}")
+        os.makedirs(d, exist_ok=True)
+        cfg = {
+            "data_dir": d,
+            "name": node_name or f"e2e-client{i}",
+            # encrypt rides the server stanza in the config schema; a
+            # client-only agent still needs it to speak the HMAC'd RPC
+            "server": {"enabled": False, "encrypt": self.ENCRYPT_KEY},
+            "client": {"enabled": True,
+                       "servers": [f"127.0.0.1:{p}" for p in self._rpc]},
+        }
+        cfg_path = os.path.join(d, "agent.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        p = AgentProc(f"client{i}",
+                      self._agent_argv(cfg_path, self._client_http[i], []),
+                      os.path.join(d, "agent.log"), self._client_http[i])
+        p.start()
+        self.clients.append(p)
+        return p
+
+    def start(self) -> "Cluster":
+        for i in range(self.n_servers):
+            self.start_server(i)
+        for p in self.servers:
+            assert p.wait_http(30), f"{p.name} never served HTTP:\n{p.tail()}"
+        assert self.wait_leader(), "no leader elected:\n" + \
+            "\n".join(p.tail(1500) for p in self.servers)
+        for i in range(self.n_clients):
+            self.start_client(i)
+        for p in self.clients:
+            assert p.wait_http(30), f"{p.name} never served HTTP:\n{p.tail()}"
+        assert wait_until(self.nodes_ready, 30), \
+            f"clients never registered: {self.leader().get('/v1/nodes')}"
+        return self
+
+    # ------------------------------------------------------------- leader
+
+    def live_servers(self) -> list[AgentProc]:
+        return [p for p in self.servers if p.alive()]
+
+    def leader(self) -> AgentProc:
+        """The server whose raft claims leadership (via /v1/status/leader
+        on each live server's own HTTP — a follower answers '')."""
+        for p in self.live_servers():
+            try:
+                if p.get("/v1/status/leader"):
+                    return p
+            except Exception:       # noqa: BLE001 — candidate probing
+                continue
+        raise RuntimeError("no live leader")
+
+    def wait_leader(self, timeout: float = 30.0) -> AgentProc | bool:
+        return wait_until(lambda: self.leader(), timeout)
+
+    def followers(self) -> list[AgentProc]:
+        lead = self.leader()
+        return [p for p in self.live_servers() if p is not lead]
+
+    def nodes_ready(self) -> bool:
+        nodes = self.leader().get("/v1/nodes")
+        return (len(nodes) >= self.n_clients
+                and all(n["Status"] == "ready" for n in nodes))
+
+    # ----------------------------------------------------------- workload
+
+    def send_leader(self, path: str, body: dict,
+                    timeout: float = 30.0) -> dict:
+        """Write through the current leader, retrying across elections:
+        mid-failover there may be no leader for a few seconds, and a
+        just-elected leader can briefly refuse writes while its broker
+        restores (the reference's clients retry exactly like this on
+        ErrNoLeader)."""
+        deadline = time.time() + timeout
+        last: Exception | None = None
+        while time.time() < deadline:
+            try:
+                return self.leader().send(path, body)
+            except Exception as e:      # noqa: BLE001 — retry until quiet
+                last = e
+                time.sleep(0.5)
+        raise RuntimeError(f"write {path} failed for {timeout}s: {last}")
+
+    def run_job(self, job: dict) -> dict:
+        return self.send_leader("/v1/jobs", {"Job": job})
+
+    def allocs(self, job_id: str) -> list[dict]:
+        return self.leader().get(f"/v1/job/{job_id}/allocations")
+
+    def running_allocs(self, job_id: str) -> list[dict]:
+        return [a for a in self.allocs(job_id)
+                if a.get("ClientStatus") == "running"
+                and a.get("DesiredStatus") == "run"]
+
+    def wait_running(self, job_id: str, count: int,
+                     timeout: float = 40.0) -> bool:
+        return bool(wait_until(
+            lambda: len(self.running_allocs(job_id)) == count, timeout))
+
+    def find_task_pids(self, under: str, needle: str = "sleep") -> list[int]:
+        """PIDs of live task processes whose cwd sits under `under` (an
+        agent data dir) and whose cmdline contains `needle`."""
+        out = []
+        base = os.path.realpath(under)
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit():
+                continue
+            try:
+                cwd = os.path.realpath(f"/proc/{pid_s}/cwd")
+                with open(f"/proc/{pid_s}/cmdline", "rb") as f:
+                    cmd = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            if cwd.startswith(base) and needle in cmd:
+                out.append(int(pid_s))
+        return sorted(out)
+
+    # ----------------------------------------------------------- teardown
+
+    def shutdown(self) -> None:
+        for p in self.clients + self.servers:
+            try:
+                p.terminate()
+            except Exception:       # noqa: BLE001 — teardown best-effort
+                pass
+        self._reap_orphan_tasks()
+
+    def _reap_orphan_tasks(self) -> None:
+        """SIGKILL any leftover task process whose cwd lives under our
+        data dirs (raw_exec tasks are session leaders on purpose — agent
+        death must not kill them — so teardown sweeps by task-dir cwd)."""
+        base = os.path.realpath(self.base)
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit():
+                continue
+            try:
+                cwd = os.path.realpath(f"/proc/{pid_s}/cwd")
+            except OSError:
+                continue
+            if cwd.startswith(base):
+                try:
+                    os.kill(int(pid_s), signal.SIGKILL)
+                except OSError:
+                    pass
+
+
+def sleep_job(job_id: str, count: int = 2, seconds: int = 600) -> dict:
+    """A raw_exec job running real /bin/sleep processes (session leaders
+    — they survive client death, which is what reattach tests need)."""
+    return {
+        "ID": job_id, "Name": job_id, "Type": "service",
+        "Datacenters": ["dc1"],
+        "TaskGroups": [{
+            "Name": "g", "Count": count,
+            "Tasks": [{
+                "Name": "t", "Driver": "raw_exec",
+                "Config": {"command": "/bin/sleep",
+                           "args": [str(seconds)]},
+                "Resources": {"CPU": 50, "MemoryMB": 32},
+            }],
+        }],
+    }

@@ -317,3 +317,126 @@ def test_intentions_http_api():
         assert a.server.intention_allowed("default", "a", "b")
     finally:
         a.shutdown()
+
+
+# ------------------------------------------------- expose admission hook
+
+def test_expose_admission_rewrites_check_and_proxy(tmp_path):
+    """ref nomad/job_endpoint_hook_expose_check.go:21: an http check with
+    expose=true gets its own dynamic listener port, the proxy task gets
+    the expose config, and the check is rewritten to the listener."""
+    job = _connect_job("exp", "exp-svc")
+    job.task_groups[0].services[0].checks = [
+        {"type": "http", "path": "/health", "expose": True,
+         "interval": 1.0},
+        {"type": "tcp"},                        # not exposable: untouched
+    ]
+    connect_admission(job)
+    tg = job.task_groups[0]
+    chk = tg.services[0].checks[0]
+    assert chk["port_label"] == "svc_expose_check_exp-svc_0"
+    labels = [p.label for p in tg.networks[0].dynamic_ports]
+    assert "svc_expose_check_exp-svc_0" in labels
+    proxy = tg.lookup_task(PROXY_PREFIX + "exp-svc")
+    assert proxy.config["expose"] == [
+        {"path": "/health",
+         "listener_port_label": "svc_expose_check_exp-svc_0",
+         "local_path_port_label": "http"}]
+    assert "port_label" not in tg.services[0].checks[1]
+    # idempotent on re-admission (job re-register)
+    connect_admission(job)
+    assert [p.label for p in tg.networks[0].dynamic_ports].count(
+        "svc_expose_check_exp-svc_0") == 1
+
+
+def test_exposed_check_serves_through_sidecar(tmp_path):
+    """VERDICT r4 #6 done-when: a job with an exposed HTTP check passes
+    its check THROUGH the sidecar in the dev agent — and the expose
+    listener serves ONLY the check path (403 elsewhere)."""
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    try:
+        assert wait_until(
+            lambda: a.server.state.node_by_id(a.client.node.id) is not None
+            and a.server.state.node_by_id(a.client.node.id).ready())
+        job = _connect_job("expjob", "exp-svc")
+        job.task_groups[0].services[0].checks = [
+            {"type": "http", "path": "/health.txt", "expose": True,
+             "interval": 0.5}]
+        job.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "cd local && echo ok > health.txt && "
+                     "echo top-secret > secret.txt && "
+                     "exec python3 -m http.server $NOMAD_PORT_http "
+                     "--bind 127.0.0.1"]}
+        a.server.job_register(job)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "expjob")))
+        alloc = [al for al in a.server.state.allocs_by_job(
+            "default", "expjob") if al.client_status == "running"][0]
+        # the expose listener's allocated port
+        expose_port = 0
+        tr = alloc.allocated_resources.tasks
+        for t in tr.values():
+            for n in t.networks:
+                for p in n.dynamic_ports:
+                    if p.label.startswith("svc_expose_check_"):
+                        expose_port = p.value
+        for n in alloc.allocated_resources.shared.networks or []:
+            for p in n.dynamic_ports:
+                if p.label.startswith("svc_expose_check_"):
+                    expose_port = p.value
+        assert expose_port, "no expose port allocated"
+        import http.client as hc
+
+        def fetch(path):
+            conn = hc.HTTPConnection("127.0.0.1", expose_port, timeout=3)
+            conn.request("GET", path)
+            r = conn.getresponse()
+            body = r.read()
+            conn.close()
+            return r.status, body
+
+        def check_path_up():
+            try:
+                return fetch("/health.txt")[0] == 200
+            except OSError:
+                return False
+        assert wait_until(check_path_up, timeout=20), \
+            "exposed check path not reachable through the sidecar"
+        status, body = fetch("/health.txt")
+        assert status == 200 and b"ok" in body
+        # only the exposed path is served
+        status, _ = fetch("/secret.txt")
+        assert status == 403
+        # keep-alive/pipelining cannot smuggle a second request past the
+        # path filter: the listener forwards exactly ONE screened request
+        # per connection (connection: close), so a pipelined follow-up
+        # for the secret never reaches the service
+        import socket as sk
+        raw = sk.create_connection(("127.0.0.1", expose_port), timeout=3)
+        raw.sendall(b"GET /health.txt HTTP/1.1\r\nhost: x\r\n\r\n"
+                    b"GET /secret.txt HTTP/1.1\r\nhost: x\r\n\r\n")
+        got = b""
+        raw.settimeout(3)
+        try:
+            while True:
+                chunk = raw.recv(65536)
+                if not chunk:
+                    break
+                got += chunk
+        except OSError:
+            pass
+        raw.close()
+        assert b"top-secret" not in got, "pipelined bypass leaked"
+        assert got.count(b"HTTP/1.") == 1, "second response served"
+        # and the CHECK actually passes through the listener: the service
+        # stays passing in the catalog
+        assert wait_until(lambda: any(
+            i.status == "passing"
+            for i in a.server.service_instances("default", "exp-svc")),
+            timeout=20)
+    finally:
+        a.shutdown()

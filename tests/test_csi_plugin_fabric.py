@@ -1,0 +1,151 @@
+"""CSI behind the OUT-OF-PROCESS plugin fabric (VERDICT r4 #2; ref
+plugins/csi/client.go — third-party CSI drivers are separate processes,
+which is the entire point of CSI). The hostpath plugin runs as an
+external executable behind the same socket protocol as driver plugins;
+crash recovery relaunches it and retries idempotent claim work."""
+import os
+import signal
+import stat
+import sys
+import textwrap
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.client.plugin_host import ExternalCSIPlugin, discover_all
+from nomad_tpu.structs import CSIVolume, VolumeRequest
+
+from test_csi import wait_until
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PLUGIN_SRC = textwrap.dedent(f"""\
+    #!{sys.executable}
+    import sys
+    sys.path.insert(0, {REPO!r})
+    from nomad_tpu.client.csi_hostpath_plugin import main
+    main()
+""")
+
+
+@pytest.fixture
+def plugin_env(tmp_path, monkeypatch):
+    """plugin_dir with the hostpath CSI shim + its backing volume dir."""
+    d = tmp_path / "plugins"
+    d.mkdir()
+    p = d / "hostpath"
+    p.write_text(PLUGIN_SRC)
+    p.chmod(p.stat().st_mode | stat.S_IXUSR)
+    base = tmp_path / "csi-backing"
+    monkeypatch.setenv("NOMAD_CSI_HOSTPATH_DIR", str(base))
+    return str(d), str(base)
+
+
+def _vol(vol_id="appdata"):
+    return CSIVolume(id=vol_id, namespace="default", plugin_id="hostpath",
+                     name=vol_id)
+
+
+def test_discovery_sorts_csi_from_driver_plugins(plugin_env):
+    plugin_dir, _ = plugin_env
+    found = discover_all(plugin_dir)
+    try:
+        assert list(found["csi"]) == ["hostpath"]
+        assert not found["driver"]
+        plug = found["csi"]["hostpath"]
+        assert isinstance(plug, ExternalCSIPlugin)
+        fp = plug.fingerprint()
+        assert fp["healthy"] and fp["provider"] == "hostpath"
+        assert not plug.requires_controller
+    finally:
+        for plug in found["csi"].values():
+            plug.shutdown()
+
+
+def test_crash_relaunch_and_idempotent_retry(plugin_env, tmp_path):
+    """SIGKILL the plugin process; the next call relaunches it and the
+    (idempotent) CSI operation succeeds against the fresh process."""
+    plugin_dir, base = plugin_env
+    found = discover_all(plugin_dir)
+    plug = found["csi"]["hostpath"]
+    try:
+        plug.node_stage_volume("v1", {})
+        assert os.path.isdir(os.path.join(base, "v1"))
+        old_pid = plug.proc.pid
+        os.kill(old_pid, signal.SIGKILL)
+        plug.proc.wait(timeout=10)
+        target = str(tmp_path / "mnt" / "v1")
+        plug.node_publish_volume("v1", target, False, {})   # relaunches
+        assert plug.proc.pid != old_pid
+        assert os.path.islink(target)
+        plug.node_unpublish_volume("v1", target)
+        assert not os.path.lexists(target)
+    finally:
+        plug.shutdown()
+
+
+def test_end_to_end_hostpath_volume_subprocess_plugin(plugin_env):
+    """The dev-agent hostpath e2e (test_csi.py:184) against a SUBPROCESS
+    plugin: publish/claim/unpublish all cross the process boundary, and
+    a plugin crash while the claim is held recovers (VERDICT r4 #2
+    done-when)."""
+    plugin_dir, base = plugin_env
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2,
+                          plugin_dir=plugin_dir))
+    a.start()
+    try:
+        plug = a.client.csi_manager.plugins.get("hostpath")
+        assert isinstance(plug, ExternalCSIPlugin), \
+            "client did not register the subprocess CSI plugin"
+        assert wait_until(
+            lambda: (a.server.csi_plugin_get("hostpath") or None)
+            is not None
+            and a.server.csi_plugin_get("hostpath").nodes_healthy == 1)
+        a.server.csi_volume_register([_vol("appdata")])
+
+        job = mock.job()
+        job.id = job.name = "csisub"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {"data": VolumeRequest(name="data", type="csi",
+                                            source="appdata")}
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", "echo persisted > "
+                                "../volumes/data/state.txt; sleep 30"]}
+        task.resources.networks = []
+        task.resources.cpu = 50
+        task.resources.memory_mb = 32
+        a.server.job_register(job)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "csisub")))
+        alloc = [al for al in a.server.state.allocs_by_job(
+            "default", "csisub") if al.client_status == "running"][0]
+        vol = a.server.csi_volume_get("default", "appdata")
+        assert alloc.id in vol.write_claims
+        backing = os.path.join(base, "appdata", "state.txt")
+        assert wait_until(lambda: os.path.exists(backing), timeout=10)
+
+        # crash the plugin process WHILE the claim is held: the claim
+        # machine must recover — stop drives unpublish through the
+        # relaunched process and the claim frees
+        os.kill(plug.proc.pid, signal.SIGKILL)
+        plug.proc.wait(timeout=10)
+        a.server.job_deregister("default", "csisub")
+        assert wait_until(
+            lambda: not a.server.csi_volume_get("default",
+                                                "appdata").in_use(),
+            timeout=30), "claim not recovered after plugin crash"
+        assert plug.alive(), "plugin was not relaunched"
+        with open(backing) as f:
+            assert f.read().strip() == "persisted"
+        # the publish target is actually gone (unpublish really ran)
+        mount = os.path.join(a.client.alloc_dir_root, alloc.id,
+                             "volumes", "data")
+        assert not os.path.lexists(mount)
+    finally:
+        a.shutdown()
