@@ -284,6 +284,19 @@ def main() -> None:
 
     compile_s = _warmup_compile()
 
+    # device dispatch floor: one trivial jit round trip. Under the axon
+    # tunnel this is ~70ms — the single depth-solve dispatch in the
+    # headline pays it once, so (value - dispatch_floor_s) approximates
+    # what a co-located chip would measure.
+    trivial = jax.jit(lambda x: x + 1)
+    np.asarray(trivial(np.zeros((8, 8), np.float32)))
+    floors = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(trivial(np.zeros((8, 8), np.float32)))
+        floors.append(time.perf_counter() - t0)
+    dispatch_floor_s = sorted(floors)[2]
+
     # measured: fresh cluster, the BASELINE 50k/10k scenario, end to end
     from nomad_tpu.metrics import metrics
     fsm = _seed_fsm(N_NODES, SCHED_ALG_TPU)
@@ -418,6 +431,7 @@ def main() -> None:
         "compile_s": round(compile_s, 3),
         "compile_s_warm_restart": warm_compile_s,
         "warm_restart_detail": warm_extra,
+        "dispatch_floor_s": round(dispatch_floor_s, 4),
         "placed": N_TASKS,
         "plan_nodes_rejected": rejected,
         "plan_nodes_total": total_nodes,

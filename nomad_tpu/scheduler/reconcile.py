@@ -514,10 +514,16 @@ class AllocReconciler:
                     alloc.deployment_status and alloc.deployment_status.canary)),
                 min_job_version=alloc.job.version if alloc.job else 0))
         if existing < tg.count:
-            for nm in name_index.next(tg.count - existing):
-                place.append(AllocPlaceResult(
-                    name=nm, task_group=tg,
-                    downgrade_non_canary=canary_state))
+            # fresh slots are uniform except for the name: batch-stamp
+            # them (a 50k-instance job mints 50k results here — dataclass
+            # __init__ frames were a visible slice of reconcile)
+            from ..structs.fastbatch import stamp_batch
+            names = name_index.next(tg.count - existing)
+            place.extend(stamp_batch(
+                AllocPlaceResult, len(names),
+                shared={"task_group": tg,
+                        "downgrade_non_canary": canary_state},
+                varying={"name": names}))
         return place
 
     def _compute_stop(self, tg: TaskGroup, name_index: AllocNameIndex,

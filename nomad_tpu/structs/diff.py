@@ -96,14 +96,22 @@ def _object_diff(name: str, old: Optional[dict], new: Optional[dict],
     return {"Type": typ, "Name": name, "Fields": fields, "Objects": objects}
 
 
+_IDENTITY_KEYS = ("Name", "Label", "Value", "LTarget", "Attribute",
+                  "GetterSource", "DestPath", "Volume")
+
+
 def _list_key(item) -> str:
     if isinstance(item, dict):
-        for k in ("Name", "Label", "Value", "LTarget", "Attribute",
-                  "GetterSource", "DestPath", "Volume"):
+        for k in _IDENTITY_KEYS:
             if item.get(k):
                 return str(item[k])
         return str(sorted(item.items()))
     return str(item)
+
+
+def _has_identity(item) -> bool:
+    return isinstance(item, dict) and any(
+        item.get(k) for k in _IDENTITY_KEYS)
 
 
 def _list_diff(name: str, old: list, new: list,
@@ -129,11 +137,54 @@ def _list_diff(name: str, old: list, new: list,
         return out
     om = {_list_key(x): x for x in old}
     nm = {_list_key(x): x for x in new}
-    for key in sorted(set(om) | set(nm)):
-        od = _object_diff(name, om.get(key), nm.get(key), contextual)
+    both = set(om) & set(nm)
+    for key in sorted(both):
+        od = _object_diff(name, om[key], nm[key], contextual)
+        if od:
+            out.append(od)
+    # identity-LESS items (networks, unnamed checks) fall back to
+    # content keys, where ANY edit changes the key: pair the leftover
+    # old/new items by field similarity so an edit renders as ONE
+    # Edited object with field-level deltas — the nested granularity
+    # `nomad plan` shows. Items that DO carry a natural identity
+    # (Name/Label/...) are never similarity-paired: a renamed service
+    # is a destroy+create in the reference's keyed diffs (diff.go),
+    # and rendering it as an in-place edit would hide that.
+    left_old = [om[k] for k in sorted(set(om) - both)]
+    left_new = [nm[k] for k in sorted(set(nm) - both)]
+    used_new: set[int] = set()
+    pairs: list[tuple] = []
+    for o in left_old:
+        best, best_sim = -1, 0.0
+        if not _has_identity(o):
+            for j, n in enumerate(left_new):
+                if j in used_new or _has_identity(n):
+                    continue
+                sim = _similarity(o, n)
+                if sim > best_sim:
+                    best_sim, best = sim, j
+        if best >= 0 and best_sim >= 0.5:
+            used_new.add(best)
+            pairs.append((o, left_new[best]))
+        else:
+            pairs.append((o, None))
+    pairs += [(None, n) for j, n in enumerate(left_new)
+              if j not in used_new]
+    for o, n in pairs:
+        od = _object_diff(name, o, n, contextual)
         if od:
             out.append(od)
     return out
+
+
+def _similarity(a, b) -> float:
+    """Fraction of (deep-)equal fields across the union of keys."""
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return 1.0 if a == b else 0.0
+    keys = (set(a) | set(b)) - _IGNORED
+    if not keys:
+        return 1.0
+    return sum(1 for k in keys if a.get(k) == b.get(k)) / len(keys)
 
 
 def task_diff(old: Optional[dict], new: Optional[dict],

