@@ -390,6 +390,23 @@ class AllocRunner:
                 setup_error = f"vault token derivation failed: {e}"
                 self.client.logger(setup_error)
 
+        # sids hook: a connect sidecar task gets a SERVICE IDENTITY token
+        # (ref taskrunner/sids_hook.go deriving Consul SI tokens) written
+        # to secrets/si_token — the credential a real mesh data plane
+        # authenticates with. Derivation failure degrades, not fails: the
+        # reference retries in the background and so does our next
+        # restart; the in-process proxy authorizes via server RPC anyway.
+        from ..integrations.connect import PROXY_PREFIX
+        if task.name.startswith(PROXY_PREFIX) and not setup_error:
+            try:
+                out = self.client.rpc.derive_si_token(self.alloc.id,
+                                                      task.name)
+                rendered.append(("secrets/si_token", out["token"], "0600"))
+            except Exception as e:      # noqa: BLE001
+                self.client.logger(
+                    f"sids: SI token derivation failed for "
+                    f"{task.name}: {e!r}")
+
         # template hook: render embedded templates against env + secrets +
         # the service catalog (ref taskrunner/template_hook.go)
         tmpl_rendered: list = []

@@ -120,10 +120,53 @@ class Client:
                              (self._sync_allocs_loop, "client-alloc-sync"),
                              (self._heartbeat_stop_loop,
                               "client-heartbeat-stop"),
-                             (self._gc_loop, "client-gc")):
+                             (self._gc_loop, "client-gc"),
+                             (self._stats_loop, "client-task-stats")):
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
+
+    # stats hook cadence (ref taskrunner/stats_hook.go driving
+    # DriverStats at the telemetry collection interval)
+    stats_interval_sec = 1.0
+
+    def _stats_loop(self) -> None:
+        """Periodic per-task usage sampling (ref
+        client/allocrunner/taskrunner/stats_hook.go + setGaugeForMemory/
+        CpuStats in client.go:2600 emitStats): every running task's
+        cpu/rss is pulled from its driver and published as gauges keyed
+        by job/group/task — never by alloc id, which would grow metric
+        cardinality without bound. The on-demand alloc_stats API keeps
+        serving point-in-time reads independently of this loop."""
+        from ..metrics import metrics
+        while not self._shutdown.wait(self.stats_interval_sec):
+            try:
+                with self._lock:
+                    runners = list(self.alloc_runners.values())
+                rollup: dict[tuple, tuple] = {}
+                for ar in runners:
+                    alloc = ar.alloc
+                    # snapshot under the runner's own lock: task starts
+                    # mutate the dict concurrently, and an unguarded
+                    # iteration error would kill this daemon thread
+                    with ar._lock:
+                        task_runners = dict(ar.task_runners)
+                    for name, tr in task_runners.items():
+                        try:
+                            st = tr.stats()
+                        except Exception:  # noqa: BLE001 — mid-stop
+                            continue
+                        key = (alloc.job_id, alloc.task_group, name)
+                        cpu, rss = rollup.get(key, (0.0, 0))
+                        rollup[key] = (cpu + st.get("cpu_percent", 0.0),
+                                       rss + st.get("memory_rss_bytes", 0))
+                for (job, tg, task), (cpu, rss) in rollup.items():
+                    base = f"nomad.client.allocs.{job}.{tg}.{task}"
+                    metrics.set_gauge(f"{base}.cpu_percent", cpu)
+                    metrics.set_gauge(f"{base}.memory_rss_bytes",
+                                      float(rss))
+            except Exception as e:      # noqa: BLE001 — sampler survives
+                self.logger(f"client: stats sample failed: {e!r}")
 
     def shutdown(self) -> None:
         self._shutdown.set()

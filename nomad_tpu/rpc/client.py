@@ -232,6 +232,9 @@ class ServerRpc:
     def vault_derive_token(self, alloc_id: str, task: str):
         return self.rpc.call("Vault.DeriveToken", alloc_id, task)
 
+    def derive_si_token(self, alloc_id: str, task: str):
+        return self.rpc.call("Node.DeriveSIToken", alloc_id, task)
+
     def vault_renew_token(self, token: str):
         return self.rpc.call("Vault.RenewToken", token)
 

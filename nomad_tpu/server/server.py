@@ -91,6 +91,7 @@ RPC_ENDPOINTS = {
     "Intention.List": ("intention_list", False),
     "Intention.Allowed": ("intention_allowed", False),
     "Vault.DeriveToken": ("vault_derive_token", True),
+    "Node.DeriveSIToken": ("derive_si_token", True),
     "Vault.RenewToken": ("vault_renew_token", True),
     "Vault.RevokeToken": ("vault_revoke_token", True),
     # leader-only: the in-memory dev backend lives in one process; routing
@@ -1021,6 +1022,31 @@ class Server:
         tok = self.secrets.derive_token(alloc_id, task,
                                         list(t.vault.policies))
         return {"token": tok.token, "ttl_sec": tok.ttl_sec}
+
+    def derive_si_token(self, alloc_id: str, task: str) -> dict:
+        """Service-identity token for a connect sidecar task (ref
+        nomad/node_endpoint.go:DeriveSIToken + the client sids_hook:
+        Consul SI tokens scoped to the service the sidecar fronts).
+        Validates the named task IS the injected proxy of one of the
+        alloc's connect services before minting."""
+        from ..integrations.connect import PROXY_PREFIX
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise ValueError(f"allocation {alloc_id!r} not found")
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        svc_name = task[len(PROXY_PREFIX):] \
+            if task.startswith(PROXY_PREFIX) else ""
+        svc = next((s for s in (tg.services if tg else [])
+                    if s.name == svc_name and s.connect), None)
+        if svc is None:
+            raise ValueError(
+                f"task {task!r} is not a connect sidecar of this alloc")
+        tok = self.secrets.derive_token(
+            alloc_id, task,
+            ["si", f"service:{alloc.namespace}/{svc.name}"])
+        return {"token": tok.token, "ttl_sec": tok.ttl_sec,
+                "service": svc.name}
 
     def vault_renew_token(self, token: str) -> dict:
         tok = self.secrets.renew_token(token)

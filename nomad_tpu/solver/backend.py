@@ -112,8 +112,6 @@ def select(kernel: str, n_padded: int, *, count=None, k_max: int = 128,
     tier, devs = _tier(n_padded, count)
     if kernel == "chunked" and tier == "pallas":
         tier = "xla"                # scan-bound: no pallas tier (above)
-    if depth_grid is not None and tier == "pallas":
-        tier = "xla"                # the pallas curve is dense-K only
     # thresholds are part of the key so runtime mutation (tests, operator
     # monkeypatch) takes effect without an explicit reset(); the resolved
     # tier (not raw count) keys the cache so counts don't fan it out
@@ -166,14 +164,13 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
                                       spread_algorithm=spread_algorithm,
                                       depth_grid=depth_grid)
         if tier == "pallas":
-            if depth_grid is not None:
-                # the pallas curve producer is dense-K only; select()
-                # remaps this, the branch is defense for direct callers
-                return _build(kernel, "xla", devs, k_max, max_steps,
-                              spread_algorithm, depth_grid)
+            # both regimes ride the hand kernel: dense-K curve for
+            # deterministic solves, sampled grid (trapezoid-weight
+            # matmul) for the jittered regime (VERDICT r4 weak #3)
             from .pallas_kernels import fill_depth_fused
             return functools.partial(fill_depth_fused, k_max=k_max,
-                                     spread_algorithm=spread_algorithm)
+                                     spread_algorithm=spread_algorithm,
+                                     depth_grid=depth_grid)
 
         def depth_xla(cap, used, ask, count, feasible, coll, desired, aff,
                       max_per_node, order_jitter, jitter_scale,

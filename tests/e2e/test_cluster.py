@@ -126,13 +126,13 @@ def test_drain_migrates_allocs(cluster):
             f"/v1/node/{drain_id}/allocations")
             if a.get("ClientStatus") == "running"]
         return not allocs
-    assert wait_until(drained, timeout=60), _diagnose(cluster)
+    assert wait_until(drained, timeout=90), _diagnose(cluster)
     # every service job still has its full count, now on the other node
     for jid, count in (("e2e-base", 2), ("e2e-reattach", 2)):
         assert wait_until(
             lambda: len([a for a in cluster.running_allocs(jid)
                          if a["NodeID"] == keep_id]) == count,
-            timeout=60), f"{jid} did not migrate:\n" + _diagnose(cluster)
+            timeout=90), f"{jid} did not migrate:\n" + _diagnose(cluster, jid)
     # un-drain so later tests get both nodes back
     cluster.send_leader(f"/v1/node/{drain_id}/drain",
                         {"DrainSpec": None, "MarkEligible": True})

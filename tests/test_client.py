@@ -322,3 +322,34 @@ def test_native_logmon_oversized_reattach_rotates_first(tmp_path):
     with open(base, "rb") as f:
         assert b"fresh-after-restart" in f.read()
     assert _os.path.exists(base + ".1")   # the oversized original rotated
+
+
+def test_stats_hook_publishes_task_gauges():
+    """stats hook (ref taskrunner/stats_hook.go + client emitStats):
+    running tasks' cpu/rss are sampled periodically and published as
+    job/group/task gauges (never keyed by alloc id)."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.metrics import metrics
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    a.client.stats_interval_sec = 0.2
+    try:
+        job = mock.job()
+        job.id = job.name = "statjob"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "raw_exec"
+        tg.tasks[0].config = {"command": "/bin/sleep", "args": ["30"]}
+        tg.tasks[0].resources.networks = []
+        a.server.job_register(job)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "statjob")))
+        name = "nomad.client.allocs.statjob.web.web.memory_rss_bytes"
+        assert wait_until(
+            lambda: metrics.gauges.get(name, -1.0) >= 0.0, timeout=10), \
+            sorted(k for k in metrics.gauges if "allocs" in k)
+        assert f"nomad.client.allocs.statjob.web.web.cpu_percent" in \
+            metrics.gauges
+    finally:
+        a.shutdown()

@@ -440,3 +440,40 @@ def test_exposed_check_serves_through_sidecar(tmp_path):
             timeout=20)
     finally:
         a.shutdown()
+
+
+def test_sidecar_gets_service_identity_token():
+    """sids hook (ref taskrunner/sids_hook.go): the injected connect
+    proxy task receives a service-identity token in secrets/si_token,
+    scoped to the service it fronts; non-sidecar tasks cannot derive
+    one."""
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=2))
+    a.start()
+    try:
+        assert wait_until(
+            lambda: a.server.state.node_by_id(a.client.node.id) is not None
+            and a.server.state.node_by_id(a.client.node.id).ready())
+        job = _connect_job("sids", "sids-svc")
+        job.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh", "args": ["-c", "sleep 60"]}
+        a.server.job_register(job)
+        assert wait_until(lambda: any(
+            al.client_status == "running"
+            for al in a.server.state.allocs_by_job("default", "sids")))
+        alloc = [al for al in a.server.state.allocs_by_job(
+            "default", "sids") if al.client_status == "running"][0]
+        from nomad_tpu.integrations.connect import PROXY_PREFIX
+        tok_path = os.path.join(a.client.alloc_dir_root, alloc.id,
+                                PROXY_PREFIX + "sids-svc", "secrets",
+                                "si_token")
+        assert wait_until(lambda: os.path.exists(tok_path), timeout=10), \
+            "sidecar did not receive an SI token"
+        with open(tok_path) as f:
+            token = f.read().strip()
+        assert token
+        # the server minted it scoped to the service identity
+        import pytest as _pt
+        with _pt.raises(Exception):
+            a.server.derive_si_token(alloc.id, "web")   # not a sidecar
+    finally:
+        a.shutdown()
