@@ -139,12 +139,21 @@ class HTTPAPI:
         # ---- jobs
         if parts == ["jobs"]:
             if method == "GET":
-                require(acl.allow_namespace_operation(ns, NS_LIST_JOBS))
+                # wildcard namespace lists across namespaces with
+                # per-job ACL filtering, like the other list routes
+                # (ref nomad/job_endpoint.go List + allowedNSes). The
+                # e2e rejoin test caught the old behavior: iter_jobs("*")
+                # matched the literal namespace "*" and returned nothing.
+                require(ns == "*" or
+                        acl.allow_namespace_operation(ns, NS_LIST_JOBS))
                 prefix = query.get("prefix", "")
                 payload, index = blocking(
                     lambda: s.state.table_index("jobs"),
-                    lambda: [self._job_stub(j) for j in s.state.iter_jobs(ns)
-                             if j.id.startswith(prefix)])
+                    lambda: [self._job_stub(j) for j in s.state.iter_jobs(
+                        None if ns == "*" else ns)
+                        if j.id.startswith(prefix)
+                        and (ns != "*" or acl.allow_namespace_operation(
+                            j.namespace, NS_LIST_JOBS))])
                 return payload, index
             if method in ("PUT", "POST"):
                 job = from_api(Job, body.get("Job", body))

@@ -139,6 +139,7 @@ class Client:
         cardinality without bound. The on-demand alloc_stats API keeps
         serving point-in-time reads independently of this loop."""
         from ..metrics import metrics
+        published: set[tuple] = set()
         while not self._shutdown.wait(self.stats_interval_sec):
             try:
                 with self._lock:
@@ -165,6 +166,14 @@ class Client:
                     metrics.set_gauge(f"{base}.cpu_percent", cpu)
                     metrics.set_gauge(f"{base}.memory_rss_bytes",
                                       float(rss))
+                # retire gauges for tasks that stopped since last cycle:
+                # without this, dead jobs report phantom usage forever
+                # and job churn grows the gauge set without bound
+                for job, tg, task in published - set(rollup):
+                    base = f"nomad.client.allocs.{job}.{tg}.{task}"
+                    metrics.gauges.pop(f"{base}.cpu_percent", None)
+                    metrics.gauges.pop(f"{base}.memory_rss_bytes", None)
+                published = set(rollup)
             except Exception as e:      # noqa: BLE001 — sampler survives
                 self.logger(f"client: stats sample failed: {e!r}")
 

@@ -12,8 +12,11 @@ snapshot-min-index barrier, nomad/worker.go:536, builds on this).
 
 Persistence (checkpoint/resume, SURVEY.md §5): term/vote in a small metadata
 file, log entries in an append-only frame file, FSM snapshots with log
-truncation — a restarted server restores snapshot + replays its log before
-rejoining (ref raft-boltdb + fsm.go Snapshot/Restore).
+truncation — a restarted server restores its FSM from the snapshot and
+reloads the log; entries past the snapshot re-apply through the applier
+only as commitment is re-established (ref raft-boltdb + fsm.go
+Snapshot/Restore; an ex-leader's unsynced tail may be truncated by the
+next leader, so it must never be applied eagerly at boot).
 """
 from __future__ import annotations
 
@@ -229,26 +232,51 @@ class RaftNode:
                 term, type_, payload = pickle.loads(raw[off:off + ln])
                 self.log.append(_Entry(term, type_, payload))
                 off += ln
-            # committed-but-unapplied entries replay on the apply loop once
-            # commit advances; conservatively re-apply everything we have
-            # (FSM application is idempotent per replay determinism)
-            for i, e in enumerate(self.log):
-                idx = self.base_index + i + 1
+            # Membership is adopted from the log at restore (config is
+            # append-time state in this design), but the FSM is NOT:
+            # a restarted server cannot know which tail entries were
+            # committed — an ex-leader's log may end in UNCOMMITTED
+            # entries a new leader will truncate and replace. Eagerly
+            # applying them bakes phantom state into the FSM AND pins
+            # last_applied past the replaced indexes, so the
+            # replacements (including this node's own re-add/promote
+            # config entries after an autopilot removal) are silently
+            # skipped — the multi-process e2e rejoin test caught a
+            # restarted server stuck as a permanent self-nonvoter this
+            # way. Like hashicorp/raft: FSM = snapshot; log entries
+            # re-apply through the applier once a leader of the next
+            # term re-establishes commitment (its election no-op).
+            for e in self.log:
                 if e.type == "_config_remove":
                     with self._lock:
                         self._apply_config_locked(e.payload)
                 elif e.type == "_config_add":
                     with self._lock:
                         self._apply_config_add_locked(e.payload)
-                elif e.type != "_noop":
+            self.commit_index = self.last_applied = self.base_index
+            if self._voters() in ([], [self.node_id]):
+                # sole voter: every entry in its own log IS committed
+                # (majority of one) — eager replay keeps single-server
+                # restarts serving immediately, with none of the
+                # uncommitted-tail hazard above
+                for i, e in enumerate(self.log):
+                    idx = self.base_index + i + 1
+                    if e.type in ("_config_remove", "_config_add",
+                                  "_noop"):
+                        continue
                     try:
                         self.fsm.apply(idx, e.type, e.payload)
                     except Exception as ex:   # noqa: BLE001
-                        # same contract as the runtime apply loop: a bad
-                        # entry must never brick restart/replay
+                        # a bad entry must never brick restart/replay
                         self.logger(
                             f"raft: fsm replay failed at {idx}: {ex!r}")
-            self.commit_index = self.last_applied = self._last_index()
+                self.commit_index = self.last_applied = self._last_index()
+        if self.base_index or self.log:
+            self.logger(
+                f"raft: {self.node_id} restored snapshot to index "
+                f"{self.base_index}, log to index {self._last_index()} "
+                f"(term {self.current_term}); uncommitted tail applies "
+                f"once a leader re-establishes commitment")
 
     # ----------------------------------------------------------- lifecycle
 
@@ -561,6 +589,8 @@ class RaftNode:
                 self.state = CANDIDATE
                 self._votes = 1
                 term = self.current_term
+                self.logger(f"raft: {self.node_id} campaigning "
+                            f"(term {term})")
                 last_idx = self._last_index()
                 last_term = self._term_at(last_idx)
                 peers = {pid: addr for pid, addr in self.peers.items()
@@ -673,6 +703,7 @@ class RaftNode:
         cli = RpcClient([addr], key=self.rpc_server.key, timeout=2.0,
                         tls=self.rpc_server.tls)
         ev = self._replicate_events[pid]
+        fails = 0
         try:
             while not self._stop.is_set():
                 with self._lock:
@@ -684,7 +715,16 @@ class RaftNode:
                 ev.clear()
                 try:
                     self._replicate_once(cli, pid, term)
-                except Exception:    # noqa: BLE001
+                    if fails >= 10:
+                        self.logger(f"raft: replication to {pid} "
+                                    f"recovered")
+                    fails = 0
+                except Exception as e:   # noqa: BLE001
+                    fails += 1
+                    if fails in (10, 100, 1000):   # once per decade, not
+                        self.logger(           # one line per heartbeat
+                            f"raft: replication to {pid} ({addr}) "
+                            f"failing x{fails}: {e!r}")
                     time.sleep(self.heartbeat_interval)
         finally:
             cli.close()

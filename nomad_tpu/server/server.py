@@ -1414,12 +1414,22 @@ class Server:
         from .raft import RaftNode
         if isinstance(self.raft, RaftNode):
             is_leader, _ = self.raft.leadership()
+            # snapshot membership under the raft lock: config-entry
+            # application resizes these dicts concurrently, and this
+            # endpoint is polled exactly during membership transitions
+            with self.raft._lock:
+                peers = dict(self.raft.peers)
+                nonvoters = set(self.raft.nonvoters)
             servers = [{
                 "ID": pid, "Node": pid, "Address": addr,
                 "Leader": (pid == self.raft.node_id and is_leader)
                 or pid == self.raft.leader_id,
-                "Voter": True, "RaftProtocol": "3",
-            } for pid, addr in sorted(self.raft.peers.items())]
+                # real voter status: freshly (re)joined servers ride as
+                # non-voters until autopilot promotes them, and operators
+                # (and the e2e rejoin test) must see that
+                "Voter": pid not in nonvoters,
+                "RaftProtocol": "3",
+            } for pid, addr in sorted(peers.items())]
             return {"Servers": servers, "Index": self.raft.barrier()}
         return {"Servers": [{
             "ID": "server-1", "Node": "server-1",

@@ -111,6 +111,43 @@ def test_client_kill9_restart_reattaches(cluster):
             assert ts.get("Restarts", 0) == 0, a
 
 
+def test_dead_server_rejoins_and_catches_up(cluster):
+    """Restart the SIGKILL'd server with its surviving data dir: it must
+    rejoin via gossip, catch up from the raft log (entries committed
+    while it was dead), and restore quorum — a later leader kill still
+    fails over."""
+    dead = [p for p in cluster.servers if not p.alive()]
+    assert dead, "failover test should have left a dead server"
+    dead[0].start()
+    assert dead[0].wait_http(30), dead[0].tail()
+    # catches up: the rejoined server's own state answers with the jobs
+    # committed during its death (reads are served locally)
+    def caught_up():
+        jobs = {j["ID"] for j in dead[0].get("/v1/jobs?namespace=*")}
+        return {"e2e-base", "e2e-fo3", "e2e-reattach"} <= jobs
+    assert wait_until(caught_up, timeout=60), \
+        f"rejoined server stale: {dead[0].tail(1500)}"
+    # the rejoined server comes back as a NON-VOTER (leader-driven serf
+    # join -> AddNonvoter) and is promoted by the autopilot tick once
+    # stable — wait for 3 VOTERS or the next kill has no quorum
+    def three_voters():
+        cfg = cluster.leader().get("/v1/operator/raft/configuration")
+        return sum(1 for sv in cfg.get("Servers", [])
+                   if sv.get("Voter")) >= 3
+    assert wait_until(three_voters, timeout=60), \
+        "rejoined server never promoted to voter:\n" + _diagnose(cluster)
+    # quorum is 3-of-3 again: killing the current leader must fail over
+    old = cluster.leader()
+    old.kill9()
+    assert wait_until(lambda: cluster.leader() is not old, timeout=30), \
+        "no failover after rejoin:\n" + _diagnose(cluster)
+    assert wait_until(lambda: len(cluster.running_allocs("e2e-base")) == 2,
+                      timeout=60), _diagnose(cluster, "e2e-base")
+    # bring it back so the remaining tests run with a full server set
+    old.start()
+    assert old.wait_http(30), old.tail()
+
+
 def test_drain_migrates_allocs(cluster):
     """Draining a node migrates its allocs to the surviving node and
     leaves the drained node empty."""
