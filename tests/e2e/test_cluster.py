@@ -178,6 +178,52 @@ def test_drain_migrates_allocs(cluster):
         for n in cluster.leader().get("/v1/nodes")), timeout=40)
 
 
+def test_operator_snapshot_restore_into_fresh_process(cluster):
+    """Disaster recovery across processes (SURVEY §5 checkpoint/resume;
+    ref operator_endpoint.go SnapshotSave/Restore): stream a snapshot
+    out of the live cluster's leader and restore it into a brand-new
+    single-server process — the job catalog survives the round trip."""
+    import json
+    import sys
+    import urllib.request
+
+    from .harness import AgentProc
+    lead = cluster.leader()
+    with urllib.request.urlopen(lead.url("/v1/operator/snapshot"),
+                                timeout=15) as r:
+        snap = r.read()
+    assert snap, "empty snapshot stream"
+    want_jobs = {j["ID"] for j in lead.get("/v1/jobs?namespace=*")}
+    assert want_jobs, "cluster has no jobs to snapshot"
+
+    http_port, rpc_port = free_ports(2)
+    d = os.path.join(cluster.base, "dr-server")
+    os.makedirs(d, exist_ok=True)
+    cfg_path = os.path.join(d, "agent.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"data_dir": d, "name": "e2e-dr",
+                   "server": {"enabled": True, "bootstrap_expect": 1},
+                   "client": {"enabled": False},
+                   "ports": {"rpc": rpc_port}}, f)
+    dr = AgentProc("dr-server",
+                   [sys.executable, "-m", "nomad_tpu.cli", "agent",
+                    "-config", cfg_path, "-port", str(http_port)],
+                   os.path.join(d, "agent.log"), http_port)
+    dr.start()
+    try:
+        assert dr.wait_http(30), dr.tail()
+        assert wait_until(lambda: dr.get("/v1/status/leader"), timeout=30)
+        req = urllib.request.Request(
+            dr.url("/v1/operator/snapshot"), data=snap, method="PUT",
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            r.read()
+        got = {j["ID"] for j in dr.get("/v1/jobs?namespace=*")}
+        assert want_jobs <= got, f"restored {got}, wanted {want_jobs}"
+    finally:
+        dr.terminate()
+
+
 def _connect_job(job_id: str, svc: str, script: str,
                  upstreams=()) -> dict:
     return {
