@@ -135,6 +135,31 @@ class Agent:
         if not ensure_native():
             self.logger("agent: native sidecars unavailable (no toolchain?);"
                         " using pure-Python fallbacks")
+        # bind HTTP FIRST (serving starts below): the bound port feeds
+        # both the node's advertised http_addr and the server's gossip
+        # http_addr tag, which follower->leader HTTP forwarding resolves
+        self.http = make_http_server(self.api, self.config.bind_addr,
+                                     self.config.http_port)
+        # pick up the OS-assigned port when asked for :0
+        self.config.http_port = self.http.server_address[1]
+        adv = self.config.advertise_addr or self.config.bind_addr
+        if adv in ("0.0.0.0", "::", ""):
+            import socket as _socket
+            try:
+                adv = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                adv = "127.0.0.1"
+        http_advertise = f"{adv}:{self.config.http_port}"
+        try:
+            self._start_rest(http_advertise)
+        except BaseException:
+            # the HTTP socket bound above must not outlive a failed
+            # start: a caller that fixes config and retries on the same
+            # fixed port would hit EADDRINUSE until this object is GC'd
+            self.http.server_close()
+            raise
+
+    def _start_rest(self, http_advertise: str) -> None:
         if self.server is not None:
             # persistent XLA compile cache: a restarted server replays
             # serialized solver executables instead of paying the ~14s
@@ -170,6 +195,7 @@ class Agent:
                     {self.server.name: self.server.rpc_addr},
                     data_dir=os.path.join(self.config.data_dir, "raft"),
                     bootstrap=(self.config.bootstrap_expect == 1))
+            self.server.http_advertise = http_advertise
             self.server.start()
             if self.config.gossip_port >= 0:
                 self.server.gossip_listen(self.config.bind_addr,
@@ -177,10 +203,6 @@ class Agent:
                                           key=self.config.key_bytes())
                 if self.config.join:
                     self.server.gossip_join(list(self.config.join))
-        self.http = make_http_server(self.api, self.config.bind_addr,
-                                     self.config.http_port)
-        # pick up the OS-assigned port when asked for :0
-        self.config.http_port = self.http.server_address[1]
         self._http_thread = threading.Thread(
             target=self.http.serve_forever, daemon=True, name="http")
         self._http_thread.start()
@@ -188,14 +210,7 @@ class Agent:
             # the node advertises its agent's HTTP address so peers can
             # migrate ephemeral disks from it (ref structs.Node.HTTPAddr;
             # bind vs advertise split as in command/agent/config.go)
-            adv = self.config.advertise_addr or self.config.bind_addr
-            if adv in ("0.0.0.0", "::", ""):
-                import socket as _socket
-                try:
-                    adv = _socket.gethostbyname(_socket.gethostname())
-                except OSError:
-                    adv = "127.0.0.1"
-            self.client.node.http_addr = f"{adv}:{self.config.http_port}"
+            self.client.node.http_addr = http_advertise
             self.client.start()
         self._start_runtime_sampler()
 

@@ -12,6 +12,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..api_codec import from_api, to_api
+from ..rpc.codec import LeadershipLostError, NotLeaderError
 from ..structs import (
     DrainStrategy, Job, SchedulerConfiguration,
 )
@@ -1165,6 +1166,7 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             query = {k: (v if k == "address" else v[0]) for k, v in
                      urllib.parse.parse_qs(parsed.query).items()}
             body = None
+            raw = b""
             length = int(self.headers.get("Content-Length", 0) or 0)
             if length:
                 raw = self.rfile.read(length)
@@ -1186,6 +1188,36 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 return
             except (KeyError,) as e:
                 self._respond(404, {"error": str(e)})
+                return
+            except LeadershipLostError as e:
+                # appended but uncommitted when leadership moved: the
+                # write MAY still land — forwarding would risk applying
+                # it twice (ref hashicorp/raft ErrLeadershipLost)
+                self._respond(500, {"error": str(e)})
+                return
+            except NotLeaderError as e:
+                # transparent follower->leader forwarding (ref
+                # nomad/rpc.go forward — theirs rides RPC, ours proxies
+                # the HTTP request to the leader's advertised HTTP addr
+                # from gossip tags). One hop only: a forwarded request
+                # that STILL lands on a non-leader (election in flight)
+                # surfaces the error to the caller, who retries.
+                if self.headers.get("X-Nomad-Forwarded"):
+                    self._respond(500, {"error": str(e)})
+                    return
+                target = ""
+                srv = api.server
+                if srv is not None:
+                    target = srv.leader_http_addr()
+                if not target:
+                    self._respond(500, {"error": str(e)})
+                    return
+                try:
+                    self._proxy_to_leader(target, method, parsed, raw,
+                                          token)
+                except Exception as pe:     # noqa: BLE001
+                    self._respond(
+                        500, {"error": f"leader forward failed: {pe}"})
                 return
             except Exception as e:      # noqa: BLE001
                 self._respond(500, {"error": repr(e)})
@@ -1345,6 +1377,48 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 pass       # client went away
             finally:
                 sub.close()
+
+        def _proxy_to_leader(self, target: str, method: str, parsed,
+                             raw: bytes, token: str) -> None:
+            """Replay this request against the leader's HTTP surface and
+            stream its response back verbatim (status, index, body)."""
+            import urllib.error
+            import urllib.request
+            url = f"http://{target}{parsed.path}"
+            if parsed.query:
+                url += f"?{parsed.query}"
+            req = urllib.request.Request(
+                url, data=raw if raw else None, method=method)
+            req.add_header("X-Nomad-Forwarded", "1")
+            if token:
+                req.add_header("X-Nomad-Token", token)
+            ctype = self.headers.get("Content-Type")
+            if ctype:
+                req.add_header("Content-Type", ctype)
+            try:
+                # must out-wait the leader's raft apply timeout (30s,
+                # raft.py apply) — a proxy timeout at exactly 30s would
+                # report a slow-but-committing write as failed and
+                # invite a duplicating retry
+                resp = urllib.request.urlopen(req, timeout=45)
+            except urllib.error.HTTPError as e:
+                resp = e                 # pass error statuses through too
+            with resp:
+                data = resp.read()       # fully read BEFORE any response
+            try:
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.headers.get(
+                    "Content-Type", "application/json"))
+                self.send_header("Content-Length", str(len(data)))
+                idx = resp.headers.get("X-Nomad-Index")
+                if idx:
+                    self.send_header("X-Nomad-Index", idx)
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError:
+                # client went away mid-write: the response has started,
+                # so the caller's except must NOT send a second one
+                pass
 
         def _respond(self, code: int, payload, headers=None) -> None:
             if isinstance(payload, RawResponse):

@@ -51,6 +51,20 @@ class NotLeaderError(Exception):
         self.leader_addr = leader_addr
 
 
+class LeadershipLostError(NotLeaderError):
+    """Leadership was lost AFTER the entry was appended (ref
+    hashicorp/raft ErrLeadershipLost vs ErrNotLeader): the write may
+    still commit under the new leader, so it must NOT be transparently
+    retried or forwarded — the outcome is unknown and a resubmit can
+    double-apply a non-idempotent write."""
+
+    def __init__(self, leader_addr: str = ""):
+        Exception.__init__(
+            self, "leadership lost while committing; outcome unknown "
+            f"(leader={leader_addr or '?'})")
+        self.leader_addr = leader_addr
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module: str, name: str):
         if (module, name) in _ALLOWED_EXACT or \

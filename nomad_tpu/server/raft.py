@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..rpc.codec import NotLeaderError
+from ..rpc.codec import LeadershipLostError, NotLeaderError
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -342,14 +342,17 @@ class RaftNode:
                     raise TimeoutError(
                         f"raft apply of {msg_type} timed out at index {index}")
                 if self.state != LEADER:
-                    raise NotLeaderError(self.leader_addr)
+                    # the entry IS appended; it may still commit under
+                    # the next leader — callers must not retry/forward
+                    # (ref hashicorp/raft ErrLeadershipLost)
+                    raise LeadershipLostError(self.leader_addr)
                 self._apply_cond.wait(min(remaining, 0.5))
             # leadership lost mid-wait: a new leader may have overwritten
             # our uncommitted entry at this index (hashicorp/raft returns
             # ErrLeadershipLost for exactly this)
             if index > self.base_index and \
                     self._term_at(index) != entry.term:
-                raise NotLeaderError(self.leader_addr)
+                raise LeadershipLostError(self.leader_addr)
             return index
 
     def bootstrap_with(self, peers: dict[str, str]) -> bool:

@@ -264,11 +264,16 @@ class Server:
             raise RuntimeError("gossip_listen requires rpc_listen() first")
         from ..rpc.server import DEFAULT_KEY
         from .gossip import Gossip
+        tags = {"role": "nomad-server", "region": self.region,
+                "rpc_addr": self.rpc_server.addr, "id": self.name}
+        if getattr(self, "http_advertise", ""):
+            # lets followers proxy HTTP writes to the leader's HTTP
+            # surface (ref serf tags port/addr feeding rpc forwarding)
+            tags["http_addr"] = self.http_advertise
         self.gossip = Gossip(
             name=self.name, bind=bind, port=port,
             key=key or DEFAULT_KEY, logger=self.logger,
-            tags={"role": "nomad-server", "region": self.region,
-                  "rpc_addr": self.rpc_server.addr, "id": self.name},
+            tags=tags,
             on_join=self._on_gossip_join,
             on_leave=self._on_gossip_leave,
             on_fail=self._on_gossip_fail)
@@ -288,6 +293,23 @@ class Server:
     def members(self) -> list[dict]:
         """ref nomad/serf.go Members for `server members` / agent API"""
         return self.gossip.members_snapshot() if self.gossip else []
+
+    def leader_http_addr(self) -> str:
+        """The current raft leader's advertised HTTP address (via its
+        gossip tags), or "" when unknown — the follower HTTP forwarding
+        target (ref nomad/rpc.go forward; our proxy rides HTTP)."""
+        if self.raft_node is None or self.gossip is None:
+            return ""
+        _, leader_rpc = self.raft_node.leadership()
+        leader_id = self.raft_node.leader_id
+        for m in self.members():
+            t = m.get("tags", {})
+            if t.get("role") != "nomad-server":
+                continue
+            if t.get("id") == leader_id or \
+                    (leader_rpc and t.get("rpc_addr") == leader_rpc):
+                return t.get("http_addr", "")
+        return ""
 
     def regions(self) -> list[str]:
         out = {self.region} | set(self.region_servers)

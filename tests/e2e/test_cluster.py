@@ -58,6 +58,19 @@ def test_job_runs_across_real_processes(cluster):
     assert len(pids) >= 2, f"no task processes found: {pids}"
 
 
+def test_follower_http_forwards_writes_to_leader(cluster):
+    """A write against a FOLLOWER's HTTP surface lands on the leader
+    transparently (ref nomad/rpc.go forward — ours proxies the HTTP
+    request to the leader's gossip-advertised HTTP address)."""
+    lead = cluster.leader()
+    follower = next(p for p in cluster.live_servers() if p is not lead)
+    resp = follower.send("/v1/jobs", {"Job": sleep_job("e2e-fwd",
+                                                       count=1)})
+    assert resp.get("eval_id"), f"no eval from forwarded write: {resp}"
+    assert cluster.wait_running("e2e-fwd", 1), _diagnose(cluster,
+                                                         "e2e-fwd")
+
+
 def test_leader_kill9_failover_and_convergence(cluster):
     """kill -9 the leader while jobs are being submitted: a new leader
     takes over from its raft log and every submitted job converges to
