@@ -204,3 +204,25 @@ def test_spa_views_reference_only_served_fields(agent):
     # nav links present
     assert re.search(r'href="#/volumes"', body)
     assert re.search(r'href="#/scaling"', body)
+
+
+def test_jobs_wildcard_listing_contract(agent):
+    """The SPA jobs view fetches /jobs?namespace=* — the wildcard must
+    list across ALL namespaces (regression: it used to match the
+    literal namespace \"*\" and render an empty jobs table; mapping
+    \"*\" to just \"default\" would hide other namespaces' jobs)."""
+    agent.server.namespace_upsert([{"name": "ui-team"}])
+    other = mock.job()
+    other.id = other.name = "ui-other-ns"
+    other.namespace = "ui-team"
+    other.task_groups[0].tasks[0].driver = "mock_driver"
+    other.task_groups[0].tasks[0].resources.networks = []
+    agent.server.job_register(other)
+    jobs = _get(agent, "/v1/jobs?namespace=*")
+    ids = {j["ID"] for j in jobs}
+    assert {"ui-job", "ui-other-ns"} <= ids, ids
+    _require(jobs[0], ["ID", "Namespace", "Type", "Priority",
+                       "Status"], "jobs")
+    # scoped listing still filters
+    assert {j["ID"] for j in _get(agent, "/v1/jobs?namespace=ui-team")} \
+        == {"ui-other-ns"}
