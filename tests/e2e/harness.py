@@ -106,15 +106,21 @@ class AgentProc:
     def url(self, path: str) -> str:
         return f"http://127.0.0.1:{self.http_port}{path}"
 
-    def get(self, path: str, timeout: float = 5.0):
-        with urllib.request.urlopen(self.url(path), timeout=timeout) as r:
+    def get(self, path: str, timeout: float = 5.0, token: str = ""):
+        req = urllib.request.Request(self.url(path))
+        if token:
+            req.add_header("X-Nomad-Token", token)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.load(r)
 
     def send(self, path: str, body: dict, method: str = "PUT",
-             timeout: float = 10.0):
+             timeout: float = 10.0, token: str = ""):
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["X-Nomad-Token"] = token
         req = urllib.request.Request(
             self.url(path), data=json.dumps(body).encode(), method=method,
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         with urllib.request.urlopen(req, timeout=timeout) as r:
             raw = r.read()
             return json.loads(raw) if raw else None
@@ -146,7 +152,13 @@ class Cluster:
     ENCRYPT_KEY = "e2e-harness-shared-key"
 
     def __init__(self, base_dir: str, n_servers: int = 3,
-                 n_clients: int = 2):
+                 n_clients: int = 2, acl: bool = False):
+        if acl and n_clients:
+            # the workload helpers (nodes_ready/run_job/allocs) drive
+            # anonymous HTTP, which deny-all ACLs reject — the ACL tier
+            # runs server-only until they learn to carry a token
+            raise ValueError("acl=True supports n_clients=0 only")
+        self.acl = acl
         self.base = base_dir
         self.servers: list[AgentProc] = []
         self.clients: list[AgentProc] = []
@@ -177,6 +189,8 @@ class Cluster:
             "client": {"enabled": False},
             "ports": {"rpc": self._rpc[i], "serf": self._gossip[i]},
         }
+        if self.acl:
+            cfg["acl"] = {"enabled": True}
         cfg_path = os.path.join(d, "agent.json")
         with open(cfg_path, "w") as f:
             json.dump(cfg, f)
@@ -222,8 +236,9 @@ class Cluster:
             self.start_client(i)
         for p in self.clients:
             assert p.wait_http(30), f"{p.name} never served HTTP:\n{p.tail()}"
-        assert wait_until(self.nodes_ready, 30), \
-            f"clients never registered: {self.leader().get('/v1/nodes')}"
+        if self.n_clients:
+            assert wait_until(self.nodes_ready, 30), \
+                f"clients never registered: {self.leader().get('/v1/nodes')}"
         return self
 
     # ------------------------------------------------------------- leader

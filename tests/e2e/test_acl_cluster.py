@@ -1,0 +1,83 @@
+"""ACL enforcement across real server processes (ref nomad/acl.go +
+command/agent ACL enforcement; the e2e half of tests/test_acl.py):
+bootstrap on the leader, token replication through the raft log to
+followers, local enforcement on every server, and token passthrough on
+follower->leader HTTP forwarding.
+"""
+import urllib.error
+
+import pytest
+
+from .harness import Cluster, sleep_job, wait_until
+
+pytestmark = pytest.mark.e2e
+
+
+@pytest.fixture(scope="module")
+def acl_cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("e2e-acl")), n_servers=2,
+                n_clients=0, acl=True)
+    try:
+        c.start()
+        yield c
+    finally:
+        c.shutdown()
+
+
+def _status(exc_or_call):
+    try:
+        exc_or_call()
+        return 200
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_acl_cluster_bootstrap_enforcement_forwarding(acl_cluster):
+    lead = acl_cluster.leader()
+    follower = next(p for p in acl_cluster.live_servers() if p is not lead)
+
+    # anonymous requests are denied on EVERY server process
+    assert _status(lambda: lead.get("/v1/jobs")) == 403
+    assert _status(lambda: follower.get("/v1/jobs")) == 403
+
+    boot = lead.send("/v1/acl/bootstrap", {}, method="POST")
+    root = boot["SecretID"]
+    assert boot["Type"] == "management"
+
+    # the minted token rides the raft log: the FOLLOWER resolves it for
+    # its own locally-served reads
+    assert wait_until(
+        lambda: isinstance(follower.get("/v1/jobs", token=root), list),
+        timeout=20), "token did not replicate to the follower"
+
+    # a token-authenticated WRITE against the follower forwards to the
+    # leader with the token intact
+    resp = follower.send("/v1/jobs", {"Job": sleep_job("acl-fwd",
+                                                       count=0)},
+                         token=root)
+    assert resp.get("eval_id"), resp
+    assert "acl-fwd" in {j["ID"] for j in lead.get("/v1/jobs",
+                                                   token=root)}
+    # ...and an anonymous write against the follower is refused LOCALLY
+    # (enforcement happens before forwarding)
+    assert _status(lambda: follower.send(
+        "/v1/jobs", {"Job": sleep_job("acl-anon", count=0)})) == 403
+
+    # scoped client token: read-only policy made on the leader, enforced
+    # by the follower
+    lead.send("/v1/acl/policy/ro", {"Rules": '''
+namespace "default" { policy = "read" }
+node { policy = "read" }
+'''}, token=root)
+    tok = lead.send("/v1/acl/token", {"Name": "ro", "Type": "client",
+                                      "Policies": ["ro"]}, token=root)
+    ro = tok["SecretID"]
+    assert wait_until(
+        lambda: isinstance(follower.get("/v1/jobs", token=ro), list),
+        timeout=20)
+    assert _status(lambda: follower.send(
+        "/v1/jobs", {"Job": sleep_job("acl-ro", count=0)},
+        token=ro)) == 403
+    # second bootstrap is refused cluster-wide
+    assert _status(lambda: lead.send("/v1/acl/bootstrap", {},
+                                     method="POST")) == 403
