@@ -199,7 +199,9 @@ def test_spa_views_reference_only_served_fields(agent):
     for frag in ("async volumes()", "async volume(", "async scaling()",
                  '"#/volumes"', '"#/scaling"', "/volumes?namespace=*",
                  "/plugins", "/scaling/policies?namespace=*",
-                 "WriteClaims", "CurrentReaders", "NodesHealthy"):
+                 "WriteClaims", "CurrentReaders", "NodesHealthy",
+                 # topo-viz refinements: per-job coloring + legend
+                 "jobHue", "legendrow", "AllocatedCPU"):
         assert frag in body, f"SPA missing {frag}"
     # nav links present
     assert re.search(r'href="#/volumes"', body)
