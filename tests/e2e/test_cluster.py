@@ -164,6 +164,13 @@ def test_dead_server_rejoins_and_catches_up(cluster):
 def test_drain_migrates_allocs(cluster):
     """Draining a node migrates its allocs to the surviving node and
     leaves the drained node empty."""
+    # settle after the rejoin test's leader churn before initiating a
+    # drain: stable leadership, ready nodes, and full workload placement
+    assert wait_until(cluster.nodes_ready, timeout=30), _diagnose(cluster)
+    for jid in ("e2e-base", "e2e-reattach"):
+        assert wait_until(
+            lambda: len(cluster.running_allocs(jid)) == 2, timeout=60), \
+            _diagnose(cluster, jid)
     node_of = {}
     for n in cluster.leader().get("/v1/nodes"):
         node_of[n["Name"]] = n["ID"]
