@@ -220,19 +220,28 @@ def cmd_job_stop(args) -> None:
     print(f"==> Evaluation {resp.get('eval_id', '')[:8]} created")
 
 
+def _ann_suffix(d: dict) -> str:
+    """Scheduling-consequence suffix (ref command/job_plan.go: the
+    "(forces create)" renderings of scheduler/annotate.go output)."""
+    ann = d.get("Annotations") or []
+    return f" ({', '.join(ann)})" if ann else ""
+
+
 def _render_field_diffs(fields: list, indent: str,
                         verbose: bool = False) -> None:
     marks = {"Added": "+", "Deleted": "-", "Edited": "+/-", "None": " "}
     for f in fields or []:
         m = marks.get(f["Type"], " ")
+        sfx = _ann_suffix(f)
         if f["Type"] == "Edited":
-            print(f"{indent}{m} {f['Name']}: {f['Old']!r} => {f['New']!r}")
+            print(f"{indent}{m} {f['Name']}: "
+                  f"{f['Old']!r} => {f['New']!r}{sfx}")
         elif f["Type"] == "Added":
-            print(f"{indent}{m} {f['Name']}: {f['New']!r}")
+            print(f"{indent}{m} {f['Name']}: {f['New']!r}{sfx}")
         elif f["Type"] == "Deleted":
-            print(f"{indent}{m} {f['Name']}: {f['Old']!r}")
+            print(f"{indent}{m} {f['Name']}: {f['Old']!r}{sfx}")
         elif verbose:   # Type None: context, shown only under -verbose
-            print(f"{indent}{m} {f['Name']}: {f['New']!r}")
+            print(f"{indent}{m} {f['Name']}: {f['New']!r}{sfx}")
 
 
 def _render_object_diffs(objs: list, indent: str,
@@ -266,7 +275,8 @@ def cmd_job_plan(args) -> None:
             for t in tg.get("Tasks", []):
                 if t["Type"] == "None" and not verbose:
                     continue
-                print(f"    {t['Type']} task {t['Name']!r}")
+                print(f"    {t['Type']} task {t['Name']!r}"
+                      f"{_ann_suffix(t)}")
                 _render_field_diffs(t.get("Fields"), "      ", verbose)
                 _render_object_diffs(t.get("Objects"), "      ", verbose)
     else:
