@@ -14,8 +14,11 @@ import time
 from contextlib import contextmanager
 
 
+RAW_VALUES_CAP = 4096       # per-sample raw-value window for percentiles
+
+
 class _Sample:
-    __slots__ = ("count", "sum", "min", "max", "last")
+    __slots__ = ("count", "sum", "min", "max", "last", "values")
 
     def __init__(self):
         self.count = 0
@@ -23,6 +26,10 @@ class _Sample:
         self.min = float("inf")
         self.max = 0.0
         self.last = 0.0
+        # bounded raw-value window so readers can compute percentiles
+        # (p50 stream batch size, p50 submit latency); list append is
+        # atomic under the GIL, matching the lock-free writer contract
+        self.values: list = []
 
     def add(self, v: float) -> None:
         self.count += 1
@@ -32,6 +39,8 @@ class _Sample:
         if v > self.max:
             self.max = v
         self.last = v
+        if len(self.values) < RAW_VALUES_CAP:
+            self.values.append(v)
 
     def as_dict(self) -> dict:
         mean = self.sum / self.count if self.count else 0.0
@@ -74,6 +83,33 @@ class Registry:
     def timer_sum(self, name: str) -> float:
         s = self.samples.get(name)
         return s.sum if s else 0.0
+
+    def percentile(self, name: str, q: float, skip: int = 0) -> float:
+        """q in [0, 1] over the sample's bounded raw-value window
+        (RAW_VALUES_CAP newest-first is NOT kept — the window holds the
+        first N values, which for bench-length runs is all of them).
+        `skip` drops the first N recorded values, so a caller can window
+        the percentile to samples recorded after a checkpoint (see
+        sample_count)."""
+        s = self.samples.get(name)
+        if s is None or len(s.values) <= skip:
+            return 0.0
+        vals = sorted(s.values[skip:])
+        idx = min(len(vals) - 1, max(0, int(q * len(vals))))
+        return vals[idx]
+
+    def sample_count(self, name: str) -> int:
+        """How many raw values the sample's window holds — the `skip`
+        checkpoint for a later windowed percentile()."""
+        s = self.samples.get(name)
+        return len(s.values) if s else 0
+
+    def ratio(self, num: str, den: str) -> float:
+        """timer_sum(num) / timer_sum(den), 0.0 when the denominator is
+        empty — e.g. phase_overlap_fraction = time the host spent working
+        while device/applier work was in flight, over all host time."""
+        d = self.timer_sum(den)
+        return self.timer_sum(num) / d if d else 0.0
 
     def counter(self, name: str) -> float:
         return self.counters.get(name, 0.0)

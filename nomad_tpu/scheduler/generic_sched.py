@@ -64,6 +64,11 @@ class GenericScheduler:
         self.failed_tg_allocs: dict[str, object] = {}
         self.queued_allocs: dict[str, int] = {}
         self.followup_evals: dict[str, list[Evaluation]] = {}
+        # set by the pipelined placer when an intermediate chunk plan
+        # under-committed (optimistic-concurrency rejection mid-pipeline):
+        # the pass must refresh state and retry, the same contract as a
+        # partial commit of a serial plan
+        self._pipeline_partial = False
 
     # ------------------------------------------------------------- process
 
@@ -103,6 +108,7 @@ class GenericScheduler:
         eval = self.eval
         self.job = self.state.job_by_id(eval.namespace, eval.job_id)
 
+        self._pipeline_partial = False
         self.failed_tg_allocs = {}
         self.queued_allocs = {tg.name: 0 for tg in
                               (self.job.task_groups if self.job else [])}
@@ -150,7 +156,10 @@ class GenericScheduler:
         eval.queued_allocations = dict(self.queued_allocs)
 
         if self.plan.is_no_op():
-            return True
+            # an intermediate pipelined chunk may have under-committed even
+            # when the FINAL plan carries nothing: refresh and retry, the
+            # same contract as a partial commit of a serial plan
+            return not self._pipeline_partial
 
         if self.plan.annotations is not None:
             # resolved now that placement filled the plan (ref
@@ -170,7 +179,11 @@ class GenericScheduler:
                 return False
             # progress was made; retry for the rest
             return False
-        return True
+        # the final plan committed fully, but a pipelined intermediate
+        # chunk may have been rejected by the applier's latest-state
+        # re-check: those placements never landed, so refresh and retry
+        # exactly as a serial partial commit would
+        return not self._pipeline_partial
 
     # ----------------------------------------------------- compute allocs
 

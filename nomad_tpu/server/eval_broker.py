@@ -6,6 +6,12 @@ later evals for the same job wait in a pending map (dedup, ref
 eval_broker.go:182 Enqueue); nacked evals requeue with escalating delay;
 wait_until evals sit in a delay heap served by a timer thread
 (ref :758 runDelayedEvalsWatcher).
+
+The broker is also the eval-stream micro-batcher's concurrency oracle:
+every dequeue/ack/nack pushes the outstanding-eval count to
+solver/microbatch.py, so a worker's small solve knows whether sibling
+evals are in flight (worth waiting the coalescing window for) before the
+siblings have even reached their own solve call.
 """
 from __future__ import annotations
 
@@ -57,6 +63,16 @@ class EvalBroker:
         self.stats = {"total_ready": 0, "total_unacked": 0,
                       "total_pending": 0, "total_waiting": 0}
 
+    def _notify_inflight(self) -> None:
+        """Push the outstanding-eval count to the solver micro-batcher
+        (its coalescing oracle). Lazy import: the broker must not drag
+        jax in; a stripped build without the solver is a no-op."""
+        try:
+            from ..solver import microbatch
+        except ImportError:
+            return
+        microbatch.broker_in_flight(self.stats["total_unacked"])
+
     # ------------------------------------------------------------- control
 
     def set_enabled(self, enabled: bool) -> None:
@@ -86,6 +102,8 @@ class EvalBroker:
         self._dequeue_count.clear()
         self._delay_heap = []
         self._shutdown = True
+        self.stats["total_unacked"] = 0
+        self._notify_inflight()
 
     # ------------------------------------------------------------- enqueue
 
@@ -151,6 +169,7 @@ class EvalBroker:
                     return None, ""
                 best = self._pick_locked(schedulers)
                 if best is not None:
+                    self._notify_inflight()
                     return best
                 if deadline is not None:
                     remaining = deadline - time.time()
@@ -234,6 +253,7 @@ class EvalBroker:
             requeue = rec.get("requeue")
             if requeue is not None:
                 self._enqueue_locked(requeue)
+            self._notify_inflight()
             self._cond.notify_all()
 
     def nack(self, eval_id: str, token: str) -> None:
@@ -264,6 +284,7 @@ class EvalBroker:
                 heapq.heappush(self._delay_heap,
                                (time.time() + delay, next(self._seq), ev))
                 self.stats["total_waiting"] += 1
+            self._notify_inflight()
             self._cond.notify_all()
 
     # -------------------------------------------------------- delay watcher

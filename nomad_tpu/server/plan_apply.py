@@ -288,3 +288,12 @@ class Planner:
         if err:
             return None
         return result
+
+    def submit_plan_async(self, plan: Plan) -> _PendingPlan:
+        """Enqueue without blocking (the pipelined plan lifecycle): the
+        applier thread evaluates and commits in queue order while the
+        caller keeps materializing later chunks; callers resolve the
+        returned pending before submitting anything that must order
+        after it."""
+        metrics.incr("nomad.plan.queue_depth_async")
+        return self.queue.enqueue(plan)

@@ -107,6 +107,21 @@ class Worker:
                 pass
         return result
 
+    def submit_plan_async(self, plan: Plan):
+        """Pipelined plan lifecycle: enqueue an intermediate chunk plan on
+        the serial applier WITHOUT waiting for the result — the scheduler
+        overlaps the next chunk's solve/materialize with this commit (ref
+        plan_apply.go:71, where evaluation overlaps the previous raft
+        commit). Returns the queue's pending handle; the placer resolves
+        every pending before the eval's final plan is submitted, so commit
+        order and the refresh-after-rejection contract are preserved."""
+        plan.eval_token = self._eval_token
+        plan.snapshot_index = max(plan.snapshot_index,
+                                  self._snapshot.latest_index()
+                                  if self._snapshot else 0)
+        metrics.incr("nomad.worker.submit_plan_async")
+        return self.server.planner.submit_plan_async(plan)
+
     def update_eval(self, ev: Evaluation) -> None:
         """ref worker.go:640 UpdateEval"""
         ev = ev.copy()

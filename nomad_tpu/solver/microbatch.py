@@ -1,0 +1,208 @@
+"""Eval-stream micro-batching: coalesce small depth solves into one
+padded batched accelerator dispatch (the tentpole of PR 1; CvxCluster /
+Tesserae's observation that batching many small placement solves into one
+device program is where the accelerator win lives).
+
+On a remote-attached TPU a 1k-task eval's solve is latency-bound: the
+dispatch round trip (~65ms under the axon tunnel) dwarfs the compute, so
+the backend selector historically pinned small solves to the host tier —
+and the 1k-eval stream never touched the chip. With several scheduler
+workers in flight the right move is different: the FIRST pending solve
+waits a short window (SchedulerConfiguration.eval_batch_window_ms, hot-
+reloadable) for siblings, the batch is padded to a fixed lane count and
+dispatched as ONE jit(vmap(fill_depth)) program on the default device,
+and each worker gets its own row of the result back. K evals then share
+one round trip instead of paying K of them.
+
+Shape discipline (one compiled artifact, ever):
+  * requests group by (array shapes, k_max, spread_algorithm, depth_grid)
+    — mixed-shape requests form separate batches;
+  * every dispatched batch is padded to exactly LANES rows (count=0
+    clones of row 0 — a zero ask places nothing), so the executable
+    compiles once per request-shape, not once per batch size;
+  * a batch of ONE falls back to the host tier inline (no round trip, no
+    window amortization to be had) — solo evals keep host-tier latency.
+
+Coalescing only engages when >1 eval is actually in flight; a lone eval
+never sleeps on the window. Two in-flight signals feed that decision:
+`eval_started`/`eval_finished` from the placer (evals currently inside
+compute_placements) and `broker_in_flight` from the server's eval broker
+(evals dequeued-but-unacked — visible BEFORE a sibling reaches its own
+solve call, so the first solve of a burst waits for siblings that are
+still in reconcile).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..metrics import metrics
+
+LANES = 8                   # fixed batch padding (one compiled artifact)
+FOLLOWER_TIMEOUT = 120.0    # follower safety valve if a leader dies
+
+
+class _Request:
+    __slots__ = ("args", "event", "out", "err")
+
+    def __init__(self, args: tuple):
+        self.args = args
+        self.event = threading.Event()
+        self.out: Optional[np.ndarray] = None
+        self.err: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: dict[tuple, list[_Request]] = {}
+        self._window_s = 0.008
+        self._enabled = True
+        self._active_evals = 0
+        self._broker_hint = 0
+        self._vmapped: dict[tuple, Callable] = {}
+
+    # ------------------------------------------------------- configuration
+
+    def configure(self, enabled: bool, window_s: float) -> None:
+        """Called by the placer from the CURRENT SchedulerConfiguration on
+        every eval — the knob hot-reloads through the same raft-replicated
+        config path as the SchedulerAlgorithm enum."""
+        self._enabled = bool(enabled)
+        self._window_s = max(0.0, float(window_s))
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def window_s(self) -> float:
+        return self._window_s
+
+    # ------------------------------------------------- eval in-flight hints
+
+    def eval_started(self) -> None:
+        with self._lock:
+            self._active_evals += 1
+
+    def eval_finished(self) -> None:
+        with self._lock:
+            self._active_evals = max(0, self._active_evals - 1)
+
+    def broker_in_flight(self, n: int) -> None:
+        """The eval broker's outstanding (dequeued, unacked) eval count —
+        pushed on every dequeue/ack/nack. Int store is atomic under the
+        GIL; no lock on the broker's hot path."""
+        self._broker_hint = max(0, int(n))
+
+    def concurrency(self) -> int:
+        """Best-known count of evals that might still issue a solve."""
+        return max(self._active_evals, self._broker_hint)
+
+    # -------------------------------------------------------------- solving
+
+    def solve(self, static_key: tuple, inner, host_fn, args: tuple
+              ) -> np.ndarray:
+        """One normalized depth solve. Blocks until the result is ready;
+        the calling worker thread may be elected batch leader and execute
+        the whole coalesced dispatch."""
+        # None marks an absent optional arg (e.g. no affinities); it must
+        # not collide with a scalar's () shape, or a mixed batch would
+        # stack None rows into a scalar column
+        key = static_key + tuple(
+            None if a is None else getattr(a, "shape", ()) for a in args)
+        solo = False
+        with self._lock:
+            if self.concurrency() <= 1:
+                # nothing to coalesce with: host tier, zero added latency
+                solo = True
+            else:
+                q = self._queues.setdefault(key, [])
+                req = _Request(args)
+                q.append(req)
+                leader = len(q) == 1
+        if solo:
+            metrics.incr("nomad.solver.microbatch.solo")
+            return np.asarray(host_fn(*args))
+
+        if leader:
+            # collect siblings for one window, then drain and dispatch
+            time.sleep(self._window_s)
+            with self._lock:
+                batch = self._queues.pop(key, [])
+            try:
+                self._run_batch(static_key, inner, host_fn, batch)
+            except BaseException as e:   # noqa: BLE001 — fan the error out
+                for r in batch:
+                    if r.err is None and r.out is None:
+                        r.err = e
+                        r.event.set()
+                raise
+        else:
+            req.event.wait(self._window_s + FOLLOWER_TIMEOUT)
+        if req.err is not None:
+            raise req.err
+        if req.out is None:
+            raise RuntimeError("microbatch leader never delivered a result")
+        return req.out
+
+    def _run_batch(self, static_key: tuple, inner, host_fn,
+                   batch: list[_Request]) -> None:
+        if not batch:
+            return
+        if len(batch) == 1:
+            # window expired with no siblings: host tier, as if solo
+            metrics.incr("nomad.solver.microbatch.solo")
+            batch[0].out = np.asarray(host_fn(*batch[0].args))
+            batch[0].event.set()
+            return
+        metrics.incr("nomad.solver.microbatch.dispatches")
+        metrics.add_sample("nomad.solver.microbatch.size", len(batch))
+        for start in range(0, len(batch), LANES):
+            self._dispatch(static_key, inner, batch[start:start + LANES])
+
+    def _dispatch(self, static_key: tuple, inner,
+                  lanes: list[_Request]) -> None:
+        from .tensorize import stack_lanes
+        # pad to the fixed lane count with count=0 clones of lane 0 —
+        # arg 3 of the normalized depth signature is `count`; zero places
+        # nothing, so padding rows are inert
+        pad = lanes[0].args
+        pad = pad[:3] + (np.int32(0),) + pad[4:]
+        cols = stack_lanes([r.args for r in lanes], pad, LANES)
+        fn = self._batched_fn(static_key, inner)
+        out = np.asarray(fn(*cols))
+        for row, req in enumerate(lanes):
+            req.out = np.array(out[row])
+            req.event.set()
+
+    def _batched_fn(self, static_key: tuple, inner):
+        fn = self._vmapped.get(static_key)
+        if fn is None:
+            import jax
+            fn = self._vmapped[static_key] = jax.jit(jax.vmap(inner))
+        return fn
+
+    def reset(self) -> None:
+        """Tests: drop compiled artifacts and queues."""
+        with self._lock:
+            self._queues.clear()
+            self._vmapped.clear()
+            self._active_evals = 0
+            self._broker_hint = 0
+
+
+_batcher = MicroBatcher()
+
+# module-level forwarding API (the backend selector and placer import
+# these; one process-wide batcher matches the one-device reality)
+configure = _batcher.configure
+enabled = _batcher.enabled
+window_s = _batcher.window_s
+eval_started = _batcher.eval_started
+eval_finished = _batcher.eval_finished
+broker_in_flight = _batcher.broker_in_flight
+concurrency = _batcher.concurrency
+solve = _batcher.solve
+reset = _batcher.reset

@@ -8,10 +8,25 @@ Division of labor (SURVEY.md hard parts 2-3):
   * host: exact sequential resources for the chosen nodes only — ports via
     NetworkIndex, device instances, cpuset cores — with per-node retry; any
     node the exact pass rejects is masked and re-solved.
+
+Pipelined plan lifecycle (PR 1 tentpole; ref nomad/plan_apply.go:71-177,
+where the applier overlaps plan evaluation with the previous raft commit):
+large simple evals split their solve into chunks whose device dispatches
+are all enqueued asynchronously up front — chunk N+1's solve consumes
+chunk N's placements through a device-side usage update, so the chip is
+never idle while the host materializes, evaluates, and commits chunk N
+through the real serial applier. Each chunk is a real Plan carrying the
+eval's snapshot index; the applier's per-node re-check against latest
+state runs per chunk, so optimistic-concurrency rejections surface
+exactly as on the serial path (a partially-committed chunk flags the
+eval for the standard refresh-and-retry). `plan_pipeline_enabled=False`
+(or NOMAD_PLAN_PIPELINE=0) forces the serial path.
 """
 from __future__ import annotations
 
+import os
 import random
+import time
 
 import numpy as np
 import jax.numpy as jnp
@@ -19,14 +34,49 @@ import jax.numpy as jnp
 from ..metrics import metrics
 from ..structs import (
     AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
-    Allocation, AllocDeploymentStatus, NetworkIndex,
+    Allocation, AllocDeploymentStatus, NetworkIndex, Plan,
     new_id, new_ids,
 )
 from ..scheduler.stack import SelectOptions
-from . import backend
+from . import backend, microbatch
 from .tensorize import (
     build_group_tensors, _lower_affinities, _lower_distinct, _lower_spreads,
 )
+
+_usage_update_fn = None
+
+
+def _usage_update(used, coll, placed, ask):
+    """(used', coll') = (used + placed ⊗ ask, coll + placed) on the
+    solve's device — the exact mirror of what materializing chunk N
+    commits host-side (utilization AND same-job collision counts, the
+    anti-affinity input), so chunk N+1's solve scores post-chunk-N state
+    without a host round trip."""
+    global _usage_update_fn
+    if _usage_update_fn is None:
+        import jax
+        _usage_update_fn = jax.jit(lambda u, c, p, a: (
+            u + p[:, None].astype(jnp.float32) * a[None, :],
+            c + p.astype(jnp.int32)))
+    return _usage_update_fn(used, coll, placed, ask)
+
+
+def _in_flight(x) -> bool:
+    """True while an async-dispatched device result is still computing."""
+    try:
+        return not x.is_ready()
+    except Exception:                    # noqa: BLE001 — numpy / old jax
+        return False
+
+
+class _SolvePrep:
+    """Per-(eval, TG) solve setup shared by the serial and pipelined
+    paths: shuffled+padded tensors, kernel routing, and the depth-regime
+    parameters (computed from the TOTAL count, so a chunked solve uses
+    the same compiled artifact and regime as the one-shot solve)."""
+    __slots__ = ("gt", "n", "count", "use_scan", "use_depth", "k_max",
+                 "sp", "dp", "aff", "max_per_node", "spread_alg",
+                 "depth_grid", "jitter", "bias_g", "m")
 
 
 class SolverPlacer:
@@ -37,6 +87,22 @@ class SolverPlacer:
         self.plan = sched.plan
 
     def compute_placements(self, destructive, place) -> bool:
+        cfg = self.ctx.scheduler_config
+        # hot-reload the stream-coalescing knobs from the raft-replicated
+        # scheduler config (same path as the SchedulerAlgorithm enum) and
+        # mark this eval in flight so concurrent small solves can find
+        # each other in the micro-batcher
+        microbatch.configure(
+            enabled=(getattr(cfg, "eval_batch_enabled", True)
+                     and os.environ.get("NOMAD_EVAL_BATCH", "") != "0"),
+            window_s=getattr(cfg, "eval_batch_window_ms", 8.0) / 1000.0)
+        microbatch.eval_started()
+        try:
+            return self._compute_placements(destructive, place)
+        finally:
+            microbatch.eval_finished()
+
+    def _compute_placements(self, destructive, place) -> bool:
         sched = self.sched
         from ..scheduler.reconcile import AllocPlaceResult
 
@@ -74,29 +140,38 @@ class SolverPlacer:
         nodes = sched._ready_nodes
         for tg_name, missings in by_tg.items():
             tg = sched.job.lookup_task_group(tg_name)
-            with metrics.measure("nomad.solver.solve"):
-                placed_map = self._solve_group(tg, nodes, len(missings))
-            node_iter = [(node, k) for node, k in placed_map if k > 0]
-            # TGs with no sequential resources (ports/devices/cores) need no
-            # per-alloc exact pass: stamp out the allocations in one batch
-            # with shared (immutable-by-convention) resource/metric objects
-            with metrics.measure("nomad.solver.materialize"):
-                if node_iter and self._is_simple(tg):
-                    mi = self._place_batch_simple(missings, tg, node_iter,
+            mi = -1
+            if self._pipeline_eligible(tg, missings, by_tg, leftovers):
+                pipelined = self._pipelined_place(tg, nodes, missings,
                                                   deployment_id)
-                else:
-                    # expand per-node counts into concrete allocations
-                    mi = 0
-                    for node, k in node_iter:
-                        for _ in range(int(k)):
-                            if mi >= len(missings):
-                                break
-                            missing = missings[mi]
-                            if self._place_one(missing, tg, node,
-                                               deployment_id):
-                                mi += 1
-                            else:
-                                break  # node rejected exact assignment
+                if pipelined is not None:
+                    mi = pipelined
+            if mi < 0:           # serial path (ineligible or scan-shaped)
+                with metrics.measure("nomad.solver.solve"):
+                    placed_map = self._solve_group(tg, nodes, len(missings))
+                node_iter = [(node, k) for node, k in placed_map if k > 0]
+                # TGs with no sequential resources (ports/devices/cores)
+                # need no per-alloc exact pass: stamp out the allocations
+                # in one batch with shared (immutable-by-convention)
+                # resource/metric objects
+                with metrics.measure("nomad.solver.materialize"):
+                    if node_iter and self._is_simple(tg):
+                        mi = self._place_batch_simple(missings, tg,
+                                                      node_iter,
+                                                      deployment_id)
+                    else:
+                        # expand per-node counts into concrete allocations
+                        mi = 0
+                        for node, k in node_iter:
+                            for _ in range(int(k)):
+                                if mi >= len(missings):
+                                    break
+                                missing = missings[mi]
+                                if self._place_one(missing, tg, node,
+                                                   deployment_id):
+                                    mi += 1
+                                else:
+                                    break  # node rejected exact assignment
             rest = missings[mi:]
             if rest:
                 # capacity exhausted: batched preemption pass (masked
@@ -125,19 +200,14 @@ class SolverPlacer:
 
     # ------------------------------------------------------------- solving
 
-    def _solve_group(self, tg, nodes, count: int):
-        """Run the batched kernel; returns [(node, count)] sorted best-first.
-
-        The full GenericStack feature matrix is tensorized: affinities,
-        multiple/targeted/negative spreads, distinct_property and
-        distinct_hosts all lower to kernel inputs (VERDICT r1 next #2).
-        Documented host-path exceptions (handled in compute_placements by
-        routing to `leftovers`): reschedules/migrations (per-alloc
-        previous-node penalty state) and canaries (per-alloc preferred
-        nodes) — both are small by construction (failed allocs, canary
-        counts), so the per-alloc stack cost is bounded."""
+    def _prep_solve(self, tg, nodes, count: int):
+        """Everything a depth/greedy/scan solve needs BEFORE the kernel
+        call: shuffled node order, lowered+padded tensors, kernel routing
+        and the depth-regime parameters. Shared verbatim by the serial
+        and pipelined paths so chunking cannot change regime selection,
+        RNG consumption order, or compiled artifacts."""
         if not nodes or count == 0:
-            return []
+            return None
         job = self.sched.job
 
         # shuffle the node axis (the RandomIterator analog, ref
@@ -218,11 +288,20 @@ class SolverPlacer:
                                 constant_values=-1)
             if aff is not None:
                 aff = np.pad(aff, (0, pad))
-        max_per_node = 1 if gt.distinct_hosts else 2 ** 30
-        metrics.incr(
-            "nomad.solver.kernel.place_chunked" if use_scan
-            else "nomad.solver.kernel.fill_depth" if use_depth
-            else "nomad.solver.kernel.fill_greedy_binpack")
+        prep = _SolvePrep()
+        prep.gt = gt
+        prep.n = n
+        prep.count = count
+        prep.use_scan = use_scan
+        prep.use_depth = use_depth
+        prep.k_max = k_max
+        prep.sp, prep.dp, prep.aff = sp, dp, aff
+        prep.max_per_node = 1 if gt.distinct_hosts else 2 ** 30
+        prep.spread_alg = spread_alg
+        prep.depth_grid = None
+        prep.jitter = None
+        prep.bias_g = 1.0
+        prep.m = 0.0
         if use_depth:
             # per-eval order jitter: the worker-decorrelation analog of
             # the host stack's 2-way sampling (see fill_depth). With
@@ -253,14 +332,14 @@ class SolverPlacer:
             # jitter_samples<=0 with a traced where, so the deterministic
             # and jittered regimes share one compiled artifact
             rng = np.random.default_rng(random.getrandbits(64))
-            jitter = rng.random(gt.cap.shape[0], dtype=np.float32)
-            depth_grid = None
+            prep.jitter = rng.random(gt.cap.shape[0], dtype=np.float32)
             if affinities or m > 3.0:
-                bias_g = 1.0
-                m = 0.0
+                prep.bias_g = 1.0
+                prep.m = 0.0
             else:
-                bias_g = float(np.clip((width - 1.0) + max(m - 1.0, 0.0),
-                                       1.0, 8.0))
+                prep.bias_g = float(np.clip(
+                    (width - 1.0) + max(m - 1.0, 0.0), 1.0, 8.0))
+                prep.m = m
                 # jittered regime: the take is capped at ceil(m)+1 (<= 4)
                 # but the density RANKING must stay full-depth (a
                 # truncated curve doubles concurrent plan rejections) —
@@ -270,21 +349,52 @@ class SolverPlacer:
                 # (m, affinities), so each regime is its own compiled
                 # artifact — warm both (bench does).
                 from .kernels import DEPTH_GRID
-                depth_grid = tuple(g for g in DEPTH_GRID if g <= k_max) \
-                    or (1,)
+                prep.depth_grid = tuple(
+                    g for g in DEPTH_GRID if g <= k_max) or (1,)
+        return prep
+
+    def _depth_solve_args(self, prep, tg, count):
+        """The normalized depth-kernel positional args for `count`
+        instances — shared by the one-shot and chunked dispatch sites.
+        Inputs stay numpy (uncommitted): each tier's jit places them on
+        ITS device — pre-committing to the default device would drag
+        host-tier solves back to the accelerator."""
+        gt = prep.gt
+        return (gt.cap, gt.used, gt.ask, np.int32(count), gt.feasible,
+                gt.job_collisions, np.int32(tg.count), prep.aff,
+                np.int32(prep.max_per_node), prep.jitter,
+                np.float32(prep.bias_g), np.float32(prep.m))
+
+    def _solve_group(self, tg, nodes, count: int):
+        """Run the batched kernel; returns [(node, count)] sorted best-first.
+
+        The full GenericStack feature matrix is tensorized: affinities,
+        multiple/targeted/negative spreads, distinct_property and
+        distinct_hosts all lower to kernel inputs (VERDICT r1 next #2).
+        Documented host-path exceptions (handled in compute_placements by
+        routing to `leftovers`): reschedules/migrations (per-alloc
+        previous-node penalty state) and canaries (per-alloc preferred
+        nodes) — both are small by construction (failed allocs, canary
+        counts), so the per-alloc stack cost is bounded."""
+        prep = self._prep_solve(tg, nodes, count)
+        if prep is None:
+            return []
+        gt = prep.gt
+        use_scan, use_depth = prep.use_scan, prep.use_depth
+        sp, dp, aff = prep.sp, prep.dp, prep.aff
+        spread_alg, max_per_node = prep.spread_alg, prep.max_per_node
+        n = prep.n
+        distincts = self._distinct_property_sets(tg)
+        metrics.incr(
+            "nomad.solver.kernel.place_chunked" if use_scan
+            else "nomad.solver.kernel.fill_depth" if use_depth
+            else "nomad.solver.kernel.fill_greedy_binpack")
+        if use_depth:
             bname, depth_fn = backend.select(
-                "depth", gt.cap.shape[0], count=count, k_max=k_max,
-                spread_algorithm=spread_alg, depth_grid=depth_grid)
+                "depth", gt.cap.shape[0], count=count, k_max=prep.k_max,
+                spread_algorithm=spread_alg, depth_grid=prep.depth_grid)
             backend.record("depth", bname)
-            # inputs stay numpy (uncommitted): each tier's jit places
-            # them on ITS device — pre-committing to the default device
-            # would drag host-tier solves back to the accelerator
-            placed = depth_fn(
-                gt.cap, gt.used, gt.ask, np.int32(count),
-                gt.feasible, gt.job_collisions,
-                np.int32(tg.count), aff,
-                np.int32(max_per_node), jitter,
-                np.float32(bias_g), np.float32(m))
+            placed = depth_fn(*self._depth_solve_args(prep, tg, count))
         elif use_scan:
             # one solve covers max_steps * k instances; split larger asks
             # across repeated solves, feeding the running state (usage,
@@ -353,6 +463,181 @@ class SolverPlacer:
                 placed[i] = allowed
         order = np.argsort(-placed)
         return [(gt.nodes[i], int(placed[i])) for i in order if placed[i] > 0]
+
+    # ------------------------------------------------ pipelined lifecycle
+
+    def _pipeline_knobs(self) -> tuple[bool, int, int]:
+        """-> (enabled, chunks, min_count) from the hot-reloadable
+        scheduler config; NOMAD_PLAN_PIPELINE=0/1 force-overrides.
+        getattr defaults keep restored pre-knob config snapshots valid."""
+        cfg = self.ctx.scheduler_config
+        enabled = bool(getattr(cfg, "plan_pipeline_enabled", True))
+        env = os.environ.get("NOMAD_PLAN_PIPELINE", "")
+        if env == "0":
+            enabled = False
+        elif env == "1":
+            enabled = True
+        # chunks=1 is honored as "stay serial" (validated as >= 1): a
+        # one-chunk pipeline would commit nothing early, so the serial
+        # path is the same behavior without the chunk bookkeeping
+        chunks = max(1, int(getattr(cfg, "plan_pipeline_chunks", 4)))
+        min_count = max(0, int(getattr(cfg, "plan_pipeline_min_count",
+                                       8192)))
+        return enabled and chunks >= 2, chunks, min_count
+
+    def _pipeline_eligible(self, tg, missings, by_tg, leftovers) -> bool:
+        """The pipelined lifecycle commits intermediate chunk plans while
+        the eval is still running, so it only engages where that is
+        provably equivalent to one big plan: a single simple task group
+        whose plan carries nothing but these placements (no stops,
+        updates, preemptions, deployments, annotations, all_at_once)."""
+        enabled, _, min_count = self._pipeline_knobs()
+        if not enabled or len(by_tg) != 1 or leftovers:
+            return False
+        if len(missings) < min_count or not self._is_simple(tg):
+            return False
+        plan = self.plan
+        if plan.all_at_once or plan.annotations is not None:
+            return False
+        if plan.node_update or plan.node_allocation or plan.node_preemptions:
+            return False
+        if plan.deployment is not None or plan.deployment_updates:
+            return False
+        if self.sched.deployment is not None:
+            return False
+        return True
+
+    def _pipelined_place(self, tg, nodes, missings, deployment_id: str):
+        """Chunked solve + per-chunk materialize/evaluate/commit with all
+        device dispatches enqueued asynchronously up front. Returns the
+        number of missings placed, or None to fall back to the serial
+        path (scan-shaped solves, degenerate preps).
+
+        Timeline for C chunks (device work ▓, host work ░):
+
+            device  ▓1▓▓2▓▓3▓▓4▓            (async queue, usage fed fwd)
+            placer      ░mat 1░░mat 2░...    (materialize chunk N)
+            applier       ░eval+commit 1░... (serial applier thread)
+
+        Chunk N+1's solve consumes chunk N's placements via a device-side
+        usage update, which is exactly what committing chunk N does to
+        the dense usage index — so per-chunk re-checks see no self-
+        conflicts, and any CONCURRENT writer landing between chunk
+        commits is caught by the applier's latest-state re-check exactly
+        as on the serial path (the eval then refreshes and retries, ref
+        plan_apply.go:638)."""
+        sched = self.sched
+        count = len(missings)
+        _, n_chunks, _ = self._pipeline_knobs()
+        with metrics.measure("nomad.solver.solve"):
+            prep = self._prep_solve(tg, nodes, count)
+            # deterministic full-curve depth solves only: the jittered
+            # sampled-grid regime caps each SOLVE's per-node take at
+            # ceil(m)+1, so C chunked solves could stack C times that cap
+            # onto the jitter-favored nodes — not behavior-identical to
+            # the one-shot take. Large evals (the pipeline's target) are
+            # deterministic-regime by construction (m > 3). distinct_hosts
+            # is the same failure shape: max_per_node=1 binds per SOLVE,
+            # so C chunks could land C same-job instances on one node
+            # (the fed-forward collision count is only a soft penalty) —
+            # stay serial. distinct_property never gets here (scan-shaped).
+            if prep is None or not prep.use_depth or \
+                    prep.depth_grid is not None or prep.gt.distinct_hosts:
+                return None
+            metrics.incr("nomad.solver.kernel.fill_depth")
+            bname, depth_fn = backend.select(
+                "depth", prep.gt.cap.shape[0], count=count,
+                k_max=prep.k_max, spread_algorithm=prep.spread_alg,
+                depth_grid=prep.depth_grid)
+            backend.record("depth", bname)
+            # async dispatch of every chunk: jax returns futures, the
+            # device queue runs them back to back while the host turns
+            # earlier chunks into plans and commits
+            base = count // n_chunks
+            chunk_counts = [base + (1 if i < count % n_chunks else 0)
+                            for i in range(n_chunks)]
+            chunk_counts = [c for c in chunk_counts if c > 0]
+            futs = []
+            args = self._depth_solve_args(prep, tg, count)
+            used_cur = prep.gt.used
+            coll_cur = prep.gt.job_collisions
+            for ci, ccount in enumerate(chunk_counts):
+                a = (args[0], used_cur, args[2], np.int32(ccount),
+                     args[4], coll_cur) + args[6:]
+                placed = depth_fn(*a)
+                futs.append(placed)
+                if ci < len(chunk_counts) - 1:
+                    used_cur, coll_cur = _usage_update(
+                        used_cur, coll_cur, placed, prep.gt.ask)
+        # host side of the pipeline: ids/names/shared objects are built
+        # while chunk 1 is still in flight on the device
+        host_t0 = time.perf_counter()
+        shared, ids, names, prev_ids = self._prepare_stamp(
+            missings, tg, deployment_id)
+        plan = self.plan
+        submit_async = getattr(sched.planner, "submit_plan_async", None)
+        pendings = []            # (chunk_plan, pending) in submit order
+        results = []             # (chunk_plan, result) once resolved
+        last_fut = futs[-1]
+        last_pending = None
+        prep_s = time.perf_counter() - host_t0
+        metrics.add_sample("nomad.plan.pipeline.host", prep_s)
+        if _in_flight(last_fut):
+            metrics.add_sample("nomad.plan.pipeline.overlap", prep_s)
+        mi = 0
+        for ci, fut in enumerate(futs):
+            with metrics.measure("nomad.solver.solve"):
+                placed = np.array(np.asarray(fut)[:prep.n])
+            host_t0 = time.perf_counter()
+            solves_behind = ci < len(futs) - 1 and _in_flight(last_fut)
+            is_last = ci == len(futs) - 1
+            order = np.argsort(-placed)
+            node_iter = [(prep.gt.nodes[i], int(placed[i]))
+                         for i in order if placed[i] > 0]
+            target = plan.node_allocation if is_last else {}
+            with metrics.measure("nomad.solver.materialize"):
+                mi = self._stamp_slice(shared, ids, names, prev_ids,
+                                       node_iter, mi, len(missings), target)
+            if not is_last and target:
+                cplan = Plan(eval_id=plan.eval_id,
+                             eval_token=plan.eval_token,
+                             priority=plan.priority, job=plan.job,
+                             snapshot_index=plan.snapshot_index)
+                cplan.node_allocation = target
+                if submit_async is not None:
+                    last_pending = submit_async(cplan)
+                    pendings.append((cplan, last_pending))
+                else:
+                    results.append((cplan, sched.planner.submit_plan(cplan)))
+            applier_behind = (last_pending is not None
+                              and not last_pending.event.is_set())
+            host_s = time.perf_counter() - host_t0
+            metrics.add_sample("nomad.plan.pipeline.host", host_s)
+            if solves_behind or applier_behind:
+                metrics.add_sample("nomad.plan.pipeline.overlap", host_s)
+        metrics.incr("nomad.plan.pipeline.evals")
+        metrics.incr("nomad.plan.pipeline.chunks", len(futs))
+        # collect every async chunk result BEFORE returning: the eval's
+        # final plan is submitted by the normal path, which in test shims
+        # may apply inline — commit order must stay chunk 1..C-1, final
+        for cplan, pending in pendings:
+            result, err = pending.wait(60.0)
+            results.append((cplan, None if err else result))
+        partial = False
+        for cplan, result in results:
+            if result is None:
+                partial = True
+                continue
+            full, _, _ = result.full_commit(cplan)
+            if not full:
+                partial = True
+        if partial:
+            # a chunk under-committed (concurrent writer won a node, or a
+            # submit failed): flag the eval so _process refreshes state
+            # and retries the remainder — the serial path's partial-
+            # commit semantics, applied per chunk
+            sched._pipeline_partial = True
+        return mi
 
     def _distinct_property_sets(self, tg):
         """PropertySets for every distinct_property constraint in scope
@@ -552,16 +837,11 @@ class SolverPlacer:
                 return False
         return True
 
-    def _place_batch_simple(self, missings, tg, node_iter,
-                            deployment_id: str) -> int:
-        """Stamp out allocations for solver placement counts in one pass.
-
-        All instances of a TG are identical, so they share ONE
-        AllocatedResources and ONE metrics object (immutable by convention —
-        the same sharing the Go reference gets from pointers into state).
-        50k-alloc materialization drops from ~6s of per-alloc NetworkIndex/
-        DeviceAllocator setup to a tight object loop (VERDICT r1 next #1).
-        """
+    def _prepare_stamp(self, missings, tg, deployment_id: str):
+        """Placed-independent stamping inputs for a TG's placements —
+        shared resource/metrics objects plus batch-minted ids and name
+        columns. Built once per TG; the pipelined path builds them while
+        the first chunk's solve is still in flight on the device."""
         from ..scheduler.reconcile import AllocPlaceResult
         sched = self.sched
         oversub = self.ctx.scheduler_config.memory_oversubscription_enabled
@@ -575,16 +855,12 @@ class SolverPlacer:
                 tr.memory_max_mb = task.resources.memory_max_mb
             total.tasks[task.name] = tr
         metrics_obj = self.ctx.metrics.copy()
-        node_allocation = self.plan.node_allocation
-
-        # Batch stamping (VERDICT r3 #2): ids are minted in one batch (one
-        # getrandom syscall), the node columns are materialized as flat
-        # per-index lists, and the Allocation objects are stamped by the
-        # native extension (structs/fastbatch.py, native/allocstamp.c) —
-        # slot stores through pre-resolved descriptors instead of 50k
-        # dataclass __init__ frames. All instances share the resource /
-        # metrics / default objects (immutable by convention — the state
-        # store's update paths copy before mutating).
+        shared = {"namespace": sched.eval.namespace,
+                  "eval_id": sched.eval.id,
+                  "job_id": sched.eval.job_id, "job": self.plan.job,
+                  "task_group": tg.name, "allocated_resources": total,
+                  "metrics": metrics_obj,
+                  "deployment_id": deployment_id}
         n_missing = len(missings)
         ids = new_ids(n_missing)
         names = [None] * n_missing
@@ -595,30 +871,42 @@ class SolverPlacer:
             else:
                 names[i] = missing.place_name
                 prev_ids[i] = missing.stop_alloc.id
+        return shared, ids, names, prev_ids
+
+    def _stamp_slice(self, shared, ids, names, prev_ids, node_iter,
+                     mi: int, n_missing: int, node_allocation: dict) -> int:
+        """Stamp allocations for `node_iter` placement counts, consuming
+        missings[mi:] and merging into a plan-shaped node_allocation dict.
+        Returns the new mi. Batch stamping (VERDICT r3 #2): ids are minted
+        in one batch (one getrandom syscall), the node columns are
+        materialized as flat per-index lists, and the Allocation objects
+        are stamped by the native extension (structs/fastbatch.py,
+        native/allocstamp.c) — slot stores through pre-resolved
+        descriptors instead of 50k dataclass __init__ frames. All
+        instances share the resource / metrics / default objects
+        (immutable by convention — the state store's update paths copy
+        before mutating)."""
+        start = mi
         node_ids: list[str] = []
         node_names: list[str] = []
         slices: list[tuple[str, int, int]] = []
-        mi = 0
         for node, k in node_iter:
             if mi >= n_missing:
                 break
             take = min(int(k), n_missing - mi)
-            slices.append((node.id, mi, mi + take))
+            slices.append((node.id, mi - start, mi - start + take))
             node_ids.extend([node.id] * take)
             node_names.extend([node.name] * take)
             mi += take
+        if mi == start:
+            return mi
         from ..structs.fastbatch import stamp_batch
         allocs = stamp_batch(
-            Allocation, mi,
-            shared={"namespace": sched.eval.namespace,
-                    "eval_id": sched.eval.id,
-                    "job_id": sched.eval.job_id, "job": self.plan.job,
-                    "task_group": tg.name, "allocated_resources": total,
-                    "metrics": metrics_obj,
-                    "deployment_id": deployment_id},
-            varying={"id": ids, "name": names, "node_id": node_ids,
-                     "node_name": node_names,
-                     "previous_allocation": prev_ids})
+            Allocation, mi - start,
+            shared=shared,
+            varying={"id": ids[start:mi], "name": names[start:mi],
+                     "node_id": node_ids, "node_name": node_names,
+                     "previous_allocation": prev_ids[start:mi]})
         for node_id, s, e in slices:
             bucket = node_allocation.get(node_id)
             if bucket is None:
@@ -626,6 +914,21 @@ class SolverPlacer:
             else:
                 bucket.extend(allocs[s:e])
         return mi
+
+    def _place_batch_simple(self, missings, tg, node_iter,
+                            deployment_id: str) -> int:
+        """Stamp out allocations for solver placement counts in one pass.
+
+        All instances of a TG are identical, so they share ONE
+        AllocatedResources and ONE metrics object (immutable by convention —
+        the same sharing the Go reference gets from pointers into state).
+        50k-alloc materialization drops from ~6s of per-alloc NetworkIndex/
+        DeviceAllocator setup to a tight object loop (VERDICT r1 next #1).
+        """
+        shared, ids, names, prev_ids = self._prepare_stamp(
+            missings, tg, deployment_id)
+        return self._stamp_slice(shared, ids, names, prev_ids, node_iter,
+                                 0, len(missings), self.plan.node_allocation)
 
     # ------------------------------------------------- exact host assignment
 

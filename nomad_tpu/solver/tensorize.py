@@ -371,3 +371,27 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
         job_collisions=collisions,
         distinct_hosts=distinct_hosts,
     )
+
+
+def stack_lanes(lane_args: list, pad_args: tuple, n_lanes: int) -> tuple:
+    """Column-stack K solves' normalized arg tuples into ONE batched arg
+    tuple of exactly `n_lanes` rows (the eval-stream micro-batch layout:
+    jit(vmap(solve)) maps axis 0 of every column back to one eval's solve).
+
+    Rows past len(lane_args) are filled from `pad_args` — the caller's
+    inert clone of lane 0 (count=0 places nothing) — so every dispatch
+    hits the same compiled artifact regardless of how many evals
+    coalesced. A column that is None in every lane stays None (an absent
+    optional input like affinities; vmap treats None as an empty pytree,
+    no batch axis needed). Mixed None/array columns are a caller bug —
+    the micro-batcher's queue key separates those shapes upstream.
+    """
+    rows = list(lane_args) + [pad_args] * (n_lanes - len(lane_args))
+    cols = []
+    for i in range(len(pad_args)):
+        vals = [r[i] for r in rows]
+        if all(v is None for v in vals):
+            cols.append(None)
+            continue
+        cols.append(np.stack(vals))
+    return tuple(cols)

@@ -26,12 +26,38 @@ class PreemptionConfig:
 @dataclass
 class SchedulerConfiguration:
     """Raft-replicated, runtime-mutable scheduler config
-    (ref operator.go:144, set via /v1/operator/scheduler/configuration)."""
+    (ref operator.go:144, set via /v1/operator/scheduler/configuration).
+
+    The tpu-batch knobs ride the same hot-reload path as
+    `scheduler_algorithm`: a SCHEDULER_CONFIG log entry replaces the
+    stored config, and every eval reads the latest copy through its
+    EvalContext — no restart, no cache to bust.
+
+      plan_pipeline_enabled   pipelined plan lifecycle: chunk the solve,
+                              dispatch chunk N+1 on the accelerator while
+                              the host materializes/evaluates/commits
+                              chunk N. False forces the serial path.
+      plan_pipeline_chunks    how many chunks a pipelined eval splits
+                              into; 1 means stay serial (a one-chunk
+                              pipeline commits nothing early).
+      plan_pipeline_min_count below this many placements an eval stays
+                              serial (chunking overhead beats the overlap).
+      eval_batch_enabled      eval-stream micro-batching: small depth
+                              solves on a TPU coalesce into one padded
+                              batched dispatch instead of the host tier.
+      eval_batch_window_ms    how long the first pending solve waits for
+                              siblings before dispatching the batch.
+    """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
     memory_oversubscription_enabled: bool = False
     reject_job_registration: bool = False
     pause_eval_broker: bool = False
+    plan_pipeline_enabled: bool = True
+    plan_pipeline_chunks: int = 4
+    plan_pipeline_min_count: int = 8192
+    eval_batch_enabled: bool = True
+    eval_batch_window_ms: float = 8.0
     create_index: int = 0
     modify_index: int = 0
 
@@ -43,4 +69,10 @@ class SchedulerConfiguration:
         if self.scheduler_algorithm not in VALID_SCHEDULER_ALGORITHMS:
             return (f"invalid scheduler algorithm {self.scheduler_algorithm!r}; "
                     f"must be one of {VALID_SCHEDULER_ALGORITHMS}")
+        if self.plan_pipeline_chunks < 1:
+            return "plan_pipeline_chunks must be >= 1"
+        if self.plan_pipeline_min_count < 0:
+            return "plan_pipeline_min_count must be >= 0"
+        if self.eval_batch_window_ms < 0:
+            return "eval_batch_window_ms must be >= 0"
         return ""

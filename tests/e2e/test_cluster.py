@@ -176,20 +176,42 @@ def test_drain_migrates_allocs(cluster):
         node_of[n["Name"]] = n["ID"]
     drain_id = node_of["e2e-client1"]
     keep_id = node_of["e2e-client0"]
+    drain_deadline_s = 60
+    drain_t0 = time.monotonic()
     cluster.send_leader(f"/v1/node/{drain_id}/drain",
-                        {"DrainSpec": {"Deadline": 60}})
+                        {"DrainSpec": {"Deadline": drain_deadline_s}})
     def drained():
         allocs = [a for a in cluster.leader().get(
             f"/v1/node/{drain_id}/allocations")
             if a.get("ClientStatus") == "running"]
         return not allocs
-    assert wait_until(drained, timeout=90), _diagnose(cluster)
-    # every service job still has its full count, now on the other node
+    # the drainer honors the CONFIGURED deadline, not "eventually". Its
+    # contract allows force-stopping stragglers AT the deadline, and the
+    # poll adds up to its interval on top, so the bound is deadline plus
+    # a small fixed slop — not 90s of "whenever"
+    deadline_slop_s = 5.0
+    assert wait_until(drained, timeout=drain_deadline_s + deadline_slop_s), \
+        _diagnose(cluster)
+    drained_elapsed = time.monotonic() - drain_t0
+    assert drained_elapsed < drain_deadline_s + deadline_slop_s, \
+        f"drain took {drained_elapsed:.1f}s, deadline {drain_deadline_s}s"
+    # every service job still has its full count, now on the other node —
+    # replacements must also land within the drain-deadline window
     for jid, count in (("e2e-base", 2), ("e2e-reattach", 2)):
+        # no floor: the wait must never outlive the bound the elapsed
+        # assert below enforces, or a run the wait allowed could still
+        # fail the assert
+        remaining = max(0.1, drain_deadline_s + deadline_slop_s
+                        - (time.monotonic() - drain_t0))
         assert wait_until(
             lambda: len([a for a in cluster.running_allocs(jid)
                          if a["NodeID"] == keep_id]) == count,
-            timeout=90), f"{jid} did not migrate:\n" + _diagnose(cluster, jid)
+            timeout=remaining), \
+            f"{jid} did not migrate within the drain deadline:\n" + \
+            _diagnose(cluster, jid)
+    migrate_elapsed = time.monotonic() - drain_t0
+    assert migrate_elapsed < drain_deadline_s + deadline_slop_s, \
+        f"migration took {migrate_elapsed:.1f}s vs {drain_deadline_s}s deadline"
     # un-drain so later tests get both nodes back
     cluster.send_leader(f"/v1/node/{drain_id}/drain",
                         {"DrainSpec": None, "MarkEligible": True})

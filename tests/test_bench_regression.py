@@ -1,0 +1,89 @@
+"""Bench regression gate (ISSUE 1 satellite): the stream metrics
+BASELINE.md names (`evals_per_sec_1k_stream`, `p50_plan_submit_s`) must
+not silently drift >10% worse than the recorded best across the
+committed `BENCH_*.json` history.
+
+Comparisons are keyed by `stream_concurrency` (absent = 1, the old
+sequential stream): a methodology change — e.g. ISSUE 1's move to
+concurrent stream workers, which trades per-eval latency for coalesced
+throughput — starts a fresh lineage rather than comparing incomparable
+numbers. Within a lineage the gate is hard.
+"""
+import glob
+import json
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIFT = 0.10
+
+
+def _bench_history():
+    """[(round, metrics_dict)] for every parseable BENCH_rNN.json."""
+    out = []
+    for path in glob.glob(os.path.join(REPO, "BENCH_*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            parsed = doc if isinstance(doc, dict) and "value" in doc else None
+        if parsed:
+            out.append((int(m.group(1)), parsed))
+    return sorted(out)
+
+
+def test_stream_metrics_do_not_regress_vs_recorded_best():
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    evals = latest.get("evals_per_sec_1k_stream")
+    p50 = latest.get("p50_plan_submit_s")
+    if evals is None and p50 is None:
+        pytest.skip(f"BENCH_r{latest_round:02d} has no stream metrics")
+    lineage = latest.get("stream_concurrency", 1)
+    peers = [p for _, p in history
+             if p.get("stream_concurrency", 1) == lineage]
+
+    if evals is not None:
+        best = max((p["evals_per_sec_1k_stream"] for p in peers
+                    if p.get("evals_per_sec_1k_stream") is not None),
+                   default=evals)
+        assert evals >= best * (1 - DRIFT), (
+            f"BENCH_r{latest_round:02d}: evals_per_sec_1k_stream {evals} "
+            f"drifted >{DRIFT:.0%} below the recorded best {best} "
+            f"(stream_concurrency={lineage})")
+
+    if p50 is not None:
+        best = min((p["p50_plan_submit_s"] for p in peers
+                    if p.get("p50_plan_submit_s") is not None),
+                   default=p50)
+        assert p50 <= best * (1 + DRIFT), (
+            f"BENCH_r{latest_round:02d}: p50_plan_submit_s {p50} drifted "
+            f">{DRIFT:.0%} above the recorded best {best} "
+            f"(stream_concurrency={lineage})")
+
+
+def test_headline_rejection_parity_is_recorded():
+    """The headline's second acceptance axis: the latest bench must have
+    run at rejection parity with zero headline plan-node rejections —
+    the optimistic-concurrency contract the pipelined lifecycle must
+    preserve."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    if "rejection_parity" not in latest:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates parity metrics")
+    assert latest["rejection_parity"] is True, \
+        f"BENCH_r{latest_round:02d} lost rejection parity"
+    assert latest.get("plan_nodes_rejected", 0) == 0, \
+        f"BENCH_r{latest_round:02d} headline rejected nodes"

@@ -68,14 +68,18 @@ def host_model_score(state, job, tg_name: str) -> float:
 
 
 def run_scenario(algorithm: str, seed: int, n_nodes: int, count: int,
-                 cpu: int = 500, mem: int = 256, node_seed_fn=None):
-    """One seeded cluster + batch job through the full scheduler path."""
+                 cpu: int = 500, mem: int = 256, node_seed_fn=None,
+                 config_kwargs=None):
+    """One seeded cluster + batch job through the full scheduler path.
+    `config_kwargs` extends the SchedulerConfiguration (e.g. the
+    plan-pipeline knobs)."""
     random.seed(seed)
     rng = np.random.default_rng(seed)
     h = Harness()
     h.state.set_scheduler_config(
         h.get_next_index(),
-        SchedulerConfiguration(scheduler_algorithm=algorithm))
+        SchedulerConfiguration(scheduler_algorithm=algorithm,
+                               **(config_kwargs or {})))
     for i in range(n_nodes):
         n = mock.node()
         if node_seed_fn is not None:
@@ -535,3 +539,202 @@ def test_fuzz_concurrent_workers_alloc_rejection_parity():
         assert node_tpu <= node_host * 1.1 + 0.005, \
             f"seed {seed}: node-level rejection {node_tpu:.4f} vs " \
             f"host {node_host:.4f}"
+
+
+# ---------------------------------------------- pipelined plan lifecycle
+
+PIPELINE_ON = {"plan_pipeline_min_count": 1, "plan_pipeline_chunks": 3}
+
+
+def test_fuzz_pipelined_path_matches_serial_invariants():
+    """ISSUE 1 acceptance: the pipelined plan lifecycle is
+    behavior-identical to serial under the differential fuzz invariants —
+    3 seeds, chunked solve+commit forced down to tiny counts, vs the
+    serial path on the same seed: all placed, no overcommit, and the
+    host-model score within the same band the serial fuzz asserts."""
+    from nomad_tpu.metrics import metrics
+    for seed in (101, 202, 303):
+        rng = np.random.default_rng(seed)
+        n_nodes = int(rng.integers(6, 20))
+        # m = 2*count/n > 3 keeps the solve in the deterministic
+        # full-curve regime — the only regime the pipeline chunks (the
+        # jittered sampled-grid regime stays serial by design)
+        count = int(rng.integers(2 * n_nodes, 3 * n_nodes))
+        c0 = metrics.counter("nomad.plan.pipeline.evals")
+        h_pipe, job_p = run_scenario(SCHED_ALG_TPU, seed, n_nodes, count,
+                                     cpu=250, mem=128,
+                                     config_kwargs=PIPELINE_ON)
+        assert metrics.counter("nomad.plan.pipeline.evals") > c0, \
+            f"seed {seed}: pipelined path never engaged"
+        h_ser, job_s = run_scenario(SCHED_ALG_TPU, seed, n_nodes, count,
+                                    cpu=250, mem=128,
+                                    config_kwargs={
+                                        "plan_pipeline_enabled": False})
+        check_committed(h_pipe, job_p, count)
+        check_committed(h_ser, job_s, count)
+        s_pipe = host_model_score(h_pipe.state, job_p, "worker")
+        s_ser = host_model_score(h_ser.state, job_s, "worker")
+        assert s_pipe >= s_ser * 0.9 - 1e-6, \
+            f"seed {seed}: pipelined {s_pipe:.4f} < 0.9 * serial {s_ser:.4f}"
+
+
+def test_pipeline_distinct_hosts_stays_serial():
+    """distinct_hosts lowers to max_per_node=1, which binds per SOLVE —
+    C chunked solves could stack C same-job instances on one node (the
+    fed-forward collision count is only a soft penalty), so the pipeline
+    must decline and the constraint must hold."""
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.structs import Constraint, OP_DISTINCT_HOSTS
+    random.seed(5)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU,
+                               **PIPELINE_ON))
+    for _ in range(12):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+    job = mock.batch_job()
+    job.constraints.append(Constraint(operand=OP_DISTINCT_HOSTS))
+    tg = job.task_groups[0]
+    tg.count = 10
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    h.state.upsert_job(h.get_next_index(), job)
+    c0 = metrics.counter("nomad.plan.pipeline.evals")
+    ev = Evaluation(job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    assert metrics.counter("nomad.plan.pipeline.evals") == c0, \
+        "distinct_hosts eval took the pipelined path"
+    allocs = [a for a in h.state.allocs_by_job("default", job.id)
+              if not a.terminal_status()]
+    assert len(allocs) == 10
+    assert len({a.node_id for a in allocs}) == 10
+
+
+def test_pipeline_single_chunk_stays_serial():
+    """plan_pipeline_chunks=1 validates (>= 1) and is honored as "stay
+    serial" — a one-chunk pipeline commits nothing early, so silently
+    running 2 chunks would contradict the validated config."""
+    from nomad_tpu.metrics import metrics
+    c0 = metrics.counter("nomad.plan.pipeline.evals")
+    h, job = run_scenario(SCHED_ALG_TPU, 7, 10, 20, cpu=250, mem=128,
+                          config_kwargs={"plan_pipeline_min_count": 1,
+                                         "plan_pipeline_chunks": 1})
+    assert metrics.counter("nomad.plan.pipeline.evals") == c0
+    check_committed(h, job, 20)
+
+
+def test_pipeline_env_flag_forces_serial():
+    """NOMAD_PLAN_PIPELINE=0 overrides an enabled config — the operator's
+    serial-fallback escape hatch."""
+    import os
+
+    from nomad_tpu.metrics import metrics
+    os.environ["NOMAD_PLAN_PIPELINE"] = "0"
+    try:
+        c0 = metrics.counter("nomad.plan.pipeline.evals")
+        h, job = run_scenario(SCHED_ALG_TPU, 7, 10, 20, cpu=250, mem=128,
+                              config_kwargs=PIPELINE_ON)
+        assert metrics.counter("nomad.plan.pipeline.evals") == c0
+        check_committed(h, job, 20)
+    finally:
+        del os.environ["NOMAD_PLAN_PIPELINE"]
+
+
+def _uniform_cluster_fsm(algorithm: str, n_nodes: int, config_kwargs=None):
+    """NomadFSM + real serial applier state with n_nodes UNIFORM mock
+    nodes (3900 usable cpu / 7936 usable mem each after reservation)."""
+    from nomad_tpu.server.fsm import NomadFSM
+
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=algorithm,
+                                  **(config_kwargs or {})))
+    idx = 2
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.name = f"uni-{i}"
+        s.upsert_node(idx, n)
+        nodes.append(n)
+        idx += 1
+    return fsm, nodes
+
+
+def test_pipelined_commit_ordering_concurrent_writer_parity():
+    """ISSUE 1 satellite: a concurrent state write lands between chunk N's
+    commit and the later chunks' commits; the applier's latest-state
+    re-check must reject those placements and the eval must
+    refresh-and-retry EXACTLY as the serial path does — same committed
+    count, same rejection count, same final eval disposition.
+
+    9 uniform nodes x 10 tasks each, count=90 (every node is needed), so
+    the hog alloc injected on a still-empty node after the first apply is
+    guaranteed to collide with a later chunk (pipelined) / the one plan
+    (serial)."""
+    import bench
+    from nomad_tpu.server.fsm import RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+
+    def hog_for(state):
+        """A full-node competitor alloc on a node with no allocs yet."""
+        hog_job = mock.batch_job()
+        hog_job.id = hog_job.name = "hog"
+        t = hog_job.task_groups[0].tasks[0]
+        t.resources.cpu = 3900
+        t.resources.memory_mb = 512
+        t.resources.networks = []
+        hog_job.task_groups[0].networks = []
+        empty = next(n for n in state.iter_nodes()
+                     if not state.allocs_by_node(n.id))
+        return mock.alloc_for(hog_job, empty)
+
+    class InjectingPlanner(Planner):
+        def __init__(self, raft, state, fire_after: int):
+            super().__init__(raft, state)
+            self._applies = 0
+            self._fire_after = fire_after
+            self.fired = False
+
+        def apply_plan(self, plan):
+            if not self.fired and self._applies == self._fire_after:
+                s = self.state
+                s.upsert_allocs(s.latest_index() + 1, [hog_for(s)])
+                self.fired = True
+            self._applies += 1
+            return super().apply_plan(plan)
+
+    def run(pipelined: bool, seed: int):
+        random.seed(seed)
+        cfg = dict(PIPELINE_ON) if pipelined \
+            else {"plan_pipeline_enabled": False}
+        fsm, _ = _uniform_cluster_fsm(SCHED_ALG_TPU, 9, cfg)
+        s = fsm.state
+        # pipelined: hog lands after chunk 1 of 3 commits; serial: hog
+        # lands after the snapshot but before the single plan applies —
+        # the same concurrent-writer race, phrased per path
+        planner = InjectingPlanner(RaftLog(fsm), s,
+                                   fire_after=1 if pipelined else 0)
+        job = bench._mk_batch_job("ordering", 90, cpu=390, mem=512)
+        s.upsert_job(s.latest_index() + 1, job)
+        shim, sched = bench._run_eval(fsm, planner, job)
+        assert planner.fired, "interleaved write never fired"
+        committed = [a for a in s.iter_allocs() if a.job_id == "ordering"]
+        rejected = sum(len(r.rejected_nodes)
+                       for _, r in shim.all_submissions() if r is not None)
+        # overcommit check against committed state
+        view = s.usage.view()
+        assert not bool((view.used > view.cap + 1e-3).any())
+        evals = [e for e in s.evals_by_job("default", "ordering")]
+        status = sorted(e.status for e in evals if e.status)
+        hog_live = bool([a for a in s.iter_allocs()
+                         if a.job_id == "hog"
+                         and not a.terminal_status()])
+        return len(committed), rejected, status, hog_live
+
+    obs_pipe = run(True, 1234)
+    obs_serial = run(False, 1234)
+    assert obs_pipe[1] >= 1, f"no rejection surfaced: {obs_pipe}"
+    assert obs_pipe == obs_serial, \
+        f"pipelined {obs_pipe} != serial {obs_serial}"

@@ -1,0 +1,159 @@
+"""Eval-stream micro-batching tests (ISSUE 1 tentpole): coalesced
+dispatch parity with the host tier, solo fallback, the broker's
+in-flight oracle, and the hot-reloadable coalescing window."""
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.solver import backend, microbatch
+from nomad_tpu.structs import (
+    Evaluation, SchedulerConfiguration, SCHED_ALG_TPU,
+)
+
+from test_solver_backend import _depth_args
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    backend.reset()
+    microbatch.reset()
+    microbatch.configure(enabled=True, window_s=0.05)
+    yield
+    backend.reset()
+    microbatch.reset()
+    microbatch.configure(enabled=True, window_s=0.008)
+
+
+def test_coalesced_dispatch_matches_host_tier(monkeypatch):
+    """Two concurrent depth solves coalesce into ONE vmapped dispatch and
+    each gets back exactly what the host tier would have produced."""
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "batch")
+    backend.reset()
+    name, batched_fn = backend.select("depth", 512, count=40)
+    assert name == "batch"
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "host")
+    backend.reset()
+    _, host_fn = backend.select("depth", 512, count=40)
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "batch")
+    backend.reset()
+
+    args = [_depth_args(512, 40, seed=s) for s in (1, 2)]
+    expected = [np.asarray(host_fn(*a)) for a in args]
+    d0 = metrics.counter("nomad.solver.microbatch.dispatches")
+
+    microbatch.eval_started()
+    microbatch.eval_started()
+    out: dict = {}
+
+    def call(i):
+        out[i] = np.asarray(batched_fn(*args[i]))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    microbatch.eval_finished()
+    microbatch.eval_finished()
+
+    assert metrics.counter("nomad.solver.microbatch.dispatches") == d0 + 1
+    for i in (0, 1):
+        assert int(out[i].sum()) == int(expected[i].sum()) == 40
+        np.testing.assert_array_equal(out[i], expected[i])
+
+
+def test_solo_eval_never_batches(monkeypatch):
+    """With one eval in flight the solve takes the host tier inline — no
+    window sleep amortization to be had, no device round trip."""
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "batch")
+    backend.reset()
+    _, batched_fn = backend.select("depth", 256, count=10)
+    d0 = metrics.counter("nomad.solver.microbatch.dispatches")
+    s0 = metrics.counter("nomad.solver.microbatch.solo")
+    microbatch.eval_started()
+    out = np.asarray(batched_fn(*_depth_args(256, 10, seed=3)))
+    microbatch.eval_finished()
+    assert int(out.sum()) == 10
+    assert metrics.counter("nomad.solver.microbatch.dispatches") == d0
+    assert metrics.counter("nomad.solver.microbatch.solo") == s0 + 1
+
+
+def test_broker_inflight_is_a_concurrency_signal():
+    """The eval broker pushes its outstanding (dequeued, unacked) count
+    to the micro-batcher on every dequeue/ack — siblings are visible
+    BEFORE they reach their own solve call."""
+    from nomad_tpu.server.eval_broker import EvalBroker
+    broker = EvalBroker()
+    broker.set_enabled(True)
+    try:
+        evs = []
+        for i in range(2):
+            ev = Evaluation(job_id=f"job-{i}", type="batch", priority=50)
+            broker.enqueue(ev)
+            evs.append(ev)
+        assert microbatch.concurrency() == 0
+        _, t1 = broker.dequeue(["batch"], timeout=1.0)
+        assert microbatch.concurrency() == 1
+        ev2, t2 = broker.dequeue(["batch"], timeout=1.0)
+        assert microbatch.concurrency() == 2
+        broker.ack(evs[0].id, t1)
+        assert microbatch.concurrency() == 1
+        broker.ack(ev2.id, t2)
+        assert microbatch.concurrency() == 0
+    finally:
+        broker.set_enabled(False)
+
+
+def test_window_knob_hot_reloads_through_scheduler_config():
+    """The coalescing window rides the SAME runtime-mutation path as the
+    SchedulerAlgorithm enum: replace the stored SchedulerConfiguration and
+    the very next eval's placer pushes the new window into the batcher —
+    no restart, no cache to bust (ISSUE 1 satellite)."""
+    random.seed(99)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU,
+                               eval_batch_window_ms=12.0))
+    for _ in range(6):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+
+    def run_one(job_id):
+        job = mock.batch_job()
+        job.id = job.name = job_id
+        tg = job.task_groups[0]
+        tg.count = 2
+        tg.networks = []
+        tg.tasks[0].resources.networks = []
+        h.state.upsert_job(h.get_next_index(), job)
+        ev = Evaluation(job_id=job.id, type=job.type)
+        h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+
+    run_one("hot-a")
+    assert microbatch.window_s() == pytest.approx(0.012)
+    assert microbatch.enabled()
+
+    # operator mutates the live config: next eval picks it up
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU,
+                               eval_batch_window_ms=20.0,
+                               eval_batch_enabled=False))
+    run_one("hot-b")
+    assert microbatch.window_s() == pytest.approx(0.020)
+    assert not microbatch.enabled()
+
+
+def test_scheduler_config_validates_batch_and_pipeline_knobs():
+    cfg = SchedulerConfiguration(eval_batch_window_ms=-1.0)
+    assert "eval_batch_window_ms" in cfg.validate()
+    cfg = SchedulerConfiguration(plan_pipeline_chunks=0)
+    assert "plan_pipeline_chunks" in cfg.validate()
+    cfg = SchedulerConfiguration(plan_pipeline_min_count=-5)
+    assert "plan_pipeline_min_count" in cfg.validate()
+    assert SchedulerConfiguration().validate() == ""

@@ -298,3 +298,31 @@ def test_depth_grid_selects_pallas_tier_on_tpu(monkeypatch):
         assert name == expect
     finally:
         backend.reset()
+
+
+# --------------------------------------------- tier remaps (docs/BACKEND_TIERS)
+
+def test_batch_tier_only_for_depth(monkeypatch):
+    """Remap table row 2: a batch pick for greedy/chunked demotes to host
+    — only depth solves micro-batch (the eval stream is depth-shaped)."""
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "batch")
+    backend.reset()
+    name, _ = backend.select("depth", 512, count=40)
+    assert name == "batch"
+    for kernel in ("greedy", "chunked"):
+        name, _ = backend.select(kernel, 512, count=40)
+        assert name == "host", kernel
+
+
+def test_tier_remap_table_documented():
+    """The docs note the selector docstring points at must exist and name
+    every remap (the pallas sampled-grid boundary in particular)."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "BACKEND_TIERS.md")
+    text = open(path).read()
+    assert "sampled-grid" in text
+    assert "chunked" in text and "pallas" in text and "xla" in text
+    assert "batch" in text and "host" in text
+    # the load-bearing boundary claim: no pallas demotion keyed on the grid
+    assert "no" in text.lower() and "depth_grid" in text
