@@ -1,0 +1,16 @@
+"""nomadlint: AST-based static analysis for nomad-tpu (JIT safety, lock
+discipline, determinism, exception hygiene). Run it locally with
+
+    python -m nomad_tpu.analysis [--json] [paths...]
+
+and see docs/STATIC_ANALYSIS.md for the rule catalog and the
+suppression/baseline workflow. Importing the package registers every
+rule module."""
+from .core import (                                    # noqa: F401
+    Baseline, Finding, Rule, all_rules, analyze_paths, analyze_source,
+    register,
+)
+from . import rules_det, rules_exc, rules_jit, rules_lock  # noqa: F401
+
+__all__ = ["Baseline", "Finding", "Rule", "all_rules", "analyze_paths",
+           "analyze_source", "register"]

@@ -1,0 +1,75 @@
+"""CLI entry point: `python -m nomad_tpu.analysis [--json] [paths...]`.
+
+Exit status 0 when every finding is baselined or suppressed, 1 when
+active findings (or unparseable files) remain — the same contract
+tests/test_lint.py enforces in tier-1.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import Baseline, all_rules, analyze_paths
+from .core import BASELINE_FILENAME
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="nomadlint: JIT-safety / lock-discipline / "
+                    "determinism static analyzer")
+    ap.add_argument("paths", nargs="*", default=["nomad_tpu"],
+                    help="files or directories to scan "
+                         "(default: nomad_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array "
+                         "(rule, path, line, message)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"baseline file (default: nearest "
+                         f"{BASELINE_FILENAME} above the first path)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file (report everything)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  [{rule.severity}]  {rule.short}", file=out)
+        return 0
+
+    if args.no_baseline:
+        baseline = Baseline()
+    elif args.baseline:
+        baseline = Baseline.load(args.baseline)
+    else:
+        baseline = Baseline.discover(args.paths[0])
+
+    findings, errors = analyze_paths(args.paths)
+    active = [f for f in findings if not baseline.matches(f)]
+    baselined = len(findings) - len(active)
+
+    if args.as_json:
+        print(json.dumps([f.as_dict() for f in active], indent=2),
+              file=out)
+        # stdout stays a pure findings array (the CI ingestion
+        # contract); parse errors still fail the run and go to stderr
+        # so a failing rc is never paired with a silent empty `[]`
+        for path, msg in errors:
+            print(f"{path}: PARSE ERROR: {msg}", file=sys.stderr)
+    else:
+        for f in active:
+            print(f.render(), file=out)
+        for path, msg in errors:
+            print(f"{path}: PARSE ERROR: {msg}", file=out)
+        summary = (f"nomadlint: {len(active)} finding(s)"
+                   + (f", {baselined} baselined" if baselined else "")
+                   + (f", {len(errors)} parse error(s)" if errors else ""))
+        print(summary, file=out)
+    return 1 if active or errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""DET001 — nondeterminism on scheduler/solver decision paths.
+
+Heterogeneity-aware schedulers (Gavel) and placement-policy systems
+(Tesserae) both treat scheduler determinism as a correctness property:
+identical (snapshot, eval, seed) inputs must give identical placements,
+or differential tests, plan-rejection accounting, and incident replay
+all lose their footing. The scheduler threads a seeded `random.Random`
+through `GenericStack.rng` for exactly this reason.
+
+Flagged inside `nomad_tpu/scheduler/` and `nomad_tpu/solver/`:
+  * calls on the process-global `random` module (`random.getrandbits`,
+    `random.shuffle`, ...) — shared mutable stream, order-dependent
+    across threads and call sites;
+  * `random.Random()` with no seed — seeded from OS entropy;
+  * `numpy.random.*` global-state calls, and `default_rng()` without a
+    seed;
+  * `time.time()` — wall clock feeding a decision path. (Wall-clock
+    uses that are part of the scheduling SPEC — reschedule windows,
+    alloc timestamps — carry an inline disable with that justification;
+    `time.monotonic`/`perf_counter` for latency metrics are fine.)
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, SourceModule, register
+
+
+@register
+class DecisionPathNondeterminism(Rule):
+    id = "DET001"
+    severity = "error"
+    short = ("global/unseeded RNG or wall clock on a scheduler/solver "
+             "decision path")
+    path_markers = ("/scheduler/", "/solver/")
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted(node.func)
+            if d is None:
+                continue
+            if d == "random.Random":
+                if not node.args:
+                    out.append(mod.finding(
+                        self, node,
+                        "unseeded random.Random() — thread the "
+                        "scheduler's seeded rng (GenericStack.rng) or "
+                        "seed deterministically"))
+            elif d.startswith("random."):
+                out.append(mod.finding(
+                    self, node,
+                    f"{d}() uses the process-global RNG stream — "
+                    f"placements stop being a function of (snapshot, "
+                    f"eval, seed); use the stack's seeded rng"))
+            elif d == "numpy.random.default_rng":
+                if not node.args:
+                    out.append(mod.finding(
+                        self, node,
+                        "numpy.random.default_rng() without a seed — "
+                        "derive the seed from the eval's rng"))
+            elif d.startswith("numpy.random."):
+                out.append(mod.finding(
+                    self, node,
+                    f"{d}() mutates numpy's global RNG state — use a "
+                    f"seeded Generator instead"))
+            elif d == "time.time":
+                out.append(mod.finding(
+                    self, node,
+                    "time.time() on a decision path makes scheduling "
+                    "wall-clock-dependent — inject `now` or use the "
+                    "eval's timestamp (disable inline where wall clock "
+                    "IS the spec, e.g. reschedule windows)"))
+        return out

@@ -1,0 +1,204 @@
+"""JIT rules: host-sync leaks inside jitted code (JIT001) and per-call
+jit construction that defeats the compile cache (JIT002).
+
+JIT001 — inside a `@jax.jit`-decorated function (including
+`functools.partial(jax.jit, static_argnames=...)`) or a lambda passed
+directly to `jax.jit(...)`, calls that force a device->host sync or leak
+a tracer to host code: `.item()`, `float()/int()/bool()` on traced
+values, `np.asarray`/`np.array`, `jax.device_get`. Arguments named in
+`static_argnames` are concrete Python values, and shape/dtype/ndim
+attributes are static under tracing, so those are exempt.
+
+JIT002 — `jax.jit(...)` constructed inside a function body: each fresh
+wrapper owns a fresh compile cache, so the call site re-traces (and on
+TPU re-compiles) every invocation. Exempt idioms that amortize the
+construction: `return jax.jit(...)` (factory — construction cost is the
+caller's, once), assignment into a subscripted cache
+(`self._fns[key] = jax.jit(...)`), and assignment to a `global`/
+`nonlocal` memo (`global _fn; _fn = jax.jit(...)`).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, SourceModule, register
+
+_JIT_NAMES = ("jax.jit",)
+_PARTIAL_NAMES = ("functools.partial", "partial")
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_HOST_MATERIALIZE = {"numpy.asarray", "numpy.array", "numpy.asanyarray",
+                     "jax.device_get"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_jit(mod: SourceModule, node: ast.AST) -> bool:
+    return mod.dotted(node) in _JIT_NAMES
+
+
+def _static_argnames(call: ast.Call) -> set:
+    """Names listed in a static_argnames kwarg of jax.jit/partial."""
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            out.update(e.value for e in v.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str))
+    return out
+
+
+def _jit_decoration(mod: SourceModule, fn: ast.AST):
+    """-> set of static arg names if `fn` is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        if _is_jit(mod, dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            if _is_jit(mod, dec.func):
+                return _static_argnames(dec)
+            if mod.dotted(dec.func) in _PARTIAL_NAMES and dec.args \
+                    and _is_jit(mod, dec.args[0]):
+                return _static_argnames(dec)
+    return None
+
+
+def _jit_contexts(mod: SourceModule):
+    """Yield (body_root, static_names) for every jitted region."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            statics = _jit_decoration(mod, node)
+            if statics is not None:
+                yield node, statics
+        elif isinstance(node, ast.Call) and _is_jit(mod, node.func) \
+                and node.args and isinstance(node.args[0], ast.Lambda):
+            yield node.args[0], _static_argnames(node)
+
+
+def _is_static_expr(expr: ast.AST, statics: set) -> bool:
+    """Structurally static under tracing: constants, names bound to
+    static args, .shape/.ndim/.dtype/.size attributes, len(), and
+    arithmetic/indexing built ONLY from those. A single traced operand
+    anywhere makes the whole expression non-static — `float(x.sum() /
+    x.shape[0])` must flag even though `.shape` appears in it."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in statics
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _STATIC_ATTRS
+    if isinstance(expr, ast.Call):
+        return (isinstance(expr.func, ast.Name) and expr.func.id == "len"
+                and all(_is_static_expr(a, statics) for a in expr.args))
+    if isinstance(expr, ast.Subscript):
+        return _is_static_expr(expr.value, statics) and \
+            _is_static_expr(expr.slice, statics)
+    if isinstance(expr, ast.BinOp):
+        return _is_static_expr(expr.left, statics) and \
+            _is_static_expr(expr.right, statics)
+    if isinstance(expr, ast.UnaryOp):
+        return _is_static_expr(expr.operand, statics)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return all(_is_static_expr(e, statics) for e in expr.elts)
+    return False
+
+
+@register
+class JitHostSync(Rule):
+    id = "JIT001"
+    severity = "error"
+    short = ("host-sync / tracer-leak call (.item(), float(), np.asarray) "
+             "inside a jax.jit region")
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for ctx, statics in _jit_contexts(mod):
+            for node in ast.walk(ctx):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    out.append(mod.finding(
+                        self, node,
+                        ".item() inside jit forces a device->host sync "
+                        "(and fails on abstract tracers)"))
+                    continue
+                d = mod.dotted(node.func)
+                if d in _SYNC_BUILTINS and len(node.args) == 1 \
+                        and not _is_static_expr(node.args[0], statics):
+                    out.append(mod.finding(
+                        self, node,
+                        f"{d}() on a traced value inside jit leaks the "
+                        f"tracer to host (TracerConversionError / silent "
+                        f"host sync); mark the arg static or keep it in "
+                        f"jnp"))
+                elif d in _HOST_MATERIALIZE:
+                    out.append(mod.finding(
+                        self, node,
+                        f"{d}() inside jit materializes on host — use "
+                        f"jnp.* so the value stays on device"))
+        return out
+
+
+@register
+class JitPerCallConstruction(Rule):
+    id = "JIT002"
+    severity = "error"
+    short = ("jax.jit(...) constructed inside a function body — a fresh "
+             "wrapper per call re-traces/re-compiles every invocation")
+
+    def _enclosing_scope(self, mod: SourceModule, node: ast.AST):
+        """Nearest function the call EXECUTES in; decorators execute in
+        the scope enclosing their function, so climb past those."""
+        child = node
+        for anc in mod.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child in anc.decorator_list or any(
+                        child is d for d in anc.decorator_list):
+                    child = anc
+                    continue
+                return anc
+            if isinstance(anc, ast.Lambda):
+                return anc
+            child = anc
+        return None
+
+    def _is_memoized(self, mod: SourceModule, call: ast.Call,
+                     scope: ast.AST) -> bool:
+        parent = mod.parent(call)
+        if isinstance(parent, ast.Return):
+            return True                          # factory pattern
+        if isinstance(parent, ast.Assign):
+            names = []
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Subscript):
+                    return True                  # cache store
+                if isinstance(tgt, ast.Name):
+                    names.append(tgt.id)
+            declared: set = set()
+            for node in ast.walk(scope):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    declared.update(node.names)
+            if names and all(n in declared for n in names):
+                return True                      # global/nonlocal memo
+        return False
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not _is_jit(mod,
+                                                             node.func):
+                continue
+            scope = self._enclosing_scope(mod, node)
+            if scope is None:                    # module scope: compiles once
+                continue
+            if self._is_memoized(mod, node, scope):
+                continue
+            out.append(mod.finding(
+                self, node,
+                "jax.jit(...) built inside a function body discards its "
+                "compile cache every call — hoist to module scope, return "
+                "it from a factory, or store it in a keyed cache"))
+        return out

@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..metrics import record_swallowed_error
 from ..structs import (
     Allocation, AllocDeploymentStatus, TaskState,
     ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_PENDING,
@@ -43,6 +44,11 @@ class AllocRunner:
         self._vault_tokens: dict[str, str] = {}      # task -> token
         self._services_registered = False
         self._check_runners: list = []
+        # serializes the WHOLE service register/deregister lifecycle
+        # (claim + RPC + check-runner spawn vs teardown) — a dedicated
+        # lock so the hot-path _lock never waits on a service RPC
+        self._services_lock = threading.Lock()
+        self._services_closed = False
         # bridge-mode netns status ({"ip","netns","gateway"}) or None
         self.network_status: Optional[dict] = None
 
@@ -75,8 +81,11 @@ class AllocRunner:
             for token in self._vault_tokens.values():
                 try:
                     self.client.rpc.vault_revoke_token(token)
-                except Exception:       # noqa: BLE001 — best effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — keep revoking
+                    # an unrevoked token outlives the alloc until TTL —
+                    # that deserves a log line and a counter (EXC001)
+                    record_swallowed_error("client.vault.revoke", e,
+                                           self.client.logger)
             self._vault_tokens.clear()
 
     def _start_vault_renewal(self, task, start_token: str,
@@ -183,48 +192,57 @@ class AllocRunner:
         return out
 
     def _register_services(self) -> None:
+        """Register this alloc's services + spawn check runners. The
+        whole body holds _services_lock: a flag-only claim would let
+        teardown interleave between the claim and the register RPC,
+        leaving the registration leaked server-side forever and check
+        runners pushing status for a dead alloc."""
         from ..integrations.services import CheckRunner
-        with self._lock:
-            # claim-before-RPC so concurrent RUNNING transitions don't
-            # double-register / double-spawn check runners
-            if self._services_registered:
+        with self._services_lock:
+            if self._services_closed or self._services_registered:
                 return
-            self._services_registered = True
-        pairs = self._service_instances()
-        if not pairs:
-            return
-        try:
-            self.client.rpc.service_register([inst for inst, _ in pairs])
-        except Exception as e:          # noqa: BLE001
-            self.client.logger(f"service register failed: {e!r}")
-            with self._lock:
-                self._services_registered = False   # retried by sync loop
-            return
-
-        def on_status(instance, status):
-            instance = instance.copy()
-            instance.status = status
+            pairs = self._service_instances()
+            if not pairs:
+                self._services_registered = True    # nothing to register
+                return
             try:
-                self.client.rpc.service_register([instance])
+                self.client.rpc.service_register(
+                    [inst for inst, _ in pairs])
             except Exception as e:      # noqa: BLE001
-                self.client.logger(f"check status push failed: {e!r}")
-        for inst, checks in pairs:
-            if checks:
-                cr = CheckRunner(inst, checks, on_status)
-                cr.start()
-                self._check_runners.append(cr)
+                self.client.logger(f"service register failed: {e!r}")
+                return                  # retried by the sync loop
+
+            def on_status(instance, status):
+                instance = instance.copy()
+                instance.status = status
+                try:
+                    self.client.rpc.service_register([instance])
+                except Exception as e:  # noqa: BLE001
+                    self.client.logger(f"check status push failed: {e!r}")
+            self._services_registered = True
+            for inst, checks in pairs:
+                if checks:
+                    cr = CheckRunner(inst, checks, on_status)
+                    cr.start()
+                    self._check_runners.append(cr)
 
     def _deregister_services(self) -> None:
-        for cr in self._check_runners:
-            cr.stop()
-        self._check_runners.clear()
-        if not self._services_registered:
-            return
-        self._services_registered = False
-        try:
-            self.client.rpc.service_deregister(alloc_id=self.alloc.id)
-        except Exception as e:          # noqa: BLE001
-            self.client.logger(f"service deregister failed: {e!r}")
+        """Terminal: close the service lifecycle (no later register can
+        claim), stop check runners, deregister. Serialized against
+        _register_services by _services_lock, so whichever side wins the
+        race, the final server-side state is deregistered."""
+        with self._services_lock:
+            self._services_closed = True
+            for cr in self._check_runners:
+                cr.stop()
+            self._check_runners.clear()
+            if not self._services_registered:
+                return
+            self._services_registered = False
+            try:
+                self.client.rpc.service_deregister(alloc_id=self.alloc.id)
+            except Exception as e:      # noqa: BLE001
+                self.client.logger(f"service deregister failed: {e!r}")
 
     def _run_impl(self) -> None:
         alloc = self.alloc

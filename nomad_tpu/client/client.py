@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Optional
 
+from ..metrics import record_swallowed_error
 from ..structs import (
     Allocation, Node, ALLOC_DESIRED_STOP, NODE_STATUS_DOWN,
     NODE_STATUS_INIT, NODE_STATUS_READY, new_id,
@@ -197,7 +198,9 @@ class Client:
             for tr in list(ar.task_runners.values()):
                 try:
                     tr.wait_done(timeout=max(0.0, deadline - time.time()))
-                except Exception:       # noqa: BLE001 — best-effort
+                # shutdown path: a runner that outlives the shared
+                # deadline is logged by its own kill path; nothing to do
+                except Exception:  # nomadlint: disable=EXC001 — shutdown best-effort
                     pass
         for drv in self.plugin_drivers.values():
             drv.shutdown()
@@ -236,13 +239,16 @@ class Client:
                 self._last_heartbeat_ok = time.monotonic()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: heartbeat failed: {e!r}")
-                # re-register: the server may have GC'd us
+                # re-register: the server may have GC'd us. A silent
+                # re-register failure leaves the node invisibly dead
+                # (EXC001) — count + log it; the loop retries next tick
                 try:
                     self.rpc.node_register(self.node)
                     self.rpc.node_update_status(self.node.id,
                                                 NODE_STATUS_READY)
-                except Exception:       # noqa: BLE001
-                    pass
+                except Exception as e2:     # noqa: BLE001
+                    record_swallowed_error("client.heartbeat.reregister",
+                                           e2, self.logger)
 
     def _heartbeat_stop_loop(self) -> None:
         """Stop allocs locally after prolonged server disconnection (ref

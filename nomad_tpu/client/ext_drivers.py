@@ -223,7 +223,9 @@ class ImageCoordinator:
             try:
                 self._remove(image)
                 self.stats["removes"] += 1
-            except Exception:  # noqa: BLE001 — image may be in use
+            # in-use images legitimately refuse removal; the next GC
+            # pass retries once the refcount drops
+            except Exception:  # nomadlint: disable=EXC001 — GC retries
                 pass
         if self.remove_delay > 0:
             t = threading.Timer(self.remove_delay, _do_remove)

@@ -215,7 +215,8 @@ def _probe_cloud(node: Node, cfg: dict, name: str, base: str,
             continue
         try:
             collected[attr] = get(base + path, headers, timeout).strip()
-        except Exception:                # noqa: BLE001 — best-effort key
+        # absent metadata keys are the NORMAL case off-cloud
+        except Exception:  # nomadlint: disable=EXC001 — probe, absent is fine
             pass
     node.attributes.update(collected)
     node.attributes["platform"] = name
@@ -409,7 +410,9 @@ def fingerprint_node(data_dir: str = "/tmp", datacenter: str = "dc1",
     for fp_name, fp in FINGERPRINTERS:
         try:
             fp(node, cfg)
-        except Exception:                # noqa: BLE001 - best-effort
+        # a fingerprinter that can't detect its facet just contributes
+        # nothing; the node registers with what the others found
+        except Exception:  # nomadlint: disable=EXC001 — probe, absent is fine
             pass
     return node
 

@@ -366,7 +366,10 @@ class CNINetworkManager:
                                 f"{e!r}")
             try:
                 self.netns("delete", ns)
-            except Exception:           # noqa: BLE001
+            # unwind path: the ORIGINAL setup error re-raises below and
+            # carries the diagnosis; a secondary netns-delete failure
+            # must not mask it
+            except Exception:  # nomadlint: disable=EXC001 — rollback
                 pass
             raise
         result = prev or {}

@@ -176,7 +176,9 @@ class PluginProcess:
         if self._sock is not None:
             try:
                 self._call("Shutdown")
-            except Exception:           # noqa: BLE001
+            # polite-shutdown RPC to a possibly-dead plugin; terminate()
+            # below is the enforcement path either way
+            except Exception:  # nomadlint: disable=EXC001 — best-effort RPC
                 pass
             try:
                 self._sock.close()
@@ -392,7 +394,9 @@ class ExternalCSIPlugin(PluginProcess):
                 self.logger(f"csi: plugin {self.name!r} down; relaunching")
                 try:
                     self.shutdown()
-                except Exception:       # noqa: BLE001 — already dead
+                # tearing down a process already observed dead; _launch
+                # below raises loudly if the relaunch fails
+                except Exception:  # nomadlint: disable=EXC001 — already dead
                     pass
                 self._launch()
         return self._call(method, **params)

@@ -166,3 +166,20 @@ class Registry:
 
 
 metrics = Registry()
+
+
+def record_swallowed_error(site: str, err: BaseException,
+                           logger=None) -> None:
+    """EXC001 discipline: daemon paths that deliberately survive an
+    exception must still surface it — a total `nomad.swallowed_errors`
+    counter (plus a per-site breakdown) moves on the /v1/metrics page,
+    and the owning component's logger gets one line. `logger=None` keeps
+    the counter for components without one (e.g. the state store's event
+    sinks)."""
+    metrics.incr("nomad.swallowed_errors")
+    metrics.incr(f"nomad.swallowed_errors.{site}")
+    if logger is not None:
+        try:
+            logger(f"{site}: swallowed {err!r}")
+        except Exception:       # noqa: BLE001 — telemetry must not throw
+            pass

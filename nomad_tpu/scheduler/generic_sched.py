@@ -9,6 +9,7 @@ scoring).
 """
 from __future__ import annotations
 
+import random
 import time
 from typing import Optional
 
@@ -122,7 +123,13 @@ class GenericScheduler:
                 self.deployment = None
 
         self.ctx = EvalContext(self.state, self.plan, self.logger)
-        self.stack = GenericStack(self.batch, self.ctx)
+        # per-eval seeded rng (DET001): the stack's shuffle and the TPU
+        # placer's permutation/jitter all draw from this stream, so one
+        # (snapshot, eval) replays bit-identically while concurrent
+        # workers (distinct eval ids) still decorrelate. str seeds hash
+        # via sha512 — stable across processes, unlike hash().
+        self.stack = GenericStack(self.batch, self.ctx,
+                                  rng=random.Random(eval.id))
         if self.job and not self.job.stopped():
             ready, by_dc = ready_nodes_in_dcs(self.state, self.job.datacenters)
             self.ctx.metrics.nodes_available = by_dc
@@ -193,7 +200,9 @@ class GenericScheduler:
         allocs = self.state.allocs_by_job(eval.namespace, eval.job_id)
         tainted = tainted_nodes(self.state, allocs)
 
-        now = time.time()
+        # reschedule/disconnect windows are wall-clock by SPEC (the
+        # reference compares against real time everywhere)
+        now = time.time()   # nomadlint: disable=DET001 — spec wall clock
         update_non_terminal_allocs_to_lost(self.plan, tainted, allocs,
                                            job=self.job, now=now)
 
@@ -451,6 +460,8 @@ class GenericScheduler:
         if prev.reschedule_tracker:
             events = list(prev.reschedule_tracker.events)
         events.append(RescheduleEvent(
+            # event timestamps are observability data, not decisions
+            # nomadlint: disable=DET001 — spec wall clock
             reschedule_time_unix=time.time(),
             prev_alloc_id=prev.id,
             prev_node_id=prev.node_id))

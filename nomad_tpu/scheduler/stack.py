@@ -53,7 +53,11 @@ class GenericStack:
                  rng: Optional[random.Random] = None):
         self.batch = batch
         self.ctx = ctx
-        self.rng = rng or random.Random()
+        # the scheduler passes a per-eval seeded Random (seeded from the
+        # eval id) so identical (snapshot, eval, seed) inputs reproduce
+        # bit-identical placements; the bare default is deterministic too
+        # rather than OS-entropy-seeded (DET001)
+        self.rng = rng if rng is not None else random.Random(0)
         self.job_version: Optional[int] = None
 
         self.source = StaticIterator(ctx, [])

@@ -176,7 +176,9 @@ def update_non_terminal_allocs_to_lost(plan, tainted: dict[str, Optional[Node]],
     pass the same timestamp the reconciler uses so both ends of the
     disconnect window agree (0 falls back to wall clock)."""
     import time as _time
-    now = now or _time.time()
+    # callers inject the eval clock; bare wall clock is the documented
+    # fallback contract above
+    now = now or _time.time()   # nomadlint: disable=DET001 — spec fallback
     for alloc in allocs:
         node = tainted.get(alloc.node_id, "absent")
         if node == "absent":

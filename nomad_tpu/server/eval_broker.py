@@ -80,7 +80,7 @@ class EvalBroker:
             was = self._enabled
             self._enabled = enabled
             if not enabled:
-                self._flush()
+                self._flush_locked()
             elif not was:
                 self._shutdown = False
                 self._timer = threading.Thread(
@@ -92,7 +92,9 @@ class EvalBroker:
     def enabled(self) -> bool:
         return self._enabled
 
-    def _flush(self) -> None:
+    def _flush_locked(self) -> None:
+        """Caller holds self._lock (the *_locked convention LOCK001
+        checks; ref eval_broker.go flush, called under b.l)."""
         self._ready.clear()
         self._ready_jobs.clear()
         self._evals.clear()
@@ -102,7 +104,13 @@ class EvalBroker:
         self._dequeue_count.clear()
         self._delay_heap = []
         self._shutdown = True
+        # every stat is maintained incrementally (+=/-=) against the
+        # queues just cleared — zero them ALL or the stats endpoint
+        # reports a phantom backlog for the life of the process
+        self.stats["total_ready"] = 0
         self.stats["total_unacked"] = 0
+        self.stats["total_pending"] = 0
+        self.stats["total_waiting"] = 0
         self._notify_inflight()
 
     # ------------------------------------------------------------- enqueue

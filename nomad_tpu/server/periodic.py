@@ -64,7 +64,9 @@ def cron_next(spec: str, after: float, tz: str = "UTC") -> Optional[float]:
         try:
             from zoneinfo import ZoneInfo
             zone = ZoneInfo(tz)
-        except Exception:   # noqa: BLE001 — unknown zone: fall back UTC
+        # unknown zone name: UTC fallback below is the documented
+        # behavior, not a silent drop
+        except Exception:  # nomadlint: disable=EXC001 — UTC fallback
             pass
     t = datetime.fromtimestamp(after, tz=zone).replace(
         second=0, microsecond=0) + timedelta(minutes=1)

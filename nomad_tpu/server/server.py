@@ -146,6 +146,9 @@ class Server:
         self.name = name or f"server-{new_id()[:8]}"
         self.fsm = NomadFSM()
         self.state: StateStore = self.fsm.state
+        # event-sink failures in _emit log through the agent (counted in
+        # nomad.swallowed_errors either way)
+        self.state.logger = self.logger
         self.raft = RaftLog(self.fsm)
         self.eval_broker = EvalBroker()
         from .event_broker import EventBroker

@@ -94,6 +94,7 @@ class MicroBatcher:
         """The eval broker's outstanding (dequeued, unacked) eval count —
         pushed on every dequeue/ack/nack. Int store is atomic under the
         GIL; no lock on the broker's hot path."""
+        # nomadlint: disable=LOCK001 — deliberate GIL-atomic store (above)
         self._broker_hint = max(0, int(n))
 
     def concurrency(self) -> int:
@@ -178,10 +179,15 @@ class MicroBatcher:
             req.event.set()
 
     def _batched_fn(self, static_key: tuple, inner):
-        fn = self._vmapped.get(static_key)
-        if fn is None:
-            import jax
-            fn = self._vmapped[static_key] = jax.jit(jax.vmap(inner))
+        # get-or-create under the lock: two leaders (different shape
+        # queues, same static key) racing the miss would each build a
+        # wrapper and one compile cache would be silently discarded —
+        # construction is cheap, tracing happens later outside the lock
+        with self._lock:
+            fn = self._vmapped.get(static_key)
+            if fn is None:
+                import jax
+                fn = self._vmapped[static_key] = jax.jit(jax.vmap(inner))
         return fn
 
     def reset(self) -> None:

@@ -25,7 +25,6 @@ eval for the standard refresh-and-retry). `plan_pipeline_enabled=False`
 from __future__ import annotations
 
 import os
-import random
 import time
 
 import numpy as np
@@ -44,6 +43,22 @@ from .tensorize import (
 )
 
 _usage_update_fn = None
+_preempt_batched_fn = None
+
+
+def _preempt_batched():
+    """Module-cached jit(vmap(preempt_top_k)): jax.jit keys its compile
+    cache per (C, V_pad) bucket on the WRAPPER object, so constructing
+    the wrapper inside _preempt_batch threw that cache away and re-traced
+    every preemption pass (nomadlint JIT002)."""
+    global _preempt_batched_fn
+    if _preempt_batched_fn is None:
+        import jax
+
+        from .kernels import preempt_top_k
+        _preempt_batched_fn = jax.jit(jax.vmap(
+            preempt_top_k, in_axes=(0, 0, None, 0, None)))
+    return _preempt_batched_fn
 
 
 def _usage_update(used, coll, placed, ask):
@@ -76,7 +91,7 @@ class _SolvePrep:
     the same compiled artifact and regime as the one-shot solve)."""
     __slots__ = ("gt", "n", "count", "use_scan", "use_depth", "k_max",
                  "sp", "dp", "aff", "max_per_node", "spread_alg",
-                 "depth_grid", "jitter", "bias_g", "m")
+                 "depth_grid", "jitter", "bias_g", "m", "distincts")
 
 
 class SolverPlacer:
@@ -141,14 +156,18 @@ class SolverPlacer:
         for tg_name, missings in by_tg.items():
             tg = sched.job.lookup_task_group(tg_name)
             mi = -1
+            prep = None
             if self._pipeline_eligible(tg, missings, by_tg, leftovers):
-                pipelined = self._pipelined_place(tg, nodes, missings,
-                                                  deployment_id)
+                pipelined, prep = self._pipelined_place(
+                    tg, nodes, missings, deployment_id)
                 if pipelined is not None:
                     mi = pipelined
             if mi < 0:           # serial path (ineligible or scan-shaped)
+                # a declined pipeline hands its prep over: tensorize,
+                # shuffle, and the per-eval RNG draws must not run twice
                 with metrics.measure("nomad.solver.solve"):
-                    placed_map = self._solve_group(tg, nodes, len(missings))
+                    placed_map = self._solve_group(tg, nodes,
+                                                   len(missings), prep=prep)
                 node_iter = [(node, k) for node, k in placed_map if k > 0]
                 # TGs with no sequential resources (ports/devices/cores)
                 # need no per-alloc exact pass: stamp out the allocations
@@ -217,10 +236,12 @@ class SolverPlacer:
         # 1 — plan-rejection parity). The kernel's stable argsort follows
         # this order for score ties, exactly like the host stack's shuffle.
         # numpy permutation (C loop) — random.shuffle costs ~7ms at 10k
-        # nodes, a real slice of small-eval latency; seeding from the
-        # global random stream keeps test reproducibility.
+        # nodes, a real slice of small-eval latency; seeded from the
+        # stack's per-eval rng (DET001), so identical (snapshot, eval,
+        # seed) inputs shuffle identically while concurrent workers
+        # (distinct eval ids) still decorrelate.
         perm = np.random.default_rng(
-            random.getrandbits(64)).permutation(len(nodes))
+            self.sched.stack.rng.getrandbits(64)).permutation(len(nodes))
         nodes = [nodes[i] for i in perm]
 
         feasible_fn = self._feasibility_fn(tg)
@@ -292,6 +313,7 @@ class SolverPlacer:
         prep.gt = gt
         prep.n = n
         prep.count = count
+        prep.distincts = distincts
         prep.use_scan = use_scan
         prep.use_depth = use_depth
         prep.k_max = k_max
@@ -331,7 +353,8 @@ class SolverPlacer:
             # the jitter array is ALWAYS passed — the kernel gates it on
             # jitter_samples<=0 with a traced where, so the deterministic
             # and jittered regimes share one compiled artifact
-            rng = np.random.default_rng(random.getrandbits(64))
+            rng = np.random.default_rng(
+                self.sched.stack.rng.getrandbits(64))
             prep.jitter = rng.random(gt.cap.shape[0], dtype=np.float32)
             if affinities or m > 3.0:
                 prep.bias_g = 1.0
@@ -365,8 +388,10 @@ class SolverPlacer:
                 np.int32(prep.max_per_node), prep.jitter,
                 np.float32(prep.bias_g), np.float32(prep.m))
 
-    def _solve_group(self, tg, nodes, count: int):
+    def _solve_group(self, tg, nodes, count: int, prep=None):
         """Run the batched kernel; returns [(node, count)] sorted best-first.
+        `prep` reuses a declined pipeline's solve prep (same regime, same
+        RNG stream position) instead of rebuilding it.
 
         The full GenericStack feature matrix is tensorized: affinities,
         multiple/targeted/negative spreads, distinct_property and
@@ -376,7 +401,8 @@ class SolverPlacer:
         previous-node penalty state) and canaries (per-alloc preferred
         nodes) — both are small by construction (failed allocs, canary
         counts), so the per-alloc stack cost is bounded."""
-        prep = self._prep_solve(tg, nodes, count)
+        if prep is None:
+            prep = self._prep_solve(tg, nodes, count)
         if prep is None:
             return []
         gt = prep.gt
@@ -384,7 +410,7 @@ class SolverPlacer:
         sp, dp, aff = prep.sp, prep.dp, prep.aff
         spread_alg, max_per_node = prep.spread_alg, prep.max_per_node
         n = prep.n
-        distincts = self._distinct_property_sets(tg)
+        distincts = prep.distincts
         metrics.incr(
             "nomad.solver.kernel.place_chunked" if use_scan
             else "nomad.solver.kernel.fill_depth" if use_depth
@@ -509,9 +535,10 @@ class SolverPlacer:
 
     def _pipelined_place(self, tg, nodes, missings, deployment_id: str):
         """Chunked solve + per-chunk materialize/evaluate/commit with all
-        device dispatches enqueued asynchronously up front. Returns the
-        number of missings placed, or None to fall back to the serial
-        path (scan-shaped solves, degenerate preps).
+        device dispatches enqueued asynchronously up front. Returns
+        (placed_count, prep); placed_count is None on a decline (scan-
+        shaped solves, degenerate preps), and the serial fallback reuses
+        `prep` so tensorize/shuffle/RNG draws never run twice.
 
         Timeline for C chunks (device work ▓, host work ░):
 
@@ -543,7 +570,7 @@ class SolverPlacer:
             # stay serial. distinct_property never gets here (scan-shaped).
             if prep is None or not prep.use_depth or \
                     prep.depth_grid is not None or prep.gt.distinct_hosts:
-                return None
+                return None, prep
             metrics.incr("nomad.solver.kernel.fill_depth")
             bname, depth_fn = backend.select(
                 "depth", prep.gt.cap.shape[0], count=count,
@@ -637,7 +664,7 @@ class SolverPlacer:
             # and retries the remainder — the serial path's partial-
             # commit semantics, applied per chunk
             sched._pipeline_partial = True
-        return mi
+        return mi, prep
 
     def _distinct_property_sets(self, tg):
         """PropertySets for every distinct_property constraint in scope
@@ -717,13 +744,10 @@ class SolverPlacer:
         victims enter the plan. Returns the missings still unplaced
         (non-simple TGs skip straight to the host fallback, which retries
         with the scalar Preemptor)."""
-        import jax
-
         from ..scheduler.reconcile import AllocPlaceResult
         from ..state.usage_index import (
             alloc_usage_tuple, node_capacity_tuple,
         )
-        from .kernels import preempt_top_k
         from .tensorize import group_ask_row
 
         sched = self.sched
@@ -779,9 +803,7 @@ class SolverPlacer:
                 free[i] -= alloc_usage_tuple(a)
         ask = group_ask_row(tg)
 
-        batched = jax.jit(jax.vmap(preempt_top_k,
-                                   in_axes=(0, 0, None, 0, None)))
-        masks = np.asarray(batched(
+        masks = np.asarray(_preempt_batched()(
             jnp.asarray(victim_res), jnp.asarray(victim_prio),
             jnp.asarray(ask), jnp.asarray(free), jnp.int32(job_prio)))
 

@@ -22,6 +22,7 @@ from typing import Callable, Iterable, Optional
 
 from .usage_index import UsageIndex
 
+from ..metrics import record_swallowed_error
 from ..structs import (
     Allocation, Deployment, Evaluation, Job, Node, SchedulerConfiguration,
     ALLOC_CLIENT_LOST, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_RUNNING,
@@ -85,6 +86,9 @@ class StateStore:
 
         # event sink (wired to the event broker by the server)
         self.event_sinks: list[Callable[[str, str, int, object], None]] = []
+        # optional: the owning server/agent wires its logger in so sink
+        # failures surface in the agent log (counted regardless)
+        self.logger: Optional[Callable[[str], None]] = None
 
     # ------------------------------------------------------------------ core
 
@@ -111,8 +115,11 @@ class StateStore:
         for sink in self.event_sinks:
             try:
                 sink(topic, etype, index, payload)
-            except Exception:
-                pass
+            except Exception as e:      # noqa: BLE001
+                # a broken sink must not block commits, but a sink that
+                # silently stops delivering is an invisible outage —
+                # count it (EXC001; logger is optional, agents wire one)
+                record_swallowed_error("state.emit", e, self.logger)
 
     def snapshot(self) -> "StateSnapshot":
         with self._lock:

@@ -190,10 +190,16 @@ def test_drain_migrates_allocs(cluster):
     # poll adds up to its interval on top, so the bound is deadline plus
     # a small fixed slop — not 90s of "whenever"
     deadline_slop_s = 5.0
+    # the elapsed asserts allow a margin ON TOP of the wait bound: a
+    # wait that succeeds just inside its timeout still pays one poll
+    # interval + HTTP probe latency before elapsed is measured, so an
+    # identical bound would flake on runs the wait legitimately accepted
+    elapsed_margin_s = 2.0
     assert wait_until(drained, timeout=drain_deadline_s + deadline_slop_s), \
         _diagnose(cluster)
     drained_elapsed = time.monotonic() - drain_t0
-    assert drained_elapsed < drain_deadline_s + deadline_slop_s, \
+    assert drained_elapsed < drain_deadline_s + deadline_slop_s \
+        + elapsed_margin_s, \
         f"drain took {drained_elapsed:.1f}s, deadline {drain_deadline_s}s"
     # every service job still has its full count, now on the other node —
     # replacements must also land within the drain-deadline window
@@ -210,7 +216,8 @@ def test_drain_migrates_allocs(cluster):
             f"{jid} did not migrate within the drain deadline:\n" + \
             _diagnose(cluster, jid)
     migrate_elapsed = time.monotonic() - drain_t0
-    assert migrate_elapsed < drain_deadline_s + deadline_slop_s, \
+    assert migrate_elapsed < drain_deadline_s + deadline_slop_s \
+        + elapsed_margin_s, \
         f"migration took {migrate_elapsed:.1f}s vs {drain_deadline_s}s deadline"
     # un-drain so later tests get both nodes back
     cluster.send_leader(f"/v1/node/{drain_id}/drain",

@@ -541,6 +541,59 @@ def test_fuzz_concurrent_workers_alloc_rejection_parity():
             f"host {node_host:.4f}"
 
 
+# ------------------------------------------------- determinism (DET001)
+
+def test_fixed_seed_bit_identical_placements():
+    """ISSUE 2 acceptance: after the DET001 fix (per-eval rng seeded from
+    the eval id, threaded from GenericStack through the solver's
+    shuffle/jitter draws), identical (snapshot, eval, seed) inputs give
+    BIT-IDENTICAL placements across two independent runs — for both
+    depth regimes: jittered sampled-grid (count << nodes, the E-S order
+    jitter actually draws) and deterministic full-curve (m > 3)."""
+
+    def run(count: int, eval_id: str):
+        random.seed(1234)       # global stream: must NOT matter anymore
+        h = Harness()
+        h.state.set_scheduler_config(
+            h.get_next_index(),
+            SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+        for i in range(16):
+            n = mock.node()
+            n.id = f"node-{i:04d}"          # pin ids so runs compare
+            n.name = f"det-{i}"
+            h.state.upsert_node(h.get_next_index(), n)
+        job = mock.batch_job()
+        job.id = job.name = f"det-job-{count}"
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.networks = []
+        t = tg.tasks[0]
+        t.resources.networks = []
+        t.resources.cpu = 250
+        t.resources.memory_mb = 128
+        h.state.upsert_job(h.get_next_index(), job)
+        ev = Evaluation(id=eval_id, job_id=job.id, type=job.type)
+        h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+        placed: dict[str, int] = {}
+        for a in h.state.allocs_by_job("default", job.id):
+            placed[a.node_id] = placed.get(a.node_id, 0) + 1
+        return placed
+
+    for count in (6, 48):       # jittered regime / deterministic regime
+        a = run(count, "det-eval-1")
+        # desync the global RNG between runs to prove independence
+        random.seed(999)
+        random.getrandbits(64)
+        b = run(count, "det-eval-1")
+        assert sum(a.values()) == count
+        assert a == b, f"count={count}: run A {a} != run B {b}"
+        # a DIFFERENT eval id decorrelates (the concurrent-worker
+        # property the shuffle exists for) — placements are allowed to
+        # differ, and for the jittered regime they essentially always do
+        c = run(count, "det-eval-2")
+        assert sum(c.values()) == count
+
+
 # ---------------------------------------------- pipelined plan lifecycle
 
 PIPELINE_ON = {"plan_pipeline_min_count": 1, "plan_pipeline_chunks": 3}
