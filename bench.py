@@ -604,6 +604,17 @@ def main() -> None:
         if total_pl else 1.0,
         "backend_tiers_headline": headline_tiers,
         "backend_tiers_stream": stream_tiers,
+        # ISSUE 3 lineage: breaker/demotion/dead-letter counters so a
+        # future regression gate can assert a healthy bench run stays
+        # chaos-free (all zeros) while chaos runs leave evidence
+        "robustness": {
+            k: int(v) for k, v in metrics.snapshot()["counters"].items()
+            if k.startswith(("nomad.solver.tier_",
+                             "nomad.solver.microbatch.fanout",
+                             "nomad.broker.dead_letter",
+                             "nomad.worker.eval_failures",
+                             "nomad.swallowed_errors",
+                             "nomad.faults.fired"))},
     }))
 
 

@@ -482,6 +482,31 @@ class HTTPAPI:
                     address=addr), None
             except ValueError as e:
                 raise HTTPError(400, str(e))
+        if parts[:2] == ["operator", "broker"]:
+            # the broker only exists on the leader: answering from a
+            # follower would report an empty dead-letter queue while the
+            # sick evals keep retrying — raise so the HTTP layer's
+            # transparent follower->leader forwarding engages
+            if s.raft_node is not None and not s.is_leader:
+                raise NotLeaderError(s.leader_rpc_addr)
+        if parts == ["operator", "broker", "failed"] and method == "GET":
+            # dead-letter visibility (ISSUE 3 failed-eval lifecycle)
+            require(acl.allow_operator_read())
+            evs = s.eval_broker.failed_evals()
+            return {"Evals": [to_api(e) for e in evs],
+                    "Count": len(evs),
+                    "Stats": dict(s.eval_broker.stats)}, None
+        if parts == ["operator", "broker", "drain-failed"] and \
+                method in ("PUT", "POST"):
+            # operator drain: terminate dead-lettered evals (and cancel
+            # their waiting follow-ups) WITHOUT retry — takes an
+            # unrecoverable eval out of the loop (ref the
+            # `nomad eval delete` escape hatch)
+            require(acl.allow_operator_write())
+            out = s.eval_drain_failed()
+            return {"DrainedEvals": out["drained"],
+                    "CancelledFollowUps": out["cancelled_follow_ups"],
+                    "Count": out["count"]}, None
         if parts == ["operator", "autopilot", "configuration"]:
             if method == "GET":
                 require(acl.allow_operator_read())

@@ -1,18 +1,45 @@
 """Core (GC) scheduler (ref nomad/core_sched.go:27): internal `_core` evals
 garbage-collect terminal evals/allocs, dead jobs, down nodes and finished
 deployments past a GC threshold.
+
+Also owns the dead-letter half of the failed-eval lifecycle (ISSUE 3):
+evals that exhaust their broker delivery limit are terminated as failed
+and re-tried via a delayed `failed-follow-up` eval whose wait grows with
+capped exponential backoff per generation — a permanently-broken eval
+backs off to FAILED_EVAL_BACKOFF_CAP_S instead of hot-looping workers,
+while a transiently-broken one (device loss, raft hiccup) retries
+quickly. Operators can take an eval out of the loop entirely with the
+agent's /v1/operator/broker/drain-failed.
 """
 from __future__ import annotations
 
 import time
 
+from ..metrics import metrics
 from ..structs import (
     Evaluation, CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
-    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_FORCE_GC, DEPLOYMENT_TERMINAL,
-    JOB_STATUS_DEAD, EVAL_STATUS_COMPLETE,
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_FAILED_EVAL_REAP, CORE_JOB_FORCE_GC,
+    DEPLOYMENT_TERMINAL, JOB_STATUS_DEAD, EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
 )
-from .fsm import (DEPLOYMENT_DELETE, EVAL_DELETE, JOB_DEREGISTER,
-                  NODE_DEREGISTER)
+from .eval_broker import FAILED_QUEUE
+from .fsm import (DEPLOYMENT_DELETE, EVAL_DELETE, EVAL_UPDATE,
+                  JOB_DEREGISTER, NODE_DEREGISTER)
+
+# failed-follow-up backoff: base * 2^generation, capped (ref
+# nomad/leader.go:782 reapFailedEvaluations, which uses a fixed 1m wait;
+# the cap keeps a permanently-failing eval to ~4 retries/hour)
+FAILED_EVAL_BACKOFF_BASE_S = 60.0
+FAILED_EVAL_BACKOFF_CAP_S = 900.0
+
+
+def failed_follow_up_wait(ev: Evaluation) -> float:
+    """Deterministic capped exponential backoff keyed on the eval's
+    follow-up generation (no jitter: determinism is a correctness
+    property here, DET001)."""
+    gen = min(max(int(ev.failed_follow_ups), 0), 16)
+    return min(FAILED_EVAL_BACKOFF_CAP_S,
+               FAILED_EVAL_BACKOFF_BASE_S * (2 ** gen))
 
 
 class CoreScheduler:
@@ -40,9 +67,40 @@ class CoreScheduler:
             self.node_gc(force)
         if kind in (CORE_JOB_DEPLOYMENT_GC,) or force:
             self.deployment_gc(force)
+        if kind in (CORE_JOB_FAILED_EVAL_REAP,) or force:
+            self.reap_failed_evals()
 
     def _cutoff(self, threshold: float, force: bool) -> float:
         return time.time() if force else time.time() - threshold
+
+    def reap_failed_evals(self) -> int:
+        """Dead-letter consumer (ref leader.go:782 reapFailedEvaluations):
+        terminate each dead-lettered eval as failed and emit the delayed
+        failed-follow-up with capped exponential backoff. Called every
+        leader-loop tick and by `_core`/force-gc evals."""
+        broker = self.server.eval_broker
+        n = 0
+        while True:
+            ev, token = broker.dequeue([FAILED_QUEUE], timeout=0.0)
+            if ev is None:
+                return n
+            failed = ev.copy()
+            failed.status = EVAL_STATUS_FAILED
+            failed.status_description = "evaluation reached delivery limit"
+            wait = failed_follow_up_wait(ev)
+            follow_up = ev.create_failed_follow_up_eval(wait_sec=wait)
+            self.server.raft.apply(EVAL_UPDATE,
+                                   {"evals": [failed, follow_up]})
+            # count AFTER the commit: a failed apply redelivers the
+            # eval and re-reaps it later — counting up front would
+            # overstate reaps in the bench robustness block
+            metrics.incr("nomad.broker.dead_letter_reaped")
+            metrics.add_sample("nomad.broker.dead_letter_backoff", wait)
+            try:
+                broker.ack(ev.id, token)
+            except ValueError:
+                pass
+            n += 1
 
     def eval_gc(self, force: bool = False) -> int:
         """ref core_sched.go:231 evalGC: terminal evals whose allocs are all

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Optional
 
+from ..metrics import metrics
 from ..structs import Evaluation, new_id
 
 DEFAULT_NACK_TIMEOUT = 60.0
@@ -61,7 +62,8 @@ class EvalBroker:
         self._shutdown = False
 
         self.stats = {"total_ready": 0, "total_unacked": 0,
-                      "total_pending": 0, "total_waiting": 0}
+                      "total_pending": 0, "total_waiting": 0,
+                      "total_failed": 0}
 
     def _notify_inflight(self) -> None:
         """Push the outstanding-eval count to the solver micro-batcher
@@ -111,6 +113,8 @@ class EvalBroker:
         self.stats["total_unacked"] = 0
         self.stats["total_pending"] = 0
         self.stats["total_waiting"] = 0
+        self.stats["total_failed"] = 0
+        metrics.set_gauge("nomad.broker.failed_queue_depth", 0)
         self._notify_inflight()
 
     # ------------------------------------------------------------- enqueue
@@ -204,6 +208,10 @@ class EvalBroker:
             return None
         _, _, eval_id = heapq.heappop(self._ready[best_queue])
         ev = self._evals.pop(eval_id)
+        if best_queue == FAILED_QUEUE:
+            self.stats["total_failed"] -= 1
+            metrics.set_gauge("nomad.broker.failed_queue_depth",
+                              self.stats["total_failed"])
         if ev.job_id and self._ready_jobs.get((ev.namespace, ev.job_id)) == eval_id:
             del self._ready_jobs[(ev.namespace, ev.job_id)]
         self.stats["total_ready"] -= 1
@@ -280,12 +288,18 @@ class EvalBroker:
             count = self._dequeue_count.get(eval_id, 1)
             if count >= self.delivery_limit:
                 # dead-letter: deliver once more via the failed queue
+                # (the leader's reaper terminates it and emits the
+                # backed-off failed-follow-up, ref leader.go:782)
                 self._evals[ev.id] = ev
                 if ev.job_id:
                     self._ready_jobs[job_key] = ev.id
                 heapq.heappush(self._ready.setdefault(FAILED_QUEUE, []),
                                (-ev.priority, next(self._seq), ev.id))
                 self.stats["total_ready"] += 1
+                self.stats["total_failed"] += 1
+                metrics.incr("nomad.broker.dead_letter")
+                metrics.set_gauge("nomad.broker.failed_queue_depth",
+                                  self.stats["total_failed"])
             else:
                 delay = (self.initial_nack_delay if count == 1
                          else self.subsequent_nack_delay)
@@ -294,6 +308,85 @@ class EvalBroker:
                 self.stats["total_waiting"] += 1
             self._notify_inflight()
             self._cond.notify_all()
+
+    # ------------------------------------------------------ dead letters
+
+    def failed_evals(self) -> list[Evaluation]:
+        """The evals currently parked on the dead-letter queue (operator
+        visibility via /v1/operator/broker/failed)."""
+        with self._lock:
+            heap = self._ready.get(FAILED_QUEUE, [])
+            return [self._evals[eid] for _, _, eid in heap
+                    if eid in self._evals]
+
+    def drain_failed(self) -> tuple[list[Evaluation], list[Evaluation]]:
+        """Operator drain: atomically remove every dead-lettered eval
+        AND every not-yet-dispatched failed-follow-up (delay heap or
+        ready, not outstanding) from the queue. One lock acquisition
+        covers both, so the leader reaper — which converts dead letters
+        into delayed follow-ups every tick — cannot interleave: whatever
+        form the broken eval currently takes, the drain catches it. The
+        caller terminates them in state and RESTORES them via
+        enqueue/restore_failed if that commit fails. Pending evals
+        blocked behind a drained eval's job are released, like an ack
+        would. Returns (dead_letters, follow_ups)."""
+        from ..structs import TRIGGER_FAILED_FOLLOW_UP
+        with self._lock:
+            heap = self._ready.get(FAILED_QUEUE, [])
+            drained = [self._evals.pop(eid) for _, _, eid in heap
+                       if eid in self._evals]
+            self._ready.pop(FAILED_QUEUE, None)
+            self.stats["total_ready"] -= len(drained)
+            self.stats["total_failed"] -= len(drained)
+            # waiting follow-ups in the delay heap
+            follows = []
+            keep = []
+            for item in self._delay_heap:
+                if item[2].triggered_by == TRIGGER_FAILED_FOLLOW_UP:
+                    follows.append(item[2])
+                    self.stats["total_waiting"] -= 1
+                else:
+                    keep.append(item)
+            if follows:
+                heapq.heapify(keep)
+                self._delay_heap = keep
+            # ready (undelivered) follow-ups; outstanding ones are left
+            # to finish — their result commits through the normal path
+            for qname, qheap in self._ready.items():
+                for _, _, eid in list(qheap):
+                    ev = self._evals.get(eid)
+                    if ev is not None and \
+                            ev.triggered_by == TRIGGER_FAILED_FOLLOW_UP:
+                        follows.append(self._evals.pop(eid))
+                        self.stats["total_ready"] -= 1
+            removed = drained + follows
+            for ev in removed:
+                self._dequeue_count.pop(ev.id, None)
+                job_key = (ev.namespace, ev.job_id)
+                if self._ready_jobs.get(job_key) == ev.id:
+                    del self._ready_jobs[job_key]
+                pending = self._pending.get(job_key)
+                if pending:
+                    nxt = pending.pop(0)
+                    if not pending:
+                        del self._pending[job_key]
+                    self.stats["total_pending"] -= 1
+                    self._enqueue_locked(nxt)
+            if drained:
+                metrics.incr("nomad.broker.dead_letter_drained",
+                             len(drained))
+            metrics.set_gauge("nomad.broker.failed_queue_depth",
+                              self.stats["total_failed"])
+            self._cond.notify_all()
+            return drained, follows
+
+    def restore_failed(self, evals: list[Evaluation]) -> None:
+        """Put drained evals back (the drain's raft commit failed): they
+        re-enter the normal queues; their preserved dequeue counts send
+        repeat offenders straight back to the dead-letter path."""
+        with self._lock:
+            for ev in evals:
+                self._enqueue_locked(ev)
 
     # -------------------------------------------------------- delay watcher
 

@@ -305,6 +305,8 @@ class RaftLog:
         self._index = fsm.state.latest_index()
 
     def apply(self, msg_type: str, payload: dict) -> int:
+        from .. import faults
+        faults.fire("raft.apply")
         # the lock spans index assignment AND application so state-store
         # mutations happen in strict log order (replay determinism)
         with self._lock:

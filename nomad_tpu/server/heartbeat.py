@@ -12,6 +12,8 @@ import threading
 import time
 from typing import Optional
 
+from .. import faults
+from ..metrics import record_swallowed_error
 from ..structs import (
     Evaluation, NODE_STATUS_DOWN, TRIGGER_NODE_UPDATE, JOB_TYPE_SYSTEM,
 )
@@ -20,6 +22,9 @@ from .fsm import EVAL_UPDATE, NODE_UPDATE_STATUS
 DEFAULT_MIN_TTL = 10.0
 DEFAULT_TTL_SPREAD = 5.0
 DEFAULT_CHECK_INTERVAL = 1.0
+# a failed invalidate re-arms the node's deadline this far out, so the
+# next sweep retries instead of forgetting the node forever (ISSUE 3)
+INVALIDATE_RETRY_BACKOFF_S = 2.0
 
 
 class HeartbeatTimers:
@@ -60,24 +65,40 @@ class HeartbeatTimers:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            now = time.time()
-            expired = []
-            with self._lock:
-                for node_id, deadline in list(self._deadlines.items()):
-                    if deadline <= now:
-                        expired.append(node_id)
-                        del self._deadlines[node_id]
-            for node_id in expired:
-                try:
-                    self._invalidate(node_id)
-                except Exception as e:   # noqa: BLE001
-                    self.server.logger(f"heartbeat: invalidate {node_id[:8]}: "
-                                       f"{e!r}")
+            self._sweep(time.time())
             self._stop.wait(DEFAULT_CHECK_INTERVAL)
+
+    def _sweep(self, now: float) -> None:
+        """One reaper pass. The deadline is deleted only AFTER a
+        successful invalidate: the old order (delete, then invalidate)
+        meant a transient raft error left the node untracked and
+        "ready" forever. On failure the deadline is re-armed with a
+        short backoff so the next sweep retries — unless a heartbeat
+        landed mid-invalidate (deadline moved), in which case the node
+        is alive again and the newer deadline wins."""
+        with self._lock:
+            expired = [(node_id, deadline)
+                       for node_id, deadline in self._deadlines.items()
+                       if deadline <= now]
+        for node_id, observed in expired:
+            try:
+                self._invalidate(node_id)
+            except Exception as e:   # noqa: BLE001
+                record_swallowed_error("heartbeat.invalidate", e,
+                                       self.server.logger)
+                with self._lock:
+                    if self._deadlines.get(node_id) == observed:
+                        self._deadlines[node_id] = \
+                            time.time() + INVALIDATE_RETRY_BACKOFF_S
+            else:
+                with self._lock:
+                    if self._deadlines.get(node_id) == observed:
+                        del self._deadlines[node_id]
 
     def _invalidate(self, node_id: str) -> None:
         """Missed TTL => down + evals (ref heartbeat.go:135
         invalidateHeartbeat)."""
+        faults.fire("heartbeat.invalidate")
         server = self.server
         node = server.state.node_by_id(node_id)
         if node is None or node.terminal_status():

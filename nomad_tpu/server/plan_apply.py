@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import faults
 from ..metrics import metrics
 from ..state import StateStore
 from ..structs import (
@@ -92,6 +93,10 @@ class Planner:
         self.queue = PlanQueue()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # the plan the applier thread has dequeued but not yet responded
+        # to — stop() must fail it if the thread dies/outlives the join,
+        # or a pipelined worker blocks on wait() forever (ISSUE 3)
+        self._inflight: Optional[_PendingPlan] = None
 
     def start(self) -> None:
         self.queue.set_enabled(True)
@@ -100,28 +105,39 @@ class Planner:
                                         name="plan-applier")
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
-        self.queue.set_enabled(False)
+        self.queue.set_enabled(False)      # queued pendings fail here
         if self._thread:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=timeout)
+        # a plan mid-apply when the join gave up (or the thread died)
+        # must still resolve — waiters see an error, not a hang. respond
+        # after a late applier respond is a harmless overwrite: every
+        # waiter already woke on the first event.set().
+        pending = self._inflight
+        if pending is not None and not pending.event.is_set():
+            pending.respond(None, "planner stopped")
 
     def _run(self) -> None:
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.5)
             if pending is None:
                 continue
+            self._inflight = pending
             try:
                 result = self.apply_plan(pending.plan)
                 pending.respond(result, None)
             except Exception as e:       # noqa: BLE001 - report to worker
                 pending.respond(None, str(e))
+            finally:
+                self._inflight = None
 
     # ------------------------------------------------------------ evaluate
 
     def apply_plan(self, plan: Plan) -> PlanResult:
         """Evaluate against latest state, then commit via the log
         (ref :204 applyPlan / :400 evaluatePlan)."""
+        faults.fire("planner.apply")
         t0 = time.perf_counter()
         snap = self.state.snapshot_min_index(plan.snapshot_index,
                                             timeout=5.0)

@@ -310,6 +310,8 @@ class RaftNode:
     def apply(self, msg_type: str, payload, timeout: float = 30.0):
         """Commit one message through the replicated log. Leader-only;
         raises NotLeaderError with a redirect hint on followers."""
+        from .. import faults
+        faults.fire("raft.apply")
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_addr)

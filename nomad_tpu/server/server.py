@@ -606,7 +606,14 @@ class Server:
         last_gc = time.time()
         while not self._leader_stop.wait(1.0):
             self.eval_broker.check_nack_timeouts()
-            self._reap_failed_evaluations()
+            try:
+                # a raft apply failing mid-reap (leadership transition,
+                # injected raft.apply fault) must not kill the loop: the
+                # dequeued eval's nack timeout redelivers it to the
+                # failed queue and the next tick retries
+                self._reap_failed_evaluations()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"failed-eval reap: {e!r}")
             try:
                 self._autopilot_cleanup_dead_servers()
             except Exception as e:      # noqa: BLE001
@@ -632,23 +639,45 @@ class Server:
                         priority=200, status="pending"))
 
     def _reap_failed_evaluations(self) -> None:
-        """Dead-letter consumer (ref leader.go:782): mark the eval failed and
-        schedule a delayed retry so a broken eval can't hot-loop workers."""
-        from ..structs import EVAL_STATUS_FAILED
-        while True:
-            ev, token = self.eval_broker.dequeue(["_failed"], timeout=0.0)
-            if ev is None:
-                return
+        """Dead-letter consumer (ref leader.go:782): the core scheduler
+        owns the terminate + backed-off failed-follow-up lifecycle."""
+        self.core_scheduler.reap_failed_evals()
+
+    def eval_drain_failed(self) -> dict:
+        """Operator drain of the broker dead-letter queue (agent HTTP
+        /v1/operator/broker/drain-failed): each drained eval terminates
+        as failed WITHOUT a follow-up — the operator is declaring it
+        unrecoverable (bad jobspec, decommissioned node class) and
+        taking it out of the retry loop."""
+        from ..structs import EVAL_STATUS_CANCELLED, EVAL_STATUS_FAILED
+        # one atomic broker removal covers dead letters AND their
+        # waiting follow-ups (the leader reaper converts one into the
+        # other every tick, so a two-step listing would race it); if the
+        # terminating raft commit then fails, everything is restored to
+        # the queue — nothing is lost, the operator simply retries
+        drained, follows = self.eval_broker.drain_failed()
+        updates = []
+        for ev in drained:
             failed = ev.copy()
             failed.status = EVAL_STATUS_FAILED
             failed.status_description = \
-                "evaluation reached delivery limit"
-            follow_up = ev.create_failed_follow_up_eval(wait_sec=60.0)
-            self.raft.apply(EVAL_UPDATE, {"evals": [failed, follow_up]})
+                "dead-lettered evaluation drained by operator"
+            updates.append(failed)
+        for ev in follows:
+            cancelled = ev.copy()
+            cancelled.status = EVAL_STATUS_CANCELLED
+            cancelled.status_description = \
+                "failed-follow-up cancelled by operator drain"
+            updates.append(cancelled)
+        if updates:
             try:
-                self.eval_broker.ack(ev.id, token)
-            except ValueError:
-                pass
+                self.raft.apply(EVAL_UPDATE, {"evals": updates})
+            except BaseException:
+                self.eval_broker.restore_failed(drained + follows)
+                raise
+        return {"drained": [ev.id for ev in drained],
+                "cancelled_follow_ups": [ev.id for ev in follows],
+                "count": len(drained) + len(follows)}
 
     def _on_eval_update(self, evals: list[Evaluation]) -> None:
         if not self.is_leader:

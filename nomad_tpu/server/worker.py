@@ -11,7 +11,8 @@ import threading
 import time
 from typing import Optional
 
-from ..metrics import metrics
+from .. import faults
+from ..metrics import metrics, record_swallowed_error
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_FAILED
 from .eval_broker import EvalBroker
@@ -60,6 +61,12 @@ class Worker:
             try:
                 self._invoke_scheduler(ev)
             except Exception as e:      # noqa: BLE001
+                # the nack path survives the exception, but it must not
+                # be invisible: a sick device/tier shows up here first
+                # (ISSUE 3 — counted per scheduler type for triage)
+                metrics.incr("nomad.worker.eval_failures")
+                metrics.incr(f"nomad.worker.eval_failures.{ev.type}")
+                record_swallowed_error("worker.run", e)
                 self.server.logger(f"worker-{self.id}: eval {ev.id[:8]} "
                                    f"failed: {e!r}")
                 try:
@@ -74,6 +81,7 @@ class Worker:
 
     def _invoke_scheduler(self, ev: Evaluation) -> None:
         """ref worker.go:552 invokeScheduler"""
+        faults.fire("worker.invoke")
         if ev.type == "_core":
             self.server.core_scheduler.process(ev)
             return
@@ -103,8 +111,11 @@ class Worker:
             try:
                 self._snapshot = self.server.state.snapshot_min_index(
                     result.refresh_index, timeout=5.0)
-            except TimeoutError:
-                pass
+            except TimeoutError as e:
+                # survivable (the stale snapshot just means another
+                # rejection/retry round) but never silent (ISSUE 3)
+                record_swallowed_error("worker.refresh_snapshot", e,
+                                       self.server.logger)
         return result
 
     def submit_plan_async(self, plan: Plan):

@@ -57,12 +57,28 @@ Normalized signatures:
 
 Env override: NOMAD_SOLVER_BACKEND=xla|pallas|sharded forces a tier
 (ops/debug escape hatch; sharded still requires >1 device).
+
+Degradation ladder (ISSUE 3 tentpole): every selected tier is wrapped in
+a per-call dispatch chain that demotes on device-tier failure —
+sharded/pallas/batch -> xla -> host — so a sick TPU degrades the cluster
+to host-solve instead of failing evals. A per-tier circuit breaker
+(BREAKER_* knobs below) opens after repeated failures inside a window,
+short-circuits the sick tier for a cooldown, then admits one half-open
+probe; `nomad.solver.tier_breaker_*` and `nomad.solver.tier_demotions*`
+counters expose the state machine. The host tier is the floor and is
+always attempted. Injected faults (`solver.dispatch.<tier>` sites,
+nomad_tpu/faults.py) ride the same catch as real XlaRuntimeErrors, so
+tier-1 proves the ladder deterministically (docs/FAULT_INJECTION.md).
 """
 from __future__ import annotations
 
 import functools
 import os
+import threading
+import time
+from contextlib import contextmanager
 
+from .. import faults
 from ..metrics import metrics
 
 # Thresholds are module-level so tests (and operators via monkeypatch)
@@ -70,6 +86,24 @@ from ..metrics import metrics
 PALLAS_MIN_NODES = 8192
 SHARD_MIN_NODES = 32768
 HOST_MAX_COUNT = 2048
+
+# Circuit-breaker tuning knobs (docs/FAULT_INJECTION.md): N failures
+# inside the window open the tier; after the cooldown one half-open
+# probe is admitted — success closes, failure re-opens.
+BREAKER_THRESHOLD = int(os.environ.get("NOMAD_BREAKER_THRESHOLD", "3"))
+BREAKER_WINDOW_S = float(os.environ.get("NOMAD_BREAKER_WINDOW_S", "30"))
+BREAKER_COOLDOWN_S = float(os.environ.get("NOMAD_BREAKER_COOLDOWN_S", "5"))
+
+# demotion order per selected tier; the last entry is the floor and is
+# never breaker-skipped. chunked's pallas remap happens in select(), so
+# a chunked chain never contains pallas.
+LADDER = {
+    "sharded": ("sharded", "xla", "host"),
+    "pallas": ("pallas", "xla", "host"),
+    "batch": ("batch", "host"),
+    "xla": ("xla", "host"),
+    "host": ("host",),
+}
 
 _cache: dict = {}
 _mesh_cache: dict = {}
@@ -79,6 +113,7 @@ def reset() -> None:
     """Drop cached selections (tests flip thresholds/env between cases)."""
     _cache.clear()
     _mesh_cache.clear()
+    _breaker.reset()
 
 
 def _mesh(devs):
@@ -88,6 +123,258 @@ def _mesh(devs):
         from .sharding import make_mesh
         m = _mesh_cache[key] = make_mesh(devs)
     return m
+
+
+# -------------------------------------------------- degradation ladder
+
+_DEVICE_ERRORS: tuple = ()
+
+
+def device_error_types() -> tuple:
+    """Exception types that mean 'this device/tier failed' (demotable),
+    as opposed to a bug in the solve itself. Built lazily: jax error
+    class locations vary across versions."""
+    global _DEVICE_ERRORS
+    if not _DEVICE_ERRORS:
+        errs: list = [faults.FaultError]
+        try:
+            from jax.errors import JaxRuntimeError
+            errs.append(JaxRuntimeError)
+        except ImportError:
+            pass
+        try:
+            from jax._src.lib import xla_client
+            errs.append(xla_client.XlaRuntimeError)
+        except Exception:   # noqa: BLE001 — internal layout, best-effort
+            pass
+        _DEVICE_ERRORS = tuple(errs)
+    return _DEVICE_ERRORS
+
+
+class TierBreaker:
+    """Per-tier circuit breaker: closed -> open (>= BREAKER_THRESHOLD
+    failures within BREAKER_WINDOW_S) -> half-open probe after
+    BREAKER_COOLDOWN_S -> closed on success / re-open on failure.
+
+    Knobs are read from module globals at call time so tests and
+    operators can monkeypatch them without rebuilding chains. Uses
+    time.monotonic — latency bookkeeping, not a scheduling decision."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # tier -> {"failures": [t, ...], "open_until": t|None,
+        #          "probing": bool}
+        self._tiers: dict[str, dict] = {}
+
+    def _rec(self, tier: str) -> dict:
+        rec = self._tiers.get(tier)
+        if rec is None:
+            rec = self._tiers[tier] = {
+                "failures": [], "open_until": None, "probing": False}
+        return rec
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tiers.clear()
+
+    def state(self, tier: str) -> str:
+        with self._lock:
+            rec = self._tiers.get(tier)
+            if rec is None or rec["open_until"] is None:
+                return "closed"
+            return "half-open" if rec["probing"] else "open"
+
+    def admit(self, tier: str) -> bool:
+        """May a call attempt this tier now? Open tiers are denied until
+        the cooldown elapses, then exactly ONE caller is admitted as the
+        half-open probe (concurrent callers keep skipping until the
+        probe resolves)."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._rec(tier)
+            if rec["open_until"] is None:
+                return True
+            if rec["probing"]:
+                return False                     # probe already in flight
+            if now < rec["open_until"]:
+                return False
+            rec["probing"] = True
+            metrics.incr(f"nomad.solver.tier_breaker_probe.{tier}")
+            return True
+
+    def record_success(self, tier: str) -> None:
+        with self._lock:
+            rec = self._rec(tier)
+            was_open = rec["open_until"] is not None
+            rec["failures"] = []
+            rec["open_until"] = None
+            rec["probing"] = False
+            if was_open:
+                metrics.incr("nomad.solver.tier_breaker_closed")
+                metrics.incr(f"nomad.solver.tier_breaker_closed.{tier}")
+            metrics.set_gauge(f"nomad.solver.tier_breaker_state.{tier}", 0)
+
+    def release(self, tier: str) -> None:
+        """Abandon an admitted half-open probe WITHOUT a verdict (the
+        probe's future was never materialized — e.g. the pipelined
+        placer degraded before reaching it). The tier returns to plain
+        open; the next cooldown-elapsed admit() probes again. No-op
+        when no probe is in flight."""
+        with self._lock:
+            rec = self._tiers.get(tier)
+            if rec is not None and rec["probing"]:
+                rec["probing"] = False
+
+    def record_failure(self, tier: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            rec = self._rec(tier)
+            if rec["probing"]:
+                # the half-open probe failed: straight back to open
+                rec["probing"] = False
+                rec["open_until"] = now + BREAKER_COOLDOWN_S
+                metrics.incr("nomad.solver.tier_breaker_reopened")
+                metrics.incr(f"nomad.solver.tier_breaker_reopened.{tier}")
+                return
+            fails = [t for t in rec["failures"] if now - t < BREAKER_WINDOW_S]
+            fails.append(now)
+            rec["failures"] = fails
+            if rec["open_until"] is None and len(fails) >= BREAKER_THRESHOLD:
+                rec["open_until"] = now + BREAKER_COOLDOWN_S
+                rec["failures"] = []
+                metrics.incr("nomad.solver.tier_breaker_opened")
+                metrics.incr(f"nomad.solver.tier_breaker_opened.{tier}")
+                metrics.set_gauge(
+                    f"nomad.solver.tier_breaker_state.{tier}", 1)
+
+
+_breaker = TierBreaker()
+
+
+def breaker() -> TierBreaker:
+    return _breaker
+
+
+def breaker_record(tier: str, ok: bool) -> None:
+    """External dispatch sites (microbatch, the pipelined placer's async
+    materialize) feed the same breaker the chain uses."""
+    if ok:
+        _breaker.record_success(tier)
+    else:
+        _breaker.record_failure(tier)
+
+
+def breaker_release(tier: str) -> None:
+    """Abandon a half-open probe whose async result will never be
+    materialized (see TierBreaker.release) — without this, a degraded
+    pipeline could leak probing=True and wedge the tier shut."""
+    _breaker.release(tier)
+
+
+def breaker_release_all() -> None:
+    """Eval-exit safety net (placer finally): release any probe still
+    marked in flight. A probe admitted for an async dispatch whose
+    future was abandoned mid-eval (degradation, unwind) must not wedge
+    its tier; releasing a concurrent eval's live probe merely allows an
+    extra probe, which its own feedback still resolves."""
+    with _breaker._lock:
+        for rec in _breaker._tiers.values():
+            rec["probing"] = False
+
+
+_dispatch_ctx = threading.local()
+
+
+@contextmanager
+def async_dispatch():
+    """Inside this context the chain returns device futures WITHOUT
+    blocking (the pipelined placer overlaps chunk solves with host
+    work); async device failures then surface at the caller's
+    materialize site, which owns recovery (placer chunk fallback) AND
+    the breaker feedback — the chain defers record_success, since an
+    unmaterialized future proves nothing about the device."""
+    prev = getattr(_dispatch_ctx, "on", False)
+    _dispatch_ctx.on = True
+    try:
+        yield
+    finally:
+        _dispatch_ctx.on = prev
+
+
+def last_dispatch_tier() -> str:
+    """The tier that actually served the calling thread's most recent
+    chain dispatch (a sync demotion can hand back a lower tier's
+    future). Async callers key their materialize-time breaker feedback
+    on this, not on the selected tier."""
+    return getattr(_dispatch_ctx, "last_tier", "")
+
+
+def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
+           spread_algorithm: bool, depth_grid=None):
+    """The per-call degradation ladder over `tiers` (primary first).
+    Synchronous failures (trace/compile/dispatch errors, injected
+    faults) demote to the next admitted tier; outside async_dispatch()
+    the result is blocked-on so async device failures surface and
+    demote here too. The floor tier is always attempted."""
+    fns = [(t, _build(kernel, t, devs, k_max, max_steps,
+                      spread_algorithm, depth_grid)) for t in tiers]
+
+    def run(*args):
+        import jax
+        errs = device_error_types()
+        last_err = None
+        for i, (tier, fn) in enumerate(fns):
+            floor = i == len(fns) - 1
+            if not floor and not _breaker.admit(tier):
+                metrics.incr(
+                    f"nomad.solver.tier_breaker_short_circuit.{tier}")
+                continue
+            async_mode = getattr(_dispatch_ctx, "on", False)
+            try:
+                faults.fire(f"solver.dispatch.{tier}")
+                out = fn(*args)
+                if not async_mode:
+                    out = jax.block_until_ready(out)
+            except errs as e:
+                _breaker.record_failure(tier)
+                metrics.incr("nomad.solver.tier_demotions")
+                metrics.incr(f"nomad.solver.tier_demotions.{tier}")
+                last_err = e
+                continue
+            except BaseException:
+                # non-demotable failure (timeout/oom faults, bugs): not
+                # a reason to try a lower tier, but the breaker must
+                # still see it — otherwise a half-open probe that dies
+                # here leaks probing=True and wedges the tier shut
+                _breaker.record_failure(tier)
+                raise
+            _dispatch_ctx.last_tier = tier
+            if not async_mode:
+                # async callers report success/failure from their
+                # materialize site (an unblocked future proves nothing)
+                _breaker.record_success(tier)
+            metrics.incr(f"nomad.solver.dispatch.{tier}")
+            if i > 0:
+                metrics.incr(f"nomad.solver.tier_degraded_serves.{tier}")
+            return out
+        raise last_err if last_err is not None else RuntimeError(
+            f"no solver tier available for {kernel} (chain {tiers})")
+    return run
+
+
+def host_fallback(kernel: str, *, k_max: int = 128, max_steps: int = 256,
+                  spread_algorithm: bool = False, depth_grid=None):
+    """The host-tier program for `kernel` — the degradation floor. Used
+    by recovery paths that already hold a poisoned device result (the
+    pipelined placer's chunk fallback) and must re-solve off-device."""
+    import jax
+    devs = jax.devices()
+    key = ("hostfb", kernel, k_max, max_steps, spread_algorithm, depth_grid)
+    fn = _cache.get(key)
+    if fn is None:
+        fn = _cache[key] = _build(kernel, "host", devs, k_max, max_steps,
+                                  spread_algorithm, depth_grid)
+    return fn
 
 
 def _tier(n_padded: int, count=None):
@@ -148,8 +435,9 @@ def select(kernel: str, n_padded: int, *, count=None, k_max: int = 128,
     cached = _cache.get(key)
     if cached is not None:
         return cached
-    out = _cache[key] = (tier, _build(kernel, tier, devs, k_max, max_steps,
-                                      spread_algorithm, depth_grid))
+    out = _cache[key] = (tier, _chain(kernel, LADDER[tier], devs, k_max,
+                                      max_steps, spread_algorithm,
+                                      depth_grid))
     return out
 
 

@@ -161,10 +161,13 @@ class MicroBatcher:
         metrics.incr("nomad.solver.microbatch.dispatches")
         metrics.add_sample("nomad.solver.microbatch.size", len(batch))
         for start in range(0, len(batch), LANES):
-            self._dispatch(static_key, inner, batch[start:start + LANES])
+            self._dispatch(static_key, inner, host_fn,
+                           batch[start:start + LANES])
 
-    def _dispatch(self, static_key: tuple, inner,
+    def _dispatch(self, static_key: tuple, inner, host_fn,
                   lanes: list[_Request]) -> None:
+        from . import backend
+        from .. import faults
         from .tensorize import stack_lanes
         # pad to the fixed lane count with count=0 clones of lane 0 —
         # arg 3 of the normalized depth signature is `count`; zero places
@@ -173,7 +176,25 @@ class MicroBatcher:
         pad = pad[:3] + (np.int32(0),) + pad[4:]
         cols = stack_lanes([r.args for r in lanes], pad, LANES)
         fn = self._batched_fn(static_key, inner)
-        out = np.asarray(fn(*cols))
+        try:
+            faults.fire("solver.microbatch.dispatch")
+            out = np.asarray(fn(*cols))
+        except backend.device_error_types():
+            # the coalesced device program died (device lost / injected):
+            # one bad dispatch must not fail K evals — fan each lane out
+            # to its own host-tier retry; only lanes whose host solve
+            # ALSO fails see an error (ISSUE 3)
+            backend.breaker_record("batch", ok=False)
+            metrics.incr("nomad.solver.microbatch.fanout")
+            metrics.incr("nomad.solver.microbatch.fanout_lanes", len(lanes))
+            for req in lanes:
+                try:
+                    req.out = np.asarray(host_fn(*req.args))
+                except BaseException as le:     # noqa: BLE001 — per lane
+                    req.err = le
+                req.event.set()
+            return
+        backend.breaker_record("batch", ok=True)
         for row, req in enumerate(lanes):
             req.out = np.array(out[row])
             req.event.set()

@@ -116,6 +116,9 @@ class SolverPlacer:
             return self._compute_placements(destructive, place)
         finally:
             microbatch.eval_finished()
+            # abandoned async probes (degraded/unwound pipelines) must
+            # not wedge a tier half-open forever
+            backend.breaker_release_all()
 
     def _compute_placements(self, destructive, place) -> bool:
         sched = self.sched
@@ -588,14 +591,22 @@ class SolverPlacer:
             args = self._depth_solve_args(prep, tg, count)
             used_cur = prep.gt.used
             coll_cur = prep.gt.job_collisions
-            for ci, ccount in enumerate(chunk_counts):
-                a = (args[0], used_cur, args[2], np.int32(ccount),
-                     args[4], coll_cur) + args[6:]
-                placed = depth_fn(*a)
-                futs.append(placed)
-                if ci < len(chunk_counts) - 1:
-                    used_cur, coll_cur = _usage_update(
-                        used_cur, coll_cur, placed, prep.gt.ask)
+            # async_dispatch: the backend chain must NOT block on the
+            # device result here — the whole point is overlapping chunk
+            # solves with host materialize/commit. Async device failures
+            # then surface at the np.asarray below, where the chunk
+            # fallback re-solves on the host tier.
+            chunk_tiers = []        # which tier actually served each chunk
+            with backend.async_dispatch():
+                for ci, ccount in enumerate(chunk_counts):
+                    a = (args[0], used_cur, args[2], np.int32(ccount),
+                         args[4], coll_cur) + args[6:]
+                    placed = depth_fn(*a)
+                    chunk_tiers.append(backend.last_dispatch_tier() or bname)
+                    futs.append(placed)
+                    if ci < len(chunk_counts) - 1:
+                        used_cur, coll_cur = _usage_update(
+                            used_cur, coll_cur, placed, prep.gt.ask)
         # host side of the pipeline: ids/names/shared objects are built
         # while chunk 1 is still in flight on the device
         host_t0 = time.perf_counter()
@@ -612,9 +623,49 @@ class SolverPlacer:
         if _in_flight(last_fut):
             metrics.add_sample("nomad.plan.pipeline.overlap", prep_s)
         mi = 0
+        chunk_done: list = []     # materialized padded chunk results
+        degraded = None           # (host_fn, used_h, coll_h) after loss
         for ci, fut in enumerate(futs):
             with metrics.measure("nomad.solver.solve"):
-                placed = np.array(np.asarray(fut)[:prep.n])
+                placed_pad = None
+                if degraded is None:
+                    try:
+                        placed_pad = np.asarray(fut)
+                        # async dispatch defers breaker feedback to HERE:
+                        # only a materialized result proves the serving
+                        # tier healthy
+                        backend.breaker_record(chunk_tiers[ci], ok=True)
+                    except backend.device_error_types():
+                        # device lost mid-pipeline: this chunk's future is
+                        # poisoned, and every later chunk consumed its
+                        # device-side usage update — re-solve the rest of
+                        # the eval on the host tier, replaying committed
+                        # chunks' usage host-side (ISSUE 3)
+                        backend.breaker_record(chunk_tiers[ci], ok=False)
+                        # later chunks' futures will never materialize:
+                        # release any half-open probe they were admitted
+                        # under, or the tier wedges shut
+                        for cj in range(ci + 1, len(futs)):
+                            backend.breaker_release(chunk_tiers[cj])
+                        metrics.incr("nomad.plan.pipeline.chunk_fallback")
+                        degraded = self._pipeline_degrade(prep, chunk_done)
+                        if self.ctx.logger:
+                            self.ctx.logger(
+                                f"solver: eval {sched.eval.id[:8]} chunk "
+                                f"{ci} device result lost; host fallback "
+                                f"for remaining chunks")
+                if placed_pad is None:
+                    host_fn, used_h, coll_h = degraded
+                    a = (args[0], used_h, args[2],
+                         np.int32(chunk_counts[ci]), args[4],
+                         coll_h) + args[6:]
+                    placed_pad = np.asarray(host_fn(*a))
+                    used_h = used_h + placed_pad[:, None].astype(
+                        np.float32) * np.asarray(args[2])[None, :]
+                    coll_h = coll_h + placed_pad.astype(np.int32)
+                    degraded = (host_fn, used_h, coll_h)
+                chunk_done.append(placed_pad)
+                placed = np.array(placed_pad[:prep.n])
             host_t0 = time.perf_counter()
             solves_behind = ci < len(futs) - 1 and _in_flight(last_fut)
             is_last = ci == len(futs) - 1
@@ -665,6 +716,24 @@ class SolverPlacer:
             # commit semantics, applied per chunk
             sched._pipeline_partial = True
         return mi, prep
+
+    def _pipeline_degrade(self, prep, chunk_done):
+        """Build the host-tier recovery state after an async device
+        failure: the floor program plus usage/collision arrays with every
+        already-materialized chunk's placements replayed host-side — the
+        numpy mirror of _usage_update, so the recovered chunks score
+        exactly the state the device chunks would have."""
+        host_fn = backend.host_fallback(
+            "depth", k_max=prep.k_max, spread_algorithm=prep.spread_alg,
+            depth_grid=prep.depth_grid)
+        used_h = np.array(prep.gt.used, np.float32)
+        coll_h = np.array(prep.gt.job_collisions, np.int32)
+        ask = np.asarray(prep.gt.ask, np.float32)
+        for placed in chunk_done:
+            p = np.asarray(placed)
+            used_h = used_h + p[:, None].astype(np.float32) * ask[None, :]
+            coll_h = coll_h + p.astype(np.int32)
+        return host_fn, used_h, coll_h
 
     def _distinct_property_sets(self, tg):
         """PropertySets for every distinct_property constraint in scope

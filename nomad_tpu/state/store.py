@@ -171,6 +171,8 @@ class StateStore:
                            ) -> "StateSnapshot":
         """Block until latest_index >= index, then snapshot
         (ref nomad/worker.go:536 snapshotMinIndex)."""
+        from .. import faults
+        faults.fire("state.snapshot_min_index")
         deadline = time.monotonic() + timeout
         with self._lock:
             while self._index < index:

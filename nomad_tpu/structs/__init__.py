@@ -50,7 +50,8 @@ from .eval import (  # noqa: F401
     TRIGGER_QUEUED_ALLOCS, TRIGGER_PREEMPTION, TRIGGER_SCALING,
     TRIGGER_MAX_DISCONNECT, TRIGGER_RECONNECT,
     CORE_JOB_EVAL_GC, CORE_JOB_NODE_GC, CORE_JOB_JOB_GC,
-    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_CSI_VOLUME_CLAIM_GC, CORE_JOB_FORCE_GC,
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_CSI_VOLUME_CLAIM_GC,
+    CORE_JOB_FAILED_EVAL_REAP, CORE_JOB_FORCE_GC,
 )
 from .plan import (  # noqa: F401
     Deployment, DeploymentState, DeploymentStatusUpdate, DesiredUpdates, Plan,

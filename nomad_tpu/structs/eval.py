@@ -41,6 +41,7 @@ CORE_JOB_NODE_GC = "node-gc"
 CORE_JOB_JOB_GC = "job-gc"
 CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
 CORE_JOB_CSI_VOLUME_CLAIM_GC = "csi-volume-claim-gc"
+CORE_JOB_FAILED_EVAL_REAP = "failed-eval-reap"
 CORE_JOB_FORCE_GC = "force-gc"
 
 
@@ -100,6 +101,9 @@ class Evaluation:
 
     failed_tg_allocs: dict[str, object] = field(default_factory=dict)  # tg -> AllocMetric
     queued_allocations: dict[str, int] = field(default_factory=dict)   # tg -> count
+    # how many failed-follow-up generations precede this eval — drives
+    # the reaper's capped exponential backoff (ISSUE 3 lifecycle)
+    failed_follow_ups: int = 0
     annotate_plan: bool = False
     leader_ack: str = ""             # broker token for ack/nack
 
@@ -167,4 +171,5 @@ class Evaluation:
             status=EVAL_STATUS_PENDING,
             wait_sec=wait_sec,
             previous_eval=self.id,
+            failed_follow_ups=self.failed_follow_ups + 1,
         )

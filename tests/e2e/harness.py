@@ -152,7 +152,11 @@ class Cluster:
     ENCRYPT_KEY = "e2e-harness-shared-key"
 
     def __init__(self, base_dir: str, n_servers: int = 3,
-                 n_clients: int = 2, acl: bool = False):
+                 n_clients: int = 2, acl: bool = False,
+                 env: dict | None = None):
+        # extra env for every agent process — the chaos tier injects
+        # NOMAD_FAULTS plans into real agents this way (ISSUE 3)
+        self.env = dict(env or {})
         if acl and n_clients:
             # the workload helpers (nodes_ready/run_job/allocs) drive
             # anonymous HTTP, which deny-all ACLs reject — the ACL tier
@@ -198,7 +202,8 @@ class Cluster:
                 for j in range(self.n_servers) if j != i]
         p = AgentProc(f"server{i}",
                       self._agent_argv(cfg_path, self._http[i], join),
-                      os.path.join(d, "agent.log"), self._http[i])
+                      os.path.join(d, "agent.log"), self._http[i],
+                      env=self.env)
         p.start()
         self.servers.append(p)
         return p
@@ -220,7 +225,8 @@ class Cluster:
             json.dump(cfg, f)
         p = AgentProc(f"client{i}",
                       self._agent_argv(cfg_path, self._client_http[i], []),
-                      os.path.join(d, "agent.log"), self._client_http[i])
+                      os.path.join(d, "agent.log"), self._client_http[i],
+                      env=self.env)
         p.start()
         self.clients.append(p)
         return p

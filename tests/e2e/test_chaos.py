@@ -1,0 +1,77 @@
+"""Chaos e2e (ISSUE 3): a REAL agent process under an injected fault
+plan, delivered through the NOMAD_FAULTS env the processes inherit.
+
+Mid-stream, the solver's primary device tier dies for the first few
+solves (demotion ladder must serve them from the host tier) and the plan
+applier throws transient errors (evals must nack + retry, not vanish).
+The stream must finish with every alloc running, ZERO evals dead-lettered
+without a follow-up, and the demotion metrics visible on /v1/metrics —
+the operator-facing evidence a sick tier leaves behind.
+"""
+import uuid
+
+import pytest
+
+from .harness import Cluster, sleep_job, wait_until
+
+pytestmark = [pytest.mark.e2e, pytest.mark.chaos]
+
+FAULTS = ('{"solver.dispatch.xla": {"mode": "raise", "times": 2},'
+          ' "planner.apply": {"mode": "nth_call", "n": 4, "times": 2},'
+          ' "worker.invoke": {"mode": "raise", "times": 1}}')
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("chaos")), n_servers=1,
+                n_clients=1, env={"NOMAD_FAULTS": FAULTS})
+    try:
+        c.start()
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_stream_survives_tier_death_no_orphan_dead_letters(chaos_cluster):
+    c = chaos_cluster
+    lead = c.leader()
+
+    # solver path on: the batched placer is what the faulted
+    # solver.dispatch.xla site sits under
+    cfg = lead.get("/v1/operator/scheduler/configuration")
+    sc = cfg["SchedulerConfig"]
+    sc["SchedulerAlgorithm"] = "tpu-batch"
+    lead.send("/v1/operator/scheduler/configuration", sc)
+
+    job_ids = []
+    for i in range(4):
+        job_id = f"chaos-{i}-{uuid.uuid4().hex[:6]}"
+        c.run_job(sleep_job(job_id, count=2, seconds=600))
+        job_ids.append(job_id)
+
+    # the whole stream lands despite the dead tier + applier hiccups
+    for job_id in job_ids:
+        assert c.wait_running(job_id, 2, timeout=60), \
+            f"{job_id} never fully running:\n" + "\n".join(
+                p.tail(2000) for p in c.servers + c.clients)
+
+    # failed-eval lifecycle invariant: any eval that terminated failed
+    # (delivery limit) must have a failed-follow-up chained to it
+    evals = lead.get("/v1/evaluations")
+    failed = [e for e in evals if e["Status"] == "failed"]
+    follow_ups = {e.get("PreviousEval") for e in evals
+                  if e.get("TriggeredBy") == "failed-follow-up"}
+    orphans = [e["ID"] for e in failed if e["ID"] not in follow_ups]
+    assert not orphans, \
+        f"dead-lettered evals without follow-up: {orphans}"
+
+    # the injected chaos actually happened, and the ladder served it:
+    # demotions + host serves are on the operator metrics surface
+    counters = lead.get("/v1/metrics")["telemetry"]["counters"]
+    # worker.invoke(1) + solver.dispatch.xla(2) + planner.apply(>=1)
+    assert counters.get("nomad.faults.fired", 0) >= 4, counters
+    assert counters.get("nomad.solver.tier_demotions.xla", 0) >= 2
+    assert counters.get("nomad.solver.tier_degraded_serves.host", 0) >= 2
+    # the faulted scheduler invoke surfaced as a counted worker eval
+    # failure (then nack + redelivery), not a silent swallow
+    assert counters.get("nomad.worker.eval_failures", 0) >= 1

@@ -1,0 +1,852 @@
+"""ISSUE 3 tests: deterministic fault injection (API semantics + every
+production injection site), the solver degradation ladder with its
+per-tier circuit breaker, the failed-eval dead-letter lifecycle, and the
+robustness satellites (heartbeat re-arm, worker failure counters,
+planner stop)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.faults import FaultError, FaultPlan
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import Harness, new_scheduler
+from nomad_tpu.solver import backend, microbatch
+from nomad_tpu.solver.backend import TierBreaker
+from nomad_tpu.structs import (
+    Evaluation, Plan, SchedulerConfiguration, SCHED_ALG_TPU,
+    CORE_JOB_FAILED_EVAL_REAP, NODE_STATUS_DOWN, NODE_STATUS_READY,
+)
+
+from test_solver_backend import _depth_args
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    backend.reset()
+    microbatch.reset()
+    yield
+    faults.clear()
+    backend.reset()
+    microbatch.reset()
+    microbatch.configure(enabled=True, window_s=0.008)
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if fn():
+                return True
+        except Exception:       # noqa: BLE001 — polling probe
+            pass
+        time.sleep(step)
+    return False
+
+
+# ----------------------------------------------------------- fault API
+
+def test_raise_mode_fires_every_call_until_times_cap():
+    plan = faults.install({"x.y": {"mode": "raise", "times": 2}})
+    for _ in range(2):
+        with pytest.raises(FaultError):
+            faults.fire("x.y")
+    faults.fire("x.y")                  # cap reached: no-op
+    assert plan.fired("x.y") == 2
+    assert plan.calls("x.y") == 3
+
+
+def test_delay_mode_sleeps_instead_of_raising():
+    faults.install({"slow.site": {"mode": "delay", "delay_ms": 60}})
+    t0 = time.perf_counter()
+    faults.fire("slow.site")            # must not raise
+    assert time.perf_counter() - t0 >= 0.05
+    assert faults.fired("slow.site") == 1
+
+
+def test_nth_call_mode_fires_every_nth():
+    faults.install({"s": {"mode": "nth_call", "n": 3}})
+    pattern = []
+    for _ in range(9):
+        try:
+            faults.fire("s")
+            pattern.append(0)
+        except FaultError:
+            pattern.append(1)
+    assert pattern == [0, 0, 1] * 3
+
+
+def test_probability_same_seed_same_fire_pattern():
+    def pattern(seed):
+        faults.install({"p.site": {"mode": "probability", "p": 0.5,
+                                   "seed": seed}})
+        out = []
+        for _ in range(200):
+            try:
+                faults.fire("p.site")
+                out.append(0)
+            except FaultError:
+                out.append(1)
+        faults.clear()
+        return out
+
+    a, b = pattern(42), pattern(42)
+    assert a == b                       # determinism contract
+    assert 0 < sum(a) < 200             # actually probabilistic
+    assert pattern(43) != a             # seed is load-bearing
+
+
+def test_probability_pattern_is_per_site_independent():
+    """Traffic on another site must not perturb a site's fire pattern."""
+    def run(noise_calls):
+        faults.install({
+            "det.site": {"mode": "probability", "p": 0.4, "seed": 7},
+            "noise.site": {"mode": "probability", "p": 0.9, "seed": 1},
+        })
+        out = []
+        for i in range(100):
+            for _ in range(noise_calls):
+                try:
+                    faults.fire("noise.site")
+                except FaultError:
+                    pass
+            try:
+                faults.fire("det.site")
+                out.append(0)
+            except FaultError:
+                out.append(1)
+        faults.clear()
+        return out
+
+    assert run(0) == run(3)
+
+
+def test_wildcard_prefix_and_exact_precedence():
+    faults.install({
+        "solver.dispatch.*": {"mode": "raise"},
+        "solver.dispatch.host": {"mode": "raise", "times": 0},  # exempt
+    })
+    with pytest.raises(FaultError):
+        faults.fire("solver.dispatch.pallas")
+    faults.fire("solver.dispatch.host")         # exact match wins: inert
+    faults.fire("solver.other")                 # outside the prefix
+
+
+def test_exc_knob_picks_the_raised_type():
+    faults.install({"t": {"mode": "raise", "exc": "timeout"},
+                    "o": {"mode": "raise", "exc": "oom"}})
+    with pytest.raises(TimeoutError):
+        faults.fire("t")
+    with pytest.raises(MemoryError):
+        faults.fire("o")
+
+
+def test_env_grammar_install(monkeypatch):
+    monkeypatch.setenv(
+        "NOMAD_FAULTS",
+        '{"env.site": {"mode": "nth_call", "n": 2, "times": 1}}')
+    plan = faults.install_from_env()
+    assert plan is faults.active()
+    faults.fire("env.site")
+    with pytest.raises(FaultError):
+        faults.fire("env.site")
+    assert plan.fired("env.site") == 1
+
+
+def test_bad_specs_rejected():
+    with pytest.raises(ValueError):
+        FaultPlan({"a": {"mode": "bogus"}})
+    with pytest.raises(ValueError):
+        FaultPlan({"a": {"mode": "raise", "exc": "bogus"}})
+    with pytest.raises(ValueError):
+        FaultPlan({"a": {"mode": "nth_call", "n": 0}})
+
+
+# --------------------------------------------------- injection sites
+
+def test_site_raft_apply():
+    from nomad_tpu.server.fsm import EVAL_UPDATE, NomadFSM, RaftLog
+    raft = RaftLog(NomadFSM())
+    faults.install({"raft.apply": {"mode": "raise", "times": 1}})
+    with pytest.raises(FaultError):
+        raft.apply(EVAL_UPDATE, {"evals": []})
+    # one-shot exhausted: the log works again
+    assert raft.apply(EVAL_UPDATE, {"evals": []}) >= 1
+    assert faults.fired("raft.apply") == 1
+
+
+def test_site_state_snapshot_min_index_as_timeout():
+    from nomad_tpu.state import StateStore
+    store = StateStore()
+    faults.install({"state.snapshot_min_index":
+                    {"mode": "raise", "exc": "timeout", "times": 1}})
+    with pytest.raises(TimeoutError):
+        store.snapshot_min_index(0)
+    assert store.snapshot_min_index(0) is not None
+
+
+def test_site_planner_apply():
+    from nomad_tpu.server.fsm import NomadFSM, RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+    fsm = NomadFSM()
+    planner = Planner(RaftLog(fsm), fsm.state)
+    planner.start()
+    try:
+        faults.install({"planner.apply": {"mode": "raise", "times": 1}})
+        assert planner.submit_plan(Plan(), timeout=5.0) is None
+        assert faults.fired("planner.apply") == 1
+        assert planner.submit_plan(Plan(), timeout=5.0) is not None
+    finally:
+        planner.stop()
+
+
+def test_site_worker_invoke():
+    from types import SimpleNamespace
+    from nomad_tpu.server.worker import Worker
+    server = SimpleNamespace(
+        core_scheduler=SimpleNamespace(process=lambda ev: None),
+        logger=lambda msg: None)
+    w = Worker(server)
+    faults.install({"worker.invoke": {"mode": "raise"}})
+    with pytest.raises(FaultError):
+        w._invoke_scheduler(Evaluation(type="_core"))
+
+
+def test_site_solver_dispatch_chain_exhaustion():
+    """Faulting every tier in a chain surfaces the last error — the
+    floor is attempted, never silently skipped."""
+    faults.install({"solver.dispatch.*": {"mode": "raise"}})
+    _, fn = backend.select("depth", 512, count=40, k_max=16)
+    with pytest.raises(FaultError):
+        fn(*_depth_args(512, 40, seed=1))
+
+
+# --------------------------------------------- breaker state machine
+
+@pytest.fixture
+def _fast_breaker(monkeypatch):
+    monkeypatch.setattr(backend, "BREAKER_THRESHOLD", 2)
+    monkeypatch.setattr(backend, "BREAKER_WINDOW_S", 10.0)
+    monkeypatch.setattr(backend, "BREAKER_COOLDOWN_S", 0.1)
+
+
+def test_breaker_opens_then_half_open_then_closes(_fast_breaker):
+    b = TierBreaker()
+    assert b.admit("pallas") and b.state("pallas") == "closed"
+    b.record_failure("pallas")
+    assert b.state("pallas") == "closed"        # below threshold
+    b.record_failure("pallas")
+    assert b.state("pallas") == "open"
+    assert not b.admit("pallas")                # cooling down
+    time.sleep(0.12)
+    assert b.admit("pallas")                    # the half-open probe
+    assert b.state("pallas") == "half-open"
+    assert not b.admit("pallas")                # one probe at a time
+    b.record_success("pallas")
+    assert b.state("pallas") == "closed"
+    assert b.admit("pallas")
+
+
+def test_breaker_probe_failure_reopens(_fast_breaker):
+    b = TierBreaker()
+    b.record_failure("xla")
+    b.record_failure("xla")
+    assert b.state("xla") == "open"
+    time.sleep(0.12)
+    assert b.admit("xla")
+    b.record_failure("xla")                     # probe failed
+    assert b.state("xla") == "open"
+    assert not b.admit("xla")
+    time.sleep(0.12)
+    assert b.admit("xla")
+    b.record_success("xla")
+    assert b.state("xla") == "closed"
+
+
+def test_breaker_window_prunes_stale_failures(monkeypatch):
+    monkeypatch.setattr(backend, "BREAKER_THRESHOLD", 3)
+    monkeypatch.setattr(backend, "BREAKER_WINDOW_S", 0.05)
+    monkeypatch.setattr(backend, "BREAKER_COOLDOWN_S", 10.0)
+    b = TierBreaker()
+    b.record_failure("sharded")
+    b.record_failure("sharded")
+    time.sleep(0.07)                            # both age out
+    b.record_failure("sharded")
+    assert b.state("sharded") == "closed"
+
+
+# --------------------------------------------------- degradation ladder
+
+def test_ladder_demotes_faulted_xla_to_host_bit_identical():
+    args = _depth_args(512, 40, seed=3)
+    _, fn = backend.select("depth", 512, count=40, k_max=16)
+    want = np.asarray(fn(*args))                # healthy xla
+    backend.reset()
+    faults.install({"solver.dispatch.xla": {"mode": "raise"}})
+    d0 = metrics.counter("nomad.solver.tier_demotions.xla")
+    h0 = metrics.counter("nomad.solver.dispatch.host")
+    _, fn2 = backend.select("depth", 512, count=40, k_max=16)
+    got = np.asarray(fn2(*args))
+    np.testing.assert_array_equal(got, want)
+    assert metrics.counter("nomad.solver.tier_demotions.xla") == d0 + 1
+    assert metrics.counter("nomad.solver.dispatch.host") == h0 + 1
+
+
+def test_ladder_sharded_fault_demotes_and_breaker_cycles(
+        monkeypatch, _fast_breaker):
+    """A sick sharded tier demotes per call, the breaker opens after the
+    threshold (later calls skip the tier without attempting it), and
+    once the tier heals the half-open probe re-closes it."""
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    backend.reset()
+    args = _depth_args(512, 300, seed=3)
+    name, fn = backend.select("depth", 512, k_max=16)
+    assert name == "sharded"
+    want = np.asarray(
+        backend.host_fallback("depth", k_max=16)(*args))
+
+    faults.install({"solver.dispatch.sharded": {"mode": "raise"}})
+    o0 = metrics.counter("nomad.solver.tier_breaker_opened.sharded")
+    s0 = metrics.counter(
+        "nomad.solver.tier_breaker_short_circuit.sharded")
+    for _ in range(4):
+        np.testing.assert_array_equal(np.asarray(fn(*args)), want)
+    # threshold 2: calls 3 and 4 short-circuited the sharded tier
+    assert faults.fired("solver.dispatch.sharded") == 2
+    assert metrics.counter(
+        "nomad.solver.tier_breaker_opened.sharded") == o0 + 1
+    assert metrics.counter(
+        "nomad.solver.tier_breaker_short_circuit.sharded") == s0 + 2
+
+    # tier heals: after the cooldown the probe runs the REAL sharded
+    # program (8-device CPU mesh) and re-closes the breaker
+    faults.clear()
+    time.sleep(0.12)
+    c0 = metrics.counter("nomad.solver.tier_breaker_closed.sharded")
+    np.testing.assert_array_equal(np.asarray(fn(*args)), want)
+    assert metrics.counter(
+        "nomad.solver.tier_breaker_closed.sharded") == c0 + 1
+    assert backend.breaker().state("sharded") == "closed"
+
+
+def test_async_dispatch_defers_breaker_success(monkeypatch):
+    """Under async_dispatch() an unmaterialized future proves nothing:
+    the chain must NOT record tier success at dispatch time (that would
+    wipe the failure window and keep a sick device's breaker closed
+    forever in the pipelined regime). Success is the materialize site's
+    call, keyed on last_dispatch_tier()."""
+    monkeypatch.setattr(backend, "BREAKER_THRESHOLD", 3)
+    monkeypatch.setattr(backend, "BREAKER_WINDOW_S", 10.0)
+    b = backend.breaker()
+    args = _depth_args(512, 40, seed=1)
+    _, fn = backend.select("depth", 512, count=40, k_max=16)
+    b.record_failure("xla")
+    b.record_failure("xla")
+    with backend.async_dispatch():
+        out = fn(*args)                 # healthy dispatch, unproven
+    assert backend.last_dispatch_tier() == "xla"
+    b.record_failure("xla")             # 3rd failure within the window
+    assert b.state("xla") == "open"     # deferred success didn't wipe it
+    np.asarray(out)
+    backend.breaker_record("xla", ok=True)      # materialize-site call
+    assert b.state("xla") == "closed"
+    # OUTSIDE async_dispatch the chain blocks and records success itself
+    b.record_failure("xla")
+    b.record_failure("xla")
+    fn(*args)
+    b.record_failure("xla")
+    assert b.state("xla") == "closed"   # window was cleared by the call
+
+
+def _det_stream_run(count, eval_id, job_tag):
+    """One pinned-id eval through the full scheduler path (the
+    fixed-seed determinism harness of test_differential, stream form).
+    Returns ({node_id: placed}, eval_status)."""
+    import random
+    random.seed(1234)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for i in range(16):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        n.name = f"chaos-{i}"
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    job.id = job.name = f"chaos-job-{job_tag}"
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.networks = []
+    t = tg.tasks[0]
+    t.resources.networks = []
+    t.resources.cpu = 250
+    t.resources.memory_mb = 128
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(id=eval_id, job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    placed: dict[str, int] = {}
+    for a in h.state.allocs_by_job("default", job.id):
+        placed[a.node_id] = placed.get(a.node_id, 0) + 1
+    return placed, h.evals[-1].status
+
+
+def test_acceptance_pallas_faulted_stream_completes_bit_identical(
+        monkeypatch, _fast_breaker):
+    """ISSUE 3 acceptance: with `solver.dispatch.pallas` faulted at
+    100%, a depth-regime eval stream (both regimes: jittered sampled
+    grid and deterministic full curve) completes with ZERO failed evals
+    — every solve demotes down the ladder — the breaker opens (later
+    evals skip the dead tier), fixed-seed placements stay bit-identical
+    to the healthy path, and after the fault clears the cooldown probe
+    re-closes the breaker."""
+    import jax
+    devs = jax.devices()
+    counts = [6, 48, 6, 48]             # jittered / deterministic regimes
+
+    # healthy reference: default routing (xla on CPU), no faults
+    ref = [_det_stream_run(c, f"acc-eval-{i}", f"{i}")
+           for i, c in enumerate(counts)]
+    assert all(st == "complete" for _, st in ref)
+
+    # now present a pallas tier (its CPU stand-in computes exactly what
+    # the healthy hand kernel computes: the xla program) and kill it
+    real_build = backend._build
+
+    def fake_build(kernel, tier, devs_, k_max, max_steps,
+                   spread_algorithm, depth_grid=None):
+        if tier == "pallas":
+            return real_build(kernel, "xla", devs_, k_max, max_steps,
+                              spread_algorithm, depth_grid)
+        return real_build(kernel, tier, devs_, k_max, max_steps,
+                          spread_algorithm, depth_grid)
+
+    monkeypatch.setattr(backend, "_tier",
+                        lambda n, count=None: ("pallas", devs))
+    monkeypatch.setattr(backend, "_build", fake_build)
+    backend.reset()
+    faults.install({"solver.dispatch.pallas": {"mode": "raise"}})
+    o0 = metrics.counter("nomad.solver.tier_breaker_opened.pallas")
+    d0 = metrics.counter("nomad.solver.tier_demotions.pallas")
+    got = [_det_stream_run(c, f"acc-eval-{i}", f"{i}")
+           for i, c in enumerate(counts)]
+
+    for i, ((placed_ref, _), (placed_got, status)) in enumerate(
+            zip(ref, got)):
+        assert status == "complete", f"eval {i} failed under fault"
+        assert sum(placed_got.values()) == counts[i]
+        assert placed_got == placed_ref, \
+            f"eval {i}: degraded placements diverged"
+    assert metrics.counter(
+        "nomad.solver.tier_breaker_opened.pallas") == o0 + 1
+    assert metrics.counter("nomad.solver.tier_demotions.pallas") >= d0 + 2
+    # breaker open => the 100% fault stopped being attempted
+    assert faults.fired("solver.dispatch.pallas") == 2
+
+    # tier heals: probe admits after cooldown and re-closes
+    faults.clear()
+    time.sleep(0.12)
+    c0 = metrics.counter("nomad.solver.tier_breaker_closed.pallas")
+    p0 = metrics.counter("nomad.solver.dispatch.pallas")
+    placed, status = _det_stream_run(48, "acc-eval-probe", "probe")
+    assert status == "complete" and sum(placed.values()) == 48
+    assert metrics.counter(
+        "nomad.solver.tier_breaker_closed.pallas") == c0 + 1
+    assert metrics.counter("nomad.solver.dispatch.pallas") == p0 + 1
+    assert backend.breaker().state("pallas") == "closed"
+
+
+# ------------------------------------------------- microbatch fan-out
+
+def test_microbatch_faulted_dispatch_fans_out_to_host_lanes(monkeypatch):
+    """A failed coalesced device dispatch must not error K evals: each
+    lane retries on the host tier and gets its exact result."""
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "batch")
+    backend.reset()
+    _, batched_fn = backend.select("depth", 512, count=40)
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "host")
+    backend.reset()
+    _, host_fn = backend.select("depth", 512, count=40)
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "batch")
+    backend.reset()
+    microbatch.configure(enabled=True, window_s=0.05)
+
+    args = [_depth_args(512, 40, seed=s) for s in (1, 2)]
+    expected = [np.asarray(host_fn(*a)) for a in args]
+    faults.install({"solver.microbatch.dispatch":
+                    {"mode": "raise", "times": 1}})
+    f0 = metrics.counter("nomad.solver.microbatch.fanout")
+
+    microbatch.eval_started()
+    microbatch.eval_started()
+    out: dict = {}
+
+    def call(i):
+        out[i] = np.asarray(batched_fn(*args[i]))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    microbatch.eval_finished()
+    microbatch.eval_finished()
+
+    assert faults.fired("solver.microbatch.dispatch") == 1
+    assert metrics.counter("nomad.solver.microbatch.fanout") == f0 + 1
+    for i in (0, 1):
+        np.testing.assert_array_equal(out[i], expected[i])
+
+
+# -------------------------------------- pipelined chunk fallback
+
+def test_pipeline_poisoned_chunk_recovers_on_host(monkeypatch):
+    """An async device failure surfacing at chunk-materialize time (the
+    shape a real TPU loss takes under the pipelined lifecycle) re-solves
+    the remaining chunks on the host tier with replayed usage — same
+    placements, no failed eval."""
+    from test_differential import check_committed
+
+    class _Poison:
+        def __array__(self, dtype=None, copy=None):
+            raise FaultError("solver.dispatch.xla")
+
+        def is_ready(self):
+            return True
+
+    def run(eval_id, poison):
+        real_select = backend.select
+        calls = {"n": 0}
+
+        def select_wrap(kernel, n, **kw):
+            name, fn = real_select(kernel, n, **kw)
+            if kernel != "depth" or not poison:
+                return name, fn
+
+            def wrap(*a):
+                out = fn(*a)
+                calls["n"] += 1
+                if calls["n"] == 3:     # last of 3 pipelined chunks
+                    return _Poison()
+                return out
+            return name, wrap
+
+        monkeypatch.setattr(backend, "select", select_wrap)
+        try:
+            import random
+            random.seed(7)
+            h = Harness()
+            h.state.set_scheduler_config(
+                h.get_next_index(),
+                SchedulerConfiguration(
+                    scheduler_algorithm=SCHED_ALG_TPU,
+                    plan_pipeline_min_count=1, plan_pipeline_chunks=3))
+            for i in range(16):
+                n = mock.node()
+                n.id = f"pnode-{i:04d}"
+                n.name = f"p-{i}"
+                h.state.upsert_node(h.get_next_index(), n)
+            job = mock.batch_job()
+            job.id = job.name = "pipe-poison-job"
+            tg = job.task_groups[0]
+            tg.count = 30               # m > 3: deterministic regime
+            tg.networks = []
+            t = tg.tasks[0]
+            t.resources.networks = []
+            t.resources.cpu = 250
+            t.resources.memory_mb = 128
+            h.state.upsert_job(h.get_next_index(), job)
+            ev = Evaluation(id=eval_id, job_id=job.id, type=job.type)
+            h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+            check_committed(h, job, 30)
+            placed: dict[str, int] = {}
+            for a in h.state.allocs_by_job("default", job.id):
+                placed[a.node_id] = placed.get(a.node_id, 0) + 1
+            return placed, h.evals[-1].status
+        finally:
+            monkeypatch.setattr(backend, "select", real_select)
+
+    want, st_ref = run("pipe-eval-1", poison=False)
+    assert st_ref == "complete"
+    backend.reset()
+    fb0 = metrics.counter("nomad.plan.pipeline.chunk_fallback")
+    got, st = run("pipe-eval-1", poison=True)
+    assert st == "complete"
+    assert metrics.counter("nomad.plan.pipeline.chunk_fallback") == fb0 + 1
+    assert got == want
+
+
+# -------------------------------------------- failed-eval lifecycle
+
+def test_dead_letter_metrics_listing_and_drain():
+    from nomad_tpu.server.eval_broker import EvalBroker
+    b = EvalBroker(initial_nack_delay=0.01, subsequent_nack_delay=0.01,
+                   delivery_limit=1)
+    b.set_enabled(True)
+    try:
+        dl0 = metrics.counter("nomad.broker.dead_letter")
+        ev1 = Evaluation(type="service", job_id="dead-job")
+        ev2 = Evaluation(type="service", job_id="dead-job")
+        b.enqueue(ev1)
+        got, tok = b.dequeue(["service"], timeout=2)
+        b.enqueue(ev2)                  # dedup: waits behind ev1
+        assert b.stats["total_pending"] == 1
+        b.nack(got.id, tok)             # delivery_limit=1: dead-letter
+        assert metrics.counter("nomad.broker.dead_letter") == dl0 + 1
+        assert b.stats["total_failed"] == 1
+        assert [e.id for e in b.failed_evals()] == [ev1.id]
+        assert metrics.gauges["nomad.broker.failed_queue_depth"] == 1
+
+        drained, follows = b.drain_failed()
+        assert [e.id for e in drained] == [ev1.id] and follows == []
+        assert b.failed_evals() == [] and b.stats["total_failed"] == 0
+        assert metrics.gauges["nomad.broker.failed_queue_depth"] == 0
+        # the pending eval for the job is released, like an ack
+        got2, tok2 = b.dequeue(["service"], timeout=2)
+        assert got2.id == ev2.id
+        b.ack(got2.id, tok2)
+    finally:
+        b.set_enabled(False)
+
+
+def test_follow_up_backoff_is_capped_exponential():
+    from nomad_tpu.server.core_sched import (
+        FAILED_EVAL_BACKOFF_BASE_S, FAILED_EVAL_BACKOFF_CAP_S,
+        failed_follow_up_wait,
+    )
+    waits = [failed_follow_up_wait(Evaluation(failed_follow_ups=g))
+             for g in range(8)]
+    assert waits[0] == FAILED_EVAL_BACKOFF_BASE_S
+    assert waits[1] == 2 * FAILED_EVAL_BACKOFF_BASE_S
+    assert waits[2] == 4 * FAILED_EVAL_BACKOFF_BASE_S
+    assert all(w <= FAILED_EVAL_BACKOFF_CAP_S for w in waits)
+    assert waits[-1] == FAILED_EVAL_BACKOFF_CAP_S
+    # generations carry through the follow-up chain
+    follow = Evaluation(failed_follow_ups=2) \
+        .create_failed_follow_up_eval(wait_sec=waits[2])
+    assert follow.failed_follow_ups == 3
+    assert follow.triggered_by == "failed-follow-up"
+
+
+def test_core_scheduler_reaps_dead_letters_with_backoff():
+    from nomad_tpu.server.server import Server
+    s = Server(num_workers=0, gc_interval=9999)
+    s.eval_broker.set_enabled(True)     # not started: the test owns reaping
+    try:
+        s.eval_broker.delivery_limit = 1
+        ev = Evaluation(type="service", job_id="gen2-job",
+                        failed_follow_ups=2)
+        s.eval_broker.enqueue(ev)
+        got, tok = s.eval_broker.dequeue(["service"], timeout=2)
+        s.eval_broker.nack(got.id, tok)
+        r0 = metrics.counter("nomad.broker.dead_letter_reaped")
+        # the `_core` eval kind drives the reap (leader loop ticks the
+        # same method)
+        s.core_scheduler.process(
+            Evaluation(type="_core", job_id=CORE_JOB_FAILED_EVAL_REAP))
+        assert metrics.counter(
+            "nomad.broker.dead_letter_reaped") == r0 + 1
+        stored = s.state.eval_by_id(ev.id)
+        assert stored.status == "failed"
+        follow = [e for e in s.state.iter_evals()
+                  if e.previous_eval == ev.id]
+        assert len(follow) == 1
+        assert follow[0].triggered_by == "failed-follow-up"
+        assert follow[0].failed_follow_ups == 3
+        assert follow[0].wait_sec == 240.0      # 60 * 2^2, under the cap
+
+        # operator drain catches the WAITING follow-up too (the reaper
+        # converts dead letters into delayed retries every tick, so the
+        # drain must cover both forms to actually stop the loop)
+        s.eval_broker.enqueue(follow[0])
+        assert s.eval_broker.stats["total_waiting"] == 1
+        out = s.eval_drain_failed()
+        assert out["cancelled_follow_ups"] == [follow[0].id]
+        assert s.eval_broker.stats["total_waiting"] == 0
+        assert s.state.eval_by_id(follow[0].id).status == "canceled"
+    finally:
+        s.shutdown()
+
+
+def test_operator_broker_failed_listing_and_drain_http():
+    """The agent HTTP operator surface: GET the dead-letter queue, then
+    drain it — drained evals terminate failed WITHOUT a follow-up."""
+    import json
+    import urllib.request
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    def call(a, method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            a.http_addr + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read() or "null")
+
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=0))
+    a.start()
+    try:
+        s = a.server
+        # freeze the leader-loop reaper: this test owns the dead letter
+        s.core_scheduler.reap_failed_evals = lambda: 0
+        b = s.eval_broker
+        b.delivery_limit = 1
+        ev = Evaluation(type="service", job_id="dead-http-job")
+        b.enqueue(ev)
+        got, tok = b.dequeue(["service"], timeout=2)
+        b.nack(got.id, tok)
+
+        payload = call(a, "GET", "/v1/operator/broker/failed")
+        assert payload["Count"] == 1
+        assert payload["Evals"][0]["ID"] == ev.id
+        assert payload["Stats"]["total_failed"] == 1
+
+        payload = call(a, "PUT", "/v1/operator/broker/drain-failed", {})
+        assert payload["Count"] == 1 and payload["DrainedEvals"] == [ev.id]
+        stored = s.state.eval_by_id(ev.id)
+        assert stored.status == "failed"
+        assert "drained by operator" in stored.status_description
+        # no follow-up: the operator took it out of the retry loop
+        assert not [e for e in s.state.iter_evals()
+                    if e.previous_eval == ev.id]
+        assert call(a, "GET", "/v1/operator/broker/failed")["Count"] == 0
+    finally:
+        a.shutdown()
+
+
+# ------------------------------------------------ heartbeat satellite
+
+def test_heartbeat_rearms_after_failed_invalidate():
+    """Regression (ISSUE 3 satellite): a transient raft error during
+    invalidate used to delete the node's deadline first, leaving the
+    node 'ready' forever. Now the deadline survives, is re-armed with a
+    short backoff, and the next sweep downs the node."""
+    from nomad_tpu.server.server import Server
+    s = Server(num_workers=0, gc_interval=9999)
+    try:
+        node = mock.node()
+        s.node_register(node)
+        assert s.state.node_by_id(node.id).status == NODE_STATUS_READY
+        hb = s.heartbeats
+        hb.reset_heartbeat_timer(node.id)
+        hb._deadlines[node.id] = time.time() - 1.0      # expired
+        faults.install({"heartbeat.invalidate":
+                        {"mode": "raise", "times": 1}})
+        sw0 = metrics.counter("nomad.swallowed_errors.heartbeat.invalidate")
+        hb._sweep(time.time())
+        # invalidate failed: node still ready, deadline RE-ARMED (the
+        # old code dropped it here and the node leaked)
+        assert s.state.node_by_id(node.id).status == NODE_STATUS_READY
+        assert node.id in hb._deadlines
+        assert hb._deadlines[node.id] > time.time() - 0.5
+        assert metrics.counter(
+            "nomad.swallowed_errors.heartbeat.invalidate") == sw0 + 1
+        # retry after the backoff succeeds (fault was one-shot)
+        hb._deadlines[node.id] = time.time() - 1.0
+        hb._sweep(time.time())
+        assert s.state.node_by_id(node.id).status == NODE_STATUS_DOWN
+        assert node.id not in hb._deadlines
+    finally:
+        s.shutdown()
+
+
+def test_heartbeat_mid_invalidate_heartbeat_wins():
+    """If the client heartbeats while a failed invalidate is in flight,
+    the fresh deadline must not be clobbered by the retry backoff."""
+    from nomad_tpu.server.server import Server
+    s = Server(num_workers=0, gc_interval=9999)
+    try:
+        node = mock.node()
+        s.node_register(node)
+        hb = s.heartbeats
+        hb._deadlines[node.id] = time.time() - 1.0
+        new_deadline = {}
+
+        class _Raft:
+            def apply(self_inner, *a, **k):
+                # simulate a heartbeat landing during the failing apply
+                ttl = hb.reset_heartbeat_timer(node.id)
+                new_deadline["v"] = hb._deadlines[node.id]
+                raise RuntimeError("transient raft error")
+
+        real_raft = s.raft
+        s.raft = _Raft()
+        try:
+            hb._sweep(time.time())
+        finally:
+            s.raft = real_raft
+        assert hb._deadlines[node.id] == new_deadline["v"]
+    finally:
+        s.shutdown()
+
+
+# -------------------------------------------------- worker satellite
+
+def test_worker_eval_failure_counted_then_retried():
+    from nomad_tpu.server.server import Server
+    s = Server(num_workers=1, gc_interval=9999)
+    s.eval_broker.initial_nack_delay = 0.05
+    s.eval_broker.subsequent_nack_delay = 0.05
+    s.start()
+    try:
+        node = mock.node()
+        s.node_register(node)
+        faults.install({"worker.invoke": {"mode": "raise", "times": 1}})
+        f0 = metrics.counter("nomad.worker.eval_failures")
+        sw0 = metrics.counter("nomad.swallowed_errors.worker.run")
+        job = mock.batch_job()
+        tg = job.task_groups[0]
+        tg.networks = []
+        tg.tasks[0].resources.networks = []
+        res = s.job_register(job)
+        # first delivery faults (counted, nacked); the retry completes
+        assert wait_until(lambda: (
+            (ev := s.state.eval_by_id(res["eval_id"])) is not None
+            and ev.status == "complete"), timeout=10)
+        assert metrics.counter("nomad.worker.eval_failures") == f0 + 1
+        assert metrics.counter(
+            "nomad.swallowed_errors.worker.run") == sw0 + 1
+    finally:
+        s.shutdown()
+
+
+# ------------------------------------------------- planner satellite
+
+def test_planner_stop_fails_stranded_pendings():
+    """A pipelined worker blocked on pending.wait() must resolve when
+    the planner stops — both the in-flight plan (applier mid-apply past
+    the join timeout) and plans still queued behind it."""
+    from nomad_tpu.server.fsm import NomadFSM, RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+    fsm = NomadFSM()
+    planner = Planner(RaftLog(fsm), fsm.state)
+    planner.start()
+    faults.install({"planner.apply": {"mode": "delay", "delay_ms": 1500}})
+    inflight = planner.submit_plan_async(Plan())
+    assert wait_until(lambda: planner._inflight is inflight, timeout=2)
+    queued = planner.submit_plan_async(Plan())
+    t0 = time.perf_counter()
+    planner.stop(timeout=0.2)
+    result, err = inflight.wait(1.0)
+    assert result is None and err == "planner stopped"
+    result_q, err_q = queued.wait(1.0)
+    assert result_q is None and err_q == "plan queue disabled"
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_plan_queue_rejects_after_disable():
+    from nomad_tpu.server.plan_apply import PlanQueue
+    q = PlanQueue()
+    q.set_enabled(True)
+    held = q.enqueue(Plan())
+    q.set_enabled(False)
+    _, err = held.wait(0.5)
+    assert err == "plan queue disabled"
+    late = q.enqueue(Plan())
+    _, err2 = late.wait(0.5)
+    assert err2 == "plan queue disabled"
