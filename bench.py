@@ -31,7 +31,7 @@ N_TASKS = 50_000
 TARGET_S = 1.0
 
 STREAM_EVALS = 16
-STREAM_CONCURRENCY = 4      # worker threads serving the 1k-eval stream
+STREAM_CONCURRENCY = 16     # worker threads serving the 1k-eval stream
 STREAM_WINDOW_MS = 15.0     # eval coalescing window for the stream burst
 
 # state writes from bench shims (index mint + upsert) are not atomic in
@@ -276,6 +276,8 @@ def _stream_run(fsm_s, n_evals: int, concurrency: int) -> list:
     from nomad_tpu.structs import (
         Evaluation, SchedulerConfiguration, SCHED_ALG_TPU, new_id,
     )
+    from nomad_tpu.solver import microbatch
+
     s = fsm_s.state
     # stream-shaped coalescing window via the hot-reloadable operator
     # knob (the same runtime-mutation path the SchedulerAlgorithm enum
@@ -296,6 +298,20 @@ def _stream_run(fsm_s, n_evals: int, concurrency: int) -> list:
         work.append(ev)
     times: list = []
     errors: list = []
+    # the production path pushes the eval broker's dequeued-but-unacked
+    # count into the micro-batcher so the FIRST solve of a burst knows
+    # siblings are coming; the bench bypasses the broker, so its workers
+    # feed the same hint themselves — without this every stream solve saw
+    # concurrency<=1 and took the solo host-tier fast path, pinning
+    # backend_tiers_stream to host (ISSUE 4 satellite, BENCH_r05 host=16)
+    outstanding = [n_evals]
+    out_lock = threading.Lock()
+    microbatch.broker_in_flight(n_evals)
+
+    def _eval_done():
+        with out_lock:
+            outstanding[0] -= 1
+            microbatch.broker_in_flight(outstanding[0])
 
     def worker():
         while True:
@@ -310,8 +326,10 @@ def _stream_run(fsm_s, n_evals: int, concurrency: int) -> list:
                 sched.process(ev)
             except BaseException as e:      # noqa: BLE001 — fail the bench
                 errors.append(e)
+                _eval_done()
                 return
             times.append(time.perf_counter() - t0)
+            _eval_done()
 
     threads = [threading.Thread(target=worker, daemon=True,
                                 name=f"stream-worker-{i}")
@@ -321,6 +339,7 @@ def _stream_run(fsm_s, n_evals: int, concurrency: int) -> list:
     for t in threads:
         t.join()
     planner_s.stop()
+    microbatch.broker_in_flight(0)
     # a silently-shorter stream would overstate evals/sec and poison the
     # regression gate's recorded best — fail loudly instead
     if errors:
@@ -533,12 +552,27 @@ def main() -> None:
         "solo": int(metrics.counter("nomad.solver.microbatch.solo")
                     - stream_base.get("nomad.solver.microbatch.solo", 0)),
     }
-    if platform == "tpu":
+    # state-cache effectiveness over the TIMED stream only (ISSUE 4): the
+    # steady-state phase must be delta-driven, not rebuild-per-eval
+    def _sc(name: str) -> int:
+        key = f"nomad.solver.state_cache.{name}"
+        return int(metrics.counter(key) - stream_base.get(key, 0))
+    sc_hits, sc_misses = _sc("hits"), _sc("misses")
+    tensor_cache_hit_rate = (sc_hits / (sc_hits + sc_misses)
+                             if sc_hits + sc_misses else 0.0)
+    state_cache_counters = {
+        k.split("nomad.solver.state_cache.")[-1]: int(v)
+        for k, v in metrics.snapshot()["counters"].items()
+        if k.startswith("nomad.solver.state_cache.")}
+    if platform == "tpu" and STREAM_CONCURRENCY >= 4:
         # the eval stream must be served by coalesced device dispatches
         # (the batch tier), not host-only — a few solo host solves at the
         # stream's ragged edges are expected, host-ONLY is the regression
+        # (BENCH_r05: host=16 because the bench never fed the broker
+        # in-flight hint; _stream_run now does)
         assert stream_tiers.get("nomad.solver.backend.batch"), \
-            f"stream evals never rode the batch tier: {stream_tiers}"
+            f"stream evals never rode the batch tier at concurrency " \
+            f"{STREAM_CONCURRENCY}: {stream_tiers}"
         assert stream_microbatch["dispatches"] >= 1, \
             f"no coalesced device dispatch fired: {stream_microbatch}"
 
@@ -596,6 +630,8 @@ def main() -> None:
         "stream_concurrency": STREAM_CONCURRENCY,
         "stream_batch_size_p50": round(stream_batch_size_p50, 1),
         "stream_microbatch": stream_microbatch,
+        "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
+        "state_cache": state_cache_counters,
         **phases,
         "phase_overlap_fraction": phase_overlap_fraction,
         "plan_pipeline_chunks": pipeline_chunks,

@@ -67,6 +67,14 @@ def enable_compile_cache(path: str = "") -> str:
     # caching; the default 1s floor would skip the small eval-stream jits
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        # also persist XLA's internal caches (autotune results, kernel
+        # selections) — on TPU these are a real slice of the warm-restart
+        # blackout beyond executable deserialization. Knob is version-
+        # dependent; best-effort.
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:   # noqa: BLE001 — older jax: executables still cache
+        pass
     _cache_enabled = True
     return path
 

@@ -190,6 +190,20 @@ class Planner:
         with metrics.measure("nomad.plan.apply"):
             index = self.raft.apply(APPLY_PLAN_RESULTS, {"result": req})
         result.alloc_index = index
+        # feed the committed plan's usage deltas to the solver's device-
+        # resident tensor cache HERE, on the leader-serial applier thread:
+        # the journal replay (host np.add.at + one batched device scatter)
+        # runs off the eval critical path, so the next eval's tensorize is
+        # a pure cache hit (ISSUE 4; docs/DEVICE_STATE_CACHE.md). The plan
+        # IS committed at this point — no cache-feed failure may surface
+        # as a failed apply (the worker would fail an eval whose plan
+        # landed); lazy import keeps a stripped solver-less build booting.
+        try:
+            from ..solver import state_cache
+            state_cache.note_commit(self.state)
+        except Exception as e:   # noqa: BLE001 — telemetry-grade feed
+            from ..metrics import record_swallowed_error
+            record_swallowed_error("plan_apply.state_cache_feed", e)
         return result
 
     def _evaluate_plan_dense(self, snap, plan: Plan) -> dict:

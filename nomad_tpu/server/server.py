@@ -197,8 +197,15 @@ class Server:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        from ..runtime import tune_gc
+        import os
+
+        from ..runtime import enable_compile_cache, tune_gc
         tune_gc()          # allocation-heavy plans vs default GC cadence
+        if os.environ.get("NOMAD_COMPILE_CACHE"):
+            # persistent XLA compile cache BEFORE the first jit: a warm
+            # restart then replays serialized executables instead of
+            # recompiling the solver grid as placement blackout
+            enable_compile_cache()
         if self.raft_node is None:
             self._establish_leadership()
         else:
@@ -593,12 +600,35 @@ class Server:
         self._leader_thread = threading.Thread(
             target=self._leader_loop, daemon=True, name="leader-loop")
         self._leader_thread.start()
+        # pre-compile the solver's (kernel, tier, bucket) grid for this
+        # cluster size in the background (ISSUE 4): a freshly-promoted
+        # leader should not pay cold XLA compiles as placement blackout
+        # on its first real eval. Below backend.WARMUP_MIN_NODES this is
+        # a no-op (unit-test servers must not compile the world).
+        threading.Thread(target=self._solver_warmup, daemon=True,
+                         name="solver-warmup").start()
         # non-authoritative region leaders mirror ACL state from the
         # authoritative region (ref nomad/leader.go:1288
         # replicateACLPolicies / :1368 replicateACLTokens)
         if self.region != self.authoritative_region:
             threading.Thread(target=self._acl_replication_loop, daemon=True,
                              name="acl-replication").start()
+
+    def _solver_warmup(self) -> None:
+        """Leader-election AOT warmup (backend.warmup). Lazy import: a
+        stripped build without the solver stays bootable; any failure is
+        logged, never fatal — evals just pay the compiles lazily."""
+        try:
+            from ..solver import backend
+            out = backend.warmup(len(self.state.iter_nodes()))
+            if not out.get("skipped"):
+                self.logger(
+                    f"server: solver warmup compiled {out['artifacts']} "
+                    f"artifacts for bucket {out.get('bucket')} in "
+                    f"{out['seconds']}s")
+        except Exception as e:      # noqa: BLE001 — warmup is best-effort
+            from ..metrics import record_swallowed_error
+            record_swallowed_error("server.solver_warmup", e, self.logger)
 
     def _leader_loop(self) -> None:
         """Broker nack-timeout reaping + periodic core GC evals

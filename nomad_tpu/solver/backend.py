@@ -319,7 +319,12 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
     fns = [(t, _build(kernel, t, devs, k_max, max_steps,
                       spread_algorithm, depth_grid)) for t in tiers]
 
-    def run(*args):
+    def run(*args, host_args=None):
+        """`host_args`: uncommitted (numpy) twin of `args`, supplied when
+        the primary dispatch rides committed device buffers (the state
+        cache's resident twins). Every tier BELOW the primary uses it —
+        the host floor's contract is uncommitted inputs, and retrying a
+        sick device's own buffers would defeat the ladder."""
         import jax
         errs = device_error_types()
         last_err = None
@@ -329,10 +334,11 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
                 metrics.incr(
                     f"nomad.solver.tier_breaker_short_circuit.{tier}")
                 continue
+            use = args if i == 0 or host_args is None else host_args
             async_mode = getattr(_dispatch_ctx, "on", False)
             try:
                 faults.fire(f"solver.dispatch.{tier}")
-                out = fn(*args)
+                out = fn(*use)
                 if not async_mode:
                     out = jax.block_until_ready(out)
             except errs as e:
@@ -539,3 +545,91 @@ def record(kernel: str, backend: str) -> None:
     """Emit the per-solve routing metrics the bench/judge read."""
     metrics.incr(f"nomad.solver.backend.{backend}")
     metrics.incr(f"nomad.solver.kernel.{kernel}.{backend}")
+
+
+# ------------------------------------------------------------------ warmup
+
+# clusters below this don't warm by default: the grid costs real compile
+# seconds and a unit-test server with a handful of mock nodes would pay
+# it on every promotion. NOMAD_AOT_WARMUP=1 forces, =0 disables.
+WARMUP_MIN_NODES = 256
+
+
+def warmup(n_nodes: int, k_maxes: tuple = (8, 64, 128),
+           budget_s: float = 300.0) -> dict:
+    """Pre-compile the (kernel, tier, bucket) grid a leader will dispatch
+    (ISSUE 4 tentpole): called from Server._establish_leadership on
+    promotion (background thread), so the first real eval after an
+    election replays compiled artifacts instead of paying cold XLA
+    compiles as placement blackout. With NOMAD_COMPILE_CACHE set the same
+    pass populates the persistent cache, so a warm RESTART skips even
+    this. The grid is enumerable precisely because every node axis is
+    bucketed through buckets.node_bucket (one place).
+
+    Artifacts are warmed by driving one tiny synthetic solve through the
+    REAL `select()` chains — that populates the exact in-memory jit caches
+    the eval path hits (an AOT lower().compile() would warm a parallel
+    cache the dispatch path never reads) and, transitively, the
+    persistent cache. Most-valuable-first under `budget_s`: the depth
+    regimes (both), then greedy, then the chunked scan."""
+    import numpy as np
+
+    from .buckets import node_bucket
+    from .kernels import DEPTH_GRID, NUM_XR
+
+    mode = os.environ.get("NOMAD_AOT_WARMUP", "")
+    if mode == "0" or (n_nodes < WARMUP_MIN_NODES and mode != "1"):
+        return {"skipped": True, "artifacts": 0, "seconds": 0.0}
+    bucket = node_bucket(n_nodes)
+    cap = np.zeros((bucket, NUM_XR), np.float32)
+    cap[:] = (4_000.0, 8_192.0, 500_000.0, 12_001.0, 10_000.0)
+    used = np.zeros_like(cap)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[:3] = (250.0, 512.0, 300.0)
+    feasible = np.ones(bucket, bool)
+    jitter = np.zeros(bucket, np.float32)
+    coll = np.zeros(bucket, np.int32)
+    t0 = time.monotonic()
+    artifacts = 0
+    plan: list[tuple] = []
+    for k_max in k_maxes:
+        grid = tuple(g for g in DEPTH_GRID if g <= k_max) or (1,)
+        # deterministic full-curve regime (the large-eval artifact), then
+        # the jittered sampled-grid regime (the small-eval stream artifact)
+        plan.append(("depth", {"k_max": k_max, "depth_grid": None}))
+        plan.append(("depth", {"k_max": k_max, "depth_grid": grid}))
+    plan.append(("greedy", {}))
+    plan.append(("chunked", {"max_steps": 256}))
+    for kernel, kw in plan:
+        if time.monotonic() - t0 > budget_s:
+            metrics.incr("nomad.solver.warmup.budget_exhausted")
+            break
+        try:
+            bname, fn = select(kernel, bucket, count=bucket * 4, **kw)
+            if kernel == "depth":
+                fn(cap, used, ask, np.int32(1), feasible, coll,
+                   np.int32(1), np.zeros(bucket, np.float32),
+                   np.int32(2 ** 30), jitter,
+                   np.float32(1.0), np.float32(0.0))
+            elif kernel == "greedy":
+                fn(cap, used, ask, np.int32(1), feasible, np.int32(2 ** 30))
+            else:
+                s_ids = np.full((1, bucket), -1, np.int32)
+                pad2 = np.full((1, 2), -1, np.int32)
+                fn(cap, used, ask, np.int32(1), feasible, coll,
+                   np.int32(1), s_ids, pad2,
+                   np.full((1, 2), -1.0, np.float32),
+                   np.full(1, -1, np.int32), np.zeros(1, np.float32),
+                   np.zeros(bucket, np.float32), s_ids, pad2,
+                   np.zeros(bucket, np.int32), np.int32(2 ** 30))
+            artifacts += 1
+        except Exception as e:  # noqa: BLE001 — warmup must never wedge
+            metrics.incr("nomad.solver.warmup.errors")
+            if os.environ.get("NOMAD_DEBUG"):
+                raise
+            del e
+    seconds = time.monotonic() - t0
+    metrics.incr("nomad.solver.warmup.artifacts", artifacts)
+    metrics.set_gauge("nomad.solver.warmup.seconds", round(seconds, 3))
+    return {"skipped": False, "artifacts": artifacts,
+            "seconds": round(seconds, 3), "bucket": bucket}

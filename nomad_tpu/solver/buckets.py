@@ -1,0 +1,31 @@
+"""Shape bucketing — the ONE place the solver rounds axes to pow2
+(ISSUE 4 tentpole). Every padded axis keys a jit compile cache entry
+(and, through the persistent compilation cache, an on-disk executable),
+so padding decisions scattered across tensorize/placer/microbatch meant
+N call sites could silently disagree and fan the artifact set out.
+Single-sourcing them here makes the compile-cache key space enumerable —
+which is exactly what `backend.warmup()` walks at leader election.
+
+  node_bucket(n)   the padded node axis for n live nodes (floor 8);
+                   tensorize's device gathers, the placer's host padding,
+                   state_cache's device twins and backend.warmup() must
+                   all agree on this or a cache-hit eval would recompile.
+  pow2(n, floor)   generic pow2 round-up (spread/distinct stanza axes,
+                   preemption victim axes, scatter-batch padding).
+  BATCH_LANES      the eval-stream micro-batch lane count (one compiled
+                   jit(vmap) artifact, ever — microbatch.py).
+"""
+from __future__ import annotations
+
+NODE_BUCKET_FLOOR = 8
+BATCH_LANES = 8
+
+
+def pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, 1), at least `floor`."""
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+def node_bucket(n: int) -> int:
+    """The padded node-axis bucket for `n` live nodes."""
+    return pow2(n, NODE_BUCKET_FLOOR)

@@ -40,8 +40,8 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..metrics import metrics
-
-LANES = 8                   # fixed batch padding (one compiled artifact)
+from .buckets import BATCH_LANES as LANES   # fixed batch padding (one
+                                            # compiled artifact, ever)
 FOLLOWER_TIMEOUT = 120.0    # follower safety valve if a leader dies
 
 

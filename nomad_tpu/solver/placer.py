@@ -38,6 +38,7 @@ from ..structs import (
 )
 from ..scheduler.stack import SelectOptions
 from . import backend, microbatch
+from .buckets import node_bucket, pow2
 from .tensorize import (
     build_group_tensors, _lower_affinities, _lower_distinct, _lower_spreads,
 )
@@ -293,11 +294,13 @@ class SolverPlacer:
         else:
             sp = dp = aff = None
 
-        # pad the node axis to a power-of-2 bucket so the jitted kernels
-        # compile once per bucket, not once per cluster size; padding rows
-        # are infeasible and can never be chosen
+        # pad the node axis to the shared pow2 bucket (buckets.node_bucket
+        # — the same bucket the state cache's device twins and
+        # backend.warmup() key on) so the jitted kernels compile once per
+        # bucket, not once per cluster size; padding rows are infeasible
+        # and can never be chosen
         n = gt.cap.shape[0]
-        padded = max(8, 1 << (n - 1).bit_length())
+        padded = node_bucket(n)
         if padded != n:
             pad = padded - n
             gt.cap = np.pad(gt.cap, ((0, pad), (0, 0)))
@@ -379,12 +382,29 @@ class SolverPlacer:
                     g for g in DEPTH_GRID if g <= k_max) or (1,)
         return prep
 
+    @staticmethod
+    def _dev_mats(gt, bname: str):
+        """The state cache's device twins, when tier `bname` should ride
+        them (values identical to gt.cap/gt.used, transfer already
+        paid) — else None. Only the default-device tiers qualify:
+        host/batch need numpy so `jax.default_device` (and the micro-
+        batcher's np.stack lane packing) place them host-side, and
+        sharded keeps numpy so GSPMD owns the initial layout. Callers
+        MUST pass the numpy twin as the chain's `host_args` so a
+        demotion never retries the sick device's own buffers."""
+        if gt.cap_dev is not None and gt.used_dev is not None and \
+                bname in ("xla", "pallas"):
+            return gt.cap_dev, gt.used_dev
+        return None
+
     def _depth_solve_args(self, prep, tg, count):
         """The normalized depth-kernel positional args for `count`
         instances — shared by the one-shot and chunked dispatch sites.
         Inputs stay numpy (uncommitted): each tier's jit places them on
         ITS device — pre-committing to the default device would drag
-        host-tier solves back to the accelerator."""
+        host-tier solves back to the accelerator. The dispatch sites
+        swap in the cache's device twins for the primary tier only
+        (_dev_mats + chain host_args)."""
         gt = prep.gt
         return (gt.cap, gt.used, gt.ask, np.int32(count), gt.feasible,
                 gt.job_collisions, np.int32(tg.count), prep.aff,
@@ -423,7 +443,12 @@ class SolverPlacer:
                 "depth", gt.cap.shape[0], count=count, k_max=prep.k_max,
                 spread_algorithm=spread_alg, depth_grid=prep.depth_grid)
             backend.record("depth", bname)
-            placed = depth_fn(*self._depth_solve_args(prep, tg, count))
+            d_args = self._depth_solve_args(prep, tg, count)
+            dev = self._dev_mats(gt, bname)
+            if dev is not None:
+                placed = depth_fn(*(dev + d_args[2:]), host_args=d_args)
+            else:
+                placed = depth_fn(*d_args)
         elif use_scan:
             # one solve covers max_steps * k instances; split larger asks
             # across repeated solves, feeding the running state (usage,
@@ -463,9 +488,13 @@ class SolverPlacer:
             bname, greedy = backend.select("greedy", gt.cap.shape[0],
                                            count=count)
             backend.record("greedy", bname)
-            placed = greedy(
-                gt.cap, gt.used, gt.ask, np.int32(count),
-                gt.feasible, np.int32(max_per_node))
+            g_args = (gt.cap, gt.used, gt.ask, np.int32(count),
+                      gt.feasible, np.int32(max_per_node))
+            dev = self._dev_mats(gt, bname)
+            if dev is not None:
+                placed = greedy(*(dev + g_args[2:]), host_args=g_args)
+            else:
+                placed = greedy(*g_args)
         placed = np.array(np.asarray(placed)[:n])   # writable host copy
         if use_scan and distincts:
             # chunk > 1 places several instances per scan step, which can
@@ -588,6 +617,11 @@ class SolverPlacer:
                             for i in range(n_chunks)]
             chunk_counts = [c for c in chunk_counts if c > 0]
             futs = []
+            # numpy mats only: chunk N+1's inputs are device-evolved from
+            # chunk N's future, and a mid-pipeline sync demotion would
+            # otherwise retry a lower tier on the sick device's buffers —
+            # the async chunk-fallback path (below) owns device-loss
+            # recovery with a host-side usage replay
             args = self._depth_solve_args(prep, tg, count)
             used_cur = prep.gt.used
             coll_cur = prep.gt.job_collisions
@@ -656,7 +690,7 @@ class SolverPlacer:
                                 f"for remaining chunks")
                 if placed_pad is None:
                     host_fn, used_h, coll_h = degraded
-                    a = (args[0], used_h, args[2],
+                    a = (prep.gt.cap, used_h, args[2],
                          np.int32(chunk_counts[ci]), args[4],
                          coll_h) + args[6:]
                     placed_pad = np.asarray(host_fn(*a))
@@ -858,7 +892,7 @@ class SolverPlacer:
             return missings
 
         c = len(candidates)
-        v_pad = max(1, 1 << (max_v - 1).bit_length())
+        v_pad = pow2(max_v)             # victim axis shares the bucketing
         from .kernels import NUM_XR
         victim_res = np.zeros((c, v_pad, NUM_XR), np.float32)
         victim_prio = np.full((c, v_pad), 2 ** 20, np.int32)  # pad: ineligible

@@ -1,0 +1,369 @@
+"""Device-resident incremental cluster tensors (ISSUE 4 tentpole).
+
+BENCH_r05 showed the steady-state eval stream spends its time rebuilding
+solver inputs, not solving: every eval re-lowered the full snapshot to
+dense host tensors and re-shipped them to the device (CvxCluster's
+observation inverted — the win is keeping the allocation problem resident
+in solver-native form ACROSS solves; Tesserae: placement throughput is
+state-refresh-bound). This cache keeps the cluster's cap/used [N, R']
+matrices and the per-node live-alloc count vector:
+
+  * built ONCE from a snapshot's `UsageView` at version i (a miss), then
+  * advanced to version j by replaying the usage index's `DeltaLog`
+    records — `np.add.at` over the journaled (row, delta) stream, the
+    EXACT op and order the store itself uses, so the advanced arrays are
+    bit-identical to a fresh view at j (the hard requirement; enforced by
+    tests/test_state_cache.py's randomized replay differential), and
+  * mirrored to the device as bucket-padded twins advanced by batched
+    scatter updates — per advance, the touched rows' final values are
+    scattered into the resident buffers, so a steady-state eval's device
+    input is one on-device gather instead of a fresh host build + h2d.
+
+Keying follows the usage index's versioning contract (usage_index.py):
+(uid, epoch) is the node-set fingerprint — any node add/drop/capacity
+change or store restore misses and reseeds; `version` orders the delta
+stream. On ANY miss, gap (journal trimmed past our cursor), or stale
+snapshot the caller falls back to the plain view build, which is the
+same bits by construction.
+
+Concurrency: scheduler workers snapshot at slightly different versions,
+and the cache can only roll forward. A small ring of displaced `used`
+generations (each valid for a version interval) serves the common
+"one commit behind" snapshot; anything older falls back (counted as a
+miss + `.stale`). All reads/advances happen under one lock; handed-out
+arrays are always fancy-index copies, and nothing outside this module
+may mutate the resident arrays (nomadlint DET002 enforces the contract
+statically).
+
+The device twins are NOT donated on update: an in-flight eval's async
+gather may still alias the displaced buffer, and XLA would fall back to
+a silent copy anyway — the old generation is dropped by refcount once
+outstanding gathers materialize (docs/DEVICE_STATE_CACHE.md).
+
+`plan_apply.Planner.apply_plan` calls `note_commit` after every raft
+commit, so the replay usually runs on the leader-serial applier thread —
+off the eval critical path — and the next eval's acquire is a pure hit.
+
+NOMAD_STATE_CACHE=0 disables the cache entirely (ops escape hatch; the
+differential tests also use it to produce the oracle path).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..metrics import metrics
+from .buckets import node_bucket, pow2
+
+# displaced used-generations kept for stale views. Sized for the worst
+# realistic snapshot lag: a full complement of concurrent scheduler
+# workers (bench streams at 16) can each land one commit between a
+# sibling's snapshot and its gather, so the ring must cover that many
+# displacements or stale serves (misses) eat the hit-rate gate. ~200KB
+# per generation at 10k nodes — memory is not the constraint.
+RING = 16
+
+
+class _Generation:
+    """A displaced `used` matrix, valid for views with
+    lo <= view.version < hi (arrays reflect exactly the journal prefix
+    through version `lo`; `hi` is the first entry version of the advance
+    that displaced it)."""
+
+    __slots__ = ("lo", "hi", "used")
+
+    def __init__(self, lo: int, hi: int, used: np.ndarray):
+        self.lo = lo
+        self.hi = hi
+        self.used = used
+
+
+class GatherResult:
+    """One eval's slice of the cached tensors, in eval (shuffled node)
+    order. cap/used are fresh host copies (callers may apply in-plan
+    corrections in place); cap_dev/used_dev — when the current device
+    generation served the request — are bucket-padded device arrays ready
+    for dispatch (padding rows zero, exactly like the host np.pad path)."""
+
+    __slots__ = ("cap", "used", "cap_dev", "used_dev")
+
+    def __init__(self, cap, used, cap_dev=None, used_dev=None):
+        self.cap = cap
+        self.used = used
+        self.cap_dev = cap_dev
+        self.used_dev = used_dev
+
+
+class TensorCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._uid = 0                   # source UsageIndex identity
+        self._epoch = -1                # node-set fingerprint
+        self.version = 0                # version of the last applied entry
+        self._seq = 0                   # absolute journal cursor
+        self.cap: Optional[np.ndarray] = None
+        self.used: Optional[np.ndarray] = None
+        self.counts: Optional[np.ndarray] = None
+        self._ring: list[_Generation] = []
+        self._bucket = 0                # device twin row count (pow2)
+        self._cap_dev = None
+        self._used_dev = None
+        self._jits: dict = {}           # (kind, *shape) -> jitted helper
+
+    # ------------------------------------------------------------- control
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get("NOMAD_STATE_CACHE", "") != "0"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._uid = 0
+            self._epoch = -1
+            self.version = 0
+            self._seq = 0
+            self.cap = self.used = self.counts = None
+            self._ring = []
+            self._bucket = 0
+            self._cap_dev = self._used_dev = None
+            self._jits.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"uid": self._uid, "epoch": self._epoch,
+                    "version": self.version, "seq": self._seq,
+                    "rows": 0 if self.cap is None else int(self.cap.shape[0]),
+                    "generations": len(self._ring)}
+
+    # ------------------------------------------------------------ internals
+
+    def _jit(self, kind: str, *key):
+        """Shape-keyed jit helpers; keys ride the pow2 buckets so the
+        artifact set stays enumerable (JIT002 cache-store idiom)."""
+        fn = self._jits.get((kind,) + key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            if kind == "gather":
+                def gather(c, u, i, m):
+                    m2 = m[:, None]
+                    return (jnp.where(m2, c[i], 0.0),
+                            jnp.where(m2, u[i], 0.0))
+                self._jits[(kind,) + key] = jax.jit(gather)
+            else:               # scatter: set final row values (order-free)
+                self._jits[(kind,) + key] = jax.jit(
+                    lambda a, i, v: a.at[i].set(v))
+            fn = self._jits[(kind,) + key]
+        return fn
+
+    def _seed_locked(self, view) -> None:
+        """Full rebuild from the view (the miss path). The seed arrays ARE
+        the view's bits, so a seeded cache trivially matches the fallback
+        path at this version."""
+        self._uid = view.uid
+        self._epoch = view.epoch
+        self.version = view.version
+        self.cap = view.cap.copy()
+        self.used = view.used.copy()
+        self.counts = (view.counts.copy() if view.counts is not None
+                       else np.zeros(view.cap.shape[0], np.int32))
+        self._ring = []
+        # journal cursor: first entry past the view's version (entries are
+        # version-ordered; post-view entries are few — scan backward)
+        floor, entries = view.delta_log.tail
+        k = len(entries)
+        while k > 0 and entries[k - 1][0] > view.version:
+            k -= 1
+        self._seq = floor + k
+        self._seed_device_locked()
+        metrics.incr("nomad.solver.state_cache.misses")
+        metrics.incr("nomad.solver.state_cache.reseeds")
+
+    def _seed_device_locked(self) -> None:
+        n = self.cap.shape[0]
+        self._bucket = node_bucket(n)
+        try:
+            import jax.numpy as jnp
+            pad = self._bucket - n
+            self._cap_dev = jnp.asarray(np.pad(self.cap, ((0, pad), (0, 0))))
+            self._used_dev = jnp.asarray(np.pad(self.used,
+                                                ((0, pad), (0, 0))))
+        except Exception:   # noqa: BLE001 — host mirrors stay authoritative
+            self._cap_dev = self._used_dev = None
+
+    def _advance_locked(self, target_version: int, log) -> bool:
+        """Replay journal entries with version <= target_version from the
+        cursor. Returns False on a gap (journal trimmed past the cursor —
+        caller reseeds). Only entry versions actually applied move
+        `self.version`, so a half-appended batch seen from note_commit can
+        never mark unseen deltas as applied."""
+        floor, entries = log.tail
+        start = self._seq - floor
+        if start < 0:
+            return False                         # gap: trimmed past us
+        k = start
+        end = len(entries)
+        while k < end and entries[k][0] <= target_version:
+            k += 1
+        if k == start:
+            return True                          # nothing to do
+        batch = entries[start:k]
+        rows = np.fromiter((e[1] for e in batch), np.int64, count=len(batch))
+        if int(rows.max()) >= self.used.shape[0]:
+            # a row past our arrays means the node set grew under us — an
+            # unlocked note_commit can race a node register + its first
+            # alloc between the epoch check and the version read. Nothing
+            # is applied; the caller reseeds (gather) or skips (feed).
+            return False
+        deltas = np.array([e[2] for e in batch], np.float32)
+        cdeltas = np.fromiter((e[3] for e in batch), np.int32,
+                              count=len(batch))
+        first_v = batch[0][0]
+        # displace the current used generation into the ring (cap is
+        # shared: alloc deltas never touch capacity; epoch rebuilds do)
+        self._ring.append(_Generation(self.version, first_v, self.used))
+        del self._ring[:-RING]
+        self.used = self.used.copy()
+        np.add.at(self.used, rows, deltas)
+        np.add.at(self.counts, rows, cdeltas)
+        self._scatter_device_locked(rows)
+        self._seq = floor + k
+        self.version = batch[-1][0]
+        metrics.incr("nomad.solver.state_cache.delta_rows", len(batch))
+        return True
+
+    def _scatter_device_locked(self, rows: np.ndarray) -> None:
+        """Advance the device twin: one batched scatter of the touched
+        rows' FINAL host values. Scatter-set (not scatter-add) keeps the
+        device bits equal to the host mirror regardless of duplicate-index
+        ordering inside XLA's scatter."""
+        if self._used_dev is None:
+            return
+        try:
+            uniq = np.unique(rows)
+            k = pow2(len(uniq))
+            idx = np.full(k, uniq[0], np.int32)      # pad repeats row 0:
+            idx[:len(uniq)] = uniq                   # same value re-set
+            vals = self.used[idx]
+            fn = self._jit("scatter", self._bucket, k)
+            self._used_dev = fn(self._used_dev, idx, vals)
+        except Exception:   # noqa: BLE001 — drop the twin, host wins
+            self._cap_dev = self._used_dev = None
+
+    # -------------------------------------------------------------- reading
+
+    def gather(self, view, rows: np.ndarray,
+               bucket: int = 0) -> Optional[GatherResult]:
+        """Serve one eval's (shuffled) node rows from the cache, advancing
+        it to the view's version first. Returns None when the cache is
+        disabled or the view carries no versioning stamp (plain test
+        fakes) — the caller then builds from the view exactly as before.
+        A stale view (older than every resident generation) is served
+        straight from the view's own arrays and counted as a miss."""
+        if view.uid == 0 or view.delta_log is None or not self.enabled():
+            return None
+        # the lock covers only version bookkeeping + the journal replay;
+        # the per-eval fancy-index copies and the device gather run
+        # OUTSIDE it on captured references — once displaced or replaced,
+        # generation arrays (host and device) are never mutated again, so
+        # concurrent workers' gathers don't convoy on one lock
+        dev = None
+        with self._lock:
+            if view.uid == self._uid and view.epoch < self._epoch:
+                # a snapshot from BEFORE a node-set change (churn +
+                # concurrent workers): never roll the shared cache
+                # backward for it — the view itself is the only source
+                metrics.incr("nomad.solver.state_cache.misses")
+                metrics.incr("nomad.solver.state_cache.stale")
+                src_cap, src_used = view.cap, view.used
+            else:
+                seeded = False
+                if view.uid != self._uid or view.epoch != self._epoch or \
+                        self.cap is None:
+                    self._seed_locked(view)
+                    seeded = True
+                elif not self._advance_locked(view.version, view.delta_log):
+                    self._seed_locked(view)
+                    seeded = True
+                if view.version >= self.version:
+                    if not seeded:  # a reseed already counted its miss
+                        metrics.incr("nomad.solver.state_cache.hits")
+                    src_cap, src_used = self.cap, self.used
+                    if bucket and self._used_dev is not None:
+                        dev = (self._cap_dev, self._used_dev, self._bucket)
+                else:
+                    for gen in self._ring:
+                        if gen.lo <= view.version < gen.hi:
+                            metrics.incr("nomad.solver.state_cache.hits")
+                            metrics.incr(
+                                "nomad.solver.state_cache.ring_hits")
+                            src_cap, src_used = self.cap, gen.used
+                            break
+                    else:
+                        # older than every generation: view is the source
+                        metrics.incr("nomad.solver.state_cache.misses")
+                        metrics.incr("nomad.solver.state_cache.stale")
+                        src_cap, src_used = view.cap, view.used
+        out = GatherResult(src_cap[rows], src_used[rows])
+        if dev is not None:
+            out.cap_dev, out.used_dev = self._gather_device(dev, rows,
+                                                            bucket)
+        return out
+
+    def _gather_device(self, dev: tuple, rows: np.ndarray, bucket: int):
+        cap_dev, used_dev, src_bucket = dev
+        try:
+            n = len(rows)
+            idx = np.zeros(bucket, np.int32)
+            idx[:n] = rows
+            valid = np.zeros(bucket, bool)
+            valid[:n] = True
+            fn = self._jit("gather", src_bucket, bucket)
+            return fn(cap_dev, used_dev, idx, valid)
+        except Exception:   # noqa: BLE001 — host arrays already serve
+            return None, None
+
+    # ------------------------------------------------------------- feeding
+
+    def note_commit(self, store) -> None:
+        """Applier-thread hook (plan_apply): eagerly replay whatever the
+        journal holds so the next eval's gather is a pure hit. Advances
+        only through entries actually visible — a concurrent writer's
+        half-appended batch is picked up by a later advance."""
+        if not self.enabled():
+            return
+        usage = getattr(store, "usage", None)
+        if usage is None or getattr(usage, "uid", 0) == 0:
+            return
+        try:
+            with self._lock:
+                if usage.uid != self._uid or usage.epoch != self._epoch \
+                        or self.cap is None:
+                    return              # let the next eval pay the reseed
+                # epoch/version are read without the store lock: a node
+                # register can land between them, making the journal
+                # reference rows past our arrays — _advance_locked bounds-
+                # checks and refuses rather than corrupting; anything else
+                # unexpected must never fail the already-committed plan
+                self._advance_locked(usage.version, usage.delta_log)
+        except Exception as e:  # noqa: BLE001 — feed is best-effort
+            from ..metrics import record_swallowed_error
+            record_swallowed_error("state_cache.note_commit", e)
+
+
+_cache = TensorCache()
+
+
+def cache() -> TensorCache:
+    return _cache
+
+
+# module-level forwarding API (tensorize and plan_apply import these; one
+# process-wide cache matches the one-leader, one-device reality)
+gather = _cache.gather
+note_commit = _cache.note_commit
+reset = _cache.reset
+enabled = _cache.enabled

@@ -16,6 +16,7 @@ from ..structs import (
     Allocation, Node, TaskGroup, DEFAULT_MAX_DYNAMIC_PORT,
     DEFAULT_MIN_DYNAMIC_PORT, OP_DISTINCT_HOSTS,
 )
+from .buckets import node_bucket, pow2 as _pow2
 from .kernels import NUM_XR, XR_CPU, XR_DISK, XR_MBITS, XR_MEM, XR_PORTS
 
 DYN_PORT_SPAN = DEFAULT_MAX_DYNAMIC_PORT - DEFAULT_MIN_DYNAMIC_PORT + 1
@@ -23,7 +24,11 @@ DYN_PORT_SPAN = DEFAULT_MAX_DYNAMIC_PORT - DEFAULT_MIN_DYNAMIC_PORT + 1
 
 @dataclasses.dataclass
 class GroupTensors:
-    """Per-(eval, task group) solver input."""
+    """Per-(eval, task group) solver input. cap_dev/used_dev are set when
+    the state cache served this eval: bucket-padded device twins of
+    cap/used (same values, already resident), which the placer hands to
+    device-tier dispatches instead of paying a fresh h2d transfer. They
+    are dropped whenever the host copies diverge (in-plan corrections)."""
     nodes: list[Node]                  # row i of every array is nodes[i]
     cap: np.ndarray                    # f32[N, R'] usable capacity
     used: np.ndarray                   # f32[N, R'] proposed utilization
@@ -31,10 +36,28 @@ class GroupTensors:
     ask: np.ndarray                    # f32[R'] per-instance claim
     job_collisions: np.ndarray         # i32[N] same job+tg proposed allocs
     distinct_hosts: bool
+    cap_dev: object = None             # f32[B, R'] device twin (or None)
+    used_dev: object = None            # f32[B, R'] device twin (or None)
+
+
+# (node.id, node.modify_index) -> capacity row. node_capacity_row is pure
+# in the node and was recomputed for every row of every eval on the
+# object-walk path (ISSUE 4 satellite); the store stamps modify_index on
+# every node upsert, so the key invalidates exactly when the node changes.
+# Rows are frozen so an accidental caller mutation fails loudly instead of
+# corrupting every later eval's capacity.
+_CAP_ROW_MEMO: dict[tuple, np.ndarray] = {}
+_CAP_ROW_MEMO_MAX = 65_536
 
 
 def node_capacity_row(node: Node) -> np.ndarray:
-    """Usable capacity (total − node reservation) in extended layout."""
+    """Usable capacity (total − node reservation) in extended layout.
+    Memoized by (node.id, node.modify_index) — returns a read-only row;
+    copy before mutating."""
+    key = (node.id, node.modify_index)
+    row = _CAP_ROW_MEMO.get(key)
+    if row is not None:
+        return row
     row = np.zeros(NUM_XR, np.float32)
     res, rsv = node.node_resources, node.reserved_resources
     row[XR_CPU] = max(0, res.cpu.cpu_shares - rsv.cpu_shares)
@@ -42,6 +65,10 @@ def node_capacity_row(node: Node) -> np.ndarray:
     row[XR_DISK] = max(0, res.disk.disk_mb - rsv.disk_mb)
     row[XR_PORTS] = DYN_PORT_SPAN
     row[XR_MBITS] = sum(n.mbits for n in res.networks) or 0
+    row.flags.writeable = False
+    if len(_CAP_ROW_MEMO) >= _CAP_ROW_MEMO_MAX:
+        _CAP_ROW_MEMO.clear()           # rare full flush beats an LRU chain
+    _CAP_ROW_MEMO[key] = row
     return row
 
 
@@ -104,10 +131,6 @@ class DistinctTensors:
     scheduler/feasible.go:604 + propertyset.go)."""
     ids: np.ndarray        # i32[D, N] value id per node (-1 missing)
     remaining: np.ndarray  # i32[D, P]; remaining[d, 0] < 0 marks pad stanza
-
-
-def _pow2(n: int, floor: int = 1) -> int:
-    return max(floor, 1 << (max(n, 1) - 1).bit_length())
 
 
 def _lower_spreads(ctx, job, tg, spreads, nodes) -> SpreadTensors:
@@ -267,11 +290,22 @@ def build_group_tensors(ctx, job, tg: TaskGroup, nodes: list[Node],
 def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
                  view) -> GroupTensors:
     from ..state.usage_index import alloc_usage_tuple
+    from . import state_cache
     n = len(nodes)
     row = view.row
     rows = np.fromiter((row[node.id] for node in nodes), np.int64, count=n)
-    cap = view.cap[rows]                       # fancy index => fresh arrays
-    used = view.used[rows]
+    # the state cache serves versioned views: host copies of the SAME bits
+    # a fresh view gather yields (the bit-identity contract), plus bucket-
+    # padded device twins for the dispatch (ISSUE 4 tentpole). Unversioned
+    # views (plain test fakes) and a disabled cache take the view path.
+    cached = state_cache.gather(view, rows, bucket=node_bucket(n))
+    if cached is not None:
+        cap, used = cached.cap, cached.used
+        cap_dev, used_dev = cached.cap_dev, cached.used_dev
+    else:
+        cap = view.cap[rows]                   # fancy index => fresh arrays
+        used = view.used[rows]
+        cap_dev = used_dev = None
     pos = {node.id: i for i, node in enumerate(nodes)}
 
     # sparse in-plan correction: state allocs − plan stops/preemptions +
@@ -292,6 +326,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
                 if existing is not None and not existing.terminal_status() \
                         and existing.node_id == node_id:
                     used[i] -= alloc_usage_tuple(existing)
+                    used_dev = None     # host copy diverged from the twin
         for node_id, placed in plan.node_allocation.items():
             i = pos.get(node_id)
             for a in placed:
@@ -304,6 +339,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
                         and existing.node_id == node_id:
                     used[i] -= alloc_usage_tuple(existing)   # in-place update
                 used[i] += alloc_usage_tuple(a)
+                used_dev = None         # host copy diverged from the twin
                 if a.job_id == job.id and a.task_group == tg.name:
                     collisions[i] += 1
 
@@ -331,6 +367,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
         nodes=nodes, cap=cap, used=used, feasible=feasible,
         ask=group_ask_row(tg), job_collisions=collisions,
         distinct_hosts=distinct_hosts,
+        cap_dev=cap_dev, used_dev=used_dev,
     )
 
 

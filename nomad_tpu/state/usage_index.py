@@ -13,12 +13,55 @@ The extended resource axis R' (XR_*) packs the scalar dims (cpu, mem, disk)
 with the coarse sequential-resource dims (free dynamic ports, bandwidth) —
 one masked floor-divide on device yields per-node instance capacity
 (ref nomad/structs/funcs.go:147 AllocsFit, the scalar original).
+
+Versioning contract (ISSUE 4, docs/DEVICE_STATE_CACHE.md): every usage
+mutation bumps `version` and mirrors its signed (row, delta, count_delta)
+records into an append-only `DeltaLog` — the EXACT stream `_flush` feeds
+`np.add.at`, so any consumer that replays the log from a matching start
+state reproduces `used` bit-identically. Node-set / capacity-row changes
+bump `epoch` instead (no delta form — consumers rebuild). The solver's
+device-resident tensor cache (nomad_tpu/solver/state_cache.py) is the one
+consumer; `UsageView` carries (uid, epoch, version, delta_log) so a
+snapshot is enough to key the cache.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import numpy as np
+
+_UID = itertools.count(1)
+
+
+class DeltaLog:
+    """Append-only journal of usage deltas, one entry per `_pending`
+    append: (version, row, usage_delta_tuple, count_delta). Writers hold
+    the owning store's lock. `tail` is an immutable (floor_seq, entries)
+    pair swapped atomically on trim, so lock-free readers grab one
+    consistent generation: entries[k] is absolute sequence floor_seq + k,
+    and a reader that cached an older list only misses entries NEWER than
+    its target version. KEEP bounds memory; a consumer whose applied
+    sequence predates `floor_seq` sees a gap and must rebuild."""
+
+    MAX = 262_144
+    KEEP = 131_072
+
+    __slots__ = ("tail",)
+
+    def __init__(self):
+        self.tail: tuple[int, list] = (0, [])
+
+    def append(self, entry: tuple) -> None:
+        floor, entries = self.tail
+        entries.append(entry)
+        if len(entries) > self.MAX:
+            drop = len(entries) - self.KEEP
+            self.tail = (floor + drop, entries[drop:])
+
+    def head_seq(self) -> int:
+        floor, entries = self.tail
+        return floor + len(entries)
 
 # extended resource axis layout (solver kernels + tensorize must match)
 XR_CPU, XR_MEM, XR_DISK, XR_PORTS, XR_MBITS = 0, 1, 2, 3, 4
@@ -118,6 +161,9 @@ class UsageIndex:
         self.node_ids: list[str] = []            # row -> node_id
         self.cap = np.zeros((0, NUM_XR), np.float32)
         self.used = np.zeros((0, NUM_XR), np.float32)
+        # live (non-terminal) alloc count per row — the per-node density
+        # vector the tensor cache advances alongside used
+        self.counts = np.zeros(0, np.int32)
         self._n = 0                              # live rows
         # alloc_id -> (row, usage tuple, sequential?) for exact removal
         self._contrib: dict[str, tuple[int, tuple, bool]] = {}
@@ -127,6 +173,15 @@ class UsageIndex:
         # deferred signed (row, delta) updates: a 50k-alloc plan apply makes
         # one np.add.at instead of 50k per-row adds; flushed before any read
         self._pending: list[tuple[int, tuple]] = []
+        # versioning contract (module docstring): uid identifies this
+        # index instance (rebuild/restore mints a new one), epoch
+        # fingerprints the node set + capacity rows, version counts
+        # usage mutations; delta_log mirrors every _pending append
+        self.uid = next(_UID)
+        self.epoch = 0
+        self.version = 0
+        self.delta_log = DeltaLog()
+        self._view_cache: Optional[tuple] = None
 
     # ------------------------------------------------------------- writers
 
@@ -147,28 +202,38 @@ class UsageIndex:
                    self.cap.shape[0] * 2)
         cap = np.zeros((grow, NUM_XR), np.float32)
         used = np.zeros((grow, NUM_XR), np.float32)
+        counts = np.zeros(grow, np.int32)
         cap[:self._n] = self.cap[:self._n]
         used[:self._n] = self.used[:self._n]
-        self.cap, self.used = cap, used
+        counts[:self._n] = self.counts[:self._n]
+        self.cap, self.used, self.counts = cap, used, counts
 
     def set_node(self, node) -> None:
+        self.version += 1
         r = self.row.get(node.id)
+        cap_row = np.asarray(node_capacity_tuple(node), np.float32)
         if r is None:
             r = self._n
             self._ensure_capacity(r + 1)
             self.row[node.id] = r
             self.node_ids.append(node.id)
             self._n += 1
-        self.cap[r] = node_capacity_tuple(node)
+            self.epoch += 1             # node-set fingerprint changed
+        elif not np.array_equal(self.cap[r], cap_row):
+            self.epoch += 1             # capacity row changed in place
+        self.cap[r] = cap_row
 
     def drop_node(self, node_id: str) -> None:
         """Zero the row but keep the slot: rows are append-only so snapshot
         row maps stay valid; dead slots are rare (node GC) and harmless."""
         r = self.row.pop(node_id, None)
         if r is not None:
+            self.version += 1
+            self.epoch += 1             # node-set fingerprint changed
             self._flush()
             self.cap[r] = 0.0
             self.used[r] = 0.0
+            self.counts[r] = 0
             # orphan the row's alloc contributions so later transitions
             # don't subtract from a zeroed row
             self._contrib = {aid: c for aid, c in self._contrib.items()
@@ -176,7 +241,10 @@ class UsageIndex:
             self.seq_rows.pop(r, None)
 
     def _retire(self, old: tuple) -> None:
-        self._pending.append((old[0], tuple(-x for x in old[1])))
+        delta = tuple(-x for x in old[1])
+        self._pending.append((old[0], delta))
+        self.delta_log.append((self.version, old[0], delta, -1))
+        self.counts[old[0]] -= 1
         if old[2]:
             left = self.seq_rows.get(old[0], 1) - 1
             if left <= 0:
@@ -185,6 +253,7 @@ class UsageIndex:
                 self.seq_rows[old[0]] = left
 
     def set_alloc(self, alloc) -> None:
+        self.version += 1
         old = self._contrib.pop(alloc.id, None)
         if old is not None:
             self._retire(old)
@@ -196,6 +265,8 @@ class UsageIndex:
         u = alloc_usage_tuple(alloc)
         seq = resources_sequential(alloc.allocated_resources)
         self._pending.append((r, u))
+        self.delta_log.append((self.version, r, u, 1))
+        self.counts[r] += 1
         self._contrib[alloc.id] = (r, u, seq)
         if seq:
             self.seq_rows[r] = self.seq_rows.get(r, 0) + 1
@@ -207,8 +278,12 @@ class UsageIndex:
         of resources objects, so u/seq resolve through their on-object
         caches; the loop body is just dict stores (VERDICT r4 #5 —
         this was the largest host phase)."""
+        self.version += 1
+        version = self.version
         row = self.row
         pend = self._pending
+        log = self.delta_log
+        counts = self.counts
         contrib = self._contrib
         seq_rows = self.seq_rows
         for alloc in allocs:
@@ -223,6 +298,8 @@ class UsageIndex:
             if r is None:
                 continue            # alloc on an unknown/removed node
             pend.append((r, u))
+            log.append((version, r, u, 1))
+            counts[r] += 1
             contrib[alloc.id] = (r, u, seq)
             if seq:
                 seq_rows[r] = seq_rows.get(r, 0) + 1
@@ -230,30 +307,51 @@ class UsageIndex:
     def drop_alloc(self, alloc_id: str) -> None:
         old = self._contrib.pop(alloc_id, None)
         if old is not None:
+            self.version += 1
             self._retire(old)
 
     # ------------------------------------------------------------- readers
 
     def view(self) -> "UsageView":
-        """Point-in-time copy for snapshots/forks (two small array copies)."""
+        """Point-in-time copy for snapshots/forks, memoized by
+        (version, epoch): stores that only saw non-usage writes since the
+        last snapshot share one immutable-by-convention view instead of
+        re-copying the matrices per snapshot."""
         self._flush()
-        return UsageView(dict(self.row), self.cap[:self._n].copy(),
-                         self.used[:self._n].copy(), dict(self.seq_rows))
+        vc = self._view_cache
+        if vc is not None and vc[0] == (self.version, self.epoch):
+            return vc[1]
+        v = UsageView(dict(self.row), self.cap[:self._n].copy(),
+                      self.used[:self._n].copy(), dict(self.seq_rows),
+                      counts=self.counts[:self._n].copy(),
+                      uid=self.uid, epoch=self.epoch, version=self.version,
+                      delta_log=self.delta_log)
+        self._view_cache = ((self.version, self.epoch), v)
+        return v
 
     def copy(self) -> "UsageIndex":
+        """Fork copy (Job.Plan dry-runs). uid=0 marks the fork
+        NON-AUTHORITATIVE: its views bypass the tensor cache entirely
+        (state_cache treats uid 0 like an unversioned test fake), so a
+        dry-run scheduler pass can never evict the live leader stream's
+        device-resident state with its own divergent mutations."""
         self._flush()
         out = UsageIndex()
+        out.uid = 0
         out.row = dict(self.row)
         out.node_ids = list(self.node_ids)
         out.cap = self.cap.copy()
         out.used = self.used.copy()
+        out.counts = self.counts.copy()
         out._n = self._n
         out._contrib = dict(self._contrib)
         out.seq_rows = dict(self.seq_rows)
         return out
 
     def rebuild(self, nodes, allocs) -> None:
-        """Full recompute (snapshot restore path)."""
+        """Full recompute (snapshot restore path). __init__ mints a new
+        uid, so tensor-cache consumers keyed on the old uid miss and
+        reseed — a restore is a new delta stream by definition."""
         self.__init__()
         for node in nodes:
             self.set_node(node)
@@ -266,13 +364,24 @@ class UsageIndex:
 
 
 class UsageView:
-    """Read-only point-in-time matrices handed to snapshots."""
+    """Read-only point-in-time matrices handed to snapshots. The
+    (uid, epoch, version, delta_log) stamp keys the solver's tensor cache
+    (state_cache.py); plain test fakes construct views without it (uid=0
+    means "no versioning — cache stays out of the way")."""
 
-    __slots__ = ("row", "cap", "used", "seq_rows")
+    __slots__ = ("row", "cap", "used", "seq_rows", "counts",
+                 "uid", "epoch", "version", "delta_log")
 
     def __init__(self, row: dict[str, int], cap: np.ndarray,
-                 used: np.ndarray, seq_rows: Optional[dict[int, int]] = None):
+                 used: np.ndarray, seq_rows: Optional[dict[int, int]] = None,
+                 counts: Optional[np.ndarray] = None, uid: int = 0,
+                 epoch: int = 0, version: int = 0, delta_log=None):
         self.row = row
         self.cap = cap
         self.used = used
         self.seq_rows = seq_rows or {}
+        self.counts = counts
+        self.uid = uid
+        self.epoch = epoch
+        self.version = version
+        self.delta_log = delta_log

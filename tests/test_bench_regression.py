@@ -72,6 +72,73 @@ def test_stream_metrics_do_not_regress_vs_recorded_best():
             f"(stream_concurrency={lineage})")
 
 
+def _platform(parsed: dict) -> str:
+    m = re.search(r"\((\w+)\)$", parsed.get("metric", ""))
+    return m.group(1) if m else ""
+
+
+def test_state_cache_stays_delta_driven():
+    """ISSUE 4 lineage: once a bench records tensor-cache metrics, a
+    regression back to rebuild-per-eval (hit rate < 0.9 in the steady
+    stream phase) fails loudly. Older BENCH_*.json rounds predate the
+    cache and are skipped."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    rate = latest.get("tensor_cache_hit_rate")
+    if rate is None:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the state cache")
+    assert rate >= 0.9, (
+        f"BENCH_r{latest_round:02d}: tensor_cache_hit_rate {rate} < 0.9 — "
+        f"the steady stream regressed to per-eval tensor rebuilds")
+    counters = latest.get("state_cache", {})
+    assert counters.get("hits", 0) > 0, \
+        f"BENCH_r{latest_round:02d}: state cache never hit"
+
+
+def test_stream_rides_batch_tier_on_accelerator():
+    """ISSUE 4 satellite: on a real TPU at stream concurrency >= 4 the
+    eval stream must show batch-tier dispatches in backend_tiers_stream —
+    host-only streaming (BENCH_r05: host=16) is the regression this PR
+    fixed. Only enforced for rounds that record the new-methodology
+    marker (tensor_cache_hit_rate), so the r05 history stays green."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    if "tensor_cache_hit_rate" not in latest:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates this gate")
+    if _platform(latest) != "tpu":
+        pytest.skip("stream tier routing is only asserted on tpu")
+    if latest.get("stream_concurrency", 1) < 4:
+        pytest.skip("no coalescing expected below concurrency 4")
+    tiers = latest.get("backend_tiers_stream", {})
+    assert tiers.get("nomad.solver.backend.batch", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: stream never rode the batch tier "
+        f"(host-tier pinning regression): {tiers}")
+
+
+def test_warm_restart_compile_does_not_regress():
+    """The persistent-compile-cache lineage: compile_s_warm_restart must
+    not drift >10% above the best recorded warm restart (BENCH_r05:
+    2.48s). Rounds without a successful probe (-1) are skipped."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    warm = latest.get("compile_s_warm_restart", -1.0)
+    if warm is None or warm < 0:
+        pytest.skip(f"BENCH_r{latest_round:02d} has no warm-restart probe")
+    peers = [p.get("compile_s_warm_restart") for _, p in history]
+    best = min((w for w in peers if w is not None and w >= 0),
+               default=warm)
+    assert warm <= best * (1 + DRIFT), (
+        f"BENCH_r{latest_round:02d}: compile_s_warm_restart {warm}s "
+        f"drifted >{DRIFT:.0%} above the recorded best {best}s — the "
+        f"persistent compile cache stopped carrying warm restarts")
+
+
 def test_headline_rejection_parity_is_recorded():
     """The headline's second acceptance axis: the latest bench must have
     run at rejection parity with zero headline plan-node rejections —

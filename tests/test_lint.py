@@ -293,6 +293,66 @@ def test_det001_inline_suppression():
     assert rule_ids(src, "pkg/scheduler/s.py") == []
 
 
+# ------------------------------------------------------------------ DET002
+
+DET002_BAD = """
+    import numpy as np
+
+    def advance(snap, rows, deltas):
+        view = snap.usage
+        view.used[3] -= deltas[0]           # direct field mutation
+        u = view.used                       # whole-array alias
+        u[rows] += deltas                   # mutation through the alias
+        np.add.at(view.used, rows, deltas)  # ufunc in-place
+"""
+
+
+def test_det002_fires_on_cached_tensor_mutation():
+    out = findings(DET002_BAD, "pkg/solver/bad.py")
+    assert [f.rule for f in out] == ["DET002"] * 3
+
+
+def test_det002_fires_on_state_cache_alias():
+    src = """
+        from nomad_tpu.solver import state_cache
+
+        def poke(rows):
+            c = state_cache.cache()
+            c.used[rows] = 0.0
+    """
+    assert rule_ids(src, "pkg/server/bad.py") == ["DET002"]
+
+
+def test_det002_copies_and_owners_are_quiet():
+    # fancy-index copies are the sanctioned pattern (tensorize does
+    # exactly this), rebinding a local is not a mutation, and the cache/
+    # journal owners themselves are exempt
+    src = """
+        import numpy as np
+
+        def build(snap, rows, deltas):
+            view = snap.usage
+            used = view.used[rows]          # fancy index => copy
+            used[3] -= deltas[0]            # mutating the copy: fine
+            used = np.zeros(4)              # rebind: fine
+            return used
+    """
+    assert rule_ids(src, "pkg/solver/ok.py") == []
+    assert rule_ids(DET002_BAD, "pkg/state/usage_index.py") == []
+    assert rule_ids(DET002_BAD, "pkg/solver/state_cache.py") == []
+    # outside the guarded trees: out of scope
+    assert rule_ids(DET002_BAD, "pkg/client/ok.py") == []
+
+
+def test_det002_inline_suppression():
+    src = """
+        def zero(snap):
+            v = snap.usage
+            v.used[0] = 0.0  # nomadlint: disable=DET002 — test-only reset
+    """
+    assert rule_ids(src, "pkg/solver/s.py") == []
+
+
 # ------------------------------------------------------------------ EXC001
 
 EXC001_BAD = """
@@ -469,7 +529,8 @@ def test_cli_nonexistent_path_fails(tmp_path):
 
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
-    assert {"JIT001", "JIT002", "LOCK001", "DET001", "EXC001"} <= ids
+    assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
+            "EXC001"} <= ids
     assert all(r.short for r in all_rules())
 
 

@@ -326,3 +326,42 @@ def test_tier_remap_table_documented():
     assert "batch" in text and "host" in text
     # the load-bearing boundary claim: no pallas demotion keyed on the grid
     assert "no" in text.lower() and "depth_grid" in text
+
+
+# ------------------------------------------------------- AOT warmup grid
+
+def test_warmup_skips_small_clusters_by_default(monkeypatch):
+    monkeypatch.delenv("NOMAD_AOT_WARMUP", raising=False)
+    out = backend.warmup(8)
+    assert out["skipped"] is True and out["artifacts"] == 0
+
+
+def test_warmup_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("NOMAD_AOT_WARMUP", "0")
+    out = backend.warmup(100_000)
+    assert out["skipped"] is True
+
+
+def test_warmup_compiles_the_grid(monkeypatch):
+    """Forced warmup at a tiny bucket drives every (kernel, regime) cell
+    through the REAL select() chains — the same cached artifacts the
+    eval path dispatches — and reports what it compiled."""
+    monkeypatch.setenv("NOMAD_AOT_WARMUP", "1")
+    backend.reset()
+    metrics.reset()
+    out = backend.warmup(12, k_maxes=(8,), budget_s=120.0)
+    assert out["skipped"] is False
+    assert out["bucket"] == 16
+    # 2 depth regimes + greedy + chunked
+    assert out["artifacts"] == 4
+    assert metrics.counter("nomad.solver.warmup.errors") == 0
+    assert metrics.counter("nomad.solver.warmup.artifacts") == 4
+
+
+def test_warmup_budget_exhaustion_is_loud(monkeypatch):
+    monkeypatch.setenv("NOMAD_AOT_WARMUP", "1")
+    backend.reset()
+    metrics.reset()
+    out = backend.warmup(12, k_maxes=(8, 16), budget_s=0.0)
+    assert out["artifacts"] == 0
+    assert metrics.counter("nomad.solver.warmup.budget_exhausted") == 1

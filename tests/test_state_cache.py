@@ -1,0 +1,571 @@
+"""Device-resident tensor cache differential tests (ISSUE 4).
+
+The hard requirement: the incremental path (seed once, advance by
+journal replay) must be BIT-identical to a fresh full rebuild from the
+snapshot view at every index — placements included — or fall back. The
+randomized replay here drives plan applies, node add/drain/down, client
+failures, preemptions, failed commits (NOMAD_FAULTS on planner.apply /
+raft.apply) and snapshot restores through the real store, asserting
+byte-equality of the gathered tensors against the view oracle after
+every step, and alloc-for-alloc placement equality between cache-on and
+cache-off scheduler runs for both depth regimes.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.server.fsm import NomadFSM, PlanApplyRequest, RaftLog
+from nomad_tpu.server.plan_apply import Planner
+from nomad_tpu.solver import state_cache
+from nomad_tpu.solver.state_cache import cache
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
+    Allocation, Evaluation, Plan, SchedulerConfiguration, SCHED_ALG_TPU,
+    new_id,
+)
+
+from test_solver import Harness
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    state_cache.reset()
+    faults.clear()
+    yield
+    state_cache.reset()
+    faults.clear()
+
+
+# ------------------------------------------------------------------ helpers
+
+def _mk_alloc(node_id: str, job_id: str = "j1", cpu: int = 100,
+              mem: int = 128, tg: str = "web") -> Allocation:
+    return Allocation(
+        id=new_id(), namespace="default", eval_id=new_id(), name=f"{job_id}.{tg}[0]",
+        job_id=job_id, task_group=tg, node_id=node_id, node_name=node_id,
+        desired_status="run", client_status="pending",
+        allocated_resources=AllocatedResources(
+            shared=AllocatedSharedResources(disk_mb=150),
+            tasks={"t": AllocatedTaskResources(cpu_shares=cpu,
+                                               memory_mb=mem)}))
+
+
+def _assert_parity(store, rng=None, msg=""):
+    """Gathered cache tensors must be byte-equal to the view oracle."""
+    snap = store.snapshot()
+    view = snap.usage
+    n = view.cap.shape[0]
+    rows = (np.arange(n, dtype=np.int64) if rng is None
+            else rng.permutation(n).astype(np.int64))
+    got = state_cache.gather(view, rows)
+    assert got is not None, msg
+    assert got.cap.tobytes() == view.cap[rows].tobytes(), \
+        f"cap diverged {msg}"
+    assert got.used.tobytes() == view.used[rows].tobytes(), \
+        f"used diverged {msg}"
+    # versioning: after a successful gather the cache may not be ahead of
+    # the store, and counts must equal the store's incremental vector
+    assert cache().version <= view.version, msg
+    assert np.array_equal(cache().counts[: n], view.counts), \
+        f"alloc-count vector diverged {msg}"
+    return view
+
+
+def _seed_store(n_nodes: int, seed: int = 7):
+    store = StateStore()
+    store.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        store.upsert_node(idx, n)
+        nodes.append(n)
+        idx += 1
+    return store, nodes, idx
+
+
+# ------------------------------------------------ randomized replay parity
+
+def test_randomized_plan_stream_is_bit_identical():
+    """Apply a randomized stream of plan commits, stops, preemptions,
+    node add/drain/down and client-side failures; after every step the
+    incremental tensors must match a fresh rebuild byte-for-byte."""
+    rng = np.random.default_rng(20260803)
+    store, nodes, idx = _seed_store(24)
+    next_node = len(nodes)                  # ids stay unique across adds
+    live: list[Allocation] = []
+    _assert_parity(store, rng, "after seed")
+    for step in range(120):
+        op = rng.integers(0, 10)
+        if op <= 4 or not live:             # plan apply: fresh placements
+            placements = [
+                _mk_alloc(nodes[int(rng.integers(0, len(nodes)))].id,
+                          job_id=f"job-{int(rng.integers(0, 5))}",
+                          cpu=int(rng.choice([50, 100, 250])),
+                          mem=int(rng.choice([64, 128, 256])))
+                for _ in range(int(rng.integers(1, 6)))]
+            stops = []
+            if live and rng.random() < 0.4:  # mixed stop in the same plan
+                victim = live.pop(int(rng.integers(0, len(live))))
+                stopped = victim.copy()
+                stopped.desired_status = "stop"
+                stopped.client_status = "complete"
+                stops.append(stopped)
+            preempted = []
+            if live and rng.random() < 0.2:
+                victim = live.pop(int(rng.integers(0, len(live))))
+                pre = victim.copy()
+                pre.desired_status = "evict"
+                pre.client_status = "complete"
+                preempted.append(pre)
+            store.upsert_plan_results(idx, PlanApplyRequest(
+                alloc_updates=stops, alloc_placements=placements,
+                alloc_preemptions=preempted))
+            live.extend(placements)
+        elif op == 5:                        # client-side failure
+            victim = live.pop(int(rng.integers(0, len(live))))
+            failed = victim.copy()
+            failed.client_status = "failed"
+            store.update_allocs_from_client(idx, [failed])
+        elif op == 6:                        # node add (epoch bump)
+            n = mock.node()
+            n.id = f"node-{next_node:04d}"
+            next_node += 1
+            store.upsert_node(idx, n)
+            nodes.append(n)
+        elif op == 7:                        # drain flip
+            from nomad_tpu.structs import DrainStrategy
+            store.update_node_drain(
+                idx, nodes[int(rng.integers(0, len(nodes)))].id,
+                DrainStrategy(deadline_sec=60) if rng.random() < 0.5
+                else None, True)
+        elif op == 8:                        # node down/up
+            node = nodes[int(rng.integers(0, len(nodes)))]
+            store.update_node_status(
+                idx, node.id,
+                "down" if rng.random() < 0.5 else "ready", 0.0)
+        else:                                # node deregister (epoch bump)
+            if len(nodes) > 8:
+                node = nodes.pop(int(rng.integers(0, len(nodes))))
+                store.delete_node(idx, [node.id])
+                live = [a for a in live if a.node_id != node.id]
+        idx += 1
+        _assert_parity(store, rng, f"after step {step}")
+    stats = cache().stats()
+    assert stats["version"] > 0 and stats["rows"] >= 24
+
+
+def test_stale_snapshot_served_from_ring_generation():
+    """A snapshot older than the cache head (the concurrent-worker case)
+    is served from a displaced generation — still byte-exact."""
+    store, nodes, idx = _seed_store(12)
+    _assert_parity(store)                   # seed the cache
+    old_view = store.snapshot().usage
+    rows = np.arange(old_view.cap.shape[0], dtype=np.int64)
+    old_cap = old_view.cap[rows].tobytes()
+    old_used = old_view.used[rows].tobytes()
+    # advance the store + cache past the old snapshot
+    store.upsert_plan_results(idx, PlanApplyRequest(
+        alloc_placements=[_mk_alloc(nodes[0].id), _mk_alloc(nodes[3].id)]))
+    _assert_parity(store)
+    got = state_cache.gather(old_view, rows)
+    assert got.cap.tobytes() == old_cap
+    assert got.used.tobytes() == old_used
+
+
+def test_journal_trim_gap_falls_back_to_rebuild(monkeypatch):
+    """Evicting journal entries past the cache's cursor must produce a
+    clean reseed (miss), never a silent divergence."""
+    from nomad_tpu.state.usage_index import DeltaLog
+    monkeypatch.setattr(DeltaLog, "MAX", 8)
+    monkeypatch.setattr(DeltaLog, "KEEP", 4)
+    rng = np.random.default_rng(5)
+    store, nodes, idx = _seed_store(10)
+    _assert_parity(store, rng)
+    from nomad_tpu.metrics import metrics
+    before = metrics.counter("nomad.solver.state_cache.reseeds")
+    # burst enough deltas to trim far past the cache cursor
+    for _ in range(6):
+        store.upsert_plan_results(idx, PlanApplyRequest(
+            alloc_placements=[_mk_alloc(nodes[i].id) for i in range(5)]))
+        idx += 1
+    _assert_parity(store, rng, "after trim burst")
+    assert metrics.counter("nomad.solver.state_cache.reseeds") > before
+
+
+def test_node_capacity_change_bumps_epoch_and_reseeds():
+    store, nodes, idx = _seed_store(10)
+    view0 = _assert_parity(store)
+    grown = nodes[2].copy()
+    grown.node_resources.cpu.cpu_shares *= 2
+    store.upsert_node(idx, grown)
+    view1 = _assert_parity(store, msg="after capacity change")
+    assert view1.epoch > view0.epoch
+
+
+def test_restore_mints_new_stream_and_reseeds():
+    fsm = NomadFSM()
+    store = fsm.state
+    store.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    for i in range(8):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        store.upsert_node(idx, n)
+        idx += 1
+    store.upsert_plan_results(idx, PlanApplyRequest(
+        alloc_placements=[_mk_alloc("node-0001"), _mk_alloc("node-0004")]))
+    _assert_parity(store)
+    uid_before = store.usage.uid
+    blob = fsm.snapshot_bytes()
+    fsm2 = NomadFSM()
+    fsm2.restore_bytes(blob)
+    assert fsm2.state.usage.uid != uid_before
+    _assert_parity(fsm2.state, msg="after restore")
+
+
+def test_disabled_cache_returns_none(monkeypatch):
+    monkeypatch.setenv("NOMAD_STATE_CACHE", "0")
+    store, _, _ = _seed_store(8)
+    view = store.snapshot().usage
+    assert state_cache.gather(view, np.arange(8, dtype=np.int64)) is None
+
+
+def test_unversioned_views_bypass_the_cache():
+    """Plain test fakes build UsageViews without a versioning stamp —
+    the cache must stay out of the way (uid=0 → None)."""
+    from nomad_tpu.state.usage_index import UsageView
+    v = UsageView({}, np.zeros((4, 5), np.float32),
+                  np.zeros((4, 5), np.float32))
+    assert state_cache.gather(v, np.arange(4, dtype=np.int64)) is None
+
+
+# ------------------------------------------------- placement differential
+
+def _run_placements(count: int, eval_id: str, n_nodes: int = 16):
+    """One fixed-seed scheduler run; returns frozenset of
+    (alloc name, node) assignments (the bit-identity witness)."""
+    random.seed(1234)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        n.name = f"sc-{i}"
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    job.id = job.name = f"sc-job-{count}"
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.networks = []
+    t = tg.tasks[0]
+    t.resources.networks = []
+    t.resources.cpu = 250
+    t.resources.memory_mb = 128
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(id=eval_id, job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == count
+    return frozenset((a.name, a.node_id, i)
+                     for i, a in enumerate(sorted(
+                         allocs, key=lambda a: (a.node_id, a.name, a.id))))
+
+
+@pytest.mark.parametrize("count", [6, 48])
+def test_placements_bit_identical_cache_on_vs_off(monkeypatch, count):
+    """The acceptance differential: cache-served evals place EXACTLY what
+    full-rebuild evals place, for the jittered sampled-grid regime
+    (count=6 on 16 nodes) and the deterministic full-curve regime
+    (count=48, m > 3)."""
+    state_cache.reset()
+    with_cache = _run_placements(count, f"sc-eval-{count}")
+    assert cache().stats()["rows"] > 0, "cache never engaged"
+    state_cache.reset()
+    monkeypatch.setenv("NOMAD_STATE_CACHE", "0")
+    without = _run_placements(count, f"sc-eval-{count}")
+    assert with_cache == without
+
+
+def test_second_eval_hits_without_rebuild():
+    from nomad_tpu.metrics import metrics
+    state_cache.reset()
+    random.seed(99)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for i in range(12):
+        n = mock.node()
+        h.state.upsert_node(h.get_next_index(), n)
+    for j in range(3):
+        job = mock.batch_job()
+        job.id = job.name = f"hit-job-{j}"
+        tg = job.task_groups[0]
+        tg.count = 4
+        tg.networks = []
+        tg.tasks[0].resources.networks = []
+        h.state.upsert_job(h.get_next_index(), job)
+        before = metrics.counter("nomad.solver.state_cache.misses")
+        ev = Evaluation(job_id=job.id, type=job.type)
+        h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+        after = metrics.counter("nomad.solver.state_cache.misses")
+        if j > 0:
+            assert after == before, "steady-state eval re-seeded the cache"
+
+
+# ------------------------------------------------------------------ chaos
+
+@pytest.mark.chaos
+def test_failed_apply_never_moves_the_cache():
+    """NOMAD_FAULTS on planner.apply: a failed plan apply commits nothing,
+    so the cache must neither advance nor diverge — and the next
+    successful commit must replay cleanly."""
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    node_ids = []
+    for i in range(10):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        s.upsert_node(idx, n)
+        node_ids.append(n.id)
+        idx += 1
+    planner = Planner(RaftLog(fsm), s)
+    _assert_parity(s, msg="pre-chaos")
+    v_before = cache().version
+
+    faults.install({"planner.apply": {"mode": "nth_call", "n": 1,
+                                      "times": 1}})
+    plan = Plan(eval_id=new_id(), priority=50,
+                snapshot_index=s.latest_index())
+    plan.node_allocation = {node_ids[0]: [_mk_alloc(node_ids[0])]}
+    with pytest.raises(faults.FaultError):
+        planner.apply_plan(plan)
+    assert not s.allocs, "failed apply leaked allocations"
+    _assert_parity(s, msg="after failed apply")
+    assert cache().version == v_before, \
+        "failed apply moved the cache version"
+
+    # the same plan succeeds on retry; note_commit advances the cache on
+    # the applier path and parity must hold at the new version
+    result = planner.apply_plan(plan)
+    assert result.alloc_index > 0 and len(s.allocs) == 1
+    view = _assert_parity(s, msg="after recovery commit")
+    assert cache().version == view.version
+
+
+@pytest.mark.chaos
+def test_failed_raft_commit_never_moves_the_cache():
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    n = mock.node()
+    n.id = "node-0000"
+    s.upsert_node(2, n)
+    planner = Planner(RaftLog(fsm), s)
+    _assert_parity(s, msg="pre-chaos")
+    faults.install({"raft.apply": {"mode": "raise", "times": 1}})
+    plan = Plan(eval_id=new_id(), priority=50,
+                snapshot_index=s.latest_index())
+    plan.node_allocation = {"node-0000": [_mk_alloc("node-0000")]}
+    with pytest.raises(faults.FaultError):
+        planner.apply_plan(plan)
+    assert not s.allocs
+    _assert_parity(s, msg="after failed raft commit")
+
+
+class _PlannerShim:
+    """Worker-planner glue over the real serial applier (inline apply:
+    single-threaded, deterministic)."""
+
+    def __init__(self, planner, state):
+        self.planner = planner
+        self.state = state
+
+    def submit_plan(self, plan):
+        return self.planner.apply_plan(plan)
+
+    def update_eval(self, ev):
+        self.state.upsert_evals(self.state.latest_index() + 1, [ev])
+
+    def create_eval(self, ev):
+        self.state.upsert_evals(self.state.latest_index() + 1, [ev])
+
+    def refresh_snapshot(self, old):
+        return self.state.snapshot()
+
+
+def _eval_stream_with_faults(count: int, fault_spec):
+    """Three fixed-seed evals through the REAL Planner.apply_plan with an
+    injected fault plan; returns (per-eval outcomes, committed placement
+    set) — the full differential witness."""
+    random.seed(777)
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    for i in range(12):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        s.upsert_node(idx, n)
+        idx += 1
+    planner = Planner(RaftLog(fsm), s)
+    faults.clear()
+    if fault_spec:
+        faults.install(fault_spec)
+    outcomes = []
+    for j in range(3):
+        job = mock.batch_job()
+        job.id = job.name = f"cj-{j}"
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.networks = []
+        tg.tasks[0].resources.networks = []
+        s.upsert_job(s.latest_index() + 1, job)
+        ev = Evaluation(id=f"chaos-ev-{j}", namespace="default",
+                        job_id=job.id, type="batch", priority=50)
+        s.upsert_evals(s.latest_index() + 1, [ev])
+        shim = _PlannerShim(planner, s)
+        sched = new_scheduler("batch", s.snapshot(), shim)
+        try:
+            sched.process(ev)
+            outcomes.append("ok")
+        except BaseException as e:      # noqa: BLE001 — outcome witness
+            outcomes.append(type(e).__name__)
+    faults.clear()
+    placed = sorted((a.job_id, a.name, a.node_id, a.desired_status)
+                    for a in s.iter_allocs())
+    return outcomes, placed
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("count", [4, 40])
+def test_placements_identical_under_apply_faults(monkeypatch, count):
+    """Acceptance: incremental-cache placements stay bit-identical to
+    full-rebuild placements under injected planner.apply faults, both
+    depth regimes. nth_call is deterministic, so cache-on and cache-off
+    runs see the SAME fault pattern — any divergence is the cache's."""
+    spec = {"planner.apply": {"mode": "nth_call", "n": 2, "times": 1}}
+    state_cache.reset()
+    monkeypatch.delenv("NOMAD_STATE_CACHE", raising=False)
+    with_cache = _eval_stream_with_faults(count, dict(spec))
+    state_cache.reset()
+    monkeypatch.setenv("NOMAD_STATE_CACHE", "0")
+    without = _eval_stream_with_faults(count, dict(spec))
+    assert with_cache[0] == without[0], "fault outcomes diverged"
+    assert with_cache[1] == without[1], "placements diverged under faults"
+    assert "FaultError" in with_cache[0], "the fault never fired"
+
+
+# ----------------------------------------------- accounting & feed races
+
+def test_reseed_counts_one_miss_not_a_phantom_hit():
+    """A miss must not also count a hit (the rate would read 0.5 on an
+    all-reseed workload instead of 0.0)."""
+    from nomad_tpu.metrics import metrics
+    store, _, _ = _seed_store(8)
+    h0 = metrics.counter("nomad.solver.state_cache.hits")
+    m0 = metrics.counter("nomad.solver.state_cache.misses")
+    _assert_parity(store, msg="seed gather")      # first gather: reseed
+    assert metrics.counter("nomad.solver.state_cache.misses") == m0 + 1
+    assert metrics.counter("nomad.solver.state_cache.hits") == h0
+    _assert_parity(store, msg="second gather")    # now a real hit
+    assert metrics.counter("nomad.solver.state_cache.hits") == h0 + 1
+
+
+def test_older_epoch_snapshot_never_rolls_the_cache_back():
+    """During node churn a worker holding a pre-churn snapshot must be
+    served from its own view, not by reseeding the shared cache
+    backward (which would ping-pong full rebuilds between workers)."""
+    store, nodes, idx = _seed_store(10)
+    old_view = store.snapshot().usage
+    n = mock.node()
+    n.id = "node-9999"
+    store.upsert_node(idx, n)                     # epoch bump
+    new_view = _assert_parity(store, msg="post-churn")   # cache at new epoch
+    epoch_after = cache().stats()["epoch"]
+    rows = np.arange(old_view.cap.shape[0], dtype=np.int64)
+    got = state_cache.gather(old_view, rows)
+    assert got.cap.tobytes() == old_view.cap[rows].tobytes()
+    assert got.used.tobytes() == old_view.used[rows].tobytes()
+    assert cache().stats()["epoch"] == epoch_after, \
+        "stale-epoch gather rolled the shared cache backward"
+    assert new_view.epoch > old_view.epoch
+
+
+def test_note_commit_row_race_is_refused_not_corrupting(monkeypatch):
+    """note_commit reads epoch/version without the store lock; if the
+    journal holds entries for rows past the cache arrays (node register
+    raced in), the advance must refuse — never IndexError, never apply a
+    partial batch — and apply_plan must still report the commit."""
+    store, nodes, idx = _seed_store(8)
+    _assert_parity(store)
+    # simulate the race: a new node + an alloc on it land in the journal
+    # while the cache still has 8 rows and its OLD epoch recorded
+    n = mock.node()
+    n.id = "node-0099"
+    store.upsert_node(idx, n)
+    store.upsert_plan_results(idx + 1, PlanApplyRequest(
+        alloc_placements=[_mk_alloc("node-0099")]))
+    c = cache()
+    c._epoch = store.usage.epoch        # force the raced epoch check past
+    state_cache.note_commit(store)      # must not raise
+    c._epoch = -1                       # drop the forced state
+    _assert_parity(store, msg="after raced note_commit")
+
+
+@pytest.mark.chaos
+def test_device_twin_dispatch_demotes_to_host_floor():
+    """A cache-served (device-twin) dispatch whose primary tier faults
+    must demote to the HOST floor on uncommitted numpy (the chain's
+    host_args) and still place everything — bit-identically to an
+    unfaulted full-rebuild run. This is the degradation-ladder guarantee
+    the resident buffers must not defeat."""
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.solver import backend
+    state_cache.reset()
+    backend.reset()
+    faults.install({"solver.dispatch.xla": {"mode": "raise"}})
+    demoted_before = metrics.counter("nomad.solver.tier_demotions.xla")
+    faulted = _run_placements(48, "sc-eval-48")
+    faults.clear()
+    assert metrics.counter("nomad.solver.tier_demotions.xla") > \
+        demoted_before, "the xla fault never forced a demotion"
+    state_cache.reset()
+    backend.reset()
+    unfaulted = _run_placements(48, "sc-eval-48")
+    assert faulted == unfaulted, \
+        "host-floor recovery diverged from the healthy path"
+
+
+def test_fork_views_never_touch_the_shared_cache():
+    """Job.Plan dry-runs schedule against StateStore.fork(); the fork's
+    views must bypass the cache (uid=0), not evict the live stream's
+    resident state with divergent dry-run mutations."""
+    store, nodes, idx = _seed_store(10)
+    _assert_parity(store)                        # live stream seeded
+    stats_before = cache().stats()
+    fork = store.fork()
+    fork.upsert_plan_results(idx, PlanApplyRequest(
+        alloc_placements=[_mk_alloc(nodes[0].id)]))
+    fview = fork.snapshot().usage
+    assert fview.uid == 0
+    rows = np.arange(fview.cap.shape[0], dtype=np.int64)
+    assert state_cache.gather(fview, rows) is None
+    assert cache().stats() == stats_before, \
+        "a dry-run fork reseeded the shared cache"
+    _assert_parity(store, msg="live stream after fork activity")
