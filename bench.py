@@ -537,12 +537,66 @@ def main() -> None:
     # window the batch-size percentile to the timed stream, like the
     # counters above — the warm pass's small batches must not bias it
     mb_skip = metrics.sample_count("nomad.solver.microbatch.size")
+    # per-phase STREAM percentiles (ISSUE 5 satellite): checkpoint the
+    # sample windows so phase_*_p50/p95 cover the timed stream only, not
+    # the headline pass — plus the commit-coalescing evidence gauges
+    STREAM_PHASES = {
+        "reconcile": "nomad.scheduler.reconcile",
+        "solve": "nomad.solver.solve",
+        "materialize": "nomad.solver.materialize",
+        "plan_evaluate": "nomad.plan.evaluate",
+        "fsm_commit": "nomad.plan.apply",
+    }
+    phase_skips = {name: metrics.sample_count(metric)
+                   for name, metric in STREAM_PHASES.items()}
+    cb_skip = metrics.sample_count("nomad.plan.commit_batch_size")
+    qd_skip = metrics.sample_count("nomad.plan.queue_depth")
+    qr_skip = metrics.sample_count("nomad.plan.queue_residual")
     t_stream0 = time.perf_counter()
     submit_times = _stream_run(fsm_s, STREAM_EVALS, STREAM_CONCURRENCY)
     stream_s = time.perf_counter() - t_stream0
     submit_times.sort()
     p50_submit = submit_times[len(submit_times) // 2]
     stream_tiers = _tier_counters(stream_base)
+    stream_phase_pcts = {}
+    for name, metric in STREAM_PHASES.items():
+        stream_phase_pcts[f"phase_{name}_p50"] = round(
+            metrics.percentile(metric, 0.5, skip=phase_skips[name]), 5)
+        stream_phase_pcts[f"phase_{name}_p95"] = round(
+            metrics.percentile(metric, 0.95, skip=phase_skips[name]), 5)
+    # commit_batch_size_p50 is PLAN-weighted: the batch width the median
+    # committed PLAN rode (a 15-wide entry carries 15 plans' worth of
+    # weight) — the per-drain median would let a few straggler singles
+    # mask that nearly every plan coalesced
+    cb_sample = metrics.samples.get("nomad.plan.commit_batch_size")
+    cb_vals = sorted(cb_sample.values[cb_skip:]) if cb_sample else []
+    commit_batch_size_p50 = 0.0
+    if cb_vals:
+        half = sum(cb_vals) / 2.0
+        acc = 0.0
+        for v in cb_vals:
+            acc += v
+            if acc >= half:
+                commit_batch_size_p50 = v
+                break
+    commit_batch_size_p50_commits = metrics.percentile(
+        "nomad.plan.commit_batch_size", 0.5, skip=cb_skip)
+    plan_queue_depth_p50 = metrics.percentile(
+        "nomad.plan.queue_depth", 0.5, skip=qd_skip)
+    plan_queue_residual_p50 = metrics.percentile(
+        "nomad.plan.queue_residual", 0.5, skip=qr_skip)
+
+    def _pc(name: str) -> int:
+        key = f"nomad.plan.{name}"
+        return int(metrics.counter(key) - stream_base.get(key, 0))
+    plan_coalesce = {
+        "commits": _pc("coalesced_commits"),
+        "plans": _pc("coalesced_plans"),
+        "commit_timeouts": _pc("commit_timeout"),
+        "snapshot_shared": int(
+            metrics.counter("nomad.state.snapshot_shared")
+            - stream_base.get("nomad.state.snapshot_shared", 0)),
+    }
     stream_batch_size_p50 = metrics.percentile(
         "nomad.solver.microbatch.size", 0.5, skip=mb_skip)
     stream_microbatch = {
@@ -630,6 +684,16 @@ def main() -> None:
         "stream_concurrency": STREAM_CONCURRENCY,
         "stream_batch_size_p50": round(stream_batch_size_p50, 1),
         "stream_microbatch": stream_microbatch,
+        # ISSUE 5: commit-coalescing + per-phase stream evidence. The
+        # phase percentiles are over the TIMED stream window only (the
+        # headline-pass sums stay in phase_*_s below).
+        **stream_phase_pcts,
+        "commit_batch_size_p50": round(commit_batch_size_p50, 1),
+        "commit_batch_size_p50_commits": round(
+            commit_batch_size_p50_commits, 1),
+        "plan_queue_depth_p50": round(plan_queue_depth_p50, 1),
+        "plan_queue_residual_p50": round(plan_queue_residual_p50, 1),
+        "plan_coalesce": plan_coalesce,
         "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
         "state_cache": state_cache_counters,
         **phases,

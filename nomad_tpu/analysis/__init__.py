@@ -10,7 +10,9 @@ from .core import (                                    # noqa: F401
     Baseline, Finding, Rule, all_rules, analyze_paths, analyze_source,
     register,
 )
-from . import rules_det, rules_exc, rules_jit, rules_lock  # noqa: F401
+from . import (                                            # noqa: F401
+    rules_det, rules_exc, rules_jit, rules_lock, rules_perf,
+)
 
 __all__ = ["Baseline", "Finding", "Rule", "all_rules", "analyze_paths",
            "analyze_source", "register"]

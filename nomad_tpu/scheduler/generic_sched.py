@@ -14,12 +14,12 @@ import time
 from typing import Optional
 
 from ..structs import (
-    AllocatedResources, AllocatedSharedResources, Allocation,
+    AllocatedResources, Allocation,
     AllocDeploymentStatus, Evaluation, Job, Plan, PlanAnnotations,
     DesiredUpdates, DESC_CANARY, DESC_NODE_TAINTED,
     EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, JOB_TYPE_BATCH,
     JOB_TYPE_SERVICE, TRIGGER_MAX_PLANS, TRIGGER_PREEMPTION,
-    TRIGGER_RETRY_FAILED_ALLOC, new_id, SCHED_ALG_TPU,
+    TRIGGER_RETRY_FAILED_ALLOC, new_id, SCHED_ALG_TPU, skeleton_for,
 )
 from ..metrics import metrics
 from .context import EvalContext
@@ -70,6 +70,10 @@ class GenericScheduler:
         # the pass must refresh state and retry, the same contract as a
         # partial commit of a serial plan
         self._pipeline_partial = False
+        # per-scheduler ResourceSkeleton pool (structs/respool.py): the
+        # host placement path shares each TG's immutable disk-only row
+        # instead of minting one per allocation
+        self._skel: dict = {}
 
     # ------------------------------------------------------------- process
 
@@ -329,10 +333,13 @@ class GenericScheduler:
             self.ctx.metrics.nodes_available = dict(self._nodes_by_dc)
             if option is not None:
                 self._handle_preemptions(option)
+                # per-alloc wrapper kept (the ranked task_resources vary
+                # per option) — accepted PERF001 remnant, see
+                # .nomadlint-baseline.json; the shared row is pooled
                 resources = AllocatedResources(
                     tasks=dict(option.task_resources),
-                    shared=option.alloc_resources or AllocatedSharedResources(
-                        disk_mb=tg.ephemeral_disk.size_mb))
+                    shared=option.alloc_resources or
+                    skeleton_for(self._skel, tg, False).shared_total.shared)
                 alloc = Allocation(
                     id=new_id(),
                     namespace=self.eval.namespace,

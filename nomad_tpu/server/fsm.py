@@ -37,6 +37,10 @@ EVAL_DELETE = "EvalDeleteRequestType"
 ALLOC_CLIENT_UPDATE = "AllocClientUpdateRequestType"
 ALLOC_UPDATE_DESIRED_TRANSITION = "AllocUpdateDesiredTransitionRequestType"
 APPLY_PLAN_RESULTS = "ApplyPlanResultsRequestType"
+# a coalesced commit batch: N verified plan results in ONE log entry (one
+# encode, one replication round, one FSM apply) — applied strictly in list
+# order so replay equals the serial one-entry-per-plan sequence
+APPLY_PLAN_RESULTS_BATCH = "ApplyPlanResultsBatchRequestType"
 DEPLOYMENT_STATUS_UPDATE = "DeploymentStatusUpdateRequestType"
 DEPLOYMENT_PROMOTE = "DeploymentPromoteRequestType"
 DEPLOYMENT_ALLOC_HEALTH = "DeploymentAllocHealthRequestType"
@@ -139,6 +143,12 @@ class NomadFSM:
             self._notify_evals(payload.get("evals", []))
         elif msg_type == APPLY_PLAN_RESULTS:
             s.upsert_plan_results(index, payload["result"])
+        elif msg_type == APPLY_PLAN_RESULTS_BATCH:
+            # per-plan order within the entry IS commit order; every plan
+            # of the batch shares the entry's index, and the store applies
+            # them under ONE lock hold so a blocking reader that observes
+            # the index always sees the WHOLE entry (serial-path parity)
+            s.upsert_plan_results_batch(index, payload["results"])
         elif msg_type == DEPLOYMENT_STATUS_UPDATE:
             s.update_deployment_status(index, payload["update"],
                                        payload.get("job"),
@@ -289,7 +299,8 @@ class NomadFSM:
             for ev in s.evals.values():
                 s._index_eval(ev)
             s.usage.rebuild(s.nodes.values(), s.allocs.values())
-            s._cond.notify_all()
+            s._snap_memo = None     # restore bypasses _bump: drop the
+            s._cond.notify_all()    # shared snapshot memo explicitly
 
 
 class RaftLog:
@@ -304,7 +315,12 @@ class RaftLog:
         self._lock = threading.Lock()
         self._index = fsm.state.latest_index()
 
-    def apply(self, msg_type: str, payload: dict) -> int:
+    def apply(self, msg_type: str, payload: dict,
+              timeout: float = 30.0) -> int:
+        # `timeout` mirrors the multi-server RaftNode.apply budget (the
+        # coalescing applier threads its per-BATCH remaining budget
+        # through); the single-node log commits synchronously, so there
+        # is nothing to wait on here.
         from .. import faults
         faults.fire("raft.apply")
         # the lock spans index assignment AND application so state-store

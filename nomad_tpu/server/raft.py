@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..metrics import metrics
 from ..rpc.codec import LeadershipLostError, NotLeaderError
 
 FOLLOWER = "follower"
@@ -309,9 +310,17 @@ class RaftNode:
 
     def apply(self, msg_type: str, payload, timeout: float = 30.0):
         """Commit one message through the replicated log. Leader-only;
-        raises NotLeaderError with a redirect hint on followers."""
+        raises NotLeaderError with a redirect hint on followers.
+
+        `timeout` is the caller's remaining budget for THIS message, not
+        a per-message constant: the coalescing plan applier passes the
+        remainder of its per-batch budget, so a batch of N plans riding
+        one entry never waits N x 30s (docs/COMMIT_COALESCING.md). A
+        timeout is counted (`nomad.raft.apply_timeout`) — the plan
+        applier layers its per-plan `nomad.plan.commit_timeout` on top."""
         from .. import faults
         faults.fire("raft.apply")
+        t_enter = time.monotonic()
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_addr)
@@ -341,8 +350,10 @@ class RaftNode:
             while self.last_applied < index and not self._stop.is_set():
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    metrics.incr("nomad.raft.apply_timeout")
                     raise TimeoutError(
-                        f"raft apply of {msg_type} timed out at index {index}")
+                        f"raft apply of {msg_type} timed out at index "
+                        f"{index} (budget {timeout:.1f}s)")
                 if self.state != LEADER:
                     # the entry IS appended; it may still commit under
                     # the next leader — callers must not retry/forward
@@ -355,6 +366,8 @@ class RaftNode:
             if index > self.base_index and \
                     self._term_at(index) != entry.term:
                 raise LeadershipLostError(self.leader_addr)
+            metrics.add_sample("nomad.raft.apply_wait",
+                               time.monotonic() - t_enter)
             return index
 
     def bootstrap_with(self, peers: dict[str, str]) -> bool:

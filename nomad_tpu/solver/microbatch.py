@@ -128,8 +128,30 @@ class MicroBatcher:
             return np.asarray(host_fn(*args))
 
         if leader:
-            # collect siblings for one window, then drain and dispatch
-            time.sleep(self._window_s)
+            # collect siblings for one window, then drain and dispatch.
+            # The wait ends EARLY once every known in-flight eval's lane
+            # has arrived (or the lane count is full): when the whole
+            # burst is queued there is nothing left to coalesce with, so
+            # sleeping out the window would be pure added latency. All
+            # lanes of one window plan against the store's memoized
+            # snapshot (state/store.py `_snapshot_locked`): the coalesced
+            # window shares ONE SnapshotMinIndex fetch instead of each
+            # lane paying its own full-table copy (ISSUE 5 satellite).
+            deadline = time.monotonic() + self._window_s
+            while True:
+                # sleep BEFORE the first check: even a window of 0 must
+                # yield the GIL once, or barrier-released siblings never
+                # get to enqueue and every dispatch degrades to solo
+                time.sleep(min(0.001, max(0.0,
+                                          deadline - time.monotonic())))
+                with self._lock:
+                    arrived = len(self._queues.get(key, ()))
+                    expected = max(self._active_evals, self._broker_hint)
+                if time.monotonic() >= deadline:
+                    break
+                if arrived >= LANES or arrived >= expected:
+                    metrics.incr("nomad.solver.microbatch.early_fire")
+                    break
             with self._lock:
                 batch = self._queues.pop(key, [])
             try:

@@ -32,9 +32,9 @@ import jax.numpy as jnp
 
 from ..metrics import metrics
 from ..structs import (
-    AllocatedResources, AllocatedSharedResources, AllocatedTaskResources,
-    Allocation, AllocDeploymentStatus, NetworkIndex, Plan,
-    new_id, new_ids,
+    AllocatedResources, AllocatedTaskResources, Allocation,
+    AllocDeploymentStatus, NetworkIndex, Plan, new_id, new_ids,
+    skeleton_for,
 )
 from ..scheduler.stack import SelectOptions
 from . import backend, microbatch
@@ -101,6 +101,10 @@ class SolverPlacer:
         self.ctx = sched.ctx
         self.state = sched.state
         self.plan = sched.plan
+        # per-eval ResourceSkeleton pool (structs/respool.py): one
+        # immutable resource base per task group, shared copy-on-write
+        # by every materialization path below
+        self._skel: dict = {}
 
     def compute_placements(self, destructive, place) -> bool:
         cfg = self.ctx.scheduler_config
@@ -495,12 +499,13 @@ class SolverPlacer:
                 placed = greedy(*(dev + g_args[2:]), host_args=g_args)
             else:
                 placed = greedy(*g_args)
-        placed = np.array(np.asarray(placed)[:n])   # writable host copy
+        placed = np.asarray(placed)[:n]     # the single device_get
         if use_scan and distincts:
             # chunk > 1 places several instances per scan step, which can
             # overshoot a distinct_property value quota within one step —
             # re-walk the counts host-side and trim the surplus (trimmed
             # instances retry via the host fallback, which is exact)
+            placed = np.array(placed)       # writable for the trim
             remaining = [row.copy() for row in dp.remaining]
             for i in np.argsort(-placed):
                 k = int(placed[i])
@@ -519,8 +524,21 @@ class SolverPlacer:
                     if vid >= 0:
                         remaining[d][vid] -= allowed
                 placed[i] = allowed
-        order = np.argsort(-placed)
-        return [(gt.nodes[i], int(placed[i])) for i in order if placed[i] > 0]
+        return self._placed_node_iter(gt.nodes, placed)
+
+    @staticmethod
+    def _placed_node_iter(nodes, placed: np.ndarray) -> list:
+        """[(node, count)] best-first via columnar selection: one
+        flatnonzero + one argsort over the PLACED rows only. The former
+        python walk over the whole node axis (10k iterations to find a
+        few hundred placed rows) was a real slice of small-eval stream
+        latency; node objects are only touched for the selected rows."""
+        sel = np.flatnonzero(placed > 0)
+        if not len(sel):
+            return []
+        sel = sel[np.argsort(-placed[sel], kind="stable")]
+        return [(nodes[i], k)
+                for i, k in zip(sel.tolist(), placed[sel].tolist())]
 
     # ------------------------------------------------ pipelined lifecycle
 
@@ -703,9 +721,7 @@ class SolverPlacer:
             host_t0 = time.perf_counter()
             solves_behind = ci < len(futs) - 1 and _in_flight(last_fut)
             is_last = ci == len(futs) - 1
-            order = np.argsort(-placed)
-            node_iter = [(prep.gt.nodes[i], int(placed[i]))
-                         for i in order if placed[i] > 0]
+            node_iter = self._placed_node_iter(prep.gt.nodes, placed)
             target = plan.node_allocation if is_last else {}
             with metrics.measure("nomad.solver.materialize"):
                 mi = self._stamp_slice(shared, ids, names, prev_ids,
@@ -916,6 +932,12 @@ class SolverPlacer:
                                                 int(masks[i].sum())))
         from ..structs import allocs_fit
         remaining = list(missings)
+        # ONE trial alloc probes every candidate node: the ask is the
+        # group's pooled resource skeleton, identical per instance (this
+        # construction used to run once per loop iteration — PERF001)
+        ask_alloc = Allocation(
+            allocated_resources=skeleton_for(self._skel, tg,
+                                             False).shared_total)
         for i in order:
             if not remaining:
                 break
@@ -928,12 +950,6 @@ class SolverPlacer:
                    for ps in distinct_sets):
                 continue
             chosen = [victims[j] for j in range(len(victims)) if masks[i][j]]
-            ask_alloc = Allocation(allocated_resources=AllocatedResources(
-                shared=AllocatedSharedResources(
-                    disk_mb=tg.ephemeral_disk.size_mb),
-                tasks={t.name: AllocatedTaskResources(
-                    cpu_shares=t.resources.cpu,
-                    memory_mb=t.resources.memory_mb) for t in tg.tasks}))
             chosen_ids = {a.id for a in chosen}
             trial = [a for a in proposed if a.id not in chosen_ids] + \
                 [ask_alloc]
@@ -970,15 +986,10 @@ class SolverPlacer:
         from ..scheduler.reconcile import AllocPlaceResult
         sched = self.sched
         oversub = self.ctx.scheduler_config.memory_oversubscription_enabled
-        total = AllocatedResources(
-            shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb))
-        for task in tg.tasks:
-            tr = AllocatedTaskResources(
-                cpu_shares=task.resources.cpu,
-                memory_mb=task.resources.memory_mb)
-            if oversub:
-                tr.memory_max_mb = task.resources.memory_max_mb
-            total.tasks[task.name] = tr
+        # pooled skeleton: the shared AllocatedResources all instances of
+        # the TG point at (identical bits to the per-field build this
+        # replaced; the XR-row cache on it computes once per group)
+        total = skeleton_for(self._skel, tg, oversub).shared_total
         metrics_obj = self.ctx.metrics.copy()
         shared = {"namespace": sched.eval.namespace,
                   "eval_id": sched.eval.id,
@@ -1077,8 +1088,13 @@ class SolverPlacer:
         dev_alloc = DeviceAllocator(self.ctx, node)
         dev_alloc.add_allocs(proposed)
 
-        total = AllocatedResources(
-            shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb))
+        # copy-on-write materialization: the pooled skeleton seeds every
+        # task row; only tasks carrying SEQUENTIAL per-alloc state
+        # (ports/devices/cores) are rebuilt below — simple tasks keep
+        # pointing at the shared immutable base rows
+        oversub = self.ctx.scheduler_config.memory_oversubscription_enabled
+        skel = skeleton_for(self._skel, tg, oversub)
+        total = skel.materialize()
         if tg.networks:
             offer, err = net_idx.assign_network(tg.networks[0])
             if offer is None:
@@ -1090,10 +1106,14 @@ class SolverPlacer:
                  "host_ip": offer.ip}
                 for p in offer.reserved_ports + offer.dynamic_ports]
         for task in tg.tasks:
+            if not skel.task_is_sequential(task.name):
+                continue            # shared CoW row already seeded
+            # genuinely per-alloc: the assigned ports/devices/cores below
+            # differ per instance — nomadlint: disable=PERF001
             tr = AllocatedTaskResources(
                 cpu_shares=task.resources.cpu,
                 memory_mb=task.resources.memory_mb)
-            if self.ctx.scheduler_config.memory_oversubscription_enabled:
+            if oversub:
                 tr.memory_max_mb = task.resources.memory_max_mb
             if task.resources.networks:
                 offer, err = net_idx.assign_network(task.resources.networks[0])
@@ -1181,10 +1201,14 @@ class SolverPlacer:
                 sched.failed_tg_allocs[tg.name] = sched.ctx.metrics.copy()
                 continue
             sched._handle_preemptions(option)
+            # the stack's ranked task_resources genuinely vary per option
+            # (penalized nodes, assigned ports) so the wrapper is
+            # per-alloc; the disk-only shared row is pooled
+            # nomadlint: disable=PERF001
             resources = AllocatedResources(
                 tasks=dict(option.task_resources),
-                shared=option.alloc_resources or AllocatedSharedResources(
-                    disk_mb=tg.ephemeral_disk.size_mb))
+                shared=option.alloc_resources or
+                skeleton_for(self._skel, tg, False).shared_total.shared)
             alloc = Allocation(
                 id=new_id(), namespace=sched.eval.namespace,
                 eval_id=sched.eval.id, name=name, job_id=sched.eval.job_id,

@@ -84,6 +84,15 @@ class StateStore:
         # the solver's input (see usage_index.py module docstring)
         self.usage = UsageIndex()
 
+        # memoized point-in-time snapshot, valid until the next write
+        # (ISSUE 5 satellite): every reader between two commits — the K
+        # worker lanes of one coalesced micro-batch window, the plan
+        # applier's per-batch SnapshotMinIndex, blocking-query fans —
+        # shares ONE StateSnapshot construction instead of each paying
+        # the full table copy. Safe because a StateSnapshot is read-only
+        # by contract and stored objects are immutable-by-convention.
+        self._snap_memo: Optional["StateSnapshot"] = None
+
         # event sink (wired to the event broker by the server)
         self.event_sinks: list[Callable[[str, str, int, object], None]] = []
         # optional: the owning server/agent wires its logger in so sink
@@ -106,6 +115,12 @@ class StateStore:
             index = self._index + 1
         self._index = max(self._index, index)
         self._table_index[table] = self._index
+        # any write invalidates the shared snapshot memo — keyed on the
+        # write GENERATION, not the index: a batched FSM entry applies
+        # several writes at one index and each must displace the memo.
+        # _bump is only ever called with self._lock held (every writer).
+        # nomadlint: disable=LOCK001 — caller holds the write lock
+        self._snap_memo = None
         return self._index
 
     def _commit(self) -> None:
@@ -123,7 +138,16 @@ class StateStore:
 
     def snapshot(self) -> "StateSnapshot":
         with self._lock:
-            return StateSnapshot(self)
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> "StateSnapshot":
+        snap = self._snap_memo
+        if snap is None:
+            snap = self._snap_memo = StateSnapshot(self)
+        else:
+            from ..metrics import metrics
+            metrics.incr("nomad.state.snapshot_shared")
+        return snap
 
     def fork(self) -> "StateStore":
         """Writable scratch copy for dry-run planning (the Job.Plan endpoint
@@ -181,7 +205,7 @@ class StateStore:
                     raise TimeoutError(
                         f"timed out waiting for index {index} (at {self._index})")
                 self._cond.wait(remaining)
-            return StateSnapshot(self)
+            return self._snapshot_locked()
 
     def block_min_index(self, index: int, timeout: float = 60.0) -> int:
         """Blocking-query primitive: wait for any write past `index`."""
@@ -1022,6 +1046,18 @@ class StateStore:
             return list(self.allocs.values())
 
     # ------------------------------------------------------------ plan apply
+
+    def upsert_plan_results_batch(self, index: int, results) -> None:
+        """Apply a coalesced commit batch's plan results in list order
+        under ONE lock hold (the lock is reentrant): all plans of the
+        entry share `index`, so a blocking reader (`snapshot_min_index`,
+        `block_min_index`) that wakes on the index must see the WHOLE
+        entry — releasing the lock between per-plan transactions would
+        let it observe index N with later plans of N still invisible,
+        and their same-index writes would never re-wake it."""
+        with self._lock:
+            for result in results:
+                self.upsert_plan_results(index, result)
 
     def upsert_plan_results(self, index: int, result) -> None:
         """Atomically apply a committed plan (ref nomad/fsm.go:998

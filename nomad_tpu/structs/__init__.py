@@ -66,6 +66,9 @@ from .network import (  # noqa: F401
     Bitmap, NetworkIndex, parse_port_spec, MAX_VALID_PORT,
     DEFAULT_MIN_DYNAMIC_PORT, DEFAULT_MAX_DYNAMIC_PORT,
 )
+from .respool import (  # noqa: F401
+    ResourceSkeleton, skeleton_for,
+)
 from .funcs import (  # noqa: F401
     DeviceAccounter, allocs_fit, score_fit_binpack, score_fit_spread,
     score_normalize, BINPACK_MAX_FIT_SCORE,

@@ -47,6 +47,20 @@ class SchedulerConfiguration:
                               batched dispatch instead of the host tier.
       eval_batch_window_ms    how long the first pending solve waits for
                               siblings before dispatching the batch.
+      plan_commit_batch_max   how many verified pending plans the serial
+                              applier may drain into ONE raft entry / FSM
+                              batch apply (cross-eval commit coalescing);
+                              1 means the pre-coalescing serial path.
+      plan_commit_timeout_s   the raft-apply budget for a WHOLE commit
+                              batch (not per message) — on exhaustion
+                              every plan of the batch fails with a
+                              `nomad.plan.commit_timeout` count instead
+                              of serially starving the queue.
+      plan_commit_window_ms   how long the applier lingers for more
+                              arrivals after a partial drain — engages
+                              ONLY while more evals than drained plans
+                              are in flight (the micro-batcher's signal),
+                              so a lone plan never waits.
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -58,6 +72,9 @@ class SchedulerConfiguration:
     plan_pipeline_min_count: int = 8192
     eval_batch_enabled: bool = True
     eval_batch_window_ms: float = 8.0
+    plan_commit_batch_max: int = 32
+    plan_commit_timeout_s: float = 30.0
+    plan_commit_window_ms: float = 5.0
     create_index: int = 0
     modify_index: int = 0
 
@@ -75,4 +92,10 @@ class SchedulerConfiguration:
             return "plan_pipeline_min_count must be >= 0"
         if self.eval_batch_window_ms < 0:
             return "eval_batch_window_ms must be >= 0"
+        if self.plan_commit_batch_max < 1:
+            return "plan_commit_batch_max must be >= 1"
+        if self.plan_commit_timeout_s <= 0:
+            return "plan_commit_timeout_s must be > 0"
+        if self.plan_commit_window_ms < 0:
+            return "plan_commit_window_ms must be >= 0"
         return ""

@@ -139,6 +139,50 @@ def test_warm_restart_compile_does_not_regress():
         f"persistent compile cache stopped carrying warm restarts")
 
 
+def test_stream_commit_coalescing_engages():
+    """ISSUE 5 lineage: once a bench records `commit_batch_size_p50`,
+    the concurrent stream must actually coalesce plan commits
+    (p50 batch width > 1 while the stream backlog exists) — a p50 of 1
+    means the applier regressed to one raft entry per plan. Platform-
+    independent: coalescing is a host-side commit-path property."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    p50 = latest.get("commit_batch_size_p50")
+    if p50 is None:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates commit coalescing")
+    if latest.get("stream_concurrency", 1) < 4:
+        pytest.skip("no commit backlog expected below concurrency 4")
+    assert p50 > 1, (
+        f"BENCH_r{latest_round:02d}: commit_batch_size_p50 {p50} — the "
+        f"stream window never coalesced plan commits")
+    coalesce = latest.get("plan_coalesce", {})
+    assert coalesce.get("commits", 0) >= 1, \
+        f"BENCH_r{latest_round:02d}: no coalesced commit recorded"
+    assert coalesce.get("commit_timeouts", 0) == 0, \
+        f"BENCH_r{latest_round:02d}: commit timeouts during a healthy run"
+
+
+def test_stream_phase_percentiles_are_recorded():
+    """The per-phase stream percentiles (ISSUE 5 satellite) must ship
+    with any bench that records the coalescing marker — the regression
+    story needs per-phase p50/p95 over the stream window, not just the
+    headline sums."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    if "commit_batch_size_p50" not in latest:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates this gate")
+    for phase in ("solve", "materialize", "plan_evaluate", "fsm_commit"):
+        for q in ("p50", "p95"):
+            key = f"phase_{phase}_{q}"
+            assert key in latest, \
+                f"BENCH_r{latest_round:02d} missing stream {key}"
+            assert latest[key] >= 0
+
+
 def test_headline_rejection_parity_is_recorded():
     """The headline's second acceptance axis: the latest bench must have
     run at rejection parity with zero headline plan-node rejections —

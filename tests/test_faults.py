@@ -828,7 +828,9 @@ def test_planner_stop_fails_stranded_pendings():
     planner.start()
     faults.install({"planner.apply": {"mode": "delay", "delay_ms": 1500}})
     inflight = planner.submit_plan_async(Plan())
-    assert wait_until(lambda: planner._inflight is inflight, timeout=2)
+    # _inflight is the drained coalescing batch (a list) since ISSUE 5
+    assert wait_until(
+        lambda: any(p is inflight for p in planner._inflight), timeout=2)
     queued = planner.submit_plan_async(Plan())
     t0 = time.perf_counter()
     planner.stop(timeout=0.2)

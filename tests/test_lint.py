@@ -530,8 +530,67 @@ def test_cli_nonexistent_path_fails(tmp_path):
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
-            "EXC001"} <= ids
+            "EXC001", "PERF001"} <= ids
     assert all(r.short for r in all_rules())
+
+
+# ----------------------------------------------------------------- PERF001
+
+PERF001_BAD = """
+    from nomad_tpu.structs import AllocatedResources, AllocatedTaskResources
+
+    def materialize(missings, tg):
+        out = []
+        for m in missings:
+            res = AllocatedResources(
+                tasks={t.name: AllocatedTaskResources(cpu_shares=t.cpu)
+                       for t in tg.tasks})
+            out.append(res)
+        return out
+"""
+
+
+def test_perf001_fires_on_per_alloc_construction_in_plan_path():
+    out = findings(PERF001_BAD, path="solver/placer.py")
+    assert [f.rule for f in out] == ["PERF001", "PERF001"]
+    assert "skeleton" in out[0].message
+
+
+def test_perf001_fires_on_deepcopy_in_loop():
+    src = """
+        import copy
+
+        def apply(plans):
+            for plan in plans:
+                twin = copy.deepcopy(plan)
+    """
+    out = findings(src, path="server/plan_apply.py")
+    assert [f.rule for f in out] == ["PERF001"]
+    assert "deepcopy" in out[0].message
+
+
+def test_perf001_quiet_outside_loops_and_outside_plan_path():
+    hoisted = """
+        from nomad_tpu.structs import AllocatedResources
+
+        def skeleton(tg):
+            return AllocatedResources()     # once per TG: fine
+    """
+    assert rule_ids(hoisted, path="solver/placer.py") == []
+    # same bad shape OUTSIDE the plan-path modules: out of scope
+    assert rule_ids(PERF001_BAD, path="client/alloc_runner.py") == []
+
+
+def test_perf001_inline_suppression():
+    src = """
+        from nomad_tpu.structs import AllocatedTaskResources
+
+        def place(tasks):
+            for t in tasks:
+                # genuinely per-alloc ports — nomadlint: disable=PERF001
+                tr = AllocatedTaskResources(cpu_shares=t.cpu)
+    """
+    assert rule_ids(src, path="scheduler/generic_sched.py") == []
 
 
 # ------------------------------------------------------------- tier-1 gate
