@@ -392,6 +392,178 @@ def warm_probe() -> None:
                       "state_seed_s": round(seed_s, 3)}))
 
 
+def failover_probe() -> None:
+    """Subprocess mode (--failover-probe, ISSUE 6): the PROMOTION half of
+    leader failover — a standby server that already holds the replicated
+    10k-node state gains leadership at t=0; measure the recovery barrier
+    (`leader_failover_s`) and promotion-to-first-completed-solve
+    (`failover_first_solve_s`), per-phase timings included. The env
+    decides warm vs cold:
+
+      warm  NOMAD_COMPILE_CACHE set (persistent XLA cache populated by
+            the parent run) + the standby twin fed + AOT warmup/tensor
+            reseed at establish — what a warm-standby follower pays;
+      cold  no compile cache, NOMAD_AOT_WARMUP=0 — a promoted server
+            that never pre-warmed, paying compiles as placement blackout.
+
+    The ELECTION half is measured separately in-process (see
+    _election_probe): it involves no compile state, so it does not need
+    process isolation."""
+    import random
+
+    import jax
+    from nomad_tpu.runtime import enable_compile_cache, tune_gc
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs import SCHED_ALG_TPU, SchedulerConfiguration
+
+    tune_gc()
+    if os.environ.get("NOMAD_COMPILE_CACHE"):
+        enable_compile_cache()
+    random.seed(20260803)
+    warm = os.environ.get("NOMAD_AOT_WARMUP", "") != "0"
+    t0 = time.perf_counter()
+    jax.devices()
+    attach_s = time.perf_counter() - t0
+
+    s = Server(num_workers=2, gc_interval=9999)
+    st = s.state
+    st.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    rng = np.random.default_rng(42)
+    for i in range(N_NODES):
+        st.upsert_node(i + 2, _mk_node(i, rng))
+
+    standby = {}
+    if warm:
+        # the standby phase: exactly what a follower does while
+        # following — feed the passive tensor twin from its store and
+        # pre-compile the solver grid (server._standby_warmup_loop /
+        # fsm.on_plan_apply do this continuously in a live follower)
+        t1 = time.perf_counter()
+        from nomad_tpu.solver import backend, state_cache
+        state_cache.standby_feed(st)
+        out = backend.warmup(N_NODES)
+        standby = {"standby_warmup_s": round(time.perf_counter() - t1, 3),
+                   "standby_artifacts": out.get("artifacts")}
+
+    burst = 2_000
+    t0 = time.perf_counter()
+    s.start()                   # leadership gained: the barrier runs here
+    establish_s = time.perf_counter() - t0
+    job = _mk_batch_job("failover-burst", burst)
+    s.job_register(job)
+    deadline = time.time() + 300
+    placed = 0
+    while time.time() < deadline:
+        placed = len(st.allocs_by_job("default", "failover-burst"))
+        if placed >= burst:
+            break
+        time.sleep(0.005)
+    first_solve_s = time.perf_counter() - t0
+    detail = {k: round(v, 4) for k, v in s._establish_timings.items()}
+    s.shutdown()
+    if placed < burst:
+        raise RuntimeError(f"failover burst placed {placed}/{burst}")
+    print(json.dumps({
+        "leader_failover_s": round(establish_s, 3),
+        "failover_first_solve_s": round(first_solve_s, 3),
+        "device_attach_s": round(attach_s, 3),
+        "warm": warm,
+        **standby,
+        "establish_detail": detail,
+    }))
+
+
+def _election_probe(timeout: float = 60.0) -> float:
+    """Crash-to-new-established-leader latency on an in-process 3-server
+    virtual-transport cluster (no solver state involved — elections are
+    pure control-plane, so in-process measurement is honest)."""
+    from nomad_tpu.rpc.virtual import VirtualNetwork
+    from nomad_tpu.server import Server
+
+    net = VirtualNetwork(seed=0)
+    servers = []
+    # the whole setup runs inside the try: a failure mid-construction
+    # must still shut down the servers already started, or they keep
+    # election-churning (and holding the GIL) through the rest of the
+    # bench, skewing every timing that follows
+    try:
+        for i in range(3):
+            sv = Server(num_workers=0, gc_interval=9999)
+            sv.rpc_listen_virtual(net, f"b{i}")
+            servers.append(sv)
+        peers = {f"b{i}": sv.rpc_addr for i, sv in enumerate(servers)}
+        for i, sv in enumerate(servers):
+            sv.enable_raft(f"b{i}", peers, election_timeout=(0.25, 0.5),
+                           heartbeat_interval=0.05, seed=i)
+            sv.start()
+        def _stable(group):
+            led = [sv for sv in group
+                   if sv.raft_node.is_leader() and sv.is_leader]
+            return led[0] if len(led) == 1 else None
+
+        deadline = time.time() + timeout
+        leader = None
+        while time.time() < deadline and leader is None:
+            leader = _stable(servers)
+            time.sleep(0.005)
+        if leader is None:
+            raise RuntimeError("election probe: no initial leader")
+        net.crash(leader.raft_node.node_id)
+        t0 = time.perf_counter()
+        rest = [sv for sv in servers if sv is not leader]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if _stable(rest) is not None:
+                return time.perf_counter() - t0
+            time.sleep(0.002)
+        raise RuntimeError("election probe: no failover leader")
+    finally:
+        for sv in servers:
+            sv.shutdown()
+
+
+def _run_failover_probes(cache_dir: str) -> dict:
+    """Parent-side driver: election in-process, promotion in children
+    (compile caches are process-wide, so warm-vs-cold needs isolation)."""
+    import subprocess
+    out = {"failover_election_s": -1.0, "leader_failover_s": -1.0,
+           "failover_first_solve_s": -1.0,
+           "failover_first_solve_cold_s": -1.0, "failover_detail": {}}
+    try:
+        out["failover_election_s"] = round(_election_probe(), 3)
+    except Exception:                   # noqa: BLE001 — probe is optional
+        pass
+
+    def _child(env):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--failover-probe"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                return json.loads(line)
+        return {}
+
+    try:
+        warm = _child(dict(os.environ, NOMAD_COMPILE_CACHE=cache_dir))
+        cold_env = dict(os.environ, NOMAD_AOT_WARMUP="0",
+                        NOMAD_STANDBY_WARMUP="0")
+        cold_env.pop("NOMAD_COMPILE_CACHE", None)
+        cold = _child(cold_env)
+        out.update({
+            "leader_failover_s": warm.get("leader_failover_s", -1.0),
+            "failover_first_solve_s":
+                warm.get("failover_first_solve_s", -1.0),
+            "failover_first_solve_cold_s":
+                cold.get("failover_first_solve_s", -1.0),
+            "failover_detail": {"warm": warm, "cold": cold},
+        })
+    except Exception:                   # noqa: BLE001 — probe is optional
+        pass
+    return out
+
+
 def main() -> None:
     import random
 
@@ -656,6 +828,11 @@ def main() -> None:
     except Exception:                   # noqa: BLE001 — probe is optional
         pass
 
+    # leader-failover lineage (ISSUE 6): election latency + warm-standby
+    # vs cold promotion-to-first-solve, gated by
+    # tests/test_bench_regression.py once recorded
+    failover = _run_failover_probes(cache_dir)
+
     print(json.dumps({
         "metric": f"end-to-end {N_TASKS//1000}k-task batch eval->plan-applied"
                   f" on {N_NODES//1000}k-node sim ({platform})",
@@ -665,6 +842,7 @@ def main() -> None:
         "compile_s": round(compile_s, 3),
         "compile_s_warm_restart": warm_compile_s,
         "warm_restart_detail": warm_extra,
+        **failover,
         "dispatch_floor_s": round(dispatch_floor_s, 4),
         "placed": N_TASKS,
         "plan_nodes_rejected": rejected,
@@ -1025,5 +1203,13 @@ if __name__ == "__main__":
         print(json.dumps(kernel_only()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":
+        failover_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--failover":
+        # standalone combined probe (election + warm/cold promotion)
+        import tempfile
+        cache_dir = os.environ.get("NOMAD_COMPILE_CACHE") or \
+            tempfile.mkdtemp(prefix="nomad-failover-xla-cache-")
+        print(json.dumps(_run_failover_probes(cache_dir)))
     else:
         main()   # driver contract: exactly one JSON line

@@ -14,6 +14,9 @@ code: `solver.dispatch.pallas`, `raft.apply`, `heartbeat.invalidate`,
   raise        fire on every call
   delay        sleep `delay_ms` then continue (slow disk, busy device)
   nth_call     fire on every n-th call at that site (1-based)
+  after        fire on EVERY call from the n-th onward (1-based) — the
+               partition shape: a link that works N-1 times and then
+               stays dead until the plan is cleared/healed
   probability  fire with probability `p` from a PER-SITE seeded RNG —
                same seed => same fire pattern over the site's call
                sequence, independent of other sites' traffic
@@ -69,7 +72,7 @@ _EXC_TYPES = {
     "runtime": RuntimeError,
 }
 
-_MODES = ("raise", "delay", "nth_call", "probability")
+_MODES = ("raise", "delay", "nth_call", "after", "probability")
 
 
 class FaultSpec:
@@ -86,8 +89,8 @@ class FaultSpec:
         if exc not in _EXC_TYPES:
             raise ValueError(f"unknown fault exc {exc!r} "
                              f"(one of {tuple(_EXC_TYPES)})")
-        if mode == "nth_call" and n < 1:
-            raise ValueError("nth_call requires n >= 1")
+        if mode in ("nth_call", "after") and n < 1:
+            raise ValueError(f"{mode} requires n >= 1")
         self.pattern = pattern
         self.mode = mode
         self.n = int(n)
@@ -111,6 +114,8 @@ class FaultSpec:
             return True
         if self.mode == "nth_call":
             return self.calls % self.n == 0
+        if self.mode == "after":
+            return self.calls >= self.n
         return self._rng.random() < self.p          # probability
 
     def raise_now(self, site: str) -> None:

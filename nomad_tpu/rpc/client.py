@@ -107,9 +107,17 @@ class RpcClient:
                     continue
                 raise
         self._checkin(addr, sock)
+        return self._raise_for_response(resp)
+
+    @staticmethod
+    def _raise_for_response(resp):
+        """Response envelope -> result or exception. Shared with the
+        virtual transport client (rpc/virtual.py) so the deterministic
+        failover tests exercise EXACTLY the production error mapping."""
         if resp.get("kind") == "NotLeaderError":
             raise NotLeaderError(resp.get("error") or "")
-        if "error" in resp and resp["error"] is not None and "result" not in resp:
+        if "error" in resp and resp["error"] is not None \
+                and "result" not in resp:
             raise RpcError(resp["error"], kind=resp.get("kind", "RpcError"))
         return resp.get("result")
 

@@ -65,6 +65,25 @@ class LeadershipLostError(NotLeaderError):
         self.leader_addr = leader_addr
 
 
+class FencedWriteError(NotLeaderError):
+    """A fenced write (apply(fence=token)) was rejected because the term
+    moved since the token was captured (ISSUE 6). Unlike
+    LeadershipLostError the entry was NEVER appended — commit is provably
+    impossible, so the caller may safely treat the write as not-happened
+    (the plan applier reports the whole batch as leadership-lost and the
+    new leader's broker restore re-drives the work)."""
+
+    def __init__(self, current_term: int = -1, fence: int = -1,
+                 leader_addr: str = ""):
+        Exception.__init__(
+            self, f"fenced write rejected: term moved {fence} -> "
+            f"{current_term} since the fence token was captured "
+            f"(leader={leader_addr or '?'})")
+        self.leader_addr = leader_addr
+        self.current_term = current_term
+        self.fence = fence
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module: str, name: str):
         if (module, name) in _ALLOWED_EXACT or \

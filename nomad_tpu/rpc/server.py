@@ -2,6 +2,12 @@
 handlers, with transparent leader forwarding for leader-only methods (ref
 nomad/rpc.go:341 handleConn / :450 forward, nomad/server.go:1146
 setupRpcServer).
+
+The dispatch/forwarding logic lives in `RpcDispatcher`, shared by the TCP
+server here and the in-memory `rpc/virtual.py` transport the deterministic
+multi-server tests ride (ISSUE 6): both route outbound hops through
+`client_for`, so follower->leader and cross-region forwarding behave
+identically over either transport.
 """
 from __future__ import annotations
 
@@ -17,23 +23,17 @@ from .codec import (FrameError, NotLeaderError, RpcError, recv_msg, send_msg)
 DEFAULT_KEY = b"nomad-tpu-dev-cluster-key"
 
 
-class RpcServer:
-    """One per agent process. Handlers are registered as
-    ``register("Node.Register", fn, leader_only=True)``; leader-only calls
-    arriving on a follower are proxied to the current leader (server-side
-    forwarding, matching the reference) when ``leader_addr_fn`` names one.
-    """
+class RpcDispatcher:
+    """Transport-independent half of an RPC server: the handler registry,
+    leader/region forwarding, and the dispatch loop body. Subclasses
+    provide `addr` and `client_for` (how to reach another server)."""
 
-    def __init__(self, bind: str = "127.0.0.1", port: int = 0,
-                 key: bytes = DEFAULT_KEY, logger=None, tls=None):
+    addr: str = ""
+
+    def _init_dispatch(self, key: bytes, logger=None, tls=None) -> None:
         self.key = key
         self.logger = logger or (lambda msg: None)
-        # TLSConfig (tlsutil.py) or None; when set, every accepted
-        # connection is wrapped in mutual TLS before framing begins (ref
-        # nomad/rpc.go listen → tlsutil IncomingTLSConfig), and outbound
-        # forwards dial with the client context
         self.tls = tls
-        self._tls_server_ctx = tls.server_context() if tls else None
         self._handlers: dict[str, tuple[Callable, bool]] = {}
         # wired by the consensus layer: () -> (is_leader, leader_rpc_addr)
         self.leadership_fn: Callable[[], tuple[bool, str]] = lambda: (True, "")
@@ -42,45 +42,6 @@ class RpcServer:
         # region are proxied to a known server of that region
         self.region = ""
         self.region_servers_fn: Callable[[], dict] = lambda: {}
-        outer = self
-
-        class _Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                sock: socket.socket = self.request
-                # idle/trickle connections may not pin a thread (and up to
-                # MAX_FRAME of pre-auth buffer) forever
-                sock.settimeout(300.0)
-                if outer._tls_server_ctx is not None:
-                    try:
-                        sock = outer._tls_server_ctx.wrap_socket(
-                            sock, server_side=True)
-                    except (ssl.SSLError, OSError) as e:
-                        outer.logger(f"rpc: tls handshake failed: {e}")
-                        return
-                try:
-                    while True:
-                        try:
-                            req = recv_msg(sock, outer.key)
-                        except (ConnectionError, OSError):
-                            return
-                        except FrameError as e:
-                            outer.logger(f"rpc: bad frame: {e}")
-                            return
-                        resp = outer._dispatch(req)
-                        try:
-                            send_msg(sock, resp, outer.key)
-                        except (ConnectionError, OSError):
-                            return
-                except Exception as e:   # noqa: BLE001
-                    outer.logger(f"rpc: connection error: {e!r}")
-
-        class _Server(socketserver.ThreadingTCPServer):
-            daemon_threads = True
-            allow_reuse_address = True
-
-        self._tcp = _Server((bind, port), _Handler)
-        self.addr = "%s:%d" % self._tcp.server_address[:2]
-        self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ registry
     def register(self, method: str, fn: Callable,
@@ -91,6 +52,14 @@ class RpcServer:
         """spec: {"Node.Register": ("node_register", leader_only), ...}"""
         for method, (attr, leader_only) in spec.items():
             self.register(method, getattr(obj, attr), leader_only=leader_only)
+
+    # ------------------------------------------------------------ transport
+    def client_for(self, addr: str, timeout: float = 30.0):
+        """An RpcClient-compatible handle on one peer address. The ONLY
+        way framework code (raft replication, forwarding) dials out, so
+        the virtual transport can intercept every hop."""
+        from .client import RpcClient
+        return RpcClient([addr], key=self.key, timeout=timeout, tls=self.tls)
 
     # ------------------------------------------------------------ dispatch
     def _dispatch(self, req) -> dict:
@@ -145,13 +114,12 @@ class RpcServer:
         if not addrs:
             return {"error": f"no path to region {region!r}",
                     "kind": "NoRegionPathError"}
-        from .client import RpcClient
         from .codec import RpcError
         random.shuffle(addrs)
         last = None
         for addr in addrs[:3]:
             try:
-                with RpcClient([addr], key=self.key, tls=self.tls) as cli:
+                with self.client_for(addr) as cli:
                     # the target is in `region`, so it serves locally —
                     # the stamp is kept for integrity, not re-forwarded
                     return {"result": cli.call(
@@ -171,10 +139,8 @@ class RpcServer:
         """Proxy a leader-only call to the leader (ref nomad/rpc.go:450)."""
         if not leader_addr or leader_addr == self.addr:
             return None
-        from .client import RpcClient
         try:
-            with RpcClient([leader_addr], key=self.key,
-                           tls=self.tls) as cli:
+            with self.client_for(leader_addr) as cli:
                 return {"result": cli.call(method, *req.get("args", ()),
                                            **req.get("kwargs", {}))}
         except NotLeaderError as e:
@@ -184,6 +150,62 @@ class RpcServer:
             # advertised leader may have just died (stale leader_addr)
             return {"error": f"leader forward failed: {e}",
                     "kind": "RetryableError"}
+
+
+class RpcServer(RpcDispatcher):
+    """One per agent process. Handlers are registered as
+    ``register("Node.Register", fn, leader_only=True)``; leader-only calls
+    arriving on a follower are proxied to the current leader (server-side
+    forwarding, matching the reference) when ``leader_addr_fn`` names one.
+    """
+
+    def __init__(self, bind: str = "127.0.0.1", port: int = 0,
+                 key: bytes = DEFAULT_KEY, logger=None, tls=None):
+        # TLSConfig (tlsutil.py) or None; when set, every accepted
+        # connection is wrapped in mutual TLS before framing begins (ref
+        # nomad/rpc.go listen → tlsutil IncomingTLSConfig), and outbound
+        # forwards dial with the client context
+        self._init_dispatch(key, logger=logger, tls=tls)
+        self._tls_server_ctx = tls.server_context() if tls else None
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock: socket.socket = self.request
+                # idle/trickle connections may not pin a thread (and up to
+                # MAX_FRAME of pre-auth buffer) forever
+                sock.settimeout(300.0)
+                if outer._tls_server_ctx is not None:
+                    try:
+                        sock = outer._tls_server_ctx.wrap_socket(
+                            sock, server_side=True)
+                    except (ssl.SSLError, OSError) as e:
+                        outer.logger(f"rpc: tls handshake failed: {e}")
+                        return
+                try:
+                    while True:
+                        try:
+                            req = recv_msg(sock, outer.key)
+                        except (ConnectionError, OSError):
+                            return
+                        except FrameError as e:
+                            outer.logger(f"rpc: bad frame: {e}")
+                            return
+                        resp = outer._dispatch(req)
+                        try:
+                            send_msg(sock, resp, outer.key)
+                        except (ConnectionError, OSError):
+                            return
+                except Exception as e:   # noqa: BLE001
+                    outer.logger(f"rpc: connection error: {e!r}")
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._tcp = _Server((bind, port), _Handler)
+        self.addr = "%s:%d" % self._tcp.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:

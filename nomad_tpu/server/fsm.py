@@ -88,6 +88,11 @@ class NomadFSM:
         # callbacks fired after specific message types commit (e.g. the
         # leader enqueues evals into the broker, ref fsm.go:760)
         self.on_eval_update: list[Callable[[list[Evaluation]], None]] = []
+        # fired after plan results apply (ISSUE 6 warm standby): a
+        # FOLLOWER feeds its passive solver state-cache twin from here,
+        # so promotion finds the device tensors already current. Best-
+        # effort: a callback failure must never fail the FSM apply
+        self.on_plan_apply: list[Callable[[int], None]] = []
 
     def apply(self, index: int, msg_type: str, payload: dict) -> object:
         """ref fsm.go:194 Apply (type switch :211-307)"""
@@ -143,12 +148,14 @@ class NomadFSM:
             self._notify_evals(payload.get("evals", []))
         elif msg_type == APPLY_PLAN_RESULTS:
             s.upsert_plan_results(index, payload["result"])
+            self._notify_plan_apply(index)
         elif msg_type == APPLY_PLAN_RESULTS_BATCH:
             # per-plan order within the entry IS commit order; every plan
             # of the batch shares the entry's index, and the store applies
             # them under ONE lock hold so a blocking reader that observes
             # the index always sees the WHOLE entry (serial-path parity)
             s.upsert_plan_results_batch(index, payload["results"])
+            self._notify_plan_apply(index)
         elif msg_type == DEPLOYMENT_STATUS_UPDATE:
             s.update_deployment_status(index, payload["update"],
                                        payload.get("job"),
@@ -227,6 +234,14 @@ class NomadFSM:
     def _notify_evals(self, evals: list[Evaluation]) -> None:
         for cb in self.on_eval_update:
             cb(evals)
+
+    def _notify_plan_apply(self, index: int) -> None:
+        for cb in self.on_plan_apply:
+            try:
+                cb(index)
+            except Exception as e:      # noqa: BLE001 — standby feed is
+                from ..metrics import record_swallowed_error   # telemetry
+                record_swallowed_error("fsm.on_plan_apply", e)
 
     # ------------------------------------------------------ snapshot/restore
 
@@ -314,9 +329,19 @@ class RaftLog:
         self.fsm = fsm
         self._lock = threading.Lock()
         self._index = fsm.state.latest_index()
+        # single-node leadership epoch: never changes in normal operation
+        # (a single-node log cannot be deposed), but the fence machinery
+        # is exercised end-to-end — restore() bumps it, matching the one
+        # event that invalidates prepared writes here (docs/FAILOVER.md)
+        self._fence = 0
+
+    def fence_token(self) -> Optional[int]:
+        """Single-node twin of RaftNode.fence_token (ISSUE 6)."""
+        with self._lock:
+            return self._fence
 
     def apply(self, msg_type: str, payload: dict,
-              timeout: float = 30.0) -> int:
+              timeout: float = 30.0, fence: Optional[int] = None) -> int:
         # `timeout` mirrors the multi-server RaftNode.apply budget (the
         # coalescing applier threads its per-BATCH remaining budget
         # through); the single-node log commits synchronously, so there
@@ -326,6 +351,11 @@ class RaftLog:
         # the lock spans index assignment AND application so state-store
         # mutations happen in strict log order (replay determinism)
         with self._lock:
+            if fence is not None and fence != self._fence:
+                from ..rpc.codec import FencedWriteError
+                from ..metrics import metrics
+                metrics.incr("nomad.raft.fence_rejected")
+                raise FencedWriteError(self._fence, fence)
             self._index += 1
             index = self._index
             self.fsm.apply(index, msg_type, payload)
@@ -343,3 +373,6 @@ class RaftLog:
         self.fsm.restore_bytes(data)
         with self._lock:
             self._index = self.fsm.state.latest_index()
+            # a restore replaces the world under any prepared write —
+            # the single-node analog of losing leadership mid-batch
+            self._fence += 1

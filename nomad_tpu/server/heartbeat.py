@@ -4,6 +4,25 @@ Each client heartbeat resets its TTL timer; a missed TTL marks the node
 down and creates one evaluation per job with allocations on it
 (ref nomad/node_endpoint.go:1358 createNodeEvals) so the schedulers replace
 the lost work — tier 2 of the failure-detection story (SURVEY.md §5).
+
+Failover semantics (ISSUE 6 satellite): a freshly-elected leader calls
+`initialize_heartbeat_timers(grace=...)` as a recovery-barrier step —
+every live node in replicated state gets a FRESH deadline of
+ttl + grace. That fixes two failure shapes at once:
+
+  * a server that loses and later REGAINS leadership still holds the
+    deadlines of its previous reign; without re-arming, its first sweep
+    would instantly mark every node down (their TTLs "expired" while it
+    was a follower, though the nodes were heartbeating the interim
+    leader perfectly well) and flood the cluster with replacement evals;
+  * a node whose heartbeat was in flight to the OLD leader during the
+    election gets the grace window to find the new leader before its
+    work is rescheduled — while a node that truly died during failover
+    IS detected once ttl + grace elapses (a new leader that never
+    initialized timers would wait forever).
+
+All deadline arithmetic reads an injectable chrono.Clock, so the grace
+behavior is unit-tested with a ManualClock instead of wall-time sleeps.
 """
 from __future__ import annotations
 
@@ -12,8 +31,8 @@ import threading
 import time
 from typing import Optional
 
-from .. import faults
-from ..metrics import record_swallowed_error
+from .. import chrono, faults
+from ..metrics import metrics, record_swallowed_error
 from ..structs import (
     Evaluation, NODE_STATUS_DOWN, TRIGGER_NODE_UPDATE, JOB_TYPE_SYSTEM,
 )
@@ -25,14 +44,23 @@ DEFAULT_CHECK_INTERVAL = 1.0
 # a failed invalidate re-arms the node's deadline this far out, so the
 # next sweep retries instead of forgetting the node forever (ISSUE 3)
 INVALIDATE_RETRY_BACKOFF_S = 2.0
+# post-election grace added on top of the TTL when the new leader
+# re-arms node timers (ref nomad/heartbeat.go initializeHeartbeatTimers,
+# which grants max(ttl, failover grace)); covers the election window plus
+# one client retry round
+DEFAULT_FAILOVER_GRACE_S = 10.0
 
 
 class HeartbeatTimers:
     def __init__(self, server, min_ttl: float = DEFAULT_MIN_TTL,
-                 ttl_spread: float = DEFAULT_TTL_SPREAD):
+                 ttl_spread: float = DEFAULT_TTL_SPREAD,
+                 failover_grace: float = DEFAULT_FAILOVER_GRACE_S,
+                 clock: Optional[chrono.Clock] = None):
         self.server = server
         self.min_ttl = min_ttl
         self.ttl_spread = ttl_spread
+        self.failover_grace = failover_grace
+        self.clock = clock or chrono.REAL
         self._lock = threading.Lock()
         self._deadlines: dict[str, float] = {}
         self._stop = threading.Event()
@@ -51,21 +79,45 @@ class HeartbeatTimers:
             self._thread.join(timeout=5.0)
             self._thread = None
 
+    def _ttl(self) -> float:
+        return self.min_ttl + random.random() * self.ttl_spread
+
     def reset_heartbeat_timer(self, node_id: str) -> float:
         """Returns the TTL the client should heartbeat within
         (ref heartbeat.go:56 resetHeartbeatTimer)."""
-        ttl = self.min_ttl + random.random() * self.ttl_spread
+        ttl = self._ttl()
         with self._lock:
-            self._deadlines[node_id] = time.time() + ttl
+            self._deadlines[node_id] = self.clock.time() + ttl
         return ttl
 
     def clear_heartbeat_timer(self, node_id: str) -> None:
         with self._lock:
             self._deadlines.pop(node_id, None)
 
+    def initialize_heartbeat_timers(self, grace: Optional[float] = None
+                                    ) -> int:
+        """Recovery-barrier step (ref heartbeat.go:40
+        initializeHeartbeatTimers): re-arm EVERY live node's TTL at
+        ttl + grace, replacing whatever deadlines survived a previous
+        reign. Returns the number of nodes armed. Leader-only by
+        construction (only _establish_leadership calls it)."""
+        faults.fire("heartbeat.initialize")
+        grace = self.failover_grace if grace is None else grace
+        now = self.clock.time()
+        armed = 0
+        with self._lock:
+            self._deadlines.clear()
+            for node in self.server.state.iter_nodes():
+                if node.terminal_status():
+                    continue
+                self._deadlines[node.id] = now + self._ttl() + grace
+                armed += 1
+        metrics.set_gauge("nomad.heartbeat.initialized", armed)
+        return armed
+
     def _run(self) -> None:
         while not self._stop.is_set():
-            self._sweep(time.time())
+            self._sweep(self.clock.time())
             self._stop.wait(DEFAULT_CHECK_INTERVAL)
 
     def _sweep(self, now: float) -> None:
@@ -89,7 +141,7 @@ class HeartbeatTimers:
                 with self._lock:
                     if self._deadlines.get(node_id) == observed:
                         self._deadlines[node_id] = \
-                            time.time() + INVALIDATE_RETRY_BACKOFF_S
+                            self.clock.time() + INVALIDATE_RETRY_BACKOFF_S
             else:
                 with self._lock:
                     if self._deadlines.get(node_id) == observed:
@@ -103,6 +155,7 @@ class HeartbeatTimers:
         node = server.state.node_by_id(node_id)
         if node is None or node.terminal_status():
             return
+        metrics.incr("nomad.heartbeat.invalidate")
         server.raft.apply(NODE_UPDATE_STATUS, {
             "node_id": node_id, "status": NODE_STATUS_DOWN,
             "updated_at": time.time()})

@@ -54,6 +54,7 @@ import numpy as np
 
 from .. import faults
 from ..metrics import metrics
+from ..rpc.codec import NotLeaderError
 from ..state import StateStore
 from ..structs import (
     Allocation, NetworkIndex, Plan, PlanResult, allocs_fit,
@@ -63,6 +64,26 @@ from .fsm import (
 )
 
 _FIT_EPS = 1e-3
+
+# the distinct disposition a pending plan gets when the applier loses
+# leadership under it (step-down, fence rejection, revoke): workers see
+# it instead of a generic failure, and `nomad.plan.leadership_lost`
+# counts every occurrence (ISSUE 6 satellite)
+LEADERSHIP_LOST = "leadership lost"
+
+# _fence_token sentinel: "fencing is supported and we are NOT leader"
+# (None means "no fencing on this log at all")
+_NOT_LEADER = object()
+
+
+class LeadershipLostPlanError(RuntimeError):
+    """A plan (or whole drained batch) could not commit because this
+    server stopped being the leader. NotLeaderError/FencedWriteError
+    from the log, or the revoke path failing pendings, all collapse to
+    this one worker-visible disposition."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(LEADERSHIP_LOST + (f": {detail}" if detail else ""))
 
 
 class _PendingPlan:
@@ -95,14 +116,31 @@ class PlanQueue:
         self._seq = itertools.count()
         self._enabled = False
 
-    def set_enabled(self, enabled: bool) -> None:
+    def set_enabled(self, enabled: bool,
+                    reason: str = "plan queue disabled") -> int:
+        """Returns the number of pendings failed by a disable (0 when
+        enabling) — the caller's metric source, exact under the lock."""
+        failed = 0
         with self._lock:
             self._enabled = enabled
             if not enabled:
                 for _, _, pending in self._heap:
-                    pending.respond(None, "plan queue disabled")
+                    pending.respond(None, reason)
+                    failed += 1
                 self._heap = []
             self._cond.notify_all()
+        return failed
+
+    def drain_stale(self, reason: str) -> int:
+        """Fail every queued pending WITHOUT toggling enablement — the
+        new leader's recovery barrier empties anything that survived the
+        previous leadership before scheduling resumes (ISSUE 6)."""
+        with self._lock:
+            stale = [pending for _, _, pending in self._heap]
+            self._heap = []
+            for pending in stale:
+                pending.respond(None, reason)
+            return len(stale)
 
     def enqueue(self, plan: Plan) -> _PendingPlan:
         pending = _PendingPlan(plan)
@@ -346,18 +384,35 @@ class Planner:
                                         name="plan-applier")
         self._thread.start()
 
-    def stop(self, timeout: float = 5.0) -> None:
+    def stop(self, timeout: float = 5.0,
+             reason: str = "planner stopped") -> None:
+        """`reason` becomes every failed pending's disposition. The
+        revoke-leadership path passes LEADERSHIP_LOST so workers (and
+        `nomad.plan.leadership_lost`) can tell a step-down from a crash
+        (ISSUE 6 satellite)."""
+        lost = reason.startswith(LEADERSHIP_LOST)
         self._stop.set()
-        self.queue.set_enabled(False)      # queued pendings fail here
+        n_queued = self.queue.set_enabled(False, reason=reason)
         if self._thread:
-            self._thread.join(timeout=timeout)
+            try:
+                self._thread.join(timeout=timeout)
+            except RuntimeError:
+                # start() raced us between Thread() and .start() (a
+                # shutdown landing mid-establish): the daemon thread
+                # sees _stop set on its first drain and exits
+                pass
         # a batch mid-apply when the join gave up (or the thread died)
         # must still resolve — waiters see an error, not a hang. respond
         # after a late applier respond is a harmless overwrite: every
-        # waiter already woke on the first event.set().
+        # waiter already woke on the first event.set(). These are NOT
+        # counted toward nomad.plan.leadership_lost: the applier's own
+        # commit-error path owns that count for drained plans, and a
+        # late-resolving applier would double-count them here.
         for pending in self._inflight:
             if not pending.event.is_set():
-                pending.respond(None, "planner stopped")
+                pending.respond(None, reason)
+        if lost and n_queued:
+            metrics.incr("nomad.plan.leadership_lost", n_queued)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -370,7 +425,18 @@ class Planner:
                 continue
             self._inflight = batch
             try:
-                outcomes = self.apply_plan_batch([p.plan for p in batch])
+                # the batch's fence: captured ONCE at drain, checked
+                # atomically at the raft append — a step-down anywhere in
+                # the evaluate window rejects the whole entry instead of
+                # racing the new leader's commits (docs/FAILOVER.md)
+                fence = self._fence_token()
+                if fence is _NOT_LEADER:
+                    for pending in batch:
+                        pending.respond(None, LEADERSHIP_LOST)
+                    metrics.incr("nomad.plan.leadership_lost", len(batch))
+                    continue
+                outcomes = self.apply_plan_batch([p.plan for p in batch],
+                                                 fence=fence)
                 for pending, (result, err) in zip(batch, outcomes):
                     pending.respond(result,
                                     str(err) if err is not None else None)
@@ -380,6 +446,16 @@ class Planner:
                         pending.respond(None, str(e))
             finally:
                 self._inflight = []
+
+    def _fence_token(self):
+        """The raft fence for one drained batch: None on logs without
+        fencing (plain test fakes), the sentinel when this server is not
+        currently the leader (drain raced a revoke)."""
+        fence_fn = getattr(self.raft, "fence_token", None)
+        if fence_fn is None:
+            return None
+        fence = fence_fn()
+        return _NOT_LEADER if fence is None else fence
 
     # ------------------------------------------------------------ evaluate
 
@@ -392,12 +468,16 @@ class Planner:
             raise err
         return result
 
-    def apply_plan_batch(self, plans: list[Plan]
+    def apply_plan_batch(self, plans: list[Plan], fence=None
                          ) -> list[tuple[Optional[PlanResult],
                                          Optional[BaseException]]]:
         """Evaluate + commit a drained batch. Returns (result, error)
         aligned with `plans`; raises only on batch-wide pre-evaluation
-        failures (the shared snapshot fetch)."""
+        failures (the shared snapshot fetch). `fence` (the drain-time
+        fence_token) makes the raft commit atomic with the leadership
+        check — a deposed applier's batch is rejected whole, reported as
+        LEADERSHIP_LOST per plan, and never lands after the new leader's
+        commits."""
         deadline = time.monotonic() + self._commit_budget()
         # ONE SnapshotMinIndex fetch shared by every plan of the batch
         # (each plan used to snapshot independently); the store memoizes
@@ -450,11 +530,11 @@ class Planner:
                     if len(reqs) == 1:
                         index = self.raft.apply(
                             APPLY_PLAN_RESULTS, {"result": reqs[0]},
-                            timeout=remaining)
+                            timeout=remaining, fence=fence)
                     else:
                         index = self.raft.apply(
                             APPLY_PLAN_RESULTS_BATCH, {"results": reqs},
-                            timeout=remaining)
+                            timeout=remaining, fence=fence)
                         metrics.incr("nomad.plan.coalesced_commits")
                         metrics.incr("nomad.plan.coalesced_plans",
                                      len(reqs))
@@ -463,6 +543,13 @@ class Planner:
             except TimeoutError as e:
                 metrics.incr("nomad.plan.commit_timeout", len(reqs))
                 commit_err = e
+            except NotLeaderError as e:
+                # FencedWriteError (entry never appended) and
+                # LeadershipLostError (appended, outcome unknown) both
+                # surface as the distinct leadership-lost disposition:
+                # either way THIS applier must not claim the commit
+                metrics.incr("nomad.plan.leadership_lost", len(reqs))
+                commit_err = LeadershipLostPlanError(str(e))
             except Exception as e:   # noqa: BLE001 — per-plan surfaced
                 commit_err = e
             if commit_err is None:
@@ -844,6 +931,10 @@ class Planner:
 
     def submit_plan(self, plan: Plan,
                     timeout: float = 10.0) -> Optional[PlanResult]:
+        # the queue's enabled flag IS the fence here: a non-leader's
+        # queue is disabled and fails the pending immediately; the
+        # commit itself is fence-checked in _run
+        # nomadlint: disable=LEAD001 — queue-gated (see comment above)
         pending = self.queue.enqueue(plan)
         result, err = pending.wait(timeout)
         if err:
@@ -858,4 +949,5 @@ class Planner:
         after it. Chunk plans enqueued back-to-back coalesce into one
         commit batch (ordering preserved: drain is priority+FIFO)."""
         metrics.incr("nomad.plan.queue_depth_async")
+        # nomadlint: disable=LEAD001 — queue-gated like submit_plan
         return self.queue.enqueue(plan)

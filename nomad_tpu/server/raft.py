@@ -28,8 +28,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import chrono
 from ..metrics import metrics
-from ..rpc.codec import LeadershipLostError, NotLeaderError
+from ..rpc.codec import FencedWriteError, LeadershipLostError, NotLeaderError
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -58,9 +59,21 @@ class RaftNode:
                  election_timeout: tuple[float, float] = (0.4, 0.8),
                  heartbeat_interval: float = 0.1,
                  snapshot_threshold: int = 8192,
-                 bootstrap: bool = True):
+                 bootstrap: bool = True,
+                 clock: Optional[chrono.Clock] = None,
+                 seed: Optional[int] = None):
         self.fsm = fsm
         self.node_id = node_id
+        # every timing DECISION (election deadlines, contact ages) reads
+        # this clock; a chrono.ManualClock makes elections fire exactly
+        # when a test advances time (ISSUE 6). Thread poll cadences stay
+        # real — see chrono.py.
+        self.clock = clock or chrono.REAL
+        # election jitter from a private RNG: with an explicit seed the
+        # campaign ORDER of a cluster is reproducible run to run (the
+        # deterministic multi-server tests seed s0 < s1 < s2)
+        self._rng = random.Random(seed) if seed is not None \
+            else random.Random()
         # bootstrap=False: an expansion server (gossip auto-join, ref
         # bootstrap_expect) — it must NOT self-elect while its config is
         # the trivial {self}; it waits to be adopted by a leader's
@@ -104,12 +117,12 @@ class RaftNode:
         self.last_applied = 0
         self.leader_id: Optional[str] = None
         self.leader_addr = ""
-        self._last_contact = time.monotonic()
+        self._last_contact = self.clock.monotonic()
         self._votes = 0
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._last_ok: dict[str, float] = {}   # peer -> last successful repl
-        now = time.monotonic()
+        now = self.clock.monotonic()
         self._peer_added_at: dict[str, float] = {p: now for p in peers}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -308,7 +321,20 @@ class RaftNode:
     def _voters(self) -> list[str]:
         return [pid for pid in self.peers if pid not in self.nonvoters]
 
-    def apply(self, msg_type: str, payload, timeout: float = 30.0):
+    def fence_token(self) -> Optional[int]:
+        """The leadership fence (ISSUE 6): the current term while this
+        node is leader, else None. A caller that captured the token
+        before a side-effect-free preparation phase (the plan applier's
+        batch evaluation) passes it back to `apply(fence=...)` — the
+        write is rejected ATOMICALLY, before the entry is appended, if
+        leadership was lost (or lost and re-won at a higher term, i.e.
+        state may have changed under an interim leader) in between.
+        Contract: docs/FAILOVER.md."""
+        with self._lock:
+            return self.current_term if self.state == LEADER else None
+
+    def apply(self, msg_type: str, payload, timeout: float = 30.0,
+              fence: Optional[int] = None):
         """Commit one message through the replicated log. Leader-only;
         raises NotLeaderError with a redirect hint on followers.
 
@@ -317,13 +343,27 @@ class RaftNode:
         remainder of its per-batch budget, so a batch of N plans riding
         one entry never waits N x 30s (docs/COMMIT_COALESCING.md). A
         timeout is counted (`nomad.raft.apply_timeout`) — the plan
-        applier layers its per-plan `nomad.plan.commit_timeout` on top."""
+        applier layers its per-plan `nomad.plan.commit_timeout` on top.
+
+        `fence` (a fence_token() value) rejects the write atomically —
+        FencedWriteError, entry NOT appended, commit provably impossible
+        — when the term has moved since the token was captured."""
         from .. import faults
         faults.fire("raft.apply")
+        faults.fire(f"raft.apply.{self.node_id}")
         t_enter = time.monotonic()
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_addr)
+            if fence is not None and fence != self.current_term:
+                # deposed (and possibly re-elected at a higher term)
+                # since the caller captured its token: the caller's
+                # prepared write raced another leader's commits. Checked
+                # under the SAME lock that serializes step-down, so the
+                # rejection is atomic with the append decision.
+                metrics.incr("nomad.raft.fence_rejected")
+                raise FencedWriteError(self.current_term, fence,
+                                       self.leader_addr)
             entry = _Entry(self.current_term, msg_type, payload)
             self.log.append(entry)
             index = self._last_index()
@@ -478,7 +518,7 @@ class RaftNode:
         self.peers[pid] = addr
         if not voter:
             self.nonvoters.add(pid)
-        self._peer_added_at[pid] = time.monotonic()
+        self._peer_added_at[pid] = self.clock.monotonic()
         self._persist_meta()
         if self.state == LEADER:
             self._next_index[pid] = self._last_index() + 1
@@ -495,7 +535,7 @@ class RaftNode:
     def server_health(self) -> list[dict]:
         """Per-peer replication health (operator autopilot health analog)."""
         with self._lock:
-            now = time.monotonic()
+            now = self.clock.monotonic()
             is_leader = self.state == LEADER
             out = []
             for pid, addr in sorted(self.peers.items()):
@@ -573,24 +613,27 @@ class RaftNode:
 
     def _election_deadline(self) -> float:
         lo, hi = self.election_timeout
-        return time.monotonic() + random.uniform(lo, hi)
+        return self.clock.monotonic() + self._rng.uniform(lo, hi)
 
     def _run_elections(self) -> None:
         deadline = self._election_deadline()
         while not self._stop.is_set():
+            # REAL poll cadence by design: under a ManualClock the loop
+            # keeps spinning but deadlines only expire when the test
+            # advances virtual time (chrono.py)
             time.sleep(0.02)
             with self._lock:
                 if self.state == LEADER:
                     deadline = self._election_deadline()
                     continue
-                if time.monotonic() < deadline:
+                if self.clock.monotonic() < deadline:
                     continue
                 # recent leader contact pushes the deadline instead of
                 # triggering an election
                 lo, _hi = self.election_timeout
-                if time.monotonic() - self._last_contact < lo:
+                if self.clock.monotonic() - self._last_contact < lo:
                     deadline = self._last_contact + \
-                        random.uniform(*self.election_timeout)
+                        self._rng.uniform(*self.election_timeout)
                     continue
                 # a non-bootstrap server with only itself in config is
                 # waiting for adoption, not for votes; a non-voter never
@@ -624,10 +667,8 @@ class RaftNode:
                     args=(pid, addr, term, last_idx, last_term)).start()
 
     def _request_vote_from(self, pid, addr, term, last_idx, last_term):
-        from ..rpc.client import RpcClient
         try:
-            with RpcClient([addr], key=self.rpc_server.key,
-                           timeout=1.0, tls=self.rpc_server.tls) as cli:
+            with self.rpc_server.client_for(addr, timeout=1.0) as cli:
                 resp = cli.call("Raft.RequestVote", term, self.node_id,
                                 last_idx, last_term)
         except Exception:    # noqa: BLE001
@@ -661,7 +702,7 @@ class RaftNode:
             # baseline contact at election: a fresh leader must not report
             # never-contacted-yet peers as long-dead (autopilot would reap
             # a briefly-slow follower right after failover)
-            now = time.monotonic()
+            now = self.clock.monotonic()
             self._last_ok = {pid: now for pid in self.peers}
             # commit a no-op entry to finalize commitment of prior terms
             # (Raft §8: a leader may only count replicas of current-term
@@ -714,12 +755,10 @@ class RaftNode:
     # --------------------------------------------------------- replication
 
     def _replicate_loop(self, pid: str, term: int) -> None:
-        from ..rpc.client import RpcClient
         addr = self.peers.get(pid)
         if addr is None:
             return
-        cli = RpcClient([addr], key=self.rpc_server.key, timeout=2.0,
-                        tls=self.rpc_server.tls)
+        cli = self.rpc_server.client_for(addr, timeout=2.0)
         ev = self._replicate_events[pid]
         fails = 0
         try:
@@ -780,7 +819,7 @@ class RaftNode:
                     return
                 self._next_index[pid] = snap["index"] + 1
                 self._match_index[pid] = snap["index"]
-                self._last_ok[pid] = time.monotonic()
+                self._last_ok[pid] = self.clock.monotonic()
             return
         resp = cli.call("Raft.AppendEntries", term, self.node_id, self.addr,
                         prev_idx, prev_term, entries, commit)
@@ -792,7 +831,7 @@ class RaftNode:
                 return
             if resp["success"]:
                 match = prev_idx + len(entries)
-                self._last_ok[pid] = time.monotonic()
+                self._last_ok[pid] = self.clock.monotonic()
                 self._match_index[pid] = max(self._match_index.get(pid, 0),
                                              match)
                 self._next_index[pid] = self._match_index[pid] + 1
@@ -897,7 +936,7 @@ class RaftNode:
                     granted = True
                     self.voted_for = candidate_id
                     self._persist_meta()
-                    self._last_contact = time.monotonic()
+                    self._last_contact = self.clock.monotonic()
                     # the old leader is presumed dead: stop advertising it
                     # for forwarding until the new leader heartbeats us
                     self.leader_id = None
@@ -917,7 +956,7 @@ class RaftNode:
                 self._step_down_locked(term)
             self.leader_id = leader_id
             self.leader_addr = leader_addr
-            self._last_contact = time.monotonic()
+            self._last_contact = self.clock.monotonic()
 
             if prev_idx > self._last_index() or \
                     (prev_idx >= self.base_index and
@@ -966,7 +1005,7 @@ class RaftNode:
                 self._step_down_locked(term)
             self.leader_id = leader_id
             self.leader_addr = leader_addr
-            self._last_contact = time.monotonic()
+            self._last_contact = self.clock.monotonic()
             if snap["index"] <= self.base_index:
                 return {"term": self.current_term}
             self.fsm.restore_bytes(snap["data"])

@@ -13,6 +13,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import faults
+from ..metrics import metrics, record_swallowed_error
+from ..rpc.codec import NotLeaderError
 from ..state import StateStore
 from ..structs import (
     Allocation, DrainStrategy, Evaluation, Job, Node, SchedulerConfiguration,
@@ -38,8 +41,30 @@ from .fsm import (
 )
 from .heartbeat import HeartbeatTimers, create_node_evals
 from .periodic import PeriodicDispatch
-from .plan_apply import Planner
+from .plan_apply import LEADERSHIP_LOST, Planner
 from .worker import Worker
+
+def _warmup_floor() -> int:
+    """The node-count floor below which establish-time device work (AOT
+    warmup, tensor reseed, standby twin feed) is skipped. Reads the
+    solver's authoritative backend.WARMUP_MIN_NODES when that module is
+    already loaded — WITHOUT importing it (the gates run before deciding
+    whether jax should be touched at all) — else the same default."""
+    import sys
+    backend = sys.modules.get("nomad_tpu.solver.backend")
+    return getattr(backend, "WARMUP_MIN_NODES", 256)
+
+
+def _device_work_gate(env_var: str, node_count: int) -> bool:
+    """ONE predicate for every establish/standby device-work gate
+    (backend.warmup applies the same semantics to NOMAD_AOT_WARMUP):
+    env "0" disables, "1" forces below the floor, default floor-gates."""
+    import os
+    mode = os.environ.get(env_var, "")
+    if mode == "0":
+        return False
+    return mode == "1" or node_count >= _warmup_floor()
+
 
 # workers do NOT consume "_failed": the leader reaps the dead-letter queue
 # (ref nomad/leader.go:782 reapFailedEvaluations)
@@ -177,6 +202,19 @@ class Server:
         self._leader_stop = threading.Event()
         self._leader_thread: Optional[threading.Thread] = None
         self.is_leader = False
+        self._shutdown_ev = threading.Event()
+        # recovery-barrier per-step timings of the most recent successful
+        # _establish_leadership (ISSUE 6; the bench failover probe reads
+        # these for failover_detail), and the raft term that
+        # establishment ran for — a re-election at a NEWER term must
+        # re-run the barrier even when the old reign's revoke callback
+        # lost the thread race (is_leader still True)
+        self._establish_timings: dict[str, float] = {}
+        self._established_term = -1
+        # serializes _establish_leadership: the election callback and the
+        # deferred establish-retry thread must never run the barrier (and
+        # double-start every leader subsystem) concurrently
+        self._establish_lock = threading.Lock()
         # network RPC (optional; wired by rpc_listen). leader_rpc_addr is
         # maintained by the consensus layer for follower->leader forwarding.
         self.rpc_server = None
@@ -193,12 +231,16 @@ class Server:
 
         # the FSM tells the leader about new evals (ref fsm.go:760)
         self.fsm.on_eval_update.append(self._on_eval_update)
+        # followers advance the passive solver tensor twin as replicated
+        # plan results land (ISSUE 6 warm standby)
+        self.fsm.on_plan_apply.append(self._feed_standby_twin)
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
         import os
 
+        self._shutdown_ev.clear()
         from ..runtime import enable_compile_cache, tune_gc
         tune_gc()          # allocation-heavy plans vs default GC cadence
         if os.environ.get("NOMAD_COMPILE_CACHE"):
@@ -210,6 +252,11 @@ class Server:
             self._establish_leadership()
         else:
             self.raft_node.start()
+            # warm standby (ISSUE 6): a follower pre-warms the AOT
+            # compile grid in the background so a later promotion pays
+            # ~0 compile instead of a cold-XLA placement blackout
+            threading.Thread(target=self._standby_warmup_loop, daemon=True,
+                             name="standby-warmup").start()
         for w in self.workers:
             w.start()
 
@@ -253,6 +300,22 @@ class Server:
         self.rpc_server = RpcServer(bind=bind, port=port,
                                     key=key or DEFAULT_KEY,
                                     logger=self.logger, tls=tls)
+        self.rpc_server.register_endpoints(self, RPC_ENDPOINTS)
+        self.rpc_server.leadership_fn = \
+            lambda: (self.is_leader, self.leader_rpc_addr)
+        self.rpc_server.start()
+        return self.rpc_server.addr
+
+    def rpc_listen_virtual(self, network, name: str,
+                           key: bytes = None) -> str:
+        """Attach this server to an in-memory `rpc.virtual.VirtualNetwork`
+        instead of a TCP listener — the deterministic multi-server test
+        transport (ISSUE 6). Interface-identical to rpc_listen():
+        enable_raft()/forwarding ride on top unchanged, and the network's
+        partition/drop/delay/crash controls apply to every hop."""
+        from ..rpc.server import DEFAULT_KEY
+        self.rpc_server = network.server(name, key=key or DEFAULT_KEY,
+                                         logger=self.logger)
         self.rpc_server.register_endpoints(self, RPC_ENDPOINTS)
         self.rpc_server.leadership_fn = \
             lambda: (self.is_leader, self.leader_rpc_addr)
@@ -511,6 +574,7 @@ class Server:
                 self.logger(f"server: acl replication apply failed: {e}")
 
     def shutdown(self) -> None:
+        self._shutdown_ev.set()
         if self.gossip is not None:
             # broadcast LEFT and close the UDP socket — a shut-down
             # server must not keep acking probes and looking alive
@@ -537,9 +601,27 @@ class Server:
 
     def _revoke_leadership(self) -> None:
         """ref nomad/leader.go revokeLeadership: disable every leader-only
-        subsystem; scheduling resumes wherever the new leader is."""
+        subsystem; scheduling resumes wherever the new leader is. Pendings
+        failed here carry the distinct leadership-lost disposition
+        (counted in `nomad.plan.leadership_lost`, ISSUE 6 satellite)."""
+        with self._establish_lock:
+            self._revoke_leadership_locked()
+
+    def _revoke_leadership_locked(self) -> None:
         if not self.is_leader:
             return
+        if self._still_leader() and self.raft_node is not None and \
+                self.raft_node.current_term == self._established_term:
+            # stale revoke: the deposal this callback reports has already
+            # been superseded by a re-election whose establishment RAN
+            # (the term matches what the barrier last established;
+            # callback threads are unordered). Tearing down now would
+            # leave a live leader with every subsystem disabled.
+            self.logger("server: ignoring stale leadership revoke")
+            return
+        self._teardown_leadership_locked(LEADERSHIP_LOST)
+
+    def _teardown_leadership_locked(self, reason: str) -> None:
         self.is_leader = False
         self._leader_stop.set()
         # join before a re-election can clear the stop event, else the old
@@ -547,55 +629,153 @@ class Server:
         if self._leader_thread is not None:
             self._leader_thread.join(timeout=5.0)
             self._leader_thread = None
+        self._disable_leader_subsystems(reason=reason)
+
+    def _disable_leader_subsystems(self, reason: str) -> None:
+        """Shared by revoke and by a recovery-barrier unwind: every
+        leader-only subsystem back to the follower state."""
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
-        self.planner.stop()
+        self.planner.stop(reason=reason)
         self.periodic.set_enabled(False)
         self.heartbeats.stop()
         self.deployment_watcher.stop()
         self.drainer.stop()
         self.volume_watcher.stop()
 
+    def _still_leader(self) -> bool:
+        """Is the CONSENSUS layer still calling us leader (independent of
+        whether establishment finished)? A shutdown aborts establishment
+        the same way a lost election does."""
+        if self._shutdown_ev.is_set():
+            return False
+        return self.raft_node is None or self.raft_node.is_leader()
+
+    # ----------------------------------------- post-election recovery barrier
+
+    # ordered recovery-barrier steps (ISSUE 6; docs/FAILOVER.md). Each is
+    # fault-injectable at `leader.establish.<name>` and metered as
+    # `nomad.leader.establish.<name>`:
+    #   barrier        raft Barrier: FSM reflects every prior-term commit
+    #   plan_queue     fail stale plan pendings; start the serial applier
+    #   state_cache    reseed/advance the device-resident tensor twins
+    #                  (warm when the standby feed tracked this store)
+    #   heartbeats     re-arm EVERY node TTL with the failover grace
+    #                  window, then start the reaper
+    #   watchers       periodic dispatch, deployment/drain/volume watchers
+    #   broker_restore re-enqueue pending evals + re-track periodic jobs
+    #                  from replicated state (runs after is_leader flips:
+    #                  concurrent commits dedup through the broker)
+
     def _establish_leadership(self) -> None:
-        """ref nomad/leader.go:224"""
+        """ref nomad/leader.go:224, hardened into an ordered, metered,
+        fault-injectable recovery barrier (ISSUE 6). Establish and
+        revoke serialize on one lock, so the election callback, the
+        deferred retry thread, and a racing revoke can never interleave
+        subsystem starts/stops; a second establish is an idempotent
+        no-op (`is_leader` already set), and a stale revoke is detected
+        inside (`_still_leader`)."""
+        with self._establish_lock:
+            self._establish_leadership_locked()
+
+    def _establish_leadership_locked(self) -> None:
+        term = self.raft_node.current_term \
+            if self.raft_node is not None else 0
         if self.is_leader:
-            return
-        # Barrier FIRST (ref leader.go:236 raft.Barrier): the restore
-        # below reads the FSM, which must reflect every entry committed
-        # under previous terms — otherwise a just-elected leader can
-        # re-enqueue an already-planned eval and double-place it. A slow
-        # apply (big replay) RETRIES rather than returning: bailing out
-        # would leave a live raft leader with every leader subsystem
-        # permanently disabled. Only losing leadership ends the wait.
+            if term == self._established_term:
+                return          # idempotent re-entry, same reign
+            # re-elected at a NEWER term while the old reign's subsystems
+            # are still up (the deposal's revoke callback lost the thread
+            # race to this election callback): tear down first so the new
+            # term runs the FULL barrier — skipping it would skip the FSM
+            # catch-up of an interim leader's commits and the heartbeat
+            # re-arm, the two failure shapes the barrier exists for
+            self.logger(f"server: re-elected at term {term} before the "
+                        f"term-{self._established_term} revoke ran; "
+                        f"re-running the recovery barrier")
+            self._teardown_leadership_locked(LEADERSHIP_LOST)
+        t_enter = time.perf_counter()
+        timings: dict[str, float] = {}
+        # Barrier FIRST (ref leader.go:236 raft.Barrier): everything below
+        # reads the FSM, which must reflect every entry committed under
+        # previous terms — otherwise a just-elected leader can re-enqueue
+        # an already-planned eval and double-place it. A slow apply (big
+        # replay) RETRIES rather than returning: bailing out would leave a
+        # live raft leader with every leader subsystem permanently
+        # disabled. Only losing leadership ends the wait.
+        t0 = time.perf_counter()
         wait_barrier = getattr(self.raft, "wait_barrier", None)
         while wait_barrier is not None:
+            if not self._still_leader():
+                self.logger("server: leadership lost during barrier")
+                return
             try:
+                faults.fire("leader.establish.barrier")
                 wait_barrier(timeout=30.0)
                 break
             except TimeoutError as e:
                 self.logger(f"server: leadership barrier slow, "
                             f"retrying: {e!r}")
-            except Exception as e:      # noqa: BLE001 — lost lead mid-wait
+            except NotLeaderError as e:     # lost lead mid-wait: done
                 self.logger(f"server: leadership barrier failed: {e!r}")
                 return
-        self.eval_broker.set_enabled(True)
-        self.blocked_evals.set_enabled(True)
-        self.planner.start()
-        self.periodic.set_enabled(True)
-        self.heartbeats.start()
-        self.deployment_watcher.start()
-        self.drainer.start()
-        self.volume_watcher.start()
-        self.is_leader = True
-        # restore: re-enqueue non-terminal evals, re-track periodic jobs
-        for ev in self.state.iter_evals():
-            if ev.status == EVAL_STATUS_PENDING:
-                self.eval_broker.enqueue(ev)
-            elif ev.should_block():
-                self.blocked_evals.block(ev)
-        for job in self.state.iter_jobs():
-            if job.is_periodic() and not job.stopped():
-                self.periodic.add(job)
+            except Exception as e:      # noqa: BLE001 — transient (incl.
+                # injected barrier faults): retry while still leader —
+                # returning here would leave a live raft leader with
+                # every leader subsystem permanently disabled
+                self.logger(f"server: leadership barrier error, "
+                            f"retrying: {e!r}")
+                time.sleep(0.05)
+        timings["barrier"] = time.perf_counter() - t0
+        metrics.add_sample("nomad.leader.establish.barrier",
+                           timings["barrier"])
+
+        ok = (self._establish_step("plan_queue", self._step_plan_queue,
+                                   timings)
+              and self._establish_step("state_cache", self._step_state_cache,
+                                       timings)
+              and self._establish_step("heartbeats", self._step_heartbeats,
+                                       timings)
+              and self._establish_step("watchers", self._step_watchers,
+                                       timings))
+        if ok:
+            # the flip happens BEFORE broker_restore: evals committed while
+            # the restore iterates reach the broker via _on_eval_update,
+            # evals committed before it are found in state, and the overlap
+            # dedups on eval id / job key inside the broker
+            self.is_leader = True
+            ok = self._establish_step("broker_restore",
+                                      self._step_broker_restore, timings)
+        if not ok:
+            # leadership lost mid-barrier or a step exhausted its retries:
+            # unwind to the follower state — a half-established leader
+            # must not run — and, if consensus still names us leader,
+            # retry the WHOLE barrier shortly (steps are idempotent)
+            self.is_leader = False
+            self._disable_leader_subsystems(reason=LEADERSHIP_LOST)
+            if self._still_leader():
+                metrics.incr("nomad.leader.establish_retry")
+                threading.Thread(target=self._reestablish_later,
+                                 daemon=True,
+                                 name="establish-retry").start()
+            return
+        if not self._still_leader() or not self.is_leader:
+            # a revoke raced the tail of the barrier (is_leader may
+            # already be False): leave everything in the follower state
+            # instead of starting a leader loop for a non-leader
+            self.is_leader = False
+            self._disable_leader_subsystems(reason=LEADERSHIP_LOST)
+            return
+        total = time.perf_counter() - t_enter
+        timings["total"] = total
+        self._establish_timings = timings
+        # record the reign as of COMPLETION: if the term moved mid-barrier
+        # (we lost and re-won), the queued establish callback for the new
+        # term sees the mismatch and re-runs the barrier
+        self._established_term = self.raft_node.current_term \
+            if self.raft_node is not None else 0
+        metrics.add_sample("nomad.leader.establish_s", total)
+        metrics.set_gauge("nomad.leader.failover_s", total)
         self._leader_stop.clear()
         self._leader_thread = threading.Thread(
             target=self._leader_loop, daemon=True, name="leader-loop")
@@ -604,7 +784,9 @@ class Server:
         # cluster size in the background (ISSUE 4): a freshly-promoted
         # leader should not pay cold XLA compiles as placement blackout
         # on its first real eval. Below backend.WARMUP_MIN_NODES this is
-        # a no-op (unit-test servers must not compile the world).
+        # a no-op (unit-test servers must not compile the world). A
+        # warm-standby follower already compiled the grid — warmup then
+        # costs one cache probe.
         threading.Thread(target=self._solver_warmup, daemon=True,
                          name="solver-warmup").start()
         # non-authoritative region leaders mirror ACL state from the
@@ -613,6 +795,148 @@ class Server:
         if self.region != self.authoritative_region:
             threading.Thread(target=self._acl_replication_loop, daemon=True,
                              name="acl-replication").start()
+
+    def _establish_step(self, name: str, fn: Callable,
+                        timings: dict) -> bool:
+        """One barrier step: fault site, bounded retries, per-step timing.
+        False aborts establishment (leadership gone or retries spent)."""
+        for attempt in range(5):
+            if not self._still_leader():
+                self.logger(f"server: leadership lost during establish "
+                            f"step {name}")
+                return False
+            t0 = time.perf_counter()
+            try:
+                faults.fire(f"leader.establish.{name}")
+                fn()
+            except Exception as e:      # noqa: BLE001 — retried, bounded
+                self.logger(f"server: establish step {name} failed "
+                            f"(attempt {attempt + 1}/5): {e!r}")
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            timings[name] = time.perf_counter() - t0
+            metrics.add_sample(f"nomad.leader.establish.{name}",
+                               timings[name])
+            return True
+        metrics.incr("nomad.leader.establish_step_failed")
+        self.logger(f"server: establish step {name} exhausted retries")
+        return False
+
+    def _step_plan_queue(self) -> None:
+        """Stale pendings from a previous reign (or from a drain that
+        raced the revoke) fail with the leadership-lost disposition
+        before the serial applier restarts."""
+        n = self.planner.queue.drain_stale(LEADERSHIP_LOST)
+        if n:
+            metrics.incr("nomad.plan.leadership_lost", n)
+            self.logger(f"server: drained {n} stale plan pendings")
+        self.planner.start()
+
+    def _step_state_cache(self) -> None:
+        """Promote/reseed the solver's device-resident cluster tensors
+        for THIS store (new uid/epoch on a cold takeover; a journal-tail
+        replay when the standby twin kept pace). Floor-gated like the AOT
+        warmup — seeding builds DEVICE twins, and a unit-test server with
+        three nodes must not pay jax backend attach at establish
+        (NOMAD_AOT_WARMUP=1 forces, =0 disables, same as backend.warmup).
+        Lazy import: a stripped solver-less build skips."""
+        if not _device_work_gate("NOMAD_AOT_WARMUP",
+                                 self.state.node_count()):
+            return
+        try:
+            from ..solver import state_cache
+        except ImportError:
+            return
+        out = state_cache.reseed(self.state)
+        if not out.get("skipped"):
+            self.logger(
+                f"server: state cache "
+                f"{'advanced (warm)' if out['warm'] else 'reseeded'}"
+                f" for {out['rows']} nodes at establish")
+
+    def _step_heartbeats(self) -> None:
+        self.heartbeats.stop()      # idempotent under step retries
+        self.heartbeats.initialize_heartbeat_timers()
+        self.heartbeats.start()
+
+    def _step_watchers(self) -> None:
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.periodic.set_enabled(True)
+        # stop-then-start: a RETRY of this step after a partial failure
+        # (e.g. thread creation failing midway) must not leak a second
+        # watcher thread — start() is not idempotent, stop() is
+        for watcher in (self.deployment_watcher, self.drainer,
+                        self.volume_watcher):
+            watcher.stop()
+            watcher.start()
+
+    def _step_broker_restore(self) -> None:
+        # re-enqueue non-terminal evals, re-track periodic jobs
+        for ev in self.state.iter_evals():
+            if ev.status == EVAL_STATUS_PENDING:
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+        for job in self.state.iter_jobs():
+            if job.is_periodic() and not job.stopped():
+                self.periodic.add(job)
+
+    def _reestablish_later(self) -> None:
+        time.sleep(1.0)
+        if self._still_leader() and not self.is_leader:
+            self._establish_leadership()
+
+    # ------------------------------------------------------- warm standby
+
+    def _standby_warmup_loop(self) -> None:
+        """Follower-side AOT warmup (ISSUE 6 warm standby): once the
+        replicated cluster crosses the warmup floor, compile the solver
+        grid NOW — so failover-to-first-solve is a cache probe, not a
+        cold XLA compile. NOMAD_STANDBY_WARMUP=0 disables."""
+        import os
+        if os.environ.get("NOMAD_STANDBY_WARMUP", "") == "0":
+            return
+        while not self._shutdown_ev.wait(2.0):
+            if self.is_leader:
+                return          # the leader establish path owns warmup
+            try:
+                n = self.state.node_count()
+                if n < _warmup_floor():
+                    continue
+                from ..solver import backend
+                out = backend.warmup(n)
+                if not out.get("skipped"):
+                    self.logger(
+                        f"server: standby warmup compiled "
+                        f"{out['artifacts']} artifacts for bucket "
+                        f"{out.get('bucket')} in {out['seconds']}s")
+                # operator-visible: this follower is a WARM standby
+                metrics.set_gauge("nomad.standby.warmed", 1)
+                return
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                record_swallowed_error("server.standby_warmup", e,
+                                       self.logger)
+                return
+
+    def _feed_standby_twin(self, index: int) -> None:
+        """fsm.on_plan_apply hook: a FOLLOWER advances the passive tensor
+        twin as replicated plan results land; the leader's own applier
+        feeds the cache via plan_apply.note_commit instead (leader-only
+        mutation stays inside the fence-checked applier, LEAD001).
+        NOMAD_STANDBY_TWIN: "0" disables, "1" forces even below the
+        warmup floor (the failover tests), default floor-gated so small
+        in-process clusters never touch the device from an FSM apply."""
+        if self.raft_node is None or self.is_leader:
+            return
+        if not _device_work_gate("NOMAD_STANDBY_TWIN",
+                                 self.state.node_count()):
+            return
+        try:
+            from ..solver import state_cache
+        except ImportError:
+            return
+        state_cache.standby_feed(self.state)
 
     def _solver_warmup(self) -> None:
         """Leader-election AOT warmup (backend.warmup). Lazy import: a

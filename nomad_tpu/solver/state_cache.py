@@ -328,6 +328,72 @@ class TensorCache:
 
     # ------------------------------------------------------------- feeding
 
+    def standby_feed(self, store) -> None:
+        """FOLLOWER-side passive twin feed (ISSUE 6 warm standby), called
+        from the FSM's on_plan_apply hook as replicated plan results
+        land. Ownership rule: an EMPTY cache adopts this store (seeding
+        the host arrays AND the device twins); a cache already tracking
+        this store's usage stream advances it; a cache owned by a
+        DIFFERENT store (another in-process server's) is left alone — the
+        first feeder wins, and a later leader's gather reseeds anyway.
+        Keeps promotion warm: the new leader's reseed() finds current
+        twins instead of paying a full rebuild (docs/DEVICE_STATE_CACHE.md)."""
+        if not self.enabled():
+            return
+        usage = getattr(store, "usage", None)
+        if usage is None or getattr(usage, "uid", 0) == 0:
+            return
+        try:
+            with self._lock:
+                if self._uid != 0 and self.cap is not None:
+                    if usage.uid != self._uid \
+                            or usage.epoch != self._epoch:
+                        return          # another store owns the cache
+                    # same unlocked version/journal read note_commit
+                    # makes — _advance_locked bounds-checks a racing
+                    # node register and refuses rather than corrupting
+                    self._advance_locked(usage.version, usage.delta_log)
+                    return
+            # empty cache: seed from a properly-locked snapshot view
+            # (store.snapshot() memoizes per write-generation, so the
+            # per-plan feed cost is one memo lookup). Taken OUTSIDE the
+            # cache lock — the store lock must never nest inside ours.
+            view = getattr(store.snapshot(), "usage", None)
+            if view is None or view.uid == 0:
+                return
+            with self._lock:
+                if self._uid == 0 or self.cap is None:
+                    self._seed_locked(view)
+        except Exception as e:  # noqa: BLE001 — feed is best-effort
+            from ..metrics import record_swallowed_error
+            record_swallowed_error("state_cache.standby_feed", e)
+
+    def reseed(self, store) -> dict:
+        """Promotion step of the leadership recovery barrier (ISSUE 6):
+        make the cache authoritative for THIS store before scheduling
+        resumes. Warm path — the standby feed already tracks this
+        store's usage stream — just replays any journal tail (twins
+        kept). Anything else (different uid/epoch, gap, empty cache)
+        pays the full reseed HERE, at establish time, instead of as
+        first-eval latency. Returns {warm, rows} for the barrier's
+        per-phase metering."""
+        usage = getattr(store, "usage", None)
+        if usage is None or getattr(usage, "uid", 0) == 0 \
+                or not self.enabled():
+            return {"warm": False, "rows": 0, "skipped": True}
+        view = getattr(store.snapshot(), "usage", None)
+        if view is None or view.uid == 0:
+            return {"warm": False, "rows": 0, "skipped": True}
+        with self._lock:
+            warm = (view.uid == self._uid and view.epoch == self._epoch
+                    and self.cap is not None)
+            if warm and self._advance_locked(view.version, view.delta_log):
+                metrics.incr("nomad.solver.state_cache.promote_warm")
+            else:
+                warm = False
+                self._seed_locked(view)
+            return {"warm": warm, "rows": int(self.cap.shape[0])}
+
     def note_commit(self, store) -> None:
         """Applier-thread hook (plan_apply): eagerly replay whatever the
         journal holds so the next eval's gather is a pure hit. Advances
@@ -365,5 +431,7 @@ def cache() -> TensorCache:
 # process-wide cache matches the one-leader, one-device reality)
 gather = _cache.gather
 note_commit = _cache.note_commit
+standby_feed = _cache.standby_feed
+reseed = _cache.reseed
 reset = _cache.reset
 enabled = _cache.enabled

@@ -299,6 +299,12 @@ class StateStore:
         with self._lock:
             return list(self.nodes.values())
 
+    def node_count(self) -> int:
+        """O(1) fleet size — hot-path gates (the standby twin feed runs
+        per replicated plan apply) must not copy the node table."""
+        with self._lock:
+            return len(self.nodes)
+
     # ------------------------------------------------------------------ jobs
 
     def upsert_job(self, index: int, job: Job, keep_version: bool = False) -> None:

@@ -81,6 +81,29 @@ def test_nth_call_mode_fires_every_nth():
     assert pattern == [0, 0, 1] * 3
 
 
+def test_after_mode_fires_from_nth_call_onward():
+    """The partition shape (ISSUE 6): works n-1 times, then stays dead."""
+    faults.install({"s": {"mode": "after", "n": 4}})
+    pattern = []
+    for _ in range(7):
+        try:
+            faults.fire("s")
+            pattern.append(0)
+        except FaultError:
+            pattern.append(1)
+    assert pattern == [0, 0, 0, 1, 1, 1, 1]
+    # times still caps total fires
+    faults.install({"s": {"mode": "after", "n": 2, "times": 2}})
+    pattern = []
+    for _ in range(5):
+        try:
+            faults.fire("s")
+            pattern.append(0)
+        except FaultError:
+            pattern.append(1)
+    assert pattern == [0, 1, 1, 0, 0]
+
+
 def test_probability_same_seed_same_fire_pattern():
     def pattern(seed):
         faults.install({"p.site": {"mode": "probability", "p": 0.5,
@@ -836,8 +859,11 @@ def test_planner_stop_fails_stranded_pendings():
     planner.stop(timeout=0.2)
     result, err = inflight.wait(1.0)
     assert result is None and err == "planner stopped"
+    # since ISSUE 6 the stop reason is ONE consistent disposition for
+    # queued and in-flight pendings alike (the revoke path passes
+    # "leadership lost" the same way)
     result_q, err_q = queued.wait(1.0)
-    assert result_q is None and err_q == "plan queue disabled"
+    assert result_q is None and err_q == "planner stopped"
     assert time.perf_counter() - t0 < 1.0
 
 

@@ -530,8 +530,81 @@ def test_cli_nonexistent_path_fails(tmp_path):
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
-            "EXC001", "PERF001"} <= ids
+            "EXC001", "PERF001", "LEAD001"} <= ids
     assert all(r.short for r in all_rules())
+
+
+# ----------------------------------------------------------------- LEAD001
+
+LEAD001_BAD = """
+    class Endpoint:
+        def kick(self, ev):
+            self.eval_broker.enqueue(ev)
+
+        def feed(self, store):
+            from ..solver import state_cache
+            state_cache.note_commit(store)
+
+        def push(self, plan):
+            return self.planner.queue.enqueue(plan)
+"""
+
+
+def test_lead001_fires_on_unfenced_leader_mutations():
+    out = findings(LEAD001_BAD, path="server/endpoint.py")
+    assert [f.rule for f in out] == ["LEAD001"] * 3
+    assert "fence" in out[0].message
+
+
+def test_lead001_scoped_to_server_paths():
+    assert rule_ids(LEAD001_BAD, path="client/endpoint.py") == []
+
+
+def test_lead001_quiet_with_leadership_or_fence_markers():
+    src = """
+        class Endpoint:
+            def kick(self, ev):
+                if not self.is_leader:
+                    return
+                self.eval_broker.enqueue(ev)
+
+            def commit(self, store, fence):
+                from ..solver import state_cache
+                state_cache.note_commit(store)
+
+            def drive(self, plan):
+                token = self.raft.fence_token()
+                if token is None:
+                    return None
+                return self.planner.queue.enqueue(plan)
+
+            def tick(self, ev):
+                while not self._leader_stop.wait(1.0):
+                    self.eval_broker.enqueue(ev)
+    """
+    assert rule_ids(src, path="server/endpoint.py") == []
+
+
+def test_lead001_inline_suppression():
+    src = """
+        class Endpoint:
+            def push(self, plan):
+                # nomadlint: disable=LEAD001 — queue-gated fixture
+                return self.queue.enqueue(plan)
+    """
+    assert rule_ids(src, path="server/endpoint.py") == []
+
+
+def test_lead001_non_mutation_broker_calls_quiet():
+    src = """
+        class Endpoint:
+            def stats(self):
+                return self.eval_broker.failed_evals()
+
+            def settle(self, eval_id, token):
+                self.eval_broker.ack(eval_id, token)
+    """
+    assert rule_ids(src, path="server/endpoint.py") == []
 
 
 # ----------------------------------------------------------------- PERF001

@@ -325,7 +325,7 @@ def test_commit_timeout_budget_surfaces_per_plan_counter(monkeypatch):
     plans = _capture_plans(fsm, n_jobs=3, count=5)
     fsm_b, planner_b = _twin(fsm)
 
-    def timing_out_apply(msg_type, payload, timeout=30.0):
+    def timing_out_apply(msg_type, payload, timeout=30.0, fence=None):
         raise TimeoutError(f"injected: budget {timeout}")
 
     monkeypatch.setattr(planner_b.raft, "apply", timing_out_apply)
