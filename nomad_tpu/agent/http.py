@@ -836,6 +836,30 @@ class HTTPAPI:
             require(acl.is_management())
             s.run_gc()
             return {}, None
+        if parts and parts[0] == "traces":
+            # eval-trace store (ISSUE 7): list + fetch-by-eval-id. Traces
+            # live in THIS server's memory (the leader runs the evals);
+            # reads are served locally, like /v1/metrics.
+            require(acl.allow_agent_read())
+            from ..obs import chrome_trace
+            from ..obs import trace as obs_trace
+            if len(parts) == 1 and method == "GET":
+                try:
+                    limit = int(query.get("limit", 200) or 200)
+                except ValueError:
+                    raise HTTPError(400, "invalid limit")
+                return {"Traces": obs_trace.traces(limit),
+                        "Stats": obs_trace.stats()}, None
+            if len(parts) == 2 and method == "GET":
+                ref = urllib.parse.unquote(parts[1])
+                tr = obs_trace.get(ref)
+                if tr is None:
+                    raise HTTPError(404, f"no trace for {ref!r}")
+                if query.get("format") == "chrome":
+                    return RawResponse(
+                        json.dumps(chrome_trace([tr])).encode(),
+                        "application/json"), None
+                return tr, None
         if parts == ["metrics"]:
             require(acl.allow_agent_read())
             if query.get("format") == "prometheus":

@@ -11,7 +11,8 @@ from .core import (                                    # noqa: F401
     register,
 )
 from . import (                                            # noqa: F401
-    rules_det, rules_exc, rules_jit, rules_lead, rules_lock, rules_perf,
+    rules_det, rules_exc, rules_jit, rules_lead, rules_lock, rules_obs,
+    rules_perf,
 )
 
 __all__ = ["Baseline", "Finding", "Rule", "all_rules", "analyze_paths",

@@ -1,0 +1,161 @@
+"""OBS001 — telemetry hygiene: bounded metric-name cardinality and
+no discarded measurement contexts.
+
+Two anti-patterns this PR's observability work (ISSUE 7) makes load-
+bearing to avoid:
+
+  1. UNBOUNDED METRIC NAMES: interpolating ids, node names, or other
+     per-entity strings into a metric NAME (`metrics.incr(f"x.{ev.id}")`)
+     grows the registry (and every Prometheus scrape) without bound.
+     Bounded dimensions (solver tier, scheduler type, breaker state,
+     kernel) are fine as name suffixes or — better — as labels on
+     `metrics.observe(...)`; per-entity attribution belongs in TRACE
+     ATTRIBUTES (nomad_tpu/obs), which are bounded by the trace store's
+     ring. Interpolated expressions are judged by an allowlist of
+     known-bounded names; anything else flags. Pre-existing per-site
+     fault/swallow counters are baselined with reasons.
+
+  2. DISCARDED MEASUREMENT CONTEXTS: `metrics.measure(...)` and
+     `trace.span(...)` return context managers — calling one as a bare
+     expression statement (or otherwise never entering it) records
+     NOTHING, silently: the classic `measure()` block that exits without
+     recording. The call must appear in a `with` item (directly or via
+     contextlib combinators).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, SourceModule, register
+
+_NAME_SINKS = ("incr", "add_sample", "set_gauge", "observe", "measure",
+               "describe")
+
+# interpolated expressions considered bounded-cardinality: solver tiers,
+# backend/kernel routing names, scheduler types, breaker states, leader
+# barrier steps
+_ALLOWED_NAMES = {"tier", "kernel", "backend", "step", "kind", "mode",
+                  "state", "sched", "phase", "metric", "stat"}
+_ALLOWED_ATTRS = {"type", "platform"}
+
+_CM_SINKS = ("measure", "span", "use")
+
+
+def _is_metrics_call(mod: SourceModule, node: ast.Call,
+                     sinks) -> str:
+    """-> the sink method name when `node` is a metrics/trace call we
+    police, else ""."""
+    d = mod.dotted(node.func)
+    if d is None:
+        return ""
+    parts = d.split(".")
+    if len(parts) < 2 or parts[-1] not in sinks:
+        return ""
+    owner = parts[-2]
+    if owner in ("metrics", "trace", "tracer") or \
+            d.startswith("nomad_tpu.metrics") or \
+            d.startswith("nomad_tpu.obs"):
+        return parts[-1]
+    return ""
+
+
+def _interp_ok(expr: ast.AST) -> bool:
+    """Is one interpolated expression provably bounded? Conversions and
+    trivial formatting wrappers unwrap first."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in _ALLOWED_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _ALLOWED_ATTRS or expr.attr in _ALLOWED_NAMES
+    return False
+
+
+@register
+class TelemetryHygiene(Rule):
+    id = "OBS001"
+    severity = "error"
+    short = ("unbounded-cardinality metric name (id/node interpolation) "
+             "or a measure()/span() context manager that is discarded "
+             "without being entered")
+    # everywhere: telemetry is written from every layer
+    path_markers = ()
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            sink = _is_metrics_call(mod, node, _NAME_SINKS)
+            if sink:
+                out.extend(self._check_name(mod, node, sink))
+            cm = _is_metrics_call(mod, node, _CM_SINKS)
+            if cm and cm != "use":
+                out.extend(self._check_discarded(mod, node, cm))
+        return out
+
+    # ---------------------------------------------------- name cardinality
+
+    def _check_name(self, mod: SourceModule, node: ast.Call,
+                    sink: str) -> list:
+        name_arg = node.args[0]
+        bad = None
+        if isinstance(name_arg, ast.JoinedStr):
+            for part in name_arg.values:
+                if isinstance(part, ast.FormattedValue) and \
+                        not _interp_ok(part.value):
+                    bad = ast.unparse(part.value)
+                    break
+        elif isinstance(name_arg, ast.BinOp) and \
+                isinstance(name_arg.op, (ast.Add, ast.Mod)):
+            # "x." + thing + ".y" / thing + ".y" / "x.%s" % thing — fold
+            # the whole chain and judge EVERY non-literal operand (a
+            # trailing literal suffix must not launder an id)
+            stack, bad = [name_arg], None
+            while stack and bad is None:
+                node_i = stack.pop()
+                if isinstance(node_i, ast.BinOp) and \
+                        isinstance(node_i.op, (ast.Add, ast.Mod)):
+                    stack.extend((node_i.left, node_i.right))
+                elif isinstance(node_i, ast.Tuple):
+                    stack.extend(node_i.elts)   # "%s.%s" % (a, b)
+                elif not _interp_ok(node_i):
+                    bad = ast.unparse(node_i)
+        elif isinstance(name_arg, ast.Call) and \
+                isinstance(name_arg.func, ast.Attribute) and \
+                name_arg.func.attr == "format":
+            for a in list(name_arg.args) + \
+                    [k.value for k in name_arg.keywords]:
+                if not _interp_ok(a):
+                    bad = ast.unparse(a)
+                    break
+        if bad is None:
+            return []
+        return [mod.finding(
+            self, node,
+            f"metric name for {sink}() interpolates {bad!r} — an "
+            f"unbounded dimension grows the registry and every scrape "
+            f"forever; use a bounded label on observe(), a trace "
+            f"attribute (nomad_tpu/obs), or allowlist a provably "
+            f"bounded name")]
+
+    # ------------------------------------------------ discarded ctx manager
+
+    def _check_discarded(self, mod: SourceModule, node: ast.Call,
+                         sink: str) -> list:
+        parent = mod.parent(node)
+        # with-item (direct or aliased): fine
+        if isinstance(parent, ast.withitem):
+            return []
+        # nested combinators: ExitStack().enter_context(measure(...)),
+        # contextlib.nullcontext fallbacks — entered by the wrapper
+        if isinstance(parent, ast.Call):
+            return []
+        if isinstance(parent, ast.Expr):
+            return [mod.finding(
+                self, node,
+                f"{sink}() called as a bare statement — the context "
+                f"manager is discarded without being entered, so the "
+                f"measurement/span is silently never recorded; wrap the "
+                f"timed block in `with ...{sink}(...):`")]
+        return []

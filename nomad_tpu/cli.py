@@ -12,6 +12,7 @@ Usage:
   python -m nomad_tpu.cli alloc status <alloc_id>
   python -m nomad_tpu.cli eval status <eval_id>
   python -m nomad_tpu.cli deployment list|status|promote <...>
+  python -m nomad_tpu.cli trace [eval_id] [-chrome out.json]
   python -m nomad_tpu.cli operator scheduler get-config
   python -m nomad_tpu.cli operator scheduler set-config -scheduler-algorithm <alg>
   python -m nomad_tpu.cli system gc
@@ -698,6 +699,91 @@ def cmd_eval_status(args) -> None:
                ["ID", "Group", "Node", "Desired", "Status"])
 
 
+def cmd_trace(args) -> None:
+    """Eval-trace browsing (ISSUE 7): `trace` lists retained traces,
+    `trace <eval-id>` renders a text waterfall of the span tree plus the
+    shared fan-in spans (micro-batch dispatch, coalesced commit) the
+    eval rode; `-chrome FILE` saves Chrome trace-event JSON for
+    chrome://tracing / Perfetto."""
+    if not args.ref:
+        out = api("GET", f"/v1/traces?limit={args.limit}")
+        trs = out.get("Traces", [])
+        if not trs:
+            print("No traces retained (telemetry_trace_enabled off, "
+                  "sampled out, or nothing ran yet)")
+            return
+        _table([[t["trace_id"][:12], (t["eval_id"] or "-")[:8],
+                 t["name"], t["status"],
+                 f"{t['duration_s'] * 1000:.1f}ms", t["spans"]]
+                for t in trs],
+               ["Trace", "Eval", "Name", "Status", "Duration", "Spans"])
+        st = out.get("Stats", {})
+        print(f"\n{st.get('retained', 0)} retained / "
+              f"{st.get('started', 0)} started, "
+              f"sample_rate={st.get('sample_rate')}")
+        return
+    ref = urllib.parse.quote(args.ref)
+    if args.chrome:
+        raw = api_raw("GET", f"/v1/traces/{ref}?format=chrome")
+        with open(args.chrome, "wb") as f:
+            f.write(raw)
+        print(f"Wrote Chrome trace-event JSON to {args.chrome} "
+              f"(open in chrome://tracing or ui.perfetto.dev)")
+        return
+    tr = api("GET", f"/v1/traces/{ref}")
+    dur = max(tr.get("duration_s") or 0.0, 1e-9)
+    print(f"Trace   {tr['trace_id']}  ({tr['name']}, "
+          f"status={tr['status']})")
+    if tr.get("eval_id"):
+        print(f"Eval    {tr['eval_id']}")
+    print(f"Wall    {dur * 1000:.2f}ms\n")
+    spans = list(tr.get("spans", ()))
+    by_parent: dict[str, list] = {}
+    for sp in spans:
+        by_parent.setdefault(sp["parent"], []).append(sp)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s["ts"])
+    width = 36
+    t0 = tr["start_unix"]
+
+    def bar(sp) -> str:
+        off = max(0.0, sp["ts"] - t0) / dur
+        frac = min(1.0, sp["dur"] / dur)
+        lead = min(width - 1, int(off * width))
+        fill = max(1, int(frac * width))
+        fill = min(fill, width - lead)
+        return " " * lead + "█" * fill + " " * (width - lead - fill)
+
+    def attrs_str(sp) -> str:
+        keep = {k: v for k, v in (sp.get("attrs") or {}).items()
+                if k in ("tier", "kernel", "cache", "lanes", "plans",
+                         "demotions", "raft_index", "error", "noop",
+                         "index")}
+        mark = "" if sp["status"] == "ok" else f" !{sp['status']}"
+        link = " ~fanin" if sp.get("links") else ""
+        return mark + link + (f"  {keep}" if keep else "")
+
+    def walk(sp, depth: int) -> None:
+        name = ("  " * depth + sp["name"])[:30]
+        print(f"{name:<30} |{bar(sp)}| {sp['dur'] * 1000:9.3f}ms"
+              f"{attrs_str(sp)}")
+        for kid in by_parent.get(sp["id"], ()):
+            walk(kid, depth + 1)
+
+    roots = by_parent.get("", [])
+    orphans = [sp for sp in spans
+               if sp["parent"] and not any(
+                   p["id"] == sp["parent"] for p in spans)]
+    for sp in roots + sorted(orphans, key=lambda s: s["ts"]):
+        walk(sp, 0)
+    linked = tr.get("linked_spans", ())
+    if linked:
+        print("\nShared fan-in spans this eval rode:")
+        for sp in sorted(linked, key=lambda s: s["ts"]):
+            print(f"~ {sp['name']:<28} |{bar(sp)}| "
+                  f"{sp['dur'] * 1000:9.3f}ms{attrs_str(sp)}")
+
+
 def cmd_deployment(args) -> None:
     if args.action == "list":
         ds = api("GET", "/v1/deployments")
@@ -1341,6 +1427,15 @@ def build_parser() -> argparse.ArgumentParser:
     ps = psub.add_parser("status")
     ps.add_argument("plugin_id", nargs="?", default="")
     ps.set_defaults(fn=cmd_plugin_status)
+
+    tr = sub.add_parser("trace")
+    tr.add_argument("ref", nargs="?", default="",
+                    help="eval id, trace id, or unique prefix; "
+                         "omit to list")
+    tr.add_argument("-limit", type=int, default=50)
+    tr.add_argument("-chrome", default="",
+                    help="write Chrome trace-event JSON to this file")
+    tr.set_defaults(fn=cmd_trace)
     return p
 
 

@@ -164,7 +164,12 @@ class Client:
                                        rss + st.get("memory_rss_bytes", 0))
                 for (job, tg, task), (cpu, rss) in rollup.items():
                     base = f"nomad.client.allocs.{job}.{tg}.{task}"
+                    # per-live-task gauges: bounded by tasks on THIS
+                    # client, and the retire pass below deletes rows on
+                    # churn — cardinality cannot grow without bound
+                    # nomadlint: disable=OBS001 — bounded + retired below
                     metrics.set_gauge(f"{base}.cpu_percent", cpu)
+                    # nomadlint: disable=OBS001 — bounded + retired below
                     metrics.set_gauge(f"{base}.memory_rss_bytes",
                                       float(rss))
                 # retire gauges for tasks that stopped since last cycle:

@@ -22,6 +22,7 @@ from ..structs import (
     TRIGGER_RETRY_FAILED_ALLOC, new_id, SCHED_ALG_TPU, skeleton_for,
 )
 from ..metrics import metrics
+from ..obs import trace
 from .context import EvalContext
 from .reconcile import AllocReconciler, AllocPlaceResult
 from .stack import GenericStack, SelectOptions
@@ -222,7 +223,8 @@ class GenericScheduler:
             eval_id=eval.id,
             eval_priority=eval.priority,
             now=now)
-        with metrics.measure("nomad.scheduler.reconcile"):
+        with metrics.measure("nomad.scheduler.reconcile"), \
+                trace.span("scheduler.reconcile"):
             results = reconciler.compute()
         self.followup_evals = results.desired_followup_evals
 

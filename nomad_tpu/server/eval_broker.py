@@ -22,6 +22,7 @@ import time
 from typing import Optional
 
 from ..metrics import metrics
+from ..obs import trace
 from ..structs import Evaluation, new_id
 
 DEFAULT_NACK_TIMEOUT = 60.0
@@ -97,6 +98,17 @@ class EvalBroker:
     def _flush_locked(self) -> None:
         """Caller holds self._lock (the *_locked convention LOCK001
         checks; ref eval_broker.go flush, called under b.l)."""
+        # every live trace this broker started ends here with the flush
+        # disposition — the worker processing an outstanding eval may
+        # still be mid-span on its own thread, so truncate (no span-leak
+        # accounting) rather than demand a clean close (ISSUE 7)
+        flushed = set(self._evals) | set(self._unack)
+        for pend in self._pending.values():
+            flushed.update(ev.id for ev in pend)
+        flushed.update(item[2].id for item in self._delay_heap)
+        for eval_id in flushed:
+            trace.end_eval(eval_id, "flushed", truncate=True,
+                           owner=id(self))
         self._ready.clear()
         self._ready_jobs.clear()
         self._evals.clear()
@@ -140,6 +152,13 @@ class EvalBroker:
             return
         if ev.id in self._evals:
             return
+        # the eval's trace begins at broker ENQUEUE: queue/delay/pending
+        # wait is attributed as `broker.wait` when it dequeues. Idempotent
+        # for live traces (delayed/pending re-enqueues keep theirs); a
+        # fresh trace starts after a completed one ended (requeue-on-ack).
+        trace.begin_eval(ev.id, "eval", owner=id(self), job=ev.job_id,
+                         type=ev.type, trigger=ev.triggered_by,
+                         priority=ev.priority)
         now = time.time()
         if ev.wait_until_unix and ev.wait_until_unix > now:
             heapq.heappush(self._delay_heap,
@@ -182,6 +201,9 @@ class EvalBroker:
                 best = self._pick_locked(schedulers)
                 if best is not None:
                     self._notify_inflight()
+                    trace.mark_dequeued(
+                        best[0].id,
+                        deliveries=self._dequeue_count.get(best[0].id, 1))
                     return best
                 if deadline is not None:
                     remaining = deadline - time.time()

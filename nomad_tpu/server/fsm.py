@@ -147,14 +147,22 @@ class NomadFSM:
                 index, payload["transitions"], payload.get("evals", []))
             self._notify_evals(payload.get("evals", []))
         elif msg_type == APPLY_PLAN_RESULTS:
-            s.upsert_plan_results(index, payload["result"])
+            from ..obs import trace
+            with trace.span("fsm.apply", index=index, plans=1):
+                s.upsert_plan_results(index, payload["result"])
             self._notify_plan_apply(index)
         elif msg_type == APPLY_PLAN_RESULTS_BATCH:
             # per-plan order within the entry IS commit order; every plan
             # of the batch shares the entry's index, and the store applies
             # them under ONE lock hold so a blocking reader that observes
-            # the index always sees the WHOLE entry (serial-path parity)
-            s.upsert_plan_results_batch(index, payload["results"])
+            # the index always sees the WHOLE entry (serial-path parity).
+            # The fsm.apply span nests under the applier's shared
+            # plan.commit span (same thread); a follower's replicated
+            # apply has no trace context and records nothing.
+            from ..obs import trace
+            with trace.span("fsm.apply", index=index,
+                            plans=len(payload["results"])):
+                s.upsert_plan_results_batch(index, payload["results"])
             self._notify_plan_apply(index)
         elif msg_type == DEPLOYMENT_STATUS_UPDATE:
             s.update_deployment_status(index, payload["update"],
@@ -354,10 +362,17 @@ class RaftLog:
             if fence is not None and fence != self._fence:
                 from ..rpc.codec import FencedWriteError
                 from ..metrics import metrics
+                from ..obs import trace
                 metrics.incr("nomad.raft.fence_rejected")
+                trace.annotate(fence_rejected=True, fence_expected=fence,
+                               fence_current=self._fence)
                 raise FencedWriteError(self._fence, fence)
             self._index += 1
             index = self._index
+            # attribute the assigned log index onto whatever span is in
+            # flight (the applier's plan.commit span) — ISSUE 7
+            from ..obs import trace
+            trace.annotate(raft_index=index)
             self.fsm.apply(index, msg_type, payload)
             return index
 

@@ -54,6 +54,7 @@ import numpy as np
 
 from .. import faults
 from ..metrics import metrics
+from ..obs import trace
 from ..rpc.codec import NotLeaderError
 from ..state import StateStore
 from ..structs import (
@@ -87,13 +88,17 @@ class LeadershipLostPlanError(RuntimeError):
 
 
 class _PendingPlan:
-    __slots__ = ("plan", "event", "result", "error")
+    __slots__ = ("plan", "event", "result", "error", "ctx", "t0")
 
     def __init__(self, plan: Plan):
         self.plan = plan
         self.event = threading.Event()
         self.result: Optional[PlanResult] = None
         self.error: Optional[str] = None
+        # trace context of the submitting eval + enqueue time: the
+        # applier attributes `plan.queue_wait` from these at drain
+        self.ctx = trace.eval_ctx(plan.eval_id) or trace.current()
+        self.t0 = time.perf_counter()
 
     def respond(self, result, error) -> None:
         self.result = result
@@ -424,6 +429,10 @@ class Planner:
             if not batch:
                 continue
             self._inflight = batch
+            t_drain = time.perf_counter()
+            for pending in batch:
+                trace.record_span("plan.queue_wait", pending.ctx,
+                                  pending.t0, drained=len(batch))
             try:
                 # the batch's fence: captured ONCE at drain, checked
                 # atomically at the raft append — a step-down anywhere in
@@ -431,7 +440,13 @@ class Planner:
                 # racing the new leader's commits (docs/FAILOVER.md)
                 fence = self._fence_token()
                 if fence is _NOT_LEADER:
+                    # measured from DRAIN like the commit path's t_batch —
+                    # from pending.t0 it would re-count the queue wait the
+                    # span above already attributed
                     for pending in batch:
+                        trace.record_span("plan.commit_wait", pending.ctx,
+                                          t_drain,
+                                          status="leadership_lost")
                         pending.respond(None, LEADERSHIP_LOST)
                     metrics.incr("nomad.plan.leadership_lost", len(batch))
                     continue
@@ -479,6 +494,12 @@ class Planner:
         LEADERSHIP_LOST per plan, and never lands after the new leader's
         commits."""
         deadline = time.monotonic() + self._commit_budget()
+        t_batch = time.perf_counter()
+        # per-plan trace contexts: drained plans resolve via eval id
+        # (their worker is on another thread); the inline apply_plan
+        # path (a batch of one on the caller's thread) via current()
+        ctxs = [trace.eval_ctx(p.eval_id) or trace.current()
+                for p in plans]
         # ONE SnapshotMinIndex fetch shared by every plan of the batch
         # (each plan used to snapshot independently); the store memoizes
         # the snapshot per write-generation, so concurrent worker lanes
@@ -495,12 +516,15 @@ class Planner:
         reqs: list[PlanApplyRequest] = []
         committed_results: list[PlanResult] = []
         noop_results: list[PlanResult] = []
-        for plan, result, err in evaluated:
+        commit_ctxs = []                # trace ctxs of committing plans
+        for (plan, result, err), pctx in zip(evaluated, ctxs):
             if err is not None or result is None:
                 continue
             if result.is_no_op() and not result.node_update:
                 noop_results.append(result)
                 continue
+            if pctx is not None:
+                commit_ctxs.append(pctx)
             reqs.append(PlanApplyRequest(
                 alloc_updates=[a for allocs in result.node_update.values()
                                for a in allocs],
@@ -517,16 +541,27 @@ class Planner:
             committed_results.append(result)
 
         commit_err: Optional[BaseException] = None
+        commit_ctx = None
         if reqs:
             # ref plan_apply.go:204 `nomad.plan.apply` (raft commit + FSM);
             # the budget spans the WHOLE batch — one slow entry may not
-            # hold the queue for 30s per message (ISSUE 5 satellite)
+            # hold the queue for 30s per message (ISSUE 5 satellite).
+            # ONE shared raft-apply span for the coalesced entry, linked
+            # to every committing plan's eval span — the commit-path
+            # fan-in twin of the micro-batch dispatch span (ISSUE 7)
             remaining = deadline - time.monotonic()
+            commit_sp = trace.start_span(
+                "plan.commit",
+                parent=commit_ctxs[0] if commit_ctxs else None,
+                links=commit_ctxs, plans=len(reqs),
+                coalesced=len(reqs) > 1)
+            commit_ctx = commit_sp.ctx()
             try:
                 if remaining <= 0:
                     raise TimeoutError(
                         "plan commit budget exhausted before raft apply")
-                with metrics.measure("nomad.plan.apply"):
+                with metrics.measure("nomad.plan.apply"), \
+                        trace.use(commit_sp):
                     if len(reqs) == 1:
                         index = self.raft.apply(
                             APPLY_PLAN_RESULTS, {"result": reqs[0]},
@@ -540,8 +575,10 @@ class Planner:
                                      len(reqs))
                 metrics.add_sample("nomad.plan.commit_batch_size",
                                    len(reqs))
+                commit_sp.end("ok")
             except TimeoutError as e:
                 metrics.incr("nomad.plan.commit_timeout", len(reqs))
+                commit_sp.end("timeout", error=repr(e)[:200])
                 commit_err = e
             except NotLeaderError as e:
                 # FencedWriteError (entry never appended) and
@@ -549,8 +586,10 @@ class Planner:
                 # surface as the distinct leadership-lost disposition:
                 # either way THIS applier must not claim the commit
                 metrics.incr("nomad.plan.leadership_lost", len(reqs))
+                commit_sp.end("leadership_lost", error=repr(e)[:200])
                 commit_err = LeadershipLostPlanError(str(e))
             except Exception as e:   # noqa: BLE001 — per-plan surfaced
+                commit_sp.end("error", error=repr(e)[:200])
                 commit_err = e
             if commit_err is None:
                 for result in committed_results:
@@ -572,14 +611,36 @@ class Planner:
             result.alloc_index = self.raft.barrier()
 
         committed_ids = {id(r) for r in committed_results}
+        noop_ids = {id(r) for r in noop_results}
         out = []
-        for plan, result, err in evaluated:
+        for (plan, result, err), pctx in zip(evaluated, ctxs):
             if err is not None:
                 out.append((None, err))
+                status, attrs = "error", {"error": repr(err)[:200]}
             elif commit_err is not None and id(result) in committed_ids:
                 out.append((None, commit_err))
+                status = "leadership_lost" if isinstance(
+                    commit_err, LeadershipLostPlanError) else \
+                    "timeout" if isinstance(commit_err, TimeoutError) \
+                    else "error"
+                attrs = {"error": repr(commit_err)[:200]}
             else:
                 out.append((result, None))
+                status = "ok"
+                attrs = {"noop": True} if id(result) in noop_ids else \
+                    {"index": getattr(result, "alloc_index", 0),
+                     "rejected": len(result.rejected_nodes)}
+            # per-plan commit attribution in the EVAL's own trace,
+            # linked to the shared raft-apply span it rode (fan-in),
+            # plus the disposition-labeled commit-wait histogram
+            trace.record_span(
+                "plan.commit_wait", pctx, t_batch,
+                links=(commit_ctx,)
+                if commit_ctx is not None and id(result) in committed_ids
+                else (), status=status, batch=len(plans), **attrs)
+            metrics.observe("nomad.plan.commit_wait_seconds",
+                            time.perf_counter() - t_batch,
+                            labels={"disposition": status})
         return out
 
     # --------------------------------------------------- batch evaluation

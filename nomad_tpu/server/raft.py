@@ -362,6 +362,9 @@ class RaftNode:
                 # under the SAME lock that serializes step-down, so the
                 # rejection is atomic with the append decision.
                 metrics.incr("nomad.raft.fence_rejected")
+                from ..obs import trace
+                trace.annotate(fence_rejected=True, fence_expected=fence,
+                               fence_current=self.current_term)
                 raise FencedWriteError(self.current_term, fence,
                                        self.leader_addr)
             entry = _Entry(self.current_term, msg_type, payload)
@@ -408,6 +411,12 @@ class RaftNode:
                 raise LeadershipLostError(self.leader_addr)
             metrics.add_sample("nomad.raft.apply_wait",
                                time.monotonic() - t_enter)
+            # attribute the replication wait + assigned index onto the
+            # caller's in-flight span (the applier's plan.commit, ISSUE 7)
+            from ..obs import trace
+            trace.annotate(raft_index=index, term=entry.term,
+                           replicate_wait_s=round(
+                               time.monotonic() - t_enter, 6))
             return index
 
     def bootstrap_with(self, peers: dict[str, str]) -> bool:

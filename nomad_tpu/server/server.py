@@ -15,6 +15,7 @@ from typing import Callable, Optional
 
 from .. import faults
 from ..metrics import metrics, record_swallowed_error
+from ..obs import trace
 from ..rpc.codec import NotLeaderError
 from ..state import StateStore
 from ..structs import (
@@ -605,7 +606,15 @@ class Server:
         failed here carry the distinct leadership-lost disposition
         (counted in `nomad.plan.leadership_lost`, ISSUE 6 satellite)."""
         with self._establish_lock:
-            self._revoke_leadership_locked()
+            was_leader = self.is_leader
+            root = trace.begin_root("leader.revoke", was_leader=was_leader)
+            try:
+                with trace.use(root):
+                    self._revoke_leadership_locked()
+            except BaseException as e:
+                root.end("error", error=repr(e)[:200])
+                raise
+            root.end("ok" if was_leader and not self.is_leader else "stale")
 
     def _revoke_leadership_locked(self) -> None:
         if not self.is_leader:
@@ -676,7 +685,22 @@ class Server:
         no-op (`is_leader` already set), and a stale revoke is detected
         inside (`_still_leader`)."""
         with self._establish_lock:
-            self._establish_leadership_locked()
+            # the recovery barrier is a ROOT trace (ISSUE 7): every
+            # `leader.establish.<step>` below nests under it, and a
+            # failover promotion shows up in /v1/traces next to the
+            # evals it unblocked
+            root = trace.begin_root(
+                "leader.establish",
+                term=self.raft_node.current_term
+                if self.raft_node is not None else 0)
+            try:
+                with trace.use(root):
+                    self._establish_leadership_locked()
+            except BaseException as e:
+                root.end("error", error=repr(e)[:200])
+                raise
+            root.end("ok" if self.is_leader else "unwound",
+                     is_leader=self.is_leader)
 
     def _establish_leadership_locked(self) -> None:
         term = self.raft_node.current_term \
@@ -729,6 +753,7 @@ class Server:
         timings["barrier"] = time.perf_counter() - t0
         metrics.add_sample("nomad.leader.establish.barrier",
                            timings["barrier"])
+        trace.record_span("leader.establish.barrier", None, t0)
 
         ok = (self._establish_step("plan_queue", self._step_plan_queue,
                                    timings)
@@ -807,14 +832,18 @@ class Server:
                 return False
             t0 = time.perf_counter()
             try:
-                faults.fire(f"leader.establish.{name}")
-                fn()
+                with trace.span(f"leader.establish.{name}",
+                                attempt=attempt):
+                    faults.fire(f"leader.establish.{name}")
+                    fn()
             except Exception as e:      # noqa: BLE001 — retried, bounded
                 self.logger(f"server: establish step {name} failed "
                             f"(attempt {attempt + 1}/5): {e!r}")
                 time.sleep(0.05 * (attempt + 1))
                 continue
             timings[name] = time.perf_counter() - t0
+            # `name` ranges over the five literal barrier step names
+            # nomadlint: disable=OBS001 — bounded step-name set
             metrics.add_sample(f"nomad.leader.establish.{name}",
                                timings[name])
             return True

@@ -13,6 +13,7 @@ from typing import Optional
 
 from .. import faults
 from ..metrics import metrics, record_swallowed_error
+from ..obs import trace
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan, PlanResult, EVAL_STATUS_FAILED
 from .eval_broker import EvalBroker
@@ -58,9 +59,33 @@ class Worker:
             metrics.add_sample("nomad.worker.dequeue_eval",
                                time.perf_counter() - t0)
             self._eval, self._eval_token = ev, token
+            # hot-reload the tracing knobs from the raft-replicated
+            # scheduler config (same path as eval_batch_*), then adopt
+            # the trace the broker began at enqueue — the cross-thread
+            # handoff (ISSUE 7). begin_eval covers broker-less paths
+            # (restore corners, direct test drives): idempotent.
+            cfg = self.server.state.get_scheduler_config()
+            trace.configure(
+                enabled=getattr(cfg, "telemetry_trace_enabled", True),
+                sample_rate=getattr(cfg, "telemetry_trace_sample", 1.0),
+                capacity=getattr(cfg, "telemetry_trace_capacity", None))
+            broker_owner = id(self.server.eval_broker)
+            ctx = trace.eval_ctx(ev.id) or trace.begin_eval(
+                ev.id, "eval", owner=broker_owner, job=ev.job_id,
+                type=ev.type, trigger=ev.triggered_by)
+            t_inv = time.perf_counter()
             try:
-                self._invoke_scheduler(ev)
+                with trace.use(ctx), \
+                        trace.span("worker.invoke", worker=self.id,
+                                   type=ev.type):
+                    self._invoke_scheduler(ev)
             except Exception as e:      # noqa: BLE001
+                # labeled histogram (ISSUE 7): invoke latency by
+                # scheduler type + disposition — bounded dimensions
+                metrics.observe("nomad.worker.invoke_seconds",
+                                time.perf_counter() - t_inv,
+                                labels={"type": ev.type,
+                                        "disposition": "error"})
                 # the nack path survives the exception, but it must not
                 # be invisible: a sick device/tier shows up here first
                 # (ISSUE 3 — counted per scheduler type for triage)
@@ -69,11 +94,17 @@ class Worker:
                 record_swallowed_error("worker.run", e)
                 self.server.logger(f"worker-{self.id}: eval {ev.id[:8]} "
                                    f"failed: {e!r}")
+                trace.end_eval(ev.id, "error", owner=broker_owner,
+                               error=repr(e)[:200])
                 try:
                     self.server.eval_broker.nack(ev.id, token)
                 except ValueError:
                     pass
                 continue
+            metrics.observe("nomad.worker.invoke_seconds",
+                            time.perf_counter() - t_inv,
+                            labels={"type": ev.type, "disposition": "ok"})
+            trace.end_eval(ev.id, "ok", owner=broker_owner)
             try:
                 self.server.eval_broker.ack(ev.id, token)
             except ValueError:
@@ -86,12 +117,14 @@ class Worker:
             self.server.core_scheduler.process(ev)
             return
         wait_index = max(ev.modify_index, ev.snapshot_index)
-        with metrics.measure("nomad.worker.wait_for_index"):
+        with metrics.measure("nomad.worker.wait_for_index"), \
+                trace.span("worker.wait_for_index", index=wait_index):
             self._snapshot = self.server.state.snapshot_min_index(
                 wait_index, timeout=5.0)
         sched = new_scheduler(ev.type, self._snapshot, self)
         # ref worker.go:553 `nomad.worker.invoke_scheduler_<type>`
-        with metrics.measure(f"nomad.worker.invoke_scheduler_{ev.type}"):
+        with metrics.measure(f"nomad.worker.invoke_scheduler_{ev.type}"), \
+                trace.span("scheduler.process", type=ev.type):
             sched.process(ev)
 
     # ------------------------------------------------- Planner interface
@@ -102,7 +135,8 @@ class Worker:
         plan.snapshot_index = max(plan.snapshot_index,
                                   self._snapshot.latest_index()
                                   if self._snapshot else 0)
-        with metrics.measure("nomad.worker.submit_plan"):
+        with metrics.measure("nomad.worker.submit_plan"), \
+                trace.span("plan.submit"):
             result = self.server.planner.submit_plan(plan)
         if result is None:
             return None

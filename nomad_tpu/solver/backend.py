@@ -336,15 +336,22 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
                 continue
             use = args if i == 0 or host_args is None else host_args
             async_mode = getattr(_dispatch_ctx, "on", False)
+            from ..obs import trace
             try:
-                faults.fire(f"solver.dispatch.{tier}")
-                out = fn(*use)
-                if not async_mode:
-                    out = jax.block_until_ready(out)
+                with trace.span(f"solver.dispatch.{tier}",
+                                attempt=i, floor=floor):
+                    faults.fire(f"solver.dispatch.{tier}")
+                    out = fn(*use)
+                    if not async_mode:
+                        out = jax.block_until_ready(out)
             except errs as e:
                 _breaker.record_failure(tier)
                 metrics.incr("nomad.solver.tier_demotions")
                 metrics.incr(f"nomad.solver.tier_demotions.{tier}")
+                # the ladder fell through this tier: record it on the
+                # surrounding solve span so per-eval traces show the
+                # demotion chain (ISSUE 7)
+                trace.annotate_list("demotions", tier)
                 last_err = e
                 continue
             except BaseException:
@@ -545,6 +552,9 @@ def record(kernel: str, backend: str) -> None:
     """Emit the per-solve routing metrics the bench/judge read."""
     metrics.incr(f"nomad.solver.backend.{backend}")
     metrics.incr(f"nomad.solver.kernel.{kernel}.{backend}")
+    # attribute the selected tier/kernel onto the in-flight solve span
+    from ..obs import trace
+    trace.annotate(tier=backend, kernel=kernel)
 
 
 # ------------------------------------------------------------------ warmup

@@ -40,19 +40,27 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..metrics import metrics
+from ..obs import trace
 from .buckets import BATCH_LANES as LANES   # fixed batch padding (one
                                             # compiled artifact, ever)
 FOLLOWER_TIMEOUT = 120.0    # follower safety valve if a leader dies
 
 
 class _Request:
-    __slots__ = ("args", "event", "out", "err")
+    __slots__ = ("args", "event", "out", "err", "ctx", "t0",
+                 "dispatch_ctx")
 
     def __init__(self, args: tuple):
         self.args = args
         self.event = threading.Event()
         self.out: Optional[np.ndarray] = None
         self.err: Optional[BaseException] = None
+        # trace context of the submitting eval (captured on ITS thread)
+        # and the shared dispatch span this lane rode — the fan-in link
+        # pair (ISSUE 7; docs/OBSERVABILITY.md)
+        self.ctx = trace.current()
+        self.t0 = time.perf_counter()
+        self.dispatch_ctx = None
 
 
 class MicroBatcher:
@@ -164,6 +172,13 @@ class MicroBatcher:
                 raise
         else:
             req.event.wait(self._window_s + FOLLOWER_TIMEOUT)
+        # per-lane wait span in the EVAL's own trace, linked to the
+        # shared dispatch span it rode (fan-in link): enqueue -> result
+        trace.record_span(
+            "solver.microbatch.wait", req.ctx, req.t0,
+            links=(req.dispatch_ctx,) if req.dispatch_ctx else (),
+            status="error" if req.err is not None else "ok",
+            solo=req.dispatch_ctx is None, leader=leader)
         if req.err is not None:
             raise req.err
         if req.out is None:
@@ -198,6 +213,17 @@ class MicroBatcher:
         pad = pad[:3] + (np.int32(0),) + pad[4:]
         cols = stack_lanes([r.args for r in lanes], pad, LANES)
         fn = self._batched_fn(static_key, inner)
+        # ONE shared dispatch span for the whole coalesced window, linked
+        # to every lane's eval span (the fan-in the flat metrics registry
+        # cannot attribute); the leader's eval hosts it, every linked
+        # trace gets it attached at end (obs/trace.py)
+        sp = trace.start_span(
+            "solver.microbatch.dispatch",
+            links=[r.ctx for r in lanes if r.ctx is not None],
+            tier="batch", bucket=LANES, lanes=len(lanes))
+        sctx = sp.ctx()
+        for req in lanes:
+            req.dispatch_ctx = sctx
         try:
             faults.fire("solver.microbatch.dispatch")
             out = np.asarray(fn(*cols))
@@ -209,6 +235,7 @@ class MicroBatcher:
             backend.breaker_record("batch", ok=False)
             metrics.incr("nomad.solver.microbatch.fanout")
             metrics.incr("nomad.solver.microbatch.fanout_lanes", len(lanes))
+            sp.end("fanout", fanout_lanes=len(lanes))
             for req in lanes:
                 try:
                     req.out = np.asarray(host_fn(*req.args))
@@ -216,7 +243,11 @@ class MicroBatcher:
                     req.err = le
                 req.event.set()
             return
+        except BaseException as e:      # noqa: BLE001 — non-demotable
+            sp.end("error", error=repr(e)[:200])
+            raise
         backend.breaker_record("batch", ok=True)
+        sp.end("ok")
         for row, req in enumerate(lanes):
             req.out = np.array(out[row])
             req.event.set()

@@ -38,6 +38,7 @@ from ..structs import (
 )
 from ..scheduler.stack import SelectOptions
 from . import backend, microbatch
+from ..obs import trace
 from .buckets import node_bucket, pow2
 from .tensorize import (
     build_group_tensors, _lower_affinities, _lower_distinct, _lower_spreads,
@@ -173,7 +174,9 @@ class SolverPlacer:
             if mi < 0:           # serial path (ineligible or scan-shaped)
                 # a declined pipeline hands its prep over: tensorize,
                 # shuffle, and the per-eval RNG draws must not run twice
-                with metrics.measure("nomad.solver.solve"):
+                with metrics.measure("nomad.solver.solve"), \
+                        trace.span("solver.solve", tg=tg_name,
+                                   count=len(missings)):
                     placed_map = self._solve_group(tg, nodes,
                                                    len(missings), prep=prep)
                 node_iter = [(node, k) for node, k in placed_map if k > 0]
@@ -181,7 +184,8 @@ class SolverPlacer:
                 # need no per-alloc exact pass: stamp out the allocations
                 # in one batch with shared (immutable-by-convention)
                 # resource/metric objects
-                with metrics.measure("nomad.solver.materialize"):
+                with metrics.measure("nomad.solver.materialize"), \
+                        trace.span("solver.materialize", tg=tg_name):
                     if node_iter and self._is_simple(tg):
                         mi = self._place_batch_simple(missings, tg,
                                                       node_iter,
@@ -203,7 +207,8 @@ class SolverPlacer:
             if rest:
                 # capacity exhausted: batched preemption pass (masked
                 # top-k victim selection on device, exact host verify)
-                with metrics.measure("nomad.solver.preempt"):
+                with metrics.measure("nomad.solver.preempt"), \
+                        trace.span("solver.preempt", tg=tg_name):
                     rest = self._preempt_batch(tg, rest, deployment_id)
             metrics.incr("nomad.solver.placements_batched",
                          len(missings) - len(rest))
@@ -606,7 +611,9 @@ class SolverPlacer:
         sched = self.sched
         count = len(missings)
         _, n_chunks, _ = self._pipeline_knobs()
-        with metrics.measure("nomad.solver.solve"):
+        with metrics.measure("nomad.solver.solve"), \
+                trace.span("solver.solve", tg=tg.name, count=count,
+                           pipelined=True):
             prep = self._prep_solve(tg, nodes, count)
             # deterministic full-curve depth solves only: the jittered
             # sampled-grid regime caps each SOLVE's per-node take at
@@ -723,7 +730,9 @@ class SolverPlacer:
             is_last = ci == len(futs) - 1
             node_iter = self._placed_node_iter(prep.gt.nodes, placed)
             target = plan.node_allocation if is_last else {}
-            with metrics.measure("nomad.solver.materialize"):
+            with metrics.measure("nomad.solver.materialize"), \
+                    trace.span("solver.materialize", tg=tg.name,
+                               pipelined=True):
                 mi = self._stamp_slice(shared, ids, names, prev_ids,
                                        node_iter, mi, len(missings), target)
             if not is_last and target:

@@ -307,6 +307,11 @@ class TensorCache:
                         metrics.incr("nomad.solver.state_cache.misses")
                         metrics.incr("nomad.solver.state_cache.stale")
                         src_cap, src_used = view.cap, view.used
+        # attribute the cache outcome onto the in-flight solve/dispatch
+        # span (ISSUE 7): src arrays being the view's == a miss served
+        # from the fallback path, the cache's == a hit
+        from ..obs import trace
+        trace.annotate(cache="miss" if src_cap is view.cap else "hit")
         out = GatherResult(src_cap[rows], src_used[rows])
         if dev is not None:
             out.cap_dev, out.used_dev = self._gather_device(dev, rows,

@@ -61,6 +61,16 @@ class SchedulerConfiguration:
                               ONLY while more evals than drained plans
                               are in flight (the micro-batcher's signal),
                               so a lone plan never waits.
+      telemetry_trace_enabled span-based eval tracing (nomad_tpu/obs/):
+                              False makes every instrumentation site a
+                              cheap no-op. NOMAD_TRACE=0/1 env overrides
+                              either way (docs/OBSERVABILITY.md).
+      telemetry_trace_sample  head-based sampling rate in [0,1] for
+                              HEALTHY traces; traces ending non-ok
+                              (faulted, failed, leadership lost) are
+                              always retained regardless.
+      telemetry_trace_capacity  how many completed traces the bounded
+                              in-memory store keeps for /v1/traces.
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -75,6 +85,9 @@ class SchedulerConfiguration:
     plan_commit_batch_max: int = 32
     plan_commit_timeout_s: float = 30.0
     plan_commit_window_ms: float = 5.0
+    telemetry_trace_enabled: bool = True
+    telemetry_trace_sample: float = 1.0
+    telemetry_trace_capacity: int = 2048
     create_index: int = 0
     modify_index: int = 0
 
@@ -98,4 +111,8 @@ class SchedulerConfiguration:
             return "plan_commit_timeout_s must be > 0"
         if self.plan_commit_window_ms < 0:
             return "plan_commit_window_ms must be >= 0"
+        if not 0.0 <= self.telemetry_trace_sample <= 1.0:
+            return "telemetry_trace_sample must be in [0, 1]"
+        if self.telemetry_trace_capacity < 1:
+            return "telemetry_trace_capacity must be >= 1"
         return ""

@@ -22,3 +22,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from nomad_tpu.runtime import ensure_native  # noqa: E402
 
 ensure_native()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _span_leak_check():
+    """ISSUE 7 satellite: no trace may complete with unclosed spans.
+    A leak is recorded ONLY when a root span ends while children are
+    still open (shutdown/flush paths use truncate and are exempt), so
+    this gate is deterministic — it cannot trip on evals merely still
+    in flight at teardown."""
+    from nomad_tpu.obs import trace
+    trace.take_leaked()         # don't blame this test for earlier noise
+    yield
+    leaked = trace.take_leaked()
+    assert not leaked, (
+        f"trace(s) completed with unclosed spans: {leaked} — every "
+        f"span must end (with-block or explicit .end()); shutdown "
+        f"paths that cut evals short must end_eval(truncate=True)")

@@ -239,3 +239,41 @@ def test_headline_rejection_parity_is_recorded():
         f"BENCH_r{latest_round:02d} lost rejection parity"
     assert latest.get("plan_nodes_rejected", 0) == 0, \
         f"BENCH_r{latest_round:02d} headline rejected nodes"
+
+
+def test_tracing_overhead_and_chain_completeness():
+    """ISSUE 7 acceptance: once a bench records the tracing block, the
+    enabled-mode overhead must stay <=5% of stream throughput, >=99% of
+    completed stream evals must carry a complete root-to-commit span
+    chain (fan-in links through the micro-batcher and the commit
+    coalescer included, where those paths fired), and the Chrome
+    trace-event export must be valid."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    overhead = latest.get("tracing_overhead_frac")
+    if overhead is None:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates eval tracing")
+    assert overhead <= 0.05, (
+        f"BENCH_r{latest_round:02d}: tracing overhead "
+        f"{overhead:.1%} breaches the 5% contract "
+        f"(docs/OBSERVABILITY.md)")
+    complete = latest.get("trace_complete_frac", 0.0)
+    assert complete >= 0.99, (
+        f"BENCH_r{latest_round:02d}: only {complete:.1%} of stream "
+        f"evals carried a complete root-to-commit span chain")
+    linked = latest.get("trace_fanin_linked_frac", 0.0)
+    assert linked >= 0.99, (
+        f"BENCH_r{latest_round:02d}: fan-in links missing on "
+        f"{1 - linked:.1%} of stream eval traces")
+    export = latest.get("trace_export", {})
+    assert export.get("valid") is True and export.get("events", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: Chrome trace export invalid: "
+        f"{export}")
+    attribution = latest.get("trace_attribution", {})
+    for key in ("queue_wait_p95", "fanin_width_p50", "dispatch_share",
+                "commit_wait_share"):
+        assert key in attribution, (
+            f"BENCH_r{latest_round:02d}: trace_attribution missing "
+            f"{key!r}")

@@ -530,7 +530,7 @@ def test_cli_nonexistent_path_fails(tmp_path):
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
-            "EXC001", "PERF001", "LEAD001"} <= ids
+            "EXC001", "PERF001", "LEAD001", "OBS001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -664,6 +664,82 @@ def test_perf001_inline_suppression():
                 tr = AllocatedTaskResources(cpu_shares=t.cpu)
     """
     assert rule_ids(src, path="scheduler/generic_sched.py") == []
+
+
+# ----------------------------------------------------------------- OBS001
+
+def test_obs001_fires_on_unbounded_metric_name_interpolation():
+    src = """
+        from nomad_tpu.metrics import metrics
+
+        def on_eval(ev):
+            metrics.incr(f"nomad.eval.done.{ev.id}")
+            metrics.add_sample("nomad.eval." + ev.job_id, 1.0)
+            metrics.set_gauge("nomad.node.%s" % node_name, 2.0)
+            metrics.incr("nomad.x." + ev.id + ".total")   # chained
+            metrics.incr(ev.id + ".total")                # left-side id
+    """
+    out = [f for f in findings(src) if f.rule == "OBS001"]
+    assert len(out) == 5
+    assert "unbounded" in out[0].message
+
+
+def test_obs001_allows_bounded_dimensions():
+    src = """
+        from nomad_tpu.metrics import metrics
+
+        def record(tier, kernel, ev):
+            metrics.incr(f"nomad.solver.backend.{tier}")
+            metrics.incr(f"nomad.solver.kernel.{kernel}.{tier}")
+            metrics.incr(f"nomad.worker.eval_failures.{ev.type}")
+            metrics.incr("nomad.plain.literal")
+            metrics.observe("nomad.dispatch_seconds", 0.1,
+                            labels={"tier": tier})
+    """
+    assert [f.rule for f in findings(src)
+            if f.rule == "OBS001"] == []
+
+
+def test_obs001_fires_on_discarded_measure_and_span():
+    src = """
+        from nomad_tpu.metrics import metrics
+        from nomad_tpu.obs import trace
+
+        def timed(work):
+            metrics.measure("nomad.work")      # never entered: records 0
+            trace.span("work")                 # same bug, span flavor
+            work()
+    """
+    out = [f for f in findings(src) if f.rule == "OBS001"]
+    assert len(out) == 2
+    assert "discarded" in out[0].message
+
+
+def test_obs001_with_blocks_and_combinators_are_quiet():
+    src = """
+        from contextlib import ExitStack
+        from nomad_tpu.metrics import metrics
+        from nomad_tpu.obs import trace
+
+        def timed(work):
+            with metrics.measure("nomad.work"), trace.span("work"):
+                work()
+            with ExitStack() as st:
+                st.enter_context(metrics.measure("nomad.other"))
+                work()
+    """
+    assert [f.rule for f in findings(src) if f.rule == "OBS001"] == []
+
+
+def test_obs001_inline_suppression():
+    src = """
+        from nomad_tpu.metrics import metrics
+
+        def on_fault(site):
+            # nomadlint: disable=OBS001 — bounded per-site fault set
+            metrics.incr(f"nomad.faults.fired.{site}")
+    """
+    assert [f.rule for f in findings(src) if f.rule == "OBS001"] == []
 
 
 # ------------------------------------------------------------- tier-1 gate

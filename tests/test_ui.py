@@ -47,12 +47,16 @@ def test_ui_references_all_views(agent):
         body = r.read().decode()
     for view in ("jobs", "deployments", "nodes", "topology", "services",
                  "events", "evals", "alloc", "tailLogs", "runExec",
-                 "depAction", "Versions"):
+                 "depAction", "Versions", "traces", "metrics"):
         assert view in body, f"UI missing view/function {view}"
     # topology utilization meters + ACL token plumbing
     for frag in ("NodeResources", "X-Nomad-Token", "tokenbox",
                  "class=\"meter\""):
         assert frag in body, f"UI missing {frag}"
+    # ISSUE 7: eval waterfall panel + histogram-bucket rendering
+    for frag in ("/traces", "wftrack", "linked_spans", "class=\"hist\"",
+                 "buckets", "format=chrome"):
+        assert frag in body, f"UI missing trace/metrics fragment {frag}"
 
 
 # ------------------------------------------- live-cluster UI data contract
