@@ -19,10 +19,13 @@ from ..structs import (
 
 
 class HTTPError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, retry_after: float = 0.0):
         super().__init__(message)
         self.code = code
         self.message = message
+        # 429 responses carry the admission bucket's earliest-retry hint
+        # as a Retry-After header (ISSUE 8; api/client.py honors it)
+        self.retry_after = retry_after
 
 
 def require(ok: bool) -> None:
@@ -87,6 +90,21 @@ class HTTPAPI:
         ns = query.get("namespace", "default")
         body = body or {}   # body-less PUT/POST is an empty request
 
+        # ---- ingress admission (ISSUE 8): per-endpoint-class token
+        # buckets BEFORE ACL resolution or any state read — an over-rate
+        # caller costs one bucket probe. /v1/status and /v1/metrics stay
+        # admissible under overload: they are how operators SEE the
+        # overload (and how monitoring tells saturated from down).
+        if parts and parts[0] not in ("status", "metrics"):
+            from ..server.overload import RateLimitExceeded
+            ctrl = getattr(s, "overload", None)
+            if ctrl is not None:
+                try:
+                    ctrl.admit(ctrl.classify_http(method, query))
+                except RateLimitExceeded as e:
+                    raise HTTPError(429, str(e),
+                                    retry_after=e.retry_after_s)
+
         # ---- ACL resolution (ref command/agent/http.go parseToken +
         # per-endpoint aclObj checks)
         from ..acl import (
@@ -129,7 +147,13 @@ class HTTPAPI:
 
         def blocking(index_fn, payload_fn):
             min_index = int(query.get("index", 0) or 0)
-            wait = min(float(query.get("wait", "0").rstrip("s") or 0), 30.0)
+            # the hold ceiling shrinks under pressure (brownout, ISSUE 8):
+            # parked long-polls are the cheapest capacity to reclaim, and
+            # a shorter hold degrades watchers to polling instead of 500s
+            cap_s = s.overload.blocking_cap_s() \
+                if getattr(s, "overload", None) is not None else 30.0
+            wait = min(float(query.get("wait", "0").rstrip("s") or 0),
+                       cap_s)
             if min_index and wait:
                 deadline = time.time() + wait
                 while index_fn() <= min_index and time.time() < deadline:
@@ -753,6 +777,11 @@ class HTTPAPI:
             if getattr(s, "gossip", None) is not None:
                 return s.regions(), None
             return [self.agent.config.region], None
+        if parts == ["status"]:
+            # liveness + the overload/pressure block (docs/OVERLOAD.md) —
+            # exempt from admission control above so operators can still
+            # see a saturated server saturating
+            return s.status_summary(), None
         if parts == ["status", "peers"]:
             peers = getattr(s.raft, "peers", None)
             if peers:
@@ -1233,7 +1262,14 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
                 payload, index = api.handle(method, parsed.path, query, body,
                                             token=token)
             except HTTPError as e:
-                self._respond(e.code, {"error": e.message})
+                headers = {}
+                if e.retry_after:
+                    # admission rejection: tell the caller WHEN a retry
+                    # can succeed (fractional seconds are legal per RFC
+                    # 9110 delta-seconds rounding up; the Python client
+                    # parses either form)
+                    headers["Retry-After"] = f"{max(0.001, e.retry_after):.3f}"
+                self._respond(e.code, {"error": e.message}, headers)
                 return
             except (KeyError,) as e:
                 self._respond(404, {"error": str(e)})

@@ -6,6 +6,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -13,10 +15,14 @@ from typing import Any, Iterator, Optional
 
 
 class APIError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after_s: float = 0.0):
         super().__init__(f"{status}: {message}")
         self.status = status
         self.message = message
+        # 429 responses carry the server's Retry-After hint (ISSUE 8);
+        # 0.0 on every other status
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass
@@ -44,12 +50,20 @@ class Client:
     """ref api/api.go NewClient"""
 
     def __init__(self, address: str = "", token: str = "",
-                 namespace: str = "", timeout: float = 65.0):
+                 namespace: str = "", timeout: float = 65.0,
+                 retry_429: int = 3, retry_budget_s: float = 15.0):
         self.address = (address or os.environ.get("NOMAD_ADDR")
                         or "http://127.0.0.1:4646").rstrip("/")
         self.token = token or os.environ.get("NOMAD_TOKEN", "")
         self.namespace = namespace or os.environ.get("NOMAD_NAMESPACE", "")
         self.timeout = timeout
+        # 429 handling (ISSUE 8 satellite): honor Retry-After with
+        # jittered backoff, at most `retry_429` retries and never more
+        # than `retry_budget_s` total sleep per call — both knobs exist
+        # so tests (and latency-sensitive callers) stay bounded;
+        # retry_429=0 restores raise-immediately.
+        self.retry_429 = max(0, int(retry_429))
+        self.retry_budget_s = max(0.0, float(retry_budget_s))
 
         self.jobs = Jobs(self)
         self.allocations = Allocations(self)
@@ -97,22 +111,43 @@ class Client:
                 json.dumps(body).encode()
         if self.token:
             headers["X-Nomad-Token"] = self.token
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = resp.read()
-                meta = QueryMeta(last_index=int(
-                    resp.headers.get("X-Nomad-Index", 0) or 0))
-                if raw:
-                    return payload, meta
-                return (json.loads(payload) if payload else None), meta
-        except urllib.error.HTTPError as e:
+        slept = 0.0
+        for attempt in range(self.retry_429 + 1):
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=headers)
             try:
-                msg = json.loads(e.read() or b"{}").get("error", str(e))
-            except (json.JSONDecodeError, OSError):
-                msg = str(e)
-            raise APIError(e.code, msg)
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    payload = resp.read()
+                    meta = QueryMeta(last_index=int(
+                        resp.headers.get("X-Nomad-Index", 0) or 0))
+                    if raw:
+                        return payload, meta
+                    return (json.loads(payload) if payload else None), meta
+            except urllib.error.HTTPError as e:
+                try:
+                    msg = json.loads(e.read() or b"{}").get("error", str(e))
+                except (json.JSONDecodeError, OSError):
+                    msg = str(e)
+                retry_after = 0.0
+                if e.code == 429:
+                    try:
+                        retry_after = float(
+                            e.headers.get("Retry-After", 1.0) or 1.0)
+                    except (TypeError, ValueError):
+                        retry_after = 1.0
+                if e.code != 429 or attempt >= self.retry_429:
+                    raise APIError(e.code, msg, retry_after_s=retry_after)
+                # jittered backoff (ISSUE 8): the hint plus up to 50%
+                # random spread so a herd of rejected clients does not
+                # re-synchronize on the same refill instant; the budget
+                # bounds total sleep per call regardless of the hint
+                delay = retry_after * (1.0 + 0.5 * random.random())
+                if slept + delay > self.retry_budget_s:
+                    raise APIError(e.code, msg, retry_after_s=retry_after)
+                time.sleep(delay)
+                slept += delay
+        raise AssertionError("unreachable: 429 retry loop fell through")
 
     def get(self, endpoint: str, q: Optional[QueryOptions] = None,
             raw: bool = False, **params) -> tuple[Any, QueryMeta]:

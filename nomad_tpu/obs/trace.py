@@ -160,6 +160,12 @@ class Tracer:
         self._tls = threading.local()
         self._enabled = os.environ.get("NOMAD_TRACE", "") != "0"
         self._sample_rate = 1.0
+        # overload brownout multiplier (ISSUE 8, server/overload.py):
+        # under pressure HEALTHY-trace head-sampling downshifts without
+        # touching the operator's configured rate — error retention is
+        # unaffected (non-ok endings are always kept), so the traces
+        # that explain the overload survive it
+        self._pressure_factor = 1.0
         self._capacity = DEFAULT_CAPACITY
         self._rng = random.Random()
         self._seq = itertools.count(1)
@@ -188,6 +194,14 @@ class Tracer:
             self._sample_rate = min(1.0, max(0.0, float(sample_rate)))
         if capacity is not None and int(capacity) >= 1:
             self._capacity = int(capacity)
+
+    def set_pressure_factor(self, factor: float) -> None:
+        """Overload-controller lever: scales the head-sampling rate for
+        healthy traces (1.0 = no downshift). Kept separate from
+        configure() — the worker re-pushes the config rate every eval
+        and must not erase the controller's downshift."""
+        with self._lock:
+            self._pressure_factor = min(1.0, max(0.0, float(factor)))
 
     def enabled(self) -> bool:
         return self._enabled
@@ -282,8 +296,8 @@ class Tracer:
                         return tr.root.ctx()
                     stale = tr
                     del self._by_eval[eval_id]
-            sampled = self._sample_rate >= 1.0 or \
-                self._rng.random() < self._sample_rate
+            rate = self._sample_rate * self._pressure_factor
+            sampled = rate >= 1.0 or self._rng.random() < rate
             tid = self._new_id()
             tr = _Trace(tid, eval_id, name, sampled, retain=False)
             if owner is not None:
@@ -543,6 +557,7 @@ class Tracer:
         with self._lock:
             return {"enabled": self._enabled,
                     "sample_rate": self._sample_rate,
+                    "pressure_factor": self._pressure_factor,
                     "capacity": self._capacity,
                     "live": len(self._live), "retained": len(self._done),
                     "started": self.started, "dropped": self.dropped}
@@ -564,6 +579,7 @@ class Tracer:
             self._leaked = []
             self.started = 0
             self.dropped = 0
+            self._pressure_factor = 1.0
 
 
 # ------------------------------------------------------------------ exports
@@ -664,6 +680,7 @@ tracer = Tracer()
 # not the object — one process-wide tracer matches the one-store,
 # one-device reality, exactly like solver/microbatch.py)
 configure = tracer.configure
+set_pressure_factor = tracer.set_pressure_factor
 enabled = tracer.enabled
 current = tracer.current
 use = tracer.use

@@ -10,7 +10,9 @@ import threading
 import time
 from typing import Optional
 
-from .codec import NotLeaderError, RpcError, recv_msg, send_msg
+from .codec import (
+    NotLeaderError, RateLimitError, RpcError, recv_msg, send_msg,
+)
 from .server import DEFAULT_KEY
 
 
@@ -116,6 +118,13 @@ class RpcClient:
         failover tests exercise EXACTLY the production error mapping."""
         if resp.get("kind") == "NotLeaderError":
             raise NotLeaderError(resp.get("error") or "")
+        if resp.get("kind") == "RateLimitError":
+            # admission rejection (ISSUE 8): typed so callers can back
+            # off for the server's hinted interval instead of retrying
+            # against another server (the limit is per ingress door, but
+            # hammering siblings is exactly what shed load must not do)
+            raise RateLimitError(resp.get("error") or "rate limited",
+                                 retry_after_s=resp.get("retry_after", 1.0))
         if "error" in resp and resp["error"] is not None \
                 and "result" not in resp:
             raise RpcError(resp["error"], kind=resp.get("kind", "RpcError"))

@@ -42,6 +42,17 @@ class RpcError(Exception):
         self.kind = kind
 
 
+class RateLimitError(RpcError):
+    """The server's ingress admission bucket rejected the call (ISSUE 8
+    overload protection). `retry_after_s` is the server's earliest-retry
+    hint; callers back off (with jitter) instead of hammering — the RPC
+    twin of HTTP 429 + Retry-After."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message, kind="RateLimitError")
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
 class NotLeaderError(Exception):
     """Write hit a follower (ref nomad/rpc.go forward). .leader_addr may
     name the current leader's rpc address ("host:port") or be empty."""

@@ -35,6 +35,10 @@ class RpcDispatcher:
         self.logger = logger or (lambda msg: None)
         self.tls = tls
         self._handlers: dict[str, tuple[Callable, bool]] = {}
+        # ingress admission hook (ISSUE 8): (method, leader_only) -> None
+        # or raise something with `retry_after_s`. Wired by the Server to
+        # its OverloadController; None (the default) admits everything.
+        self.admission_fn: Optional[Callable] = None
         # wired by the consensus layer: () -> (is_leader, leader_rpc_addr)
         self.leadership_fn: Callable[[], tuple[bool, str]] = lambda: (True, "")
         # cross-region forwarding (ref nomad/rpc.go forwardRegion): wired
@@ -78,6 +82,24 @@ class RpcDispatcher:
             return {"seq": seq, "error": f"unknown rpc method {method!r}",
                     "kind": "RpcError"}
         fn, leader_only = entry
+        if self.admission_fn is not None:
+            # admission BEFORE leader forwarding: an over-rate write is
+            # rejected at whichever server it hit, not proxied to pile
+            # onto the leader (the leader's own dispatcher admits again
+            # for forwarded traffic — both doors are guarded)
+            try:
+                self.admission_fn(method, leader_only)
+            except Exception as e:      # noqa: BLE001 — envelope, not raise
+                retry = getattr(e, "retry_after_s", None)
+                if retry is None:
+                    # a controller BUG is not throttling: surface the
+                    # real error kind so callers fail fast instead of
+                    # treating an internal error as a backoff-forever
+                    # rate limit
+                    return {"seq": seq, "error": str(e),
+                            "kind": type(e).__name__}
+                return {"seq": seq, "error": str(e),
+                        "kind": "RateLimitError", "retry_after": retry}
         if leader_only:
             is_leader, leader_addr = self.leadership_fn()
             if not is_leader and not leader_addr:

@@ -8,21 +8,31 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..metrics import metrics
 from ..structs import Evaluation, TRIGGER_MAX_PLANS
+
+# per-tracker capture ceiling (ISSUE 8): blocked evals dedup per job, so
+# this only binds when MORE JOBS than this are simultaneously
+# unplaceable — at which point capturing further evals just defers the
+# same capacity verdict. Overflow drops the lowest-priority capture
+# (counted), which simply re-blocks on its next evaluation.
+DEFAULT_MAX_CAPTURED = 16_384
 
 
 class BlockedEvals:
-    def __init__(self, enqueue_fn: Callable[[Evaluation], None]):
+    def __init__(self, enqueue_fn: Callable[[Evaluation], None],
+                 max_captured: int = DEFAULT_MAX_CAPTURED):
         self._lock = threading.Lock()
         self._enabled = False
         self.enqueue_fn = enqueue_fn
+        self.max_captured = max_captured
         # eval_id -> eval
         self._captured: dict[str, Evaluation] = {}
         # (namespace, job_id) -> eval_id (one blocked eval per job)
         self._by_job: dict[tuple[str, str], str] = {}
         self._escaped: set[str] = set()
         self.stats = {"total_blocked": 0, "total_escaped": 0,
-                      "total_unblocked": 0}
+                      "total_unblocked": 0, "total_dropped": 0}
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -43,6 +53,21 @@ class BlockedEvals:
             if old_id and old_id in self._captured:
                 old = self._captured.pop(old_id)
                 self._escaped.discard(old_id)
+            if self.max_captured > 0 and old_id is None and \
+                    len(self._captured) >= self.max_captured:
+                # cap (ISSUE 8): drop the lowest-priority capture (the
+                # incoming eval included) — counted, never silent
+                victim_id = min(self._captured,
+                                key=lambda i: self._captured[i].priority)
+                if self._captured[victim_id].priority >= ev.priority:
+                    metrics.incr("nomad.blocked_evals.dropped")
+                    self.stats["total_dropped"] += 1
+                    return
+                victim = self._captured.pop(victim_id)
+                self._escaped.discard(victim_id)
+                self._by_job.pop((victim.namespace, victim.job_id), None)
+                metrics.incr("nomad.blocked_evals.dropped")
+                self.stats["total_dropped"] += 1
             self._captured[ev.id] = ev
             self._by_job[job_key] = ev.id
             if ev.escaped_computed_class or not ev.class_eligibility:

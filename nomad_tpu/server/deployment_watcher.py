@@ -10,9 +10,9 @@ follow-up evals so the scheduler places the next max_parallel batch.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
+from .. import chrono
 from ..structs import (
     Deployment, DeploymentStatusUpdate, Evaluation,
     DEPLOYMENT_STATUS_FAILED, DEPLOYMENT_STATUS_RUNNING,
@@ -33,9 +33,14 @@ DESC_FAILED_REVERT = ("Failed due to unhealthy allocations - rolling back "
 
 
 class DeploymentWatcher:
-    def __init__(self, server, poll_interval: float = 0.25):
+    def __init__(self, server, poll_interval: float = 0.25,
+                 clock: Optional[chrono.Clock] = None):
         self.server = server
         self.poll_interval = poll_interval
+        # progress-deadline DECISIONS ride the clock (ISSUE 8 satellite):
+        # "the deployment made no progress for progress_deadline_sec" is
+        # testable with ManualClock.advance() instead of real sleeps
+        self.clock = clock or chrono.REAL
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # deployment_id -> alloc_id -> last folded verdict; a changed verdict
@@ -91,7 +96,7 @@ class DeploymentWatcher:
         if healthy or unhealthy:
             self.server.raft.apply(DEPLOYMENT_ALLOC_HEALTH, {
                 "deployment_id": d.id, "healthy": healthy,
-                "unhealthy": unhealthy, "timestamp": time.time()})
+                "unhealthy": unhealthy, "timestamp": self.clock.time()})
             d = state.deployment_by_id(d.id)
             if d is None or not d.active():
                 return
@@ -99,13 +104,13 @@ class DeploymentWatcher:
         # progress deadline bookkeeping
         deadline = self._progress_by.get(d.id)
         if deadline is None:
-            deadline = time.time() + max(
+            deadline = self.clock.time() + max(
                 (st.progress_deadline_sec or 600.0)
                 for st in d.task_groups.values()) if d.task_groups else \
-                time.time() + 600.0
+                self.clock.time() + 600.0
             self._progress_by[d.id] = deadline
         if made_progress:
-            self._progress_by[d.id] = time.time() + max(
+            self._progress_by[d.id] = self.clock.time() + max(
                 (st.progress_deadline_sec or 600.0)
                 for st in d.task_groups.values())
 
@@ -114,7 +119,7 @@ class DeploymentWatcher:
             self._fail(d, DESC_UNHEALTHY_ALLOCS)
             return
 
-        if time.time() >= self._progress_by[d.id] and \
+        if self.clock.time() >= self._progress_by[d.id] and \
            not self._complete_check(d):
             self._fail(d, DESC_PROGRESS_DEADLINE)
             return

@@ -6,9 +6,9 @@ drains at the deadline, and lifts the drain when the node is empty.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
+from .. import chrono
 from ..structs import (
     DesiredTransition, Evaluation, EVAL_STATUS_PENDING, JOB_TYPE_SYSTEM,
     TRIGGER_NODE_DRAIN,
@@ -17,9 +17,14 @@ from .fsm import ALLOC_UPDATE_DESIRED_TRANSITION, NODE_UPDATE_DRAIN
 
 
 class NodeDrainer:
-    def __init__(self, server, poll_interval: float = 0.25):
+    def __init__(self, server, poll_interval: float = 0.25,
+                 clock: Optional[chrono.Clock] = None):
         self.server = server
         self.poll_interval = poll_interval
+        # deadline DECISIONS ride the clock (ISSUE 8 satellite): a
+        # ManualClock test advances virtual time past the force deadline
+        # instead of sleeping it out; the poll cadence stays real
+        self.clock = clock or chrono.REAL
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -54,7 +59,7 @@ class NodeDrainer:
         strategy = node.drain_strategy
         force = (strategy.deadline_sec < 0 or
                  (strategy.force_deadline_unix and
-                  time.time() >= strategy.force_deadline_unix))
+                  self.clock.time() >= strategy.force_deadline_unix))
 
         remaining = []
         for alloc in state.allocs_by_node(node.id):

@@ -12,6 +12,17 @@ every dequeue/ack/nack pushes the outstanding-eval count to
 solver/microbatch.py, so a worker's small solve knows whether sibling
 evals are in flight (worth waiting the coalescing window for) before the
 siblings have even reached their own solve call.
+
+The broker is also the first line of overload protection (ISSUE 8):
+its backlog is bounded by the hot-reloadable `broker_depth_cap`, and on
+overflow the LOWEST-priority queued eval — deterministically by
+(priority, seq): lowest priority first, newest arrival within a
+priority — is shed into the existing dead-letter lifecycle, where the
+leader reaper terminates it and emits a backed-off failed-follow-up.
+Shed work retries with backoff instead of vanishing; core/system evals
+are never shed. Evals are stamped with an enqueue TTL
+(`eval_deadline_s`) so downstream stages can drop work whose caller
+already gave up (worker.py, plan_apply.py; docs/OVERLOAD.md).
 """
 from __future__ import annotations
 
@@ -19,11 +30,13 @@ import heapq
 import itertools
 import threading
 import time
-from typing import Optional
+from collections import deque
+from typing import Callable, Optional
 
-from ..metrics import metrics
+from .. import faults
+from ..metrics import metrics, record_swallowed_error
 from ..obs import trace
-from ..structs import Evaluation, new_id
+from ..structs import Evaluation, TRIGGER_FAILED_FOLLOW_UP, new_id
 
 DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_INITIAL_NACK_DELAY = 1.0
@@ -31,16 +44,47 @@ DEFAULT_SUBSEQUENT_NACK_DELAY = 20.0
 
 FAILED_QUEUE = "_failed"
 
+# scheduler types exempt from overload shedding: internal housekeeping
+# (`_core`) and system jobs keep the cluster itself alive — shedding them
+# to make room for user load would trade availability for goodput
+SHED_EXEMPT_TYPES = frozenset({"_core", "system"})
+
 
 class EvalBroker:
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
                  initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
                  subsequent_nack_delay: float = DEFAULT_SUBSEQUENT_NACK_DELAY,
-                 delivery_limit: int = 3):
+                 delivery_limit: int = 3,
+                 config_fn: Optional[Callable] = None):
         self.nack_timeout = nack_timeout
         self.initial_nack_delay = initial_nack_delay
         self.subsequent_nack_delay = subsequent_nack_delay
         self.delivery_limit = delivery_limit
+        # overload knobs (ISSUE 8): `config_fn` returns the live
+        # SchedulerConfiguration (hot-reloadable; the server wires
+        # state.get_scheduler_config); without one the explicit
+        # attributes apply (0 = unbounded / no TTL — standalone brokers
+        # in unit tests keep the pre-overload behavior)
+        self.config_fn = config_fn
+        self.depth_cap = 0
+        self.eval_deadline_s = 0.0
+        # poked whenever the cap trips (shed or exempt-overflow) so the
+        # pressure state reacts to a sub-second burst instead of waiting
+        # for the next 1s leader tick; the server wires overload.tick
+        self.on_overflow: Optional[Callable] = None
+        # (priority, seq, eval_id) of recent sheds — the hammer test's
+        # determinism witness; bounded so a shed storm cannot leak
+        self.shed_log: deque = deque(maxlen=4096)
+        # heap entries invalidated by a shed: the eval moved to the
+        # FAILED_QUEUE heap but stays in self._evals, so the stale-entry
+        # skip in _pick_locked can't key on eval id alone
+        self._shed_entries: set = set()
+        # delayed failed-follow-ups (the shed/dead-letter RETRY channel)
+        # parked in the delay heap: excluded from the depth the cap
+        # bounds — they are backoff-parked retries, not offered load,
+        # and counting them would let one burst's follow-ups re-trigger
+        # shedding forever (shed -> follow-up -> depth -> shed ...)
+        self._waiting_follow_ups = 0
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -64,7 +108,7 @@ class EvalBroker:
 
         self.stats = {"total_ready": 0, "total_unacked": 0,
                       "total_pending": 0, "total_waiting": 0,
-                      "total_failed": 0}
+                      "total_failed": 0, "total_shed": 0}
 
     def _notify_inflight(self) -> None:
         """Push the outstanding-eval count to the solver micro-batcher
@@ -117,6 +161,8 @@ class EvalBroker:
         self._unack.clear()
         self._dequeue_count.clear()
         self._delay_heap = []
+        self._shed_entries.clear()
+        self._waiting_follow_ups = 0
         self._shutdown = True
         # every stat is maintained incrementally (+=/-=) against the
         # queues just cleared — zero them ALL or the stats endpoint
@@ -128,6 +174,120 @@ class EvalBroker:
         self.stats["total_failed"] = 0
         metrics.set_gauge("nomad.broker.failed_queue_depth", 0)
         self._notify_inflight()
+
+    # ---------------------------------------------------- overload (ISSUE 8)
+
+    def _overload_knobs(self) -> tuple[int, float]:
+        """(depth_cap, eval_deadline_s) from the live scheduler config
+        when wired, else the explicit attributes. Reads are two attribute
+        lookups on an in-memory dataclass — cheap enough per enqueue."""
+        cfg = self.config_fn() if self.config_fn is not None else None
+        if cfg is None:
+            return self.depth_cap, self.eval_deadline_s
+        try:
+            return (max(0, int(getattr(cfg, "broker_depth_cap", 0))),
+                    max(0.0, float(getattr(cfg, "eval_deadline_s", 0.0))))
+        except (TypeError, ValueError):
+            return 0, 0.0
+
+    def depth(self) -> int:
+        """Queued backlog the depth cap bounds: ready + job-pending +
+        delayed, MINUS dead letters (they ride the ready stat but await
+        the reaper — counting them would let a shed storm re-trigger
+        itself) and unacked (bounded by worker count, already in flight)."""
+        with self._lock:
+            return self._depth_locked()
+
+    def _depth_locked(self) -> int:
+        return max(0, self.stats["total_ready"] - self.stats["total_failed"]
+                   + self.stats["total_pending"]
+                   + self.stats["total_waiting"]
+                   - self._waiting_follow_ups)
+
+    def _delay_push_locked(self, when: float, ev: Evaluation) -> None:
+        # callers are bounded: enqueue is depth-cap/shed gated, nack by
+        # the delivery limit
+        # nomadlint: disable=QUEUE001 — caller-bounded (above)
+        heapq.heappush(self._delay_heap, (when, next(self._seq), ev))
+        self.stats["total_waiting"] += 1
+        if ev.triggered_by == TRIGGER_FAILED_FOLLOW_UP:
+            self._waiting_follow_ups += 1
+
+    def _shed_candidates_locked(self):
+        """Live, non-exempt ready entries: (neg_priority, seq, eval_id)
+        tuples. The victim is max() of these — lowest priority first,
+        newest seq within a priority (deterministic by (priority, seq)).
+        Deliberately O(ready) per shed: this is the over-cap emergency
+        path only (bounded by the cap itself), and a mirrored max-heap
+        would need exact-entry liveness tracking across dequeue/nack/
+        drain to avoid double-delivery — complexity the correctness
+        tests would have to re-prove. Revisit if shed-path lock hold
+        time ever shows up in the bench."""
+        out = []
+        for qname, heap in self._ready.items():
+            if qname == FAILED_QUEUE or qname in SHED_EXEMPT_TYPES:
+                continue
+            out.extend(
+                e for e in heap
+                if e[2] in self._evals and e not in self._shed_entries
+                # follow-ups are never victims: re-shedding the shed
+                # channel's own retries is a reap<->shed cycle
+                and self._evals[e[2]].triggered_by
+                != TRIGGER_FAILED_FOLLOW_UP)
+        return out
+
+    def _shed_locked(self, incoming: Evaluation, incoming_key) -> bool:
+        """Make room for `incoming` by dead-lettering the lowest-priority
+        queued eval (possibly `incoming` itself). Returns True when the
+        incoming eval was the victim (caller must not enqueue it). The
+        shed eval re-enters via the failed-eval backoff lifecycle: the
+        reaper terminates it and emits a delayed failed-follow-up, so
+        shed work retries instead of vanishing (core_sched.py)."""
+        victims = self._shed_candidates_locked()
+        if incoming.type not in SHED_EXEMPT_TYPES:
+            victims.append(incoming_key)
+        if not victims:
+            # backlog is all core/system work: admit over cap — shedding
+            # the cluster's own housekeeping is never the right trade
+            metrics.incr("nomad.broker.shed_exempt_overflow")
+            return False
+        victim = max(victims)
+        neg_p, seq, eval_id = victim
+        self.shed_log.append((-neg_p, seq, eval_id))
+        metrics.incr("nomad.broker.shed")
+        self.stats["total_shed"] = self.stats.get("total_shed", 0) + 1
+        if victim is incoming_key:
+            ev = incoming
+            self._evals[eval_id] = ev
+            job_key = (ev.namespace, ev.job_id)
+            if ev.job_id and job_key not in self._ready_jobs and \
+                    job_key not in self._outstanding_jobs:
+                # claim the job only when unclaimed: a shed incoming
+                # whose job already has a ready/outstanding eval must
+                # not steal that eval's dedup registration
+                self._ready_jobs[job_key] = eval_id
+        else:
+            ev = self._evals[eval_id]
+            self._shed_entries.add(victim)
+            self.stats["total_ready"] -= 1
+            # the eval stays in self._evals and keeps its _ready_jobs
+            # claim — it is still "ready", just on the dead-letter queue
+            # (exactly the nack-at-delivery-limit shape)
+        # fresh seq on the dead-letter entry: the tombstone set matches
+        # by tuple VALUE, so the failed-queue twin must never compare
+        # equal to the invalidated original
+        heapq.heappush(self._ready.setdefault(FAILED_QUEUE, []),
+                       (neg_p, next(self._seq), eval_id))
+        self.stats["total_ready"] += 1
+        self.stats["total_failed"] += 1
+        metrics.set_gauge("nomad.broker.failed_queue_depth",
+                          self.stats["total_failed"])
+        # the shed disposition ends the eval's trace (PR-7): the retry
+        # is a NEW eval (the follow-up) with its own trace
+        trace.end_eval(eval_id, "shed", owner=id(self),
+                       priority=ev.priority, shed_seq=seq)
+        self._cond.notify_all()
+        return victim is incoming_key
 
     # ------------------------------------------------------------- enqueue
 
@@ -160,16 +320,58 @@ class EvalBroker:
                          type=ev.type, trigger=ev.triggered_by,
                          priority=ev.priority)
         now = time.time()
+        cap, ttl = self._overload_knobs()
+        parking = bool((ev.wait_until_unix and ev.wait_until_unix > now)
+                       or ev.wait_sec)
+        if ttl > 0 and not ev.deadline_unix and not parking and \
+                ev.type not in SHED_EXEMPT_TYPES:
+            # enqueue TTL (ISSUE 8): stamped on a COPY — the caller's
+            # object may be the raft-replicated state eval, which this
+            # leader-local deadline must not mutate. The clock starts
+            # when the eval becomes RUNNABLE offered load: evals headed
+            # for the delay heap (backed-off follow-ups, delayed
+            # reschedules) are deliberately parked future work and get
+            # their TTL at graduation — stamping them here would expire
+            # every retry whose backoff exceeds the TTL, silently
+            # voiding the shed/dead-letter contract. Requeues of
+            # already-stamped evals (nack delay, pending release) keep
+            # the ORIGINAL deadline. Core/system evals are
+            # deadline-exempt like they are shed-exempt: expiring
+            # housekeeping under load would drop exactly the work that
+            # keeps the cluster healthy.
+            ev = ev.copy()
+            ev.deadline_unix = now + ttl
+        if cap > 0 and self._depth_locked() >= cap and \
+                ev.triggered_by != TRIGGER_FAILED_FOLLOW_UP:
+            # follow-ups BYPASS the cap: they are the shed/dead-letter
+            # lifecycle's own retry channel — capping them re-sheds what
+            # shedding just parked, a cycle by construction
+            try:
+                faults.fire("broker.shed")
+                incoming_was_victim = self._shed_locked(
+                    ev, (-ev.priority, next(self._seq), ev.id))
+            except Exception as e:   # noqa: BLE001 — injected/shed failure
+                # a failed shed (injected fault, accounting error) must
+                # not lose the INCOMING eval: admit over cap, loudly —
+                # availability beats a strict cap when the shedder breaks
+                record_swallowed_error("broker.shed", e)
+                incoming_was_victim = False
+            if self.on_overflow is not None:
+                # pressure reacts NOW, not at the next 1s leader tick —
+                # safe under the (reentrant) broker lock: tick reads
+                # depth back through it on this same thread
+                try:
+                    self.on_overflow()
+                except Exception as e:   # noqa: BLE001 — telemetry hook
+                    record_swallowed_error("broker.overflow_hook", e)
+            if incoming_was_victim:
+                return
         if ev.wait_until_unix and ev.wait_until_unix > now:
-            heapq.heappush(self._delay_heap,
-                           (ev.wait_until_unix, next(self._seq), ev))
-            self.stats["total_waiting"] += 1
+            self._delay_push_locked(ev.wait_until_unix, ev)
             self._cond.notify_all()
             return
         if ev.wait_sec:
-            heapq.heappush(self._delay_heap,
-                           (now + ev.wait_sec, next(self._seq), ev))
-            self.stats["total_waiting"] += 1
+            self._delay_push_locked(now + ev.wait_sec, ev)
             self._cond.notify_all()
             return
         job_key = (ev.namespace, ev.job_id)
@@ -219,8 +421,13 @@ class EvalBroker:
         best_queue = None
         for sched in schedulers:
             heap = self._ready.get(sched)
-            while heap and heap[0][2] not in self._evals:
-                heapq.heappop(heap)  # stale entry
+            # stale entries: acked/drained evals (id gone) and shed
+            # tombstones (the eval moved to the dead-letter queue but
+            # keeps its id registration — match by entry VALUE)
+            while heap and (heap[0][2] not in self._evals
+                            or heap[0] in self._shed_entries):
+                self._shed_entries.discard(heap[0])
+                heapq.heappop(heap)
             if not heap:
                 continue
             if best_key is None or heap[0] < best_key:
@@ -325,9 +532,7 @@ class EvalBroker:
             else:
                 delay = (self.initial_nack_delay if count == 1
                          else self.subsequent_nack_delay)
-                heapq.heappush(self._delay_heap,
-                               (time.time() + delay, next(self._seq), ev))
-                self.stats["total_waiting"] += 1
+                self._delay_push_locked(time.time() + delay, ev)
             self._notify_inflight()
             self._cond.notify_all()
 
@@ -352,7 +557,6 @@ class EvalBroker:
         enqueue/restore_failed if that commit fails. Pending evals
         blocked behind a drained eval's job are released, like an ack
         would. Returns (dead_letters, follow_ups)."""
-        from ..structs import TRIGGER_FAILED_FOLLOW_UP
         with self._lock:
             heap = self._ready.get(FAILED_QUEUE, [])
             drained = [self._evals.pop(eid) for _, _, eid in heap
@@ -367,6 +571,8 @@ class EvalBroker:
                 if item[2].triggered_by == TRIGGER_FAILED_FOLLOW_UP:
                     follows.append(item[2])
                     self.stats["total_waiting"] -= 1
+                    self._waiting_follow_ups = max(
+                        0, self._waiting_follow_ups - 1)
                 else:
                     keep.append(item)
             if follows:
@@ -422,6 +628,11 @@ class EvalBroker:
                 while self._delay_heap and self._delay_heap[0][0] <= now:
                     _, _, ev = heapq.heappop(self._delay_heap)
                     self.stats["total_waiting"] -= 1
+                    if ev.triggered_by == TRIGGER_FAILED_FOLLOW_UP:
+                        # graduating from backoff: it becomes real
+                        # offered load again (counts toward the cap)
+                        self._waiting_follow_ups = max(
+                            0, self._waiting_follow_ups - 1)
                     ev = ev.copy()
                     ev.wait_sec = 0.0
                     ev.wait_until_unix = 0.0

@@ -15,6 +15,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..metrics import metrics
+
 ALL_KEYS = "*"
 
 TOPIC_JOB = "Job"
@@ -93,6 +95,10 @@ class Subscription:
                     dropped = True
             self._cond.notify_all()
         if dropped:
+            # the per-subscriber cap firing must be visible (ISSUE 8
+            # satellite): a fleet of watchers silently re-subscribing in
+            # a drop loop looks exactly like healthy streaming otherwise
+            metrics.incr("nomad.event.subscriber_dropped")
             self._broker._unsubscribe(self)
 
     def next_events(self, timeout: Optional[float] = None
@@ -137,6 +143,8 @@ class EventBroker:
             return
         with self._lock:
             self._latest_index = max(self._latest_index, index)
+            # the ring bound lives in __init__: deque(maxlen=buffer_size)
+            # nomadlint: disable=QUEUE001 — deque maxlen ring (above)
             self._buffer.append((index, events))
             subs = list(self._subs)
         for sub in subs:

@@ -77,6 +77,20 @@ LEADERSHIP_LOST = "leadership lost"
 _NOT_LEADER = object()
 
 
+class PlanExpiredError(RuntimeError):
+    """The submitting eval's enqueue deadline lapsed before this plan
+    reached the applier (ISSUE 8): the plan is rejected BEFORE the raft
+    round — the caller already gave up, so committing it would spend a
+    consensus round-trip (and follower applies) on anti-goodput. The
+    worker sees the distinct `expired` disposition; an expired plan can
+    never reach a raft entry by construction."""
+
+    def __init__(self, plan: Plan, now: float):
+        super().__init__(
+            f"plan for eval {plan.eval_id[:8]} expired "
+            f"{now - plan.deadline_unix:.2f}s past its deadline")
+
+
 class LeadershipLostPlanError(RuntimeError):
     """A plan (or whole drained batch) could not commit because this
     server stopped being the leader. NotLeaderError/FencedWriteError
@@ -616,7 +630,9 @@ class Planner:
         for (plan, result, err), pctx in zip(evaluated, ctxs):
             if err is not None:
                 out.append((None, err))
-                status, attrs = "error", {"error": repr(err)[:200]}
+                status = "expired" if isinstance(err, PlanExpiredError) \
+                    else "error"
+                attrs = {"error": repr(err)[:200]}
             elif commit_err is not None and id(result) in committed_ids:
                 out.append((None, commit_err))
                 status = "leadership_lost" if isinstance(
@@ -668,6 +684,13 @@ class Planner:
             shape = _PlanShape(plan)
             shapes.append(shape)
             try:
+                # deadline gate FIRST (ISSUE 8): a past-deadline plan
+                # fails alone — no shared-state work, no raft entry —
+                # with the distinct `expired` disposition
+                if plan.deadline_unix and \
+                        time.time() >= plan.deadline_unix:
+                    metrics.incr("nomad.plan.expired")
+                    raise PlanExpiredError(plan, time.time())
                 faults.fire("planner.apply")
                 refs = self._plan_refs(plan)
                 conflicted = bool(refs & seen_refs)
@@ -1009,6 +1032,12 @@ class Planner:
         returned pending before submitting anything that must order
         after it. Chunk plans enqueued back-to-back coalesce into one
         commit batch (ordering preserved: drain is priority+FIFO)."""
-        metrics.incr("nomad.plan.queue_depth_async")
         # nomadlint: disable=LEAD001 — queue-gated like submit_plan
-        return self.queue.enqueue(plan)
+        pending = self.queue.enqueue(plan)
+        # depth is a LEVEL, not an event: gauge+sample like the sync
+        # drain path above (the old `queue_depth_async` counter only
+        # ever counted submissions — ISSUE 8 satellite)
+        depth = self.queue.depth()
+        metrics.set_gauge("nomad.plan.queue_depth", depth)
+        metrics.add_sample("nomad.plan.queue_depth", depth)
+        return pending

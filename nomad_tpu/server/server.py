@@ -176,7 +176,11 @@ class Server:
         # nomad.swallowed_errors either way)
         self.state.logger = self.logger
         self.raft = RaftLog(self.fsm)
-        self.eval_broker = EvalBroker()
+        # the broker reads its overload knobs (depth cap, enqueue TTL)
+        # straight from the raft-replicated scheduler config — the same
+        # hot-reload path every other runtime knob rides (ISSUE 8)
+        self.eval_broker = EvalBroker(
+            config_fn=self.state.get_scheduler_config)
         from .event_broker import EventBroker
         self.event_broker = EventBroker()
         self.state.event_sinks.append(self.event_broker.sink)
@@ -184,6 +188,17 @@ class Server:
         from .acl_endpoint import ACLEndpoint
         self.acl = ACLEndpoint(self, enabled=acl_enabled)
         self.planner = Planner(self.raft, self.state)
+        # overload brain (ISSUE 8): ingress admission buckets + the
+        # ok->saturated->shedding pressure state driving the brownout
+        # levers; ticked by the leader loop, reset on revoke
+        from .overload import OverloadController
+        self.overload = OverloadController(
+            broker_depth_fn=self.eval_broker.depth,
+            plan_depth_fn=self.planner.queue.depth,
+            config_fn=self.state.get_scheduler_config)
+        # a cap trip re-computes pressure immediately — a sub-second
+        # burst must engage brownout before the next 1s leader tick
+        self.eval_broker.on_overflow = self.overload.tick
         self.periodic = PeriodicDispatch(self)
         self.heartbeats = HeartbeatTimers(self)
         self.core_scheduler = CoreScheduler(self)
@@ -279,6 +294,33 @@ class Server:
         self.raft_node.on_leadership_change = self._on_leadership_change
         self.rpc_server.leadership_fn = self._raft_leadership
 
+    # RPC methods the admission buckets never touch: raft consensus
+    # traffic (rate-limiting replication/votes under load would turn an
+    # overload into an outage) and the node heartbeat path (starving
+    # heartbeats mass-invalidates the fleet exactly when it is busiest).
+    _ADMISSION_EXEMPT_PREFIXES = ("Raft.",)
+    _ADMISSION_EXEMPT = {"Node.UpdateStatus", "Status.Members",
+                         "Status.Regions"}
+    # long-hold methods billed against the blocking-query bucket
+    _ADMISSION_BLOCKING = {"Node.GetClientAllocs", "Eval.Dequeue"}
+
+    def _rpc_admission(self, method: str, leader_only: bool) -> None:
+        """RpcDispatcher admission hook (ISSUE 8): classify the method
+        (write / read / blocking) and probe the matching token bucket;
+        raises overload.RateLimitExceeded for the dispatcher to envelope
+        as a RateLimitError with the retry hint."""
+        if method in self._ADMISSION_EXEMPT or \
+                method.startswith(self._ADMISSION_EXEMPT_PREFIXES):
+            return
+        from .overload import CLASS_BLOCKING, CLASS_READ, CLASS_WRITE
+        if method in self._ADMISSION_BLOCKING:
+            cls = CLASS_BLOCKING
+        elif leader_only:
+            cls = CLASS_WRITE
+        else:
+            cls = CLASS_READ
+        self.overload.admit(cls)
+
     def _raft_leadership(self) -> tuple[bool, str]:
         is_leader, leader_addr = self.raft_node.leadership()
         self.leader_rpc_addr = leader_addr
@@ -304,6 +346,7 @@ class Server:
         self.rpc_server.register_endpoints(self, RPC_ENDPOINTS)
         self.rpc_server.leadership_fn = \
             lambda: (self.is_leader, self.leader_rpc_addr)
+        self.rpc_server.admission_fn = self._rpc_admission
         self.rpc_server.start()
         return self.rpc_server.addr
 
@@ -320,6 +363,7 @@ class Server:
         self.rpc_server.register_endpoints(self, RPC_ENDPOINTS)
         self.rpc_server.leadership_fn = \
             lambda: (self.is_leader, self.leader_rpc_addr)
+        self.rpc_server.admission_fn = self._rpc_admission
         self.rpc_server.start()
         return self.rpc_server.addr
 
@@ -651,6 +695,9 @@ class Server:
         self.deployment_watcher.stop()
         self.drainer.stop()
         self.volume_watcher.stop()
+        # release the brownout levers: a demoted server must not keep a
+        # stale pressure state pinned on the process-wide batcher/tracer
+        self.overload.reset()
 
     def _still_leader(self) -> bool:
         """Is the CONSENSUS layer still calling us leader (independent of
@@ -989,6 +1036,11 @@ class Server:
         last_gc = time.time()
         while not self._leader_stop.wait(1.0):
             self.eval_broker.check_nack_timeouts()
+            try:
+                # pressure recompute + brownout apply/release (ISSUE 8)
+                self.overload.tick()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"overload tick: {e!r}")
             try:
                 # a raft apply failing mid-reap (leadership transition,
                 # injected raft.apply fault) must not kill the loop: the
@@ -1735,8 +1787,10 @@ class Server:
     def node_get_client_allocs(self, node_id: str, min_index: int = 0,
                                timeout: float = 30.0) -> dict:
         """Blocking query the client long-polls (ref node_endpoint.go
-        GetClientAllocs / client watchAllocations)."""
-        deadline = time.time() + timeout
+        GetClientAllocs / client watchAllocations). The hold shrinks
+        under pressure (brownout, ISSUE 8) — parked long-polls return
+        capacity, clients just re-poll sooner."""
+        deadline = time.time() + min(timeout, self.overload.blocking_cap_s())
         while True:
             allocs = self.state.allocs_by_node(node_id)
             index = self.state.latest_index()
@@ -1992,6 +2046,17 @@ class Server:
         return {"index": index}
 
     # ----------------------------------------------------------- utilities
+
+    def status_summary(self) -> dict:
+        """GET /v1/status: liveness + the overload/pressure block
+        (docs/OVERLOAD.md). Served locally by any server — a follower
+        reports its own (idle) pressure, which is itself informative."""
+        return {
+            "Leader": self.is_leader,
+            "Name": self.name,
+            "Pressure": self.overload.snapshot(),
+            "Broker": dict(self.eval_broker.stats),
+        }
 
     def run_gc(self) -> None:
         """Force a full GC pass (the `nomad system gc` analog)."""

@@ -73,6 +73,28 @@ class Worker:
             ctx = trace.eval_ctx(ev.id) or trace.begin_eval(
                 ev.id, "eval", owner=broker_owner, job=ev.job_id,
                 type=ev.type, trigger=ev.triggered_by)
+            # deadline propagation (ISSUE 8): an eval whose enqueue TTL
+            # lapsed in the queue is dropped BEFORE the solve — its
+            # caller already gave up, so device time spent on it is pure
+            # anti-goodput. The drop is acked (the eval is done, not
+            # redelivered) and traced with the `expired` disposition.
+            if ev.deadline_unix and time.time() >= ev.deadline_unix:
+                try:
+                    faults.fire("worker.expire")
+                    metrics.incr("nomad.worker.eval_expired")
+                    metrics.observe(
+                        "nomad.worker.invoke_seconds", 0.0,
+                        labels={"type": ev.type, "disposition": "expired"})
+                    trace.end_eval(
+                        ev.id, "expired", owner=broker_owner,
+                        deadline_unix=ev.deadline_unix,
+                        late_s=round(time.time() - ev.deadline_unix, 3))
+                    self.server.eval_broker.ack(ev.id, token)
+                except Exception as e:   # noqa: BLE001 — injected/ack race
+                    # an injected expiry-path fault (or an ack race with
+                    # a nack-timeout sweep) must not kill the worker loop
+                    record_swallowed_error("worker.expire", e)
+                continue
             t_inv = time.perf_counter()
             try:
                 with trace.use(ctx), \

@@ -68,6 +68,13 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._queues: dict[tuple, list[_Request]] = {}
         self._window_s = 0.008
+        # pressure brownout multiplier (ISSUE 8): under overload the
+        # coalescing window WIDENS so each device round trip amortizes
+        # over more lanes — throughput up, per-eval latency up, which is
+        # the right trade exactly when the queue is the bottleneck.
+        # Separate from _window_s: the placer re-applies the config base
+        # every eval, the overload controller owns the multiplier.
+        self._pressure_boost = 1.0
         self._enabled = True
         self._active_evals = 0
         self._broker_hint = 0
@@ -85,8 +92,14 @@ class MicroBatcher:
     def enabled(self) -> bool:
         return self._enabled
 
+    def set_pressure_boost(self, factor: float) -> None:
+        """Overload-controller lever (server/overload.py): >1 widens the
+        effective window under pressure; 1.0 restores the config base."""
+        with self._lock:
+            self._pressure_boost = max(1.0, float(factor))
+
     def window_s(self) -> float:
-        return self._window_s
+        return self._window_s * self._pressure_boost
 
     # ------------------------------------------------- eval in-flight hints
 
@@ -145,7 +158,7 @@ class MicroBatcher:
             # snapshot (state/store.py `_snapshot_locked`): the coalesced
             # window shares ONE SnapshotMinIndex fetch instead of each
             # lane paying its own full-table copy (ISSUE 5 satellite).
-            deadline = time.monotonic() + self._window_s
+            deadline = time.monotonic() + self.window_s()
             while True:
                 # sleep BEFORE the first check: even a window of 0 must
                 # yield the GIL once, or barrier-released siblings never
@@ -171,7 +184,7 @@ class MicroBatcher:
                         r.event.set()
                 raise
         else:
-            req.event.wait(self._window_s + FOLLOWER_TIMEOUT)
+            req.event.wait(self.window_s() + FOLLOWER_TIMEOUT)
         # per-lane wait span in the EVAL's own trace, linked to the
         # shared dispatch span it rode (fan-in link): enqueue -> result
         trace.record_span(
@@ -271,6 +284,7 @@ class MicroBatcher:
             self._vmapped.clear()
             self._active_evals = 0
             self._broker_hint = 0
+            self._pressure_boost = 1.0
 
 
 _batcher = MicroBatcher()
@@ -279,6 +293,7 @@ _batcher = MicroBatcher()
 # these; one process-wide batcher matches the one-device reality)
 configure = _batcher.configure
 enabled = _batcher.enabled
+set_pressure_boost = _batcher.set_pressure_boost
 window_s = _batcher.window_s
 eval_started = _batcher.eval_started
 eval_finished = _batcher.eval_finished

@@ -88,6 +88,11 @@ class Evaluation:
 
     wait_sec: float = 0.0            # broker initial delay
     wait_until_unix: float = 0.0     # delayed eval absolute time
+    # enqueue TTL (ISSUE 8): stamped by the broker from the hot-reloadable
+    # eval_deadline_s config unless the creator set one; 0 = no deadline.
+    # Workers drop expired evals BEFORE the solve; the plan applier
+    # rejects past-deadline plans before they cost a raft round.
+    deadline_unix: float = 0.0
 
     next_eval: str = ""
     previous_eval: str = ""
@@ -139,6 +144,7 @@ class Evaluation:
             priority=(job.priority if job else self.priority),
             job=job,
             all_at_once=(job.all_at_once if job else False),
+            deadline_unix=self.deadline_unix,
         )
 
     def create_blocked_eval(self, classes: dict[str, bool], escaped: bool,

@@ -71,6 +71,32 @@ class SchedulerConfiguration:
                               always retained regardless.
       telemetry_trace_capacity  how many completed traces the bounded
                               in-memory store keeps for /v1/traces.
+      ingress_write_rate      token-bucket admission rate (requests/s)
+                              for write endpoints at the HTTP/RPC front
+                              doors; over-rate callers get 429 +
+                              Retry-After before any state is touched.
+                              0 disables the class (docs/OVERLOAD.md).
+      ingress_read_rate       same, for non-blocking reads.
+      ingress_blocking_rate   same, for blocking queries (?index=&wait=).
+      ingress_burst_s         bucket capacity in seconds of rate: a
+                              bucket holds rate*burst_s tokens, so short
+                              bursts up to that size are admitted even
+                              at the sustained limit.
+      broker_depth_cap        eval-broker backlog ceiling (ready +
+                              job-pending + delayed). On overflow the
+                              LOWEST-priority pending eval is shed into
+                              the failed-eval backoff lifecycle (never
+                              core/system evals); 0 = unbounded (the
+                              pre-overload-layer behavior).
+      eval_deadline_s         enqueue TTL stamped on evals entering the
+                              broker: workers drop expired evals before
+                              the solve, the plan applier rejects past-
+                              deadline plans before the raft round
+                              (goodput over throughput). 0 = no TTL.
+      pressure_saturated_frac fraction of broker_depth_cap at which the
+                              pressure state leaves `ok` (brownout:
+                              wider micro-batch window, trace sampling
+                              downshift, shorter blocking queries).
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -88,6 +114,13 @@ class SchedulerConfiguration:
     telemetry_trace_enabled: bool = True
     telemetry_trace_sample: float = 1.0
     telemetry_trace_capacity: int = 2048
+    ingress_write_rate: float = 0.0
+    ingress_read_rate: float = 0.0
+    ingress_blocking_rate: float = 0.0
+    ingress_burst_s: float = 2.0
+    broker_depth_cap: int = 8192
+    eval_deadline_s: float = 0.0
+    pressure_saturated_frac: float = 0.5
     create_index: int = 0
     modify_index: int = 0
 
@@ -115,4 +148,16 @@ class SchedulerConfiguration:
             return "telemetry_trace_sample must be in [0, 1]"
         if self.telemetry_trace_capacity < 1:
             return "telemetry_trace_capacity must be >= 1"
+        for knob in ("ingress_write_rate", "ingress_read_rate",
+                     "ingress_blocking_rate"):
+            if getattr(self, knob) < 0:
+                return f"{knob} must be >= 0 (0 disables)"
+        if self.ingress_burst_s <= 0:
+            return "ingress_burst_s must be > 0"
+        if self.broker_depth_cap < 0:
+            return "broker_depth_cap must be >= 0 (0 = unbounded)"
+        if self.eval_deadline_s < 0:
+            return "eval_deadline_s must be >= 0 (0 = no deadline)"
+        if not 0.0 < self.pressure_saturated_frac <= 1.0:
+            return "pressure_saturated_frac must be in (0, 1]"
         return ""

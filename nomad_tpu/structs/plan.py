@@ -147,6 +147,10 @@ class Plan:
     deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
     annotations: Optional[PlanAnnotations] = None
     snapshot_index: int = 0
+    # the submitting eval's enqueue TTL (ISSUE 8): the applier rejects a
+    # past-deadline plan BEFORE the raft round — its caller already gave
+    # up, committing would be wasted device+consensus work. 0 = none.
+    deadline_unix: float = 0.0
 
     # ---- mutators used by the schedulers (ref structs.go Plan.AppendAlloc etc) ----
 

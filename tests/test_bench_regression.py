@@ -241,6 +241,47 @@ def test_headline_rejection_parity_is_recorded():
         f"BENCH_r{latest_round:02d} headline rejected nodes"
 
 
+def test_overload_burst_gate():
+    """ISSUE 8 acceptance: once a bench records the overload block, the
+    10x-burst lineage must show graceful degradation, not collapse —
+    the broker depth never exceeds its cap, goodput during the burst
+    stays >= 70% of the steady-state rate, recovery (burst end ->
+    backlog drained) lands under 5s on the dev sim, the shedder and
+    pressure state machine actually engaged, and zero expired evals
+    reached a raft entry."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    ov = latest.get("overload")
+    if not isinstance(ov, dict) or "goodput_evals_per_s" not in ov:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the overload "
+                    f"lineage")
+    assert ov.get("depth_over_cap_samples", 1) == 0 and \
+        ov["max_broker_depth"] <= ov["broker_depth_cap"], (
+        f"BENCH_r{latest_round:02d}: broker depth {ov['max_broker_depth']} "
+        f"exceeded its cap {ov['broker_depth_cap']} during the burst")
+    steady = ov["steady_evals_per_s"]
+    goodput = ov["goodput_evals_per_s"]
+    assert goodput >= 0.7 * steady, (
+        f"BENCH_r{latest_round:02d}: burst goodput {goodput}/s fell "
+        f"below 70% of steady-state {steady}/s — the overload layer is "
+        f"collapsing throughput instead of shedding excess")
+    assert ov["recovery_s"] < 5.0, (
+        f"BENCH_r{latest_round:02d}: {ov['recovery_s']}s to drain after "
+        f"the burst breaches the 5s recovery budget")
+    assert ov["shed_count"] > 0, (
+        f"BENCH_r{latest_round:02d}: a 10x burst never tripped the "
+        f"shedder — the depth cap is not engaging")
+    assert ov["pressure_state_transitions"] >= 2, (
+        f"BENCH_r{latest_round:02d}: pressure state never cycled "
+        f"(transitions={ov['pressure_state_transitions']}) — the burst "
+        f"should enter AND leave the saturated/shedding states")
+    assert ov["expired_committed"] == 0, (
+        f"BENCH_r{latest_round:02d}: {ov['expired_committed']} expired "
+        f"eval(s) reached a raft entry — the deadline gate leaked")
+
+
 def test_tracing_overhead_and_chain_completeness():
     """ISSUE 7 acceptance: once a bench records the tracing block, the
     enabled-mode overhead must stay <=5% of stream throughput, >=99% of

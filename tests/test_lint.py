@@ -530,7 +530,7 @@ def test_cli_nonexistent_path_fails(tmp_path):
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
-            "EXC001", "PERF001", "LEAD001", "OBS001"} <= ids
+            "EXC001", "PERF001", "LEAD001", "OBS001", "QUEUE001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -740,6 +740,97 @@ def test_obs001_inline_suppression():
             metrics.incr(f"nomad.faults.fired.{site}")
     """
     assert [f.rule for f in findings(src) if f.rule == "OBS001"] == []
+
+
+# ---------------------------------------------------------------- QUEUE001
+
+QUEUE001_BAD = """
+    import heapq
+
+    BACKLOG = []
+
+    class Broker:
+        def enqueue(self, item):
+            heapq.heappush(self._heap, item)
+
+        def park(self, item):
+            self._pending_queue.append(item)
+
+        def stash(self, item):
+            BACKLOG.append(item)
+"""
+
+
+def test_queue001_fires_on_uncapped_server_queue_growth():
+    out = findings(QUEUE001_BAD, path="server/broker.py")
+    assert [f.rule for f in out] == ["QUEUE001"] * 3
+    assert "cap" in out[0].message
+
+
+def test_queue001_scoped_to_server_paths():
+    assert rule_ids(QUEUE001_BAD, path="solver/broker.py") == []
+
+
+def test_queue001_cap_checked_growth_is_quiet():
+    src = """
+        import heapq
+
+        class Broker:
+            def enqueue(self, item):
+                if len(self._heap) >= self.depth_cap:
+                    self._shed_lowest()
+                heapq.heappush(self._heap, item)
+
+            def park(self, item, max_pending):
+                if self._count < max_pending:
+                    self._pending_queue.append(item)
+
+            def offer(self, item):
+                self._queue.append(item)
+                if len(self._queue) > self.limit:
+                    self._queue.popleft()
+    """
+    assert rule_ids(src, path="server/broker.py") == []
+
+
+def test_queue001_local_and_non_queue_names_are_quiet():
+    src = """
+        import heapq
+
+        class Broker:
+            def drain(self):
+                keep = []
+                for item in self._heap:
+                    keep.append(item)       # local list: not a queue
+                heapq.heappush(keep, None)  # local heap: fine
+                self.results.append(1)      # not queue-named
+
+            def log_shed(self, rec):
+                self.shed_log.append(rec)   # bounded deque elsewhere
+    """
+    assert rule_ids(src, path="server/broker.py") == []
+
+
+def test_queue001_setdefault_heappush_is_caught():
+    src = """
+        import heapq
+
+        class Broker:
+            def enqueue(self, key, item):
+                heapq.heappush(self._ready.setdefault(key, []), item)
+    """
+    out = findings(src, path="server/broker.py")
+    assert [f.rule for f in out] == ["QUEUE001"]
+
+
+def test_queue001_inline_suppression():
+    src = """
+        class Broker:
+            def publish(self, batch):
+                # nomadlint: disable=QUEUE001 — deque maxlen ring
+                self._buffer.append(batch)
+    """
+    assert rule_ids(src, path="server/broker.py") == []
 
 
 # ------------------------------------------------------------- tier-1 gate

@@ -1,0 +1,776 @@
+"""Overload resilience tier (ISSUE 8): admission control, bounded broker
+with priority-aware shedding, deadline propagation, and the pressure/
+brownout state machine — plus the chaos acceptance run (burst under
+injected tier demotions, shed/expired trace dispositions, backoff
+re-entry)."""
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.obs import trace as obs_trace
+from nomad_tpu.server import Server
+from nomad_tpu.server.eval_broker import EvalBroker, FAILED_QUEUE
+from nomad_tpu.server.overload import (
+    CLASS_BLOCKING, CLASS_READ, CLASS_WRITE, OverloadController,
+    PRESSURE_OK, PRESSURE_SATURATED, PRESSURE_SHEDDING, RateLimitExceeded,
+    TokenBucket,
+)
+from nomad_tpu.structs import (
+    Evaluation, SchedulerConfiguration, TRIGGER_FAILED_FOLLOW_UP,
+)
+
+
+def wait_until(fn, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clean_levers():
+    """Every test releases the process-wide brownout levers and any
+    installed fault plan — pressure state must not leak across tests."""
+    yield
+    faults.clear()
+    obs_trace.set_pressure_factor(1.0)
+    try:
+        from nomad_tpu.solver import microbatch
+        microbatch.set_pressure_boost(1.0)
+    except ImportError:
+        pass
+
+
+# ------------------------------------------------------------ token bucket
+
+def test_token_bucket_admits_burst_then_rejects_with_hint():
+    b = TokenBucket(rate=10.0, burst_s=1.0)     # capacity 10
+    waits = [b.take() for _ in range(12)]
+    assert waits[:10] == [0.0] * 10
+    assert all(w > 0.0 for w in waits[10:])
+    # the hint is the genuine refill horizon (~1 token at 10/s)
+    assert all(w <= 0.11 for w in waits[10:])
+
+
+def test_token_bucket_refills_at_rate():
+    b = TokenBucket(rate=1000.0, burst_s=0.1)   # capacity 100
+    while b.take() == 0.0:
+        pass
+    time.sleep(0.02)                            # ~20 tokens back
+    assert b.take() == 0.0
+
+
+def test_token_bucket_zero_rate_admits_everything():
+    b = TokenBucket(rate=0.0)
+    assert all(b.take() == 0.0 for _ in range(1000))
+
+
+def test_token_bucket_reconfigure_refills():
+    b = TokenBucket(rate=1.0, burst_s=1.0)
+    assert b.take() == 0.0
+    assert b.take() > 0.0                       # dry
+    b.configure(rate=5.0, burst_s=2.0)          # raised: fresh capacity
+    assert b.take() == 0.0
+
+
+# ------------------------------------------------- controller + admission
+
+class _Cfg:
+    """Duck-typed SchedulerConfiguration slice for controller units."""
+
+    def __init__(self, **kw):
+        self.ingress_write_rate = kw.get("write", 0.0)
+        self.ingress_read_rate = kw.get("read", 0.0)
+        self.ingress_blocking_rate = kw.get("blocking", 0.0)
+        self.ingress_burst_s = kw.get("burst", 1.0)
+        self.broker_depth_cap = kw.get("cap", 0)
+        self.eval_deadline_s = kw.get("ttl", 0.0)
+        self.pressure_saturated_frac = kw.get("frac", 0.5)
+
+
+def test_admit_per_class_buckets_and_hot_reload():
+    cfg = _Cfg(write=2.0, burst=1.0)
+    ctrl = OverloadController(config_fn=lambda: cfg)
+    ctrl.admit(CLASS_WRITE)
+    ctrl.admit(CLASS_WRITE)
+    with pytest.raises(RateLimitExceeded) as exc:
+        ctrl.admit(CLASS_WRITE)
+    assert exc.value.retry_after_s > 0.0
+    assert exc.value.endpoint_class == CLASS_WRITE
+    # reads are a separate bucket (unlimited here)
+    for _ in range(50):
+        ctrl.admit(CLASS_READ)
+    # hot reload: raising the write rate admits immediately
+    cfg.ingress_write_rate = 100.0
+    ctrl.admit(CLASS_WRITE)
+
+
+def test_classify_http():
+    c = OverloadController.classify_http
+    assert c("GET", {}) == CLASS_READ
+    assert c("GET", {"index": "7", "wait": "10s"}) == CLASS_BLOCKING
+    assert c("PUT", {}) == CLASS_WRITE
+    assert c("DELETE", {}) == CLASS_WRITE
+
+
+def test_pressure_transitions_and_brownout_levers():
+    from nomad_tpu.solver import microbatch
+    depth = [0]
+    cfg = _Cfg(cap=100, frac=0.5)
+    ctrl = OverloadController(broker_depth_fn=lambda: depth[0],
+                              config_fn=lambda: cfg)
+    base = metrics.counter("nomad.pressure.transitions")
+    assert ctrl.tick() == PRESSURE_OK
+    assert microbatch.window_s() == pytest.approx(
+        microbatch._batcher._window_s)
+
+    depth[0] = 60                               # >= 50% of cap
+    assert ctrl.tick() == PRESSURE_SATURATED
+    assert microbatch.window_s() > microbatch._batcher._window_s
+    assert obs_trace.stats()["pressure_factor"] < 1.0
+
+    depth[0] = 120                              # >= cap
+    assert ctrl.tick() == PRESSURE_SHEDDING
+    shed_window = microbatch.window_s()
+    assert shed_window > microbatch._batcher._window_s * 2
+
+    # hysteresis: just below the saturation line stays engaged...
+    depth[0] = 40
+    assert ctrl.tick() == PRESSURE_SATURATED
+    # ...well clear releases, and the levers revert
+    depth[0] = 0
+    assert ctrl.tick() == PRESSURE_OK
+    assert microbatch.window_s() == pytest.approx(
+        microbatch._batcher._window_s)
+    assert obs_trace.stats()["pressure_factor"] == 1.0
+    assert metrics.counter("nomad.pressure.transitions") - base == 4
+    snap = ctrl.snapshot()
+    assert snap["State"] == PRESSURE_OK
+    assert snap["MaxBrokerDepth"] == 120
+    assert snap["Transitions"] >= 4
+
+
+def test_reset_releases_levers():
+    cfg = _Cfg(cap=10)
+    ctrl = OverloadController(broker_depth_fn=lambda: 50,
+                              config_fn=lambda: cfg)
+    assert ctrl.tick() == PRESSURE_SHEDDING
+    ctrl.reset()
+    assert ctrl.state() == PRESSURE_OK
+    assert obs_trace.stats()["pressure_factor"] == 1.0
+
+
+# ------------------------------------------------------- broker shedding
+
+def _broker(cap=0, ttl=0.0, **kw):
+    b = EvalBroker(**kw)
+    b.depth_cap = cap
+    b.eval_deadline_s = ttl
+    b.set_enabled(True)
+    return b
+
+
+def test_broker_sheds_lowest_priority_first():
+    b = _broker(cap=3)
+    evs = [Evaluation(type="service", job_id=f"j{i}", priority=p)
+           for i, p in enumerate([90, 50, 70])]
+    for ev in evs:
+        b.enqueue(ev)
+    assert b.depth() == 3
+    # the 4th arrival (priority 60) displaces the priority-50 eval
+    incoming = Evaluation(type="service", job_id="j-new", priority=60)
+    b.enqueue(incoming)
+    assert b.depth() == 3
+    assert b.stats["total_shed"] == 1
+    shed_ids = {e.id for e in b.failed_evals()}
+    assert shed_ids == {evs[1].id}
+    # the survivor set is the top-3 by priority
+    got = {b.dequeue(["service"], timeout=1)[0].id for _ in range(3)}
+    assert got == {evs[0].id, evs[2].id, incoming.id}
+
+
+def test_broker_sheds_incoming_when_it_is_lowest():
+    b = _broker(cap=2)
+    keep = [Evaluation(type="service", job_id=f"k{i}", priority=80)
+            for i in range(2)]
+    for ev in keep:
+        b.enqueue(ev)
+    low = Evaluation(type="service", job_id="low", priority=10)
+    b.enqueue(low)
+    assert {e.id for e in b.failed_evals()} == {low.id}
+    assert b.depth() == 2
+
+
+def test_broker_shed_tiebreak_newest_seq():
+    """Equal priorities: the NEWEST arrival is shed (deterministic by
+    (priority, seq) — FIFO fairness for earlier arrivals)."""
+    b = _broker(cap=2)
+    first = Evaluation(type="service", job_id="a", priority=50)
+    second = Evaluation(type="service", job_id="b", priority=50)
+    third = Evaluation(type="service", job_id="c", priority=50)
+    b.enqueue(first)
+    b.enqueue(second)
+    b.enqueue(third)                    # newest of an all-equal set
+    assert {e.id for e in b.failed_evals()} == {third.id}
+
+
+def test_broker_never_sheds_core_or_system():
+    b = _broker(cap=2)
+    core = Evaluation(type="_core", job_id="eval-gc", priority=1)
+    system = Evaluation(type="system", job_id="sys", priority=1)
+    b.enqueue(core)
+    b.enqueue(system)
+    user = Evaluation(type="service", job_id="user", priority=200)
+    b.enqueue(user)                     # over cap; only itself sheddable
+    assert {e.id for e in b.failed_evals()} == {user.id}
+    # an all-exempt backlog admits over cap rather than shed housekeeping
+    core2 = Evaluation(type="_core", job_id="node-gc", priority=1)
+    b.enqueue(core2)
+    assert b.depth() == 3
+    assert core2.id not in {e.id for e in b.failed_evals()}
+
+
+def test_broker_shed_trace_disposition():
+    obs_trace.configure(enabled=True, sample_rate=1.0)
+    b = _broker(cap=1)
+    keep = Evaluation(type="service", job_id="keep", priority=90)
+    shed = Evaluation(type="service", job_id="shed-me", priority=10)
+    b.enqueue(keep)
+    b.enqueue(shed)
+    tr = obs_trace.get(shed.id)
+    assert tr is not None and tr["status"] == "shed"
+
+
+def test_broker_shed_fault_site_admits_over_cap():
+    """An injected broker.shed fault must not lose the incoming eval:
+    it is admitted over cap and the failure is counted, not raised."""
+    b = _broker(cap=1)
+    b.enqueue(Evaluation(type="service", job_id="a", priority=50))
+    base = metrics.counter("nomad.swallowed_errors")
+    faults.install({"broker.shed": {"mode": "raise"}})
+    try:
+        b.enqueue(Evaluation(type="service", job_id="b", priority=50))
+    finally:
+        faults.clear()
+    assert b.depth() == 2               # over cap, nothing lost
+    assert metrics.counter("nomad.swallowed_errors") > base
+    assert b.stats["total_shed"] == 0
+
+
+def test_broker_shed_victim_not_delivered_from_original_queue():
+    """A shed ready eval must only come back via the FAILED queue — the
+    tombstoned original heap entry may not deliver."""
+    b = _broker(cap=2)
+    victim = Evaluation(type="service", job_id="v", priority=10)
+    b.enqueue(victim)
+    b.enqueue(Evaluation(type="service", job_id="w1", priority=90))
+    b.enqueue(Evaluation(type="service", job_id="w2", priority=80))
+    assert {e.id for e in b.failed_evals()} == {victim.id}
+    for _ in range(2):
+        got, tok = b.dequeue(["service"], timeout=1)
+        assert got.id != victim.id
+        b.ack(got.id, tok)
+    got, _ = b.dequeue(["service"], timeout=0.2)
+    assert got is None                  # service queue truly empty
+    got, _ = b.dequeue([FAILED_QUEUE], timeout=1)
+    assert got is not None and got.id == victim.id
+
+
+def test_broker_concurrent_enqueue_hammer_deterministic_shed():
+    """ISSUE 8 satellite: N threads hammer enqueue; the cap holds and
+    the shed set is exactly the (priority, seq) bottom — every shed
+    eval's priority is <= every surviving backlog eval's priority."""
+    cap = 16
+    b = _broker(cap=cap)
+    n_threads, per = 8, 25
+    barrier = threading.Barrier(n_threads)
+    evs = [[Evaluation(type="service", job_id=f"h{t}-{i}",
+                       priority=(t * per + i) % 97 + 1)
+            for i in range(per)] for t in range(n_threads)]
+
+    def run(t):
+        barrier.wait()
+        for ev in evs[t]:
+            b.enqueue(ev)
+
+    threads = [threading.Thread(target=run, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert b.depth() == cap
+    assert b.stats["total_shed"] == n_threads * per - cap
+    assert len(b.shed_log) == b.stats["total_shed"]
+    survivors = []
+    with b._lock:
+        for qname, heap in b._ready.items():
+            if qname == FAILED_QUEUE:
+                continue
+            survivors.extend(
+                -e[0] for e in heap
+                if e[2] in b._evals and e not in b._shed_entries)
+    assert len(survivors) == cap
+    max_shed = max(p for p, _, _ in b.shed_log)
+    assert max_shed <= min(survivors)
+
+
+def test_blocked_evals_cap_counts_drops():
+    from nomad_tpu.server.blocked_evals import BlockedEvals
+    enq = []
+    be = BlockedEvals(enq.append, max_captured=3)
+    be.set_enabled(True)
+    base = metrics.counter("nomad.blocked_evals.dropped")
+    for i in range(3):
+        be.block(Evaluation(job_id=f"b{i}", priority=50))
+    low = Evaluation(job_id="low", priority=10)
+    be.block(low)                       # lowest priority: dropped itself
+    assert be.stats["total_blocked"] == 3
+    assert low.id not in be._captured
+    high = Evaluation(job_id="high", priority=90)
+    be.block(high)                      # displaces a priority-50 capture
+    assert high.id in be._captured
+    assert be.stats["total_blocked"] == 3
+    assert metrics.counter("nomad.blocked_evals.dropped") - base == 2
+    assert be.stats["total_dropped"] == 2
+
+
+def test_event_broker_subscriber_drop_counts():
+    from nomad_tpu.server.event_broker import EventBroker, make_event
+    broker = EventBroker(max_pending=2)
+    sub = broker.subscribe()
+    base = metrics.counter("nomad.event.subscriber_dropped")
+    for i in range(4):                  # 3rd batch overflows max_pending
+        broker.publish(i + 1, [make_event("Job", "update", i + 1,
+                                          ("default", f"j{i}"))])
+    assert metrics.counter("nomad.event.subscriber_dropped") - base == 1
+    from nomad_tpu.server.event_broker import SubscriptionClosedError
+    with pytest.raises(SubscriptionClosedError):
+        sub.next_events(timeout=0.1)
+
+
+# -------------------------------------------------- deadline propagation
+
+def test_broker_stamps_enqueue_ttl():
+    b = _broker(ttl=30.0)
+    ev = Evaluation(type="service", job_id="j")
+    t0 = time.time()
+    b.enqueue(ev)
+    got, tok = b.dequeue(["service"], timeout=1)
+    assert t0 + 29.0 <= got.deadline_unix <= time.time() + 31.0
+    # a caller-set deadline wins over the config TTL
+    b.ack(got.id, tok)
+    ev2 = Evaluation(type="service", job_id="j2", deadline_unix=12345.0)
+    b.enqueue(ev2)
+    got2, _ = b.dequeue(["service"], timeout=1)
+    assert got2.deadline_unix == 12345.0
+
+
+def test_ttl_not_stamped_while_parked_in_delay_heap():
+    """Backed-off follow-ups (and any delayed eval) get their TTL at
+    GRADUATION, not at park time — otherwise every retry whose backoff
+    exceeds the TTL would expire while deliberately parked, silently
+    voiding the shed/dead-letter 'retries, never vanishes' contract."""
+    b = _broker(ttl=0.5)
+    ev = Evaluation(type="service", job_id="j", wait_sec=1.0,
+                    triggered_by=TRIGGER_FAILED_FOLLOW_UP)
+    t_park = time.time()
+    b.enqueue(ev)
+    got, _ = b.dequeue(["service"], timeout=5)   # graduates after ~1s
+    assert got is not None
+    # the deadline clock started at graduation (>= park + backoff), so
+    # the eval is NOT already expired despite backoff > TTL
+    assert got.deadline_unix >= t_park + 1.0
+    assert got.deadline_unix > time.time() - 0.2
+
+
+def test_http_admission_index_zero_is_a_read():
+    c = OverloadController.classify_http
+    assert c("GET", {"index": "0"}) == CLASS_READ
+    assert c("GET", {"index": "0", "wait": "10s"}) == CLASS_READ
+    assert c("GET", {"index": "7"}) == CLASS_BLOCKING
+    assert c("GET", {"index": "garbage"}) == CLASS_READ
+
+
+def test_broker_overflow_hook_fires_on_cap_trip():
+    ticks = []
+    b = _broker(cap=1)
+    b.on_overflow = lambda: ticks.append(1)
+    b.enqueue(Evaluation(type="service", job_id="a", priority=50))
+    assert not ticks                    # under cap: no poke
+    b.enqueue(Evaluation(type="service", job_id="b", priority=50))
+    assert len(ticks) == 1              # cap tripped: pressure poked
+
+
+def test_rpc_admission_bug_is_not_enveloped_as_rate_limit():
+    """A broken admission hook must surface as its real error kind, not
+    as a RateLimitError clients would back off on forever."""
+    from nomad_tpu.rpc.server import RpcDispatcher
+
+    class _D(RpcDispatcher):
+        def __init__(self):
+            self._init_dispatch(b"k")
+
+    d = _D()
+    d.register("X.Do", lambda: "ok")
+
+    def broken(method, leader_only):
+        raise AttributeError("controller bug")
+
+    d.admission_fn = broken
+    resp = d._dispatch({"seq": 1, "method": "X.Do"})
+    assert resp["kind"] == "AttributeError"
+    assert "retry_after" not in resp
+
+
+def test_worker_drops_expired_eval_before_solve():
+    obs_trace.configure(enabled=True, sample_rate=1.0)
+    s = Server(num_workers=1, gc_interval=9999)
+    s.start()
+    try:
+        base = metrics.counter("nomad.worker.eval_expired")
+        ev = Evaluation(type="service", job_id="stale",
+                        deadline_unix=time.time() - 5.0)
+        s.eval_broker.enqueue(ev)
+        assert wait_until(
+            lambda: metrics.counter("nomad.worker.eval_expired") > base)
+        # acked (done), never invoked, traced as expired
+        assert wait_until(
+            lambda: s.eval_broker.stats["total_unacked"] == 0)
+        tr = obs_trace.get(ev.id)
+        assert tr is not None and tr["status"] == "expired"
+        assert not any(sp["name"] == "scheduler.process"
+                       for sp in tr["spans"])
+    finally:
+        s.shutdown()
+
+
+def test_plan_applier_rejects_expired_plan_before_raft():
+    from nomad_tpu.server.fsm import NomadFSM, RaftLog
+    from nomad_tpu.server.plan_apply import PlanExpiredError, Planner
+    from nomad_tpu.structs import Plan
+
+    fsm = NomadFSM()
+
+    class CountingLog(RaftLog):
+        applies = 0
+
+        def apply(self, *a, **kw):
+            CountingLog.applies += 1
+            return super().apply(*a, **kw)
+
+    planner = Planner(CountingLog(fsm), fsm.state)
+    node = mock.node()
+    fsm.state.upsert_node(2, node)
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    plan = Plan(eval_id="e1", deadline_unix=time.time() - 1.0,
+                node_allocation={node.id: [alloc]})
+    base = metrics.counter("nomad.plan.expired")
+    with pytest.raises(PlanExpiredError):
+        planner.apply_plan(plan)
+    assert CountingLog.applies == 0     # zero expired plans reach raft
+    assert metrics.counter("nomad.plan.expired") - base == 1
+    # a live deadline commits normally
+    plan2 = Plan(eval_id="e2", deadline_unix=time.time() + 60.0,
+                 node_allocation={node.id: [alloc]})
+    result = planner.apply_plan(plan2)
+    assert result is not None and CountingLog.applies == 1
+
+
+def test_eval_make_plan_carries_deadline():
+    ev = Evaluation(job_id="j", deadline_unix=777.0)
+    assert ev.make_plan(None).deadline_unix == 777.0
+
+
+# --------------------------------- ManualClock deadline math (satellite)
+
+def test_deployment_watcher_progress_deadline_manual_clock():
+    """The progress-deadline decision rides chrono.Clock: a ManualClock
+    advance fails the deployment with zero real sleeps."""
+    from nomad_tpu.chrono import ManualClock
+    from nomad_tpu.server.deployment_watcher import (
+        DESC_PROGRESS_DEADLINE, DeploymentWatcher,
+    )
+    from nomad_tpu.structs import (
+        Deployment, DeploymentState, DEPLOYMENT_STATUS_FAILED,
+    )
+
+    s = Server(num_workers=0, gc_interval=9999)   # never started
+    clock = ManualClock()
+    w = DeploymentWatcher(s, clock=clock)
+    d = Deployment(job_id="j", task_groups={
+        "web": DeploymentState(desired_total=1,
+                               progress_deadline_sec=100.0)})
+    s.state.upsert_deployment(2, d)
+    w._watch_one(s.state.deployment_by_id(d.id))   # arms the deadline
+    assert s.state.deployment_by_id(d.id).status == "running"
+    clock.advance(99.0)
+    w._watch_one(s.state.deployment_by_id(d.id))
+    assert s.state.deployment_by_id(d.id).status == "running"
+    clock.advance(2.0)                             # past the deadline
+    w._watch_one(s.state.deployment_by_id(d.id))
+    got = s.state.deployment_by_id(d.id)
+    assert got.status == DEPLOYMENT_STATUS_FAILED
+    assert got.status_description == DESC_PROGRESS_DEADLINE
+
+
+def test_drainer_force_deadline_manual_clock():
+    """The drain force-deadline decision rides chrono.Clock: before the
+    deadline max_parallel is respected, advancing virtual time past it
+    force-drains everything — no real waiting."""
+    from nomad_tpu.chrono import ManualClock
+    from nomad_tpu.server.drainer import NodeDrainer
+    from nomad_tpu.structs import DrainStrategy, MigrateStrategy
+
+    s = Server(num_workers=0, gc_interval=9999)   # never started
+    clock = ManualClock()
+    dr = NodeDrainer(s, clock=clock)
+    st = s.state
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+    st.upsert_job(2, job)
+    node = mock.node()
+    node.drain_strategy = DrainStrategy(
+        deadline_sec=1000.0,
+        force_deadline_unix=clock.time() + 1000.0)
+    st.upsert_node(3, node)
+    st.upsert_allocs(4, [mock.alloc_for(job, node, i) for i in range(2)])
+
+    def migrating():
+        return sum(a.desired_transition.should_migrate()
+                   for a in st.allocs_by_node(node.id))
+
+    dr._drain_node(st.node_by_id(node.id))
+    assert migrating() == 1                  # max_parallel before deadline
+    dr._drain_node(st.node_by_id(node.id))
+    assert migrating() == 1                  # still capped
+    clock.advance(2000.0)                    # past the force deadline
+    dr._drain_node(st.node_by_id(node.id))
+    assert migrating() == 2                  # force drains the rest
+
+
+# --------------------------------------------------- ingress admission
+
+def test_http_admission_429_with_retry_after():
+    from nomad_tpu.agent.http import HTTPAPI, HTTPError
+
+    class _AgentStub:
+        def __init__(self, server):
+            self.server = server
+            self.client = None
+
+    s = Server(num_workers=0, gc_interval=9999)
+    s.start()
+    try:
+        s.state.set_scheduler_config(
+            s.state.latest_index() + 1,
+            SchedulerConfiguration(ingress_write_rate=1.0,
+                                   ingress_burst_s=1.0))
+        api = HTTPAPI(_AgentStub(s))
+        job = mock.job()
+        from nomad_tpu.api_codec import to_api
+        body = {"Job": to_api(job)}
+        api.handle("PUT", "/v1/jobs", {}, body)          # takes the token
+        with pytest.raises(HTTPError) as exc:
+            api.handle("PUT", "/v1/jobs", {}, body)
+        assert exc.value.code == 429
+        assert exc.value.retry_after > 0.0
+        # reads are unlimited here, and /v1/status stays admissible
+        api.handle("GET", "/v1/jobs", {}, None)
+        out, _ = api.handle("GET", "/v1/status", {}, None)
+        assert out["Pressure"]["State"] == PRESSURE_OK
+        assert out["Pressure"]["Limits"]["write"] == 1.0
+    finally:
+        s.shutdown()
+
+
+def test_rpc_admission_rate_limit_error():
+    from nomad_tpu.rpc.client import RpcClient
+    from nomad_tpu.rpc.codec import RateLimitError
+
+    s = Server(num_workers=0, gc_interval=9999)
+    s.rpc_listen()
+    s.start()
+    try:
+        s.state.set_scheduler_config(
+            s.state.latest_index() + 1,
+            SchedulerConfiguration(ingress_write_rate=1.0,
+                                   ingress_burst_s=1.0))
+        with RpcClient([s.rpc_addr]) as cli:
+            cli.call("Job.Register", mock.job())         # takes the token
+            with pytest.raises(RateLimitError) as exc:
+                cli.call("Job.Register", mock.job())
+            assert exc.value.retry_after_s > 0.0
+            # reads ride a separate (unlimited) bucket
+            cli.call("Operator.SchedulerGetConfiguration")
+    finally:
+        s.shutdown()
+
+
+def test_api_client_honors_retry_after_with_budget():
+    from nomad_tpu.api.client import APIError, Client
+
+    hits = []
+
+    class _Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            hits.append(time.monotonic())
+            if len(hits) <= 2:
+                body = json.dumps({"error": "rate limit exceeded"}).encode()
+                self.send_response(429)
+                self.send_header("Retry-After", "0.05")
+            else:
+                body = json.dumps({"ok": True}).encode()
+                self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    addr = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        c = Client(address=addr, retry_429=3, retry_budget_s=5.0)
+        out, _ = c.get("/v1/jobs")
+        assert out == {"ok": True}
+        assert len(hits) == 3
+        # jittered backoff actually waited the hinted interval
+        assert hits[1] - hits[0] >= 0.05
+        # retry_429=0 restores raise-immediately with the hint attached
+        hits.clear()
+        c0 = Client(address=addr, retry_429=0)
+        with pytest.raises(APIError) as exc:
+            c0.get("/v1/jobs")
+        assert exc.value.status == 429
+        assert exc.value.retry_after_s == pytest.approx(0.05)
+        assert len(hits) == 1
+        # a tiny budget gives up early instead of sleeping past it
+        hits.clear()
+        cb = Client(address=addr, retry_429=5, retry_budget_s=0.0)
+        with pytest.raises(APIError):
+            cb.get("/v1/jobs")
+        assert len(hits) == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_blocking_query_brownout_shortens_hold():
+    from nomad_tpu.agent.http import HTTPAPI
+
+    class _AgentStub:
+        def __init__(self, server):
+            self.server = server
+            self.client = None
+
+    s = Server(num_workers=0, gc_interval=9999)
+    s.start()
+    try:
+        s.state.set_scheduler_config(
+            s.state.latest_index() + 1,
+            SchedulerConfiguration(broker_depth_cap=4))
+        for i in range(6):
+            s.eval_broker.enqueue(
+                Evaluation(type="service", job_id=f"p{i}", priority=50))
+        assert s.overload.tick() == PRESSURE_SHEDDING
+        api = HTTPAPI(_AgentStub(s))
+        t0 = time.monotonic()
+        _, index = api.handle(
+            "GET", "/v1/nodes",
+            {"index": str(s.state.latest_index() + 1000), "wait": "20s"},
+            None)
+        held = time.monotonic() - t0
+        assert held < 5.0, f"blocking query held {held:.1f}s under shedding"
+    finally:
+        s.shutdown()
+
+
+# -------------------------------------------------- chaos acceptance run
+
+@pytest.mark.chaos
+def test_overload_burst_chaos_shed_and_backoff_reentry():
+    """ISSUE 8 acceptance: a burst beyond the broker cap, WITH injected
+    solver tier demotions active. Sheds carry the `shed` disposition,
+    re-enter via the failed-eval backoff lifecycle (reaper -> delayed
+    failed-follow-up), the cap holds, and the system drains."""
+    obs_trace.configure(enabled=True, sample_rate=1.0)
+    faults.install({"solver.dispatch.*":
+                    {"mode": "probability", "p": 0.3, "seed": 7}})
+    # workers start AFTER the burst lands: the shed decisions are then a
+    # pure function of (priority, seq) — a warm scheduler draining mid-
+    # burst would make "did the cap trip" a race
+    s = Server(num_workers=0, gc_interval=9999)
+    # chaos-speed retry shape: the default 20s nack delay would park
+    # faulted evals (still counted as backlog) for most of the test
+    s.eval_broker.initial_nack_delay = 0.01
+    s.eval_broker.subsequent_nack_delay = 0.01
+    s.start()
+    try:
+        for _ in range(3):
+            s.node_register(mock.node())
+        cap = 6
+        s.state.set_scheduler_config(
+            s.state.latest_index() + 1,
+            SchedulerConfiguration(broker_depth_cap=cap,
+                                   eval_deadline_s=60.0))
+        shed_base = metrics.counter("nomad.broker.shed")
+        for i in range(20):
+            job = mock.job()
+            job.id = job.name = f"burst-{i}"
+            job.task_groups[0].count = 1
+            job.priority = 30 + (i % 3) * 20
+            s.job_register(job)
+            s.overload.tick()       # the 1s leader tick, at burst speed
+            assert s.eval_broker.depth() <= cap, \
+                "broker depth exceeded its cap during the burst"
+        shed_n = metrics.counter("nomad.broker.shed") - shed_base
+        assert shed_n > 0, "burst never tripped the shedder"
+        assert s.overload.tick() == PRESSURE_SHEDDING
+        # now bring the workers up to drain the survivors under chaos
+        from nomad_tpu.server.worker import Worker
+        s.workers = [Worker(s, i) for i in range(2)]
+        for w in s.workers:
+            w.start()
+        # shed dispositions are traced
+        shed_ids = [eid for _, _, eid in s.eval_broker.shed_log]
+        shed_traced = [obs_trace.get(eid) for eid in shed_ids]
+        assert any(t is not None and t["status"] == "shed"
+                   for t in shed_traced)
+        assert s.overload.max_broker_depth > 0
+        # backoff re-entry: the reaper terminates each shed eval and
+        # emits a delayed failed-follow-up (nothing vanishes)
+        assert wait_until(
+            lambda: s.core_scheduler.reap_failed_evals() >= 0 and any(
+                e.triggered_by == TRIGGER_FAILED_FOLLOW_UP
+                for e in s.state.iter_evals()), timeout=15)
+        # the READY backlog drains despite the injected chaos (delayed
+        # follow-ups legitimately park in the delay heap with backoff —
+        # the operator drain below is their documented exit)
+        def _ready_drained():
+            st = s.eval_broker.stats
+            return (st["total_ready"] - st["total_failed"] == 0
+                    and st["total_unacked"] == 0
+                    and st["total_pending"] == 0)
+        assert wait_until(_ready_drained, timeout=60)
+        # recovery: cancel the parked retries (the operator escape
+        # hatch) and the pressure state returns to ok
+        s.eval_drain_failed()
+        assert wait_until(
+            lambda: s.overload.tick() == PRESSURE_OK, timeout=10)
+    finally:
+        faults.clear()
+        s.shutdown()
