@@ -15,7 +15,10 @@ TPU re-compiles) every invocation. Exempt idioms that amortize the
 construction: `return jax.jit(...)` (factory — construction cost is the
 caller's, once), assignment into a subscripted cache
 (`self._fns[key] = jax.jit(...)`), and assignment to a `global`/
-`nonlocal` memo (`global _fn; _fn = jax.jit(...)`).
+`nonlocal` memo (`global _fn; _fn = jax.jit(...)`). Each exemption
+looks through wrapper calls taking the jit as an argument — the sharded
+tier's `_serialize_launches(jax.jit(...))` keeps the jit's compile
+cache alive inside the returned/stored wrapper.
 """
 from __future__ import annotations
 
@@ -167,7 +170,17 @@ class JitPerCallConstruction(Rule):
 
     def _is_memoized(self, mod: SourceModule, call: ast.Call,
                      scope: ast.AST) -> bool:
+        # a jit built inside a wrapper call — e.g. the sharded tier's
+        # `_serialize_launches(jax.jit(...))` (launch serialization,
+        # sharding.py) — is memoized iff the WRAPPER's result is: climb
+        # through calls that take the jit (or its wrapper) as an
+        # argument before applying the factory/cache-store checks
+        node = call
         parent = mod.parent(call)
+        while isinstance(parent, ast.Call) and \
+                any(node is a for a in parent.args):
+            node = parent
+            parent = mod.parent(node)
         if isinstance(parent, ast.Return):
             return True                          # factory pattern
         if isinstance(parent, ast.Assign):

@@ -1,0 +1,237 @@
+"""SHARD001 — node-axis matrices on device without an explicit sharding
+spec, and in/out sharding arity mismatches (ISSUE 9).
+
+The failure mode this rule exists for is SILENT: `jax.device_put(cap)`
+or `jax.jit(f)` over a node-axis matrix without a spec does not crash —
+GSPMD happily replicates the array onto every device, which is invisible
+at 10k nodes and an OOM (plus a full per-eval re-scatter) at 100k. The
+blessed pattern is `solver/sharding.py`'s helpers (`put_node_sharded`,
+`node_sharding`, the `sharded_*` kernel wrappers with matching
+in/out specs) and `solver/state_cache.py`'s spec-carrying `_jit` cache —
+those two files OWN sharding decisions and are exempt from the
+missing-spec checks (the arity checks still apply there: a wrapper whose
+`in_shardings` tuple disagrees with its target's signature fails at
+trace time with an error pointing nowhere near the real mistake).
+
+Flagged (outside sharding.py / state_cache.py):
+  * `jax.device_put(<node-matrix name>)` with no placement argument
+    (2nd positional / `device=` / `sharding=` keyword) — a bare put of
+    `cap`/`used`/`*_dev` replicates under a mesh;
+  * `jax.jit(f, ...)` (call, decorator, or `functools.partial(jax.jit,
+    ...)` decorator) with NO `in_shardings`, where `f` is resolvable in
+    the module (local def / lambda) and its signature carries BOTH a
+    cap-ish and a used-ish parameter — the node-matrix solve shape.
+
+Flagged everywhere (arity checks):
+  * `in_shardings=(...)` tuple whose length differs from the resolvable
+    target's positional-parameter count;
+  * `out_shardings=(...)` tuple whose length differs from the target's
+    single `return (a, b, ...)` tuple, when that is statically visible.
+
+Solo-tier programs that deliberately leave sharding to the backend
+selector chains (the `kernels.py` jits) carry baseline entries; new
+sites take an inline `# nomadlint: disable=SHARD001 — <why>` with a
+justification, the standard workflow (docs/STATIC_ANALYSIS.md).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Rule, SourceModule, register
+
+_EXEMPT_FILES = ("solver/sharding.py", "solver/state_cache.py")
+
+def _matrixish_name(name: str) -> bool:
+    low = name.lower()
+    return low in ("cap", "used") or low.endswith("_dev") or \
+        low.startswith(("cap_", "used_"))
+
+
+def _param_names(fn) -> list:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _has_cap_and_used(params: list) -> bool:
+    has_cap = any(p == "cap" or p.startswith("cap_") for p in params)
+    has_used = any(p == "used" or p.startswith("used_") for p in params)
+    return has_cap and has_used
+
+
+def _expr_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+class _Resolver:
+    """Best-effort target-signature resolution: lambdas inline, local
+    function defs by name resolved through the ENCLOSING scopes of the
+    jit call site first (several factories define a local `run`; the
+    nearest one is the python binding that applies), module level last."""
+
+    def __init__(self, mod: SourceModule):
+        self._mod = mod
+
+    def _lookup(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        scopes = [s for s in self._mod.ancestors(at)
+                  if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Module))]
+        scopes.append(self._mod.tree)
+        for scope in scopes:
+            for child in ast.walk(scope):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and \
+                        child.name == name:
+                    return child
+        return None
+
+    def params(self, target: ast.AST) -> Optional[list]:
+        if isinstance(target, ast.Lambda):
+            return _param_names(target)
+        if isinstance(target, ast.Name):
+            fn = self._lookup(target.id, target)
+            if fn is not None:
+                return _param_names(fn)
+        return None
+
+    def return_tuple_len(self, target: ast.AST) -> Optional[int]:
+        fn = None
+        if isinstance(target, ast.Name):
+            fn = self._lookup(target.id, target)
+        elif isinstance(target, ast.Lambda):
+            body = target.body
+            return len(body.elts) if isinstance(body, ast.Tuple) else None
+        if fn is None:
+            return None
+        lens = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                lens.add(len(node.value.elts)
+                         if isinstance(node.value, ast.Tuple) else -1)
+        if len(lens) == 1:
+            n = lens.pop()
+            return n if n > 0 else None
+        return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.keyword]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+@register
+class UnshardedNodeMatrix(Rule):
+    id = "SHARD001"
+    severity = "error"
+    short = ("device_put/jit of a node-axis matrix (cap/used/*_dev) "
+             "without an explicit sharding spec outside sharding.py/"
+             "state_cache.py, or in/out_shardings arity mismatches — "
+             "silent full replication OOMs at 100k nodes")
+
+    def _exempt(self, mod: SourceModule) -> bool:
+        p = "/" + mod.match_path.lstrip("/")
+        return any(p.endswith(e) or ("/" + e) in p for e in _EXEMPT_FILES)
+
+    # -------------------------------------------------- per-call checks
+
+    def _check_device_put(self, mod, node: ast.Call) -> Optional[str]:
+        if not node.args:
+            return None
+        name = _expr_name(node.args[0])
+        if not name or not _matrixish_name(name):
+            return None
+        if len(node.args) >= 2 or _kw(node, "device") is not None or \
+                _kw(node, "sharding") is not None:
+            return None
+        return (f"jax.device_put({name}) without a placement: under a "
+                f"device mesh this silently REPLICATES the node matrix "
+                f"onto every device — use sharding.put_node_sharded / "
+                f"pass a NamedSharding, or move the decision into "
+                f"sharding.py/state_cache.py")
+
+    def _check_jit(self, mod, node: ast.Call, target: ast.AST,
+                   resolver: _Resolver, exempt_file: bool) -> list:
+        out = []
+        params = resolver.params(target)
+        in_sh = _kw(node, "in_shardings")
+        out_sh = _kw(node, "out_shardings")
+        if in_sh is None and not exempt_file and params is not None and \
+                _has_cap_and_used(params):
+            out.append(
+                f"jax.jit of `{_expr_name(target) or '<lambda>'}"
+                f"({', '.join(params[:4])}{', ...' if len(params) > 4 else ''})`"
+                f" carries node-axis matrices but no in_shardings: under "
+                f"a mesh the compiled program replicates them — give it "
+                f"explicit specs (sharding.node_sharding) or route it "
+                f"through the sharding.py wrappers")
+        if in_sh is not None and isinstance(in_sh.value, ast.Tuple) and \
+                params is not None and len(in_sh.value.elts) != len(params):
+            out.append(
+                f"in_shardings has {len(in_sh.value.elts)} entries but "
+                f"`{_expr_name(target) or '<lambda>'}` takes "
+                f"{len(params)} positional parameters — the mismatch "
+                f"fails at trace time far from this line")
+        if out_sh is not None and isinstance(out_sh.value, ast.Tuple):
+            rlen = resolver.return_tuple_len(target)
+            if rlen is not None and rlen != len(out_sh.value.elts):
+                out.append(
+                    f"out_shardings has {len(out_sh.value.elts)} entries "
+                    f"but `{_expr_name(target) or '<lambda>'}` returns a "
+                    f"{rlen}-tuple")
+        return out
+
+    # ---------------------------------------------------------- driver
+
+    def check(self, mod: SourceModule) -> list:
+        findings = []
+        exempt_file = self._exempt(mod)
+        resolver = _Resolver(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                dotted = mod.dotted(node.func)
+                if dotted == "jax.device_put" and not exempt_file:
+                    msg = self._check_device_put(mod, node)
+                    if msg:
+                        findings.append(mod.finding(self, node, msg))
+                elif dotted == "jax.jit" and node.args:
+                    for msg in self._check_jit(mod, node, node.args[0],
+                                               resolver, exempt_file):
+                        findings.append(mod.finding(self, node, msg))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorator forms: @jax.jit and
+                # @functools.partial(jax.jit, static_argnames=...)
+                for dec in node.decorator_list:
+                    call = None
+                    bare = False
+                    if isinstance(dec, ast.Call):
+                        d = mod.dotted(dec.func)
+                        if d == "jax.jit":
+                            call = dec
+                        elif d == "functools.partial" and dec.args and \
+                                mod.dotted(dec.args[0]) == "jax.jit":
+                            call = dec
+                    elif mod.dotted(dec) == "jax.jit":
+                        bare = True
+                    if call is None and not bare:
+                        continue
+                    params = _param_names(node)
+                    has_specs = call is not None and \
+                        _kw(call, "in_shardings") is not None
+                    if not exempt_file and not has_specs and \
+                            _has_cap_and_used(params):
+                        findings.append(mod.finding(
+                            self, dec,
+                            f"jitted `{node.name}({', '.join(params[:4])}"
+                            f"{', ...' if len(params) > 4 else ''})` "
+                            f"carries node-axis matrices but no "
+                            f"in_shardings: under a mesh the compiled "
+                            f"program replicates them — give it explicit "
+                            f"specs or route it through the sharding.py "
+                            f"wrappers"))
+        return findings

@@ -1984,6 +1984,12 @@ class Server:
         from .raft import RaftNode
         if not isinstance(self.raft, RaftNode) or not self.is_leader:
             return
+        # tick evidence: tests that drive this method directly (the
+        # de-flaked gossip promote test) still assert the HOUSEKEEPING
+        # LOOP invokes it, via this counter — dropping the loop call
+        # would silently stop real clusters from promoting nonvoters
+        from ..metrics import metrics
+        metrics.incr("nomad.autopilot.promote_tick")
         cfg = self.state.get_autopilot_config()
         stabilization = float(cfg.get("ServerStabilizationTimeSec", 10.0))
         for s_h in self.raft.server_health():

@@ -120,8 +120,18 @@ def _mesh(devs):
     key = tuple(d.id for d in devs)
     m = _mesh_cache.get(key)
     if m is None:
-        from .sharding import make_mesh
-        m = _mesh_cache[key] = make_mesh(devs)
+        import jax
+
+        from . import sharding
+        if len(devs) == len(jax.devices()):
+            # the full-device mesh MUST be the process singleton: the
+            # state cache's resident twins are placed with shardings
+            # over it, and a kernel jit built on a different Mesh object
+            # would reshard every twin it consumes (ISSUE 9)
+            m = sharding.mesh()
+        if m is None:
+            m = sharding.make_mesh(devs)
+        _mesh_cache[key] = m
     return m
 
 
@@ -420,6 +430,18 @@ def _tier(n_padded: int, count=None):
         if microbatch.enabled():
             return "batch", devs
         return "host", devs
+    if len(devs) > 1 and count is not None and 0 < count <= HOST_MAX_COUNT:
+        # multi-device mesh off-TPU (CPU dev mesh, GPU pods): the stream
+        # regression fix (ISSUE 9 satellite; BENCH_r05's host=16 class
+        # of failure) — concurrent small solves must coalesce here too,
+        # with the micro-batch lanes data-parallel over the mesh
+        # (sharding.lane_sharding). The concurrency gate keeps solo
+        # evals on the xla tier: select() re-resolves the tier per call
+        # (the cache keys on the RESOLVED tier), so this is a dynamic
+        # routing decision, not a cached one.
+        from . import microbatch
+        if microbatch.enabled() and microbatch.concurrency() > 1:
+            return "batch", devs
     if len(devs) > 1 and n_padded >= SHARD_MIN_NODES and \
             n_padded % len(devs) == 0:
         return "sharded", devs

@@ -6,19 +6,32 @@ N call sites could silently disagree and fan the artifact set out.
 Single-sourcing them here makes the compile-cache key space enumerable —
 which is exactly what `backend.warmup()` walks at leader election.
 
-  node_bucket(n)   the padded node axis for n live nodes (floor 8);
-                   tensorize's device gathers, the placer's host padding,
-                   state_cache's device twins and backend.warmup() must
-                   all agree on this or a cache-hit eval would recompile.
+  node_bucket(n)   the padded node axis for n live nodes (floor 8),
+                   rounded to a multiple of the device-mesh size so the
+                   sharded tier sees identical per-shard shapes (ISSUE 9:
+                   GSPMD requires the sharded axis to divide evenly;
+                   every shard gets bucket/S rows, padding rows are
+                   infeasible and inert). tensorize's device gathers,
+                   the placer's host padding, state_cache's device twins
+                   and backend.warmup() must all agree on this or a
+                   cache-hit eval would recompile.
   pow2(n, floor)   generic pow2 round-up (spread/distinct stanza axes,
                    preemption victim axes, scatter-batch padding).
   BATCH_LANES      the eval-stream micro-batch lane count (one compiled
                    jit(vmap) artifact, ever — microbatch.py).
+
+For the (universal) power-of-two device counts the mesh rounding is a
+no-op — a pow2 bucket >= 8 already divides by 1/2/4/8 devices — but a
+torn pod (e.g. 6 healthy chips) must not silently unshard every solve,
+so the rounding is explicit rather than assumed.
 """
 from __future__ import annotations
 
 NODE_BUCKET_FLOOR = 8
 BATCH_LANES = 8
+
+_MESH_SHARDS: int = 0       # last resolved count (fallback when jax is
+                            # unimportable mid-process; tests _reset_shards)
 
 
 def pow2(n: int, floor: int = 1) -> int:
@@ -26,6 +39,36 @@ def pow2(n: int, floor: int = 1) -> int:
     return max(floor, 1 << (max(n, 1) - 1).bit_length())
 
 
+def mesh_shards() -> int:
+    """Device count the sharded tier's 1-D mesh spans (1 = solo). Read
+    lazily (importing the solver never initializes a jax backend) and
+    re-resolved per call — `jax.devices()` is cached by jax, and the
+    device set can change under us (torn pod, tests faking devices):
+    `sharding.mesh()` and the placer's preempt wrapper self-heal on
+    that, so the bucket rounding must track the same count or buckets
+    stop being mesh multiples and every solve silently unshards."""
+    global _MESH_SHARDS
+    try:
+        import jax
+        _MESH_SHARDS = max(1, len(jax.devices()))
+    except Exception:   # noqa: BLE001 — no backend => solo shapes
+        if _MESH_SHARDS <= 0:
+            _MESH_SHARDS = 1
+    return _MESH_SHARDS
+
+
+def _reset_shards() -> None:
+    """Drop the fallback count (tests that fake then restore devices)."""
+    global _MESH_SHARDS
+    _MESH_SHARDS = 0
+
+
 def node_bucket(n: int) -> int:
-    """The padded node-axis bucket for `n` live nodes."""
-    return pow2(n, NODE_BUCKET_FLOOR)
+    """The padded node-axis bucket for `n` live nodes: pow2 (floor 8),
+    then rounded up to a multiple of the mesh size so every shard of the
+    sharded tier sees the same [bucket/S, R'] block shape."""
+    b = pow2(n, NODE_BUCKET_FLOOR)
+    s = mesh_shards()
+    if s > 1 and b % s:
+        b += s - (b % s)
+    return b

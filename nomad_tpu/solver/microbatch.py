@@ -271,10 +271,38 @@ class MicroBatcher:
         # wrapper and one compile cache would be silently discarded —
         # construction is cheap, tracing happens later outside the lock
         with self._lock:
-            fn = self._vmapped.get(static_key)
+            from .sharding import _serialize_launches, lane_sharding, mesh
+            # the mesh object keys the cache alongside the static shape:
+            # a device-set change (torn pod, tests faking devices)
+            # rebuilds sharding.mesh()'s singleton, and a wrapper whose
+            # NamedShardings reference the DEAD mesh would throw on
+            # every coalesced dispatch forever (fanning all lanes out to
+            # host) — same self-healing as placer._preempt_sharded_fn
+            m = mesh()
+            key = (static_key, m)
+            fn = self._vmapped.get(key)
             if fn is None:
                 import jax
-                fn = self._vmapped[static_key] = jax.jit(jax.vmap(inner))
+
+                # on a device mesh the LANE axis (axis 0 of every
+                # stacked column) goes data-parallel over the devices:
+                # one coalesced dispatch, each shard solving its lanes'
+                # evals (ISSUE 9; the "evals" axis of SURVEY §2.7). A
+                # single sharding is a valid pytree prefix for the whole
+                # arg tuple — every stacked column shares the lane axis.
+                # The launch is serialized (sharding.py): concurrent
+                # batch leaders' multi-device dispatches must not
+                # interleave collective rendezvous. Solo-device (or
+                # non-dividing lane counts): plain jit, exactly as
+                # before.
+                sh = lane_sharding(LANES, m)
+                if sh is not None:
+                    self._vmapped[key] = _serialize_launches(
+                        jax.jit(jax.vmap(inner), in_shardings=sh,
+                                out_shardings=sh))
+                else:
+                    self._vmapped[key] = jax.jit(jax.vmap(inner))
+                fn = self._vmapped[key]
         return fn
 
     def reset(self) -> None:

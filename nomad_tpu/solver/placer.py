@@ -46,6 +46,18 @@ from .tensorize import (
 
 _usage_update_fn = None
 _preempt_batched_fn = None
+# (mesh, fn): the compiled sharded preempt wrapper is only valid for the
+# mesh it was built on — keying by the mesh object self-heals when a
+# test (or torn-pod handling) changes the device set, instead of
+# padding inputs for the NEW shard count into an executable compiled
+# for the old one
+_preempt_sharded_fn: tuple = (None, None)
+
+# candidate-node axes at least this long shard their preemption victim
+# scan over the device mesh (ISSUE 9 cross-shard reduce); below it the
+# solo jit(vmap) wins on dispatch latency. Module-level so tests force
+# the route (tests/test_sharding.py).
+PREEMPT_SHARD_MIN = 1024
 
 
 def _preempt_batched():
@@ -258,7 +270,8 @@ class SolverPlacer:
         nodes = [nodes[i] for i in perm]
 
         feasible_fn = self._feasibility_fn(tg)
-        gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn)
+        gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn,
+                                 count=count)
         spreads = list(tg.spreads) + list(job.spreads)
         affinities = list(job.affinities) + list(tg.affinities)
         for t in tg.tasks:
@@ -395,14 +408,28 @@ class SolverPlacer:
     def _dev_mats(gt, bname: str):
         """The state cache's device twins, when tier `bname` should ride
         them (values identical to gt.cap/gt.used, transfer already
-        paid) — else None. Only the default-device tiers qualify:
-        host/batch need numpy so `jax.default_device` (and the micro-
-        batcher's np.stack lane packing) place them host-side, and
-        sharded keeps numpy so GSPMD owns the initial layout. Callers
-        MUST pass the numpy twin as the chain's `host_args` so a
-        demotion never retries the sick device's own buffers."""
-        if gt.cap_dev is not None and gt.used_dev is not None and \
-                bname in ("xla", "pallas"):
+        paid) — else None. host/batch always need numpy so
+        `jax.default_device` (and the micro-batcher's np.stack lane
+        packing) place them host-side. On a device mesh the twins are
+        node-axis PARTITIONED (ISSUE 9) and feed the sharded tier ONLY:
+        its in_shardings match the resident spec, so chained solves stay
+        partitioned with no per-eval re-scatter. The solo tiers (xla /
+        pallas) take numpy there — argument shardings are part of a
+        compiled executable's identity, so letting them consume
+        partitioned twins would double every artifact into a sharded and
+        an unsharded variant (and pallas_call is not GSPMD-aware at
+        all). On a single device the twins are unsharded and xla/pallas
+        ride them exactly as before (ISSUE 4). Callers MUST pass the
+        numpy twin as the chain's `host_args` so a demotion never
+        retries the sick device's own buffers."""
+        if gt.cap_dev is None or gt.used_dev is None:
+            return None
+        from .sharding import is_node_sharded
+        if is_node_sharded(gt.cap_dev):
+            if bname == "sharded":
+                return gt.cap_dev, gt.used_dev
+            return None
+        if bname in ("xla", "pallas"):
             return gt.cap_dev, gt.used_dev
         return None
 
@@ -931,9 +958,8 @@ class SolverPlacer:
                 free[i] -= alloc_usage_tuple(a)
         ask = group_ask_row(tg)
 
-        masks = np.asarray(_preempt_batched()(
-            jnp.asarray(victim_res), jnp.asarray(victim_prio),
-            jnp.asarray(ask), jnp.asarray(free), jnp.int32(job_prio)))
+        masks = self._preempt_masks(victim_res, victim_prio, ask, free,
+                                    job_prio)
 
         # fewest-victims nodes first (minimal disruption, the
         # PreemptionScoringIterator's preference, ref rank.go:775)
@@ -972,6 +998,68 @@ class SolverPlacer:
             else:
                 remaining.insert(0, missing)
         return remaining
+
+    def _preempt_masks(self, victim_res, victim_prio, ask, free,
+                       job_prio) -> np.ndarray:
+        """Victim-mask solve over all candidate nodes -> bool[C, V]. At
+        pod scale the CANDIDATE axis shards over the device mesh
+        (sharding.sharded_preempt_top_k: per-shard masked top-k victim
+        scans, winner masks gathered — the preemption half of the
+        ISSUE 9 cross-shard reduce); the solo jit(vmap) serves small
+        axes and every demotion. The sharded attempt rides the standard
+        ladder discipline: `solver.dispatch.sharded` fault site, the
+        sharded tier's circuit breaker, and a host-arg retry (the solo
+        path re-solves from the SAME numpy inputs, so a sick mesh never
+        changes the verdict, only the route)."""
+        global _preempt_sharded_fn
+        demoted = False
+        c = victim_res.shape[0]
+        from . import sharding
+        m = sharding.mesh()
+        # the forced-tier override quarantines the mesh for preemption
+        # scans too: NOMAD_SOLVER_BACKEND=host/xla must keep EVERY
+        # multi-device launch off a sick interconnect, not just solves
+        forced = os.environ.get("NOMAD_SOLVER_BACKEND", "")
+        if m is not None and c >= PREEMPT_SHARD_MIN and \
+                forced in ("", "sharded") and \
+                backend.breaker().admit("sharded"):
+            from .. import faults
+            s = len(m.devices.flat)
+            pad = (-c) % s
+            try:
+                with trace.span("solver.dispatch.sharded",
+                                kernel="preempt", candidates=c):
+                    faults.fire("solver.dispatch.sharded")
+                    if _preempt_sharded_fn[0] is not m:
+                        from .sharding import sharded_preempt_top_k
+                        _preempt_sharded_fn = (m, sharded_preempt_top_k(m))
+                    vr = np.pad(victim_res, ((0, pad), (0, 0), (0, 0)))
+                    # pad candidates are all-ineligible victims: the
+                    # masked scan returns an empty mask for them
+                    vp = np.pad(victim_prio, ((0, pad), (0, 0)),
+                                constant_values=2 ** 20)
+                    fr = np.pad(free, ((0, pad), (0, 0)))
+                    out = np.asarray(_preempt_sharded_fn[1](
+                        vr, vp, np.asarray(ask, np.float32), fr,
+                        np.int32(job_prio)))[:c]
+                backend.breaker_record("sharded", ok=True)
+                metrics.incr("nomad.solver.dispatch.sharded")
+                return out
+            except backend.device_error_types():
+                backend.breaker_record("sharded", ok=False)
+                metrics.incr("nomad.solver.tier_demotions")
+                metrics.incr("nomad.solver.tier_demotions.sharded")
+                trace.annotate_list("demotions", "sharded")
+                demoted = True
+        out = np.asarray(_preempt_batched()(
+            jnp.asarray(victim_res), jnp.asarray(victim_prio),
+            jnp.asarray(ask), jnp.asarray(free), jnp.int32(job_prio)))
+        if demoted:
+            # same surface backend._chain reports a lower-tier serve on
+            # after a demotion — preemption scans must not be invisible
+            # on the degraded-serves dashboards
+            metrics.incr("nomad.solver.tier_degraded_serves.xla")
+        return out
 
     # ------------------------------------------- batched alloc materialization
 

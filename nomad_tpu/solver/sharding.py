@@ -1,12 +1,36 @@
 """Multi-chip sharding for the solver (SURVEY.md §2.7: node axis over ICI).
 
-The recipe (scaling-book style): pick a Mesh, annotate input shardings, let
-GSPMD insert the collectives. The node axis shards across the "nodes" mesh
-axis; eval batches shard across "evals" (data parallel over evaluations —
-the TPU analog of the reference's per-core scheduler workers,
-ref nomad/server.go:1581).
+The recipe (scaling-book style, SNIPPETS [1]-[3]): pick a 1-D Mesh,
+annotate input shardings with `NamedSharding`/`PartitionSpec` along axis
+0, let GSPMD insert the collectives — and give every producer MATCHING
+out_shardings so chained solves stay partitioned (the pjit contract: the
+output of one sharded program feeding the next must already carry the
+next program's in_shardings, or every eval pays a full re-scatter). The
+node axis shards across the "nodes" mesh axis; eval batches shard across
+the same 1-D mesh (data parallel over evaluations — the TPU analog of
+the reference's per-core scheduler workers, ref nomad/server.go:1581).
+
+ISSUE 9 additions on top of the kernel wrappers:
+  * `mesh()`/`node_sharding()`/`vec_sharding()`/`lane_sharding()` — the
+    process-wide mesh singleton and the specs every resident node-axis
+    array (state_cache device twins, microbatch lanes) is placed with.
+  * `is_node_sharded(x)` — introspection: does `x` already carry the
+    node-axis NamedSharding (so a dispatch can consume it without a
+    re-scatter, and tests can assert nothing silently replicated)?
+  * `cross_shard_top_k` / `sharded_spread_counts` — the EXPLICIT
+    shard_map forms of the two cross-shard reduces the production
+    kernels rely on GSPMD to insert (the chunked kernel's per-step
+    winner top-k and running spread-count psum). They are
+    parity-pinned against host oracles in tier-1
+    (tests/test_sharding.py): if a jax upgrade changes collective
+    semantics, these fail loudly where the compiler-inserted versions
+    would drift silently. `sharded_preempt_top_k` (below) is the
+    production-wired member of the family (placer._preempt_masks).
 """
 from __future__ import annotations
+
+import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -16,11 +40,190 @@ from .kernels import (
     fill_depth, fill_greedy_binpack, place_chunked, preempt_top_k,
 )
 
+NODE_AXIS = "nodes"
 
-def make_mesh(devices=None, axis: str = "nodes") -> Mesh:
+_mesh_lock = threading.Lock()
+_mesh_singleton: Mesh | None = None
+
+# ---------------------------------------------------- launch serialization
+#
+# Multi-device programs RENDEZVOUS: every shard's per-device execution
+# must arrive at the same collective instance. Two threads launching
+# sharded programs concurrently can interleave their per-device
+# executions so that (e.g.) rank 0 services launch A's all-gather while
+# rank 5 services launch B's — both rendezvous starve and the process
+# wedges (observed live: 16 stream workers' concurrent state-cache
+# gathers deadlocked the CPU mesh inside
+# collective_ops_utils rendezvous). Every sharded callable this module
+# hands out therefore serializes its LAUNCH behind one process-wide
+# lock; on the CPU backend (unordered thread-pool execution) the result
+# is additionally blocked on inside the lock, so a program's
+# collectives fully retire before the next launch enqueues. Real
+# accelerator runtimes execute launches in per-device FIFO order, so
+# consistent enqueue order alone suffices there and the async overlap
+# (pipelined chunks) is preserved.
+
+_launch_lock = threading.RLock()
+_launch_blocks: bool | None = None
+
+
+def _serialize_launches(fn):
+    @functools.wraps(fn)
+    def run(*args, **kwargs):
+        global _launch_blocks
+        if _launch_blocks is None:
+            _launch_blocks = jax.devices()[0].platform == "cpu"
+        with _launch_lock:
+            out = fn(*args, **kwargs)
+            if _launch_blocks:
+                out = jax.block_until_ready(out)
+            return out
+    return run
+
+
+def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     import numpy as np
     return Mesh(np.array(devices), (axis,))
+
+
+def mesh() -> Mesh | None:
+    """The process-wide 1-D solver mesh over ALL devices, or None when
+    only one device exists (solo tiers own that regime). One mesh for
+    the whole process: state-cache twins, microbatch lanes and the
+    sharded kernel wrappers must agree on it or chained dispatches
+    reshard between owners."""
+    global _mesh_singleton
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    with _mesh_lock:
+        if _mesh_singleton is None or \
+                len(_mesh_singleton.devices.flat) != len(devs):
+            _mesh_singleton = make_mesh(devs)
+        return _mesh_singleton
+
+
+def reset() -> None:
+    """Tests that fake the device set drop the mesh singleton."""
+    global _mesh_singleton, _launch_blocks
+    with _mesh_lock:
+        _mesh_singleton = None
+        _launch_blocks = None
+
+
+def node_sharding(m: Mesh | None = None) -> NamedSharding | None:
+    """NamedSharding for a [N(, R')] node-axis matrix: rows over the
+    mesh. The spec every resident cap/used twin is placed with — and the
+    in/out sharding of every sharded solve that consumes them."""
+    m = m if m is not None else mesh()
+    if m is None:
+        return None
+    return NamedSharding(m, P(NODE_AXIS, None))
+
+
+def vec_sharding(m: Mesh | None = None) -> NamedSharding | None:
+    """NamedSharding for a [N] node-axis vector (placements, feasible)."""
+    m = m if m is not None else mesh()
+    if m is None:
+        return None
+    return NamedSharding(m, P(NODE_AXIS))
+
+
+def lane_sharding(n_lanes: int, m: Mesh | None = None
+                  ) -> NamedSharding | None:
+    """NamedSharding for the micro-batcher's [LANES, ...] stacked solve
+    columns: the lane (eval) axis data-parallel over the same 1-D mesh.
+    None when the lane count does not divide over the devices — the
+    solo-device jit path is then correct as-is."""
+    m = m if m is not None else mesh()
+    if m is None or n_lanes % len(m.devices.flat):
+        return None
+    return NamedSharding(m, P(NODE_AXIS))
+
+
+def is_node_sharded(x, m: Mesh | None = None) -> bool:
+    """Does `x` carry the node-axis NamedSharding over the process mesh
+    (axis 0 actually partitioned — NOT fully replicated)? The assertion
+    behind "chained solves stay partitioned": a silently-replicated twin
+    OOMs at 100k nodes and pays a full scatter per eval."""
+    m = m if m is not None else mesh()
+    if m is None:
+        return False
+    sh = getattr(x, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return False
+    spec = tuple(sh.spec)
+    return bool(spec) and spec[0] == NODE_AXIS and \
+        sh.mesh.shape.get(NODE_AXIS, 1) > 1
+
+
+# ------------------------------------------------- cross-shard reduces
+
+def cross_shard_top_k(m: Mesh, k: int, axis: str = NODE_AXIS):
+    """Winner top-k as an EXPLICIT two-stage cross-shard reduce: each
+    shard scans its own rows for local winners, the S*k candidate
+    (score, global-index) pairs are all-gathered, and the global top-k
+    picks from candidates only — O(N/S) local work + an O(S*k)
+    collective instead of a full-axis gather. Correct because a global
+    winner is necessarily a winner of its own shard.
+
+    Returns fn(score f32[N]) -> (values f32[k], indices i32[k]), both
+    replicated (every shard holds the verdict — the placer reads it
+    once)."""
+    n_shards = m.shape[axis]
+
+    from jax.experimental.shard_map import shard_map
+
+    # check_rep=False: the replication of the post-all-gather top_k is
+    # semantic (every shard computes the same candidates), which the
+    # static rep checker cannot see through lax.top_k/take
+    @functools.partial(shard_map, mesh=m, in_specs=(P(axis),),
+                       out_specs=(P(), P()), check_rep=False)
+    def run(score):
+        n_local = score.shape[0]
+        shard = jax.lax.axis_index(axis)
+        v, i = jax.lax.top_k(score, min(k, n_local))
+        gi = (i + shard * n_local).astype(jnp.int32)
+        vs = jax.lax.all_gather(v, axis).reshape(-1)       # [S*k]
+        gs = jax.lax.all_gather(gi, axis).reshape(-1)
+        fv, fi = jax.lax.top_k(vs, min(k, n_shards * v.shape[0]))
+        return fv, jnp.take(gs, fi)
+
+    return _serialize_launches(jax.jit(run))
+
+
+def sharded_spread_counts(m: Mesh, n_props: int, axis: str = NODE_AXIS):
+    """Spread-stanza running counts as a per-shard bincount + psum: each
+    shard bin-counts its own nodes' placements per spread value, the
+    [S_stanza, P] partials sum across shards. The explicit form of the
+    reduce GSPMD inserts inside the chunked kernel's pcounts update.
+
+    Returns fn(ids i32[S, N] (-1 missing), add i32[N]) -> i32[S, P],
+    replicated."""
+    from jax.experimental.shard_map import shard_map
+
+    @functools.partial(shard_map, mesh=m, in_specs=(P(None, axis), P(axis)),
+                       out_specs=P(), check_rep=False)
+    def run(ids, add):
+        safe = jnp.clip(ids, 0, n_props - 1)
+        adds = jnp.where(ids >= 0, add[None, :], 0)
+        local = jax.vmap(
+            lambda row_ids, row_add: jnp.zeros((n_props,), jnp.int32)
+            .at[row_ids].add(row_add))(safe, adds)
+        return jax.lax.psum(local, axis)
+
+    return _serialize_launches(jax.jit(run))
+
+
+def put_node_sharded(arr, m: Mesh | None = None):
+    """Place a host [N(, R')] node-axis array onto the mesh with the
+    node-axis spec (the state cache's twin-seeding path). Falls back to
+    a plain device put when no mesh exists."""
+    sh = node_sharding(m)
+    if sh is None:
+        return jnp.asarray(arr)
+    return jax.device_put(arr, sh)
 
 
 def sharded_fill_greedy(mesh: Mesh, axis: str = "nodes"):
@@ -33,11 +236,11 @@ def sharded_fill_greedy(mesh: Mesh, axis: str = "nodes"):
     vec_sharded = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
 
-    return jax.jit(
+    return _serialize_launches(jax.jit(
         fill_greedy_binpack,
         in_shardings=(node_sharded, node_sharded, replicated, replicated,
                       vec_sharded, replicated),
-        out_shardings=vec_sharded)
+        out_shardings=vec_sharded))
 
 
 def sharded_place_chunked(mesh: Mesh, axis: str = "nodes",
@@ -66,11 +269,11 @@ def sharded_place_chunked(mesh: Mesh, axis: str = "nodes",
             max_steps=max_steps, spread_algorithm=spread_algorithm,
             placed_init=placed_init)
 
-    return jax.jit(
+    return _serialize_launches(jax.jit(
         run,
         in_shardings=(nd, nd, rep, rep, nv, nv, rep,
                       sn, rep, rep, rep, rep, nv, sn, rep, nv, rep),
-        out_shardings=(nv, nd, rep, rep))
+        out_shardings=(nv, nd, rep, rep)))
 
 
 def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16,
@@ -95,10 +298,11 @@ def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16,
                           jitter_samples=jitter_samples,
                           depth_grid=depth_grid)
 
-    return jax.jit(run,
-                   in_shardings=(nd, nd, rep, rep, nv, nv, rep, nv,
-                                 rep, nv, rep, rep),
-                   out_shardings=nv)
+    return _serialize_launches(jax.jit(
+        run,
+        in_shardings=(nd, nd, rep, rep, nv, nv, rep, nv,
+                      rep, nv, rep, rep),
+        out_shardings=nv))
 
 
 def sharded_preempt_top_k(mesh: Mesh, axis: str = "nodes"):
@@ -112,9 +316,9 @@ def sharded_preempt_top_k(mesh: Mesh, axis: str = "nodes"):
     rep = NamedSharding(mesh, P())
 
     batched = jax.vmap(preempt_top_k, in_axes=(0, 0, None, 0, None))
-    return jax.jit(batched,
-                   in_shardings=(cd, cv, rep, cf, rep),
-                   out_shardings=cv)
+    return _serialize_launches(jax.jit(
+        batched, in_shardings=(cd, cv, rep, cf, rep),
+        out_shardings=cv))
 
 
 def sharded_eval_batch_fill_greedy(mesh: Mesh, node_axis: str = "nodes",
@@ -128,6 +332,7 @@ def sharded_eval_batch_fill_greedy(mesh: Mesh, node_axis: str = "nodes",
     spec1 = NamedSharding(mesh, P(eval_axis, node_axis))
     spec_b = NamedSharding(mesh, P(eval_axis))
     spec_ask = NamedSharding(mesh, P(eval_axis, None))
-    return jax.jit(batched,
-                   in_shardings=(spec2, spec2, spec_ask, spec_b, spec1),
-                   out_shardings=spec1)
+    return _serialize_launches(jax.jit(
+        batched,
+        in_shardings=(spec2, spec2, spec_ask, spec_b, spec1),
+        out_shardings=spec1))

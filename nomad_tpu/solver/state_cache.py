@@ -111,6 +111,7 @@ class TensorCache:
         self._bucket = 0                # device twin row count (pow2)
         self._cap_dev = None
         self._used_dev = None
+        self._sharded = False           # twins partitioned over the mesh
         self._jits: dict = {}           # (kind, *shape) -> jitted helper
 
     # ------------------------------------------------------------- control
@@ -129,6 +130,7 @@ class TensorCache:
             self._ring = []
             self._bucket = 0
             self._cap_dev = self._used_dev = None
+            self._sharded = False
             self._jits.clear()
 
     def stats(self) -> dict:
@@ -140,23 +142,70 @@ class TensorCache:
 
     # ------------------------------------------------------------ internals
 
-    def _jit(self, kind: str, *key):
+    def _jit(self, kind: str, sharded: bool, *key):
         """Shape-keyed jit helpers; keys ride the pow2 buckets so the
-        artifact set stays enumerable (JIT002 cache-store idiom)."""
-        fn = self._jits.get((kind,) + key)
+        artifact set stays enumerable (JIT002 cache-store idiom).
+
+        `sharded` is passed EXPLICITLY (not read off self): the gather
+        path runs outside the cache lock on captured twin references, so
+        a concurrent reseed flipping `self._sharded` between the capture
+        and this call must not hand partitioned twins to the plain
+        unserialized jit branch — an unserialized multi-device launch is
+        the rendezvous wedge sharding.py's launch serialization exists
+        to prevent. Callers pass the flag captured WITH the twins. The
+        mesh object itself keys the cache too, so a device-set change
+        (torn pod) self-heals into fresh executables instead of
+        repeatedly throwing against a dead mesh's shardings.
+
+        When the twins live sharded on a device mesh (ISSUE 9), every
+        helper carries EXPLICIT in/out shardings — matching specs in and
+        out is what keeps the twins partitioned across the advance →
+        gather → solve chain (SNIPPETS [2]/[3] pjit contract); without
+        out_shardings a single unconstrained jit could silently replicate
+        a 100k-node matrix onto every chip."""
+        from .sharding import mesh
+        m = mesh() if sharded else None
+        key = (kind, sharded, m) + key
+        fn = self._jits.get(key)
         if fn is None:
             import jax
             import jax.numpy as jnp
+            from .sharding import _serialize_launches, node_sharding
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            node_sh = node_sharding(m) if m is not None else None
+            rep = NamedSharding(m, P()) if m is not None else None
             if kind == "gather":
-                def gather(c, u, i, m):
-                    m2 = m[:, None]
+                def gather(c, u, i, mk):
+                    m2 = mk[:, None]
                     return (jnp.where(m2, c[i], 0.0),
                             jnp.where(m2, u[i], 0.0))
-                self._jits[(kind,) + key] = jax.jit(gather)
+                if node_sh is not None:
+                    # _serialize_launches: concurrent scheduler workers
+                    # all gather; unserialized multi-device launches can
+                    # interleave their collective rendezvous and wedge
+                    # (sharding.py, launch serialization)
+                    self._jits[key] = _serialize_launches(
+                        jax.jit(
+                            gather,
+                            in_shardings=(node_sh, node_sh, rep, rep),
+                            out_shardings=(node_sh, node_sh)))
+                else:
+                    self._jits[key] = jax.jit(gather)
             else:               # scatter: set final row values (order-free)
-                self._jits[(kind,) + key] = jax.jit(
-                    lambda a, i, v: a.at[i].set(v))
-            fn = self._jits[(kind,) + key]
+                def scatter(a, i, v):
+                    return a.at[i].set(v)
+                if node_sh is not None:
+                    # the journal replay's device half: each touched
+                    # row's final value routes to its OWNING shard (the
+                    # scatter's row index decides the target device);
+                    # out spec == in spec keeps the twin partitioned
+                    self._jits[key] = _serialize_launches(
+                        jax.jit(
+                            scatter, in_shardings=(node_sh, rep, rep),
+                            out_shardings=node_sh))
+                else:
+                    self._jits[key] = jax.jit(scatter)
+            fn = self._jits[key]
         return fn
 
     def _seed_locked(self, view) -> None:
@@ -187,12 +236,44 @@ class TensorCache:
         self._bucket = node_bucket(n)
         try:
             import jax.numpy as jnp
+            from .sharding import mesh, put_node_sharded
             pad = self._bucket - n
-            self._cap_dev = jnp.asarray(np.pad(self.cap, ((0, pad), (0, 0))))
-            self._used_dev = jnp.asarray(np.pad(self.used,
-                                                ((0, pad), (0, 0))))
+            cap_p = np.pad(self.cap, ((0, pad), (0, 0)))
+            used_p = np.pad(self.used, ((0, pad), (0, 0)))
+            # twins shard ONLY when the sharded tier can actually consume
+            # this bucket (forced, or past the tier's node floor —
+            # backend._tier's own condition; the bucket is always a mesh
+            # multiple). Below the floor no tier ever reads a partitioned
+            # twin (placer._dev_mats hands sharded twins to the sharded
+            # tier alone), so sharding here would bill every commit a
+            # serialized multi-device scatter collective for dead state
+            # AND evict xla/pallas from their ISSUE-4 residency on every
+            # multi-device box under the floor. The forced-tier override
+            # quarantines the mesh the same way: NOMAD_SOLVER_BACKEND=
+            # host/xla must not have twin advances launch collectives on
+            # the interconnect the operator just fenced off.
+            forced = os.environ.get("NOMAD_SOLVER_BACKEND", "")
+            from . import backend
+            shard_twins = (forced == "sharded" or (
+                forced == "" and self._bucket >= backend.SHARD_MIN_NODES))
+            if mesh() is not None and shard_twins:
+                # PER-SHARD twins (ISSUE 9): one logical [B, R'] array
+                # partitioned row-wise over the mesh — each device holds
+                # its B/S rows; node_bucket already padded B to a mesh
+                # multiple so every shard sees the identical block shape.
+                # Host mirrors stay the bit-identity source; the sharded
+                # scatter in _jit advances each shard from the SAME delta
+                # journal replay the host arrays ride.
+                self._sharded = True
+                self._cap_dev = put_node_sharded(cap_p)
+                self._used_dev = put_node_sharded(used_p)
+            else:
+                self._sharded = False
+                self._cap_dev = jnp.asarray(cap_p)
+                self._used_dev = jnp.asarray(used_p)
         except Exception:   # noqa: BLE001 — host mirrors stay authoritative
             self._cap_dev = self._used_dev = None
+            self._sharded = False
 
     def _advance_locked(self, target_version: int, log) -> bool:
         """Replay journal entries with version <= target_version from the
@@ -248,7 +329,7 @@ class TensorCache:
             idx = np.full(k, uniq[0], np.int32)      # pad repeats row 0:
             idx[:len(uniq)] = uniq                   # same value re-set
             vals = self.used[idx]
-            fn = self._jit("scatter", self._bucket, k)
+            fn = self._jit("scatter", self._sharded, self._bucket, k)
             self._used_dev = fn(self._used_dev, idx, vals)
         except Exception:   # noqa: BLE001 — drop the twin, host wins
             self._cap_dev = self._used_dev = None
@@ -256,13 +337,23 @@ class TensorCache:
     # -------------------------------------------------------------- reading
 
     def gather(self, view, rows: np.ndarray,
-               bucket: int = 0) -> Optional[GatherResult]:
+               bucket: int = 0, tier: str = "") -> Optional[GatherResult]:
         """Serve one eval's (shuffled) node rows from the cache, advancing
         it to the view's version first. Returns None when the cache is
         disabled or the view carries no versioning stamp (plain test
         fakes) — the caller then builds from the view exactly as before.
         A stale view (older than every resident generation) is served
-        straight from the view's own arrays and counted as a miss."""
+        straight from the view's own arrays and counted as a miss.
+
+        `tier` is the backend tier the caller resolved for this eval
+        (tensorize threads it on mesh machines): the device pair is only
+        gathered when that tier consumes what the twins actually are —
+        sharded twins feed the sharded tier, unsharded twins the solo
+        tiers (placer._dev_mats). The mismatch case is real: the twins
+        shard by the CLUSTER bucket, the tier resolves by the EVAL's
+        candidate axis, so a constraint-filtered small eval on a big
+        sharded cluster would otherwise pay a serialized multi-device
+        gather collective whose result the solo tier then discards."""
         if view.uid == 0 or view.delta_log is None or not self.enabled():
             return None
         # the lock covers only version bookkeeping + the journal replay;
@@ -292,8 +383,14 @@ class TensorCache:
                     if not seeded:  # a reseed already counted its miss
                         metrics.incr("nomad.solver.state_cache.hits")
                     src_cap, src_used = self.cap, self.used
-                    if bucket and self._used_dev is not None:
-                        dev = (self._cap_dev, self._used_dev, self._bucket)
+                    if bucket and self._used_dev is not None and \
+                            (not tier or
+                             (tier == "sharded") == self._sharded):
+                        # the shardedness flag travels WITH the captured
+                        # twins: the gather below runs outside the lock,
+                        # and a concurrent reseed may flip self._sharded
+                        dev = (self._cap_dev, self._used_dev,
+                               self._bucket, self._sharded)
                 else:
                     for gen in self._ring:
                         if gen.lo <= view.version < gen.hi:
@@ -319,14 +416,14 @@ class TensorCache:
         return out
 
     def _gather_device(self, dev: tuple, rows: np.ndarray, bucket: int):
-        cap_dev, used_dev, src_bucket = dev
+        cap_dev, used_dev, src_bucket, sharded = dev
         try:
             n = len(rows)
             idx = np.zeros(bucket, np.int32)
             idx[:n] = rows
             valid = np.zeros(bucket, bool)
             valid[:n] = True
-            fn = self._jit("gather", src_bucket, bucket)
+            fn = self._jit("gather", sharded, src_bucket, bucket)
             return fn(cap_dev, used_dev, idx, valid)
         except Exception:   # noqa: BLE001 — host arrays already serve
             return None, None

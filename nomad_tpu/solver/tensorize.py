@@ -269,26 +269,29 @@ def _lower_affinities(ctx, affinities, nodes) -> np.ndarray:
 
 
 def build_group_tensors(ctx, job, tg: TaskGroup, nodes: list[Node],
-                        feasible_fn) -> GroupTensors:
+                        feasible_fn, count: int = None) -> GroupTensors:
     """Lower one task group's placement problem.
 
     Fast path: read the store's incrementally-maintained dense cap/used
     matrices (state/usage_index.py) and apply the in-plan delta sparsely —
     O(N·R') array ops + O(plan) instead of an O(allocs) object walk per
     eval (VERDICT r1 weak #1). Falls back to the object walk for states
-    without a usage view (plain test fakes).
+    without a usage view (plain test fakes). `count` (instances asked,
+    when the caller knows it) feeds the backend's small-solve tier
+    routing so the device gather is only paid for tiers that consume it.
     """
     view = getattr(ctx.state, "usage", None)
     if view is not None:
         try:
-            return _build_dense(ctx, job, tg, nodes, feasible_fn, view)
+            return _build_dense(ctx, job, tg, nodes, feasible_fn, view,
+                                count=count)
         except KeyError:
             pass        # node missing from the index: recompute from objects
     return _build_from_objects(ctx, job, tg, nodes, feasible_fn)
 
 
 def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
-                 view) -> GroupTensors:
+                 view, count: int = None) -> GroupTensors:
     from ..state.usage_index import alloc_usage_tuple
     from . import state_cache
     n = len(nodes)
@@ -298,7 +301,28 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     # a fresh view gather yields (the bit-identity contract), plus bucket-
     # padded device twins for the dispatch (ISSUE 4 tentpole). Unversioned
     # views (plain test fakes) and a disabled cache take the view path.
-    cached = state_cache.gather(view, rows, bucket=node_bucket(n))
+    # On a device mesh the device gather is requested only when the tier
+    # the backend will actually select for this (node axis, count) can
+    # consume the twins (placer._dev_mats): sharded rides partitioned
+    # twins, xla/pallas ride unsharded ones (sub-floor buckets — the
+    # state cache seeds them unsharded there, same condition). batch and
+    # host take numpy, so paying a gather — a serialized multi-device
+    # collective when the twins are partitioned — for them bought
+    # nothing: small-count evals on a big-cluster mesh (the common
+    # production shape) otherwise gathered per solve and discarded the
+    # result every time (ISSUE 9).
+    bucket = node_bucket(n)
+    dev_bucket = bucket
+    tier = ""
+    from .sharding import mesh as _mesh
+    if _mesh() is not None:
+        from . import backend
+        tier = backend._tier(bucket, count)[0]
+        if tier not in ("sharded", "xla", "pallas"):
+            dev_bucket = 0
+    # `tier` rides along so the cache can also decline the mismatch case
+    # (sharded twins + solo tier for a constraint-filtered small eval)
+    cached = state_cache.gather(view, rows, bucket=dev_bucket, tier=tier)
     if cached is not None:
         cap, used = cached.cap, cached.used
         cap_dev, used_dev = cached.cap_dev, cached.used_dev

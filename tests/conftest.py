@@ -1,5 +1,13 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so all
-sharding/collective paths are exercised without TPU hardware.
+sharding/collective paths are exercised without TPU hardware — EVERY
+tier-1 pass runs the sharded tier, the per-shard state twins, the
+cross-shard reduces and the sharded→xla demotion ladder for real
+(ISSUE 9; tests/test_sharding.py is the dedicated suite, and the
+sharded parity tests in test_solver_backend.py ride the same mesh).
+`bench.py` forces the same flag, so recorded benches exercise the tier
+too. To simulate a 1-device world inside a test, monkeypatch
+`jax.devices` and reset `solver.sharding` + `solver.buckets` (see
+test_single_device_world_demotes_to_solo_tiers).
 
 Note: the environment's sitecustomize may import jax at interpreter startup
 (before this file runs), so setting JAX_PLATFORMS here is too late — use

@@ -16,7 +16,15 @@ from .harness import Cluster, sleep_job, wait_until
 
 pytestmark = [pytest.mark.e2e, pytest.mark.chaos]
 
+# both small-solve device tiers are faulted: since ISSUE 9 the agent
+# inherits the virtual 8-device mesh (conftest exports XLA_FLAGS), so
+# concurrent small solves may coalesce onto the batch tier instead of
+# solo xla — the scenario is "the first device-tier solves die and the
+# ladder serves them from the host floor", whichever tier routing picks.
+# The host floor is deliberately NOT faulted (wildcards cap `times` per
+# concrete site, so `solver.dispatch.*` would kill the floor too).
 FAULTS = ('{"solver.dispatch.xla": {"mode": "raise", "times": 2},'
+          ' "solver.dispatch.batch": {"mode": "raise", "times": 2},'
           ' "planner.apply": {"mode": "nth_call", "n": 4, "times": 2},'
           ' "worker.invoke": {"mode": "raise", "times": 1}}')
 
@@ -68,9 +76,13 @@ def test_stream_survives_tier_death_no_orphan_dead_letters(chaos_cluster):
     # the injected chaos actually happened, and the ladder served it:
     # demotions + host serves are on the operator metrics surface
     counters = lead.get("/v1/metrics")["telemetry"]["counters"]
-    # worker.invoke(1) + solver.dispatch.xla(2) + planner.apply(>=1)
+    # worker.invoke(1) + >=2 device-tier dispatches (xla/batch split
+    # depends on coalescing; `times` caps each site at 2) +
+    # planner.apply(>=1)
     assert counters.get("nomad.faults.fired", 0) >= 4, counters
-    assert counters.get("nomad.solver.tier_demotions.xla", 0) >= 2
+    demotions = (counters.get("nomad.solver.tier_demotions.xla", 0)
+                 + counters.get("nomad.solver.tier_demotions.batch", 0))
+    assert demotions >= 2, counters
     assert counters.get("nomad.solver.tier_degraded_serves.host", 0) >= 2
     # the faulted scheduler invoke surfaced as a counted worker eval
     # failure (then nack + redelivery), not a silent swallow

@@ -282,6 +282,86 @@ def test_overload_burst_gate():
         f"eval(s) reached a raft entry — the deadline gate leaked")
 
 
+def test_pod_scale_sharded_lineage():
+    """ISSUE 9 acceptance: once a bench records the pod_scale block, the
+    100k-node/1M-task lineage must show (a) the full ask placed through
+    the real path, (b) a mesh actually spanning >1 device, (c) the
+    sharded-vs-solo differential inside its contract — bit-parity where
+    the formulation is order-free, else a rejection-rate delta
+    <= 0.5pt — and (d) on real multi-device hardware (not the virtual
+    CPU mesh) the <2s end-to-end wall-clock target."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    ps = latest.get("pod_scale")
+    if isinstance(ps, dict) and "error" in ps:
+        # a recorded pod_scale block that is an ERROR means the lineage
+        # RAN and crashed — the worst regression this gate exists for;
+        # it must not disarm as "predates the lineage"
+        pytest.fail(f"BENCH_r{latest_round:02d}: pod-scale lineage run "
+                    f"crashed: {ps['error']}")
+    if not isinstance(ps, dict) or "n_nodes" not in ps:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the pod-scale "
+                    f"lineage")
+    assert ps["n_nodes"] >= 100_000 and ps["n_tasks"] >= 1_000_000, (
+        f"BENCH_r{latest_round:02d}: pod_scale ran under-scale "
+        f"({ps['n_nodes']} nodes / {ps['n_tasks']} tasks) — the lineage "
+        f"is 100k/1M")
+    assert ps["mesh_shape"].get("nodes", 1) > 1, (
+        f"BENCH_r{latest_round:02d}: pod_scale ran on a 1-device mesh — "
+        f"the sharded tier never engaged")
+    assert ps["placed"] == ps["n_tasks"], (
+        f"BENCH_r{latest_round:02d}: pod_scale placed {ps['placed']}/"
+        f"{ps['n_tasks']}")
+    assert ps.get("sharded_dispatches", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: the pod-scale solve never rode the "
+        f"sharded tier")
+    div = ps.get("sharded_vs_solo_divergence", {})
+    assert "bit_parity" in div, (
+        f"BENCH_r{latest_round:02d}: pod_scale recorded no sharded-vs-"
+        f"solo differential: {div}")
+    assert div["bit_parity"] or div["rejection_delta_pt"] <= 0.5, (
+        f"BENCH_r{latest_round:02d}: sharded-vs-solo diverged beyond the "
+        f"bounded-divergence contract: {div}")
+    if ps["platform"] in ("tpu", "gpu"):
+        assert ps["value_s"] < ps.get("target_s", 2.0), (
+            f"BENCH_r{latest_round:02d}: pod-scale end-to-end "
+            f"{ps['value_s']}s breaches the 2s target on real hardware")
+
+
+def test_stream_tier_is_not_host_pinned():
+    """ISSUE 9 satellite (the BENCH_r05 backend_tiers_stream host=16
+    regression): for benches of the pod-scale era (multi-device mesh,
+    stream concurrency >= 4), the timed stream must show a NON-host
+    solver tier serving evals — host-only streaming means the coalescing
+    path (batch tier) silently disengaged again."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    ps = latest.get("pod_scale")
+    if isinstance(ps, dict) and "error" in ps:
+        pytest.fail(f"BENCH_r{latest_round:02d}: pod-scale lineage run "
+                    f"crashed: {ps['error']}")
+    if not isinstance(ps, dict) or "mesh_shape" not in ps:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the pod-scale "
+                    f"era")
+    if ps["mesh_shape"].get("nodes", 1) <= 1 or \
+            latest.get("stream_concurrency", 1) < 4:
+        pytest.skip("no coalescing expected: solo mesh or low "
+                    "concurrency")
+    tiers = latest.get("backend_tiers_stream", {})
+    non_host = sum(
+        v for k, v in tiers.items()
+        if k.startswith("nomad.solver.backend.") and
+        not k.endswith(".host"))
+    assert non_host > 0, (
+        f"BENCH_r{latest_round:02d}: every stream solve landed on the "
+        f"host tier ({tiers}) — the BENCH_r05 host-pinning regression "
+        f"is back")
+
+
 def test_tracing_overhead_and_chain_completeness():
     """ISSUE 7 acceptance: once a bench records the tracing block, the
     enabled-mode overhead must stay <=5% of stream throughput, >=99% of

@@ -504,9 +504,23 @@ def test_fuzz_concurrent_workers_alloc_rejection_parity():
     from nomad_tpu.server.fsm import RaftLog
     from nomad_tpu.server.plan_apply import Planner
 
+    from nomad_tpu.solver import microbatch
+
+    # PR-7 noted this test as a load flake: node ids and eval ids came
+    # from urandom, so every run sampled a DIFFERENT shuffle/jitter
+    # stream and the parity band occasionally clipped under an unlucky
+    # draw. Pinning both (node ids key store iteration order; eval ids
+    # seed the per-eval stack rng, DET001) makes each seed's rates a
+    # constant — the parity claim is now exact, not statistical. The
+    # microbatch reset drops in-flight hints a loaded suite may have
+    # leaked (coalescing changes timing, never bits, but a leaked hint
+    # makes lone solves wait out the batch window under load).
+    microbatch.reset()
+
     def rates(algorithm, seed, n_nodes=400, n_jobs=6, tasks=300):
         random.seed(seed)
-        fsm = bench._seed_fsm(n_nodes, algorithm, seed=seed + 7)
+        fsm = bench._seed_fsm(n_nodes, algorithm, seed=seed + 7,
+                              pin_ids=f"fz{seed}-")
         planner = Planner(RaftLog(fsm), fsm.state)
         jobs = []
         for j in range(n_jobs):
@@ -515,8 +529,10 @@ def test_fuzz_concurrent_workers_alloc_rejection_parity():
             jobs.append(job)
         stale = fsm.state.snapshot()    # every "worker" plans from here
         rn = tn = ra = ta = 0
-        for job in jobs:
-            shim, _ = bench._run_eval(fsm, planner, job, snap=stale)
+        for j, job in enumerate(jobs):
+            shim, _ = bench._run_eval(
+                fsm, planner, job, snap=stale,
+                eval_id=f"fuzz-{algorithm}-{seed}-{j}")
             for plan, result in shim.submissions:
                 if result is None:
                     continue

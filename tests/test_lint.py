@@ -147,6 +147,30 @@ def test_jit002_allows_memoized_idioms():
     assert rule_ids(src) == []
 
 
+def test_jit002_sees_through_wrapper_calls():
+    """The sharded tier's launch-serialization idiom: a jit nested in a
+    wrapper call is memoized iff the WRAPPER's result is returned/stored
+    — and a wrapper built per call still fires."""
+    src = """
+        import jax
+
+        def factory(serialize):
+            return serialize(jax.jit(lambda x: x + 1))
+
+        class C:
+            def cached(self, key, wrap, inner):
+                self._cache[key] = wrap(jax.jit(inner))
+                return self._cache[key]
+
+        def bad(wrap, xs):
+            fn = wrap(jax.jit(lambda x: x * 2))
+            return fn(xs)
+    """
+    out = findings(src)
+    assert [f.rule for f in out] == ["JIT002"]
+    assert "wrap(jax.jit(lambda x: x * 2))" in out[0].context
+
+
 def test_jit002_inline_suppression():
     src = """
         import jax
@@ -530,7 +554,8 @@ def test_cli_nonexistent_path_fails(tmp_path):
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
-            "EXC001", "PERF001", "LEAD001", "OBS001", "QUEUE001"} <= ids
+            "EXC001", "PERF001", "LEAD001", "OBS001", "QUEUE001",
+            "SHARD001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -831,6 +856,138 @@ def test_queue001_inline_suppression():
                 self._buffer.append(batch)
     """
     assert rule_ids(src, path="server/broker.py") == []
+
+
+# ---------------------------------------------------------------- SHARD001
+
+SHARD001_PUT_BAD = """
+    import jax
+
+    def seed(cap, used):
+        cap_dev = jax.device_put(cap)
+        used_dev = jax.device_put(used)
+        return cap_dev, used_dev
+"""
+
+
+def test_shard001_fires_on_bare_device_put_of_node_matrix():
+    out = findings(SHARD001_PUT_BAD, path="solver/placer.py")
+    assert [f.rule for f in out] == ["SHARD001", "SHARD001"]
+    assert "REPLICATES" in out[0].message
+
+
+def test_shard001_quiet_with_explicit_placement_or_in_owner_files():
+    src = """
+        import jax
+        from jax.sharding import NamedSharding
+
+        def seed(cap, sh):
+            a = jax.device_put(cap, sh)
+            b = jax.device_put(cap, device=sh)
+            c = jax.device_put(cap, sharding=sh)
+            return a, b, c
+    """
+    assert rule_ids(src, path="solver/placer.py") == []
+    # sharding.py / state_cache.py OWN placement decisions
+    assert rule_ids(SHARD001_PUT_BAD, path="solver/sharding.py") == []
+    assert rule_ids(SHARD001_PUT_BAD,
+                    path="solver/state_cache.py") == []
+    # non-matrix names are not the rule's business
+    src2 = """
+        import jax
+
+        def stage(scores):
+            return jax.device_put(scores)
+    """
+    assert rule_ids(src2, path="solver/placer.py") == []
+
+
+def test_shard001_fires_on_specless_jit_of_node_matrices():
+    src = """
+        import jax
+
+        def build():
+            def solve(cap, used, ask):
+                return (cap - used) @ ask
+            return jax.jit(solve)
+    """
+    out = findings(src, path="solver/backend.py")
+    assert [f.rule for f in out] == ["SHARD001"]
+    assert "in_shardings" in out[0].message
+
+
+def test_shard001_quiet_with_specs_and_on_decorated_exempt_paths():
+    src = """
+        import jax
+
+        def build(node_sh, rep):
+            def solve(cap, used, ask):
+                return (cap - used) @ ask
+            return jax.jit(solve,
+                           in_shardings=(node_sh, node_sh, rep),
+                           out_shardings=node_sh)
+    """
+    assert rule_ids(src, path="solver/backend.py") == []
+
+
+def test_shard001_decorator_forms_fire():
+    src = """
+        import functools
+        import jax
+
+        @jax.jit
+        def solve(cap, used):
+            return cap - used
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def solve2(cap, used, k):
+            return cap - used
+    """
+    out = findings(src, path="solver/kernels2.py")
+    assert [f.rule for f in out] == ["SHARD001", "SHARD001"]
+
+
+def test_shard001_in_shardings_arity_mismatch_fires_everywhere():
+    # arity checks hold even inside sharding.py — that is where the
+    # wrappers live and where a miscounted tuple actually happens
+    src = """
+        import jax
+
+        def wrap(nd, rep):
+            def run(cap, used, ask):
+                return cap - used + ask
+            return jax.jit(run, in_shardings=(nd, nd),
+                           out_shardings=nd)
+    """
+    out = findings(src, path="solver/sharding.py")
+    assert [f.rule for f in out] == ["SHARD001"]
+    assert "3 positional parameters" in out[0].message
+
+
+def test_shard001_out_shardings_return_tuple_mismatch():
+    src = """
+        import jax
+
+        def wrap(nd, rep):
+            def run(cap, used):
+                return cap, used, cap + used
+            return jax.jit(run, in_shardings=(nd, nd),
+                           out_shardings=(nd, nd))
+    """
+    out = findings(src, path="solver/sharding.py")
+    assert [f.rule for f in out] == ["SHARD001"]
+    assert "returns a 3-tuple" in out[0].message
+
+
+def test_shard001_inline_suppression():
+    src = """
+        import jax
+
+        def seed(cap):
+            # nomadlint: disable=SHARD001 — single-device debug path
+            return jax.device_put(cap)
+    """
+    assert rule_ids(src, path="solver/placer.py") == []
 
 
 # ------------------------------------------------------------- tier-1 gate

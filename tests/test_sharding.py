@@ -1,0 +1,392 @@
+"""ISSUE 9: the sharded tier, for real, on the tier-1 virtual 8-device
+CPU mesh (conftest.py forces `--xla_force_host_platform_device_count=8`,
+so every tier-1 pass exercises mesh construction, NamedSharding spec
+round-trips, per-shard state twins, cross-shard reduces and the
+sharded→xla demotion ladder without TPU hardware).
+
+Contracts pinned here (docs/SHARDED_SOLVE.md):
+  * one process-wide 1-D mesh; node buckets pad to a mesh multiple;
+  * resident twins and chained solve outputs STAY partitioned — no
+    silent full replication (the 100k-node OOM failure mode);
+  * per-shard twins advanced by the delta journal are bit-identical to
+    a fresh view at every version;
+  * `solver.dispatch.sharded` faults demote to xla with the same bits;
+  * a 1-device world cleanly demotes everything to the solo tiers.
+"""
+import numpy as np
+import jax
+import pytest
+
+from nomad_tpu import faults
+from nomad_tpu.metrics import metrics
+from nomad_tpu.solver import backend, buckets, microbatch, sharding
+from nomad_tpu.solver import placer as placer_mod
+from nomad_tpu.solver import state_cache
+from nomad_tpu.solver.kernels import NUM_XR
+from nomad_tpu.solver.state_cache import cache
+
+from test_state_cache import _mk_alloc, _seed_store
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    backend.reset()
+    state_cache.reset()
+    faults.clear()
+    microbatch.reset()
+    yield
+    backend.reset()
+    state_cache.reset()
+    faults.clear()
+    microbatch.reset()
+
+
+def _depth_args(n, count, seed=0):
+    rng = np.random.default_rng(seed)
+    cap = np.zeros((n, NUM_XR), np.float32)
+    cap[:, 0] = rng.choice([2000, 4000, 8000], n)
+    cap[:, 1] = rng.choice([4096, 8192, 16384], n)
+    cap[:, 2] = 100_000
+    cap[:, 3] = 12_001
+    cap[:, 4] = 1_000
+    used = np.zeros_like(cap)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 500, 256
+    feas = np.ones(n, bool)
+    feas[::7] = False
+    return (cap, used, ask, np.int32(count), feas,
+            np.zeros(n, np.int32), np.int32(count),
+            np.zeros(n, np.float32), np.int32(2 ** 30),
+            rng.random(n, dtype=np.float32), np.float32(1.0),
+            np.float32(0.0))
+
+
+# --------------------------------------------------- mesh + spec plumbing
+
+def test_mesh_is_a_process_singleton_over_all_devices():
+    assert len(jax.devices()) == 8, "conftest must force the 8-device mesh"
+    m = sharding.mesh()
+    assert m is not None
+    assert m is sharding.mesh()                 # singleton
+    assert m.shape == {"nodes": 8}
+    # the backend's full-device mesh IS the singleton — a second Mesh
+    # object would reshard every resident twin a kernel consumes
+    assert backend._mesh(jax.devices()) is m
+
+
+def test_spec_round_trip_and_introspection():
+    x = np.arange(64 * NUM_XR, dtype=np.float32).reshape(64, NUM_XR)
+    dev = sharding.put_node_sharded(x)
+    assert sharding.is_node_sharded(dev)
+    sh = dev.sharding
+    assert tuple(sh.spec) == ("nodes", None)
+    np.testing.assert_array_equal(np.asarray(dev), x)
+    # replicated / host arrays are NOT node-sharded
+    assert not sharding.is_node_sharded(x)
+    assert not sharding.is_node_sharded(jax.device_put(x))
+
+
+def test_node_bucket_pads_to_mesh_multiple(monkeypatch):
+    # 8 devices: pow2 >= 8 already divides — rounding is a no-op
+    assert buckets.node_bucket(100) == 128
+    assert buckets.node_bucket(3) == 8
+    # a torn pod (6 healthy chips) must still divide evenly —
+    # mesh_shards re-resolves from the LIVE device set per call, so the
+    # rounding tracks a mid-process device change (the same self-healing
+    # sharding.mesh() and the preempt wrapper do)
+    real = jax.devices
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **kw: real(*a, **kw)[:6])
+    assert buckets.node_bucket(100) % 6 == 0
+    assert buckets.node_bucket(100) == 132
+    monkeypatch.setattr(jax, "devices", real)
+    assert buckets.node_bucket(100) == 128
+
+
+def test_single_device_world_demotes_to_solo_tiers(monkeypatch):
+    real = jax.devices
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **kw: real(*a, **kw)[:1])
+    sharding.reset()
+    buckets._reset_shards()
+    backend.reset()
+    try:
+        assert sharding.mesh() is None
+        assert sharding.node_sharding() is None
+        assert sharding.lane_sharding(8) is None
+        name, _ = backend.select("depth", backend.SHARD_MIN_NODES)
+        assert name == "xla"            # sharded requires >1 device
+        monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "sharded")
+        backend.reset()
+        name, _ = backend.select("depth", backend.SHARD_MIN_NODES)
+        assert name == "xla"            # forced override demotes too
+    finally:
+        monkeypatch.setattr(jax, "devices", real)
+        sharding.reset()
+        buckets._reset_shards()
+        backend.reset()
+
+
+# --------------------------------------------- chained partitioned solves
+
+def test_chained_solves_stay_partitioned_with_no_rescatter(monkeypatch):
+    """Acceptance: a chained 2-eval solve keeps arrays partitioned — the
+    state cache's twins are node-sharded, its gather hands the dispatch
+    node-sharded inputs, the sharded kernel's out specs keep the result
+    partitioned, and the journal advance between evals scatters into the
+    SAME partitioned twin (no reseed, no full replication)."""
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    store, nodes, idx = _seed_store(24)
+    n = len(nodes)
+    bucket = buckets.node_bucket(n)
+    rows = np.arange(n, dtype=np.int64)
+
+    view = store.snapshot().usage
+    got = state_cache.gather(view, rows, bucket=bucket)
+    assert got is not None and got.cap_dev is not None
+    assert sharding.is_node_sharded(got.cap_dev)
+    assert sharding.is_node_sharded(got.used_dev)
+    assert sharding.is_node_sharded(cache()._used_dev)
+
+    name, fn = backend.select("depth", bucket, k_max=8)
+    assert name == "sharded"
+    args = _depth_args(bucket, 6, seed=3)
+    placed1 = fn(got.cap_dev, got.used_dev, *args[2:])
+    sh = getattr(placed1, "sharding", None)
+    assert sh is not None and tuple(sh.spec) == ("nodes",), \
+        "sharded solve output lost its node partitioning"
+
+    # eval 2: journal advances between evals — the twin must ADVANCE
+    # (sharded scatter), not reseed, and stay partitioned
+    misses0 = metrics.counter("nomad.solver.state_cache.misses")
+    store.upsert_allocs(idx, [_mk_alloc(nodes[0].id),
+                              _mk_alloc(nodes[5].id)])
+    view2 = store.snapshot().usage
+    got2 = state_cache.gather(view2, rows, bucket=bucket)
+    assert got2 is not None and got2.cap_dev is not None
+    assert sharding.is_node_sharded(got2.used_dev)
+    assert metrics.counter("nomad.solver.state_cache.misses") == misses0, \
+        "the advance reseeded instead of replaying the journal"
+    placed2 = fn(got2.cap_dev, got2.used_dev, *args[2:])
+    assert tuple(placed2.sharding.spec) == ("nodes",)
+    assert int(np.asarray(placed2).sum()) == 6
+
+
+def test_per_shard_twins_replay_journal_bit_identically(monkeypatch):
+    """Acceptance: after a stream of commits, the partitioned device twin
+    holds EXACTLY the bits of a fresh view — the delta-journal replay
+    routed every touched row to its owning shard. (Twins only seed
+    sharded when the sharded tier can consume the bucket — lower its
+    floor to this test's scale.)"""
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    store, nodes, idx = _seed_store(20)
+    n = len(nodes)
+    bucket = buckets.node_bucket(n)
+    rows = np.arange(n, dtype=np.int64)
+    rng = np.random.default_rng(9)
+    state_cache.gather(store.snapshot().usage, rows, bucket=bucket)
+    for step in range(6):
+        allocs = [_mk_alloc(nodes[int(rng.integers(0, n))].id,
+                            cpu=int(rng.choice([50, 100, 250])))
+                  for _ in range(int(rng.integers(1, 5)))]
+        store.upsert_allocs(idx, allocs)
+        idx += 1
+        view = store.snapshot().usage
+        got = state_cache.gather(view, rows, bucket=bucket)
+        assert got is not None
+        tc = cache()
+        assert sharding.is_node_sharded(tc._used_dev)
+        dev_used = np.asarray(tc._used_dev)
+        assert dev_used[:n].tobytes() == view.used.tobytes(), \
+            f"device twin diverged from the view at step {step}"
+        assert not dev_used[n:].any(), "padding rows must stay zero"
+
+
+def test_concurrent_sharded_launches_do_not_wedge(monkeypatch):
+    """Regression pin for a LIVE deadlock: concurrent threads launching
+    multi-device programs (stream workers' sharded state-cache gathers
+    racing the applier's scatter advances) interleaved their per-device
+    executions across two collective rendezvous and wedged the process.
+    sharding._serialize_launches must keep hammered gather+scatter
+    traffic from concurrent threads live (docs/SHARDED_SOLVE.md)."""
+    import threading
+    import time as _time
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    store, nodes, idx = _seed_store(24)
+    n = len(nodes)
+    bucket = buckets.node_bucket(n)
+    rows = np.arange(n, dtype=np.int64)
+    state_cache.gather(store.snapshot().usage, rows, bucket=bucket)
+    assert sharding.is_node_sharded(cache()._used_dev)
+    stop = threading.Event()
+    errs: list = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                v = store.snapshot().usage
+                state_cache.gather(v, rows, bucket=bucket)
+        except Exception as e:      # noqa: BLE001 — surface to the test
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    # writer: every commit advances the partitioned twin via the sharded
+    # scatter while the readers launch sharded gathers
+    for step in range(40):
+        store.upsert_allocs(idx, [_mk_alloc(nodes[step % n].id)])
+        idx += 1
+    _time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), \
+        "concurrent sharded launches wedged in a collective rendezvous"
+    assert not errs, errs
+
+
+# ----------------------------------------------------- demotion + faults
+
+def test_sharded_demotes_to_xla_under_injected_fault(monkeypatch):
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    args = _depth_args(512, 40, seed=5)
+    name, fn = backend.select("depth", 512, k_max=16)
+    assert name == "sharded"
+    demo0 = metrics.counter("nomad.solver.tier_demotions.sharded")
+    faults.install({"solver.dispatch.sharded": {"mode": "raise",
+                                                "times": 1}})
+    got = np.asarray(fn(*args))
+    assert metrics.counter("nomad.solver.tier_demotions.sharded") == \
+        demo0 + 1, "the injected sharded fault did not demote"
+    faults.clear()
+    want = np.asarray(fn(*args))        # clean sharded pass, same bits
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == 40
+
+
+def test_breaker_opens_sharded_tier_after_repeated_faults(monkeypatch):
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    args = _depth_args(256, 10, seed=6)
+    _, fn = backend.select("depth", 256, k_max=8)
+    faults.install({"solver.dispatch.sharded": {
+        "mode": "raise", "times": backend.BREAKER_THRESHOLD}})
+    for _ in range(backend.BREAKER_THRESHOLD):
+        fn(*args)                       # each demotes + feeds the breaker
+    assert backend.breaker().state("sharded") == "open"
+    sc0 = metrics.counter(
+        "nomad.solver.tier_breaker_short_circuit.sharded")
+    fn(*args)                           # open tier is skipped, not tried
+    assert metrics.counter(
+        "nomad.solver.tier_breaker_short_circuit.sharded") == sc0 + 1
+
+
+# ------------------------------------------------- cross-shard reduces
+
+def test_cross_shard_top_k_matches_host_argsort():
+    m = sharding.mesh()
+    rng = np.random.default_rng(11)
+    score = rng.permutation(256).astype(np.float32)
+    fn = sharding.cross_shard_top_k(m, 16)
+    v, i = fn(score)
+    order = np.argsort(-score)[:16]
+    np.testing.assert_array_equal(np.asarray(v), score[order])
+    np.testing.assert_array_equal(np.asarray(i), order)
+
+
+def test_sharded_spread_counts_psum_matches_host_bincount():
+    m = sharding.mesh()
+    rng = np.random.default_rng(12)
+    n, p = 64, 8
+    ids = rng.integers(-1, p, size=(3, n)).astype(np.int32)
+    add = rng.integers(0, 4, size=n).astype(np.int32)
+    got = np.asarray(sharding.sharded_spread_counts(m, p)(ids, add))
+    want = np.zeros((3, p), np.int32)
+    for s in range(3):
+        for j in range(n):
+            if ids[s, j] >= 0:
+                want[s, ids[s, j]] += add[j]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sharded_preemption_masks_match_solo_and_demote(monkeypatch):
+    """The placer's preemption victim scan shards its candidate axis at
+    pod scale; the masks must equal the solo jit(vmap) bit-for-bit, and
+    an injected sharded fault falls back to the solo path silently."""
+    monkeypatch.setattr(placer_mod, "PREEMPT_SHARD_MIN", 1)
+    rng = np.random.default_rng(13)
+    c, v = 24, 4
+    vr = rng.uniform(10, 300, size=(c, v, NUM_XR)).astype(np.float32)
+    vp = rng.integers(10, 60, size=(c, v)).astype(np.int32)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 400, 512
+    free = rng.uniform(0, 200, size=(c, NUM_XR)).astype(np.float32)
+    want = np.asarray(placer_mod._preempt_batched()(
+        vr, vp, ask, free, np.int32(70)))
+    got = placer_mod.SolverPlacer._preempt_masks(
+        None, vr, vp, ask, free, np.int32(70))
+    np.testing.assert_array_equal(got, want)
+    demo0 = metrics.counter("nomad.solver.tier_demotions.sharded")
+    faults.install({"solver.dispatch.sharded": {"mode": "raise",
+                                                "times": 1}})
+    got_f = placer_mod.SolverPlacer._preempt_masks(
+        None, vr, vp, ask, free, np.int32(70))
+    np.testing.assert_array_equal(got_f, want)
+    assert metrics.counter("nomad.solver.tier_demotions.sharded") == \
+        demo0 + 1
+
+
+def test_forced_solo_backend_quarantines_sharded_preemption(monkeypatch):
+    """NOMAD_SOLVER_BACKEND=host/xla quarantines the mesh for EVERY
+    multi-device launch — an operator keeping traffic off a sick
+    interconnect must not have preemption scans re-expose it."""
+    monkeypatch.setattr(placer_mod, "PREEMPT_SHARD_MIN", 1)
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "host")
+    rng = np.random.default_rng(14)
+    c, v = 16, 3
+    vr = rng.uniform(10, 300, size=(c, v, NUM_XR)).astype(np.float32)
+    vp = rng.integers(10, 60, size=(c, v)).astype(np.int32)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 400, 512
+    free = rng.uniform(0, 200, size=(c, NUM_XR)).astype(np.float32)
+    sh0 = metrics.counter("nomad.solver.dispatch.sharded")
+    got = placer_mod.SolverPlacer._preempt_masks(
+        None, vr, vp, ask, free, np.int32(70))
+    assert metrics.counter("nomad.solver.dispatch.sharded") == sh0, \
+        "forced solo backend still launched a sharded preemption scan"
+    want = np.asarray(placer_mod._preempt_batched()(
+        vr, vp, ask, free, np.int32(70)))
+    np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------- micro-batch lanes
+
+def test_microbatch_lanes_shard_over_the_mesh():
+    sh = sharding.lane_sharding(buckets.BATCH_LANES)
+    assert sh is not None
+    fn = microbatch._batcher._batched_fn(
+        ("lane-shard-test",), lambda a, b: a * 2.0 + b)
+    a = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    b = np.ones((8, 4), np.float32)
+    out = fn(a, b)
+    np.testing.assert_array_equal(np.asarray(out), a * 2.0 + b)
+    osh = out.sharding
+    assert tuple(osh.spec)[:1] == ("nodes",), \
+        "coalesced lanes are not data-parallel over the mesh"
+
+
+def test_stream_small_solves_ride_batch_tier_on_mesh():
+    """The ISSUE 9 stream-tier fix: on a multi-device mesh a small
+    concurrent depth solve resolves to the batch tier (coalesced,
+    device-bound) instead of pinning to host/xla; a solo eval still
+    takes the solo tier."""
+    microbatch.configure(enabled=True, window_s=0.0)
+    microbatch.broker_in_flight(4)
+    try:
+        name, _ = backend.select("depth", 16384, count=1000)
+        assert name == "batch"
+    finally:
+        microbatch.broker_in_flight(0)
+    name, _ = backend.select("depth", 16384, count=1000)
+    assert name == "xla", "solo eval must not pay the batch window"
