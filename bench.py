@@ -546,6 +546,190 @@ def _overload_run() -> dict:
         s.shutdown()
 
 
+STORM_NODES = int(os.environ.get("NOMAD_STORM_NODES", str(N_NODES)))
+STORM_JOBS = int(os.environ.get("NOMAD_STORM_JOBS", "12"))
+STORM_TASKS_PER_JOB = int(os.environ.get("NOMAD_STORM_TASKS", "400"))
+STORM_KILL_FRAC = 0.10
+STORM_RATE_CAP = int(os.environ.get("NOMAD_STORM_RATE_CAP", "256"))
+
+
+def _node_storm_run() -> dict:
+    """Node-storm lineage (ISSUE 10): kill 10% of the 10k-node sim AT
+    ONCE through the real heartbeat-sweep path on a live Server and
+    audit the bounded-cost contract:
+
+      * the status flip lands in ceil(K / rate-cap) BATCH raft entries
+        (rate-capped sweeps with carry-over), never K per-node entries;
+      * replacement evals dedupe to one per affected job — the flood
+        size is recorded against the per-(job, node) counterfactual;
+      * the device state cache NEVER reseeds (the taint rides the delta
+        journal; `nomad.solver.state_cache.reseeds` delta must be 0);
+      * zero node-update evals dead-letter, and detection -> every lost
+        alloc replaced on a survivor is the recovery wall time.
+
+    The sweep clock is a ManualClock so mass expiry is commanded, not
+    raced; the reaper thread sees frozen time and stays idle. Gated in
+    tests/test_bench_regression.py once a BENCH_*.json carries the
+    block."""
+    import math
+
+    from nomad_tpu.chrono import ManualClock
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.fsm import BATCH_NODE_UPDATE_STATUS
+    from nomad_tpu.structs import (
+        NODE_STATUS_DOWN, TRIGGER_NODE_UPDATE, SCHED_ALG_TPU,
+        SchedulerConfiguration,
+    )
+
+    clock = ManualClock()
+    s = Server(num_workers=STREAM_CONCURRENCY, gc_interval=9999)
+    s.heartbeats.clock = clock
+    s.heartbeats.ttl_spread = 0.0
+    s.flap_damper.clock = clock
+    s.eval_broker.initial_nack_delay = 0.05
+    s.eval_broker.subsequent_nack_delay = 0.2
+    st = s.state
+    st.set_scheduler_config(1, SchedulerConfiguration(
+        scheduler_algorithm=SCHED_ALG_TPU,
+        eval_batch_window_ms=STREAM_WINDOW_MS,
+        heartbeat_invalidate_rate_cap=STORM_RATE_CAP))
+    rng = np.random.default_rng(10)
+    node_ids = []
+    for i in range(STORM_NODES):
+        n = _mk_node(i, rng)
+        st.upsert_node(i + 2, n)
+        node_ids.append(n.id)
+        # the store path skips reset_heartbeat_timer: arm explicitly so
+        # the sweep owns every node's deadline
+        s.heartbeats.reset_heartbeat_timer(n.id)
+    batch_entries = [0]
+    raft_apply = s.raft.apply
+
+    def counting_apply(msg_type, payload, **kw):
+        if msg_type == BATCH_NODE_UPDATE_STATUS:
+            batch_entries[0] += 1
+        return raft_apply(msg_type, payload, **kw)
+
+    s.raft.apply = counting_apply
+    s.start()
+    try:
+        jobs = []
+        for j in range(STORM_JOBS):
+            job = _mk_batch_job(f"storm-{j}", STORM_TASKS_PER_JOB)
+            s.job_register(job)
+            jobs.append(job)
+
+        def placed() -> int:
+            return sum(
+                1 for job in jobs
+                for a in st.allocs_by_job("default", job.id)
+                if a.desired_status == "run" and not a.terminal_status())
+        want = STORM_JOBS * STORM_TASKS_PER_JOB
+        deadline = time.time() + 300
+        while time.time() < deadline and placed() < want:
+            time.sleep(0.02)
+        if placed() < want:
+            raise RuntimeError(f"seed placement stalled at "
+                               f"{placed()}/{want}")
+
+        # doom 10% of the fleet, weighted onto LOADED nodes so the kill
+        # actually strands work (binpack concentrates placements)
+        loaded = sorted({a.node_id for job in jobs
+                         for a in st.allocs_by_job("default", job.id)})
+        k = max(1, int(STORM_NODES * STORM_KILL_FRAC))
+        doomed = loaded[: min(len(loaded), k)]
+        if len(doomed) < k:
+            spare = [nid for nid in node_ids if nid not in set(doomed)]
+            doomed += spare[: k - len(doomed)]
+        doomed_set = set(doomed)
+        lost_allocs = sum(
+            1 for job in jobs for a in st.allocs_by_job("default", job.id)
+            if a.node_id in doomed_set and a.desired_status == "run"
+            and not a.terminal_status())
+        # per-(job, node) counterfactual flood: what the pre-batch path
+        # would have enqueued for the same kill
+        flood_counterfactual = sum(
+            len({a.job_id for a in st.allocs_by_node(nid)
+                 if not a.terminal_status()}) for nid in doomed)
+
+        reseeds0 = metrics.counter("nomad.solver.state_cache.reseeds")
+        dead0 = metrics.counter("nomad.broker.dead_letter")
+        coalesced0 = metrics.counter("nomad.broker.node_update_coalesced")
+        carryover0 = metrics.counter("nomad.heartbeat.sweep_carryover")
+        evals_before = {e.id for e in st.iter_evals()}
+
+        # mass expiry: survivors heartbeat after the advance, then the
+        # commanded sweeps drain the doomed set under the rate cap.
+        # (The leader-establish barrier re-armed every node at
+        # ttl + failover_grace, so the advance must clear that too.)
+        # The background reaper thread is stopped first: production has
+        # exactly ONE sweeper, and a second concurrent caller could
+        # collect an overlapping expired set and bill an extra batch
+        # entry against the ceil(K/cap) budget the gate audits.
+        s.heartbeats.stop()
+        clock.advance(s.heartbeats.min_ttl + s.heartbeats.failover_grace
+                      + 1.0)
+        for nid in node_ids:
+            if nid not in doomed_set:
+                s.node_heartbeat(nid)
+        t0 = time.perf_counter()
+        sweeps = 0
+        while any(st.node_by_id(nid).status != NODE_STATUS_DOWN
+                  for nid in doomed):
+            s.heartbeats._sweep(clock.time())
+            sweeps += 1
+            if sweeps > 4 * math.ceil(k / max(1, STORM_RATE_CAP)) + 4:
+                raise RuntimeError("storm sweeps not converging")
+        detection_s = time.perf_counter() - t0
+
+        def recovered() -> bool:
+            for job in jobs:
+                live = sum(
+                    1 for a in st.allocs_by_job("default", job.id)
+                    if a.desired_status == "run"
+                    and not a.terminal_status()
+                    and a.node_id not in doomed_set)
+                if live < STORM_TASKS_PER_JOB:
+                    return False
+            return True
+        deadline = time.time() + 300
+        while time.time() < deadline and not recovered():
+            time.sleep(0.02)
+        recovery_s = time.perf_counter() - t0
+        if not recovered():
+            raise RuntimeError("storm recovery stalled")
+
+        flood = [e for e in st.iter_evals()
+                 if e.id not in evals_before
+                 and e.triggered_by == TRIGGER_NODE_UPDATE]
+        return {
+            "n_nodes": STORM_NODES,
+            "nodes_killed": len(doomed),
+            "allocs_lost": lost_allocs,
+            "rate_cap": STORM_RATE_CAP,
+            "raft_invalidation_entries": batch_entries[0],
+            "sweeps": sweeps,
+            "detection_s": round(detection_s, 3),
+            "recovery_s": round(recovery_s, 3),
+            "eval_flood_size": len(flood),
+            "eval_flood_counterfactual": flood_counterfactual,
+            "node_update_coalesced": int(
+                metrics.counter("nomad.broker.node_update_coalesced")
+                - coalesced0),
+            "reseeds_delta": int(
+                metrics.counter("nomad.solver.state_cache.reseeds")
+                - reseeds0),
+            "dead_letter_delta": int(
+                metrics.counter("nomad.broker.dead_letter") - dead0),
+            "carryover": int(
+                metrics.counter("nomad.heartbeat.sweep_carryover")
+                - carryover0),
+        }
+    finally:
+        s.shutdown()
+
+
 POD_NODES = int(os.environ.get("NOMAD_POD_NODES", "100000"))
 POD_TASKS = int(os.environ.get("NOMAD_POD_TASKS", "1000000"))
 
@@ -1207,25 +1391,31 @@ def main() -> None:
         trace_export = {"valid": False, "error": repr(e)[:200]}
 
     # ---- tracing overhead: the SAME workload (identical seed, fresh
-    # cluster each run) in an untraced/traced/untraced sandwich — run-
-    # order warmth and cluster-layout variance both dwarf the per-span
-    # cost, so the traced run is compared against the MEAN of the two
-    # untraced runs bracketing it. The regression gate bounds the
-    # enabled-mode cost at <=5% of stream throughput once recorded.
+    # cluster each run) in an interleaved untraced/traced sandwich
+    # (u t u t u t u, half-length legs) — run-order warmth, cluster-
+    # layout variance, and shared-box CPU jitter all dwarf the per-span
+    # cost, so each traced leg is compared against the MEAN of its two
+    # bracketing untraced legs and the reported overhead is the MEDIAN
+    # over the traced legs: one slow leg (a noisy neighbour, a GC
+    # pause) cannot claim a 20% "overhead" a single 3-leg sandwich
+    # would report. The regression gate bounds the enabled-mode cost at
+    # <=5% of stream throughput once recorded.
+    leg_evals = max(1, STREAM_EVALS // 2)
+
     def _overhead_run(traced: bool) -> float:
         obs_trace.configure(enabled=traced)
         fsm_o = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=11)
         t0 = time.perf_counter()
-        _stream_run(fsm_o, STREAM_EVALS, STREAM_CONCURRENCY)
-        return STREAM_EVALS / (time.perf_counter() - t0)
+        _stream_run(fsm_o, leg_evals, STREAM_CONCURRENCY)
+        return leg_evals / (time.perf_counter() - t0)
 
-    rate_u1 = _overhead_run(traced=False)
-    rate_t = _overhead_run(traced=True)
-    rate_u2 = _overhead_run(traced=False)
+    legs = [_overhead_run(traced=bool(i % 2)) for i in range(7)]
     obs_trace.configure(enabled=True)
-    evals_per_sec_untraced = (rate_u1 + rate_u2) / 2.0
-    tracing_overhead_frac = round(
-        max(0.0, 1.0 - rate_t / evals_per_sec_untraced), 4)
+    overheads = sorted(
+        max(0.0, 1.0 - legs[i] / ((legs[i - 1] + legs[i + 1]) / 2.0))
+        for i in (1, 3, 5))
+    tracing_overhead_frac = round(overheads[1], 4)
+    evals_per_sec_untraced = (legs[0] + legs[2] + legs[4] + legs[6]) / 4.0
     if platform == "tpu" and STREAM_CONCURRENCY >= 4:
         # the eval stream must be served by coalesced device dispatches
         # (the batch tier), not host-only — a few solo host solves at the
@@ -1271,6 +1461,15 @@ def main() -> None:
         overload = _overload_run()
     except Exception as e:              # noqa: BLE001 — probe is optional
         overload = {"error": repr(e)[:200]}
+
+    # node-storm lineage (ISSUE 10): kill 10% of the sim at once through
+    # the real sweep path — batched invalidation entries, eval-flood
+    # size vs counterfactual, zero reseeds, recovery wall; gated by
+    # tests/test_bench_regression.py once recorded
+    try:
+        node_storm = _node_storm_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        node_storm = {"error": repr(e)[:200]}
 
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
@@ -1341,6 +1540,9 @@ def main() -> None:
         # ISSUE 8: overload/goodput lineage (10x burst, bounded broker,
         # deadline enforcement, pressure transitions, recovery)
         "overload": overload,
+        # ISSUE 10: mass node-failure lineage (batched invalidation,
+        # taint-riding state cache, deduped eval flood, recovery wall)
+        "node_storm": node_storm,
         "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
         "state_cache": state_cache_counters,
         **phases,
@@ -1679,6 +1881,10 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--overload":
         # standalone overload lineage (the 10x burst probe alone)
         print(json.dumps(_overload_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--node-storm":
+        # standalone node-storm lineage (ISSUE 10): 10% mass kill on the
+        # 10k-node sim; NOMAD_STORM_{NODES,JOBS,TASKS,RATE_CAP} resize
+        print(json.dumps(_node_storm_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":

@@ -32,7 +32,12 @@ class DecisionPathNondeterminism(Rule):
     severity = "error"
     short = ("global/unseeded RNG or wall clock on a scheduler/solver "
              "decision path")
-    path_markers = ("/scheduler/", "/solver/")
+    # server/heartbeat.py joined the scope with ISSUE 10: every deadline
+    # decision there reads the injectable chrono.Clock and the TTL jitter
+    # draws from a seeded per-instance Random, so ManualClock storm tests
+    # replay bit-identically — a wall-clock or global-RNG regression
+    # would silently de-determinize the mass-failure suite
+    path_markers = ("/scheduler/", "/solver/", "/server/heartbeat.py")
 
     def check(self, mod: SourceModule) -> list:
         out = []
@@ -103,7 +108,7 @@ class CachedTensorMutation(Rule):
              "state cache) outside state_cache")
     path_markers = ("/solver/", "/state/", "/server/", "/scheduler/")
     EXEMPT = ("state/usage_index.py", "solver/state_cache.py")
-    FIELDS = {"cap", "used", "counts", "cap_dev", "used_dev"}
+    FIELDS = {"cap", "used", "counts", "cap_dev", "used_dev", "elig"}
     _INPLACE_CALLS = {"numpy.add.at", "numpy.subtract.at",
                       "numpy.multiply.at", "numpy.divide.at"}
 

@@ -230,8 +230,19 @@ class ExecDriver(Driver):
                 return
             time.sleep(0.05)
         # escalation: the task ignored its signal — SIGKILL the TASK's
-        # process group (from the pidfile), then the executor
+        # process group (from the pidfile), then give the executor a
+        # moment to reap the child and persist the result. SIGKILLing
+        # the executor immediately (the old order) raced its waitpid:
+        # in a container whose PID 1 never reaps orphans, the killed
+        # child stayed a zombie forever and `kill(child, 0)` kept
+        # succeeding — the task looked alive after a confirmed kill.
         self._kill_task_group(rec)
+        reap_deadline = time.time() + 2.0
+        while time.time() < reap_deadline:
+            if self._read_result(rec["result"]) is not None or \
+               not self._executor_alive(rec):
+                return
+            time.sleep(0.02)
         try:
             os.kill(rec["pid"], signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

@@ -36,7 +36,9 @@ from typing import Callable, Optional
 from .. import faults
 from ..metrics import metrics, record_swallowed_error
 from ..obs import trace
-from ..structs import Evaluation, TRIGGER_FAILED_FOLLOW_UP, new_id
+from ..structs import (
+    Evaluation, TRIGGER_FAILED_FOLLOW_UP, TRIGGER_NODE_UPDATE, new_id,
+)
 
 DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_INITIAL_NACK_DELAY = 1.0
@@ -48,6 +50,18 @@ FAILED_QUEUE = "_failed"
 # (`_core`) and system jobs keep the cluster itself alive — shedding them
 # to make room for user load would trade availability for goodput
 SHED_EXEMPT_TYPES = frozenset({"_core", "system"})
+
+# triggers that are never shed victims AND bypass the depth cap:
+# failed-follow-ups are the shed/dead-letter lifecycle's own retry
+# channel (capping them re-sheds what shedding just parked), and
+# node-update evals are the replacement path for work LOST to a node
+# failure — dead-lettering those behind user churn would leave dead
+# allocs unreplaced exactly when the cluster is busiest (ISSUE 10)
+SHED_EXEMPT_TRIGGERS = frozenset({TRIGGER_FAILED_FOLLOW_UP,
+                                  TRIGGER_NODE_UPDATE})
+# node-update evals also skip the enqueue TTL: replacement of lost
+# allocs must complete eventually, not expire behind a burst
+DEADLINE_EXEMPT_TRIGGERS = frozenset({TRIGGER_NODE_UPDATE})
 
 
 class EvalBroker:
@@ -85,6 +99,13 @@ class EvalBroker:
         # and counting them would let one burst's follow-ups re-trigger
         # shedding forever (shed -> follow-up -> depth -> shed ...)
         self._waiting_follow_ups = 0
+        # ids of node-update evals superseded by an already-queued
+        # node-update eval for the same job (storm coalescing, ISSUE
+        # 10): parked for the leader loop to cancel in state — the
+        # broker runs inside the FSM's eval callback, so it can never
+        # raft-apply the cancellation itself. Ids only (the cancel path
+        # re-reads state by id), drained via take_coalesced().
+        self._coalesced: list[str] = []
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -163,6 +184,7 @@ class EvalBroker:
         self._delay_heap = []
         self._shed_entries.clear()
         self._waiting_follow_ups = 0
+        self._coalesced.clear()
         self._shutdown = True
         # every stat is maintained incrementally (+=/-=) against the
         # queues just cleared — zero them ALL or the stats endpoint
@@ -230,10 +252,13 @@ class EvalBroker:
             out.extend(
                 e for e in heap
                 if e[2] in self._evals and e not in self._shed_entries
-                # follow-ups are never victims: re-shedding the shed
-                # channel's own retries is a reap<->shed cycle
+                # exempt triggers are never victims: re-shedding the
+                # shed channel's own retries (follow-ups) is a
+                # reap<->shed cycle, and shedding lost-alloc
+                # replacement work (node-update) dead-letters exactly
+                # the evals that keep dead nodes' work alive
                 and self._evals[e[2]].triggered_by
-                != TRIGGER_FAILED_FOLLOW_UP)
+                not in SHED_EXEMPT_TRIGGERS)
         return out
 
     def _shed_locked(self, incoming: Evaluation, incoming_key) -> bool:
@@ -312,6 +337,9 @@ class EvalBroker:
             return
         if ev.id in self._evals:
             return
+        if ev.triggered_by == TRIGGER_NODE_UPDATE and ev.job_id and \
+                self._node_update_coalesce_locked(ev):
+            return
         # the eval's trace begins at broker ENQUEUE: queue/delay/pending
         # wait is attributed as `broker.wait` when it dequeues. Idempotent
         # for live traces (delayed/pending re-enqueues keep theirs); a
@@ -324,7 +352,8 @@ class EvalBroker:
         parking = bool((ev.wait_until_unix and ev.wait_until_unix > now)
                        or ev.wait_sec)
         if ttl > 0 and not ev.deadline_unix and not parking and \
-                ev.type not in SHED_EXEMPT_TYPES:
+                ev.type not in SHED_EXEMPT_TYPES and \
+                ev.triggered_by not in DEADLINE_EXEMPT_TRIGGERS:
             # enqueue TTL (ISSUE 8): stamped on a COPY — the caller's
             # object may be the raft-replicated state eval, which this
             # leader-local deadline must not mutate. The clock starts
@@ -342,10 +371,13 @@ class EvalBroker:
             ev = ev.copy()
             ev.deadline_unix = now + ttl
         if cap > 0 and self._depth_locked() >= cap and \
-                ev.triggered_by != TRIGGER_FAILED_FOLLOW_UP:
-            # follow-ups BYPASS the cap: they are the shed/dead-letter
-            # lifecycle's own retry channel — capping them re-sheds what
-            # shedding just parked, a cycle by construction
+                ev.triggered_by not in SHED_EXEMPT_TRIGGERS:
+            # exempt triggers BYPASS the cap: follow-ups are the shed/
+            # dead-letter lifecycle's own retry channel (capping them
+            # re-sheds what shedding just parked, a cycle by
+            # construction), and node-update replacement work is
+            # bounded by the coalescer (at most one per affected job)
+            # so admitting it over cap cannot run away
             try:
                 faults.fire("broker.shed")
                 incoming_was_victim = self._shed_locked(
@@ -389,6 +421,74 @@ class EvalBroker:
                        (-ev.priority, next(self._seq), ev.id))
         self.stats["total_ready"] += 1
         self._cond.notify_all()
+
+    def _node_update_coalesce_locked(self, ev: Evaluation) -> bool:
+        """Storm coalescing (ISSUE 10): a node-update eval whose job
+        already has a not-yet-dispatched node-update eval queued (ready
+        or job-pending) is redundant — the queued one will snapshot
+        state AFTER this enqueue, so its scheduler pass covers this
+        failure too. Mirrors the blocked-eval dedupe shape: keep the
+        earliest, supersede the rest. An OUTSTANDING (dequeued,
+        mid-solve) eval does NOT coalesce — its snapshot may predate
+        this failure; the normal one-per-job dedupe parks the new eval
+        in pending instead, which is exactly the coverage needed.
+        Returns True when the incoming eval was superseded; the
+        superseded eval is parked for take_coalesced() so the leader
+        loop can mark it canceled in state."""
+        job_key = (ev.namespace, ev.job_id)
+        queued = None
+        ready_id = self._ready_jobs.get(job_key)
+        if ready_id is not None:
+            cand = self._evals.get(ready_id)
+            # a DEAD-LETTERED node-update eval never runs a scheduler
+            # pass (the reaper terminates it into a backed-off
+            # follow-up), so it covers nothing — the newcomer must park
+            # via the ordinary one-per-job dedupe instead of being
+            # canceled against it
+            if cand is not None and \
+                    cand.triggered_by == TRIGGER_NODE_UPDATE and \
+                    not any(eid == ready_id for _, _, eid in
+                            self._ready.get(FAILED_QUEUE, ())):
+                queued = cand
+        if queued is None:
+            for pend in self._pending.get(job_key, ()):
+                if pend.triggered_by == TRIGGER_NODE_UPDATE:
+                    queued = pend
+                    break
+        if queued is None:
+            return False
+        self._coalesced.append(ev.id)
+        if len(self._coalesced) > 65536:
+            # a drop leaks a permanently-pending state record (the
+            # cancel never happens) — the bound exists only as a
+            # runaway-memory backstop, so it is ids-only, far above any
+            # real storm (one entry per superseded eval between two
+            # ~1s leader ticks), and every trim is COUNTED
+            metrics.incr("nomad.broker.node_update_coalesce_dropped",
+                         len(self._coalesced) - 65536)
+            del self._coalesced[:-65536]
+        metrics.incr("nomad.broker.node_update_coalesced")
+        return True
+
+    def take_coalesced(self) -> list[str]:
+        """Drain the superseded node-update eval ids (leader loop): the
+        caller cancels them in state so they terminate instead of
+        sitting pending forever."""
+        with self._lock:
+            out, self._coalesced = self._coalesced, []
+            return out
+
+    def restash_coalesced(self, eval_ids: list[str]) -> None:
+        """Return drained ids after a FAILED cancel apply — the leader
+        re-drains them next tick. Losing them on a transient raft error
+        leaks the superseded evals as permanently-pending state records
+        (eval GC only reaps terminal evals)."""
+        with self._lock:
+            self._coalesced[:0] = eval_ids
+            if len(self._coalesced) > 65536:
+                metrics.incr("nomad.broker.node_update_coalesce_dropped",
+                             len(self._coalesced) - 65536)
+                del self._coalesced[:-65536]
 
     # ------------------------------------------------------------- dequeue
 

@@ -47,6 +47,11 @@ DEPLOYMENT_ALLOC_HEALTH = "DeploymentAllocHealthRequestType"
 SCHEDULER_CONFIG = "SchedulerConfigRequestType"
 PERIODIC_LAUNCH = "PeriodicLaunchRequestType"
 BATCH_NODE_UPDATE_DRAIN = "BatchNodeUpdateDrainRequestType"
+# one heartbeat-sweep's expired nodes flipped down in ONE log entry
+# (ISSUE 10): a 10%-of-the-fleet partition costs ceil(K/rate-cap) raft
+# rounds instead of K — the batch applies under one store lock hold so
+# blocking readers see whole sweeps, never a half-marked storm
+BATCH_NODE_UPDATE_STATUS = "BatchNodeUpdateStatusRequestType"
 DEPLOYMENT_DELETE = "DeploymentDeleteRequestType"
 ACL_POLICY_UPSERT = "ACLPolicyUpsertRequestType"
 ACL_POLICY_DELETE = "ACLPolicyDeleteRequestType"
@@ -107,13 +112,26 @@ class NomadFSM:
         elif msg_type == NODE_UPDATE_DRAIN:
             s.update_node_drain(index, payload["node_id"], payload.get("drain"),
                                 payload.get("mark_eligible", False))
+        elif msg_type == BATCH_NODE_UPDATE_STATUS:
+            s.update_node_status_batch(index, payload["node_ids"],
+                                       payload["status"],
+                                       payload.get("updated_at", 0.0))
+            # the batch's deduped replacement evals ride the SAME entry
+            # (the JOB_REGISTER shape): status flip + evals commit
+            # atomically, so neither a crash nor a leadership loss
+            # between two entries can strand down nodes with no evals
+            evs = payload.get("evals") or []
+            if evs:
+                s.upsert_evals(index, evs)
+                self._notify_evals(evs)
         elif msg_type == BATCH_NODE_UPDATE_DRAIN:
             for node_id, drain in payload["updates"].items():
                 s.update_node_drain(index, node_id, drain,
                                     payload.get("mark_eligible", False))
         elif msg_type == NODE_UPDATE_ELIGIBILITY:
             s.update_node_eligibility(index, payload["node_id"],
-                                      payload["eligibility"])
+                                      payload["eligibility"],
+                                      payload.get("flap_until"))
         elif msg_type == JOB_REGISTER:
             s.upsert_job(index, payload["job"])
             evs = payload.get("evals") or []

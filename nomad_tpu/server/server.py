@@ -21,8 +21,10 @@ from ..state import StateStore
 from ..structs import (
     Allocation, DrainStrategy, Evaluation, Job, Node, SchedulerConfiguration,
     ALLOC_CLIENT_FAILED, ALLOC_CLIENT_COMPLETE, ALLOC_DESIRED_STOP,
-    EVAL_STATUS_PENDING, JOB_TYPE_BATCH, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
-    JOB_TYPE_SYSBATCH, NODE_STATUS_DOWN, NODE_STATUS_READY,
+    EVAL_STATUS_CANCELLED, EVAL_STATUS_PENDING,
+    JOB_TYPE_BATCH, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM,
+    JOB_TYPE_SYSBATCH, NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE,
+    NODE_STATUS_DOWN, NODE_STATUS_READY,
     TRIGGER_ALLOC_STOP, TRIGGER_JOB_DEREGISTER, TRIGGER_JOB_REGISTER,
     TRIGGER_NODE_DRAIN, TRIGGER_NODE_UPDATE, TRIGGER_RETRY_FAILED_ALLOC,
     CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
@@ -40,7 +42,7 @@ from .fsm import (
     NODE_UPDATE_ELIGIBILITY, NODE_UPDATE_STATUS, NomadFSM, RaftLog,
     SCHEDULER_CONFIG,
 )
-from .heartbeat import HeartbeatTimers, create_node_evals
+from .heartbeat import FlapDamper, HeartbeatTimers, create_node_evals
 from .periodic import PeriodicDispatch
 from .plan_apply import LEADERSHIP_LOST, Planner
 from .worker import Worker
@@ -201,6 +203,13 @@ class Server:
         self.eval_broker.on_overflow = self.overload.tick
         self.periodic = PeriodicDispatch(self)
         self.heartbeats = HeartbeatTimers(self)
+        # flap damper (ISSUE 10): holds down/up-cycling nodes ineligible
+        # with exponential re-admit backoff so reconnect churn cannot
+        # oscillate the solver's eligibility mask; shares the heartbeat
+        # clock so ManualClock tests drive both from one timeline
+        # no explicit clock: the damper tracks heartbeats.clock
+        # dynamically, so swapping in a ManualClock moves both
+        self.flap_damper = FlapDamper(self)
         self.core_scheduler = CoreScheduler(self)
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
@@ -698,6 +707,9 @@ class Server:
         # release the brownout levers: a demoted server must not keep a
         # stale pressure state pinned on the process-wide batcher/tracer
         self.overload.reset()
+        # a follower must never re-admit flap-held nodes; the new
+        # leader adopts the holds from replicated state at establish
+        self.flap_damper.reset()
 
     def _still_leader(self) -> bool:
         """Is the CONSENSUS layer still calling us leader (independent of
@@ -933,6 +945,11 @@ class Server:
     def _step_heartbeats(self) -> None:
         self.heartbeats.stop()      # idempotent under step retries
         self.heartbeats.initialize_heartbeat_timers()
+        # inherit flap holds a deposed leader committed (flap_held_until
+        # rides raft on the eligibility entry) so held nodes still
+        # re-admit on schedule after a failover
+        self.flap_damper.reset()
+        self.flap_damper.adopt(self.state)
         self.heartbeats.start()
 
     def _step_watchers(self) -> None:
@@ -1065,6 +1082,17 @@ class Server:
                 self._autopilot_promote_stable_servers()
             except Exception as e:      # noqa: BLE001
                 self.logger(f"autopilot promote: {e!r}")
+            try:
+                # re-admit flap-held nodes whose hold expired (ISSUE 10)
+                self._flap_readmit_tick()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"flap readmit: {e!r}")
+            try:
+                # terminate node-update evals the broker coalesced away
+                # (the broker cannot raft-apply from the FSM callback)
+                self._cancel_coalesced_evals()
+            except Exception as e:      # noqa: BLE001
+                self.logger(f"coalesced-eval cancel: {e!r}")
             if time.time() - last_gc >= self.gc_interval:
                 last_gc = time.time()
                 for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
@@ -1077,6 +1105,61 @@ class Server:
         """Dead-letter consumer (ref leader.go:782): the core scheduler
         owns the terminate + backed-off failed-follow-up lifecycle."""
         self.core_scheduler.reap_failed_evals()
+
+    def _flap_readmit_tick(self) -> None:
+        """Re-admit nodes whose flap hold expired (ISSUE 10): restore
+        eligibility (which clears `flap_held_until` in the store) and
+        wake blocked evals for the node's class. A node whose hold was
+        already lifted by an operator eligibility write (flap_held_until
+        cleared) just drops out of the damper's set."""
+        for node_id in self.flap_damper.due():
+            node = self.state.node_by_id(node_id)
+            if node is None or not getattr(node, "flap_held_until", 0.0):
+                self.flap_damper.release(node_id)
+                continue
+            index = self.raft.apply(NODE_UPDATE_ELIGIBILITY, {
+                "node_id": node_id,
+                "eligibility": NODE_SCHED_ELIGIBLE})
+            self.flap_damper.release(node_id)
+            metrics.incr("nomad.heartbeat.flap_readmitted")
+            self.blocked_evals.unblock(node.computed_class, index)
+            # the hold path suppressed the READY transition's system-job
+            # evals ("nothing may schedule onto it yet") — emit them at
+            # re-admission or the node comes back without its node-local
+            # system allocs until some unrelated eval happens by
+            evals = [e for e in create_node_evals(self.state, node_id)
+                     if e.type == JOB_TYPE_SYSTEM]
+            if evals:
+                self.raft.apply(EVAL_UPDATE, {"evals": evals})
+
+    def _cancel_coalesced_evals(self) -> None:
+        """Storm-coalesced node-update evals (ISSUE 10) were superseded
+        in the broker by an earlier queued eval for the same job; their
+        state records would sit `pending` forever without this — cancel
+        them so eval GC can reap."""
+        superseded = self.eval_broker.take_coalesced()
+        if not superseded:
+            return
+        canceled = []
+        for eval_id in superseded:
+            cur = self.state.eval_by_id(eval_id)
+            if cur is None or cur.terminal_status():
+                continue
+            cur = cur.copy()
+            cur.status = EVAL_STATUS_CANCELLED
+            cur.status_description = ("superseded by a queued node-update "
+                                      "eval (storm coalescing)")
+            canceled.append(cur)
+        if canceled:
+            try:
+                self.raft.apply(EVAL_UPDATE, {"evals": canceled})
+            except Exception:
+                # a transient apply failure must not lose the drained
+                # ids — re-stash so the next tick retries the cancel
+                self.eval_broker.restash_coalesced(superseded)
+                raise
+            metrics.incr("nomad.broker.node_update_canceled",
+                         len(canceled))
 
     def eval_drain_failed(self) -> dict:
         """Operator drain of the broker dead-letter queue (agent HTTP
@@ -1720,10 +1803,24 @@ class Server:
             node.compute_class()
         if not node.status:
             node.status = NODE_STATUS_READY
+        prior = self.state.node_by_id(node.id)
         index = self.raft.apply(NODE_REGISTER, {"node": node})
         ttl = self.heartbeats.reset_heartbeat_timer(node.id)
         if node.status == NODE_STATUS_READY:
-            self.blocked_evals.unblock(node.computed_class, index)
+            hold = None
+            if prior is not None and prior.status != NODE_STATUS_READY:
+                # a down node coming back via re-register is the same
+                # down->up edge the status endpoint sees (ISSUE 10)
+                hold = self.flap_damper.record_up(node.id)
+            if hold is not None:
+                self.raft.apply(NODE_UPDATE_ELIGIBILITY, {
+                    "node_id": node.id,
+                    "eligibility": NODE_SCHED_INELIGIBLE,
+                    "flap_until": hold})
+            else:
+                stored = self.state.node_by_id(node.id)
+                if not getattr(stored, "flap_held_until", 0.0):
+                    self.blocked_evals.unblock(node.computed_class, index)
         return {"heartbeat_ttl": ttl, "index": index}
 
     def node_update_status(self, node_id: str, status: str) -> dict:
@@ -1733,16 +1830,39 @@ class Server:
             raise KeyError(f"node {node_id} not found")
         evals: list[Evaluation] = []
         if node.status != status:
+            was_up = node.status == NODE_STATUS_READY
             index = self.raft.apply(NODE_UPDATE_STATUS, {
                 "node_id": node_id, "status": status,
                 "updated_at": time.time()})
             if status == NODE_STATUS_DOWN:
+                if was_up:
+                    self.flap_damper.record_down(node_id)
                 evals = create_node_evals(self.state, node_id)
             elif status == NODE_STATUS_READY:
-                node = self.state.node_by_id(node_id)
-                self.blocked_evals.unblock(node.computed_class, index)
-                evals = [e for e in create_node_evals(self.state, node_id)
-                         if e.type == JOB_TYPE_SYSTEM]
+                hold = self.flap_damper.record_up(node_id)
+                if hold is not None:
+                    # flap damping (ISSUE 10): the node cycled down/up
+                    # past the threshold — hold it ineligible (the
+                    # deadline rides raft) instead of letting reconnect
+                    # churn oscillate the eligibility mask. No unblock,
+                    # no system evals: nothing may schedule onto it yet.
+                    self.raft.apply(NODE_UPDATE_ELIGIBILITY, {
+                        "node_id": node_id,
+                        "eligibility": NODE_SCHED_INELIGIBLE,
+                        "flap_until": hold})
+                else:
+                    node = self.state.node_by_id(node_id)
+                    # a node still inside an active flap hold cycling
+                    # down/up below the (reset) threshold must not
+                    # unblock evals or get system evals — it is
+                    # ineligible until the readmit tick lifts the hold
+                    # (same guard node_register applies)
+                    if not getattr(node, "flap_held_until", 0.0):
+                        self.blocked_evals.unblock(node.computed_class,
+                                                   index)
+                        evals = [e for e in
+                                 create_node_evals(self.state, node_id)
+                                 if e.type == JOB_TYPE_SYSTEM]
             if evals:
                 self.raft.apply(EVAL_UPDATE, {"evals": evals})
         ttl = self.heartbeats.reset_heartbeat_timer(node_id)
@@ -1778,6 +1898,9 @@ class Server:
     def node_update_eligibility(self, node_id: str, eligibility: str) -> dict:
         index = self.raft.apply(NODE_UPDATE_ELIGIBILITY, {
             "node_id": node_id, "eligibility": eligibility})
+        # an operator eligibility write supersedes any flap hold (the
+        # store cleared flap_held_until with this entry)
+        self.flap_damper.release(node_id)
         if eligibility == "eligible":
             node = self.state.node_by_id(node_id)
             if node:

@@ -107,6 +107,11 @@ class TensorCache:
         self.cap: Optional[np.ndarray] = None
         self.used: Optional[np.ndarray] = None
         self.counts: Optional[np.ndarray] = None
+        # eligibility-mask column mirror (ISSUE 10): advanced by taint
+        # SET entries in the same journal replay as `used`, so a mass
+        # node failure flips schedulability WITHOUT an epoch reseed —
+        # cap/used and the device twins stay resident through a storm
+        self.elig: Optional[np.ndarray] = None
         self._ring: list[_Generation] = []
         self._bucket = 0                # device twin row count (pow2)
         self._cap_dev = None
@@ -126,7 +131,7 @@ class TensorCache:
             self._epoch = -1
             self.version = 0
             self._seq = 0
-            self.cap = self.used = self.counts = None
+            self.cap = self.used = self.counts = self.elig = None
             self._ring = []
             self._bucket = 0
             self._cap_dev = self._used_dev = None
@@ -138,7 +143,9 @@ class TensorCache:
             return {"uid": self._uid, "epoch": self._epoch,
                     "version": self.version, "seq": self._seq,
                     "rows": 0 if self.cap is None else int(self.cap.shape[0]),
-                    "generations": len(self._ring)}
+                    "generations": len(self._ring),
+                    "tainted_rows": (0 if self.elig is None
+                                     else int((self.elig < 0.5).sum()))}
 
     # ------------------------------------------------------------ internals
 
@@ -219,6 +226,9 @@ class TensorCache:
         self.used = view.used.copy()
         self.counts = (view.counts.copy() if view.counts is not None
                        else np.zeros(view.cap.shape[0], np.int32))
+        ve = getattr(view, "elig", None)
+        self.elig = (ve.copy() if ve is not None
+                     else np.ones(view.cap.shape[0], np.float32))
         self._ring = []
         # journal cursor: first entry past the view's version (entries are
         # version-ordered; post-view entries are few — scan backward)
@@ -292,28 +302,45 @@ class TensorCache:
         if k == start:
             return True                          # nothing to do
         batch = entries[start:k]
-        rows = np.fromiter((e[1] for e in batch), np.int64, count=len(batch))
-        if int(rows.max()) >= self.used.shape[0]:
+        all_rows = np.fromiter((e[1] for e in batch), np.int64,
+                               count=len(batch))
+        if int(all_rows.max()) >= self.used.shape[0]:
             # a row past our arrays means the node set grew under us — an
             # unlocked note_commit can race a node register + its first
             # alloc between the epoch check and the version read. Nothing
             # is applied; the caller reseeds (gather) or skips (feed).
             return False
-        deltas = np.array([e[2] for e in batch], np.float32)
-        cdeltas = np.fromiter((e[3] for e in batch), np.int32,
-                              count=len(batch))
-        first_v = batch[0][0]
-        # displace the current used generation into the ring (cap is
-        # shared: alloc deltas never touch capacity; epoch rebuilds do)
-        self._ring.append(_Generation(self.version, first_v, self.used))
-        del self._ring[:-RING]
-        self.used = self.used.copy()
-        np.add.at(self.used, rows, deltas)
-        np.add.at(self.counts, rows, cdeltas)
-        self._scatter_device_locked(rows)
+        # taint SET entries (None delta, ISSUE 10) advance the
+        # eligibility-mask column; usage deltas advance used/counts.
+        # Splitting here is what lets a mass node failure ride the
+        # ordinary replay instead of an epoch reseed.
+        taints = [e for e in batch if e[2] is None]
+        usage = [e for e in batch if e[2] is not None] if taints else batch
+        if usage:
+            rows = np.fromiter((e[1] for e in usage), np.int64,
+                               count=len(usage))
+            deltas = np.array([e[2] for e in usage], np.float32)
+            cdeltas = np.fromiter((e[3] for e in usage), np.int32,
+                                  count=len(usage))
+            first_v = usage[0][0]
+            # displace the current used generation into the ring (cap is
+            # shared: alloc deltas never touch capacity; epoch rebuilds do)
+            self._ring.append(_Generation(self.version, first_v, self.used))
+            del self._ring[:-RING]
+            self.used = self.used.copy()
+            np.add.at(self.used, rows, deltas)
+            np.add.at(self.counts, rows, cdeltas)
+            self._scatter_device_locked(rows)
+            metrics.incr("nomad.solver.state_cache.delta_rows", len(usage))
+        if taints:
+            if self.elig is None:
+                self.elig = np.ones(self.used.shape[0], np.float32)
+            for e in taints:            # in-order SETs: last write wins
+                self.elig[e[1]] = e[4]
+            metrics.incr("nomad.solver.state_cache.taint_rows",
+                         len(taints))
         self._seq = floor + k
         self.version = batch[-1][0]
-        metrics.incr("nomad.solver.state_cache.delta_rows", len(batch))
         return True
 
     def _scatter_device_locked(self, rows: np.ndarray) -> None:

@@ -382,6 +382,16 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     feasible = np.fromiter((feasible_fn(node) for node in nodes), bool,
                            count=n)
 
+    # taint mask (ISSUE 10): AND the journaled eligibility column into
+    # feasibility. Candidates are normally pre-filtered by node.ready()
+    # so this is a no-op — but it makes the solver's verdict independent
+    # of host-side filtering (bit-parity with the ready() oracle is
+    # pinned in tests/test_node_storm.py), and it is the seam flap
+    # damping and future unfiltered-candidate paths mask through.
+    elig = getattr(view, "elig", None)
+    if elig is not None:
+        feasible &= elig[rows] > 0.5
+
     distinct_hosts = any(c.operand == OP_DISTINCT_HOSTS
                          for c in list(job.constraints) + list(tg.constraints))
     if distinct_hosts:

@@ -230,6 +230,14 @@ class StateStore:
                 if node.drain_strategy is None and existing.drain_strategy:
                     node.drain_strategy = existing.drain_strategy
                     node.scheduling_eligibility = existing.scheduling_eligibility
+                if existing.flap_held_until:
+                    # a flap hold survives re-registration (ISSUE 10):
+                    # only the damper's re-admit or an operator
+                    # eligibility write lifts it — a flapping agent
+                    # re-registering must not wash its own hold away
+                    node.flap_held_until = existing.flap_held_until
+                    node.scheduling_eligibility = \
+                        existing.scheduling_eligibility
             else:
                 node.create_index = index
             node.modify_index = self._bump("nodes", index)
@@ -259,8 +267,36 @@ class StateStore:
             node.status_updated_at = updated_at
             node.modify_index = self._bump("nodes", index)
             self.nodes[node_id] = node
+            self.usage.set_node_taint(node_id, node.ready())
             self._emit("Node", "NodeStatusUpdate", node.modify_index, node)
             self._commit()
+
+    def update_node_status_batch(self, index: int, node_ids: list[str],
+                                 status: str,
+                                 updated_at: float = 0.0) -> int:
+        """Batched status flip (ISSUE 10): one FSM entry marks a whole
+        heartbeat-sweep's expired nodes, under ONE lock hold and one
+        commit — the serial per-node sequence's exact final state (the
+        storm differential in tests/test_node_storm.py pins byte
+        equality). Nodes GC'd between expiry and commit are skipped.
+        Returns the number of nodes actually updated."""
+        n = 0
+        with self._lock:
+            idx = self._bump("nodes", index)
+            for node_id in node_ids:
+                node = self.nodes.get(node_id)
+                if node is None:
+                    continue
+                node = node.copy()
+                node.status = status
+                node.status_updated_at = updated_at
+                node.modify_index = idx
+                self.nodes[node_id] = node
+                self.usage.set_node_taint(node_id, node.ready())
+                self._emit("Node", "NodeStatusUpdate", idx, node)
+                n += 1
+            self._commit()
+        return n
 
     def update_node_drain(self, index: int, node_id: str, drain,
                           mark_eligible: bool = False) -> None:
@@ -276,19 +312,26 @@ class StateStore:
                 node.scheduling_eligibility = "eligible"
             node.modify_index = self._bump("nodes", index)
             self.nodes[node_id] = node
+            self.usage.set_node_taint(node_id, node.ready())
             self._emit("Node", "NodeDrain", node.modify_index, node)
             self._commit()
 
     def update_node_eligibility(self, index: int, node_id: str,
-                                eligibility: str) -> None:
+                                eligibility: str,
+                                flap_until: Optional[float] = None) -> None:
+        """`flap_until` is set by the flap damper (ISSUE 10): the hold
+        deadline rides raft so a NEW leader can re-admit nodes a deposed
+        damper held. Operator/plain eligibility writes clear it."""
         with self._lock:
             node = self.nodes.get(node_id)
             if node is None:
                 raise KeyError(f"node {node_id} not found")
             node = node.copy()
             node.scheduling_eligibility = eligibility
+            node.flap_held_until = float(flap_until or 0.0)
             node.modify_index = self._bump("nodes", index)
             self.nodes[node_id] = node
+            self.usage.set_node_taint(node_id, node.ready())
             self._commit()
 
     def node_by_id(self, node_id: str) -> Optional[Node]:

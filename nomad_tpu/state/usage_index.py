@@ -23,6 +23,17 @@ bump `epoch` instead (no delta form — consumers rebuild). The solver's
 device-resident tensor cache (nomad_tpu/solver/state_cache.py) is the one
 consumer; `UsageView` carries (uid, epoch, version, delta_log) so a
 snapshot is enough to key the cache.
+
+Taint mask (ISSUE 10, docs/NODE_FAILURE.md): node status/eligibility/
+drain changes ride the SAME journal as an eligibility-mask column
+(`elig`, f32[N], 1.0 = schedulable) instead of bumping `epoch` — a
+5-tuple journal entry `(version, row, None, 0, elig)` is a taint SET,
+distinguishable from a usage delta by its None delta. A mass node
+failure (10% of the fleet at once) therefore advances consumers through
+ordinary replay: cap/used tensors and per-shard device twins stay
+resident, `nomad.solver.state_cache.reseeds` stays flat. `epoch` is
+reserved for true node-set mutation: add, remove (drop_node), or a
+capacity-row change.
 """
 from __future__ import annotations
 
@@ -36,7 +47,9 @@ _UID = itertools.count(1)
 
 class DeltaLog:
     """Append-only journal of usage deltas, one entry per `_pending`
-    append: (version, row, usage_delta_tuple, count_delta). Writers hold
+    append: (version, row, usage_delta_tuple, count_delta) — plus taint
+    entries (version, row, None, 0, elig) that SET the eligibility-mask
+    column (ISSUE 10; consumers key on the None delta). Writers hold
     the owning store's lock. `tail` is an immutable (floor_seq, entries)
     pair swapped atomically on trim, so lock-free readers grab one
     consistent generation: entries[k] is absolute sequence floor_seq + k,
@@ -164,6 +177,11 @@ class UsageIndex:
         # live (non-terminal) alloc count per row — the per-node density
         # vector the tensor cache advances alongside used
         self.counts = np.zeros(0, np.int32)
+        # eligibility mask column (ISSUE 10): 1.0 = node schedulable
+        # (ready + eligible + not draining). Status flips journal a
+        # taint SET entry — no epoch bump — so tensor-cache consumers
+        # survive a mass node failure without reseeding.
+        self.elig = np.ones(0, np.float32)
         self._n = 0                              # live rows
         # alloc_id -> (row, usage tuple, sequential?) for exact removal
         self._contrib: dict[str, tuple[int, tuple, bool]] = {}
@@ -203,15 +221,19 @@ class UsageIndex:
         cap = np.zeros((grow, NUM_XR), np.float32)
         used = np.zeros((grow, NUM_XR), np.float32)
         counts = np.zeros(grow, np.int32)
+        elig = np.ones(grow, np.float32)
         cap[:self._n] = self.cap[:self._n]
         used[:self._n] = self.used[:self._n]
         counts[:self._n] = self.counts[:self._n]
-        self.cap, self.used, self.counts = cap, used, counts
+        elig[:self._n] = self.elig[:self._n]
+        self.cap, self.used, self.counts, self.elig = cap, used, counts, elig
 
     def set_node(self, node) -> None:
         self.version += 1
         r = self.row.get(node.id)
         cap_row = np.asarray(node_capacity_tuple(node), np.float32)
+        ready = getattr(node, "ready", None)
+        elig = 1.0 if (ready is None or ready()) else 0.0
         if r is None:
             r = self._n
             self._ensure_capacity(r + 1)
@@ -219,9 +241,31 @@ class UsageIndex:
             self.node_ids.append(node.id)
             self._n += 1
             self.epoch += 1             # node-set fingerprint changed
+            self.elig[r] = elig         # epoch miss: consumers reseed
         elif not np.array_equal(self.cap[r], cap_row):
             self.epoch += 1             # capacity row changed in place
+            self.elig[r] = elig
+        elif self.elig[r] != elig:
+            # re-register flipping schedulability (a down node coming
+            # back): journal the taint SET so consumers advance in place
+            self.elig[r] = elig
+            self.delta_log.append((self.version, r, None, 0, elig))
         self.cap[r] = cap_row
+
+    def set_node_taint(self, node_id: str, eligible: bool) -> None:
+        """Journal a schedulability flip for an existing node (status/
+        eligibility/drain change) WITHOUT touching `epoch` — the taint
+        rides the delta log, so resident tensor-cache twins advance
+        through a mass failure instead of reseeding (ISSUE 10)."""
+        r = self.row.get(node_id)
+        if r is None:
+            return
+        val = 1.0 if eligible else 0.0
+        if self.elig[r] == val:
+            return                      # no-op flips don't pollute the log
+        self.version += 1
+        self.elig[r] = val
+        self.delta_log.append((self.version, r, None, 0, val))
 
     def drop_node(self, node_id: str) -> None:
         """Zero the row but keep the slot: rows are append-only so snapshot
@@ -234,6 +278,7 @@ class UsageIndex:
             self.cap[r] = 0.0
             self.used[r] = 0.0
             self.counts[r] = 0
+            self.elig[r] = 0.0          # epoch bumped: no journal entry
             # orphan the row's alloc contributions so later transitions
             # don't subtract from a zeroed row
             self._contrib = {aid: c for aid, c in self._contrib.items()
@@ -325,7 +370,8 @@ class UsageIndex:
                       self.used[:self._n].copy(), dict(self.seq_rows),
                       counts=self.counts[:self._n].copy(),
                       uid=self.uid, epoch=self.epoch, version=self.version,
-                      delta_log=self.delta_log)
+                      delta_log=self.delta_log,
+                      elig=self.elig[:self._n].copy())
         self._view_cache = ((self.version, self.epoch), v)
         return v
 
@@ -343,6 +389,7 @@ class UsageIndex:
         out.cap = self.cap.copy()
         out.used = self.used.copy()
         out.counts = self.counts.copy()
+        out.elig = self.elig.copy()
         out._n = self._n
         out._contrib = dict(self._contrib)
         out.seq_rows = dict(self.seq_rows)
@@ -370,12 +417,13 @@ class UsageView:
     means "no versioning — cache stays out of the way")."""
 
     __slots__ = ("row", "cap", "used", "seq_rows", "counts",
-                 "uid", "epoch", "version", "delta_log")
+                 "uid", "epoch", "version", "delta_log", "elig")
 
     def __init__(self, row: dict[str, int], cap: np.ndarray,
                  used: np.ndarray, seq_rows: Optional[dict[int, int]] = None,
                  counts: Optional[np.ndarray] = None, uid: int = 0,
-                 epoch: int = 0, version: int = 0, delta_log=None):
+                 epoch: int = 0, version: int = 0, delta_log=None,
+                 elig: Optional[np.ndarray] = None):
         self.row = row
         self.cap = cap
         self.used = used
@@ -385,3 +433,6 @@ class UsageView:
         self.epoch = epoch
         self.version = version
         self.delta_log = delta_log
+        # eligibility mask column (ISSUE 10); None on plain test fakes —
+        # consumers treat a missing column as all-schedulable
+        self.elig = elig

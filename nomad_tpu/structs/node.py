@@ -71,6 +71,12 @@ class Node:
 
     computed_class: str = ""
     status_updated_at: float = 0.0
+    # flap damping (ISSUE 10, docs/NODE_FAILURE.md): while nonzero, the
+    # node was held ineligible by the leader's flap damper until this
+    # wall-clock deadline. Rides raft (NODE_UPDATE_ELIGIBILITY payload)
+    # so a NEW leader re-admits nodes a deposed damper held; operator
+    # eligibility writes clear it.
+    flap_held_until: float = 0.0
     create_index: int = 0
     modify_index: int = 0
 

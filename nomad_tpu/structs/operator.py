@@ -97,6 +97,21 @@ class SchedulerConfiguration:
                               pressure state leaves `ok` (brownout:
                               wider micro-batch window, trace sampling
                               downshift, shorter blocking queries).
+      heartbeat_invalidate_rate_cap
+                              max expired nodes one heartbeat sweep may
+                              flip down (one BATCH_NODE_UPDATE_STATUS
+                              raft entry per sweep); overflow carries
+                              over to the next sweep, so a mass
+                              partition drains paced instead of as one
+                              raft megaflood. 0 = uncapped
+                              (docs/NODE_FAILURE.md).
+      flap_damping_threshold  down->up cycles inside the window before a
+                              node is held ineligible (flap damping);
+                              0 disables damping entirely.
+      flap_damping_window_s   sliding window the cycle count lives in.
+      flap_damping_backoff_s  first hold duration; doubles per
+                              subsequent flap episode.
+      flap_damping_backoff_max_s   hold ceiling for chronic flappers.
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -121,6 +136,11 @@ class SchedulerConfiguration:
     broker_depth_cap: int = 8192
     eval_deadline_s: float = 0.0
     pressure_saturated_frac: float = 0.5
+    heartbeat_invalidate_rate_cap: int = 4096
+    flap_damping_threshold: int = 3
+    flap_damping_window_s: float = 300.0
+    flap_damping_backoff_s: float = 30.0
+    flap_damping_backoff_max_s: float = 900.0
     create_index: int = 0
     modify_index: int = 0
 
@@ -160,4 +180,15 @@ class SchedulerConfiguration:
             return "eval_deadline_s must be >= 0 (0 = no deadline)"
         if not 0.0 < self.pressure_saturated_frac <= 1.0:
             return "pressure_saturated_frac must be in (0, 1]"
+        if self.heartbeat_invalidate_rate_cap < 0:
+            return "heartbeat_invalidate_rate_cap must be >= 0 (0 = uncapped)"
+        if self.flap_damping_threshold < 0:
+            return "flap_damping_threshold must be >= 0 (0 disables)"
+        if self.flap_damping_window_s <= 0:
+            return "flap_damping_window_s must be > 0"
+        if self.flap_damping_backoff_s <= 0:
+            return "flap_damping_backoff_s must be > 0"
+        if self.flap_damping_backoff_max_s < self.flap_damping_backoff_s:
+            return ("flap_damping_backoff_max_s must be >= "
+                    "flap_damping_backoff_s")
         return ""

@@ -291,6 +291,12 @@ def test_det001_fires_only_on_scheduler_solver_paths():
         ["DET001", "DET001", "DET001"]
     # same source outside the decision-path dirs: out of scope
     assert rule_ids(DET001_BAD, "pkg/client/ok.py") == []
+    # ISSUE 10: server/heartbeat.py joined the scope — every deadline
+    # decision there must read the injectable clock / seeded RNG or the
+    # ManualClock storm tests silently de-determinize
+    assert rule_ids(DET001_BAD, "pkg/server/heartbeat.py") == \
+        ["DET001", "DET001", "DET001"]
+    assert rule_ids(DET001_BAD, "pkg/server/other.py") == []
 
 
 def test_det001_seeded_rng_is_quiet():

@@ -1,0 +1,1139 @@
+"""Mass node-failure resilience (ISSUE 10 tentpole, docs/NODE_FAILURE.md).
+
+The contracts under test, across all four layers:
+
+  * **Batched invalidation** — killing K nodes one-at-a-time vs in one
+    `BATCH_NODE_UPDATE_STATUS` sweep yields BIT-identical final
+    placements, and a rate-capped sweep drains a mass expiry in
+    ceil(K / cap) raft entries with carry-over, never a per-node flood.
+  * **Taint-masked device state** — node status/eligibility flips ride
+    the delta journal as eligibility-mask SETs (no epoch bump): the
+    tensor cache and its per-shard device twins stay RESIDENT through a
+    storm (`nomad.solver.state_cache.reseeds` unchanged, twins still
+    node-sharded on the virtual 8-device mesh), and the journaled mask
+    keeps bit-parity with the `node.ready()` host oracle through
+    arbitrary churn.
+  * **Storm containment** — replacement evals dedupe to one per
+    (namespace, job) per batch, redundant node-update evals coalesce in
+    the broker (and the leader cancels the superseded records), lost-
+    alloc replacement work is shed/cap/deadline-exempt, and a
+    down/up-cycling node is flap-damped with exponential re-admit.
+  * **Determinism** — every storm here is driven through ManualClock +
+    seeded RNG (DET001 now scopes `server/heartbeat.py`); the chaos
+    shapes (`heartbeat.sweep` faults, a 3-server virtual-transport
+    cluster) replay bit-identically.
+"""
+import math
+import random
+import time
+import types
+
+import numpy as np
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.chrono import ManualClock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.server import Server
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.fsm import (
+    BATCH_NODE_UPDATE_STATUS, EVAL_UPDATE, NODE_UPDATE_ELIGIBILITY,
+    NODE_UPDATE_STATUS, NomadFSM, RaftLog,
+)
+from nomad_tpu.server.heartbeat import (
+    INVALIDATE_RETRY_BACKOFF_S, FlapDamper, create_node_evals,
+    create_node_evals_batch,
+)
+from nomad_tpu.server.plan_apply import Planner
+from nomad_tpu.solver import backend, buckets, sharding, state_cache
+from nomad_tpu.solver.state_cache import cache
+from nomad_tpu.structs import (
+    Evaluation, SchedulerConfiguration, SCHED_ALG_TPU,
+    ALLOC_CLIENT_RUNNING, JOB_TYPE_SYSTEM,
+    NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE,
+    NODE_STATUS_DOWN, NODE_STATUS_READY, TRIGGER_NODE_UPDATE,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    state_cache.reset()
+    faults.clear()
+    yield
+    state_cache.reset()
+    faults.clear()
+
+
+def wait_until(cond, timeout=10.0, step=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ------------------------------------------------------------------ helpers
+
+def _mk_job(j: int, count: int, cpu: int = 250, mem: int = 128,
+            priority: int = 50):
+    job = mock.batch_job()
+    job.id = job.name = f"storm-job-{j}"
+    job.priority = priority
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.networks = []
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    return job
+
+
+class _Shim:
+    """Worker-planner glue over the real serial applier (inline apply:
+    single-threaded, deterministic)."""
+
+    def __init__(self, planner, state):
+        self.planner = planner
+        self.state = state
+
+    def submit_plan(self, plan):
+        return self.planner.apply_plan(plan)
+
+    def update_eval(self, ev):
+        self.state.upsert_evals(self.state.latest_index() + 1, [ev])
+
+    def create_eval(self, ev):
+        self.state.upsert_evals(self.state.latest_index() + 1, [ev])
+
+    def refresh_snapshot(self, old):
+        return self.state.snapshot()
+
+
+def _seed_cluster(n_nodes: int = 24, n_jobs: int = 4, count: int = 6):
+    """A deterministic loaded cluster: pinned node ids, `n_jobs` batch
+    jobs placed through the REAL scheduler/planner path with pinned
+    eval ids (fixed shuffles/jitter)."""
+    random.seed(31)
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        n.name = n.id
+        s.upsert_node(idx, n)
+        nodes.append(n)
+        idx += 1
+    planner = Planner(RaftLog(fsm), s)
+    shim = _Shim(planner, s)
+    for j in range(n_jobs):
+        job = _mk_job(j, count)
+        s.upsert_job(s.latest_index() + 1, job)
+        ev = Evaluation(id=f"seed-ev-{j}", namespace="default",
+                        job_id=job.id, type="batch", priority=50)
+        s.upsert_evals(s.latest_index() + 1, [ev])
+        new_scheduler("batch", s.snapshot(), shim).process(ev)
+    return fsm, nodes
+
+
+def _fingerprint(s):
+    """(live placements, full alloc dispositions, usage bytes, mask
+    bytes) — the storm differential witness, id-independent because
+    replacement alloc ids are fresh uuids in each leg."""
+    live = tuple(sorted(
+        (a.job_id, a.name, a.node_id) for a in s.iter_allocs()
+        if a.desired_status == "run" and not a.terminal_status()))
+    disp = tuple(sorted(
+        (a.job_id, a.name, a.node_id, a.desired_status, a.client_status)
+        for a in s.iter_allocs()))
+    view = s.usage.view()
+    elig = view.elig.tobytes() if view.elig is not None else b""
+    return live, disp, view.cap.tobytes(), view.used.tobytes(), elig
+
+
+def _storm_leg(fsm, doomed_ids: list[str], batched: bool) -> int:
+    """Down `doomed_ids` (one entry vs one-per-node), enqueue the
+    replacement evals with ids pinned by (job, occurrence) — so the two
+    legs' schedulers draw identical per-eval rng streams for the FIRST
+    (effective) eval of each job — and process every eval through the
+    real planner. Returns the number of invalidation raft entries."""
+    random.seed(99)
+    s = fsm.state
+    raft = RaftLog(fsm)
+    planner = Planner(raft, s)
+    shim = _Shim(planner, s)
+    if batched:
+        raft.apply(BATCH_NODE_UPDATE_STATUS, {
+            "node_ids": list(doomed_ids), "status": NODE_STATUS_DOWN,
+            "updated_at": 1000.0})
+        entries = 1
+        evals = create_node_evals_batch(s, list(doomed_ids))
+    else:
+        entries = 0
+        for nid in doomed_ids:
+            raft.apply(NODE_UPDATE_STATUS, {
+                "node_id": nid, "status": NODE_STATUS_DOWN,
+                "updated_at": 1000.0})
+            entries += 1
+        evals = []
+        for nid in doomed_ids:
+            evals.extend(create_node_evals(s, nid))
+    occ: dict = {}
+    for ev in evals:
+        k = (ev.namespace, ev.job_id)
+        ev.id = f"storm-ev-{ev.job_id}-{occ.get(k, 0)}"
+        occ[k] = occ.get(k, 0) + 1
+    raft.apply(EVAL_UPDATE, {"evals": evals})
+    for ev in evals:
+        new_scheduler(ev.type, s.snapshot(), shim).process(ev)
+    return entries
+
+
+# ------------------------------------------------- the storm differential
+
+def test_storm_differential_serial_vs_batched_bit_identical():
+    """Acceptance: killing K nodes one-at-a-time (K raft entries, one
+    eval set per node) vs in ONE batched sweep (1 entry, deduped evals)
+    must land bit-identical final placements, dispositions, usage
+    matrices, and eligibility masks."""
+    fsm, nodes = _seed_cluster()
+    s = fsm.state
+    loaded = [n.id for n in nodes if s.allocs_by_node(n.id)]
+    assert len(loaded) >= 2, "seed must spread allocs over several nodes"
+    doomed = sorted(set(loaded[:4]) | {nodes[0].id, nodes[1].id})
+    blob = fsm.snapshot_bytes()
+    twin = NomadFSM()
+    twin.restore_bytes(blob)
+
+    serial_entries = _storm_leg(fsm, doomed, batched=False)
+    batch_entries = _storm_leg(twin, doomed, batched=True)
+    assert serial_entries == len(doomed)
+    assert batch_entries == 1
+
+    fp_serial = _fingerprint(fsm.state)
+    fp_batch = _fingerprint(twin.state)
+    assert fp_serial == fp_batch, "storm legs diverged"
+
+    # the storm actually moved work: every doomed node's live allocs
+    # were replaced onto survivors
+    live, _, _, _, _ = fp_batch
+    assert live, "replacements never landed"
+    assert not any(node_id in doomed for _, _, node_id in live), \
+        "a replacement landed on a downed node"
+
+
+def test_batched_eval_set_is_strictly_smaller():
+    """The flood arithmetic: the per-node path emits one eval per
+    (job, node) pair; the batch dedupes to one per job."""
+    fsm, nodes = _seed_cluster(n_nodes=12, n_jobs=3, count=8)
+    s = fsm.state
+    doomed = [n.id for n in nodes if s.allocs_by_node(n.id)]
+    per_node = []
+    for nid in doomed:
+        per_node.extend(create_node_evals(s, nid))
+    batched = create_node_evals_batch(s, doomed)
+    batched_jobs = {(e.namespace, e.job_id) for e in batched}
+    assert len(batched) == len(batched_jobs), "batch output has dupes"
+    assert {(e.namespace, e.job_id) for e in per_node} == batched_jobs
+    assert len(per_node) > len(batched), \
+        "the batch path saved no eval flood — dedupe is dead code"
+
+
+# ------------------------------------------- create_node_evals batch scale
+
+def test_create_node_evals_batch_dedupe_priority_and_system_once():
+    s = NomadFSM().state
+    s.set_scheduler_config(1, SchedulerConfiguration())
+    nodes = []
+    idx = 2
+    for i in range(4):
+        n = mock.node()
+        n.id = f"b-node-{i}"
+        s.upsert_node(idx, n)
+        nodes.append(n)
+        idx += 1
+    job_a = _mk_job("a", 2, priority=70)
+    job_b = _mk_job("b", 2, priority=40)
+    job_c = _mk_job("c", 1)                 # allocs only on the survivor
+    sysjob = mock.system_job()
+    sysjob.priority = 60
+    for job in (job_a, job_b, job_c, sysjob):
+        s.upsert_job(idx, job)
+        idx += 1
+    # job A spans doomed nodes 0+1, job B spans 1+2, job C on node 3
+    placement = [(job_a, 0), (job_a, 1), (job_b, 1), (job_b, 2),
+                 (job_c, 3)]
+    for k, (job, ni) in enumerate(placement):
+        a = mock.alloc_for(job, nodes[ni])
+        a.id = f"b-alloc-{k}"
+        s.upsert_allocs(idx, [a])
+        idx += 1
+
+    doomed = [nodes[0].id, nodes[1].id, nodes[2].id]
+    evals = create_node_evals_batch(s, doomed)
+    by_job = {e.job_id: e for e in evals}
+    # one eval per affected job + ONE per system job, none for job C
+    assert set(by_job) == {job_a.id, job_b.id, sysjob.id}
+    assert len(evals) == 3
+    # priority/type inherit from the job
+    assert by_job[job_a.id].priority == 70
+    assert by_job[job_b.id].priority == 40
+    assert by_job[sysjob.id].priority == 60
+    assert by_job[sysjob.id].type == JOB_TYPE_SYSTEM
+    assert all(e.triggered_by == TRIGGER_NODE_UPDATE for e in evals)
+    assert all(e.status == "pending" for e in evals)
+    # the eval anchors to the first doomed node carrying the job's alloc
+    assert by_job[job_a.id].node_id == nodes[0].id
+    assert by_job[job_b.id].node_id == nodes[1].id
+    # serial comparison: per-node calls emit the (job, node) cross
+    # product — 2 for A, 2 for B, 3 for the system job
+    per_node = []
+    for nid in doomed:
+        per_node.extend(create_node_evals(s, nid))
+    assert len(per_node) == 7
+
+
+def test_disconnect_window_allocs_ride_instead_of_immediate_loss():
+    """max_client_disconnect (satellite): a RUNNING alloc on a downed
+    node inside its disconnect window is NOT immediately stopped/lost —
+    the node-update eval (which must still fire: it drives the unknown
+    transition) marks it `unknown` and parks a timeout-later eval; only
+    window expiry makes it lost."""
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    nodes = []
+    for i in range(4):
+        n = mock.node()
+        n.id = f"dc-node-{i}"
+        s.upsert_node(idx, n)
+        nodes.append(n)
+        idx += 1
+    job = _mk_job("dc", 1)
+    job.type = "service"
+    job.task_groups[0].max_client_disconnect_sec = 300.0
+    s.upsert_job(idx, job)
+    idx += 1
+    a = mock.alloc_for(job, nodes[0])
+    a.id = "dc-alloc-0"
+    a.name = f"{job.id}.{job.task_groups[0].name}[0]"
+    a.task_group = job.task_groups[0].name
+    a.client_status = ALLOC_CLIENT_RUNNING
+    s.upsert_allocs(idx, [a])
+    idx += 1
+
+    raft = RaftLog(fsm)
+    raft.apply(BATCH_NODE_UPDATE_STATUS, {
+        "node_ids": [nodes[0].id], "status": NODE_STATUS_DOWN,
+        "updated_at": time.time()})
+    evals = create_node_evals_batch(s, [nodes[0].id])
+    assert [e.job_id for e in evals] == [job.id], \
+        "the disconnect-window job still needs its node-update eval"
+    evals[0].id = "dc-ev-0"
+    raft.apply(EVAL_UPDATE, {"evals": evals})
+    planner = Planner(raft, s)
+    new_scheduler("service", s.snapshot(), _Shim(planner, s)) \
+        .process(evals[0])
+
+    cur = s.alloc_by_id(a.id)
+    assert cur.client_status == "unknown", \
+        "a disconnect-window alloc must ride as unknown, not be lost"
+    assert cur.desired_status == "run", \
+        "a disconnect-window alloc was stopped inside its window"
+    assert cur.disconnected_at > 0
+    # the window-expiry eval is parked for later
+    later = [e for e in s.iter_evals()
+             if e.job_id == job.id and e.wait_until_unix]
+    assert later, "no timeout-later eval was parked for window expiry"
+
+    # window expiry: backdate the disconnect and reconcile again
+    cur = cur.copy()
+    cur.disconnected_at = time.time() - 400.0
+    s.upsert_allocs(s.latest_index() + 1, [cur])
+    ev2 = Evaluation(id="dc-ev-1", namespace="default", job_id=job.id,
+                     type="service", priority=50,
+                     triggered_by=TRIGGER_NODE_UPDATE)
+    raft.apply(EVAL_UPDATE, {"evals": [ev2]})
+    new_scheduler("service", s.snapshot(), _Shim(planner, s)).process(ev2)
+    cur = s.alloc_by_id(a.id)
+    assert cur.client_status == "lost" or cur.desired_status == "stop", \
+        "an expired disconnect window must finally lose the alloc"
+    live = [al for al in s.allocs_by_job("default", job.id)
+            if al.desired_status == "run" and not al.terminal_status()]
+    assert any(al.node_id != nodes[0].id for al in live), \
+        "no replacement placed after window expiry"
+
+
+# --------------------------------------------- rate-capped, paced sweeps
+
+def _manual_server(**cfg_kw):
+    clock = ManualClock()
+    s = Server(num_workers=0, gc_interval=9999)
+    s.heartbeats.clock = clock
+    s.heartbeats.ttl_spread = 0.0        # deterministic deadlines
+    s.flap_damper.clock = clock
+    s.state.set_scheduler_config(
+        s.state.latest_index() + 1,
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU,
+                               **cfg_kw))
+    return s, clock
+
+
+def _count_applies(s, counts: dict):
+    orig = s.raft.apply
+
+    def counting(msg_type, payload, **kw):
+        counts[msg_type] = counts.get(msg_type, 0) + 1
+        counts.setdefault("_sizes", []).append(
+            len(payload.get("node_ids", ())) if msg_type ==
+            BATCH_NODE_UPDATE_STATUS else 0)
+        return orig(msg_type, payload, **kw)
+
+    s.raft.apply = counting
+
+
+def test_rate_capped_sweep_paces_a_mass_expiry():
+    """Acceptance: K expired nodes drain in ceil(K / cap) batch entries
+    with carry-over — never one raft entry per node, never one
+    unbounded megaflood."""
+    cap = 4
+    s, clock = _manual_server(heartbeat_invalidate_rate_cap=cap,
+                              flap_damping_threshold=0)
+    try:
+        doomed, survivors = [], []
+        for i in range(13):
+            n = mock.node()
+            s.node_register(n)
+            (doomed if i < 10 else survivors).append(n.id)
+        clock.advance(s.heartbeats.min_ttl + 1.0)    # everyone expired
+        for nid in survivors:
+            s.node_heartbeat(nid)                    # back to now + ttl
+        counts: dict = {}
+        _count_applies(s, counts)
+        carry0 = metrics.counter("nomad.heartbeat.sweep_carryover")
+        sweeps = 0
+        while any(s.state.node_by_id(nid).status != NODE_STATUS_DOWN
+                  for nid in doomed):
+            s.heartbeats._sweep(clock.time())
+            sweeps += 1
+            assert sweeps <= 10, "sweeps are not making progress"
+        expect = math.ceil(len(doomed) / cap)
+        assert sweeps == expect
+        assert counts.get(BATCH_NODE_UPDATE_STATUS, 0) == expect, \
+            "invalidation cost more raft entries than ceil(K/cap)"
+        assert counts.get(NODE_UPDATE_STATUS, 0) == 0, \
+            "a per-node status entry leaked through the batch path"
+        sizes = [z for z in counts["_sizes"] if z]
+        assert max(sizes) <= cap
+        assert sum(sizes) == len(doomed)
+        assert metrics.counter("nomad.heartbeat.sweep_carryover") > carry0
+        for nid in survivors:
+            assert s.state.node_by_id(nid).status == NODE_STATUS_READY
+    finally:
+        s.shutdown()
+
+
+@pytest.mark.chaos
+def test_sweep_fault_rearms_whole_batch_and_retries():
+    """`heartbeat.sweep` fault site: a failed batch invalidate re-arms
+    EVERY member with the short backoff (nodes stay tracked), and a
+    heartbeat landing before the retry wins the per-node CAS."""
+    s, clock = _manual_server(flap_damping_threshold=0)
+    try:
+        nodes = [mock.node() for _ in range(5)]
+        for n in nodes:
+            s.node_register(n)
+        clock.advance(s.heartbeats.min_ttl + 1.0)
+        faults.install({"heartbeat.sweep": {"mode": "raise", "times": 1}})
+        s.heartbeats._sweep(clock.time())
+        assert all(s.state.node_by_id(n.id).status == NODE_STATUS_READY
+                   for n in nodes), "a faulted sweep must commit nothing"
+        with s.heartbeats._lock:
+            deadlines = dict(s.heartbeats._deadlines)
+        retry_at = clock.time() + INVALIDATE_RETRY_BACKOFF_S
+        assert all(deadlines[n.id] == retry_at for n in nodes), \
+            "a failed batch must re-arm every member"
+        # one node heartbeats before the retry: the CAS saves it
+        s.node_heartbeat(nodes[4].id)
+        clock.advance(INVALIDATE_RETRY_BACKOFF_S + 0.1)
+        s.heartbeats._sweep(clock.time())
+        for n in nodes[:4]:
+            assert s.state.node_by_id(n.id).status == NODE_STATUS_DOWN
+        assert s.state.node_by_id(nodes[4].id).status == NODE_STATUS_READY
+    finally:
+        s.shutdown()
+
+
+def test_invalidate_batch_carries_evals_in_the_same_raft_entry():
+    """Atomicity by construction: the down-batch's replacement evals
+    ride the SAME raft entry as the status flips (the JOB_REGISTER
+    shape) — a crash or leadership loss between two separate entries
+    could otherwise commit the flips and strand the down nodes
+    eval-less forever (the next sweep filters them as terminal)."""
+    s, clock = _manual_server(flap_damping_threshold=0)
+    try:
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            s.node_register(n)
+        sysjob = mock.system_job()
+        s.state.upsert_job(s.state.latest_index() + 1, sysjob)
+        clock.advance(s.heartbeats.min_ttl + 1.0)
+        counts: dict = {}
+        _count_applies(s, counts)
+        s.heartbeats._sweep(clock.time())
+        assert all(s.state.node_by_id(n.id).status == NODE_STATUS_DOWN
+                   for n in nodes)
+        got = [e for e in s.state.iter_evals()
+               if e.triggered_by == TRIGGER_NODE_UPDATE]
+        assert [e.job_id for e in got] == [sysjob.id]
+        # ONE entry carried both; no separate EVAL_UPDATE was applied
+        assert counts.get(BATCH_NODE_UPDATE_STATUS, 0) == 1
+        assert counts.get(EVAL_UPDATE, 0) == 0
+        # and a FAILED apply commits neither flips nor evals
+        n4 = mock.node()
+        s.node_register(n4)
+        clock.advance(s.heartbeats.min_ttl + 1.0)
+        faults.install({"heartbeat.sweep": {"mode": "raise", "times": 1}})
+        s.heartbeats._sweep(clock.time())
+        assert s.state.node_by_id(n4.id).status == NODE_STATUS_READY
+        assert len([e for e in s.state.iter_evals()
+                    if e.triggered_by == TRIGGER_NODE_UPDATE]) == len(got)
+    finally:
+        s.shutdown()
+
+
+# ------------------------------------------- taint mask vs epoch contract
+
+def _store_with_nodes(n_nodes: int):
+    fsm = NomadFSM()
+    s = fsm.state
+    s.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    nodes = []
+    idx = 2
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"t-node-{i:04d}"
+        s.upsert_node(idx, n)
+        nodes.append(n)
+        idx += 1
+    return fsm, s, nodes
+
+
+def test_status_flip_journals_taint_without_epoch_bump():
+    fsm, s, nodes = _store_with_nodes(8)
+    u = s.usage
+    e0, v0 = u.epoch, u.version
+    raft = RaftLog(fsm)
+    raft.apply(BATCH_NODE_UPDATE_STATUS, {
+        "node_ids": [nodes[0].id, nodes[3].id],
+        "status": NODE_STATUS_DOWN, "updated_at": 1.0})
+    assert u.epoch == e0, "a status flip must NOT bump the epoch"
+    assert u.version == v0 + 2
+    _, entries = u.delta_log.tail
+    taints = [e for e in entries if e[2] is None]
+    assert len(taints) == 2 and all(e[4] == 0.0 for e in taints)
+    assert u.elig[u.row[nodes[0].id]] == 0.0
+    assert u.elig[u.row[nodes[1].id]] == 1.0
+    # drain + eligibility flips ride the journal too
+    s.update_node_eligibility(s.latest_index() + 1, nodes[1].id,
+                              NODE_SCHED_INELIGIBLE)
+    assert u.epoch == e0
+    assert u.elig[u.row[nodes[1].id]] == 0.0
+    # a no-op flip (down node marked down again) adds no journal entry
+    v_now = u.version
+    s.update_node_status(s.latest_index() + 1, nodes[0].id,
+                         NODE_STATUS_DOWN, 2.0)
+    assert u.version == v_now
+    # epoch stays reserved for true node-set mutation
+    extra = mock.node()
+    s.upsert_node(s.latest_index() + 1, extra)
+    assert u.epoch == e0 + 1
+
+
+def test_storm_advances_tensor_cache_without_reseed():
+    """Acceptance: `nomad.solver.state_cache.reseeds` is UNCHANGED by a
+    mass status flip — the taint rides the delta journal into the
+    resident cache instead of evicting it."""
+    fsm, s, nodes = _store_with_nodes(16)
+    rows = np.arange(16, dtype=np.int64)
+    assert state_cache.gather(s.snapshot().usage, rows) is not None
+    reseeds0 = metrics.counter("nomad.solver.state_cache.reseeds")
+    misses0 = metrics.counter("nomad.solver.state_cache.misses")
+    doomed = [n.id for n in nodes[:6]]
+    RaftLog(fsm).apply(BATCH_NODE_UPDATE_STATUS, {
+        "node_ids": doomed, "status": NODE_STATUS_DOWN, "updated_at": 1.0})
+    view = s.snapshot().usage
+    got = state_cache.gather(view, rows)
+    assert got is not None
+    assert metrics.counter("nomad.solver.state_cache.reseeds") == reseeds0, \
+        "the storm reseeded the cache — taint must ride the journal"
+    assert metrics.counter("nomad.solver.state_cache.misses") == misses0
+    assert got.cap.tobytes() == view.cap[rows].tobytes()
+    assert got.used.tobytes() == view.used[rows].tobytes()
+    tc = cache()
+    assert tc.elig is not None
+    assert int((tc.elig[:16] < 0.5).sum()) == len(doomed)
+    assert tc.stats()["tainted_rows"] == len(doomed)
+
+
+def test_taint_mask_bit_parity_with_ready_oracle():
+    """The journaled mask vs the host oracle: through a churn of status
+    flips, drains, eligibility writes, and re-admissions, the cache's
+    advanced elig column equals `node.ready()` per node at every step."""
+    fsm, s, nodes = _store_with_nodes(12)
+    raft = RaftLog(fsm)
+    rows = np.arange(12, dtype=np.int64)
+    state_cache.gather(s.snapshot().usage, rows)
+    rng = random.Random(5)
+    for step in range(40):
+        n = nodes[rng.randrange(len(nodes))]
+        op = rng.randrange(4)
+        idx = s.latest_index() + 1
+        if op == 0:
+            raft.apply(BATCH_NODE_UPDATE_STATUS, {
+                "node_ids": [n.id], "status": NODE_STATUS_DOWN,
+                "updated_at": float(step)})
+        elif op == 1:
+            s.update_node_status(idx, n.id, NODE_STATUS_READY, float(step))
+        elif op == 2:
+            s.update_node_eligibility(idx, n.id, NODE_SCHED_INELIGIBLE)
+        else:
+            s.update_node_eligibility(idx, n.id, NODE_SCHED_ELIGIBLE)
+        view = s.snapshot().usage
+        assert state_cache.gather(view, rows) is not None
+        oracle = np.array([s.node_by_id(m.id).ready() for m in nodes],
+                          bool)
+        got = cache().elig[:12] > 0.5
+        assert np.array_equal(got, oracle), \
+            f"mask diverged from ready() oracle at step {step}"
+        assert np.array_equal(view.elig > 0.5, oracle)
+
+
+def test_sharded_twins_stay_partitioned_through_a_storm(monkeypatch):
+    """Acceptance: on the virtual 8-device mesh, a mass status flip
+    leaves the per-shard device twins RESIDENT and node-sharded — the
+    taint advance never pays a reseed or collapses the partitioning."""
+    monkeypatch.setattr(backend, "SHARD_MIN_NODES", 8)
+    fsm, s, nodes = _store_with_nodes(24)
+    n = len(nodes)
+    bucket = buckets.node_bucket(n)
+    rows = np.arange(n, dtype=np.int64)
+    got = state_cache.gather(s.snapshot().usage, rows, bucket=bucket)
+    assert got is not None and got.cap_dev is not None
+    assert sharding.is_node_sharded(cache()._used_dev)
+    reseeds0 = metrics.counter("nomad.solver.state_cache.reseeds")
+    misses0 = metrics.counter("nomad.solver.state_cache.misses")
+    RaftLog(fsm).apply(BATCH_NODE_UPDATE_STATUS, {
+        "node_ids": [m.id for m in nodes[:8]],
+        "status": NODE_STATUS_DOWN, "updated_at": 1.0})
+    view = s.snapshot().usage
+    got2 = state_cache.gather(view, rows, bucket=bucket)
+    assert got2 is not None and got2.used_dev is not None
+    assert sharding.is_node_sharded(got2.used_dev)
+    assert sharding.is_node_sharded(cache()._used_dev), \
+        "the storm collapsed the twin's partitioning"
+    assert metrics.counter("nomad.solver.state_cache.reseeds") == reseeds0
+    assert metrics.counter("nomad.solver.state_cache.misses") == misses0
+    assert int((cache().elig[:n] < 0.5).sum()) == 8
+
+
+# --------------------------------------------------- broker storm traffic
+
+def _broker(cap=0, ttl=0.0):
+    b = EvalBroker()
+    b.depth_cap = cap
+    b.eval_deadline_s = ttl
+    b.set_enabled(True)
+    return b
+
+
+def _node_ev(job="j1", eid=None, priority=50):
+    return Evaluation(id=eid or f"ne-{job}-{random.random()}",
+                      namespace="default", job_id=job, type="batch",
+                      priority=priority, triggered_by=TRIGGER_NODE_UPDATE)
+
+
+def test_node_update_evals_coalesce_while_queued():
+    b = _broker()
+    first = _node_ev("j1", "ne-first")
+    b.enqueue(first)
+    base = metrics.counter("nomad.broker.node_update_coalesced")
+    dup = _node_ev("j1", "ne-dup")
+    b.enqueue(dup)
+    assert b.depth() == 1, "the redundant node-update eval was queued"
+    assert metrics.counter("nomad.broker.node_update_coalesced") == base + 1
+    assert b.take_coalesced() == ["ne-dup"]
+    assert b.take_coalesced() == []
+    # a different job does not coalesce
+    b.enqueue(_node_ev("j2", "ne-other"))
+    assert b.depth() == 2
+
+
+def test_outstanding_node_update_eval_does_not_coalesce():
+    """A dequeued (mid-solve) eval's snapshot may predate the new
+    failure: the newcomer must park via the ordinary one-per-job dedupe
+    (pending), NOT be superseded."""
+    b = _broker()
+    first = _node_ev("j1", "ne-out-1")
+    b.enqueue(first)
+    got, token = b.dequeue(["batch"], timeout=1)
+    assert got.id == first.id
+    second = _node_ev("j1", "ne-out-2")
+    b.enqueue(second)
+    assert b.take_coalesced() == []
+    assert b.stats["total_pending"] == 1
+    # but a THIRD arrival now coalesces against the pending second
+    third = _node_ev("j1", "ne-out-3")
+    b.enqueue(third)
+    assert b.take_coalesced() == ["ne-out-3"]
+    b.ack(first.id, token)
+
+
+def test_node_update_evals_are_shed_and_deadline_exempt():
+    """Replacement-of-lost-work traffic bypasses the depth cap, is never
+    a shed victim, and takes no enqueue TTL — it must outlive any user
+    churn burst instead of dead-lettering behind it."""
+    b = _broker(cap=3, ttl=2.0)
+    user = [Evaluation(namespace="default", job_id=f"u{i}", type="batch",
+                       priority=90) for i in range(3)]
+    for ev in user:
+        b.enqueue(ev)
+    assert b.depth() == 3
+    nu = _node_ev("lost-job", "ne-exempt", priority=10)
+    b.enqueue(nu)
+    assert b.depth() == 4, "node-update eval must bypass the cap"
+    assert b.stats["total_shed"] == 0
+    assert nu.id in b._evals
+    # over-cap user arrivals shed users, never the node-update eval
+    b.enqueue(Evaluation(namespace="default", job_id="u-late",
+                         type="batch", priority=95))
+    assert b.stats["total_shed"] == 1
+    assert nu.id in b._evals and nu.id not in \
+        {e.id for e in b.failed_evals()}
+    # no deadline was stamped on the node-update eval
+    queued = b._evals[nu.id]
+    assert not queued.deadline_unix, \
+        "lost-alloc replacement work must not expire behind a burst"
+
+
+def test_dead_lettered_node_update_eval_does_not_coalesce():
+    """A dead-lettered node-update eval never runs a scheduler pass
+    (the reaper terminates it into a backed-off follow-up), so it must
+    NOT act as the 'queued' covering eval — the newcomer parks via the
+    ordinary one-per-job dedupe instead of being canceled."""
+    b = _broker()
+    b.delivery_limit = 1
+    first = _node_ev("j-dead", "ne-dead-1")
+    b.enqueue(first)
+    got, token = b.dequeue(["batch"], timeout=1)
+    assert got.id == first.id
+    b.nack(first.id, token)          # count >= limit -> dead-letter
+    assert any(e.id == first.id for e in b.failed_evals())
+    second = _node_ev("j-dead", "ne-dead-2")
+    b.enqueue(second)
+    assert b.take_coalesced() == [], \
+        "a dead-lettered eval coalesced away its replacement coverage"
+    assert b.stats["total_pending"] == 1
+
+
+def test_cancel_coalesced_restashes_ids_on_apply_failure():
+    """A transient raft failure while canceling superseded evals must
+    re-stash the drained ids — losing them leaks the coalesced evals
+    as permanently-pending state records (eval GC only reaps
+    terminal)."""
+    s = Server(num_workers=0, gc_interval=9999)
+    try:
+        s.eval_broker.set_enabled(True)
+        first = _node_ev("rs-job", "rs-ev-1")
+        dup = _node_ev("rs-job", "rs-ev-2")
+        s.raft.apply(EVAL_UPDATE, {"evals": [first, dup]})
+        s.eval_broker.enqueue(first)
+        s.eval_broker.enqueue(dup)          # superseded, parked
+        orig = s.raft.apply
+        fail = {"armed": True}
+
+        def flaky(msg_type, payload, **kw):
+            if fail["armed"] and msg_type == EVAL_UPDATE and \
+                    any(e.id == "rs-ev-2" for e in payload["evals"]):
+                fail["armed"] = False
+                raise RuntimeError("transient raft apply failure")
+            return orig(msg_type, payload, **kw)
+
+        s.raft.apply = flaky
+        with pytest.raises(RuntimeError):
+            s._cancel_coalesced_evals()
+        assert s.state.eval_by_id("rs-ev-2").status == "pending"
+        s._cancel_coalesced_evals()          # next tick retries
+        assert s.state.eval_by_id("rs-ev-2").status == "canceled"
+    finally:
+        s.shutdown()
+
+
+def test_flap_damper_follows_heartbeat_clock_dynamically():
+    """Swapping heartbeats.clock after construction must move the
+    damper too — the two clocks diverging makes hold/no-hold window
+    math nondeterministic (wall time mixed with manual time)."""
+    s = Server(num_workers=0, gc_interval=9999)
+    try:
+        clock = ManualClock()
+        s.heartbeats.clock = clock
+        assert s.flap_damper.clock is clock
+        own = ManualClock()
+        s.flap_damper.clock = own            # explicit injection wins
+        assert s.flap_damper.clock is own
+    finally:
+        s.shutdown()
+
+
+def test_leader_cancels_coalesced_eval_records():
+    s = Server(num_workers=0, gc_interval=9999)
+    try:
+        s.eval_broker.set_enabled(True)
+        first = _node_ev("cj", "co-ev-1")
+        dup = _node_ev("cj", "co-ev-2")
+        s.raft.apply(EVAL_UPDATE, {"evals": [first, dup]})
+        s.eval_broker.enqueue(first)
+        s.eval_broker.enqueue(dup)          # superseded, parked
+        s._cancel_coalesced_evals()
+        cur = s.state.eval_by_id("co-ev-2")
+        assert cur.status == "canceled"
+        assert "superseded" in cur.status_description
+        assert s.state.eval_by_id("co-ev-1").status == "pending"
+        # idempotent on an empty park list
+        s._cancel_coalesced_evals()
+    finally:
+        s.shutdown()
+
+
+# -------------------------------------------------------- flap damping
+
+class _FakeCfgServer:
+    def __init__(self, **kw):
+        cfg = SchedulerConfiguration(
+            flap_damping_threshold=kw.get("threshold", 3),
+            flap_damping_window_s=kw.get("window", 100.0),
+            flap_damping_backoff_s=kw.get("backoff", 30.0),
+            flap_damping_backoff_max_s=kw.get("backoff_max", 120.0))
+        self.state = types.SimpleNamespace(
+            get_scheduler_config=lambda: cfg)
+
+
+def test_flap_damper_threshold_and_exponential_backoff():
+    clock = ManualClock()
+    d = FlapDamper(_FakeCfgServer(), clock=clock)
+    nid = "flappy"
+    for cycle in range(2):
+        d.record_down(nid)
+        assert d.record_up(nid) is None
+        clock.advance(1.0)
+    d.record_down(nid)
+    hold = d.record_up(nid)                  # third cycle trips
+    assert hold == pytest.approx(clock.time() + 30.0)
+    assert d.held(nid)
+    assert d.due() == []
+    clock.advance(30.1)
+    assert d.due() == [nid]
+    d.release(nid)
+    assert not d.held(nid)
+    # the next episode doubles, then caps
+    for expect in (60.0, 120.0, 120.0):
+        for _ in range(3):
+            d.record_down(nid)
+            hold = d.record_up(nid)
+            clock.advance(0.5)
+        assert hold == pytest.approx(clock.time() - 0.5 + expect)
+        d.release(nid)
+
+
+def test_flap_damper_quiet_spell_resets_episode_and_zero_disables():
+    clock = ManualClock()
+    d = FlapDamper(_FakeCfgServer(window=50.0), clock=clock)
+    nid = "n"
+    for _ in range(3):
+        d.record_up(nid)
+    d.release(nid)
+    # a full quiet window ends the episode: back to the base backoff
+    clock.advance(60.0)
+    for _ in range(3):
+        d.record_up(nid)
+    with d._lock:
+        deadline = d._held[nid]
+    assert deadline == pytest.approx(clock.time() + 30.0)
+    # threshold 0 disables entirely
+    d0 = FlapDamper(_FakeCfgServer(threshold=0), clock=clock)
+    for _ in range(10):
+        assert d0.record_up(nid) is None
+    assert not d0.held(nid)
+
+
+def test_flap_damper_adopts_replicated_holds():
+    clock = ManualClock()
+    d = FlapDamper(_FakeCfgServer(), clock=clock)
+    held = mock.node()
+    held.flap_held_until = clock.time() + 40.0
+    free = mock.node()
+    state = types.SimpleNamespace(iter_nodes=lambda: [held, free])
+    assert d.adopt(state) == 1
+    assert d.held(held.id) and not d.held(free.id)
+    clock.advance(41.0)
+    assert d.due() == [held.id]
+    d.reset()
+    assert not d.held(held.id)
+
+
+def test_flapping_node_held_ineligible_then_readmitted():
+    """Server-level: a node cycling down/up past the threshold is held
+    ineligible (flap_held_until rides raft), blocked evals are NOT
+    unblocked onto it, and the leader tick re-admits it after the
+    hold — restoring eligibility and clearing the hold from state."""
+    s, clock = _manual_server(flap_damping_threshold=3,
+                              flap_damping_window_s=300.0,
+                              flap_damping_backoff_s=30.0,
+                              flap_damping_backoff_max_s=900.0)
+    try:
+        n = mock.node()
+        s.node_register(n)
+        sysjob = mock.system_job()
+        s.state.upsert_job(s.state.latest_index() + 1, sysjob)
+        held0 = metrics.counter("nomad.heartbeat.flap_held")
+        for _ in range(3):
+            s.node_update_status(n.id, NODE_STATUS_DOWN)
+            clock.advance(1.0)
+            s.node_update_status(n.id, NODE_STATUS_READY)
+            clock.advance(1.0)
+        cur = s.state.node_by_id(n.id)
+        assert cur.scheduling_eligibility == NODE_SCHED_INELIGIBLE
+        assert cur.flap_held_until > clock.time()
+        assert s.flap_damper.held(n.id)
+        assert metrics.counter("nomad.heartbeat.flap_held") == held0 + 1
+        assert not cur.ready()
+        # a held node re-registering must not wash its hold away
+        fresh = mock.node()
+        fresh.id = n.id
+        fresh.name = n.name
+        s.node_register(fresh)
+        cur = s.state.node_by_id(n.id)
+        assert cur.flap_held_until > 0
+        assert cur.scheduling_eligibility == NODE_SCHED_INELIGIBLE
+        # too early: the tick does nothing
+        s._flap_readmit_tick()
+        assert s.flap_damper.held(n.id)
+        # hold expiry: re-admitted, eligibility restored, hold cleared,
+        # and the system-job evals the suppressed READY path skipped
+        # are finally emitted (the node must get its node-local system
+        # allocs back)
+        sys_evals0 = len([e for e in s.state.iter_evals()
+                          if e.job_id == sysjob.id])
+        clock.advance(31.0)
+        s._flap_readmit_tick()
+        cur = s.state.node_by_id(n.id)
+        assert cur.scheduling_eligibility == NODE_SCHED_ELIGIBLE
+        assert cur.flap_held_until == 0.0
+        assert not s.flap_damper.held(n.id)
+        assert metrics.counter("nomad.heartbeat.flap_readmitted") >= 1
+        assert len([e for e in s.state.iter_evals()
+                    if e.job_id == sysjob.id]) == sys_evals0 + 1
+    finally:
+        s.shutdown()
+
+
+def test_poison_job_does_not_starve_batch_eval_construction(monkeypatch):
+    """Per-job failure isolation in create_node_evals_batch: one job
+    whose eval construction raises loses its eval (counted) instead of
+    failing the whole batch — an exception would otherwise re-arm and
+    retry the ENTIRE sweep batch forever, starving invalidation of
+    every other expired node."""
+    fsm, s, nodes = _store_with_nodes(2)
+    idx = s.latest_index() + 1
+    good = _mk_job("good", 1)
+    bad = _mk_job("bad", 1)
+    for job in (good, bad):
+        s.upsert_job(idx, job)
+        idx += 1
+    for k, job in enumerate((good, bad)):
+        a = mock.alloc_for(job, nodes[0])
+        a.id = f"poison-alloc-{k}"
+        s.upsert_allocs(idx, [a])
+        idx += 1
+    orig = s.job_by_id
+
+    def poisoned(ns, jid):
+        if jid == bad.id:
+            raise RuntimeError("poison job")
+        return orig(ns, jid)
+
+    monkeypatch.setattr(s, "job_by_id", poisoned)
+    errs0 = metrics.counter("nomad.heartbeat.node_eval_errors")
+    evals = create_node_evals_batch(s, [nodes[0].id])
+    assert [e.job_id for e in evals] == [good.id], \
+        "the healthy job's eval must survive the poison member"
+    assert metrics.counter("nomad.heartbeat.node_eval_errors") == errs0 + 1
+
+
+def test_held_node_cycling_below_threshold_stays_suppressed():
+    """A node inside an active flap hold that cycles down/up again
+    (below the reset threshold, so record_up returns no new hold) must
+    NOT take the ordinary READY path: no system-job evals, no unblock —
+    it is ineligible until the readmit tick lifts the hold."""
+    s, clock = _manual_server(flap_damping_threshold=3,
+                              flap_damping_window_s=300.0,
+                              flap_damping_backoff_s=30.0,
+                              flap_damping_backoff_max_s=900.0)
+    try:
+        n = mock.node()
+        s.node_register(n)
+        sysjob = mock.system_job()
+        s.state.upsert_job(s.state.latest_index() + 1, sysjob)
+        for _ in range(3):
+            s.node_update_status(n.id, NODE_STATUS_DOWN)
+            clock.advance(1.0)
+            s.node_update_status(n.id, NODE_STATUS_READY)
+            clock.advance(1.0)
+        assert s.flap_damper.held(n.id)
+        # another down/up cycle DURING the hold: one up < threshold
+        # (the DOWN edge legitimately emits its replacement evals —
+        # only the READY edge must stay suppressed)
+        s.node_update_status(n.id, NODE_STATUS_DOWN)
+        clock.advance(1.0)
+        sys_evals0 = len([e for e in s.state.iter_evals()
+                          if e.job_id == sysjob.id])
+        res = s.node_update_status(n.id, NODE_STATUS_READY)
+        cur = s.state.node_by_id(n.id)
+        assert cur.scheduling_eligibility == NODE_SCHED_INELIGIBLE
+        assert cur.flap_held_until > 0
+        sys_evals = [e.id for e in s.state.iter_evals()
+                     if e.job_id == sysjob.id]
+        assert len(sys_evals) == sys_evals0, \
+            "a held node's up-edge emitted system evals through the hold"
+        assert not any(eid in res["eval_ids"] for eid in sys_evals)
+    finally:
+        s.shutdown()
+
+
+def test_operator_eligibility_write_supersedes_flap_hold():
+    s, clock = _manual_server(flap_damping_threshold=2,
+                              flap_damping_window_s=300.0,
+                              flap_damping_backoff_s=60.0,
+                              flap_damping_backoff_max_s=900.0)
+    try:
+        n = mock.node()
+        s.node_register(n)
+        for _ in range(2):
+            s.node_update_status(n.id, NODE_STATUS_DOWN)
+            clock.advance(1.0)
+            s.node_update_status(n.id, NODE_STATUS_READY)
+            clock.advance(1.0)
+        assert s.flap_damper.held(n.id)
+        s.node_update_eligibility(n.id, NODE_SCHED_ELIGIBLE)
+        cur = s.state.node_by_id(n.id)
+        assert cur.flap_held_until == 0.0
+        assert cur.scheduling_eligibility == NODE_SCHED_ELIGIBLE
+        assert not s.flap_damper.held(n.id)
+    finally:
+        s.shutdown()
+
+
+# ------------------------------------------------ end-to-end storm drill
+
+def test_mass_failure_recovers_all_replacements_no_reseed():
+    """E2E through a live server: batch-down 1/3 of a loaded cluster,
+    let the workers replace everything, and audit the bounded-cost
+    contract — one invalidation entry, deduped evals, zero reseeds,
+    zero node-update dead letters."""
+    s = Server(num_workers=2, gc_interval=9999)
+    s.start()
+    try:
+        nodes = []
+        for _ in range(9):
+            n = mock.node()
+            s.node_register(n)
+            nodes.append(n)
+        jobs = []
+        for j in range(3):
+            job = _mk_job(f"e2e-{j}", 6)
+            s.job_register(job)
+            jobs.append(job)
+        assert wait_until(lambda: all(
+            len([a for a in s.state.allocs_by_job("default", job.id)
+                 if not a.terminal_status()]) == 6 for job in jobs))
+        doomed = sorted({a.node_id for job in jobs
+                         for a in s.state.allocs_by_job("default", job.id)
+                         })[:3]
+        reseeds0 = metrics.counter("nomad.solver.state_cache.reseeds")
+        batches0 = metrics.counter("nomad.heartbeat.invalidate_batches")
+        dead0 = metrics.counter("nomad.broker.dead_letter")
+        t0 = time.time()
+        flipped = s.heartbeats._invalidate_batch(list(doomed))
+        assert flipped == len(doomed)
+
+        def recovered():
+            for job in jobs:
+                live = [a for a in
+                        s.state.allocs_by_job("default", job.id)
+                        if a.desired_status == "run"
+                        and not a.terminal_status()
+                        and a.node_id not in doomed]
+                if len(live) < 6:
+                    return False
+            return True
+
+        assert wait_until(recovered, timeout=30), \
+            "replacements never fully landed on the survivors"
+        recovery_s = time.time() - t0
+        assert recovery_s < 30
+        assert metrics.counter("nomad.heartbeat.invalidate_batches") \
+            == batches0 + 1
+        assert metrics.counter("nomad.solver.state_cache.reseeds") \
+            == reseeds0, "the storm evicted the device state cache"
+        assert metrics.counter("nomad.broker.dead_letter") == dead0, \
+            "lost-alloc replacement work dead-lettered"
+    finally:
+        s.shutdown()
+
+
+@pytest.mark.chaos
+def test_storm_batch_replicates_and_holds_survive_failover():
+    """Virtual 3-server cluster: the batched down-entry replicates to
+    followers, and a flap hold committed by the old leader is ADOPTED
+    by the new leader's damper after a failover."""
+    from tests.test_raft import make_cluster, shutdown_all, \
+        wait_stable_leader
+    servers = make_cluster(3)
+    try:
+        leader = wait_stable_leader(servers)
+        clock = ManualClock()
+        leader.heartbeats.clock = clock
+        nodes = [mock.node() for _ in range(6)]
+        for n in nodes:
+            leader.node_register(n)
+        leader.heartbeats.initialize_heartbeat_timers(grace=0.0)
+        clock.advance(leader.heartbeats.min_ttl +
+                      leader.heartbeats.ttl_spread + 1.0)
+        leader.heartbeats._sweep(clock.time())
+        followers = [s for s in servers if s is not leader]
+        assert wait_until(lambda: all(
+            all(f.state.node_by_id(n.id) is not None and
+                f.state.node_by_id(n.id).status == NODE_STATUS_DOWN
+                for n in nodes) for f in followers), timeout=10), \
+            "the batched down-entry never replicated"
+        # a flap hold rides raft: the new leader adopts it at establish
+        hold_until = time.time() + 3600.0
+        leader.raft.apply(NODE_UPDATE_ELIGIBILITY, {
+            "node_id": nodes[0].id,
+            "eligibility": NODE_SCHED_INELIGIBLE,
+            "flap_until": hold_until})
+        leader.shutdown()
+        new_leader = wait_stable_leader(followers)
+        assert wait_until(lambda: new_leader.flap_damper.held(nodes[0].id),
+                          timeout=10), \
+            "the new leader never adopted the replicated flap hold"
+    finally:
+        shutdown_all(servers)
