@@ -1416,6 +1416,44 @@ def main() -> None:
         for i in (1, 3, 5))
     tracing_overhead_frac = round(overheads[1], 4)
     evals_per_sec_untraced = (legs[0] + legs[2] + legs[4] + legs[6]) / 4.0
+
+    # ---- explain overhead (ISSUE 11): the SAME interleaved-sandwich
+    # method as tracing above — off/on/off/on/off/on/off half-length
+    # legs, each on-leg judged against the mean of its bracketing
+    # off-legs, the MEDIAN per-leg overhead reported — bounding the
+    # attribution byproduct (per-solve fixed-shape reduce + stage-mask
+    # bookkeeping) at <=2% of stream throughput once recorded
+    # (tests/test_bench_regression.py::test_explain_overhead_gate).
+    from nomad_tpu.solver import explain as solver_explain
+
+    # phase DELTAS for records AND errors (the PR-10 node_storm lesson:
+    # absolute process-lifetime counters let earlier same-process bench
+    # phases contaminate the lineage the gate asserts on)
+    ex_records_base = metrics.counter("nomad.solver.explain.records")
+    ex_errors_base = metrics.counter("nomad.solver.explain.errors")
+
+    def _explain_leg(on: bool) -> float:
+        solver_explain.configure(enabled=on)
+        fsm_e = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=11)
+        t0 = time.perf_counter()
+        _stream_run(fsm_e, leg_evals, STREAM_CONCURRENCY)
+        return leg_evals / (time.perf_counter() - t0)
+
+    ex_legs = [_explain_leg(on=bool(i % 2)) for i in range(7)]
+    solver_explain.configure(enabled=None)     # back to config-driven
+    ex_overheads = sorted(
+        max(0.0, 1.0 - ex_legs[i] / ((ex_legs[i - 1] + ex_legs[i + 1])
+                                     / 2.0))
+        for i in (1, 3, 5))
+    explain_block = {
+        "overhead_frac": round(ex_overheads[1], 4),
+        "evals_per_sec_explain_off": round(
+            (ex_legs[0] + ex_legs[2] + ex_legs[4] + ex_legs[6]) / 4.0, 2),
+        "records": int(metrics.counter("nomad.solver.explain.records")
+                       - ex_records_base),
+        "errors": int(metrics.counter("nomad.solver.explain.errors")
+                      - ex_errors_base),
+    }
     if platform == "tpu" and STREAM_CONCURRENCY >= 4:
         # the eval stream must be served by coalesced device dispatches
         # (the batch tier), not host-only — a few solo host solves at the
@@ -1537,6 +1575,7 @@ def main() -> None:
         "evals_per_sec_1k_stream_untraced": round(
             evals_per_sec_untraced, 2),
         "tracing_overhead_frac": tracing_overhead_frac,
+        "explain": explain_block,
         # ISSUE 8: overload/goodput lineage (10x burst, bounded broker,
         # deadline enforcement, pressure transitions, recovery)
         "overload": overload,

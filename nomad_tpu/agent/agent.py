@@ -260,7 +260,13 @@ class Agent:
 
     def stats(self) -> dict:
         from ..metrics import metrics
-        out = {"telemetry": metrics.snapshot()}
+        from ..obs import devruntime
+        # re-sample the device-runtime gauges per scrape (pull-driven —
+        # memory watermarks/live buffers land in the snapshot below, the
+        # device+mesh rows ride alongside for the UI Metrics page)
+        device_runtime = devruntime.snapshot()
+        out = {"telemetry": metrics.snapshot(),
+               "device_runtime": device_runtime}
         if self.server is not None:
             out["broker"] = dict(self.server.eval_broker.stats)
             out["blocked_evals"] = dict(self.server.blocked_evals.stats)

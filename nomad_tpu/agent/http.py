@@ -541,6 +541,14 @@ class HTTPAPI:
         if parts == ["operator", "autopilot", "health"]:
             require(acl.allow_operator_read())
             return s.operator_server_health(), None
+        if parts == ["operator", "debug"] and method == "GET":
+            # one-shot debug bundle (ISSUE 11): metrics + traces +
+            # pressure/broker/state-cache/breaker internals + recent
+            # placement-explain records + device-runtime telemetry.
+            # Served LOCALLY by any server (each server's internals are
+            # its own) — `operator debug` captures it into the archive.
+            require(acl.allow_operator_read())
+            return s.operator_debug_bundle(), None
         if parts == ["operator", "snapshot"]:
             # management-only BOTH ways: the snapshot embeds every ACL token
             # secret, and restore deserializes arbitrary bytes

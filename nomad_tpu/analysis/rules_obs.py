@@ -1,5 +1,6 @@
-"""OBS001 — telemetry hygiene: bounded metric-name cardinality and
-no discarded measurement contexts.
+"""OBS001/OBS002 — telemetry hygiene: bounded metric-name cardinality,
+no discarded measurement contexts, and no silently-dropped rejected
+placements.
 
 Two anti-patterns this PR's observability work (ISSUE 7) makes load-
 bearing to avoid:
@@ -159,3 +160,98 @@ class TelemetryHygiene(Rule):
                 f"measurement/span is silently never recorded; wrap the "
                 f"timed block in `with ...{sink}(...):`")]
         return []
+
+
+# ---------------------------------------------------------------- OBS002
+
+# loop-iterable / loop-target markers identifying a walk over placement
+# units (the reconciler's AllocPlaceResult / destructive-update shapes)
+_PLACEMENT_ITER_MARKERS = ("missings", "leftovers", "destructive",
+                           "unplaced")
+_PLACEMENT_TARGETS = ("missing",)
+
+# evidence that the enclosing function attaches (or hands off to
+# something that attaches) an AllocMetric for rejected work
+_ATTACH_ATTRS = ("failed_tg_allocs",)
+_ATTACH_CALLS = ("filter_node", "exhausted_node", "fallback",
+                 "failed_metric", "explain", "preempt")
+_ATTACH_KWARGS = ("metrics",)
+
+
+@register
+class RejectionAttribution(Rule):
+    id = "OBS002"
+    severity = "error"
+    short = ("a scheduler/solver code path walks placement units and can "
+             "drop a rejected task without attaching an AllocMetric "
+             "(no failed_tg_allocs/metrics write or attributed handoff "
+             "in the enclosing function)")
+    # the two layers that own placement verdicts; everything else
+    # receives AllocMetric objects, it doesn't mint them
+    path_markers = ("/scheduler/", "/solver/")
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.For):
+                continue
+            if not self._is_placement_walk(node):
+                continue
+            fn = self._enclosing_function(mod, node)
+            if fn is None:
+                continue
+            if self._drops(node) and not self._attaches(fn):
+                out.append(mod.finding(
+                    self, node,
+                    "placement-unit loop can drop a rejected task with "
+                    "no AllocMetric attribution in the enclosing "
+                    "function — a rejection the operator can never "
+                    "explain; write failed_tg_allocs / ctx.metrics (or "
+                    "hand off to a fallback/explain path) before "
+                    "dropping, or disable with justification"))
+        return out
+
+    @staticmethod
+    def _enclosing_function(mod: SourceModule, node: ast.AST):
+        for anc in mod.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    @staticmethod
+    def _is_placement_walk(loop: ast.For) -> bool:
+        if isinstance(loop.target, ast.Name) and \
+                loop.target.id in _PLACEMENT_TARGETS:
+            return True
+        try:
+            it = ast.unparse(loop.iter).lower()
+        except Exception:   # noqa: BLE001 — unparse best-effort
+            return False
+        return any(m in it for m in _PLACEMENT_ITER_MARKERS)
+
+    @staticmethod
+    def _drops(loop: ast.For) -> bool:
+        """A unit can leave the loop unplaced: a `continue`, or a bare
+        `break` before the collection is exhausted."""
+        for sub in ast.walk(loop):
+            if isinstance(sub, (ast.Continue, ast.Break)):
+                return True
+        return False
+
+    @staticmethod
+    def _attaches(fn: ast.AST) -> bool:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in _ATTACH_ATTRS:
+                return True
+            if isinstance(sub, ast.Call):
+                try:
+                    d = ast.unparse(sub.func).lower()
+                except Exception:   # noqa: BLE001
+                    d = ""
+                if any(m in d for m in _ATTACH_CALLS):
+                    return True
+                for kw in sub.keywords:
+                    if kw.arg in _ATTACH_KWARGS:
+                        return True
+        return False

@@ -690,6 +690,13 @@ def cmd_eval_status(args) -> None:
             print(f"  * Resources exhausted on {n} node(s): {dim}")
         for klass, n in (m.get("ClassExhausted") or {}).items():
             print(f"  * Class {klass!r} exhausted on {n} node(s)")
+        # tensor-path explain (ISSUE 11): winning-row score metadata the
+        # device solve attached — who DID win, next to why others lost
+        for sm in (m.get("ScoreMeta") or [])[:5]:
+            nid = (sm.get("node_id") or sm.get("NodeID") or "")[:8]
+            score = sm.get("normalized_score",
+                           sm.get("NormalizedScore", 0.0))
+            print(f"  * Scored node {nid}: {score:.4f}")
     allocs = api("GET", f"/v1/evaluation/{args.eval_id}/allocations")
     if allocs:
         print("\nAllocations")
@@ -924,6 +931,10 @@ def cmd_operator_debug(args) -> None:
         "deployments.json": ("GET", "/v1/deployments"),
         "scheduler-configuration.json":
             ("GET", "/v1/operator/scheduler/configuration"),
+        # the server-side one-shot bundle (ISSUE 11): metrics + recent
+        # traces + pressure/broker/state-cache/breaker stats + recent
+        # placement-explain records + device-runtime telemetry
+        "operator-debug.json": ("GET", "/v1/operator/debug"),
         "autopilot-health.json": ("GET", "/v1/operator/autopilot/health"),
         "raft-configuration.json":
             ("GET", "/v1/operator/raft/configuration"),
@@ -933,6 +944,7 @@ def cmd_operator_debug(args) -> None:
     }
     raw_captures = {
         "pprof-goroutine.txt": "/v1/agent/pprof/goroutine",
+        "metrics.prom": "/v1/metrics?format=prometheus",
     }
     tmp = tempfile.mkdtemp(prefix="nomad-debug-")
     manifest = {"CapturedAt": _time.strftime("%Y-%m-%dT%H:%M:%SZ",

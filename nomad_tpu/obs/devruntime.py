@@ -1,0 +1,148 @@
+"""Device-runtime telemetry (ISSUE 11): what the accelerator runtime
+itself is doing, surfaced next to the scheduler's own counters.
+
+Three families, all bounded-cardinality:
+
+  * per-device memory/buffer gauges from `jax.local_devices()`:
+    `nomad.device.{mem_bytes_in_use,mem_peak_bytes,live_buffers}.d<N>`
+    (ordinal-suffixed — the device count is a fixed property of the
+    process, not an unbounded dimension);
+  * compile-cache counters `nomad.compile_cache.{hits,misses}` fed by a
+    jax monitoring listener (persistent compilation cache events) —
+    zero when the running jax exposes no such events;
+  * the mesh/shard layout snapshot (`sharding.mesh()`), so a debug
+    bundle shows exactly how the node axis was partitioned when the
+    capture ran.
+
+Everything here is best-effort and exception-proof: telemetry must never
+take down a scheduler, and the jax internals it reads vary across
+versions. `install()` is idempotent; `refresh_gauges()` is called on
+every /v1/metrics scrape and debug-bundle capture (pull-driven — no
+background thread)."""
+from __future__ import annotations
+
+import threading
+
+from ..metrics import metrics
+
+_lock = threading.Lock()
+_installed = False
+
+# monitoring-event substrings -> our counter. jax records
+# '/jax/compilation_cache/cache_hits' (and _misses) when the persistent
+# compile cache is enabled; tolerate renames by substring match.
+_EVENT_COUNTERS = (
+    ("cache_hit", "nomad.compile_cache.hits"),
+    ("cache_miss", "nomad.compile_cache.misses"),
+)
+
+
+def install() -> None:
+    """Register the compile-cache monitoring listener (idempotent)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    # the counters must exist even when no event ever fires, so the
+    # prometheus exposition and the UI metrics page always carry them
+    metrics.incr("nomad.compile_cache.hits", 0)
+    metrics.incr("nomad.compile_cache.misses", 0)
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            if "compilation_cache" not in event:
+                return
+            for needle, counter in _EVENT_COUNTERS:
+                if needle in event:
+                    metrics.incr(counter)
+                    return
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:       # noqa: BLE001 — telemetry is best-effort
+        pass
+
+
+def _device_rows() -> list[dict]:
+    import jax
+    rows = []
+    live_by_device: dict = {}
+    try:
+        for arr in jax.live_arrays():
+            for d in arr.devices():
+                live_by_device[d.id] = live_by_device.get(d.id, 0) + 1
+    except Exception:       # noqa: BLE001 — internal API drift
+        live_by_device = {}
+    for dev in jax.local_devices():
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:   # noqa: BLE001 — CPU backends have none
+            stats = {}
+        rows.append({
+            "id": dev.id,
+            "platform": dev.platform,
+            "kind": getattr(dev, "device_kind", ""),
+            "process_index": getattr(dev, "process_index", 0),
+            "mem_bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "mem_peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+            "mem_limit_bytes": int(stats.get("bytes_limit", 0)),
+            "live_buffers": int(live_by_device.get(dev.id, 0)),
+        })
+    return rows
+
+
+def _mesh_layout() -> dict:
+    try:
+        from ..solver import sharding
+        m = sharding.mesh()
+        if m is None:
+            return {"sharded": False, "devices": 1}
+        return {"sharded": True,
+                "axis_names": list(m.axis_names),
+                "shape": {k: int(v) for k, v in m.shape.items()},
+                "devices": int(len(m.devices.flat)),
+                "device_ids": [int(d.id) for d in m.devices.flat]}
+    except Exception:       # noqa: BLE001
+        return {"sharded": False, "devices": 0}
+
+
+def refresh_gauges() -> list[dict]:
+    """Re-sample the per-device gauges into the registry and return the
+    rows. Called per scrape/capture — no background cadence to tune."""
+    install()
+    try:
+        rows = _device_rows()
+    except Exception:       # noqa: BLE001 — no jax, no gauges
+        return []
+    for row in rows:
+        # the per-device suffix is a bounded dimension: device ordinals
+        # are a fixed property of the process, not cluster entities
+        suffix = f"d{row['id']}"
+        # nomadlint: disable=OBS001 — bounded per-device ordinal suffix
+        metrics.set_gauge(f"nomad.device.mem_bytes_in_use.{suffix}",
+                          row["mem_bytes_in_use"])
+        # nomadlint: disable=OBS001 — bounded per-device ordinal suffix
+        metrics.set_gauge(f"nomad.device.mem_peak_bytes.{suffix}",
+                          row["mem_peak_bytes"])
+        # nomadlint: disable=OBS001 — bounded per-device ordinal suffix
+        metrics.set_gauge(f"nomad.device.live_buffers.{suffix}",
+                          row["live_buffers"])
+    return rows
+
+
+def snapshot() -> dict:
+    """The debug-bundle block: devices + mesh layout + compile-cache
+    counters + the solver's compile-cache configuration."""
+    import os
+    rows = refresh_gauges()
+    return {
+        "devices": rows,
+        "mesh": _mesh_layout(),
+        "compile_cache": {
+            "hits": int(metrics.counter("nomad.compile_cache.hits")),
+            "misses": int(metrics.counter("nomad.compile_cache.misses")),
+            "persistent_dir": os.environ.get("NOMAD_COMPILE_CACHE", ""),
+        },
+    }

@@ -64,6 +64,10 @@ class GenericScheduler:
         self.deployment = None
         self.blocked: Optional[Evaluation] = None
         self.failed_tg_allocs: dict[str, object] = {}
+        # per-TG explain records from the tensor solve (ISSUE 11): the
+        # placer registers one per solved task group so a failed
+        # placement attaches the device-derived AllocMetric
+        self.solver_explains: dict[str, object] = {}
         self.queued_allocs: dict[str, int] = {}
         self.followup_evals: dict[str, list[Evaluation]] = {}
         # set by the pipelined placer when an intermediate chunk plan
@@ -116,6 +120,7 @@ class GenericScheduler:
 
         self._pipeline_partial = False
         self.failed_tg_allocs = {}
+        self.solver_explains = {}
         self.queued_allocs = {tg.name: 0 for tg in
                               (self.job.task_groups if self.job else [])}
         self.plan = eval.make_plan(self.job)

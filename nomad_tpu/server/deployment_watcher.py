@@ -19,6 +19,7 @@ from ..structs import (
     DEPLOYMENT_STATUS_SUCCESSFUL, EVAL_STATUS_PENDING,
     TRIGGER_DEPLOYMENT_WATCHER, TRIGGER_ROLLING_UPDATE,
 )
+from .lifecycle import LoopHandle
 from .fsm import (
     DEPLOYMENT_ALLOC_HEALTH, DEPLOYMENT_PROMOTE, DEPLOYMENT_STATUS_UPDATE,
     EVAL_UPDATE, JOB_REGISTER,
@@ -41,26 +42,22 @@ class DeploymentWatcher:
         # "the deployment made no progress for progress_deadline_sec" is
         # testable with ManualClock.advance() instead of real sleeps
         self.clock = clock or chrono.REAL
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        # explicit start/join lifecycle state (server/lifecycle.py): the
+        # handle owns the stop event so set+join and clear+spawn are
+        # atomic pairs (a leadership re-acquire can no longer clear the
+        # event out from under a mid-join stop and leak a second watcher)
+        self._loop = LoopHandle()
+        self._stop = self._loop.stop_event
         # deployment_id -> alloc_id -> last folded verdict; a changed verdict
         # (healthy flipping to unhealthy) must be re-processed
         self._seen_health: dict[str, dict[str, bool]] = {}
         self._progress_by: dict[str, float] = {}
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="deployment-watcher")
-        self._thread.start()
+        self._loop.start(self._run, "deployment-watcher")
 
     def stop(self) -> None:
-        self._stop.set()
-        # join so a quick leadership re-acquire can't clear the stop event
-        # before this loop observes it (would leak a second watcher)
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        self._loop.stop(timeout=5.0)
 
     def _run(self) -> None:
         """ref deployments_watcher.go:164 watchDeployments"""

@@ -14,6 +14,7 @@ from ..structs import (
     TRIGGER_NODE_DRAIN,
 )
 from .fsm import ALLOC_UPDATE_DESIRED_TRANSITION, NODE_UPDATE_DRAIN
+from .lifecycle import LoopHandle
 
 
 class NodeDrainer:
@@ -25,21 +26,16 @@ class NodeDrainer:
         # ManualClock test advances virtual time past the force deadline
         # instead of sleeping it out; the poll cadence stays real
         self.clock = clock or chrono.REAL
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        # explicit start/join lifecycle state (server/lifecycle.py):
+        # see deployment_watcher — the handle owns the stop event
+        self._loop = LoopHandle()
+        self._stop = self._loop.stop_event
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="node-drainer")
-        self._thread.start()
+        self._loop.start(self._run, "node-drainer")
 
     def stop(self) -> None:
-        self._stop.set()
-        # join: see deployment_watcher.stop (stop/start flap race)
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        self._loop.stop(timeout=5.0)
 
     def track_node(self, node_id: str) -> None:
         """Hook for UpdateDrain; polling picks it up on the next tick."""

@@ -51,6 +51,7 @@ from ..structs import (
     Evaluation, NODE_STATUS_DOWN, TRIGGER_NODE_UPDATE, JOB_TYPE_SYSTEM,
 )
 from .fsm import BATCH_NODE_UPDATE_STATUS
+from .lifecycle import LoopHandle
 
 DEFAULT_MIN_TTL = 10.0
 DEFAULT_TTL_SPREAD = 5.0
@@ -83,21 +84,20 @@ class HeartbeatTimers:
         self._rng = random.Random(0x6e6f6d61 if seed is None else seed)
         self._lock = threading.Lock()
         self._deadlines: dict[str, float] = {}
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        # explicit start/join lifecycle state (server/lifecycle.py): the
+        # recovery barrier start()s the reaper on the election-callback
+        # thread while shutdown/revoke stop() it from another — the old
+        # bare-Thread pattern could join a not-yet-started thread, and a
+        # racing restart could clear the stop event out from under a
+        # mid-join stop(). The handle owns both the event and the thread.
+        self._loop = LoopHandle()
+        self._stop = self._loop.stop_event
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="heartbeat-reaper")
-        self._thread.start()
+        self._loop.start(self._run, "heartbeat-reaper")
 
     def stop(self) -> None:
-        self._stop.set()
-        # join: see deployment_watcher.stop (stop/start flap race)
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        self._loop.stop(timeout=5.0)
 
     def _ttl(self) -> float:
         return self.min_ttl + self._rng.random() * self.ttl_spread

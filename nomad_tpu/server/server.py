@@ -2187,6 +2187,56 @@ class Server:
             "Broker": dict(self.eval_broker.stats),
         }
 
+    def operator_debug_bundle(self) -> dict:
+        """GET /v1/operator/debug (ISSUE 11): one self-contained snapshot
+        of everything an operator needs to explain THIS server's behavior
+        after the fact — metrics, recent traces, pressure/broker/state-
+        cache/breaker internals, the latest placement-explain records and
+        the device-runtime telemetry — the server-side block `nomad-tpu
+        operator debug` folds into its timestamped archive
+        (docs/OBSERVABILITY.md lists the format). Read-only and local:
+        every block samples in-process state, no raft round."""
+        faults.fire("operator.debug")
+        from ..api_codec import to_api
+        from ..obs import devruntime
+        from ..obs import trace as obs_trace
+        from ..solver import backend as solver_backend
+        from ..solver import explain as solver_explain
+        from ..solver import state_cache
+        # spec wall clock: capture timestamps are observability data
+        # nomadlint: disable=DET001 — capture timestamp, not a decision
+        captured = time.time()
+        breaker = solver_backend.breaker()
+        tiers = ("sharded", "pallas", "batch", "xla", "host")
+        raft_block: dict = {"Enabled": self.raft_node is not None}
+        if self.raft_node is not None:
+            raft_block.update({
+                "Term": self.raft_node.current_term,
+                "CommitIndex": self.raft_node.commit_index,
+                "LastApplied": self.raft_node.last_applied,
+                "State": self.raft_node.state,
+                "Health": self.raft_node.server_health(),
+            })
+        return {
+            "Meta": {
+                "Name": self.name,
+                "Leader": self.is_leader,
+                "CapturedUnix": round(captured, 3),
+                "EstablishTimings": dict(self._establish_timings),
+            },
+            "Status": self.status_summary(),
+            "Metrics": metrics.snapshot(),
+            "DeviceRuntime": devruntime.snapshot(),
+            "Traces": {"Stats": obs_trace.stats(),
+                       "Recent": obs_trace.traces(50)},
+            "Explains": solver_explain.recent(64),
+            "StateCache": state_cache.cache().stats(),
+            "Breakers": {t: breaker.state(t) for t in tiers},
+            "BlockedEvals": dict(self.blocked_evals.stats),
+            "SchedulerConfig": to_api(self.state.get_scheduler_config()),
+            "Raft": raft_block,
+        }
+
     def run_gc(self) -> None:
         """Force a full GC pass (the `nomad system gc` analog)."""
         self.core_scheduler.process(Evaluation(

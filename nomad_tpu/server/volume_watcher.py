@@ -27,6 +27,7 @@ from ..structs.csi import (
     CLAIM_STATE_NODE_DETACHED, CLAIM_STATE_READY_TO_FREE,
     CLAIM_STATE_TAKEN,
 )
+from .lifecycle import LoopHandle
 
 
 class VolumeWatcher:
@@ -35,22 +36,16 @@ class VolumeWatcher:
     def __init__(self, server, interval: float = 5.0):
         self.server = server
         self.interval = interval
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        # explicit start/join lifecycle state (server/lifecycle.py):
+        # see deployment_watcher — the handle owns the stop event
+        self._loop = LoopHandle()
+        self._stop = self._loop.stop_event
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="volume-watcher")
-        self._thread.start()
+        self._loop.start(self._run, "volume-watcher")
 
     def stop(self) -> None:
-        self._stop.set()
-        # join before a leadership re-acquire clears the stop event, else
-        # the old loop never observes it and two watchers run
-        if self._thread is not None:
-            self._thread.join(timeout=self.interval + 5.0)
-            self._thread = None
+        self._loop.stop(timeout=self.interval + 5.0)
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):

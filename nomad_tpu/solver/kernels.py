@@ -497,6 +497,78 @@ def place_chunked(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
     return placed, final_used, pcounts, drem
 
 
+def _explain_reduce_impl(cap: jnp.ndarray, used: jnp.ndarray,
+                         ask: jnp.ndarray, feasible: jnp.ndarray,
+                         collisions: jnp.ndarray, placed: jnp.ndarray,
+                         class_ids: jnp.ndarray, distinct_hosts,
+                         n_classes: int = 2) -> tuple:
+    """Elimination attribution as a byproduct of the solve (ISSUE 11):
+    the per-stage mask reductions the placement kernels already compute,
+    kept as a small fixed-shape output instead of discarded.
+
+    Evaluated at POST-solve usage (used + placed ⊗ ask) — the state a
+    host iterator-stack re-walk over the same cluster would see — so a
+    failed placement's counts are bit-consistent with the host oracle
+    (tests/test_explain.py pins this):
+
+      * distinct-hosts: a feasible row whose post-solve same-job
+        collision count is positive is what DistinctHostsIterator
+        filters (feasible.go:505);
+      * exhaustion: a candidate row where one more instance overflows
+        any dimension, attributed to the FIRST failing dimension in
+        extended-resource order — exactly ComparableResources.superset's
+        cpu -> memory -> disk check order (structs/resources.py);
+      * per-node-class histograms via a pre-lowered id column (bounded
+        by distinct classes, not node count).
+
+    Everything lowers to elementwise ops + axis sums — first-failing-dim
+    via a cumsum==1 one-hot and the class histograms via an [N, C]
+    one-hot compare — NOT .at[].add scatters, which XLA:CPU lowers ~10x
+    slower at stream-relevant buckets (the ≤2% overhead contract,
+    docs/OBSERVABILITY.md). Pure reduction: never touches the placement
+    math, so placements are bit-identical with explain on or off. All
+    shapes static per (bucket, n_classes) — one compiled artifact per
+    bucket. (Winning-row score metadata is NOT computed here: the
+    placer derives it host-side from the already-materialized placed
+    rows, a handful of numpy ops over `placed>0` rows only.)
+
+    Returns (counts i32[6] = [feasible, dh_filtered, exhausted, fit,
+    placed_nodes, placed_total], dim_exhausted i32[R'],
+    class_exhausted i32[n_classes], class_dh i32[n_classes])."""
+    placed_i = placed.astype(jnp.int32)
+    post = used + placed_i[:, None].astype(jnp.float32) * ask[None, :]
+    coll_post = collisions + placed_i
+    feas = feasible.astype(bool)
+    dh = feas & distinct_hosts & (coll_post > 0)
+    cand = feas & ~dh
+    over = post + ask[None, :] > cap                  # bool[N, R']
+    exh = cand & jnp.any(over, axis=1)
+    # first failing dim as a one-hot: the first True column is where the
+    # running count of Trues reaches exactly 1
+    first = over & (jnp.cumsum(over.astype(jnp.int32), axis=1) == 1)
+    dim_exh = jnp.sum(first & exh[:, None], axis=0).astype(jnp.int32)
+    # [N, C] one-hot class compare; class_ids == -1 (no class / padding)
+    # matches no column
+    cls_onehot = class_ids[:, None] == jnp.arange(n_classes)[None, :]
+    class_exh = jnp.sum(cls_onehot & exh[:, None], axis=0
+                        ).astype(jnp.int32)
+    class_dh = jnp.sum(cls_onehot & dh[:, None], axis=0).astype(jnp.int32)
+    fit = cand & ~exh
+    counts = jnp.stack([
+        jnp.sum(feas), jnp.sum(dh), jnp.sum(exh), jnp.sum(fit),
+        jnp.sum(placed_i > 0), jnp.sum(placed_i)]).astype(jnp.int32)
+    return counts, dim_exh, class_exh, class_dh
+
+
+# solo-tier artifact of the reduce; the sharded tier's psum variant
+# lives in sharding.py (mesh-spec'd) — this bare jit is the single-
+# device floor on uncommitted host inputs, same class as the solo
+# kernel jits baselined above.
+# nomadlint: disable=SHARD001 — solo-tier reduce; sharded twin has specs
+explain_reduce = jax.jit(_explain_reduce_impl,
+                         static_argnames=("n_classes",))
+
+
 @jax.jit
 def preemption_distance(victim_res: jnp.ndarray, ask: jnp.ndarray
                         ) -> jnp.ndarray:

@@ -32,12 +32,12 @@ import jax.numpy as jnp
 
 from ..metrics import metrics
 from ..structs import (
-    AllocatedResources, AllocatedTaskResources, Allocation,
+    AllocatedResources, AllocatedTaskResources, Allocation, AllocMetric,
     AllocDeploymentStatus, NetworkIndex, Plan, new_id, new_ids,
     skeleton_for,
 )
 from ..scheduler.stack import SelectOptions
-from . import backend, microbatch
+from . import backend, explain as explain_mod, microbatch
 from ..obs import trace
 from .buckets import node_bucket, pow2
 from .tensorize import (
@@ -105,7 +105,8 @@ class _SolvePrep:
     the same compiled artifact and regime as the one-shot solve)."""
     __slots__ = ("gt", "n", "count", "use_scan", "use_depth", "k_max",
                  "sp", "dp", "aff", "max_per_node", "spread_alg",
-                 "depth_grid", "jitter", "bias_g", "m", "distincts")
+                 "depth_grid", "jitter", "bias_g", "m", "distincts",
+                 "ex", "ex_ids", "ex_ncls")
 
 
 class SolverPlacer:
@@ -129,6 +130,10 @@ class SolverPlacer:
             enabled=(getattr(cfg, "eval_batch_enabled", True)
                      and os.environ.get("NOMAD_EVAL_BATCH", "") != "0"),
             window_s=getattr(cfg, "eval_batch_window_ms", 8.0) / 1000.0)
+        # hot-reload the explain ring capacity from the same replicated
+        # config (enabled-ness is resolved per solve in _prep_solve)
+        explain_mod.configure(
+            capacity=getattr(cfg, "placement_explain_recent", 256))
         microbatch.eval_started()
         try:
             return self._compute_placements(destructive, place)
@@ -270,8 +275,38 @@ class SolverPlacer:
         nodes = [nodes[i] for i in perm]
 
         feasible_fn = self._feasibility_fn(tg)
-        gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn,
-                                 count=count)
+        # explain attribution (ISSUE 11): the irregular host walk runs
+        # against a SCRATCH AllocMetric so the checker objects' concrete
+        # filter reasons (plus the class-cached repeats _feasibility_fn
+        # records) become stage-1 of the elimination attribution instead
+        # of vanishing into the eval-wide metric. The swap changes no
+        # placement input — feasibility verdicts are identical either way.
+        ex_rec = None
+        if explain_mod.enabled(self.ctx.scheduler_config):
+            ex_rec = explain_mod.ExplainRecord(
+                self.sched.eval.id, self.sched.eval.job_id, tg.name)
+            ex_rec.nodes_total = len(nodes)
+            scratch = AllocMetric()
+            # marks the tensorize walk for _feasibility_fn: cached-class
+            # fast-path rejections record their FeasibilityWrapper-style
+            # reason ONLY into this scratch, never into the live metric
+            scratch.explain_walk = True
+            saved = self.ctx.metrics
+            self.ctx.metrics = scratch
+            try:
+                gt = build_group_tensors(self.ctx, job, tg, nodes,
+                                         feasible_fn, count=count,
+                                         explain=True)
+            finally:
+                self.ctx.metrics = saved
+            ex_rec.irregular = scratch
+            st = gt.ex_stages or {}
+            ex_rec.elig_filtered = st.get("elig_filtered", 0)
+            ex_rec.dh_pre = st.get("dh_pre", 0)
+            ex_rec.dh_pre_classes = st.get("dh_pre_classes", {})
+        else:
+            gt = build_group_tensors(self.ctx, job, tg, nodes, feasible_fn,
+                                     count=count)
         spreads = list(tg.spreads) + list(job.spreads)
         affinities = list(job.affinities) + list(tg.affinities)
         for t in tg.tasks:
@@ -342,6 +377,26 @@ class SolverPlacer:
         prep.n = n
         prep.count = count
         prep.distincts = distincts
+        prep.ex = ex_rec
+        prep.ex_ids = None
+        prep.ex_ncls = 0
+        if ex_rec is not None:
+            # node-class id column for the device histogram, padded to
+            # the same bucket as every other solve input (padding = -1).
+            # The dense path gathered it vectorized from the usage
+            # index's class column; the object-walk fallback lowers it
+            # per node here (small test clusters only).
+            bucket = gt.cap.shape[0]
+            st = gt.ex_stages or {}
+            ids = st.get("class_ids")
+            if ids is not None:
+                ex_rec.classes = st.get("class_names", [])
+                prep.ex_ids = np.full(bucket, -1, np.int32)
+                prep.ex_ids[:len(ids)] = ids
+            else:
+                prep.ex_ids, ex_rec.classes = explain_mod.class_ids_for(
+                    gt.nodes, bucket)
+            prep.ex_ncls = explain_mod.class_pad(len(ex_rec.classes))
         prep.use_scan = use_scan
         prep.use_depth = use_depth
         prep.k_max = k_max
@@ -531,8 +586,26 @@ class SolverPlacer:
                 placed = greedy(*(dev + g_args[2:]), host_args=g_args)
             else:
                 placed = greedy(*g_args)
-        placed = np.asarray(placed)[:n]     # the single device_get
-        if use_scan and distincts:
+        ex_out = None
+        # the distinct_property trim below mutates `placed` host-side —
+        # attribution must describe the TRIMMED (committed) placements,
+        # so the early device enqueue is skipped on that path
+        trim_pending = use_scan and bool(distincts)
+        if prep.ex is not None and not trim_pending and \
+                explain_mod.wants_device_reduce(placed):
+            prep.ex.tier = bname
+            try:
+                # enqueued BEHIND the in-flight solve on its device;
+                # materialized at the same point the placement vector is
+                # (below) — no extra synchronization point
+                # (docs/OBSERVABILITY.md)
+                ex_out = explain_mod.dispatch_reduce(
+                    gt, placed, prep.ex_ids, prep.ex_ncls)
+            except Exception:       # noqa: BLE001 — never fail the solve
+                metrics.incr("nomad.solver.explain.errors")
+        placed_h = np.asarray(placed)       # the single device_get
+        placed = placed_h[:n]
+        if trim_pending:
             # chunk > 1 places several instances per scan step, which can
             # overshoot a distinct_property value quota within one step —
             # re-walk the counts host-side and trim the surplus (trimmed
@@ -556,7 +629,37 @@ class SolverPlacer:
                     if vid >= 0:
                         remaining[d][vid] -= allowed
                 placed[i] = allowed
+            placed_h = np.pad(placed, (0, placed_h.shape[0] - n))
+        if prep.ex is not None:
+            prep.ex.tier = bname
+            prep.ex.kernel = ("chunked" if use_scan
+                              else "depth" if use_depth else "greedy")
+            try:
+                import jax
+                with metrics.measure("nomad.solver.explain.seconds"):
+                    if ex_out is None:
+                        # host-resident (or post-trim) result: the numpy
+                        # twin, same bits
+                        ex_out = explain_mod.dispatch_reduce(
+                            gt, placed_h, prep.ex_ids, prep.ex_ncls)
+                    prep.ex.absorb_reduce(jax.device_get(ex_out), gt,
+                                          placed)
+            except Exception:       # noqa: BLE001 — never fail the solve
+                metrics.incr("nomad.solver.explain.errors")
+            self._register_explain(tg, prep.ex)
         return self._placed_node_iter(gt.nodes, placed)
+
+    def _register_explain(self, tg, rec) -> None:
+        """Retain the solve's explain record where its consumers find
+        it: keyed per task group on the owning scheduler (a host-
+        fallback failure attaches rec.failed_metric instead of an
+        O(N)-walk artifact) and in the process-wide ring the operator
+        debug bundle ships."""
+        ex_map = getattr(self.sched, "solver_explains", None)
+        if ex_map is None:
+            ex_map = self.sched.solver_explains = {}
+        ex_map[tg.name] = rec
+        explain_mod.note(rec)
 
     @staticmethod
     def _placed_node_iter(nodes, placed: np.ndarray) -> list:
@@ -801,6 +904,24 @@ class SolverPlacer:
             # and retries the remainder — the serial path's partial-
             # commit semantics, applied per chunk
             sched._pipeline_partial = True
+        if prep.ex is not None:
+            # pipelined attribution: the reduce runs over the SUMMED
+            # chunk placements (all chunks are materialized by now — the
+            # pendings wait above is the pipeline's own sync point), so
+            # the record describes the whole eval's post-solve state
+            try:
+                total = np.asarray(chunk_done[0]).astype(np.int32)
+                for c in chunk_done[1:]:
+                    total = total + np.asarray(c).astype(np.int32)
+                prep.ex.tier = chunk_tiers[-1] if chunk_tiers else bname
+                prep.ex.kernel = "depth"
+                out = explain_mod.dispatch_reduce(
+                    prep.gt, total, prep.ex_ids, prep.ex_ncls)
+                import jax
+                prep.ex.absorb_reduce(jax.device_get(out), prep.gt, total)
+            except Exception:       # noqa: BLE001 — never fail the eval
+                metrics.incr("nomad.solver.explain.errors")
+            self._register_explain(tg, prep.ex)
         return mi, prep
 
     def _pipeline_degrade(self, prep, chunk_done):
@@ -865,10 +986,23 @@ class SolverPlacer:
             EVAL_COMPUTED_CLASS_ELIGIBLE, EVAL_COMPUTED_CLASS_INELIGIBLE,
             EVAL_COMPUTED_CLASS_UNKNOWN)
 
+        ctx = self.ctx
+
         def feasible(node) -> bool:
             klass = node.computed_class
+            # cached-ineligible fast paths count "computed class
+            # ineligible" exactly like the host FeasibilityWrapper
+            # (feasible.go FilterNode) — but ONLY into the explain
+            # scratch metric the tensorize walk runs against: later
+            # re-walks over the same closure (the preemption pass's
+            # candidate filter) must not double-count into the live
+            # eval-wide metric the host path never touched this way
+            record = getattr(ctx.metrics, "explain_walk", False)
             st = elig.job_status(klass)
             if st == EVAL_COMPUTED_CLASS_INELIGIBLE:
+                if record:
+                    ctx.metrics.filter_node(node,
+                                            "computed class ineligible")
                 return False
             if st != EVAL_COMPUTED_CLASS_ELIGIBLE:
                 ok = all(c.feasible(node) for c in job_checks)
@@ -878,6 +1012,9 @@ class SolverPlacer:
                     return False
             st = elig.task_group_status(tg.name, klass)
             if st == EVAL_COMPUTED_CLASS_INELIGIBLE:
+                if record:
+                    ctx.metrics.filter_node(node,
+                                            "computed class ineligible")
                 return False
             if st != EVAL_COMPUTED_CLASS_ELIGIBLE:
                 ok = all(c.feasible(node) for c in tg_checks)
@@ -997,6 +1134,14 @@ class SolverPlacer:
                     self.plan.append_preempted_alloc(victim, sched.eval.id)
             else:
                 remaining.insert(0, missing)
+        rec = getattr(sched, "solver_explains", {}).get(tg.name)
+        if rec is not None:
+            # preemption candidacy (explain stage 5): how many candidate
+            # nodes the victim scan considered, how many produced a
+            # viable victim set, and how many placements it rescued
+            rec.preempt_candidates = c
+            rec.preempt_with_victims = int(masks.any(axis=1).sum())
+            rec.preempt_placed = len(missings) - len(remaining)
         return remaining
 
     def _preempt_masks(self, victim_res, victim_prio, ask, free,
@@ -1088,6 +1233,13 @@ class SolverPlacer:
         # replaced; the XR-row cache on it computes once per group)
         total = skeleton_for(self._skel, tg, oversub).shared_total
         metrics_obj = self.ctx.metrics.copy()
+        rec = getattr(sched, "solver_explains", {}).get(tg.name)
+        if rec is not None:
+            # `alloc status` explainability: the walk's filter counts
+            # plus the winning rows' score metadata from the device
+            # solve ride the shared metrics object every stamped alloc
+            # points at (ISSUE 11)
+            rec.enrich_placed_metric(metrics_obj)
         shared = {"namespace": sched.eval.namespace,
                   "eval_id": sched.eval.id,
                   "job_id": sched.eval.job_id, "job": self.plan.job,
@@ -1266,6 +1418,22 @@ class SolverPlacer:
         self.plan.append_alloc(alloc, None)
         return True
 
+    def _failed_metric(self, tg) -> AllocMetric:
+        """The AllocMetric a failed placement reports (ISSUE 11). When
+        the tensor solve explained this task group, materialize ITS
+        attribution — the on-device byproduct, pinned bit-consistent
+        with a fresh host iterator-stack walk in tests/test_explain.py —
+        instead of whatever the fallback stack's last reset-and-re-walk
+        left in ctx.metrics. TGs that never reached the tensor solve
+        (reschedules, canaries) keep the stack's own metric."""
+        rec = getattr(self.sched, "solver_explains", {}).get(tg.name)
+        if rec is not None:
+            if not rec.rejected:
+                rec.rejected = True
+                metrics.incr("nomad.solver.explain.rejections")
+            return rec.failed_metric(dict(self.sched._nodes_by_dc))
+        return self.sched.ctx.metrics.copy()
+
     def _fallback(self, leftovers, deployment_id: str) -> bool:
         """Per-alloc stack selection for what batching couldn't handle."""
         from ..scheduler.reconcile import AllocPlaceResult
@@ -1295,7 +1463,7 @@ class SolverPlacer:
                     self.plan.pop_update(prev)
                     sched.queued_allocs[tg.name] = \
                         sched.queued_allocs.get(tg.name, 0) - 1
-                sched.failed_tg_allocs[tg.name] = sched.ctx.metrics.copy()
+                sched.failed_tg_allocs[tg.name] = self._failed_metric(tg)
                 continue
             sched._handle_preemptions(option)
             # the stack's ranked task_resources genuinely vary per option

@@ -216,6 +216,42 @@ def sharded_spread_counts(m: Mesh, n_props: int, axis: str = NODE_AXIS):
     return _serialize_launches(jax.jit(run))
 
 
+# (mesh, n_classes, k) -> compiled sharded explain reduce. Memoized on
+# the Mesh OBJECT (like the placer's preempt wrapper): a device-set
+# change invalidates the entry instead of shape-mismatching forever.
+_explain_cache: dict = {}
+
+
+def sharded_explain_reduce(m: Mesh, n_classes: int, axis: str = NODE_AXIS):
+    """The explain reduce (kernels._explain_reduce_impl) with the node
+    axis sharded over the mesh: per-shard partial stage counts and
+    dimension/class histograms psum across shards (GSPMD inserts the
+    collectives for the replicated-output sums) — so a solve served by
+    the sharded tier explains itself WITHOUT first gathering the
+    placement vector. Replicated small outputs; bit-parity with the solo
+    reduce is pinned in tests/test_explain.py."""
+    key = (m, n_classes)
+    fn = _explain_cache.get(key)
+    if fn is not None:
+        return fn
+    from .kernels import _explain_reduce_impl
+    nd = NamedSharding(m, P(axis, None))
+    nv = NamedSharding(m, P(axis))
+    rep = NamedSharding(m, P())
+
+    def run(cap, used, ask, feasible, collisions, placed, class_ids,
+            distinct_hosts):
+        return _explain_reduce_impl(cap, used, ask, feasible, collisions,
+                                    placed, class_ids, distinct_hosts,
+                                    n_classes=n_classes)
+
+    fn = _explain_cache[key] = _serialize_launches(jax.jit(
+        run,
+        in_shardings=(nd, nd, rep, nv, nv, nv, nv, rep),
+        out_shardings=(rep, rep, rep, rep)))
+    return fn
+
+
 def put_node_sharded(arr, m: Mesh | None = None):
     """Place a host [N(, R')] node-axis array onto the mesh with the
     node-axis spec (the state cache's twin-seeding path). Falls back to

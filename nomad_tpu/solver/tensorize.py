@@ -38,6 +38,12 @@ class GroupTensors:
     distinct_hosts: bool
     cap_dev: object = None             # f32[B, R'] device twin (or None)
     used_dev: object = None            # f32[B, R'] device twin (or None)
+    # explain stage attribution (ISSUE 11), populated only when the
+    # placer lowers with explain=True: counts of nodes eliminated by
+    # the taint/eligibility mask and the pre-solve distinct-hosts
+    # collision filter — the two stages _build_* folds into `feasible`
+    # that a host iterator walk attributes separately. None = explain off.
+    ex_stages: Optional[dict] = None
 
 
 # (node.id, node.modify_index) -> capacity row. node_capacity_row is pure
@@ -268,8 +274,27 @@ def _lower_affinities(ctx, affinities, nodes) -> np.ndarray:
     return out
 
 
+def _explain_stages(nodes, walk, elig_ok, dh_pre) -> dict:
+    """Fold the per-stage masks into the counts the AllocMetric
+    materialization needs: eligibility-mask eliminations among walk
+    survivors, pre-solve distinct-hosts eliminations among eligible
+    survivors, with a per-node-class histogram for the latter (the host
+    DistinctHostsIterator records class_filtered per node)."""
+    classes: dict[str, int] = {}
+    for i in np.flatnonzero(dh_pre):
+        klass = nodes[int(i)].node_class
+        if klass:
+            classes[klass] = classes.get(klass, 0) + 1
+    return {
+        "elig_filtered": int(np.count_nonzero(walk & ~elig_ok)),
+        "dh_pre": int(np.count_nonzero(dh_pre)),
+        "dh_pre_classes": classes,
+    }
+
+
 def build_group_tensors(ctx, job, tg: TaskGroup, nodes: list[Node],
-                        feasible_fn, count: int = None) -> GroupTensors:
+                        feasible_fn, count: int = None,
+                        explain: bool = False) -> GroupTensors:
     """Lower one task group's placement problem.
 
     Fast path: read the store's incrementally-maintained dense cap/used
@@ -284,14 +309,16 @@ def build_group_tensors(ctx, job, tg: TaskGroup, nodes: list[Node],
     if view is not None:
         try:
             return _build_dense(ctx, job, tg, nodes, feasible_fn, view,
-                                count=count)
+                                count=count, explain=explain)
         except KeyError:
             pass        # node missing from the index: recompute from objects
-    return _build_from_objects(ctx, job, tg, nodes, feasible_fn)
+    return _build_from_objects(ctx, job, tg, nodes, feasible_fn,
+                               explain=explain)
 
 
 def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
-                 view, count: int = None) -> GroupTensors:
+                 view, count: int = None,
+                 explain: bool = False) -> GroupTensors:
     from ..state.usage_index import alloc_usage_tuple
     from . import state_cache
     n = len(nodes)
@@ -381,6 +408,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
 
     feasible = np.fromiter((feasible_fn(node) for node in nodes), bool,
                            count=n)
+    walk = feasible.copy() if explain else None
 
     # taint mask (ISSUE 10): AND the journaled eligibility column into
     # feasibility. Candidates are normally pre-filtered by node.ready()
@@ -389,11 +417,28 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     # pinned in tests/test_node_storm.py), and it is the seam flap
     # damping and future unfiltered-candidate paths mask through.
     elig = getattr(view, "elig", None)
+    elig_ok = None
     if elig is not None:
-        feasible &= elig[rows] > 0.5
+        elig_ok = elig[rows] > 0.5
+        feasible &= elig_ok
 
     distinct_hosts = any(c.operand == OP_DISTINCT_HOSTS
                          for c in list(job.constraints) + list(tg.constraints))
+    ex_stages = None
+    if explain:
+        if elig_ok is None:
+            elig_ok = np.ones(n, bool)
+        dh_pre = feasible & (collisions > 0) if distinct_hosts \
+            else np.zeros(n, bool)
+        ex_stages = _explain_stages(nodes, walk, elig_ok, dh_pre)
+        # class-id column for the device histogram, gathered VECTORIZED
+        # from the usage index (a per-node python walk here serialized
+        # the GIL across the whole stream — ISSUE 11 overhead contract)
+        class_col = getattr(view, "class_col", None)
+        if class_col is not None:
+            ex_stages["class_ids"] = class_col[rows]
+            ex_stages["class_names"] = list(
+                getattr(view, "class_names", ()) or ())
     if distinct_hosts:
         feasible &= collisions == 0
 
@@ -401,12 +446,12 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
         nodes=nodes, cap=cap, used=used, feasible=feasible,
         ask=group_ask_row(tg), job_collisions=collisions,
         distinct_hosts=distinct_hosts,
-        cap_dev=cap_dev, used_dev=used_dev,
+        cap_dev=cap_dev, used_dev=used_dev, ex_stages=ex_stages,
     )
 
 
 def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
-                        feasible_fn) -> GroupTensors:
+                        feasible_fn, explain: bool = False) -> GroupTensors:
     """Object-walk fallback: derives everything from proposed_allocs.
 
     feasible_fn(node) -> bool runs the irregular host-side checks (constraint
@@ -422,9 +467,10 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
     distinct_hosts = any(c.operand == OP_DISTINCT_HOSTS
                          for c in list(job.constraints) + list(tg.constraints))
 
+    walk = np.zeros(n, bool)
     for i, node in enumerate(nodes):
         cap[i] = node_capacity_row(node)
-        feasible[i] = feasible_fn(node)
+        feasible[i] = walk[i] = feasible_fn(node)
         proposed = ctx.proposed_allocs(node.id)
         for alloc in proposed:
             used[i] += alloc_usage_row(alloc)
@@ -432,6 +478,12 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
                 collisions[i] += 1
         if distinct_hosts and collisions[i] > 0:
             feasible[i] = False
+
+    ex_stages = None
+    if explain:
+        dh_pre = walk & (collisions > 0) if distinct_hosts \
+            else np.zeros(n, bool)
+        ex_stages = _explain_stages(nodes, walk, np.ones(n, bool), dh_pre)
 
     return GroupTensors(
         nodes=nodes,
@@ -441,6 +493,7 @@ def _build_from_objects(ctx, job, tg: TaskGroup, nodes: list[Node],
         ask=group_ask_row(tg),
         job_collisions=collisions,
         distinct_hosts=distinct_hosts,
+        ex_stages=ex_stages,
     )
 
 

@@ -182,6 +182,15 @@ class UsageIndex:
         # taint SET entry — no epoch bump — so tensor-cache consumers
         # survive a mass node failure without reseeding.
         self.elig = np.ones(0, np.float32)
+        # node-class id column (ISSUE 11): -1 = classless; ids index
+        # `class_names`, a grow-only universe bounded by distinct
+        # operator-assigned classes. Host-side only (never journaled —
+        # no device twin reads it): the explain path's per-class
+        # histograms gather `class_col[rows]` vectorized instead of a
+        # GIL-serializing python walk over 10k node objects per eval.
+        self.class_col = np.full(0, -1, np.int32)
+        self.class_names: list[str] = []
+        self._class_lookup: dict[str, int] = {}
         self._n = 0                              # live rows
         # alloc_id -> (row, usage tuple, sequential?) for exact removal
         self._contrib: dict[str, tuple[int, tuple, bool]] = {}
@@ -222,11 +231,14 @@ class UsageIndex:
         used = np.zeros((grow, NUM_XR), np.float32)
         counts = np.zeros(grow, np.int32)
         elig = np.ones(grow, np.float32)
+        class_col = np.full(grow, -1, np.int32)
         cap[:self._n] = self.cap[:self._n]
         used[:self._n] = self.used[:self._n]
         counts[:self._n] = self.counts[:self._n]
         elig[:self._n] = self.elig[:self._n]
+        class_col[:self._n] = self.class_col[:self._n]
         self.cap, self.used, self.counts, self.elig = cap, used, counts, elig
+        self.class_col = class_col
 
     def set_node(self, node) -> None:
         self.version += 1
@@ -251,6 +263,15 @@ class UsageIndex:
             self.elig[r] = elig
             self.delta_log.append((self.version, r, None, 0, elig))
         self.cap[r] = cap_row
+        klass = getattr(node, "node_class", "") or ""
+        if not klass:
+            self.class_col[r] = -1
+        else:
+            cid = self._class_lookup.get(klass)
+            if cid is None:
+                cid = self._class_lookup[klass] = len(self.class_names)
+                self.class_names.append(klass)
+            self.class_col[r] = cid
 
     def set_node_taint(self, node_id: str, eligible: bool) -> None:
         """Journal a schedulability flip for an existing node (status/
@@ -279,6 +300,7 @@ class UsageIndex:
             self.used[r] = 0.0
             self.counts[r] = 0
             self.elig[r] = 0.0          # epoch bumped: no journal entry
+            self.class_col[r] = -1
             # orphan the row's alloc contributions so later transitions
             # don't subtract from a zeroed row
             self._contrib = {aid: c for aid, c in self._contrib.items()
@@ -371,7 +393,9 @@ class UsageIndex:
                       counts=self.counts[:self._n].copy(),
                       uid=self.uid, epoch=self.epoch, version=self.version,
                       delta_log=self.delta_log,
-                      elig=self.elig[:self._n].copy())
+                      elig=self.elig[:self._n].copy(),
+                      class_col=self.class_col[:self._n].copy(),
+                      class_names=tuple(self.class_names))
         self._view_cache = ((self.version, self.epoch), v)
         return v
 
@@ -390,6 +414,9 @@ class UsageIndex:
         out.used = self.used.copy()
         out.counts = self.counts.copy()
         out.elig = self.elig.copy()
+        out.class_col = self.class_col.copy()
+        out.class_names = list(self.class_names)
+        out._class_lookup = dict(self._class_lookup)
         out._n = self._n
         out._contrib = dict(self._contrib)
         out.seq_rows = dict(self.seq_rows)
@@ -417,13 +444,16 @@ class UsageView:
     means "no versioning — cache stays out of the way")."""
 
     __slots__ = ("row", "cap", "used", "seq_rows", "counts",
-                 "uid", "epoch", "version", "delta_log", "elig")
+                 "uid", "epoch", "version", "delta_log", "elig",
+                 "class_col", "class_names")
 
     def __init__(self, row: dict[str, int], cap: np.ndarray,
                  used: np.ndarray, seq_rows: Optional[dict[int, int]] = None,
                  counts: Optional[np.ndarray] = None, uid: int = 0,
                  epoch: int = 0, version: int = 0, delta_log=None,
-                 elig: Optional[np.ndarray] = None):
+                 elig: Optional[np.ndarray] = None,
+                 class_col: Optional[np.ndarray] = None,
+                 class_names: tuple = ()):
         self.row = row
         self.cap = cap
         self.used = used
@@ -436,3 +466,7 @@ class UsageView:
         # eligibility mask column (ISSUE 10); None on plain test fakes —
         # consumers treat a missing column as all-schedulable
         self.elig = elig
+        # node-class id column + universe (ISSUE 11); None on fakes —
+        # the explain path then falls back to the per-node object walk
+        self.class_col = class_col
+        self.class_names = class_names

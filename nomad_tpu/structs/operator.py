@@ -112,6 +112,18 @@ class SchedulerConfiguration:
       flap_damping_backoff_s  first hold duration; doubles per
                               subsequent flap episode.
       flap_damping_backoff_max_s   hold ceiling for chronic flappers.
+      placement_explain_enabled   placement explainability (ISSUE 11):
+                              the tensor solve keeps its per-stage
+                              elimination reductions as a fixed-shape
+                              device byproduct and materializes real
+                              AllocMetric attribution for failed
+                              placements. NOMAD_EXPLAIN=0/1 env
+                              overrides either way; placements are
+                              bit-identical on or off
+                              (docs/OBSERVABILITY.md).
+      placement_explain_recent  how many recent explain records the
+                              bounded process ring retains for the
+                              operator debug bundle.
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -141,6 +153,8 @@ class SchedulerConfiguration:
     flap_damping_window_s: float = 300.0
     flap_damping_backoff_s: float = 30.0
     flap_damping_backoff_max_s: float = 900.0
+    placement_explain_enabled: bool = True
+    placement_explain_recent: int = 256
     create_index: int = 0
     modify_index: int = 0
 
@@ -191,4 +205,6 @@ class SchedulerConfiguration:
         if self.flap_damping_backoff_max_s < self.flap_damping_backoff_s:
             return ("flap_damping_backoff_max_s must be >= "
                     "flap_damping_backoff_s")
+        if self.placement_explain_recent < 1:
+            return "placement_explain_recent must be >= 1"
         return ""

@@ -462,3 +462,33 @@ def test_tracing_overhead_and_chain_completeness():
         assert key in attribution, (
             f"BENCH_r{latest_round:02d}: trace_attribution missing "
             f"{key!r}")
+
+
+def test_explain_overhead_gate():
+    """ISSUE 11 acceptance: once a bench records the `explain` block,
+    the placement-explain byproduct (per-solve fixed-shape reduce +
+    stage-mask bookkeeping) must cost <=2% of stream throughput, the
+    stream must actually have produced explain records, and the
+    attribution path must have recorded zero swallowed errors."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    block = latest.get("explain")
+    if block is None:
+        pytest.skip(
+            f"BENCH_r{latest_round:02d} predates placement explain")
+    if "error" in block:
+        pytest.fail(
+            f"BENCH_r{latest_round:02d}: explain bench errored instead "
+            f"of recording: {block['error']}")
+    assert block["overhead_frac"] <= 0.02, (
+        f"BENCH_r{latest_round:02d}: explain overhead "
+        f"{block['overhead_frac']:.1%} breaches the 2% contract "
+        f"(docs/OBSERVABILITY.md)")
+    assert block.get("records", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: the explain legs produced no "
+        f"records — the sandwich measured nothing")
+    assert block.get("errors", 0) == 0, (
+        f"BENCH_r{latest_round:02d}: {block['errors']} explain "
+        f"reductions swallowed errors during the bench")

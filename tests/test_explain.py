@@ -1,0 +1,524 @@
+"""Placement explainability (ISSUE 11): the tensor path's elimination
+attribution must be bit-consistent with the host iterator stack.
+
+The oracle is a FRESH GenericStack select over the identical (post-eval)
+cluster state: a failing select walks every candidate through
+FeasibilityWrapper -> DistinctHosts -> BinPack exactly once with fresh
+per-class caches — the same first-walk semantics the tensor path's
+single per-(eval, TG) lowering has — so every AllocMetric count
+(nodes evaluated / filtered with reasons / per-class / exhausted per
+dimension) must match EXACTLY, not approximately.
+
+Also pinned here: placements are bit-identical with explain on vs off,
+the sharded tier's psum reduce matches the solo reduce bit-for-bit
+(kernel-level AND end-to-end on the tier-1 virtual 8-device mesh), the
+winning rows' score metadata lands on placed allocs, and the operator
+debug bundle (endpoint + CLI archive) is capturable on a live dev agent.
+"""
+import json
+import random
+import tarfile
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.solver import backend, explain, microbatch, state_cache
+from nomad_tpu.structs import (
+    Constraint, Evaluation, OP_DISTINCT_HOSTS, SchedulerConfiguration,
+    SCHED_ALG_TPU,
+)
+
+from test_solver import Harness
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("NOMAD_EXPLAIN", raising=False)
+    monkeypatch.delenv("NOMAD_SOLVER_BACKEND", raising=False)
+    backend.reset()
+    state_cache.reset()
+    microbatch.reset()
+    explain.configure(enabled=None)
+    explain.reset()
+    yield
+    backend.reset()
+    state_cache.reset()
+    microbatch.reset()
+    explain.configure(enabled=None)
+    explain.reset()
+
+
+# ------------------------------------------------------------- scenarios
+
+def build_and_run(algorithm, seed, n_nodes, count, ask_cpu, ask_mem, *,
+                  constraint=False, distinct_hosts=False, hetero=False,
+                  node_class=False, eval_id=None):
+    """One seeded cluster + batch job through the full scheduler path,
+    with pinned eval id so identical inputs replay bit-identically."""
+    random.seed(seed)
+    rng = np.random.default_rng(seed)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=algorithm))
+    for i in range(n_nodes):
+        n = mock.node()
+        if hetero:
+            n.node_resources.cpu.cpu_shares = int(
+                rng.choice([4000, 16000]))
+            n.node_resources.memory.memory_mb = int(
+                rng.choice([8192, 65536]))
+        rack = "r1" if rng.random() < 0.5 else "r2"
+        n.attributes["custom.rack"] = rack
+        if node_class:
+            n.node_class = f"class-{rack}"
+        n.compute_class()
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.networks = []
+    task = tg.tasks[0]
+    task.resources.cpu = ask_cpu
+    task.resources.memory_mb = ask_mem
+    task.resources.networks = []
+    if constraint:
+        tg.constraints = list(tg.constraints) + [Constraint(
+            ltarget="${attr.custom.rack}", rtarget="r1", operand="=")]
+    if distinct_hosts:
+        tg.constraints = list(tg.constraints) + [Constraint(
+            operand=OP_DISTINCT_HOSTS)]
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(id=eval_id or f"explain-ev-{seed}", job_id=job.id,
+                    type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    return h, job, tg
+
+
+def oracle_failed_metric(h, job, tg):
+    """The iterator-stack oracle: one fresh GenericStack select over the
+    harness's (post-eval) state. A failing select exhausts the source,
+    so ctx.metrics afterwards is the host stack's full attribution."""
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.stack import GenericStack, SelectOptions
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+    snap = h.state.snapshot()
+    ctx = EvalContext(snap)
+    stack = GenericStack(True, ctx, rng=random.Random(0))
+    ready, by_dc = ready_nodes_in_dcs(snap, job.datacenters)
+    stack.set_nodes(ready)
+    stack.set_job(job)
+    option = stack.select(tg, SelectOptions())
+    assert option is None, "oracle unexpectedly placed — bad scenario"
+    m = ctx.metrics
+    m.nodes_available = by_dc
+    return m
+
+
+def assert_metric_parity(tensor_m, oracle_m):
+    """Field-exact equality on everything the host stack can attribute.
+    (score_meta is tensor-path-extra: the host records no score metadata
+    on a failed placement.)"""
+    assert tensor_m.nodes_evaluated == oracle_m.nodes_evaluated
+    assert tensor_m.nodes_filtered == oracle_m.nodes_filtered
+    assert dict(tensor_m.constraint_filtered) == \
+        dict(oracle_m.constraint_filtered)
+    assert dict(tensor_m.class_filtered) == dict(oracle_m.class_filtered)
+    assert tensor_m.nodes_exhausted == oracle_m.nodes_exhausted
+    assert dict(tensor_m.dimension_exhausted) == \
+        dict(oracle_m.dimension_exhausted)
+    assert dict(tensor_m.class_exhausted) == \
+        dict(oracle_m.class_exhausted)
+    assert dict(tensor_m.nodes_available) == dict(oracle_m.nodes_available)
+
+
+def _failed(h, tg):
+    ev = h.evals[-1]
+    assert tg.name in ev.failed_tg_allocs, \
+        f"expected a failed placement for {tg.name}"
+    return ev.failed_tg_allocs[tg.name]
+
+
+# -------------------------------------------------- rejection attribution
+
+def test_rejected_eval_reports_full_attribution():
+    """The acceptance surface: a rejected eval on the tensor path says
+    WHY — nodes evaluated, per-dimension exhaustion, blocked eval carries
+    the same metric."""
+    h, job, tg = build_and_run(SCHED_ALG_TPU, 3, n_nodes=4, count=5,
+                               ask_cpu=9000, ask_mem=64)
+    m = _failed(h, tg)
+    assert m.nodes_evaluated == 4
+    assert m.nodes_exhausted == 4
+    assert m.dimension_exhausted == {"cpu": 4}
+    # the blocked eval the scheduler queued carries the same attribution
+    blocked = [e for e in h.created_evals if e.status == "blocked"]
+    assert blocked and tg.name in blocked[0].failed_tg_allocs
+    assert blocked[0].failed_tg_allocs[tg.name].dimension_exhausted == \
+        {"cpu": 4}
+    # and the ring retained a rejected record for the debug bundle
+    recent = explain.recent(8)
+    assert any(r["rejected"] and r["dim_exhausted"] == {"cpu": 4}
+               for r in recent)
+
+
+def test_memory_binding_dimension_attributed():
+    h, job, tg = build_and_run(SCHED_ALG_TPU, 4, n_nodes=3, count=2,
+                               ask_cpu=100, ask_mem=32768)
+    m = _failed(h, tg)
+    assert m.dimension_exhausted == {"memory": 3}
+    assert_metric_parity(m, oracle_failed_metric(h, job, tg))
+
+
+# ------------------------------------------------------ oracle parity fuzz
+
+@pytest.mark.parametrize("seed", [1, 5, 9, 13])
+def test_parity_fuzz_greedy_regime_constraints(seed):
+    """count=1 rejections through the greedy kernel with irregular
+    constraint filtering: concrete first-in-class reasons + cached
+    'computed class ineligible' repeats must match the wrapper's."""
+    h, job, tg = build_and_run(
+        SCHED_ALG_TPU, seed, n_nodes=6 + seed % 5, count=1,
+        ask_cpu=20000, ask_mem=64, constraint=True, hetero=True,
+        node_class=True)
+    assert_metric_parity(_failed(h, tg), oracle_failed_metric(h, job, tg))
+
+
+@pytest.mark.parametrize("seed", [2, 6, 10])
+def test_parity_fuzz_jittered_depth_regime(seed):
+    """count in (1, n]: the sampled-grid jittered depth regime (m <= 3).
+    Pure exhaustion rejections, heterogeneous binding dimensions."""
+    h, job, tg = build_and_run(
+        SCHED_ALG_TPU, seed, n_nodes=16, count=2,
+        ask_cpu=20000, ask_mem=70000, hetero=True, node_class=True)
+    m = _failed(h, tg)
+    assert m.nodes_exhausted == 16
+    assert sum(m.dimension_exhausted.values()) == 16
+    assert_metric_parity(m, oracle_failed_metric(h, job, tg))
+
+
+@pytest.mark.parametrize("seed", [3, 7, 11])
+def test_parity_fuzz_deterministic_depth_regime_partial_placement(seed):
+    """count >> capacity: the deterministic full-curve regime (m > 3)
+    places what fits, the remainder is rejected — attribution describes
+    the POST-solve state, exactly what a host re-walk over the committed
+    cluster reports."""
+    h, job, tg = build_and_run(
+        SCHED_ALG_TPU, seed, n_nodes=4, count=24,
+        ask_cpu=1900, ask_mem=512)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert 0 < len(allocs) < 24          # partially placed, rest failed
+    m = _failed(h, tg)
+    assert m.nodes_exhausted == 4
+    assert_metric_parity(m, oracle_failed_metric(h, job, tg))
+
+
+def test_parity_distinct_hosts_post_solve_filtering():
+    """distinct_hosts with count > nodes: one instance lands per node,
+    the remainder's rejection attributes every node to the
+    distinct_hosts filter — exactly what DistinctHostsIterator reports
+    on the committed cluster."""
+    h, job, tg = build_and_run(
+        SCHED_ALG_TPU, 8, n_nodes=6, count=9,
+        ask_cpu=100, ask_mem=64, distinct_hosts=True)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 6
+    assert len({a.node_id for a in allocs}) == 6
+    m = _failed(h, tg)
+    assert m.constraint_filtered.get(OP_DISTINCT_HOSTS) == 6
+    assert m.nodes_exhausted == 0
+    assert_metric_parity(m, oracle_failed_metric(h, job, tg))
+
+
+# ------------------------------------------------------------ bit identity
+
+def test_placements_bit_identical_explain_on_off():
+    """Explain is a pure byproduct: same seed, same eval id, explain on
+    vs off — identical committed placements and identical usage rows."""
+
+    def run(enabled: bool):
+        explain.configure(enabled=enabled)
+        backend.reset()
+        state_cache.reset()
+        h, job, tg = build_and_run(SCHED_ALG_TPU, 21, n_nodes=6,
+                                   count=10, ask_cpu=700, ask_mem=256,
+                                   hetero=True, eval_id="bitid-ev")
+        # node/job ids are fresh uuids per run: compare by the usage
+        # index's stable insertion-order row + the instance index
+        rows = h.state.usage.row
+        allocs = h.state.allocs_by_job("default", job.id)
+        placed = sorted((rows[a.node_id], a.name.rsplit(".", 1)[-1])
+                        for a in allocs)
+        usage = h.state.usage.used.tobytes()
+        return placed, usage
+
+    on_placed, on_usage = run(True)
+    off_placed, off_usage = run(False)
+    assert on_placed == off_placed
+    assert on_usage == off_usage
+    assert len(on_placed) == 10
+
+
+def test_rejection_bit_identical_explain_on_off():
+    def run(enabled: bool):
+        explain.configure(enabled=enabled)
+        backend.reset()
+        state_cache.reset()
+        h, job, tg = build_and_run(SCHED_ALG_TPU, 22, n_nodes=5,
+                                   count=8, ask_cpu=1500, ask_mem=512,
+                                   eval_id="bitid-rej-ev")
+        rows = h.state.usage.row
+        allocs = h.state.allocs_by_job("default", job.id)
+        return sorted((rows[a.node_id], a.name.rsplit(".", 1)[-1])
+                      for a in allocs)
+
+    assert run(True) == run(False)
+
+
+def test_env_kill_switch_disables_records(monkeypatch):
+    monkeypatch.setenv("NOMAD_EXPLAIN", "0")
+    h, job, tg = build_and_run(SCHED_ALG_TPU, 23, n_nodes=3, count=2,
+                               ask_cpu=9000, ask_mem=64)
+    assert explain.recent(8) == []
+    # the rejection still carries the host fallback's own metric
+    m = _failed(h, tg)
+    assert m.nodes_evaluated == 3
+
+
+# -------------------------------------------------------- placed metadata
+
+def test_placed_allocs_carry_score_metadata():
+    """`alloc status` explainability: placed allocs share a metrics
+    object carrying nodes-evaluated and the winning rows' binpack
+    scores from the device solve."""
+    h, job, tg = build_and_run(SCHED_ALG_TPU, 31, n_nodes=5, count=4,
+                               ask_cpu=300, ask_mem=128)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 4
+    m = allocs[0].metrics
+    assert m.nodes_evaluated == 5
+    assert m.score_meta, "winning-row score metadata missing"
+    assert all(0.0 <= sm["normalized_score"] <= 1.0 for sm in m.score_meta)
+    placed_nodes = {a.node_id for a in allocs}
+    assert {sm["node_id"] for sm in m.score_meta} <= placed_nodes
+    assert m.scores             # node_id.binpack -> score
+
+
+def test_placed_allocs_keep_filter_attribution():
+    """With explain on, the irregular walk's filter counts are diverted
+    into the scratch metric — they must still reach the metrics object
+    stamped onto PLACED allocs (the pre-explain `alloc status` surface
+    showed them; a default-on feature must not lose them)."""
+    h, job, tg = build_and_run(SCHED_ALG_TPU, 33, n_nodes=8, count=2,
+                               ask_cpu=100, ask_mem=64, constraint=True)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == 2     # r1 nodes exist and fit
+    m = allocs[0].metrics
+    assert m.nodes_filtered > 0
+    assert any("custom.rack" in r for r in m.constraint_filtered), \
+        m.constraint_filtered
+
+
+def test_preemption_candidacy_recorded():
+    """Stage-5 observability: the batched preemption pass actually runs
+    (low-priority victims occupy every node, preemption enabled for
+    batch) and the record counts candidates / viable victim sets /
+    rescued placements."""
+    from nomad_tpu.structs import PreemptionConfig
+    random.seed(77)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(
+            scheduler_algorithm=SCHED_ALG_TPU,
+            preemption_config=PreemptionConfig(
+                batch_scheduler_enabled=True)))
+    for _ in range(3):
+        h.state.upsert_node(h.get_next_index(), mock.node())
+
+    def _job(priority, count, cpu):
+        job = mock.batch_job()
+        job.priority = priority
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.networks = []
+        task = tg.tasks[0]
+        task.resources.cpu = cpu
+        task.resources.memory_mb = 128
+        task.resources.networks = []
+        return job, tg
+
+    low, _ = _job(1, 3, 3000)
+    h.state.upsert_job(h.get_next_index(), low)
+    h.process(lambda s, p: new_scheduler(low.type, s, p),
+              Evaluation(id="preempt-low-ev", job_id=low.id,
+                         type=low.type))
+    assert len(h.state.allocs_by_job("default", low.id)) == 3
+
+    high, tg_h = _job(50, 2, 3000)
+    h.state.upsert_job(h.get_next_index(), high)
+    h.process(lambda s, p: new_scheduler(high.type, s, p),
+              Evaluation(id="preempt-high-ev", job_id=high.id,
+                         type=high.type))
+    rec = [r for r in explain.recent(8)
+           if r["eval_id"] == "preempt-high-ev" and r["tg"] == tg_h.name]
+    assert rec, "no explain record for the preempting eval"
+    p = rec[0]["preempt"]
+    assert p["candidates"] == 3
+    assert p["with_victims"] >= 1
+    assert p["placed"] >= 1
+
+
+# --------------------------------------------------------- sharded parity
+
+def _reduce_args(seed=0, n=16, n_classes=4):
+    rng = np.random.default_rng(seed)
+    from nomad_tpu.solver.kernels import NUM_XR
+    cap = np.zeros((n, NUM_XR), np.float32)
+    cap[:, 0] = rng.choice([2000.0, 4000.0], n)
+    cap[:, 1] = rng.choice([4096.0, 8192.0], n)
+    cap[:, 2] = 50_000.0
+    used = (cap * rng.uniform(0.0, 0.9, (n, NUM_XR))).astype(np.float32)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 1500.0, 2048.0
+    feas = rng.random(n) > 0.2
+    coll = rng.integers(0, 2, n).astype(np.int32)
+    placed = rng.integers(0, 3, n).astype(np.int32)
+    cls = rng.integers(-1, n_classes, n).astype(np.int32)
+    return (cap, used, ask, feas, coll, placed, cls, np.bool_(True))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_numpy_reduce_twin_matches_jitted_bit_for_bit(seed):
+    """The host-routing twin (explain.reduce_numpy) must return the SAME
+    bits as the jitted reduce — it serves the same contract on CPU
+    backends and the host tier."""
+    from nomad_tpu.solver.kernels import explain_reduce
+    args = _reduce_args(seed)
+    jit_out = explain_reduce(*args, n_classes=4)
+    np_out = explain.reduce_numpy(*args, n_classes=4)
+    for a, b in zip(jit_out, np_out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_explain_reduce_matches_solo_bit_for_bit(seed):
+    """The psum form of the reduce (per-shard partials + collectives on
+    the virtual 8-device mesh) returns the SAME bits as the solo jit."""
+    from nomad_tpu.solver.kernels import explain_reduce
+    from nomad_tpu.solver import sharding
+    m = sharding.mesh()
+    if m is None:
+        pytest.skip("single-device world")
+    args = _reduce_args(seed)
+    solo = explain_reduce(*args, n_classes=4)
+    shd = sharding.sharded_explain_reduce(m, n_classes=4)(*args)
+    for a, b in zip(solo, shd):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_tier_end_to_end_attribution_matches_solo(monkeypatch):
+    """Force the sharded tier: the solve's node-sharded placement vector
+    feeds the mesh-spec'd reduce, and the rejected eval's AllocMetric is
+    bit-consistent with the solo-tier run of the identical scenario."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device world")
+
+    def run():
+        backend.reset()
+        state_cache.reset()
+        explain.reset()
+        h, job, tg = build_and_run(SCHED_ALG_TPU, 41, n_nodes=16,
+                                   count=3, ask_cpu=9000, ask_mem=64,
+                                   eval_id="sharded-ev")
+        return _failed(h, tg), [r for r in explain.recent(8)
+                                if r["tg"] == tg.name][0]
+
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "sharded")
+    m_sharded, rec_sharded = run()
+    assert rec_sharded["tier"] == "sharded"
+    monkeypatch.delenv("NOMAD_SOLVER_BACKEND")
+    m_solo, rec_solo = run()
+    assert m_sharded.nodes_evaluated == m_solo.nodes_evaluated == 16
+    assert dict(m_sharded.dimension_exhausted) == \
+        dict(m_solo.dimension_exhausted)
+    assert m_sharded.nodes_exhausted == m_solo.nodes_exhausted
+    assert rec_sharded["dim_exhausted"] == rec_solo["dim_exhausted"]
+    assert rec_sharded["n_feasible"] == rec_solo["n_feasible"]
+
+
+# ------------------------------------------------------------ debug bundle
+
+@pytest.fixture(scope="module")
+def agent():
+    from nomad_tpu.agent import Agent, AgentConfig
+    a = Agent(AgentConfig(dev_mode=True, http_port=0, num_workers=1))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def _call(agent, path):
+    import urllib.request
+    with urllib.request.urlopen(agent.http_addr + path,
+                                timeout=35) as resp:
+        return json.loads(resp.read() or "null")
+
+
+def test_operator_debug_endpoint_blocks(agent):
+    b = _call(agent, "/v1/operator/debug")
+    for key in ("Meta", "Status", "Metrics", "DeviceRuntime", "Traces",
+                "Explains", "StateCache", "Breakers", "SchedulerConfig",
+                "Raft"):
+        assert key in b, f"bundle missing {key}"
+    assert b["Meta"]["Name"]
+    assert b["DeviceRuntime"]["devices"], "no device rows"
+    assert "hits" in b["DeviceRuntime"]["compile_cache"]
+    assert set(b["Breakers"]) == {"sharded", "pallas", "batch", "xla",
+                                  "host"}
+    assert "counters" in b["Metrics"]
+
+
+def test_device_gauges_exported_in_prometheus(agent):
+    import urllib.request
+    agent.config.telemetry_prometheus = True
+    with urllib.request.urlopen(
+            agent.http_addr + "/v1/metrics?format=prometheus",
+            timeout=35) as resp:
+        text = resp.read().decode()
+    assert "nomad_device_mem_bytes_in_use_d0" in text
+    assert "nomad_device_live_buffers_d0" in text
+    assert "nomad_compile_cache_hits" in text
+    assert "nomad_compile_cache_misses" in text
+
+
+def test_operator_debug_cli_archive_loadable(agent, tmp_path,
+                                             monkeypatch):
+    """`nomad-tpu operator debug` against the live dev agent produces a
+    loadable tar.gz whose operator-debug.json carries the new blocks."""
+    import types
+
+    from nomad_tpu import cli as cli_mod
+    monkeypatch.setenv("NOMAD_ADDR", agent.http_addr)
+    out = tmp_path / "bundle.tar.gz"
+    args = types.SimpleNamespace(duration="0.1", interval="0.25",
+                                 output=str(out))
+    cli_mod.cmd_operator_debug(args)
+    assert out.exists()
+    with tarfile.open(out, "r:gz") as tar:
+        names = tar.getnames()
+        debug_member = [n for n in names
+                        if n.endswith("operator-debug.json")]
+        assert debug_member, names
+        payload = json.loads(
+            tar.extractfile(debug_member[0]).read())
+        assert "Explains" in payload and "DeviceRuntime" in payload
+        index = [n for n in names if n.endswith("index.json")]
+        manifest = json.loads(tar.extractfile(index[0]).read())
+        assert "operator-debug.json" in manifest["Files"]
+        assert any(n.endswith("metrics.prom") or
+                   "metrics.prom" in manifest["Errors"] for n in names)

@@ -89,6 +89,105 @@ def test_raftnode_fence_rejects_after_term_moves():
         s.shutdown()
 
 
+def test_loop_handle_start_stop_race_regression():
+    """PR-10 in-suite flake ("cannot join thread before it is started"):
+    Server.shutdown() could stop() a daemon loop while the recovery
+    barrier's election-callback thread was mid-start() — the bare-Thread
+    pattern published the Thread object BEFORE starting it, so the
+    concurrent join raised. LoopHandle serializes start/stop and only
+    publishes a started thread; hammer the pair concurrently and assert
+    no RuntimeError ever escapes."""
+    import threading
+
+    from nomad_tpu.server.lifecycle import LoopHandle
+
+    h = LoopHandle()
+    stop_ev = threading.Event()
+
+    def loop() -> None:
+        stop_ev.wait(0.002)
+
+    errors: list = []
+
+    def hammer(fn) -> None:
+        for _ in range(400):
+            try:
+                fn()
+            except RuntimeError as e:   # the regression signature
+                errors.append(e)
+
+    t1 = threading.Thread(target=hammer, args=(
+        lambda: h.start(loop, "race-loop"),))
+    t2 = threading.Thread(target=hammer, args=(lambda: h.stop(0.5),))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert not errors, errors[:3]
+    stop_ev.set()
+    h.stop()
+    assert not h.is_alive()
+
+
+def test_loop_handle_timed_out_stop_keeps_handle_and_recovers():
+    """A stop() whose join exhausts its timeout must KEEP the handle
+    (returning False) so a later start() cannot clear the stop event
+    out from under the still-draining loop and spawn a duplicate; once
+    the old loop exits, a restart succeeds cleanly."""
+    import threading
+
+    from nomad_tpu.server.lifecycle import LoopHandle
+
+    h = LoopHandle()
+    release = threading.Event()
+    h.start(lambda: release.wait(10), "slow-drain")
+    assert h.stop(timeout=0.05) is False   # loop ignores the stop event
+    assert h.is_alive()
+    release.set()                          # old loop can now exit
+    fresh = threading.Event()
+    assert h.start(lambda: fresh.wait(5), "fresh")
+    assert h.is_alive()
+    fresh.set()
+    assert h.stop() is True
+    assert not h.is_alive()
+
+
+def test_heartbeat_timers_concurrent_start_stop_regression():
+    """The production shape of the PR-10 flake: HeartbeatTimers.start()
+    from the establish barrier racing stop() from shutdown/revoke. Also
+    pins that a start() while the reaper is already alive does NOT leak
+    a second loop (LoopHandle.start is a no-op on a live thread)."""
+    import threading
+
+    from nomad_tpu.server.heartbeat import HeartbeatTimers
+
+    class _Srv:
+        logger = staticmethod(lambda *_: None)
+        state = None
+
+    hb = HeartbeatTimers(_Srv())
+    errors: list = []
+
+    def hammer(fn) -> None:
+        for _ in range(200):
+            try:
+                fn()
+            except RuntimeError as e:
+                errors.append(e)
+
+    t1 = threading.Thread(target=hammer, args=(hb.start,))
+    t2 = threading.Thread(target=hammer, args=(hb.stop,))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert not errors, errors[:3]
+    hb.start()
+    assert not hb._loop.start(lambda: None, "dup")   # already alive
+    hb.stop()
+    assert not hb._loop.is_alive()
+
+
 def test_fence_token_is_none_on_follower():
     servers = make_cluster(3, seed=2)
     try:
@@ -171,7 +270,7 @@ def test_recovery_barrier_steps_metered_and_fault_injectable():
         assert metrics.counter("nomad.leader.establish_step_failed") == 0
         # subsystems all came up despite the injected fault
         assert s.eval_broker.enabled
-        assert s.heartbeats._thread is not None
+        assert s.heartbeats._loop.is_alive()
     finally:
         s.shutdown()
 

@@ -560,8 +560,8 @@ def test_cli_nonexistent_path_fails(tmp_path):
 def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
-            "EXC001", "PERF001", "LEAD001", "OBS001", "QUEUE001",
-            "SHARD001"} <= ids
+            "EXC001", "PERF001", "LEAD001", "OBS001", "OBS002",
+            "QUEUE001", "SHARD001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -771,6 +771,97 @@ def test_obs001_inline_suppression():
             metrics.incr(f"nomad.faults.fired.{site}")
     """
     assert [f.rule for f in findings(src) if f.rule == "OBS001"] == []
+
+
+# ----------------------------------------------------------------- OBS002
+
+OBS002_BAD = """
+    class Placer:
+        def place(self, destructive, place):
+            for missing in list(destructive) + list(place):
+                tg = missing.task_group
+                if self.job.lookup_task_group(tg.name) is None:
+                    continue          # silent drop: no metric anywhere
+                self.plan.append_alloc(self.make(missing))
+"""
+
+
+def test_obs002_fires_on_unattributed_placement_drop():
+    out = [f for f in findings(OBS002_BAD, path="solver/placer.py")
+           if f.rule == "OBS002"]
+    assert len(out) == 1
+    assert "AllocMetric" in out[0].message
+
+
+def test_obs002_scoped_to_scheduler_and_solver_paths():
+    # receivers of AllocMetric objects (server endpoints, CLI) don't
+    # mint them — the rule stays out of their way
+    assert [f.rule for f in findings(OBS002_BAD, path="server/endpoint.py")
+            if f.rule == "OBS002"] == []
+
+
+def test_obs002_quiet_when_failed_metric_attached():
+    src = """
+        class Sched:
+            def place(self, place):
+                for missing in place:
+                    tg = missing.task_group
+                    option = self.stack.select(tg)
+                    if option is None:
+                        self.failed_tg_allocs[tg.name] = \\
+                            self.ctx.metrics.copy()
+                        continue
+                    self.plan.append_alloc(self.make(missing, option))
+    """
+    assert [f.rule for f in findings(src, path="scheduler/generic.py")
+            if f.rule == "OBS002"] == []
+
+
+def test_obs002_quiet_on_attributed_handoff():
+    src = """
+        class Placer:
+            def place(self, missings, tg):
+                leftovers = []
+                for missing in missings:
+                    if not self.fits(missing):
+                        leftovers.append(missing)
+                        continue
+                    self.plan.append_alloc(self.make(missing))
+                return self._fallback(leftovers)
+
+            def score(self, missings):
+                for missing in missings:
+                    if missing.canary:
+                        continue
+                    self.ctx.metrics.filter_node(None, "canary")
+    """
+    assert [f.rule for f in findings(src, path="solver/placer.py")
+            if f.rule == "OBS002"] == []
+
+
+def test_obs002_quiet_without_drop_paths():
+    src = """
+        class Placer:
+            def place(self, missings):
+                for missing in missings:
+                    self.plan.append_alloc(self.make(missing))
+    """
+    assert [f.rule for f in findings(src, path="solver/placer.py")
+            if f.rule == "OBS002"] == []
+
+
+def test_obs002_inline_suppression():
+    src = """
+        class Placer:
+            def place(self, missings):
+                # nomadlint: disable=OBS002 — metric attached by caller
+                for missing in missings:
+                    if missing.stale:
+                        continue
+                    self.plan.append_alloc(self.make(missing))
+    """
+    assert [f.rule for f in findings(src, path="solver/placer.py")
+            if f.rule == "OBS002"] == []
 
 
 # ---------------------------------------------------------------- QUEUE001
