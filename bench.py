@@ -730,6 +730,113 @@ def _node_storm_run() -> dict:
         s.shutdown()
 
 
+CRASH_ENTRIES = int(os.environ.get("NOMAD_CRASH_ENTRIES", "1000"))
+
+
+def _crash_recovery_run() -> dict:
+    """Crash-recovery lineage (ISSUE 13, docs/DURABILITY.md): the raft
+    WAL's durability/throughput envelope on this box.
+
+      * raft-apply throughput of a disk-backed sole-voter server at
+        each fsync discipline (`always` / `interval` / `never`) — the
+        plan stream rides this same append path;
+      * restart wall time with a LONG log (replay-bound) vs after
+        compaction (snapshot-bound) — the operator's recovery story;
+      * zero lost commits: every apply acked under fsync=always is
+        present after a restart.
+
+    Gated by tests/test_bench_regression.py::test_crash_recovery_gate
+    once a BENCH_*.json carries the block: recovery bounded, zero lost
+    commits, and fsync=interval within a documented fraction (>=0.3x)
+    of fsync=never."""
+    import shutil
+    import tempfile
+
+    from nomad_tpu.rpc.virtual import VirtualNetwork
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.fsm import NODE_REGISTER
+
+    rng = np.random.default_rng(13)
+
+    def _boot(root, net_seed, threshold=1 << 30):
+        net = VirtualNetwork(seed=net_seed)
+        # num_workers=0: pure consensus/persistence measurement — no
+        # scheduler traffic competing for the GIL mid-timing
+        s = Server(num_workers=0, gc_interval=9999)
+        s.rpc_listen_virtual(net, "s0")
+        s.enable_raft("s0", {"s0": s.rpc_addr}, data_dir=root,
+                      snapshot_threshold=threshold, seed=1,
+                      election_timeout=(0.2, 0.4),
+                      heartbeat_interval=0.05)
+        s.start()
+        deadline = time.time() + 20
+        while not s.raft_node.is_leader() and time.time() < deadline:
+            time.sleep(0.005)
+        assert s.raft_node.is_leader(), "sole voter failed to establish"
+        return s
+
+    def _throughput_leg(mode, net_seed):
+        root = tempfile.mkdtemp(prefix=f"nomad-crash-{mode}-")
+        os.environ["NOMAD_RAFT_FSYNC"] = mode
+        try:
+            s = _boot(root, net_seed)
+            try:
+                nodes = [_mk_node(i, rng) for i in range(CRASH_ENTRIES)]
+                t0 = time.perf_counter()
+                acked = 0
+                for n in nodes:
+                    s.raft.apply(NODE_REGISTER, {"node": n})
+                    acked += 1
+                wall = time.perf_counter() - t0
+            finally:
+                s.shutdown()
+            return root, acked, CRASH_ENTRIES / wall
+        finally:
+            os.environ.pop("NOMAD_RAFT_FSYNC", None)
+
+    _root, _, never_eps = _throughput_leg("never", 101)
+    shutil.rmtree(_root, ignore_errors=True)
+    _root, _, interval_eps = _throughput_leg("interval", 102)
+    shutil.rmtree(_root, ignore_errors=True)
+    root, acked, always_eps = _throughput_leg("always", 103)
+
+    # restart with the LONG log: replay-bound recovery
+    t0 = time.perf_counter()
+    s2 = _boot(root, 104)
+    restart_long_s = time.perf_counter() - t0
+    frames_long = len(s2.raft_node.log)
+    recovered = len(s2.state.nodes)
+    lost_commits = max(0, acked - recovered)
+    # compact, then restart again: snapshot-bound recovery
+    with s2.raft_node._lock:
+        s2.raft_node._compact_locked()
+    frames_post = len(s2.raft_node.log)
+    s2.shutdown()
+    t0 = time.perf_counter()
+    s3 = _boot(root, 105)
+    restart_post_s = time.perf_counter() - t0
+    recovered_post = len(s3.state.nodes)
+    s3.shutdown()
+    shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "entries": CRASH_ENTRIES,
+        "fsync_always_entries_per_s": round(always_eps, 1),
+        "fsync_interval_entries_per_s": round(interval_eps, 1),
+        "fsync_never_entries_per_s": round(never_eps, 1),
+        "fsync_interval_vs_never_frac": round(
+            interval_eps / never_eps, 3) if never_eps else 0.0,
+        "restart_s_long_log": round(restart_long_s, 4),
+        "restart_s_post_compaction": round(restart_post_s, 4),
+        "log_frames_long": frames_long,
+        "log_frames_post_compaction": frames_post,
+        "acked_entries": acked,
+        "recovered_entries": recovered,
+        "recovered_entries_post_compaction": recovered_post,
+        "lost_commits": lost_commits,
+    }
+
+
 POD_NODES = int(os.environ.get("NOMAD_POD_NODES", "100000"))
 POD_TASKS = int(os.environ.get("NOMAD_POD_TASKS", "1000000"))
 
@@ -1509,6 +1616,14 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         node_storm = {"error": repr(e)[:200]}
 
+    # crash-recovery lineage (ISSUE 13): fsync-discipline throughput
+    # envelope + replay-vs-snapshot restart wall + zero-lost-commit
+    # audit; gated by tests/test_bench_regression.py once recorded
+    try:
+        crash_recovery = _crash_recovery_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        crash_recovery = {"error": repr(e)[:200]}
+
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
     # tests/test_bench_regression.py once recorded
@@ -1582,6 +1697,7 @@ def main() -> None:
         # ISSUE 10: mass node-failure lineage (batched invalidation,
         # taint-riding state cache, deduped eval flood, recovery wall)
         "node_storm": node_storm,
+        "crash_recovery": crash_recovery,
         "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
         "state_cache": state_cache_counters,
         **phases,
@@ -1924,6 +2040,11 @@ if __name__ == "__main__":
         # standalone node-storm lineage (ISSUE 10): 10% mass kill on the
         # 10k-node sim; NOMAD_STORM_{NODES,JOBS,TASKS,RATE_CAP} resize
         print(json.dumps(_node_storm_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--crash-recovery":
+        # standalone crash-recovery lineage (ISSUE 13): fsync-mode
+        # raft-apply throughput + restart wall pre/post compaction +
+        # lost-commit audit; NOMAD_CRASH_ENTRIES resizes
+        print(json.dumps(_crash_recovery_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":

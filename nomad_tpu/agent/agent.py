@@ -103,7 +103,7 @@ class Agent:
                 acl_enabled=self.config.acl_enabled,
                 region=self.config.region,
                 authoritative_region=self.config.authoritative_region,
-                name=self.config.node_name or "",
+                name=self.config.node_name or self._stable_server_name(),
                 secrets_file=self.config.secrets_file)
         if self.config.client_enabled:
             if self.server is not None:
@@ -125,6 +125,35 @@ class Agent:
                 logger=self.logger,
                 plugin_dir=self.config.plugin_dir)
         self.api = HTTPAPI(self)
+
+    def _stable_server_name(self) -> str:
+        """A server's raft identity must survive restarts (ISSUE 13
+        restart-from-disk): the on-disk raft configuration names THIS
+        server as a voter, so a fresh random name on every boot would
+        make the restarted process an unknown peer that can never
+        self-elect from its own WAL — it would sit as a permanent
+        follower of a one-member cluster whose sole voter no longer
+        exists. Persist the generated name under data_dir on first
+        boot and reuse it, the way the reference persists its node-id
+        (-dev runs with an auto tempdir keep today's per-boot names)."""
+        from ..structs import new_id
+        path = os.path.join(self.config.data_dir, "server_name")
+        try:
+            with open(path, encoding="utf-8") as f:
+                name = f.read().strip()
+            if name:
+                return name
+        except OSError:
+            pass
+        name = f"server-{new_id()[:8]}"
+        try:
+            # first boot may precede every other data_dir consumer
+            os.makedirs(self.config.data_dir, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(name)
+        except OSError as e:
+            self.logger(f"agent: could not persist server name: {e}")
+        return name
 
     def start(self) -> None:
         # compiled sidecars (executor, logmon, allocstamp) are built from

@@ -1,0 +1,120 @@
+"""DUR001 — raw persistence writes outside the durable-storage helpers.
+
+ISSUE 13 moved every byte the control plane persists behind
+`server/durable.py`: CRC-framed WAL appends, crc-enveloped blobs, an
+atomically-replaced MANIFEST as the commit point, and the hot-reloadable
+fsync discipline (docs/DURABILITY.md). A raw `open(..., "wb")` +
+`os.replace` flush that never fsyncs survives SIGKILL but not power
+loss (the rename is journaled before the data), and a raw append-mode
+log has no frame headers — a torn tail or a stale generation is
+silently re-read as truth, the exact crash window the WAL closed.
+
+Flagged inside `server/`, `state/`, and `client/`:
+  * `open(..., "ab")` / `os.fdopen(..., "ab")` — an append-mode
+    persistence stream with no CRC/index framing; route it through the
+    durable module's WAL helpers (or justify why the data is
+    loss-tolerant, e.g. task stdout streams);
+  * `open(..., "wb")` in a function that also calls
+    `os.replace`/`os.rename` but never `os.fsync` — the
+    atomic-replace-without-durability shape (`client/state_db.py`'s
+    fsync-then-replace flush is the compliant pattern).
+
+`server/durable.py` itself is exempt: it IS the helper module whose
+write paths carry the crc/fsync discipline (and the fault sites).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, SourceModule, register
+
+
+@register
+class RawPersistenceWrite(Rule):
+    id = "DUR001"
+    severity = "error"
+    short = ("raw persistence write (append-mode log, or atomic-replace "
+             "without fsync) outside the durable-storage helpers")
+    path_markers = ("/server/", "/state/", "/client/")
+    EXEMPT = ("server/durable.py",)
+
+    _OPENERS = ("open", "os.fdopen")
+    _REPLACERS = ("os.replace", "os.rename")
+
+    def applies_to(self, mod: SourceModule) -> bool:
+        if any(mod.match_path.endswith(e) for e in self.EXEMPT):
+            return False
+        return super().applies_to(mod)
+
+    # ------------------------------------------------------------ helpers
+
+    @staticmethod
+    def _open_mode(node: ast.Call) -> str:
+        """The string mode of an open()-ish call, "" when not literal."""
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return ""
+
+    def _scope_of(self, mod: SourceModule, node: ast.AST) -> ast.AST:
+        for anc in mod.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return mod.tree
+
+    def _scope_calls(self, mod: SourceModule, scope: ast.AST) -> tuple:
+        """(has_replace, has_fsync) among calls DIRECTLY in `scope`
+        (nested defs are their own persistence contexts)."""
+        has_replace = has_fsync = False
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._scope_of(mod, node) is not scope:
+                continue
+            d = mod.dotted(node.func)
+            if d in self._REPLACERS:
+                has_replace = True
+            elif d == "os.fsync":
+                has_fsync = True
+        return has_replace, has_fsync
+
+    # -------------------------------------------------------------- check
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        scope_info: dict[int, tuple] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.dotted(node.func) not in self._OPENERS:
+                continue
+            mode = self._open_mode(node)
+            if "a" in mode and "b" in mode:
+                out.append(mod.finding(
+                    self, node,
+                    "append-mode binary write is a raw WAL with no "
+                    "frame CRC/index — route control-plane state "
+                    "through server/durable.py (loss-tolerant streams "
+                    "carry an inline disable saying so)"))
+                continue
+            if "w" not in mode or "b" not in mode:
+                continue
+            scope = self._scope_of(mod, node)
+            key = id(scope)
+            if key not in scope_info:
+                scope_info[key] = self._scope_calls(mod, scope)
+            has_replace, has_fsync = scope_info[key]
+            if has_replace and not has_fsync:
+                out.append(mod.finding(
+                    self, node,
+                    "atomic-replace flush without os.fsync — the "
+                    "rename survives a crash but the data may not; "
+                    "fsync before os.replace (see "
+                    "client/state_db.py._flush_snapshot) or use "
+                    "server/durable.py"))
+        return out

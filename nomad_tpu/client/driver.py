@@ -430,7 +430,12 @@ def _open_log_sinks(task_dir: str, task):
                     p.kill()
             raise
         return sinks[0], sinks[1], procs
+    # task stdout/stderr streams: loss-tolerant by contract (the
+    # reference loses in-flight log bytes on power loss too); not
+    # control-plane state
+    # nomadlint: disable=DUR001 — loss-tolerant log stream
     stdout = open(os.path.join(task_dir, f"{task.name}.stdout.log"), "ab")
+    # nomadlint: disable=DUR001 — task log stream, see above
     stderr = open(os.path.join(task_dir, f"{task.name}.stderr.log"), "ab")
     return stdout, stderr, []
 

@@ -376,8 +376,12 @@ class DockerDriver:
         out = self._docker("logs", rec["id"])
         try:
             base = os.path.join(rec["task_dir"], rec["task_name"])
+            # docker log capture: loss-tolerant stream data,
+            # re-fetchable from the daemon
+            # nomadlint: disable=DUR001 — loss-tolerant log stream
             with open(f"{base}.stdout.log", "ab") as f:
                 f.write(out.stdout)
+            # nomadlint: disable=DUR001 — docker log capture, see above
             with open(f"{base}.stderr.log", "ab") as f:
                 f.write(out.stderr)
         except OSError:

@@ -83,6 +83,10 @@ class LogRotator:
         # reference avoids even that by owning the write path via FIFO).
         size = os.path.getsize(path)
         if keep >= 1:
+            # log-rotation copy of a task output stream: loss-tolerant
+            # data, fsyncing every rotation would tax the client for
+            # bytes nobody re-reads after a crash
+            # nomadlint: disable=DUR001 — loss-tolerant log stream
             with open(path, "rb") as src, open(f"{path}.1", "wb") as dst:
                 remaining = size
                 while remaining > 0:

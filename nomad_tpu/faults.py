@@ -20,6 +20,14 @@ code: `solver.dispatch.pallas`, `raft.apply`, `heartbeat.invalidate`,
   probability  fire with probability `p` from a PER-SITE seeded RNG —
                same seed => same fire pattern over the site's call
                sequence, independent of other sites' traffic
+  torn         BYTES sites only (`faults.mangle(site, data)`): from the
+               n-th call onward (default 1), raise TornWriteError
+               carrying a seeded PREFIX of the payload — the write site
+               writes the prefix, then propagates (power loss mid-write)
+  corrupt      BYTES sites only: from the n-th call onward, return the
+               payload with ONE seeded bit flipped and continue — the
+               write "succeeds" but what reached the platter is damaged
+               (silent media corruption, detected later by CRC)
 
 plus common knobs: `times` caps total fires (-1 = unlimited; `times: 1`
 is a one-shot), and `exc` picks the raised type (`fault` -> FaultError,
@@ -65,6 +73,17 @@ class FaultError(RuntimeError):
         self.site = site
 
 
+class TornWriteError(FaultError):
+    """A `torn`-mode fire at a bytes site (ISSUE 13): `.prefix` is the
+    seeded prefix of the payload that "reached the disk" before the
+    simulated power loss. The write site's contract: write the prefix,
+    flush it, then let this propagate as the crash."""
+
+    def __init__(self, site: str, prefix: bytes):
+        super().__init__(site)
+        self.prefix = prefix
+
+
 _EXC_TYPES = {
     "fault": FaultError,
     "timeout": TimeoutError,
@@ -72,7 +91,12 @@ _EXC_TYPES = {
     "runtime": RuntimeError,
 }
 
-_MODES = ("raise", "delay", "nth_call", "after", "probability")
+_MODES = ("raise", "delay", "nth_call", "after", "probability",
+          "torn", "corrupt")
+# modes that only act on byte payloads (via mangle()); a plain fire()
+# at a site matched by one of these is counted but never raises — the
+# site has no bytes to tear/corrupt
+_BYTES_MODES = ("torn", "corrupt")
 
 
 class FaultSpec:
@@ -89,7 +113,7 @@ class FaultSpec:
         if exc not in _EXC_TYPES:
             raise ValueError(f"unknown fault exc {exc!r} "
                              f"(one of {tuple(_EXC_TYPES)})")
-        if mode in ("nth_call", "after") and n < 1:
+        if mode in ("nth_call", "after", "torn", "corrupt") and n < 1:
             raise ValueError(f"{mode} requires n >= 1")
         self.pattern = pattern
         self.mode = mode
@@ -114,7 +138,9 @@ class FaultSpec:
             return True
         if self.mode == "nth_call":
             return self.calls % self.n == 0
-        if self.mode == "after":
+        if self.mode in ("after", "torn", "corrupt"):
+            # torn/corrupt compose with `n` + `times` so a crash-point
+            # fuzzer can say "tear exactly the k-th write at this site"
             return self.calls >= self.n
         return self._rng.random() < self.p          # probability
 
@@ -123,6 +149,21 @@ class FaultSpec:
         if exc_type is FaultError:
             raise FaultError(site)
         raise exc_type(f"injected fault at {site}")
+
+    def mangle_now(self, site: str, data: bytes) -> bytes:
+        """Apply a fired bytes-mode spec to a payload (under the plan
+        lock). `torn` raises with the seeded prefix; `corrupt` returns
+        the payload with one seeded bit flipped."""
+        if not data:
+            if self.mode == "torn":
+                raise TornWriteError(site, b"")
+            return data
+        if self.mode == "torn":
+            k = self._rng.randrange(len(data))
+            raise TornWriteError(site, data[:k])
+        pos = self._rng.randrange(len(data))
+        bit = 1 << self._rng.randrange(8)
+        return data[:pos] + bytes([data[pos] ^ bit]) + data[pos + 1:]
 
 
 class FaultPlan:
@@ -176,6 +217,10 @@ class FaultPlan:
             spec = self._match(site)
             if spec is None:
                 return
+            if spec.mode in _BYTES_MODES:
+                # bytes-only modes act through mangle(); a plain fire()
+                # at the same site is observed but can't tear anything
+                return
             spec.calls += 1
             if not spec.should_fire():
                 return
@@ -187,6 +232,34 @@ class FaultPlan:
         if spec.mode == "delay":
             time.sleep(delay_s)                     # outside the lock
             return
+        spec.raise_now(site)
+
+    def mangle(self, site: str, data: bytes) -> bytes:
+        """Bytes-site injection point (ISSUE 13): returns the payload to
+        actually write. `corrupt` returns a seeded one-bit-flipped copy;
+        `torn` raises TornWriteError carrying the seeded prefix; every
+        NON-bytes mode behaves exactly like fire() here, so one spec can
+        target a write site whichever way the test needs."""
+        delay_s = 0.0
+        spec = None
+        with self._lock:
+            self.observed[site] = self.observed.get(site, 0) + 1
+            spec = self._match(site)
+            if spec is None:
+                return data
+            spec.calls += 1
+            if not spec.should_fire():
+                return data
+            spec.fires += 1
+            metrics.incr("nomad.faults.fired")
+            metrics.incr(f"nomad.faults.fired.{site}")
+            if spec.mode in _BYTES_MODES:
+                return spec.mangle_now(site, data)
+            if spec.mode == "delay":
+                delay_s = spec.delay_ms / 1000.0
+        if spec.mode == "delay":
+            time.sleep(delay_s)                     # outside the lock
+            return data
         spec.raise_now(site)
 
     def fired(self, site_or_pattern: str) -> int:
@@ -239,6 +312,17 @@ def fire(site: str) -> None:
     if plan is None:
         return
     plan.fire(site)
+
+
+def mangle(site: str, data: bytes) -> bytes:
+    """Bytes-site injection point: the caller writes whatever comes
+    back. No plan installed => one attribute read and the same bytes.
+    A `torn` spec raises TornWriteError — the site writes `.prefix`,
+    flushes, and re-raises (the simulated power loss)."""
+    plan = _plan
+    if plan is None:
+        return data
+    return plan.mangle(site, data)
 
 
 def fired(site: str) -> int:

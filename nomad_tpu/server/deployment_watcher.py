@@ -63,14 +63,23 @@ class DeploymentWatcher:
         """ref deployments_watcher.go:164 watchDeployments"""
         while not self._stop.wait(self.poll_interval):
             try:
-                for d in self.server.state.iter_deployments():
-                    if d.active():
-                        self._watch_one(d)
-                    else:
-                        self._seen_health.pop(d.id, None)
-                        self._progress_by.pop(d.id, None)
+                self.tick()
             except Exception as e:      # noqa: BLE001
                 self.server.logger(f"deployment-watcher: {e!r}")
+
+    def tick(self) -> None:
+        """One watcher pass over every deployment. Public so bounded-
+        wait tests can drive the state machine directly inside their
+        poll instead of racing the 0.25s loop on a loaded box (the PR-6
+        gossip-promote deflake pattern); an extra concurrent pass is
+        harmless — health folding dedups via _seen_health and the
+        status updates are idempotent."""
+        for d in self.server.state.iter_deployments():
+            if d.active():
+                self._watch_one(d)
+            else:
+                self._seen_health.pop(d.id, None)
+                self._progress_by.pop(d.id, None)
 
     # ----------------------------------------------------------- per-deploy
 

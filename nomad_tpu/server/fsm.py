@@ -15,7 +15,6 @@ from __future__ import annotations
 import dataclasses
 import pickle
 import threading
-import time
 from typing import Callable, Optional
 
 from ..state import StateStore
@@ -107,8 +106,14 @@ class NomadFSM:
         elif msg_type == NODE_DEREGISTER:
             s.delete_node(index, payload["node_ids"])
         elif msg_type == NODE_UPDATE_STATUS:
+            # replay determinism (ISSUE 13): applying a log entry must
+            # be a pure function of the entry — a wall-clock default
+            # here would re-stamp a DIFFERENT time when the entry
+            # re-applies after a restart, so restored state silently
+            # diverged from the state the cluster acked. Every emitter
+            # stamps updated_at explicitly (PR-10 satellite).
             s.update_node_status(index, payload["node_id"], payload["status"],
-                                 payload.get("updated_at", time.time()))
+                                 payload.get("updated_at", 0.0))
         elif msg_type == NODE_UPDATE_DRAIN:
             s.update_node_drain(index, payload["node_id"], payload.get("drain"),
                                 payload.get("mark_eligible", False))
@@ -195,10 +200,13 @@ class NomadFSM:
                 s.upsert_evals(index, [payload["eval"]])
                 self._notify_evals([payload["eval"]])
         elif msg_type == DEPLOYMENT_ALLOC_HEALTH:
+            # timestamp default 0.0, not time.time(): restart replay
+            # must reproduce the originally-applied state bit-for-bit
+            # (the watcher always stamps from its injectable clock)
             s.update_deployment_alloc_health(
                 index, payload["deployment_id"],
                 payload.get("healthy", []), payload.get("unhealthy", []),
-                payload.get("timestamp", time.time()))
+                payload.get("timestamp", 0.0))
             if payload.get("eval"):
                 s.upsert_evals(index, [payload["eval"]])
                 self._notify_evals([payload["eval"]])

@@ -10,20 +10,22 @@ and visible in the local FSM at the returned index, and every replica applies
 the identical message sequence (replay determinism; the scheduler's
 snapshot-min-index barrier, nomad/worker.go:536, builds on this).
 
-Persistence (checkpoint/resume, SURVEY.md §5): term/vote in a small metadata
-file, log entries in an append-only frame file, FSM snapshots with log
-truncation — a restarted server restores its FSM from the snapshot and
-reloads the log; entries past the snapshot re-apply through the applier
-only as commitment is re-established (ref raft-boltdb + fsm.go
-Snapshot/Restore; an ex-leader's unsynced tail may be truncated by the
-next leader, so it must never be applied eagerly at boot).
+Persistence (checkpoint/resume, SURVEY.md §5; crash consistency, ISSUE
+13): term/vote in a crc-enveloped metadata file, log entries in an
+append-only WAL whose frames carry (index, term, crc32) headers, FSM
+snapshots + log generations named by an atomically-replaced MANIFEST —
+all through `server/durable.py` (fsync discipline, fault sites, torn-
+write recovery: docs/DURABILITY.md). A restarted server restores its
+FSM from the snapshot and reloads the log; entries past the snapshot
+re-apply through the applier only as commitment is re-established (ref
+raft-boltdb + fsm.go Snapshot/Restore; an ex-leader's unsynced tail may
+be truncated by the next leader, so it must never be applied eagerly at
+boot).
 """
 from __future__ import annotations
 
 import os
-import pickle
 import random
-import struct
 import threading
 import time
 from typing import Callable, Optional
@@ -35,8 +37,6 @@ from ..rpc.codec import FencedWriteError, LeadershipLostError, NotLeaderError
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
-
-_FRAME = struct.Struct(">I")
 
 
 class _Entry:
@@ -86,8 +86,17 @@ class RaftNode:
         self.heartbeat_interval = heartbeat_interval
         self.snapshot_threshold = snapshot_threshold
         self.data_dir = data_dir
+        self._durable = None
         if data_dir:
-            os.makedirs(data_dir, exist_ok=True)
+            from .durable import DurableRaftDir
+            self._durable = DurableRaftDir(
+                data_dir, policy_fn=self._fsync_policy,
+                logger=lambda m: self.logger(m), scope=node_id)
+        # restore telemetry (tests + the operator debug bundle): how the
+        # last boot had to recover its on-disk state
+        self.log_quarantined = False
+        self.log_tail_truncated = 0
+        self.log_migrated = False
 
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
@@ -130,7 +139,6 @@ class RaftNode:
         # leadership observer (Server establish/revoke), called off-lock
         self.on_leadership_change: Callable[[bool], None] = lambda lead: None
 
-        self._log_file = None
         self._restore_from_disk()
 
         rpc_server.register("Raft.RequestVote", self._rpc_request_vote)
@@ -154,67 +162,65 @@ class RaftNode:
 
     # --------------------------------------------------------- persistence
 
-    def _meta_path(self):
-        return os.path.join(self.data_dir, "raft_meta.pickle")
-
-    def _log_path(self):
-        return os.path.join(self.data_dir, "raft_log.bin")
-
-    def _snap_path(self):
-        return os.path.join(self.data_dir, "raft_snapshot.bin")
+    def _fsync_policy(self) -> tuple:
+        """-> (mode, interval_s) for the durable dir. Reads the raft-
+        replicated SchedulerConfiguration each call — the same hot-
+        reload path as every other runtime knob; NOMAD_RAFT_FSYNC
+        (`mode` or `mode:interval_ms`) force-overrides for bench legs
+        and tests."""
+        env = os.environ.get("NOMAD_RAFT_FSYNC", "")
+        if env:
+            mode, _, iv = env.partition(":")
+            if mode in ("always", "interval", "never"):
+                try:
+                    interval = float(iv) / 1000.0 if iv else 0.05
+                except ValueError:
+                    interval = 0.05
+                return mode, interval
+        try:
+            cfg = self.fsm.state.get_scheduler_config()
+            return cfg.raft_fsync, cfg.raft_fsync_interval_ms / 1000.0
+        except Exception:       # noqa: BLE001 — config unreadable mid-
+            return "always", 0.0    # restore: default to safety
 
     def _persist_meta(self) -> None:
-        if not self.data_dir:
+        if self._durable is None:
             return
-        tmp = self._meta_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"term": self.current_term, "voted_for": self.voted_for,
-                         "peers": self.peers,
-                         "nonvoters": set(self.nonvoters)}, f)
-        os.replace(tmp, self._meta_path())
+        self._durable.save_meta(
+            {"term": self.current_term, "voted_for": self.voted_for,
+             "peers": self.peers, "nonvoters": set(self.nonvoters)})
 
     def _append_to_disk(self, entries: list[_Entry]) -> None:
-        if not self.data_dir:
+        """Append the TAIL `entries` (already in self.log) to the WAL."""
+        if self._durable is None or not entries:
             return
-        if self._log_file is None:
-            self._log_file = open(self._log_path(), "ab")
-        for e in entries:
-            blob = pickle.dumps((e.term, e.type, e.payload),
-                                protocol=pickle.HIGHEST_PROTOCOL)
-            self._log_file.write(_FRAME.pack(len(blob)) + blob)
-        self._log_file.flush()
+        start = self._last_index() - len(entries) + 1
+        self._durable.append(start,
+                             [(e.term, e.type, e.payload) for e in entries])
 
     def _rewrite_log_on_disk(self) -> None:
-        """After truncation/conflict resolution or snapshot compaction."""
-        if not self.data_dir:
+        """After truncation/conflict resolution: commit a new log
+        generation under the manifest (the snapshot is untouched)."""
+        if self._durable is None:
             return
-        if self._log_file is not None:
-            self._log_file.close()
-            self._log_file = None
-        tmp = self._log_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            for e in self.log:
-                blob = pickle.dumps((e.term, e.type, e.payload),
-                                    protocol=pickle.HIGHEST_PROTOCOL)
-                f.write(_FRAME.pack(len(blob)) + blob)
-        os.replace(tmp, self._log_path())
+        self._durable.commit_generation(
+            None, [(e.term, e.type, e.payload) for e in self.log],
+            self.base_index + 1)
 
-    def _persist_snapshot(self, data: bytes) -> None:
-        if not self.data_dir:
-            return
-        tmp = self._snap_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"index": self.base_index, "term": self.base_term,
-                         "data": data, "peers": self._base_peers,
-                         "nonvoters": set(self._base_nonvoters)}, f)
-        os.replace(tmp, self._snap_path())
+    def _snapshot_doc(self, data: bytes) -> dict:
+        return {"index": self.base_index, "term": self.base_term,
+                "data": data, "peers": dict(self._base_peers),
+                "nonvoters": set(self._base_nonvoters)}
 
     def _restore_from_disk(self) -> None:
-        if not self.data_dir:
+        if self._durable is None:
             return
-        if os.path.exists(self._snap_path()):
-            with open(self._snap_path(), "rb") as f:
-                snap = pickle.load(f)
+        st = self._durable.load()
+        self.log_quarantined = st.quarantined
+        self.log_tail_truncated = st.tail_truncated_frames
+        self.log_migrated = st.migrated
+        if st.snapshot is not None:
+            snap = st.snapshot
             self.fsm.restore_bytes(snap["data"])
             self.base_index = snap["index"]
             self.base_term = snap["term"]
@@ -226,26 +232,20 @@ class RaftNode:
                 self.nonvoters = set(snap.get("nonvoters", ()))
                 self._base_nonvoters = set(snap.get("nonvoters", ()))
             self.commit_index = self.last_applied = self.base_index
-        if os.path.exists(self._meta_path()):
-            with open(self._meta_path(), "rb") as f:
-                meta = pickle.load(f)
+        if st.meta is not None:
+            meta = st.meta
             self.current_term = meta["term"]
             self.voted_for = meta["voted_for"]
             if meta.get("peers"):
                 self.peers = dict(meta["peers"])
                 self.nonvoters = set(meta.get("nonvoters", ()))
-        if os.path.exists(self._log_path()):
-            with open(self._log_path(), "rb") as f:
-                raw = f.read()
-            off = 0
-            while off + 4 <= len(raw):
-                (ln,) = _FRAME.unpack_from(raw, off)
-                off += 4
-                if off + ln > len(raw):
-                    break       # torn tail write: drop it
-                term, type_, payload = pickle.loads(raw[off:off + ln])
+        if st.entries:
+            # frames are self-identifying: durable.load() already
+            # verified contiguity from base_index+1, CRC-truncated any
+            # torn tail, and quarantined mid-file damage — what arrives
+            # here is replayable by construction
+            for _idx, term, type_, payload in st.entries:
                 self.log.append(_Entry(term, type_, payload))
-                off += ln
             # Membership is adopted from the log at restore (config is
             # append-time state in this design), but the FSM is NOT:
             # a restarted server cannot know which tail entries were
@@ -312,9 +312,8 @@ class RaftNode:
             self._apply_cond.notify_all()
             for ev in self._replicate_events.values():
                 ev.set()
-        if self._log_file is not None:
-            self._log_file.close()
-            self._log_file = None
+        if self._durable is not None:
+            self._durable.close()
 
     # ------------------------------------------------------- public: apply
 
@@ -370,7 +369,16 @@ class RaftNode:
             entry = _Entry(self.current_term, msg_type, payload)
             self.log.append(entry)
             index = self._last_index()
-            self._append_to_disk([entry])
+            try:
+                self._append_to_disk([entry])
+            except Exception:
+                # durability first: the entry was never written, never
+                # replicated (the replicate events fire below), and the
+                # caller sees the failure — roll the in-memory log back
+                # so memory and disk stay one object
+                self.log.pop()
+                metrics.incr("nomad.raft.persist_errors")
+                raise
             if msg_type in ("_config_add", "_config_remove"):
                 # adopt the new configuration at append time (§4.1); a
                 # leader removing itself keeps replicating but no longer
@@ -653,9 +661,25 @@ class RaftNode:
                 if self.node_id in self.nonvoters:
                     deadline = self._election_deadline()
                     continue
+                prev_term, prev_vote = self.current_term, self.voted_for
                 self.current_term += 1
                 self.voted_for = self.node_id
-                self._persist_meta()
+                try:
+                    self._persist_meta()
+                except Exception as e:   # noqa: BLE001
+                    # an unpersisted self-vote must never be acted on: a
+                    # crash would forget it and this term could see a
+                    # second vote — revert to the PRIOR persisted pair
+                    # (never to None: that would erase the memory of a
+                    # vote already granted in prev_term and allow a
+                    # second grant there) and retry next deadline
+                    self.current_term = prev_term
+                    self.voted_for = prev_vote
+                    metrics.incr("nomad.raft.persist_errors")
+                    self.logger(f"raft: vote persist failed, campaign "
+                                f"aborted: {e!r}")
+                    deadline = self._election_deadline()
+                    continue
                 self.state = CANDIDATE
                 self._votes = 1
                 term = self.current_term
@@ -717,8 +741,6 @@ class RaftNode:
             # (Raft §8: a leader may only count replicas of current-term
             # entries toward commit)
             noop = _Entry(term, "_noop", {})
-            self.log.append(noop)
-            self._append_to_disk([noop])
             # make membership fully log-described: re-append the current
             # config so servers adopted later (gossip auto-join with a
             # trivial {self} base config) learn EVERY member — including
@@ -727,8 +749,20 @@ class RaftNode:
             cfg_entries = [_Entry(term, "_config_add",
                                   (pid, addr, pid not in self.nonvoters))
                            for pid, addr in self.peers.items()]
-            self.log.extend(cfg_entries)
-            self._append_to_disk(cfg_entries)
+            establish = [noop] + cfg_entries
+            self.log.extend(establish)
+            try:
+                self._append_to_disk(establish)
+            except Exception as e:   # noqa: BLE001
+                # a leader that cannot write its own log cannot lead:
+                # roll the entries back and step down — the next
+                # election re-tries (possibly on healed disk)
+                del self.log[-len(establish):]
+                metrics.incr("nomad.raft.persist_errors")
+                self.logger(f"raft: establishment append failed, "
+                            f"stepping down: {e!r}")
+                self._step_down_locked(self.current_term)
+                return
             self._match_index[self.node_id] = self._last_index()
             peers = {pid: addr for pid, addr in self.peers.items()
                      if pid != self.node_id}
@@ -754,7 +788,19 @@ class RaftNode:
             self.current_term = term
             self.voted_for = None
         self.state = FOLLOWER
-        self._persist_meta()
+        try:
+            self._persist_meta()
+        except Exception as e:   # noqa: BLE001
+            # stepping down must never fail: callers include the
+            # election/replication threads (an escaped exception kills
+            # the daemon for good) and the establishment-failure path
+            # (which would leave leader_id advertising a follower).
+            # Vote safety is unaffected — any future grant/campaign
+            # re-persists term+vote atomically BEFORE acting, and is
+            # itself withheld when that persist fails
+            metrics.incr("nomad.raft.persist_errors")
+            self.logger(f"raft: meta persist failed during step-down "
+                        f"(continuing as follower): {e!r}")
         if was_leader:
             self.leader_id = None
             self.leader_addr = ""
@@ -849,6 +895,11 @@ class RaftNode:
                     ev = self._replicate_events.get(pid)
                     if ev is not None:
                         ev.set()   # more to send
+            elif resp.get("retry"):
+                # follower persist hiccup, not a conflict: keep
+                # next_index where it is; the loop's heartbeat-interval
+                # wait retries the identical batch until the disk heals
+                pass
             else:
                 # conflict: back up (follower hints its last index)
                 hint = resp.get("last_index")
@@ -884,12 +935,22 @@ class RaftNode:
                 end = self.commit_index
                 batch = [(i, self._entry_at(i)) for i in range(start, end + 1)]
             for idx, e in batch:
-                if e.type == "_config_remove":
-                    with self._lock:
-                        self._apply_config_locked(e.payload)
-                elif e.type == "_config_add":
-                    with self._lock:
-                        self._apply_config_add_locked(e.payload)
+                if e.type in ("_config_remove", "_config_add"):
+                    try:
+                        with self._lock:
+                            if e.type == "_config_remove":
+                                self._apply_config_locked(e.payload)
+                            else:
+                                self._apply_config_add_locked(e.payload)
+                    except Exception as ex:   # noqa: BLE001
+                        # a meta-persist failure inside a config apply
+                        # must not kill the applier: the config is
+                        # adopted in memory and the LOG is the
+                        # authority at restore — the meta peers field
+                        # is a cache rebuilt from snapshot + log
+                        metrics.incr("nomad.raft.persist_errors")
+                        self.logger(f"raft: config apply persist "
+                                    f"failed at {idx}: {ex!r}")
                 elif e.type != "_noop":
                     try:
                         self.fsm.apply(idx, e.type, e.payload)
@@ -899,7 +960,17 @@ class RaftNode:
                 self.last_applied = end
                 self._apply_cond.notify_all()
                 if len(self.log) >= self.snapshot_threshold:
-                    self._compact_locked()
+                    try:
+                        self._compact_locked()
+                    except Exception as ex:   # noqa: BLE001
+                        # a failed compaction must not kill the applier:
+                        # the manifest still names the old consistent
+                        # generation, memory is already compacted, and
+                        # the next apply batch retries
+                        metrics.incr("nomad.raft.compact_failed")
+                        self.logger(
+                            f"raft: compaction persist failed "
+                            f"(retrying next batch): {ex!r}")
 
     def _compact_locked(self) -> None:
         """Snapshot the FSM and truncate the applied prefix of the log."""
@@ -924,8 +995,15 @@ class RaftNode:
                 self._base_nonvoters.discard(e.payload)
         self.log = self.log[keep_from:]
         self.base_index = snap_index
-        self._persist_snapshot(data)
-        self._rewrite_log_on_disk()
+        if self._durable is not None:
+            # ONE generation commit (snapshot + truncated log behind an
+            # atomic manifest replace) — the old persist-snapshot-then-
+            # rewrite-log pair left a crash window in which an
+            # index-less stale log shadowed the new snapshot (ISSUE 13)
+            self._durable.commit_generation(
+                self._snapshot_doc(data),
+                [(e.term, e.type, e.payload) for e in self.log],
+                self.base_index + 1)
 
     # ------------------------------------------------------- RPC handlers
 
@@ -943,8 +1021,25 @@ class RaftNode:
                 up_to_date = (last_term, last_idx) >= (my_term, my_last)
                 if up_to_date:
                     granted = True
+                    prev_vote = self.voted_for
                     self.voted_for = candidate_id
-                    self._persist_meta()
+                    try:
+                        # the vote must be durable BEFORE the grant
+                        # leaves this server (fsync=always): a granted-
+                        # then-forgotten vote is the double-vote hole
+                        self._persist_meta()
+                    except Exception as e:   # noqa: BLE001
+                        # revert to the PRIOR value (a retransmitted
+                        # grant's prev is the same candidate — setting
+                        # None instead would forget the original
+                        # persisted grant and free this term's vote)
+                        self.voted_for = prev_vote
+                        granted = False
+                        metrics.incr("nomad.raft.persist_errors")
+                        self.logger(f"raft: vote persist failed, grant "
+                                    f"withheld: {e!r}")
+                        return {"term": self.current_term,
+                                "granted": False}
                     self._last_contact = self.clock.monotonic()
                     # the old leader is presumed dead: stop advertising it
                     # for forwarding until the new leader heartbeats us
@@ -978,7 +1073,11 @@ class RaftNode:
                 entries = entries[skip:]
                 prev_idx = self.base_index
             # append, truncating conflicts; the common case is a pure
-            # append which hits the cheap append-only disk path
+            # append which hits the cheap append-only disk path.
+            # truncation REBINDS self.log (slice copy), so orig_log
+            # stays the untouched pre-RPC list — the persist-failure
+            # path below restores it wholesale, keeping memory == disk
+            orig_log = self.log
             truncated = False
             appended: list[_Entry] = []
             for i, (eterm, etype, epayload) in enumerate(entries):
@@ -992,15 +1091,43 @@ class RaftNode:
                 e = _Entry(eterm, etype, epayload)
                 self.log.append(e)
                 appended.append(e)
-            if truncated:
-                self._rewrite_log_on_disk()
-            elif appended:
-                self._append_to_disk(appended)
+            persist_ok = True
+            try:
+                if truncated:
+                    self._rewrite_log_on_disk()
+                elif appended:
+                    self._append_to_disk(appended)
+            except Exception as e:   # noqa: BLE001
+                persist_ok = False
+                metrics.incr("nomad.raft.persist_errors")
+                self.logger(f"raft: follower persist failed: {e!r}")
+                if truncated:
+                    # a failed conflict rewrite must not leave memory
+                    # truncated while disk still holds the old tail: a
+                    # leader RETRY would then match memory and ack
+                    # entries that never reached disk. Restore the
+                    # pre-RPC log; the retry re-runs the whole exchange
+                    self.log = orig_log
+                    truncated = False
+                elif appended:
+                    # pure-append failure: roll the tail back so memory
+                    # and disk agree, and make the leader retry
+                    del self.log[-len(appended):]
+                appended = []
             if truncated or any(e.type in ("_config_add", "_config_remove")
                                 for e in appended):
                 # adopt appended config entries immediately (§4.1) and roll
                 # back any truncated ones, in one recompute
                 self._recompute_config_locked()
+            if not persist_ok:
+                # `retry` distinguishes a LOCAL persist hiccup from a
+                # log conflict: the logs match, so the leader must not
+                # walk next_index backwards (that re-ships ever-larger
+                # matching prefixes and eventually a pointless
+                # InstallSnapshot) — it just retries the same batch
+                return {"term": self.current_term, "success": False,
+                        "retry": True,
+                        "last_index": self._last_index()}
             if leader_commit > self.commit_index:
                 self.commit_index = min(leader_commit, self._last_index())
                 self._commit_cond.notify_all()
@@ -1017,6 +1144,26 @@ class RaftNode:
             self._last_contact = self.clock.monotonic()
             if snap["index"] <= self.base_index:
                 return {"term": self.current_term}
+            if self._durable is not None:
+                # one atomic generation commit (snapshot + empty log +
+                # manifest): the pre-WAL code wrote snapshot and log as
+                # two files and a crash in between re-based the stale
+                # log under the new snapshot. Persist BEFORE mutating
+                # memory: if this raises, the handler surfaces the
+                # error with memory untouched, so the leader's RETRY
+                # is not short-circuited by an already-advanced
+                # base_index into never persisting (which would strand
+                # the durable dir's append cursor behind memory and
+                # fail every subsequent replication append)
+                peers = dict(snap["peers"]) if snap.get("peers") \
+                    else dict(self._base_peers)
+                nonvoters = set(snap.get("nonvoters", ())) \
+                    if snap.get("peers") else set(self._base_nonvoters)
+                self._durable.commit_generation(
+                    {"index": snap["index"], "term": snap["term"],
+                     "data": snap["data"], "peers": peers,
+                     "nonvoters": nonvoters},
+                    [], snap["index"] + 1)
             self.fsm.restore_bytes(snap["data"])
             self.base_index = snap["index"]
             self.base_term = snap["term"]
@@ -1028,7 +1175,5 @@ class RaftNode:
                 self._base_nonvoters = set(snap.get("nonvoters", ()))
             self.commit_index = max(self.commit_index, snap["index"])
             self.last_applied = snap["index"]
-            self._persist_snapshot(snap["data"])
-            self._rewrite_log_on_disk()
             self._persist_meta()
             return {"term": self.current_term}

@@ -2217,6 +2217,19 @@ class Server:
                 "State": self.raft_node.state,
                 "Health": self.raft_node.server_health(),
             })
+            # durable-storage state (ISSUE 13, docs/DURABILITY.md):
+            # generation, fsync discipline + counters, and how the last
+            # boot recovered (tail truncation / quarantine / migration)
+            dur = self.raft_node._durable
+            raft_block["Durability"] = {
+                "Stats": dur.stats() if dur is not None else None,
+                "Restore": {
+                    "Quarantined": self.raft_node.log_quarantined,
+                    "TailTruncatedFrames":
+                        self.raft_node.log_tail_truncated,
+                    "Migrated": self.raft_node.log_migrated,
+                },
+            }
         return {
             "Meta": {
                 "Name": self.name,

@@ -124,6 +124,22 @@ class SchedulerConfiguration:
       placement_explain_recent  how many recent explain records the
                               bounded process ring retains for the
                               operator debug bundle.
+      raft_fsync              fsync discipline for raft persistence
+                              (ISSUE 13, docs/DURABILITY.md): `always`
+                              fsyncs every append/meta/commit (the
+                              no-acked-entry-lost contract), `interval`
+                              paces append fsyncs at
+                              raft_fsync_interval_ms while still
+                              syncing commit points (manifest/meta/
+                              snapshot), `never` trusts the page cache
+                              (throughput over durability — a power
+                              loss may forget acked entries; a plain
+                              process crash still loses nothing).
+                              Hot-reloadable; NOMAD_RAFT_FSYNC
+                              (`mode` or `mode:interval_ms`) overrides
+                              for bench legs.
+      raft_fsync_interval_ms  append-fsync pacing for raft_fsync =
+                              interval.
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -155,6 +171,8 @@ class SchedulerConfiguration:
     flap_damping_backoff_max_s: float = 900.0
     placement_explain_enabled: bool = True
     placement_explain_recent: int = 256
+    raft_fsync: str = "always"
+    raft_fsync_interval_ms: float = 50.0
     create_index: int = 0
     modify_index: int = 0
 
@@ -207,4 +225,9 @@ class SchedulerConfiguration:
                     "flap_damping_backoff_s")
         if self.placement_explain_recent < 1:
             return "placement_explain_recent must be >= 1"
+        if self.raft_fsync not in ("always", "interval", "never"):
+            return ("raft_fsync must be one of 'always', 'interval', "
+                    "'never'")
+        if self.raft_fsync_interval_ms <= 0:
+            return "raft_fsync_interval_ms must be > 0"
         return ""

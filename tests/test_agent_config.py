@@ -140,3 +140,26 @@ def test_duration_literals():
     assert _duration("5m") == 300.0
     assert _duration("1h") == 3600.0
     assert _duration("2") == 2.0
+
+
+def test_server_identity_is_stable_across_restarts(tmp_path):
+    """ISSUE 13 restart-from-disk: an agent's raft identity must
+    survive a restart — the on-disk raft config names THIS server as a
+    voter, and a fresh random name per boot would leave the restarted
+    process an unknown peer that can never self-elect from its own
+    WAL. The generated name persists under data_dir; an explicit
+    node_name always wins."""
+    from nomad_tpu.agent.agent import Agent
+
+    cfg = AgentConfig(dev_mode=True, data_dir=str(tmp_path))
+    a1 = Agent(cfg)
+    name1 = a1.server.name
+    assert (tmp_path / "server_name").read_text() == name1
+
+    a2 = Agent(AgentConfig(dev_mode=True, data_dir=str(tmp_path)))
+    assert a2.server.name == name1          # reused, not re-rolled
+
+    named = Agent(AgentConfig(dev_mode=True, data_dir=str(tmp_path),
+                              node_name="explicit"))
+    assert named.server.name == "explicit"  # config wins, file untouched
+    assert (tmp_path / "server_name").read_text() == name1

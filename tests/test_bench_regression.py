@@ -464,6 +464,58 @@ def test_tracing_overhead_and_chain_completeness():
             f"{key!r}")
 
 
+def test_crash_recovery_gate():
+    """ISSUE 13 acceptance: once a bench records the crash_recovery
+    block, the durable-storage lineage must show (a) ZERO lost commits
+    — every raft apply acked under fsync=always survives the restart;
+    (b) bounded recovery — replay-bound restart under 10s on the dev
+    sim and the post-compaction restart no slower than the long-log
+    one beyond noise; (c) the fsync disciplines actually form the
+    documented ladder — `interval` keeps >= 0.3x of `never`'s apply
+    throughput (docs/DURABILITY.md) and `always` is the slowest-or-
+    equal, or the pacing knob silently stopped pacing."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    cr = latest.get("crash_recovery")
+    if isinstance(cr, dict) and "error" in cr:
+        pytest.fail(f"BENCH_r{latest_round:02d}: crash-recovery lineage "
+                    f"run crashed: {cr['error']}")
+    if not isinstance(cr, dict) or "lost_commits" not in cr:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the "
+                    f"crash-recovery lineage")
+    assert cr["lost_commits"] == 0, (
+        f"BENCH_r{latest_round:02d}: {cr['lost_commits']} acked "
+        f"commit(s) lost across restart at fsync=always — the WAL "
+        f"durability contract is broken")
+    assert cr["recovered_entries_post_compaction"] >= \
+        cr["acked_entries"], (
+        f"BENCH_r{latest_round:02d}: compaction lost committed state")
+    assert cr["restart_s_long_log"] < 10.0, (
+        f"BENCH_r{latest_round:02d}: {cr['restart_s_long_log']}s to "
+        f"restart from a {cr['log_frames_long']}-frame log breaches "
+        f"the 10s dev-sim recovery budget")
+    # compaction exists to bound replay: the snapshot-bound restart
+    # must not be slower than the replay-bound one beyond 50% noise
+    assert cr["restart_s_post_compaction"] <= \
+        cr["restart_s_long_log"] * 1.5, (
+        f"BENCH_r{latest_round:02d}: post-compaction restart "
+        f"({cr['restart_s_post_compaction']}s) slower than the "
+        f"long-log restart ({cr['restart_s_long_log']}s) — snapshot "
+        f"restore regressed")
+    frac = cr["fsync_interval_vs_never_frac"]
+    assert frac >= 0.3, (
+        f"BENCH_r{latest_round:02d}: fsync=interval throughput is only "
+        f"{frac:.0%} of fsync=never — interval pacing stopped "
+        f"amortizing the sync cost (docs/DURABILITY.md documents the "
+        f">=0.3x contract)")
+    assert cr["fsync_always_entries_per_s"] <= \
+        cr["fsync_never_entries_per_s"] * 1.1, (
+        f"BENCH_r{latest_round:02d}: fsync=always out-ran fsync=never "
+        f"— the discipline knob is not reaching the write path")
+
+
 def test_explain_overhead_gate():
     """ISSUE 11 acceptance: once a bench records the `explain` block,
     the placement-explain byproduct (per-solve fixed-shape reduce +

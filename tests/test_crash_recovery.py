@@ -1,0 +1,671 @@
+"""Crash-point recovery fuzzer (ISSUE 13 tentpole, marker `chaos`).
+
+Kills a raft server at every enumerated persistence point — WAL append
+(raised and TORN mid-frame), fsync, meta (term/vote), snapshot,
+manifest commit — restarts it from disk, and asserts the raft
+invariants the durable layer (server/durable.py, docs/DURABILITY.md)
+exists to keep:
+
+  * no acked-committed entry lost (fsync=always — the default);
+  * restored FSM bit-identical to a never-crashed oracle that applied
+    the same committed prefix;
+  * at most one vote per term across restart (term+vote ride one
+    crc-enveloped atomic meta write; a server that cannot persist a
+    vote ABSTAINS instead of voting volatile);
+  * CRC-detected tail damage truncated at the last valid frame, while
+    pre-commit-index (mid-file) corruption quarantines the log and
+    recovers via the leader's InstallSnapshot;
+  * the solver state cache reseeds cleanly after restart (fresh usage
+    uid) with post-restart placement bit-parity against a
+    never-crashed server.
+
+Everything is deterministic: virtual transport, seeded election
+jitter, seeded fault plans, pickle-copied payload scripts.
+"""
+import os
+import pickle
+import time
+
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.rpc.virtual import VirtualNetwork
+from nomad_tpu.server import Server
+from nomad_tpu.server import durable
+from nomad_tpu.server.fsm import JOB_REGISTER, NODE_REGISTER
+from nomad_tpu.structs import Evaluation
+
+pytestmark = pytest.mark.chaos
+
+FAST = dict(election_timeout=(0.5, 1.0), heartbeat_interval=0.08)
+DISK = dict(election_timeout=(1.2, 2.4), heartbeat_interval=0.15)
+
+
+def wait_until(fn, timeout=10.0, step=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _copy(obj):
+    return pickle.loads(pickle.dumps(obj))
+
+
+def _mk_server(net, name, data_dir, snapshot_threshold=8192, seed=1,
+               workers=1, timing=FAST):
+    s = Server(num_workers=workers, gc_interval=9999)
+    s.rpc_listen_virtual(net, name)
+    s.enable_raft(name, {name: s.rpc_addr},
+                  data_dir=str(data_dir) if data_dir else None,
+                  snapshot_threshold=snapshot_threshold, seed=seed,
+                  **timing)
+    s.start()
+    return s
+
+
+# ------------------------------------------------------------ the script
+
+def _build_script():
+    """12 deterministic data ops with NO scheduler side effects (jobs
+    ride without evals), so FSM state is a pure function of the applied
+    prefix and snapshot_bytes can be compared bit-for-bit."""
+    ops = []
+    for i in range(12):
+        if i % 2 == 0:
+            ops.append((NODE_REGISTER, {"node": mock.node()}))
+        else:
+            ops.append((JOB_REGISTER, {"job": mock.job()}))
+    return ops
+
+
+def _apply_script(server, ops, stop_on_error):
+    """-> (acked_ids, last_attempted). Each payload is pickle-copied so
+    one script drives many servers without shared mutation."""
+    acked = []
+    last_attempted = -1
+    for i, (msg_type, payload) in enumerate(ops):
+        last_attempted = i
+        try:
+            server.raft.apply(msg_type, _copy(payload), timeout=10.0)
+            acked.append(i)
+        except Exception:   # noqa: BLE001 — injected crash
+            if stop_on_error:
+                break
+    return acked, last_attempted
+
+
+def _present_map(server, ops):
+    """Which script ops' effects are visible in the restored FSM."""
+    present = []
+    for msg_type, payload in ops:
+        if msg_type == NODE_REGISTER:
+            present.append(
+                server.state.node_by_id(payload["node"].id) is not None)
+        else:
+            present.append(server.state.job_by_id(
+                "default", payload["job"].id) is not None)
+    return present
+
+
+@pytest.fixture(scope="module")
+def script_and_oracle(tmp_path_factory):
+    """One never-crashed oracle run: oracle_snaps[k] is the FSM's
+    snapshot_bytes after the first k script ops (indexes identical to
+    the disk servers': same establishment entries, same sole voter)."""
+    ops = _build_script()
+    net = VirtualNetwork(seed=99)
+    oracle = _mk_server(net, "o0", None, seed=1)
+    try:
+        assert wait_until(lambda: oracle.raft_node.is_leader())
+        snaps = [oracle.fsm.snapshot_bytes()]
+        for msg_type, payload in ops:
+            oracle.raft.apply(msg_type, _copy(payload), timeout=10.0)
+            snaps.append(oracle.fsm.snapshot_bytes())
+    finally:
+        oracle.shutdown()
+    return ops, snaps
+
+
+# ---------------------------------------------- Part A: single-node sweep
+
+# (site, spec, stop_on_error): `after` models a disk that dies at the
+# n-th write and stays dead (the process lingers, then the box dies);
+# `torn` models power loss mid-write (the script stops immediately).
+# Append call #1 is the leader's establishment batch; compactions
+# (snapshot_threshold=6) also bill disk.append for the generation log.
+CRASH_POINTS = (
+    [("disk.append", {"mode": "after", "n": k}, False)
+     for k in (1, 2, 3, 5, 8)]
+    + [("disk.append", {"mode": "torn", "n": k, "times": 1,
+                        "seed": 13 + k}, True) for k in (1, 2, 5, 8)]
+    + [("disk.fsync", {"mode": "after", "n": k}, False) for k in (1, 3)]
+    + [("disk.meta", {"mode": "after", "n": k}, False) for k in (1, 2)]
+    + [("disk.snapshot", {"mode": "after", "n": 1}, False),
+       ("disk.manifest", {"mode": "after", "n": 1}, False),
+       ("disk.manifest", {"mode": "torn", "n": 1, "times": 1,
+                          "seed": 5}, True)]
+)
+
+
+@pytest.mark.parametrize("site,spec,stop", CRASH_POINTS,
+                         ids=[f"{s}-{sp['mode']}-n{sp.get('n', 1)}"
+                              for s, sp, _ in CRASH_POINTS])
+def test_crash_point_sweep_single_node(tmp_path, script_and_oracle,
+                                       site, spec, stop):
+    ops, oracle_snaps = script_and_oracle
+    net = VirtualNetwork(seed=3)
+    root = tmp_path / "raft"
+
+    a = _mk_server(net, "s0", root, snapshot_threshold=6, seed=1)
+    became_leader = wait_until(lambda: a.raft_node.is_leader(), timeout=8)
+    if spec["n"] == 1 and site in ("disk.meta", "disk.fsync",
+                                   "disk.append"):
+        # n=1 kills establishment/boot-path writes: installing before
+        # the first campaign finishes is racy in-process, so re-create
+        # the server with the fault active from boot instead (boot
+        # itself may crash — that IS an enumerated point)
+        a.shutdown()
+        for f in os.listdir(root):
+            os.unlink(root / f)
+        faults.install({site: spec})
+        try:
+            a = _mk_server(net, "s0", root, snapshot_threshold=6, seed=1)
+        except Exception:   # noqa: BLE001 — crashed during first boot
+            a = None
+            became_leader = False
+        else:
+            became_leader = wait_until(lambda: a.raft_node.is_leader(),
+                                       timeout=1.5)
+    else:
+        assert became_leader
+        faults.install({site: spec})
+
+    acked, last_attempted = [], -1
+    if became_leader:
+        acked, last_attempted = _apply_script(a, ops, stop_on_error=stop)
+        # give the async applier a beat so compaction-site faults fire
+        if site in ("disk.snapshot", "disk.manifest"):
+            wait_until(lambda: faults.fired(site) > 0, timeout=5)
+    if a is not None:
+        a.shutdown()
+    faults.clear()      # the restart models a healed machine
+
+    b = _mk_server(net, "s0", root, snapshot_threshold=6, seed=1)
+    try:
+        assert wait_until(lambda: b.raft_node.is_leader(), timeout=8)
+        present = _present_map(b, ops)
+        # invariant 1: fsync=always (the default) loses NOTHING acked
+        lost = [i for i in acked if not present[i]]
+        assert not lost, (
+            f"{site} {spec}: acked op(s) {lost} did not survive the "
+            f"crash (present={present})")
+        k = 0
+        while k < len(ops) and present[k]:
+            k += 1
+        extras = [i for i in range(k, len(ops)) if present[i]]
+        if not extras:
+            # invariant 2: restored FSM identical to the never-crashed
+            # oracle at the same prefix — field-exact structural
+            # equality of every table (pickle BYTES can differ on
+            # shared-reference memoization after a restore round trip
+            # while every value is equal, so == on the unpickled
+            # tables is the honest check)
+            assert pickle.loads(b.fsm.snapshot_bytes()) == \
+                pickle.loads(oracle_snaps[k]), (
+                f"{site} {spec}: restored FSM diverged from the oracle "
+                f"at prefix {k}")
+        else:
+            # an fsync-failure crash may leave the LAST attempt's frame
+            # on disk: valid bytes the caller rolled back in memory
+            # (failed applies free their index for the next attempt, so
+            # the surviving frame carries a later op at an early
+            # index). It was never acked — recovering it is the legal
+            # "appended entry may still commit" raft outcome — but
+            # NOTHING ELSE unacked may surface
+            assert extras == [last_attempted], (
+                f"{site} {spec}: unacked op(s) {extras} surfaced "
+                f"(only the last attempt {last_attempted} may)")
+            assert last_attempted not in acked
+    finally:
+        b.shutdown()
+
+
+def test_crash_during_compaction_window_is_atomic(tmp_path,
+                                                  script_and_oracle):
+    """The _compact_locked crash window the manifest closed: tear the
+    GENERATION commit (snapshot written, manifest replace torn) and
+    assert restore serves the OLD generation — never a new snapshot
+    over a stale re-based log."""
+    ops, oracle_snaps = script_and_oracle
+    net = VirtualNetwork(seed=4)
+    root = tmp_path / "raft"
+    a = _mk_server(net, "s0", root, snapshot_threshold=6, seed=1)
+    assert wait_until(lambda: a.raft_node.is_leader())
+    faults.install({"disk.manifest": {"mode": "torn", "n": 1, "times": 1,
+                                      "seed": 11}})
+    acked, _ = _apply_script(a, ops, stop_on_error=False)
+    assert wait_until(lambda: faults.fired("disk.manifest") > 0, timeout=5)
+    a.shutdown()
+    faults.clear()
+
+    b = _mk_server(net, "s0", root, snapshot_threshold=6, seed=1)
+    try:
+        assert wait_until(lambda: b.raft_node.is_leader())
+        assert not b.raft_node.log_quarantined
+        present = _present_map(b, ops)
+        assert all(present[i] for i in acked)
+        assert present == [True] * len(ops)     # appends were unaffected
+        assert pickle.loads(b.fsm.snapshot_bytes()) == \
+            pickle.loads(oracle_snaps[len(ops)])
+    finally:
+        b.shutdown()
+
+
+def test_fsync_never_still_survives_clean_process_crash(tmp_path,
+                                                        script_and_oracle):
+    """raft_fsync=never trades power-loss durability for throughput,
+    but a plain process death (no kernel loss) must still recover
+    everything — the writes happened, only the fsyncs were skipped."""
+    ops, oracle_snaps = script_and_oracle
+    net = VirtualNetwork(seed=5)
+    root = tmp_path / "raft"
+    os.environ["NOMAD_RAFT_FSYNC"] = "never"
+    try:
+        a = _mk_server(net, "s0", root, seed=1)
+        assert wait_until(lambda: a.raft_node.is_leader())
+        acked, _ = _apply_script(a, ops, stop_on_error=False)
+        assert len(acked) == len(ops)
+        a.shutdown()
+
+        b = _mk_server(net, "s0", root, seed=1)
+        try:
+            assert wait_until(lambda: b.raft_node.is_leader())
+            assert _present_map(b, ops) == [True] * len(ops)
+            assert pickle.loads(b.fsm.snapshot_bytes()) == \
+                pickle.loads(oracle_snaps[len(ops)])
+        finally:
+            b.shutdown()
+    finally:
+        os.environ.pop("NOMAD_RAFT_FSYNC", None)
+
+
+# ------------------------- Part B: placement parity + state-cache reseed
+
+def test_placement_bit_parity_and_state_cache_reseed_after_crash(tmp_path):
+    """After a torn-append crash + restart, the restored server must
+    place EXACTLY what a never-crashed server places (same snapshot,
+    same pinned eval id => same seeded placement), and the usage index
+    mints a fresh uid so the solver state cache reseeds instead of
+    advancing stale device twins."""
+    from nomad_tpu.solver import state_cache
+
+    net = VirtualNetwork(seed=7)
+    root = tmp_path / "raft"
+    nodes = [mock.node() for _ in range(3)]
+    job = mock.job()
+    eval_id = "0000feed-beef-0000-0000-00000000c0de"
+
+    a = _mk_server(net, "s0", root, seed=1, workers=2)
+    assert wait_until(lambda: a.raft_node.is_leader())
+    for n in nodes:
+        a.raft.apply(NODE_REGISTER, {"node": _copy(n)})
+    uid_before = a.state.usage.uid
+    assert uid_before != 0
+    # power loss tears the NEXT append mid-frame
+    faults.install({"disk.append": {"mode": "torn", "n": 1, "times": 1,
+                                    "seed": 21}})
+    with pytest.raises(Exception):
+        a.raft.apply(JOB_REGISTER, {"job": _copy(mock.job())})
+    faults.clear()
+    a.shutdown()
+
+    b = _mk_server(net, "s0", root, seed=1, workers=2)
+    oracle = _mk_server(VirtualNetwork(seed=8), "o0", None, seed=1,
+                        workers=2)
+    try:
+        assert wait_until(lambda: b.raft_node.is_leader())
+        assert wait_until(lambda: oracle.raft_node.is_leader())
+        # restore rebuilt the usage index under a FRESH uid: any state
+        # cache keyed to the old store declines and reseeds (uid mint)
+        assert b.state.usage.uid not in (0, uid_before)
+        out = state_cache.reseed(b.state)
+        assert isinstance(out, dict)
+        for n in nodes:
+            oracle.raft.apply(NODE_REGISTER, {"node": _copy(n)})
+
+        placements = {}
+        for tag, server in (("restored", b), ("oracle", oracle)):
+            ev = Evaluation(id=eval_id, namespace="default",
+                            priority=job.priority, type=job.type,
+                            job_id=job.id)
+            server.raft.apply(JOB_REGISTER, {"job": _copy(job),
+                                             "evals": [_copy(ev)]})
+            count = sum(tg.count for tg in job.task_groups)
+            assert wait_until(lambda: len(server.state.allocs_by_job(
+                "default", job.id)) >= count, timeout=15), \
+                f"{tag}: placement never landed"
+            placements[tag] = {
+                al.name: al.node_id
+                for al in server.state.allocs_by_job("default", job.id)}
+        # invariant: post-restart placement bit-parity
+        assert placements["restored"] == placements["oracle"]
+    finally:
+        oracle.shutdown()
+        b.shutdown()
+
+
+# --------------------------------------- Part C: cluster-level invariants
+
+def _mk_cluster(n, net, tmp_path, snapshot_threshold=8192,
+                workers=1):
+    servers = []
+    for i in range(n):
+        s = Server(num_workers=workers, gc_interval=9999)
+        s.rpc_listen_virtual(net, f"s{i}")
+        servers.append(s)
+    peers = {f"s{i}": s.rpc_addr for i, s in enumerate(servers)}
+    for i, s in enumerate(servers):
+        s.enable_raft(f"s{i}", peers,
+                      data_dir=str(tmp_path / f"raft{i}"),
+                      snapshot_threshold=snapshot_threshold,
+                      seed=1000 + i, **DISK)
+        s.start()
+    return servers
+
+
+def _stable_leader(servers, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        led = [s for s in servers if s.raft_node.is_leader()]
+        if len(led) == 1 and led[0].is_leader:
+            return led[0]
+        time.sleep(0.01)
+    raise AssertionError("no stable leader")
+
+
+def test_follower_torn_append_restart_converges(tmp_path):
+    """Tear ONE follower's WAL mid-replication (scoped disk site),
+    restart it, and assert it converges back to the leader's exact
+    state — no double apply, no lost committed entry."""
+    net = VirtualNetwork(seed=31)
+    servers = _mk_cluster(3, net, tmp_path)
+    try:
+        leader = _stable_leader(servers)
+        jobs = [mock.job() for _ in range(6)]
+        for j in jobs[:3]:
+            leader.job_register(j)
+        victim = next(s for s in servers if s is not leader)
+        vid = victim.raft_node.node_id
+        # this follower's disk dies torn; its peers keep writing
+        faults.install({f"disk.append.{vid}":
+                        {"mode": "torn", "n": 1, "times": 1, "seed": 17}})
+        for j in jobs[3:]:
+            leader.job_register(j)      # commits via the OTHER follower
+        assert wait_until(
+            lambda: faults.fired(f"disk.append.{vid}") > 0, timeout=10)
+        net.crash(vid)
+        victim.shutdown()
+        faults.clear()
+        live = [s for s in servers if s is not victim]
+        assert wait_until(lambda: all(
+            s.state.job_by_id("default", jobs[-1].id) is not None
+            for s in live), timeout=20)
+
+        net.restart(vid)
+        idx = int(vid[1:])
+        s2 = Server(num_workers=1, gc_interval=9999)
+        s2.rpc_listen_virtual(net, vid)
+        s2.enable_raft(vid,
+                       {f"s{i}": s.rpc_addr
+                        for i, s in enumerate(servers)},
+                       data_dir=str(tmp_path / f"raft{idx}"),
+                       seed=1000 + idx, **DISK)
+        s2.start()
+        try:
+            assert wait_until(lambda: all(
+                s2.state.job_by_id("default", j.id) is not None
+                for j in jobs), timeout=30)
+            # exactly once: job version history shows ONE registration
+            for j in jobs:
+                assert s2.state.job_by_id("default", j.id).version == 0
+        finally:
+            s2.shutdown()
+    finally:
+        faults.clear()
+        for s in servers:
+            s.shutdown()
+
+
+def test_vote_durability_and_abstention(tmp_path):
+    """(a) a server whose meta disk is dead ABSTAINS from voting — the
+    vote must be durable BEFORE the grant leaves the server, so a
+    persist failure withholds the grant (the volatile-vote double-vote
+    hole is closed at the source); (b) across a restart, a persisted
+    (term, vote) pair is restored exactly — term and vote ride ONE
+    atomic crc envelope, so a server remembers both or neither."""
+    net = VirtualNetwork(seed=33)
+    servers = _mk_cluster(3, net, tmp_path)
+    try:
+        leader = _stable_leader(servers)
+        leader.job_register(mock.job())
+        followers = [s for s in servers if s is not leader]
+        broken = followers[0]
+        bid = broken.raft_node.node_id
+        node = broken.raft_node
+        with node._lock:
+            term_before = node.current_term
+            vote_before = node.voted_for
+        faults.install({f"disk.meta.{bid}": {"mode": "after", "n": 1}})
+
+        # a candidate of a FUTURE term asks for a vote it would win on
+        # log freshness — the dead meta disk must withhold the grant
+        # (the step-down persist or the grant persist raises; either
+        # way no grant leaves the server)
+        with node._lock:
+            last_idx = node._last_index()
+            last_term = node._term_at(last_idx)
+        try:
+            resp = node._rpc_request_vote(term_before + 10, "candidate-x",
+                                          last_idx + 100, last_term + 10)
+            granted = resp["granted"]
+        except Exception:   # noqa: BLE001 — persist failure surfaced
+            granted = False
+        assert not granted
+        assert faults.fired(f"disk.meta.{bid}") > 0
+        # nothing volatile either: a crash right now forgets no grant,
+        # because none was made — disk still shows the OLD pair
+        disk_meta = durable.DurableRaftDir(
+            str(tmp_path / f"raft{int(bid[1:])}")).load_meta()
+        assert disk_meta["term"] == term_before
+        assert disk_meta["voted_for"] == vote_before
+        faults.clear()
+        # healed disk: a grant persists BEFORE it leaves the server.
+        # (+50, not +10: the failed step-down may have bumped the
+        # in-memory term and churned the live cluster's elections — a
+        # far-future term out-ranks whatever the churn reached)
+        resp = node._rpc_request_vote(term_before + 50, "candidate-x",
+                                      last_idx + 100, last_term + 50)
+        assert resp["granted"]
+        disk_meta = durable.DurableRaftDir(
+            str(tmp_path / f"raft{int(bid[1:])}")).load_meta()
+        assert disk_meta["term"] == term_before + 50
+        assert disk_meta["voted_for"] == "candidate-x"
+
+        # a RETRANSMITTED grant whose persist fails must revert to the
+        # PRIOR vote (candidate-x), never to None — forgetting the
+        # original persisted grant would free this term's vote for a
+        # different candidate (the double-vote hole, review-hardened)
+        faults.install({f"disk.meta.{bid}": {"mode": "after", "n": 1}})
+        resp = node._rpc_request_vote(term_before + 50, "candidate-x",
+                                      last_idx + 100, last_term + 50)
+        assert not resp["granted"]
+        with node._lock:
+            assert node.voted_for == "candidate-x"
+        faults.clear()
+        resp = node._rpc_request_vote(term_before + 50, "candidate-y",
+                                      last_idx + 100, last_term + 50)
+        assert not resp["granted"]      # term's vote still candidate-x's
+
+        # (b) restart the OTHER follower and compare meta restoration.
+        # Freeze it FIRST (crash + shutdown + let its election loop
+        # exit), then read memory and disk in a settled state
+        other = followers[1]
+        oid = other.raft_node.node_id
+        net.crash(oid)
+        other.shutdown()
+        time.sleep(0.3)
+        with other.raft_node._lock:
+            mem_term = other.raft_node.current_term
+            mem_vote = other.raft_node.voted_for
+        disk_meta = durable.DurableRaftDir(
+            str(tmp_path / f"raft{int(oid[1:])}")).load_meta()
+        assert disk_meta["term"] == mem_term
+        assert disk_meta["voted_for"] == mem_vote
+
+        net.restart(oid)
+        idx = int(oid[1:])
+        s2 = Server(num_workers=1, gc_interval=9999)
+        s2.rpc_listen_virtual(net, oid)
+        s2.enable_raft(oid,
+                       {f"s{i}": s.rpc_addr
+                        for i, s in enumerate(servers)},
+                       data_dir=str(tmp_path / f"raft{idx}"),
+                       seed=1000 + idx, **DISK)
+        try:
+            # restored BEFORE start(): at most one vote per term — the
+            # server remembers exactly the pair it persisted
+            assert s2.raft_node.current_term == mem_term
+            assert s2.raft_node.voted_for == mem_vote
+            s2.start()
+        finally:
+            s2.shutdown()
+    finally:
+        faults.clear()
+        for s in servers:
+            s.shutdown()
+
+
+def test_midfile_corruption_quarantines_and_recovers_via_snapshot(
+        tmp_path):
+    """Pre-commit-index corruption: flip a byte in an EARLY frame of a
+    follower's WAL while later frames stay valid. The restore must NOT
+    replay around the damage — the log quarantines, the follower
+    restores from its own snapshot, and the leader's InstallSnapshot /
+    AppendEntries catch-up converges it."""
+    net = VirtualNetwork(seed=37)
+    # high threshold so the VICTIM's WAL still holds frames to corrupt
+    # (a compaction would leave it nearly empty)
+    servers = _mk_cluster(3, net, tmp_path, snapshot_threshold=500)
+    try:
+        leader = _stable_leader(servers)
+        jobs = [mock.job() for _ in range(8)]
+        for j in jobs:
+            leader.job_register(j)
+        victim = next(s for s in servers if s is not leader)
+        vid = victim.raft_node.node_id
+        idx = int(vid[1:])
+        assert wait_until(lambda: victim.state.job_by_id(
+            "default", jobs[-1].id) is not None, timeout=20)
+        net.crash(vid)
+        victim.shutdown()
+
+        # leader moves on AND compacts past the victim's log, so the
+        # quarantined victim must be served an InstallSnapshot
+        more = [mock.job() for _ in range(12)]
+        leader.raft_node.snapshot_threshold = 1
+        for j in more:
+            leader.job_register(j)
+        assert wait_until(lambda: leader.raft_node.base_index > 0,
+                          timeout=10)
+
+        root = tmp_path / f"raft{idx}"
+        man = durable._read_envelope(str(root / durable.MANIFEST))
+        log_path = str(root / man["log"])
+        raw = bytearray(open(log_path, "rb").read())
+        assert len(raw) > 64, "victim log unexpectedly small"
+        raw[24] ^= 0x08                 # damage an EARLY frame
+        with open(log_path, "wb") as f:
+            f.write(bytes(raw))
+
+        net.restart(vid)
+        s2 = Server(num_workers=1, gc_interval=9999)
+        s2.rpc_listen_virtual(net, vid)
+        s2.enable_raft(vid,
+                       {f"s{i}": s.rpc_addr
+                        for i, s in enumerate(servers)},
+                       data_dir=str(root), seed=1000 + idx, **DISK)
+        s2.start()
+        try:
+            assert s2.raft_node.log_quarantined, \
+                "mid-file damage was not quarantined"
+            assert os.path.exists(log_path + ".quarantined")
+            assert wait_until(lambda: all(
+                s2.state.job_by_id("default", j.id) is not None
+                for j in jobs + more), timeout=30), \
+                "quarantined follower never converged"
+            assert s2.raft_node.base_index > 0     # snapshot installed
+        finally:
+            s2.shutdown()
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_install_snapshot_persist_failure_is_retryable(tmp_path):
+    """Review-hardened: the follower persists an installed snapshot
+    BEFORE mutating memory. If persist ran after, a failure would leave
+    base_index advanced in memory, the leader's retry would
+    short-circuit on `index <= base_index` without ever persisting,
+    and the stranded durable append cursor would fail every subsequent
+    replication forever. With persist-first, the retry simply re-runs
+    the install once the disk heals."""
+    net = VirtualNetwork(seed=41)
+    servers = _mk_cluster(3, net, tmp_path, snapshot_threshold=500)
+    try:
+        leader = _stable_leader(servers)
+        victim = next(s for s in servers if s is not leader)
+        vid = victim.raft_node.node_id
+        jobs = [mock.job() for _ in range(4)]
+        for j in jobs[:2]:
+            leader.job_register(j)
+        assert wait_until(lambda: victim.state.job_by_id(
+            "default", jobs[1].id) is not None, timeout=20)
+
+        # partition the victim, move the leader past its log horizon
+        net.crash(vid)
+        leader.raft_node.snapshot_threshold = 1
+        for j in jobs[2:]:
+            leader.job_register(j)
+        assert wait_until(lambda: leader.raft_node.base_index > 0,
+                          timeout=10)
+        base_before = victim.raft_node.base_index
+
+        # the victim's manifest disk is dead: every install fails...
+        faults.install({f"disk.manifest.{vid}": {"mode": "after", "n": 1}})
+        net.restart(vid)
+        assert wait_until(
+            lambda: faults.fired(f"disk.manifest.{vid}") >= 2, timeout=20), \
+            "leader stopped retrying the failed InstallSnapshot"
+        # ...and memory was never advanced past what disk can back
+        assert victim.raft_node.base_index == base_before
+        # disk heals: the retry completes and the victim converges
+        faults.clear()
+        assert wait_until(lambda: all(
+            victim.state.job_by_id("default", j.id) is not None
+            for j in jobs), timeout=30), \
+            "victim never converged after the disk healed"
+        assert victim.raft_node.base_index > base_before
+    finally:
+        faults.clear()
+        for s in servers:
+            s.shutdown()

@@ -1,0 +1,522 @@
+"""Unit tier for the crash-consistent durable storage (ISSUE 13,
+server/durable.py): frame format + CRC detection, the manifest commit
+point, fsync discipline, torn/corrupt fault modes, the corruption
+recovery matrix (tail truncate vs mid-file quarantine vs stale-log
+drop), and the legacy (pre-WAL) migration. The end-to-end crash-point
+fuzzer lives in tests/test_crash_recovery.py."""
+import os
+import pickle
+import struct
+
+import pytest
+
+from nomad_tpu import faults
+from nomad_tpu.server import durable
+from nomad_tpu.server.durable import DurableRaftDir
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def mk(tmp_path, mode="always", interval=0.0):
+    return DurableRaftDir(str(tmp_path / "raft"),
+                          policy_fn=lambda: (mode, interval))
+
+
+def seed(d, n=5, start=1, term=1):
+    d.append(start, [(term, f"t{start + i}", {"i": start + i})
+                     for i in range(n)])
+
+
+def entries_of(load):
+    return [(idx, type_) for idx, _term, type_, _p in load.entries]
+
+
+# --------------------------------------------------------------- basics
+
+def test_append_load_roundtrip(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 5)
+    d.append(6, [(2, "x", {"payload": list(range(10))})])
+    d.close()
+
+    d2 = mk(tmp_path)
+    st = d2.load()
+    assert not st.quarantined and not st.migrated
+    assert st.tail_truncated_frames == 0
+    assert [e[0] for e in st.entries] == [1, 2, 3, 4, 5, 6]
+    assert st.entries[5][2] == "x"
+    assert st.entries[5][3] == {"payload": list(range(10))}
+    assert st.entries[2][1] == 1        # term survives the frame header
+
+
+def test_meta_roundtrip_and_crc_rejects_flip(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    d.save_meta({"term": 7, "voted_for": "s1", "peers": {"s1": "a"}})
+    assert d.load_meta()["term"] == 7
+    path = os.path.join(d.path, durable.META)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x40
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    assert d.load_meta() is None        # CRC says so, no pickle guessing
+
+
+def test_append_gap_is_a_caller_bug(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 3)
+    with pytest.raises(RuntimeError, match="gap"):
+        d.append(7, [(1, "x", {})])
+
+
+# ------------------------------------------------------ commit point
+
+def test_commit_generation_is_atomic_under_manifest_crash(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 6)
+    snap = {"index": 4, "term": 1, "data": b"snap-bytes", "peers": {}}
+    faults.install({"disk.manifest": {"mode": "raise", "times": 1}})
+    with pytest.raises(faults.FaultError):
+        d.commit_generation(snap, [(1, "t5", {"i": 5}), (1, "t6", {"i": 6})],
+                            first_index=5)
+    d.close()
+    faults.clear()
+
+    # crash BEFORE the manifest replace: the old generation is intact
+    st = mk(tmp_path).load()
+    assert st.snapshot is None
+    assert [e[0] for e in st.entries] == [1, 2, 3, 4, 5, 6]
+
+    # retry lands the whole generation
+    d = mk(tmp_path)
+    d.load()
+    d.commit_generation(snap, [(1, "t5", {"i": 5}), (1, "t6", {"i": 6})],
+                        first_index=5)
+    d.close()
+    st = mk(tmp_path).load()
+    assert st.snapshot["data"] == b"snap-bytes"
+    assert [e[0] for e in st.entries] == [5, 6]
+
+
+def test_commit_generation_crash_at_snapshot_keeps_old_pair(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 4)
+    faults.install({"disk.snapshot": {"mode": "raise", "times": 1}})
+    with pytest.raises(faults.FaultError):
+        d.commit_generation({"index": 2, "term": 1, "data": b"s"},
+                            [(1, "t3", {}), (1, "t4", {})], first_index=3)
+    d.close()
+    st = mk(tmp_path).load()
+    assert st.snapshot is None
+    assert [e[0] for e in st.entries] == [1, 2, 3, 4]
+
+
+def test_torn_manifest_write_keeps_old_manifest(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 3)
+    faults.install({"disk.manifest": {"mode": "torn", "seed": 3,
+                                      "times": 1}})
+    with pytest.raises(faults.TornWriteError):
+        d.commit_generation({"index": 3, "term": 1, "data": b"s"}, [],
+                            first_index=4)
+    d.close()
+    st = mk(tmp_path).load()        # tmp was torn, never replaced
+    assert st.snapshot is None
+    assert [e[0] for e in st.entries] == [1, 2, 3]
+
+
+# --------------------------------------------------- recovery matrix
+
+def test_torn_tail_truncates_at_last_valid_frame(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 5)
+    log = os.path.join(d.path, d._log_name)
+    d.close()
+    raw = open(log, "rb").read()
+    with open(log, "wb") as f:
+        f.write(raw[:-7])               # tear the last frame mid-payload
+
+    d2 = mk(tmp_path)
+    st = d2.load()
+    assert not st.quarantined
+    assert st.tail_truncated_frames == 1
+    assert [e[0] for e in st.entries] == [1, 2, 3, 4]
+    # the file was repaired in place: a second load is clean
+    st2 = mk(tmp_path).load()
+    assert st2.tail_truncated_frames == 0
+    assert [e[0] for e in st2.entries] == [1, 2, 3, 4]
+
+
+def test_mid_file_corruption_quarantines_log(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 6)
+    log = os.path.join(d.path, d._log_name)
+    d.close()
+    raw = bytearray(open(log, "rb").read())
+    raw[40] ^= 0x01                     # damage an EARLY frame
+    with open(log, "wb") as f:
+        f.write(bytes(raw))
+
+    st = mk(tmp_path).load()
+    assert st.quarantined
+    assert st.entries == []             # the log cannot be trusted
+    assert os.path.exists(log + ".quarantined")     # kept for forensics
+
+
+def test_corrupt_fault_mode_is_crc_detected_at_load(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 3)
+    faults.install({"disk.append": {"mode": "corrupt", "seed": 9,
+                                    "times": 1}})
+    d.append(4, [(1, "t4", {"i": 4})])      # write "succeeds", bits lie
+    d.close()
+    faults.clear()
+    st = mk(tmp_path).load()
+    assert [e[0] for e in st.entries] == [1, 2, 3]
+    assert st.tail_truncated_frames == 1
+
+
+def test_index_regression_means_later_write_wins(tmp_path):
+    # the failed-conflict-rewrite shape: disk keeps a stale tail, later
+    # appends re-write the same indexes — the reader drops the stale
+    # suffix instead of replaying both
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 5, term=1)
+    d.append(4, [(2, "t4b", {"new": True}), (2, "t5b", {"new": True})])
+    d.close()
+    st = mk(tmp_path).load()
+    assert [(e[0], e[1]) for e in st.entries] == \
+        [(1, 1), (2, 1), (3, 1), (4, 2), (5, 2)]
+    assert st.entries[3][2] == "t4b"
+
+
+def test_stale_log_that_misses_snapshot_is_dropped(tmp_path):
+    # the pre-WAL crash window's signature, now self-identifying: a log
+    # starting past snapshot.index+1 cannot be re-based silently
+    d = mk(tmp_path)
+    d.load()
+    d.commit_generation({"index": 10, "term": 1, "data": b"s"}, [],
+                        first_index=11)
+    d.append(11, [(1, "t11", {})])
+    d.close()
+    # hand-forge a manifest pointing the snapshot at a LOWER index so
+    # the log frames (11..) no longer connect to base 5
+    man = durable._read_envelope(os.path.join(d.path, durable.MANIFEST))
+    snap_name = "snapshot-zz.bin"
+    with open(os.path.join(d.path, snap_name), "wb") as f:
+        f.write(durable._envelope({"index": 5, "term": 1, "data": b"s5"}))
+    with open(os.path.join(d.path, durable.MANIFEST), "wb") as f:
+        f.write(durable._envelope({**man, "snapshot": snap_name}))
+
+    st = mk(tmp_path).load()
+    assert st.stale_log_dropped
+    assert st.entries == []
+    assert st.snapshot["index"] == 5
+
+
+def test_corrupt_manifest_quarantines_generation(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 3)
+    d.save_meta({"term": 3, "voted_for": "s0", "peers": {}})
+    d.close()
+    path = os.path.join(d.path, durable.MANIFEST)
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+
+    d2 = mk(tmp_path)
+    st = d2.load()
+    assert st.quarantined and st.entries == [] and st.snapshot is None
+    # term/vote are NOT part of the generation: meta survives
+    assert st.meta["term"] == 3
+    # the dir restarts on a fresh consistent generation
+    d2.append(1, [(4, "x", {})])
+    d2.close()
+    st2 = mk(tmp_path).load()
+    assert [e[0] for e in st2.entries] == [1]
+
+
+# ------------------------------------------------------------- fsync
+
+def test_fsync_policy_modes(tmp_path, monkeypatch):
+    calls = []
+    real = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: calls.append(fd) or real(fd))
+
+    d = mk(tmp_path, mode="never")
+    d.load()
+    seed(d, 4)
+    d.close()
+    assert calls == []                  # never: page cache trusted
+
+    calls.clear()
+    d = DurableRaftDir(str(tmp_path / "r2"),
+                       policy_fn=lambda: ("always", 0.0))
+    d.load()
+    for i in range(3):
+        d.append(i + 1, [(1, "t", {"i": i})])
+    d.close()
+    always_appends = len(calls)
+    assert always_appends >= 3          # every append synced
+
+    calls.clear()
+    d = DurableRaftDir(str(tmp_path / "r3"),
+                       policy_fn=lambda: ("interval", 3600.0))
+    d.load()
+    for i in range(10):
+        d.append(i + 1, [(1, "t", {"i": i})])
+    interval_appends = len(calls)
+    assert interval_appends < 3         # paced far below always
+    # commit points still sync under interval mode
+    d.commit_generation({"index": 10, "term": 1, "data": b"s"}, [],
+                        first_index=11)
+    assert len(calls) > interval_appends
+    d.close()
+
+
+def test_fsync_fault_site_fires(tmp_path):
+    d = mk(tmp_path, mode="always")
+    d.load()
+    faults.install({"disk.fsync": {"mode": "raise", "times": 1}})
+    with pytest.raises(faults.FaultError):
+        d.append(1, [(1, "t", {})])
+
+
+# ------------------------------------------- torn/corrupt determinism
+
+def test_torn_mode_prefix_is_seeded_and_deterministic():
+    data = bytes(range(200))
+    prefixes = []
+    for _ in range(2):
+        plan = faults.install({"site.x": {"mode": "torn", "seed": 42}})
+        try:
+            plan.mangle("site.x", data)
+        except faults.TornWriteError as t:
+            prefixes.append(t.prefix)
+        faults.clear()
+    assert prefixes[0] == prefixes[1]
+    assert data.startswith(prefixes[0]) and len(prefixes[0]) < len(data)
+
+
+def test_corrupt_mode_flips_one_seeded_bit():
+    data = bytes(200)
+    outs = []
+    for _ in range(2):
+        plan = faults.install({"site.x": {"mode": "corrupt", "seed": 7}})
+        outs.append(plan.mangle("site.x", data))
+        faults.clear()
+    assert outs[0] == outs[1] != data
+    assert len(outs[0]) == len(data)
+    assert sum(a != b for a, b in zip(outs[0], data)) == 1
+
+
+def test_bytes_modes_compose_with_n_and_times():
+    plan = faults.install({"site.x": {"mode": "torn", "n": 3, "times": 1}})
+    data = b"x" * 50
+    assert plan.mangle("site.x", data) == data      # call 1
+    assert plan.mangle("site.x", data) == data      # call 2
+    with pytest.raises(faults.TornWriteError):
+        plan.mangle("site.x", data)                 # call 3 fires
+    assert plan.mangle("site.x", data) == data      # times=1 exhausted
+    # a plain fire() at a bytes-mode site is observed, never raises
+    plan2 = faults.install({"site.y": {"mode": "corrupt"}})
+    plan2.fire("site.y")
+    assert plan2.calls("site.y") == 1
+
+
+def test_non_bytes_modes_work_through_mangle():
+    plan = faults.install({"site.x": {"mode": "nth_call", "n": 2}})
+    data = b"d" * 10
+    assert plan.mangle("site.x", data) == data
+    with pytest.raises(faults.FaultError):
+        plan.mangle("site.x", data)
+
+
+# ------------------------------------------------------------ legacy
+
+def _write_legacy(path, snap_index=0, n_entries=4, term=2):
+    """Forge the pre-WAL on-disk format the old raft.py wrote."""
+    os.makedirs(path, exist_ok=True)
+    frame = struct.Struct(">I")
+    if snap_index:
+        with open(os.path.join(path, durable.LEGACY_SNAP), "wb") as f:
+            pickle.dump({"index": snap_index, "term": 1,
+                         "data": b"legacy-snap", "peers": {"s0": "a"},
+                         "nonvoters": set()}, f)
+    with open(os.path.join(path, durable.LEGACY_LOG), "wb") as f:
+        for i in range(n_entries):
+            blob = pickle.dumps((term, f"legacy{i}", {"i": i}),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(frame.pack(len(blob)) + blob)
+    with open(os.path.join(path, durable.LEGACY_META), "wb") as f:
+        pickle.dump({"term": term, "voted_for": "s0",
+                     "peers": {"s0": "a"}, "nonvoters": set()}, f)
+
+
+def test_legacy_migration_first_start(tmp_path):
+    root = str(tmp_path / "raft")
+    _write_legacy(root, snap_index=10, n_entries=4)
+    d = DurableRaftDir(root, policy_fn=lambda: ("always", 0.0))
+    st = d.load()
+    assert st.migrated
+    assert st.snapshot["data"] == b"legacy-snap"
+    assert st.meta["term"] == 2 and st.meta["voted_for"] == "s0"
+    assert [e[0] for e in st.entries] == [11, 12, 13, 14]
+    assert st.entries[0][2] == "legacy0"
+    # legacy files gone, manifest present — second boot is plain WAL
+    assert not os.path.exists(os.path.join(root, durable.LEGACY_LOG))
+    assert not os.path.exists(os.path.join(root, durable.LEGACY_META))
+    d.close()
+    st2 = DurableRaftDir(root, policy_fn=lambda: ("always", 0.0)).load()
+    assert not st2.migrated
+    assert [e[0] for e in st2.entries] == [11, 12, 13, 14]
+
+
+def test_legacy_migration_without_snapshot(tmp_path):
+    root = str(tmp_path / "raft")
+    _write_legacy(root, snap_index=0, n_entries=3)
+    st = DurableRaftDir(root, policy_fn=lambda: ("always", 0.0)).load()
+    assert st.migrated and st.snapshot is None
+    assert [e[0] for e in st.entries] == [1, 2, 3]
+
+
+def test_legacy_torn_tail_dropped_at_migration(tmp_path):
+    root = str(tmp_path / "raft")
+    _write_legacy(root, snap_index=0, n_entries=3)
+    with open(os.path.join(root, durable.LEGACY_LOG), "ab") as f:
+        f.write(struct.Struct(">I").pack(9999) + b"short")
+    st = DurableRaftDir(root, policy_fn=lambda: ("always", 0.0)).load()
+    assert st.migrated
+    assert [e[0] for e in st.entries] == [1, 2, 3]
+
+
+def test_stats_surface(tmp_path):
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 2)
+    s = d.stats()
+    assert s["appends"] == 1 and s["fsync_mode"] == "always"
+    assert s["gen"] >= 1 and s["next_index"] == 3
+
+
+# ------------------------------------------------------------- knobs
+
+def test_raft_fsync_knob_validation_and_codec_roundtrip():
+    from nomad_tpu.api_codec import from_api, to_api
+    from nomad_tpu.structs import SchedulerConfiguration
+
+    assert SchedulerConfiguration().validate() == ""
+    assert SchedulerConfiguration().raft_fsync == "always"   # safe default
+    for mode in ("always", "interval", "never"):
+        assert SchedulerConfiguration(raft_fsync=mode).validate() == ""
+    assert "raft_fsync" in \
+        SchedulerConfiguration(raft_fsync="sometimes").validate()
+    assert "raft_fsync_interval_ms" in \
+        SchedulerConfiguration(raft_fsync_interval_ms=0).validate()
+    cfg = SchedulerConfiguration(raft_fsync="interval",
+                                 raft_fsync_interval_ms=120.0)
+    rt = from_api(SchedulerConfiguration, to_api(cfg))
+    assert rt.raft_fsync == "interval"
+    assert rt.raft_fsync_interval_ms == 120.0
+
+
+def test_fsync_policy_hot_reloads_from_scheduler_config(tmp_path,
+                                                        monkeypatch):
+    """The knob rides the same raft-replicated hot-reload path as every
+    other runtime knob — and NOMAD_RAFT_FSYNC force-overrides it for
+    bench legs."""
+    import time as _time
+
+    from nomad_tpu.rpc.virtual import VirtualNetwork
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.fsm import SCHEDULER_CONFIG
+    from nomad_tpu.structs import SchedulerConfiguration
+
+    monkeypatch.delenv("NOMAD_RAFT_FSYNC", raising=False)
+    net = VirtualNetwork(seed=55)
+    s = Server(num_workers=0, gc_interval=9999)
+    s.rpc_listen_virtual(net, "s0")
+    s.enable_raft("s0", {"s0": s.rpc_addr},
+                  data_dir=str(tmp_path / "raft"), seed=1,
+                  election_timeout=(0.2, 0.4), heartbeat_interval=0.05)
+    s.start()
+    try:
+        deadline = _time.time() + 10
+        while not s.raft_node.is_leader() and _time.time() < deadline:
+            _time.sleep(0.005)
+        assert s.raft_node.is_leader()
+        assert s.raft_node._fsync_policy() == ("always", 0.05)
+        s.raft.apply(SCHEDULER_CONFIG, {"config": SchedulerConfiguration(
+            raft_fsync="interval", raft_fsync_interval_ms=200.0)})
+        assert s.raft_node._fsync_policy() == ("interval", 0.2)
+        monkeypatch.setenv("NOMAD_RAFT_FSYNC", "never")
+        assert s.raft_node._fsync_policy()[0] == "never"
+        monkeypatch.setenv("NOMAD_RAFT_FSYNC", "interval:500")
+        assert s.raft_node._fsync_policy() == ("interval", 0.5)
+    finally:
+        s.shutdown()
+
+
+def test_dir_sync_failure_after_manifest_replace_keeps_commit(tmp_path):
+    """Once os.replace lands the manifest, the generation is LIVE: a
+    post-replace directory-fsync failure must neither unlink the new
+    generation's files (a committed manifest naming deleted files is
+    total state loss) nor delete the OLD generation (the un-journaled
+    rename could still revert at power loss)."""
+    d = mk(tmp_path)
+    d.load()
+    seed(d, 4)
+    old_log = d._log_name
+    # fsync call order in a with-snapshot commit: snapshot blob(1),
+    # snapshot dir(2), gen log(3), dir(4), manifest tmp(5), [replace],
+    # post-replace dir sync(6) — fire from 6 onward
+    faults.install({"disk.fsync": {"mode": "after", "n": 6}})
+    d.commit_generation({"index": 4, "term": 1, "data": b"s"}, [],
+                        first_index=5)      # must NOT raise
+    d.close()
+    faults.clear()
+    st = mk(tmp_path).load()
+    assert st.snapshot is not None and st.snapshot["index"] == 4
+    assert st.entries == []
+    # old generation retained as the power-loss fallback
+    assert os.path.exists(os.path.join(d.path, old_log))
+
+
+def test_legacy_migration_refuses_unreadable_snapshot(tmp_path):
+    root = str(tmp_path / "raft")
+    _write_legacy(root, snap_index=10, n_entries=3)
+    with open(os.path.join(root, durable.LEGACY_SNAP), "wb") as f:
+        f.write(b"not a pickle")
+    with pytest.raises(RuntimeError, match="refusing to migrate"):
+        DurableRaftDir(root, policy_fn=lambda: ("always", 0.0)).load()
+    # nothing consumed: the legacy files are intact for inspection
+    assert os.path.exists(os.path.join(root, durable.LEGACY_LOG))
+    assert not os.path.exists(os.path.join(root, durable.MANIFEST))
+
+
+def test_legacy_migration_refuses_damaged_complete_frame(tmp_path):
+    root = str(tmp_path / "raft")
+    _write_legacy(root, snap_index=0, n_entries=3)
+    log = os.path.join(root, durable.LEGACY_LOG)
+    raw = bytearray(open(log, "rb").read())
+    raw[10] ^= 0xFF                     # damage INSIDE frame 1's pickle
+    with open(log, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(RuntimeError, match="refusing to migrate"):
+        DurableRaftDir(root, policy_fn=lambda: ("always", 0.0)).load()

@@ -1087,6 +1087,106 @@ def test_shard001_inline_suppression():
     assert rule_ids(src, path="solver/placer.py") == []
 
 
+# ------------------------------------------------------------------ DUR001
+
+DUR001_APPEND_BAD = """
+    def persist_entry(path, blob):
+        with open(path, "ab") as f:
+            f.write(blob)
+"""
+
+DUR001_REPLACE_BAD = """
+    import os
+
+    def flush(path, blob):
+        with open(path + ".tmp", "wb") as f:
+            f.write(blob)
+        os.replace(path + ".tmp", path)
+"""
+
+
+def test_dur001_fires_on_raw_append_log():
+    out = findings(DUR001_APPEND_BAD, "pkg/server/thing.py")
+    assert [f.rule for f in out] == ["DUR001"]
+    assert "durable" in out[0].message
+
+
+def test_dur001_fires_on_replace_without_fsync():
+    out = findings(DUR001_REPLACE_BAD, "pkg/state/thing.py")
+    assert [f.rule for f in out] == ["DUR001"]
+    assert "fsync" in out[0].message
+
+
+def test_dur001_scoped_to_persistence_dirs_and_exempts_durable():
+    # out of scope: solver/, scheduler/, tools
+    assert rule_ids(DUR001_APPEND_BAD, "pkg/solver/thing.py") == []
+    assert rule_ids(DUR001_REPLACE_BAD, "pkg/scheduler/thing.py") == []
+    # the durable-storage module OWNS the WAL append discipline
+    assert rule_ids(DUR001_APPEND_BAD, "server/durable.py") == []
+    # client/ IS in scope (state_db, log writers)
+    assert rule_ids(DUR001_APPEND_BAD, "pkg/client/thing.py") == \
+        ["DUR001"]
+
+
+def test_dur001_fsynced_replace_is_quiet():
+    # the client/state_db.py _flush_snapshot shape: fsync BEFORE the
+    # atomic replace (os.fdopen included)
+    src = """
+        import os
+        import tempfile
+
+        def flush(path, blob):
+            fd, tmp = tempfile.mkstemp()
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+    """
+    assert rule_ids(src, "pkg/client/state_db.py") == []
+
+
+def test_dur001_plain_wb_without_replace_is_quiet():
+    # a plain binary write with no atomic-replace intent (exports,
+    # artifact staging) is not the persistence shape this rule tracks
+    src = """
+        def export(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+    """
+    assert rule_ids(src, "pkg/client/exporter.py") == []
+
+
+def test_dur001_sibling_function_fsync_does_not_leak_scope():
+    src = """
+        import os
+
+        def careful(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+                os.fsync(f.fileno())
+            os.replace(path, path + ".bak")
+
+        def sloppy(path, blob):
+            with open(path + ".tmp", "wb") as f:
+                f.write(blob)
+            os.replace(path + ".tmp", path)
+    """
+    out = findings(src, "pkg/server/thing.py")
+    assert [f.rule for f in out] == ["DUR001"]
+    assert out[0].line > 8          # only the sloppy function fires
+
+
+def test_dur001_inline_suppression():
+    src = """
+        def capture(path, blob):
+            # nomadlint: disable=DUR001 — loss-tolerant log stream
+            with open(path, "ab") as f:
+                f.write(blob)
+    """
+    assert rule_ids(src, "pkg/client/logs.py") == []
+
+
 # ------------------------------------------------------------- tier-1 gate
 
 def test_nomadlint_gate_whole_tree():

@@ -134,3 +134,42 @@ def test_corrupt_state_file_recovers_fresh(tmp_path):
     assert db.get_all_allocations() == []
     db.put_allocation(Allocation(id="x"))
     assert [a.id for a in StateDB(path).get_all_allocations()] == ["x"]
+
+
+def test_flush_fsyncs_file_before_replace_and_dir_after(tmp_path,
+                                                       monkeypatch):
+    """ISSUE 13 satellite: the restart-reattach contract must survive
+    POWER LOSS, not just SIGKILL — pin the durability ordering of every
+    task-handle/alloc-state flush: data fsync BEFORE the atomic
+    os.replace (the rename is journaled before the data otherwise), and
+    a directory fsync AFTER it (the rename itself must reach disk)."""
+    path = str(tmp_path / "client_state.db")
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: events.append("fsync") or real_fsync(fd))
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: events.append(("replace", os.path.basename(b)))
+        or real_replace(a, b))
+
+    db = StateDB(path)
+    events.clear()
+    db.put_allocation(Allocation(id="a1"))
+    db.put_task_handles("a1", {"t": {"pid": 1}})
+
+    flushes = []
+    cur = []
+    for ev in events:
+        cur.append(ev)
+        if ev == "fsync" and len(cur) >= 3:
+            flushes.append(cur)
+            cur = []
+    assert len(flushes) == 2, f"expected 2 flush sequences: {events}"
+    for seq in flushes:
+        # file fsync -> replace(db path) -> dir fsync, in that order
+        assert seq[0] == "fsync"
+        assert seq[1] == ("replace", os.path.basename(path))
+        assert seq[2] == "fsync"
+
+    assert [a.id for a in StateDB(path).get_all_allocations()] == ["a1"]
