@@ -733,6 +733,101 @@ def _node_storm_run() -> dict:
 CRASH_ENTRIES = int(os.environ.get("NOMAD_CRASH_ENTRIES", "1000"))
 
 
+def _device_chaos_run() -> dict:
+    """Device-chaos lineage (ISSUE 14): kill 1→K of the 8 virtual
+    devices mid-stream via `device.lost.d<N>` faults and prove the
+    elastic mesh absorbs it — every killed device costs ONE generation
+    bump + quarantine, resident state-cache twins evacuate onto the
+    survivor mesh, every in-flight solve replays, and ZERO evals are
+    lost. The stream is the standard 1k-TASK-eval stream
+    (STREAM_EVALS concurrent 1k-task evals — the same workload shape
+    `evals_per_sec_1k_stream` measures; NOMAD_CHAOS_EVALS resizes).
+    Gated by tests/test_bench_regression.py::test_device_chaos_gate
+    once recorded (docs/SHARDED_SOLVE.md)."""
+    import jax
+
+    from nomad_tpu import faults
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.solver import backend as sbackend
+    from nomad_tpu.solver import buckets as sbuckets
+    from nomad_tpu.solver import microbatch, sharding, state_cache
+    from nomad_tpu.structs import SCHED_ALG_TPU
+
+    n_devices = len(jax.devices())
+    kills = [k for k in (1, 2, 4) if k < n_devices]
+    n_evals = int(os.environ.get("NOMAD_CHAOS_EVALS", str(STREAM_EVALS)))
+    old_floor = sbackend.SHARD_MIN_NODES
+
+    def _reset_world():
+        faults.clear()
+        sharding.reset()
+        sbuckets._reset_shards()
+        sbackend.reset()
+        state_cache.reset()
+        microbatch.reset()
+
+    legs = []
+    try:
+        for ki, kill in enumerate(kills):
+            _reset_world()
+            # engage the sharded resident twins at sim scale (the 10k
+            # sim's bucket is 16384) so the kills hit real partitioned
+            # state, not just solo dispatches
+            sbackend.SHARD_MIN_NODES = 8192
+            base = dict(metrics.snapshot()["counters"])
+            # per-leg evacuation wall = MAX over the leg's evacuation
+            # SAMPLES (the `nomad.mesh.evacuation_seconds` gauge is
+            # last-write-wins — a leg with several evacuations would
+            # report only its final, typically warmest one)
+            ev_skip = metrics.sample_count("nomad.mesh.evacuation")
+            fsm_c = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=29 + ki)
+            # each victim dies ONCE at a staggered dispatch, so the
+            # stream sees kill → rebuild → evacuate → replay, then the
+            # next victim dies on the already-rebuilt mesh (the stagger
+            # is tight enough that ALL K victims die inside the stream)
+            faults.install({
+                f"device.lost.d{d}": {"mode": "after", "n": 4 + 5 * i,
+                                      "times": 1}
+                for i, d in enumerate(range(1, kill + 1))})
+            t0 = time.perf_counter()
+            times = _stream_run(fsm_c, n_evals, STREAM_CONCURRENCY)
+            wall = time.perf_counter() - t0
+            fired = sum(faults.fired(f"device.lost.d{d}")
+                        for d in range(1, kill + 1))
+            faults.clear()
+            snap = metrics.snapshot()
+
+            def delta(key):
+                return int(snap["counters"].get(key, 0) - base.get(key, 0))
+            legs.append({
+                "killed": kill,
+                "loss_faults_fired": fired,
+                "evals": n_evals,
+                "evals_lost": n_evals - len(times),
+                "generation_bumps": sharding.generation(),
+                "quarantined": sorted(sharding.quarantined()),
+                "replays": delta("nomad.mesh.replays"),
+                "device_loss_events": delta("nomad.mesh.device_loss"),
+                "evacuations": delta(
+                    "nomad.solver.state_cache.evacuations"),
+                "evacuation_s": round(metrics.percentile(
+                    "nomad.mesh.evacuation", 1.0, skip=ev_skip), 4),
+                "stream_wall_s": round(wall, 3),
+            })
+    finally:
+        sbackend.SHARD_MIN_NODES = old_floor
+        _reset_world()
+    return {
+        "devices": n_devices,
+        "legs": legs,
+        "evals_lost": sum(leg["evals_lost"] for leg in legs),
+        "replays": sum(leg["replays"] for leg in legs),
+        "generation_bumps": sum(leg["generation_bumps"] for leg in legs),
+        "max_evacuation_s": max(
+            (leg["evacuation_s"] for leg in legs), default=0.0),
+    }
+
+
 def _crash_recovery_run() -> dict:
     """Crash-recovery lineage (ISSUE 13, docs/DURABILITY.md): the raft
     WAL's durability/throughput envelope on this box.
@@ -1624,6 +1719,14 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         crash_recovery = {"error": repr(e)[:200]}
 
+    # device-chaos lineage (ISSUE 14): kill 1→K of the 8 virtual devices
+    # mid-stream — generation bumps, evacuation wall, replayed evals,
+    # evals lost == 0; gated once recorded
+    try:
+        device_chaos = _device_chaos_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        device_chaos = {"error": repr(e)[:200]}
+
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
     # tests/test_bench_regression.py once recorded
@@ -1698,6 +1801,9 @@ def main() -> None:
         # taint-riding state cache, deduped eval flood, recovery wall)
         "node_storm": node_storm,
         "crash_recovery": crash_recovery,
+        # ISSUE 14: elastic-mesh device-chaos lineage (kill 1..K of 8
+        # virtual devices mid-stream; zero evals lost, replays recorded)
+        "device_chaos": device_chaos,
         "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
         "state_cache": state_cache_counters,
         **phases,
@@ -2045,6 +2151,10 @@ if __name__ == "__main__":
         # raft-apply throughput + restart wall pre/post compaction +
         # lost-commit audit; NOMAD_CRASH_ENTRIES resizes
         print(json.dumps(_crash_recovery_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--device-chaos":
+        # standalone device-chaos lineage (ISSUE 14): kill 1..K of the
+        # 8 virtual devices mid-1k-eval-stream; NOMAD_CHAOS_EVALS resizes
+        print(json.dumps(_device_chaos_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":

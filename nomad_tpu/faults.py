@@ -32,7 +32,9 @@ code: `solver.dispatch.pallas`, `raft.apply`, `heartbeat.invalidate`,
 plus common knobs: `times` caps total fires (-1 = unlimited; `times: 1`
 is a one-shot), and `exc` picks the raised type (`fault` -> FaultError,
 `timeout` -> TimeoutError, `oom` -> MemoryError, `runtime` ->
-RuntimeError) so a site can simulate its real failure shape.
+RuntimeError, `device_lost` -> DeviceLostError, an XlaRuntimeError-shaped
+accelerator loss — the default at `device.lost.d<N>` sites) so a site
+can simulate its real failure shape.
 
 Install via the test API (`faults.install({...})`) or the environment:
 
@@ -84,11 +86,61 @@ class TornWriteError(FaultError):
         self.prefix = prefix
 
 
+# The device-loss error type (ISSUE 14): XlaRuntimeError-shaped — it
+# subclasses the REAL jax runtime error where available, so every
+# `except backend.device_error_types()` seam catches it exactly like a
+# genuine torn-pod/preempted-slice error, while also deriving FaultError
+# so environments without jax internals still demote. Built lazily: the
+# class base depends on jax internals whose import must not be paid by
+# processes that never dispatch (agents, the CLI).
+_DEVICE_LOST_CLS = None
+
+
+def device_lost_error_type():
+    """The DeviceLostError class (lazily built, see above)."""
+    global _DEVICE_LOST_CLS
+    if _DEVICE_LOST_CLS is None:
+        try:
+            from jax._src.lib import xla_client
+            base = xla_client.XlaRuntimeError
+        except Exception:   # noqa: BLE001 — internal layout, best-effort
+            base = None
+        if base is None or issubclass(FaultError, base):
+            # no jax internals (or XlaRuntimeError degenerates to a
+            # FaultError ancestor): FaultError alone — adding the
+            # ancestor again would make the MRO inconsistent
+            bases: tuple = (FaultError,)
+        else:
+            bases = (base, FaultError)
+
+        class DeviceLostError(*bases):
+            """An injected device loss (`device.lost.d<N>` sites): the
+            accelerator behind `device_id` is gone — preempted slice,
+            torn pod, runtime reset. Dispatch seams classify this as
+            device-loss (backend.classify_device_error) and trigger a
+            mesh generation rebuild instead of a transient demotion."""
+
+            def __init__(self, site: str):
+                did = -1
+                tail = site.rsplit(".", 1)[-1]
+                if tail.startswith("d") and tail[1:].isdigit():
+                    did = int(tail[1:])
+                RuntimeError.__init__(
+                    self, f"INTERNAL: injected DEVICE_LOST at {site}: "
+                    f"device d{did} handle is invalid")
+                self.site = site
+                self.device_id = did
+
+        _DEVICE_LOST_CLS = DeviceLostError
+    return _DEVICE_LOST_CLS
+
+
 _EXC_TYPES = {
     "fault": FaultError,
     "timeout": TimeoutError,
     "oom": MemoryError,
     "runtime": RuntimeError,
+    "device_lost": None,        # resolved lazily (device_lost_error_type)
 }
 
 _MODES = ("raise", "delay", "nth_call", "after", "probability",
@@ -115,6 +167,11 @@ class FaultSpec:
                              f"(one of {tuple(_EXC_TYPES)})")
         if mode in ("nth_call", "after", "torn", "corrupt") and n < 1:
             raise ValueError(f"{mode} requires n >= 1")
+        if exc == "fault" and pattern.startswith("device.lost."):
+            # device.lost.d<N> sites default to the XlaRuntimeError-shaped
+            # loss (a plain FaultError there would classify as transient
+            # and never exercise the rebuild path the site exists for)
+            exc = "device_lost"
         self.pattern = pattern
         self.mode = mode
         self.n = int(n)
@@ -145,6 +202,8 @@ class FaultSpec:
         return self._rng.random() < self.p          # probability
 
     def raise_now(self, site: str) -> None:
+        if self.exc == "device_lost":
+            raise device_lost_error_type()(site)
         exc_type = _EXC_TYPES[self.exc]
         if exc_type is FaultError:
             raise FaultError(site)

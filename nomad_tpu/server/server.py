@@ -2202,6 +2202,7 @@ class Server:
         from ..obs import trace as obs_trace
         from ..solver import backend as solver_backend
         from ..solver import explain as solver_explain
+        from ..solver import sharding as solver_sharding
         from ..solver import state_cache
         # spec wall clock: capture timestamps are observability data
         # nomadlint: disable=DET001 — capture timestamp, not a decision
@@ -2244,6 +2245,16 @@ class Server:
                        "Recent": obs_trace.traces(50)},
             "Explains": solver_explain.recent(64),
             "StateCache": state_cache.cache().stats(),
+            # elastic-mesh state (ISSUE 14, docs/SHARDED_SOLVE.md):
+            # generation, quarantined devices, surviving shard count —
+            # plus the mesh counters an operator reads after a loss
+            "Mesh": {
+                **solver_sharding.describe(),
+                "Rebuilds": int(metrics.counter("nomad.mesh.rebuilds")),
+                "Replays": int(metrics.counter("nomad.mesh.replays")),
+                "Evacuations": int(metrics.counter(
+                    "nomad.solver.state_cache.evacuations")),
+            },
             "Breakers": {t: breaker.state(t) for t in tiers},
             "BlockedEvals": dict(self.blocked_evals.stats),
             "SchedulerConfig": to_api(self.state.get_scheduler_config()),

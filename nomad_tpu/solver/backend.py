@@ -69,6 +69,16 @@ counters expose the state machine. The host tier is the floor and is
 always attempted. Injected faults (`solver.dispatch.<tier>` sites,
 nomad_tpu/faults.py) ride the same catch as real XlaRuntimeErrors, so
 tier-1 proves the ladder deterministically (docs/FAULT_INJECTION.md).
+
+Elastic mesh (ISSUE 14 tentpole): dispatch exceptions are CLASSIFIED
+(`classify_device_error`) into transient (the breaker ladder above) vs
+device-loss (quarantine the corpse, rebuild the mesh over survivors at
+a bumped generation — sharding.rebuild — then replay the identical
+inputs once per generation bump through a fresh select()). Selection
+chains key on the mesh generation, so a rebuild invalidates every
+cached chain instead of letting it throw against a dead Mesh forever;
+`device.lost.d<N>` fault sites at each dispatch seam make the whole
+path drivable on the CPU dev mesh (docs/SHARDED_SOLVE.md Elasticity).
 """
 from __future__ import annotations
 
@@ -161,6 +171,67 @@ def device_error_types() -> tuple:
     return _DEVICE_ERRORS
 
 
+# message markers that distinguish a LOST device (quarantine + mesh
+# rebuild, ISSUE 14) from a transient dispatch error (breaker ladder,
+# ISSUE 3) inside the same XlaRuntimeError envelope — the shapes real
+# TPU runtimes emit for preempted slices / torn pods / runtime resets
+_DEVICE_LOSS_MARKERS = (
+    "device_lost", "device lost", "device is lost", "preempted",
+    "slice has been torn", "handle is invalid", "device unavailable",
+    "chip unavailable", "heartbeat timeout",
+)
+
+
+def classify_device_error(exc: BaseException) -> str:
+    """-> 'device_loss' | 'transient' for an exception already known to
+    be one of device_error_types(). Device loss means the accelerator is
+    GONE: retrying the same mesh can only fail again, so the response is
+    quarantine + generation rebuild + one replay — not the cooldown
+    ladder a transient compile/dispatch error rides."""
+    if isinstance(exc, faults.device_lost_error_type()):
+        return "device_loss"
+    msg = str(exc).lower()
+    if any(m in msg for m in _DEVICE_LOSS_MARKERS):
+        return "device_loss"
+    return "transient"
+
+
+def _lost_device_ids(exc: BaseException) -> tuple:
+    did = getattr(exc, "device_id", None)
+    return (int(did),) if isinstance(did, int) and did >= 0 else ()
+
+
+def note_dispatch_failure(tier: str, exc: BaseException,
+                          generation: int = None) -> bool:
+    """One dispatch seam's failure disposition (ISSUE 14): classify,
+    feed the breaker (device loss opens it IMMEDIATELY — no retry storm
+    through a dead mesh), and on device loss quarantine the corpse and
+    rebuild the mesh. Returns True when the caller should REPLAY its
+    identical inputs against the new generation — i.e. the generation
+    advanced past the one the dispatch rode (at most one replay per
+    generation bump; callers cap cascades at sharding.MAX_REPLAYS and
+    then fall to the normal host floor)."""
+    from . import sharding
+    kind = classify_device_error(exc)
+    if kind != "device_loss":
+        _breaker.record_failure(tier)
+        return False
+    lost = set(_lost_device_ids(exc))
+    metrics.incr("nomad.mesh.device_loss")
+    metrics.incr(f"nomad.mesh.device_loss.{tier}")
+    stale = generation is not None \
+        and sharding.generation() > generation \
+        and not (lost - sharding.quarantined())
+    if not stale:
+        # open NOW: concurrent dispatches must not storm the dead mesh
+        # in the window before the rebuild lands (the rebuild resets the
+        # tier for the new, healthy generation)
+        _breaker.record_failure(tier, device_loss=True)
+    new_gen = sharding.rebuild("device_loss", lost,
+                               observed_generation=generation)
+    return generation is None or new_gen > generation
+
+
 class TierBreaker:
     """Per-tier circuit breaker: closed -> open (>= BREAKER_THRESHOLD
     failures within BREAKER_WINDOW_S) -> half-open probe after
@@ -235,10 +306,28 @@ class TierBreaker:
             if rec is not None and rec["probing"]:
                 rec["probing"] = False
 
-    def record_failure(self, tier: str) -> None:
+    def record_failure(self, tier: str, device_loss: bool = False) -> None:
         now = time.monotonic()
         with self._lock:
             rec = self._rec(tier)
+            if device_loss:
+                # ISSUE 14 satellite: a LOST device is not a transient —
+                # the tier opens immediately (no BREAKER_THRESHOLD-retry
+                # storm through a dead mesh). The mesh rebuild resets the
+                # tier for the new generation; if no rebuild helps (the
+                # loss is unattributable and keeps recurring) the normal
+                # cooldown/probe cycle governs from here.
+                if rec["open_until"] is None:
+                    metrics.incr("nomad.solver.tier_breaker_opened")
+                    metrics.incr(f"nomad.solver.tier_breaker_opened.{tier}")
+                    metrics.incr(
+                        "nomad.solver.tier_breaker_opened.device_loss")
+                rec["probing"] = False
+                rec["open_until"] = now + BREAKER_COOLDOWN_S
+                rec["failures"] = []
+                metrics.set_gauge(
+                    f"nomad.solver.tier_breaker_state.{tier}", 1)
+                return
             if rec["probing"]:
                 # the half-open probe failed: straight back to open
                 rec["probing"] = False
@@ -257,6 +346,14 @@ class TierBreaker:
                 metrics.set_gauge(
                     f"nomad.solver.tier_breaker_state.{tier}", 1)
 
+    def reset_tier(self, tier: str) -> None:
+        """Forget a tier's failure history (mesh rebuild: the device the
+        failures blamed is quarantined out of the new generation)."""
+        with self._lock:
+            if tier in self._tiers:
+                del self._tiers[tier]
+            metrics.set_gauge(f"nomad.solver.tier_breaker_state.{tier}", 0)
+
 
 _breaker = TierBreaker()
 
@@ -272,6 +369,25 @@ def breaker_record(tier: str, ok: bool) -> None:
         _breaker.record_success(tier)
     else:
         _breaker.record_failure(tier)
+
+
+def on_mesh_rebuild(gen: int, quarantined_new: bool = True) -> None:
+    """sharding.rebuild() hook: drop every selection/chain built against
+    the old mesh (their NamedShardings reference a dead Mesh object and
+    would throw on every dispatch forever — the PR-9 dead-mesh-wrapper
+    class). When the rebuild actually QUARANTINED a new corpse, the
+    device tiers also get a clean breaker slate — their failures on
+    record blame a device the new generation no longer contains. An
+    UNATTRIBUTABLE loss (no device id on the error) rebuilds the same
+    device set, so the breaker stays open there: without that, a
+    recurring unattributable loss would reset its own breaker on every
+    rebuild and each eval would pay a fresh rebuild storm instead of
+    the cooldown/probe cycle."""
+    _cache.clear()
+    _mesh_cache.clear()
+    if quarantined_new:
+        for tier in ("sharded", "batch", "xla", "pallas"):
+            _breaker.reset_tier(tier)
 
 
 def breaker_release(tier: str) -> None:
@@ -320,14 +436,25 @@ def last_dispatch_tier() -> str:
 
 
 def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
-           spread_algorithm: bool, depth_grid=None):
+           spread_algorithm: bool, depth_grid=None, snap=None):
     """The per-call degradation ladder over `tiers` (primary first).
     Synchronous failures (trace/compile/dispatch errors, injected
     faults) demote to the next admitted tier; outside async_dispatch()
     the result is blocked-on so async device failures surface and
-    demote here too. The floor tier is always attempted."""
+    demote here too. The floor tier is always attempted.
+
+    Device LOSS (ISSUE 14) takes a different exit than a transient
+    demotion: the corpse is quarantined, the mesh rebuilds at a new
+    generation, and the chain re-enters select() ONCE per generation
+    bump to re-dispatch the identical (uncommitted) inputs against the
+    survivors — the in-flight solve replays instead of riding the
+    ladder down. A failed replay falls to the remaining ladder and the
+    host floor exactly as before."""
     fns = [(t, _build(kernel, t, devs, k_max, max_steps,
-                      spread_algorithm, depth_grid)) for t in tiers]
+                      spread_algorithm, depth_grid,
+                      mesh_obj=snap.mesh if snap is not None else None))
+           for t in tiers]
+    gen = snap.generation if snap is not None else None
 
     def run(*args, host_args=None):
         """`host_args`: uncommitted (numpy) twin of `args`, supplied when
@@ -336,6 +463,8 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
         the host floor's contract is uncommitted inputs, and retrying a
         sick device's own buffers would defeat the ladder."""
         import jax
+
+        from . import sharding
         errs = device_error_types()
         last_err = None
         for i, (tier, fn) in enumerate(fns):
@@ -351,11 +480,15 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
                 with trace.span(f"solver.dispatch.{tier}",
                                 attempt=i, floor=floor):
                     faults.fire(f"solver.dispatch.{tier}")
+                    if tier != "host":
+                        # the host tier never touches an accelerator;
+                        # every other tier is a device.lost.d<N> seam
+                        sharding.fire_device_loss_sites()
                     out = fn(*use)
                     if not async_mode:
                         out = jax.block_until_ready(out)
             except errs as e:
-                _breaker.record_failure(tier)
+                replay = note_dispatch_failure(tier, e, generation=gen)
                 metrics.incr("nomad.solver.tier_demotions")
                 metrics.incr(f"nomad.solver.tier_demotions.{tier}")
                 # the ladder fell through this tier: record it on the
@@ -363,6 +496,36 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
                 # demotion chain (ISSUE 7)
                 trace.annotate_list("demotions", tier)
                 last_err = e
+                if replay:
+                    depth = getattr(_dispatch_ctx, "replay_depth", 0)
+                    if depth < sharding.MAX_REPLAYS:
+                        # replay the IDENTICAL inputs against the new
+                        # generation: uncommitted twins only — `args`
+                        # may reference the dead mesh's buffers. The
+                        # re-select carries no `count`, so the replay
+                        # may serve from a solo tier where the first
+                        # dispatch coalesced — bits identical either
+                        # way, and only THIS in-flight solve takes the
+                        # detour; new evals re-route normally
+                        replay_use = host_args if host_args is not None \
+                            else args
+                        n_pad = int(replay_use[0].shape[0])
+                        metrics.incr("nomad.mesh.replays")
+                        trace.annotate_list("demotions",
+                                            f"{tier}:replay")
+                        _dispatch_ctx.replay_depth = depth + 1
+                        try:
+                            _, fn2 = select(
+                                kernel, n_pad, k_max=k_max,
+                                max_steps=max_steps,
+                                spread_algorithm=spread_algorithm,
+                                depth_grid=depth_grid)
+                            return fn2(*replay_use)
+                        except errs as e2:
+                            last_err = e2
+                            continue
+                        finally:
+                            _dispatch_ctx.replay_depth = depth
                 continue
             except BaseException:
                 # non-demotable failure (timeout/oom faults, bugs): not
@@ -400,15 +563,25 @@ def host_fallback(kernel: str, *, k_max: int = 128, max_steps: int = 256,
     return fn
 
 
-def _tier(n_padded: int, count=None):
-    """-> (tier_name, devices) under thresholds + env override."""
+def _tier(n_padded: int, count=None, snap=None):
+    """-> (tier_name, devices) under thresholds + env override. `snap`
+    (sharding.MeshSnapshot) pins the device set the verdict describes —
+    sharded eligibility reads the SNAPSHOT's shard count, not a fresh
+    jax.devices() that a concurrent rebuild may have shrunk (ISSUE 14
+    satellite: no split-brain between bucket padding and launch spec)."""
     import jax
     devs = jax.devices()
+    if snap is None:
+        from . import sharding
+        snap = sharding.snapshot()
+    shards = snap.shards
+    mesh_devs = list(snap.mesh.devices.flat) if snap.mesh is not None \
+        else devs
     forced = os.environ.get("NOMAD_SOLVER_BACKEND", "")
     if forced:
-        if forced == "sharded" and len(devs) > 1 and \
-                n_padded % len(devs) == 0:
-            return "sharded", devs
+        if forced == "sharded" and shards > 1 and \
+                n_padded % shards == 0:
+            return "sharded", mesh_devs
         # pallas has no CPU/GPU lowering at interpret=False: honoring the
         # override off-TPU would crash the first eval inside pallas_call
         if forced == "pallas" and devs[0].platform == "tpu":
@@ -430,7 +603,7 @@ def _tier(n_padded: int, count=None):
         if microbatch.enabled():
             return "batch", devs
         return "host", devs
-    if len(devs) > 1 and count is not None and 0 < count <= HOST_MAX_COUNT:
+    if shards > 1 and count is not None and 0 < count <= HOST_MAX_COUNT:
         # multi-device mesh off-TPU (CPU dev mesh, GPU pods): the stream
         # regression fix (ISSUE 9 satellite; BENCH_r05's host=16 class
         # of failure) — concurrent small solves must coalesce here too,
@@ -442,9 +615,9 @@ def _tier(n_padded: int, count=None):
         from . import microbatch
         if microbatch.enabled() and microbatch.concurrency() > 1:
             return "batch", devs
-    if len(devs) > 1 and n_padded >= SHARD_MIN_NODES and \
-            n_padded % len(devs) == 0:
-        return "sharded", devs
+    if shards > 1 and n_padded >= SHARD_MIN_NODES and \
+            n_padded % shards == 0:
+        return "sharded", mesh_devs
     if devs[0].platform == "tpu" and n_padded >= PALLAS_MIN_NODES:
         return "pallas", devs
     return "xla", devs
@@ -452,27 +625,43 @@ def _tier(n_padded: int, count=None):
 
 def select(kernel: str, n_padded: int, *, count=None, k_max: int = 128,
            max_steps: int = 256, spread_algorithm: bool = False,
-           depth_grid=None):
+           depth_grid=None, mesh_snap=None):
     """-> (backend_name, fn) for `kernel` in {greedy, depth, chunked}.
     `count` (instances asked) feeds the small-solve host routing;
-    `depth_grid` selects the sampled-curve depth variant."""
-    tier, devs = _tier(n_padded, count)
+    `depth_grid` selects the sampled-curve depth variant. `mesh_snap`
+    (sharding.MeshSnapshot) lets the caller pin tier selection, launch
+    specs AND its own bucket padding to one atomic device-set read; when
+    omitted a fresh snapshot is taken here."""
+    from . import sharding
+    snap = mesh_snap if mesh_snap is not None else sharding.snapshot()
+    if snap.generation != sharding.generation():
+        # the mesh moved on under this caller (mid-eval rebuild): NEVER
+        # build a chain against the dead Mesh — the pinned snapshot only
+        # guarantees bucket/spec coherence within its own generation.
+        # A fresh snapshot routes the old-bucket solve to a solo tier
+        # (the stale bucket rarely divides the survivor count) — same
+        # bits, and no dead-mesh wrappers pinned in the select cache.
+        snap = sharding.snapshot()
+    tier, devs = _tier(n_padded, count, snap=snap)
     if kernel == "chunked" and tier == "pallas":
         tier = "xla"                # scan-bound: no pallas tier (above)
     if kernel != "depth" and tier == "batch":
         tier = "host"               # only depth solves micro-batch (above)
     # thresholds are part of the key so runtime mutation (tests, operator
     # monkeypatch) takes effect without an explicit reset(); the resolved
-    # tier (not raw count) keys the cache so counts don't fan it out
+    # tier (not raw count) keys the cache so counts don't fan it out.
+    # The mesh GENERATION keys the cache too (ISSUE 14): a rebuild must
+    # never serve a chain whose NamedShardings reference the dead Mesh.
     key = (kernel, n_padded, k_max, max_steps, spread_algorithm, tier,
            depth_grid, PALLAS_MIN_NODES, SHARD_MIN_NODES, HOST_MAX_COUNT,
+           snap.generation,
            os.environ.get("NOMAD_SOLVER_BACKEND", ""))
     cached = _cache.get(key)
     if cached is not None:
         return cached
     out = _cache[key] = (tier, _chain(kernel, LADDER[tier], devs, k_max,
                                       max_steps, spread_algorithm,
-                                      depth_grid))
+                                      depth_grid, snap=snap))
     return out
 
 
@@ -490,8 +679,15 @@ def _on_host(fn):
 
 
 def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
-           spread_algorithm: bool, depth_grid=None):
+           spread_algorithm: bool, depth_grid=None, mesh_obj=None):
     from .kernels import fill_depth, fill_greedy_binpack, place_chunked
+
+    def tier_mesh():
+        # the sharded tier builds against the SNAPSHOT's mesh when the
+        # caller pinned one (select threads it through) — a concurrent
+        # rebuild must not hand this chain a different device set than
+        # the one its eligibility verdict described (ISSUE 14)
+        return mesh_obj if mesh_obj is not None else _mesh(devs)
 
     if tier == "host":
         inner = _build(kernel, "xla", devs, k_max, max_steps,
@@ -515,7 +711,7 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
     if kernel == "greedy":
         if tier == "sharded":
             from .sharding import sharded_fill_greedy
-            return sharded_fill_greedy(_mesh(devs))
+            return sharded_fill_greedy(tier_mesh())
         if tier == "pallas":
             from .pallas_kernels import fill_greedy_binpack_fused
             return fill_greedy_binpack_fused
@@ -524,7 +720,7 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
     if kernel == "depth":
         if tier == "sharded":
             from .sharding import sharded_fill_depth
-            return sharded_fill_depth(_mesh(devs), k_max=k_max,
+            return sharded_fill_depth(tier_mesh(), k_max=k_max,
                                       spread_algorithm=spread_algorithm,
                                       depth_grid=depth_grid)
         if tier == "pallas":
@@ -552,7 +748,7 @@ def _build(kernel: str, tier: str, devs, k_max: int, max_steps: int,
     if kernel == "chunked":
         if tier == "sharded":
             from .sharding import sharded_place_chunked
-            return sharded_place_chunked(_mesh(devs), max_steps=max_steps,
+            return sharded_place_chunked(tier_mesh(), max_steps=max_steps,
                                          spread_algorithm=spread_algorithm)
 
         def chunked_xla(cap, used, ask, count, feasible, coll, desired,

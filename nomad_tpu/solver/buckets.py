@@ -43,14 +43,18 @@ def mesh_shards() -> int:
     """Device count the sharded tier's 1-D mesh spans (1 = solo). Read
     lazily (importing the solver never initializes a jax backend) and
     re-resolved per call — `jax.devices()` is cached by jax, and the
-    device set can change under us (torn pod, tests faking devices):
-    `sharding.mesh()` and the placer's preempt wrapper self-heal on
-    that, so the bucket rounding must track the same count or buckets
-    stop being mesh multiples and every solve silently unshards."""
+    device set can change under us (torn pod, tests faking devices,
+    ISSUE 14 quarantine): `sharding.mesh()` and the placer's preempt
+    wrapper self-heal on that, so the bucket rounding must track the
+    same count or buckets stop being mesh multiples and every solve
+    silently unshards. Counts HEALTHY devices only — a quarantined
+    device is out of the mesh, so buckets must round to the survivor
+    count (including non-pow2 remainders: 7 survivors of 8 pad every
+    bucket to a multiple of 7)."""
     global _MESH_SHARDS
     try:
-        import jax
-        _MESH_SHARDS = max(1, len(jax.devices()))
+        from . import sharding
+        _MESH_SHARDS = max(1, len(sharding.healthy_devices()))
     except Exception:   # noqa: BLE001 — no backend => solo shapes
         if _MESH_SHARDS <= 0:
             _MESH_SHARDS = 1
@@ -63,12 +67,15 @@ def _reset_shards() -> None:
     _MESH_SHARDS = 0
 
 
-def node_bucket(n: int) -> int:
+def node_bucket(n: int, shards: int = None) -> int:
     """The padded node-axis bucket for `n` live nodes: pow2 (floor 8),
     then rounded up to a multiple of the mesh size so every shard of the
-    sharded tier sees the same [bucket/S, R'] block shape."""
+    sharded tier sees the same [bucket/S, R'] block shape. Callers that
+    hold a `sharding.MeshSnapshot` pass its `shards` explicitly so the
+    bucket and the launch spec describe the SAME device set even when a
+    rebuild races the eval (ISSUE 14 satellite)."""
     b = pow2(n, NODE_BUCKET_FLOOR)
-    s = mesh_shards()
+    s = mesh_shards() if shards is None else max(1, int(shards))
     if s > 1 and b % s:
         b += s - (b % s)
     return b

@@ -216,7 +216,7 @@ class MicroBatcher:
 
     def _dispatch(self, static_key: tuple, inner, host_fn,
                   lanes: list[_Request]) -> None:
-        from . import backend
+        from . import backend, sharding
         from .. import faults
         from .tensorize import stack_lanes
         # pad to the fixed lane count with count=0 clones of lane 0 —
@@ -225,7 +225,6 @@ class MicroBatcher:
         pad = lanes[0].args
         pad = pad[:3] + (np.int32(0),) + pad[4:]
         cols = stack_lanes([r.args for r in lanes], pad, LANES)
-        fn = self._batched_fn(static_key, inner)
         # ONE shared dispatch span for the whole coalesced window, linked
         # to every lane's eval span (the fan-in the flat metrics registry
         # cannot attribute); the leader's eval hosts it, every linked
@@ -237,30 +236,49 @@ class MicroBatcher:
         sctx = sp.ctx()
         for req in lanes:
             req.dispatch_ctx = sctx
-        try:
-            faults.fire("solver.microbatch.dispatch")
-            out = np.asarray(fn(*cols))
-        except backend.device_error_types():
-            # the coalesced device program died (device lost / injected):
-            # one bad dispatch must not fail K evals — fan each lane out
-            # to its own host-tier retry; only lanes whose host solve
-            # ALSO fails see an error (ISSUE 3)
-            backend.breaker_record("batch", ok=False)
-            metrics.incr("nomad.solver.microbatch.fanout")
-            metrics.incr("nomad.solver.microbatch.fanout_lanes", len(lanes))
-            sp.end("fanout", fanout_lanes=len(lanes))
-            for req in lanes:
-                try:
-                    req.out = np.asarray(host_fn(*req.args))
-                except BaseException as le:     # noqa: BLE001 — per lane
-                    req.err = le
-                req.event.set()
-            return
-        except BaseException as e:      # noqa: BLE001 — non-demotable
-            sp.end("error", error=repr(e)[:200])
-            raise
+        replays = 0
+        while True:
+            gen = sharding.generation()
+            # re-fetched per attempt: the wrapper cache keys on the mesh
+            # object, so a generation bump resolves a FRESH executable
+            # over the survivors instead of throwing on the dead Mesh
+            fn = self._batched_fn(static_key, inner)
+            try:
+                faults.fire("solver.microbatch.dispatch")
+                sharding.fire_device_loss_sites()
+                out = np.asarray(fn(*cols))
+                break
+            except backend.device_error_types() as e:
+                # classify (ISSUE 14): device LOSS rebuilds the mesh and
+                # replays the identical coalesced window against the new
+                # generation — at most one replay per generation bump —
+                # so K in-flight evals survive a dead device without even
+                # leaving the batch tier. Transients (and a replay that
+                # keeps dying) fan each lane out to its own host-tier
+                # retry exactly as before (ISSUE 3): only lanes whose
+                # host solve ALSO fails see an error.
+                if backend.note_dispatch_failure("batch", e,
+                                                 generation=gen) \
+                        and replays < sharding.MAX_REPLAYS:
+                    replays += 1
+                    metrics.incr("nomad.mesh.replays")
+                    continue
+                metrics.incr("nomad.solver.microbatch.fanout")
+                metrics.incr("nomad.solver.microbatch.fanout_lanes",
+                             len(lanes))
+                sp.end("fanout", fanout_lanes=len(lanes))
+                for req in lanes:
+                    try:
+                        req.out = np.asarray(host_fn(*req.args))
+                    except BaseException as le:  # noqa: BLE001 — per lane
+                        req.err = le
+                    req.event.set()
+                return
+            except BaseException as e:      # noqa: BLE001 — non-demotable
+                sp.end("error", error=repr(e)[:200])
+                raise
         backend.breaker_record("batch", ok=True)
-        sp.end("ok")
+        sp.end("ok", replays=replays)
         for row, req in enumerate(lanes):
             req.out = np.array(out[row])
             req.event.set()
@@ -305,6 +323,14 @@ class MicroBatcher:
                 fn = self._vmapped[key]
         return fn
 
+    def on_mesh_rebuild(self, gen: int) -> None:
+        """sharding.rebuild() hook (ISSUE 14): drop every vmapped wrapper
+        — entries for the new mesh re-key naturally (the Mesh object is
+        part of the cache key), but wrappers referencing the DEAD mesh
+        would otherwise pin dead NamedShardings in memory forever."""
+        with self._lock:
+            self._vmapped.clear()
+
     def reset(self) -> None:
         """Tests: drop compiled artifacts and queues."""
         with self._lock:
@@ -328,4 +354,5 @@ eval_finished = _batcher.eval_finished
 broker_in_flight = _batcher.broker_in_flight
 concurrency = _batcher.concurrency
 solve = _batcher.solve
+on_mesh_rebuild = _batcher.on_mesh_rebuild
 reset = _batcher.reset

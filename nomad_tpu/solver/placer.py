@@ -37,7 +37,7 @@ from ..structs import (
     skeleton_for,
 )
 from ..scheduler.stack import SelectOptions
-from . import backend, explain as explain_mod, microbatch
+from . import backend, explain as explain_mod, microbatch, sharding
 from ..obs import trace
 from .buckets import node_bucket, pow2
 from .tensorize import (
@@ -106,7 +106,7 @@ class _SolvePrep:
     __slots__ = ("gt", "n", "count", "use_scan", "use_depth", "k_max",
                  "sp", "dp", "aff", "max_per_node", "spread_alg",
                  "depth_grid", "jitter", "bias_g", "m", "distincts",
-                 "ex", "ex_ids", "ex_ncls")
+                 "ex", "ex_ids", "ex_ncls", "snap")
 
 
 class SolverPlacer:
@@ -355,9 +355,13 @@ class SolverPlacer:
         # — the same bucket the state cache's device twins and
         # backend.warmup() key on) so the jitted kernels compile once per
         # bucket, not once per cluster size; padding rows are infeasible
-        # and can never be chosen
+        # and can never be chosen. ONE MeshSnapshot pins the shard count
+        # used for the padding AND the tier/launch specs of every select
+        # below (ISSUE 14 satellite: a mid-eval mesh rebuild must not
+        # split-brain the bucket math against the launch spec)
+        snap = sharding.snapshot()
         n = gt.cap.shape[0]
-        padded = node_bucket(n)
+        padded = node_bucket(n, shards=snap.shards)
         if padded != n:
             pad = padded - n
             gt.cap = np.pad(gt.cap, ((0, pad), (0, 0)))
@@ -376,6 +380,7 @@ class SolverPlacer:
         prep.gt = gt
         prep.n = n
         prep.count = count
+        prep.snap = snap
         prep.distincts = distincts
         prep.ex = ex_rec
         prep.ex_ids = None
@@ -479,7 +484,13 @@ class SolverPlacer:
         retries the sick device's own buffers."""
         if gt.cap_dev is None or gt.used_dev is None:
             return None
-        from .sharding import is_node_sharded
+        from .sharding import generation, is_node_sharded
+        if getattr(gt, "gen", None) is not None and \
+                gt.gen != generation():
+            # twins captured before a mesh rebuild (ISSUE 14): their
+            # buffers may reference the dead mesh — the numpy path
+            # serves the same bits on the new generation
+            return None
         if is_node_sharded(gt.cap_dev):
             if bname == "sharded":
                 return gt.cap_dev, gt.used_dev
@@ -532,7 +543,8 @@ class SolverPlacer:
         if use_depth:
             bname, depth_fn = backend.select(
                 "depth", gt.cap.shape[0], count=count, k_max=prep.k_max,
-                spread_algorithm=spread_alg, depth_grid=prep.depth_grid)
+                spread_algorithm=spread_alg, depth_grid=prep.depth_grid,
+                mesh_snap=prep.snap)
             backend.record("depth", bname)
             d_args = self._depth_solve_args(prep, tg, count)
             dev = self._dev_mats(gt, bname)
@@ -548,7 +560,8 @@ class SolverPlacer:
             cover = max_steps * min(gt.cap.shape[0], 256)
             bname, chunked_fn = backend.select(
                 "chunked", gt.cap.shape[0], count=count,
-                max_steps=max_steps, spread_algorithm=spread_alg)
+                max_steps=max_steps, spread_algorithm=spread_alg,
+                mesh_snap=prep.snap)
             backend.record("chunked", bname)
             # numpy inputs (see the depth call site); the carried state
             # arrays come back committed to the chosen tier's device and
@@ -577,7 +590,8 @@ class SolverPlacer:
             placed = placed_dev
         else:
             bname, greedy = backend.select("greedy", gt.cap.shape[0],
-                                           count=count)
+                                           count=count,
+                                           mesh_snap=prep.snap)
             backend.record("greedy", bname)
             g_args = (gt.cap, gt.used, gt.ask, np.int32(count),
                       gt.feasible, np.int32(max_per_node))
@@ -762,7 +776,7 @@ class SolverPlacer:
             bname, depth_fn = backend.select(
                 "depth", prep.gt.cap.shape[0], count=count,
                 k_max=prep.k_max, spread_algorithm=prep.spread_alg,
-                depth_grid=prep.depth_grid)
+                depth_grid=prep.depth_grid, mesh_snap=prep.snap)
             backend.record("depth", bname)
             # async dispatch of every chunk: jax returns futures, the
             # device queue runs them back to back while the host turns
@@ -824,25 +838,37 @@ class SolverPlacer:
                         # only a materialized result proves the serving
                         # tier healthy
                         backend.breaker_record(chunk_tiers[ci], ok=True)
-                    except backend.device_error_types():
-                        # device lost mid-pipeline: this chunk's future is
-                        # poisoned, and every later chunk consumed its
+                    except backend.device_error_types() as e:
+                        # device failure mid-pipeline: this chunk's future
+                        # is poisoned, and every later chunk consumed its
                         # device-side usage update — re-solve the rest of
-                        # the eval on the host tier, replaying committed
-                        # chunks' usage host-side (ISSUE 3)
-                        backend.breaker_record(chunk_tiers[ci], ok=False)
+                        # the eval off the poisoned queue, replaying
+                        # committed chunks' usage host-side (ISSUE 3).
+                        # Device LOSS (ISSUE 14) classifies differently:
+                        # the mesh rebuilds and the remaining chunks
+                        # REPLAY through a fresh select() at the new
+                        # generation (identical inputs, at most one
+                        # replay per bump — the fresh chain's own ladder
+                        # owns any further failure); transients keep the
+                        # host-floor fallback exactly as before.
+                        replay = backend.note_dispatch_failure(
+                            chunk_tiers[ci], e,
+                            generation=prep.snap.generation)
                         # later chunks' futures will never materialize:
                         # release any half-open probe they were admitted
                         # under, or the tier wedges shut
                         for cj in range(ci + 1, len(futs)):
                             backend.breaker_release(chunk_tiers[cj])
                         metrics.incr("nomad.plan.pipeline.chunk_fallback")
-                        degraded = self._pipeline_degrade(prep, chunk_done)
+                        degraded = self._pipeline_degrade(
+                            prep, chunk_done, count=count,
+                            replay=replay)
                         if self.ctx.logger:
                             self.ctx.logger(
                                 f"solver: eval {sched.eval.id[:8]} chunk "
-                                f"{ci} device result lost; host fallback "
-                                f"for remaining chunks")
+                                f"{ci} device result lost; "
+                                f"{'generation replay' if replay else 'host fallback'}"
+                                f" for remaining chunks")
                 if placed_pad is None:
                     host_fn, used_h, coll_h = degraded
                     a = (prep.gt.cap, used_h, args[2],
@@ -924,15 +950,28 @@ class SolverPlacer:
             self._register_explain(tg, prep.ex)
         return mi, prep
 
-    def _pipeline_degrade(self, prep, chunk_done):
-        """Build the host-tier recovery state after an async device
-        failure: the floor program plus usage/collision arrays with every
-        already-materialized chunk's placements replayed host-side — the
-        numpy mirror of _usage_update, so the recovered chunks score
-        exactly the state the device chunks would have."""
-        host_fn = backend.host_fallback(
-            "depth", k_max=prep.k_max, spread_algorithm=prep.spread_alg,
-            depth_grid=prep.depth_grid)
+    def _pipeline_degrade(self, prep, chunk_done, count=None,
+                          replay=False):
+        """Build the recovery state after an async device failure: a
+        solve program plus usage/collision arrays with every already-
+        materialized chunk's placements replayed host-side — the numpy
+        mirror of _usage_update, so the recovered chunks score exactly
+        the state the device chunks would have. `replay=True` (a device
+        LOSS whose mesh rebuild advanced the generation, ISSUE 14) routes
+        the remaining chunks through a fresh select() chain at the NEW
+        generation — the in-flight eval replays on the survivors — while
+        a transient failure keeps the host floor (ISSUE 3)."""
+        if replay:
+            metrics.incr("nomad.mesh.replays")
+            _, host_fn = backend.select(
+                "depth", prep.gt.cap.shape[0], count=count,
+                k_max=prep.k_max, spread_algorithm=prep.spread_alg,
+                depth_grid=prep.depth_grid)
+        else:
+            host_fn = backend.host_fallback(
+                "depth", k_max=prep.k_max,
+                spread_algorithm=prep.spread_alg,
+                depth_grid=prep.depth_grid)
         used_h = np.array(prep.gt.used, np.float32)
         coll_h = np.array(prep.gt.job_collisions, np.int32)
         ask = np.asarray(prep.gt.ask, np.float32)
@@ -1160,21 +1199,26 @@ class SolverPlacer:
         demoted = False
         c = victim_res.shape[0]
         from . import sharding
-        m = sharding.mesh()
         # the forced-tier override quarantines the mesh for preemption
         # scans too: NOMAD_SOLVER_BACKEND=host/xla must keep EVERY
         # multi-device launch off a sick interconnect, not just solves
         forced = os.environ.get("NOMAD_SOLVER_BACKEND", "")
-        if m is not None and c >= PREEMPT_SHARD_MIN and \
-                forced in ("", "sharded") and \
-                backend.breaker().admit("sharded"):
+        replays = 0
+        while True:
+            snap = sharding.snapshot()
+            m = snap.mesh
+            if not (m is not None and c >= PREEMPT_SHARD_MIN and
+                    forced in ("", "sharded") and
+                    backend.breaker().admit("sharded")):
+                break
             from .. import faults
-            s = len(m.devices.flat)
+            s = snap.shards
             pad = (-c) % s
             try:
                 with trace.span("solver.dispatch.sharded",
                                 kernel="preempt", candidates=c):
                     faults.fire("solver.dispatch.sharded")
+                    sharding.fire_device_loss_sites(m)
                     if _preempt_sharded_fn[0] is not m:
                         from .sharding import sharded_preempt_top_k
                         _preempt_sharded_fn = (m, sharded_preempt_top_k(m))
@@ -1190,12 +1234,24 @@ class SolverPlacer:
                 backend.breaker_record("sharded", ok=True)
                 metrics.incr("nomad.solver.dispatch.sharded")
                 return out
-            except backend.device_error_types():
-                backend.breaker_record("sharded", ok=False)
+            except backend.device_error_types() as e:
                 metrics.incr("nomad.solver.tier_demotions")
                 metrics.incr("nomad.solver.tier_demotions.sharded")
                 trace.annotate_list("demotions", "sharded")
+                # device LOSS (ISSUE 14): the mesh rebuilt over the
+                # survivors — replay the identical scan once per
+                # generation bump (the re-pad above re-derives from the
+                # NEW shard count, non-pow2 remainders included); a
+                # transient (or an exhausted cascade) demotes to the
+                # solo jit(vmap) below with the same verdict bits
+                if backend.note_dispatch_failure(
+                        "sharded", e, generation=snap.generation) \
+                        and replays < sharding.MAX_REPLAYS:
+                    replays += 1
+                    metrics.incr("nomad.mesh.replays")
+                    continue
                 demoted = True
+                break
         out = np.asarray(_preempt_batched()(
             jnp.asarray(victim_res), jnp.asarray(victim_prio),
             jnp.asarray(ask), jnp.asarray(free), jnp.int32(job_prio)))

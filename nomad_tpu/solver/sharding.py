@@ -10,6 +10,23 @@ node axis shards across the "nodes" mesh axis; eval batches shard across
 the same 1-D mesh (data parallel over evaluations — the TPU analog of
 the reference's per-core scheduler workers, ref nomad/server.go:1581).
 
+ISSUE 14 — elastic mesh: devices are NOT immortal (preempted slices,
+torn pods, runtime resets). The mesh carries an explicit **generation**
+counter and a quarantine set; `rebuild(reason, lost_device_ids)`
+quarantines the corpses, rebuilds the singleton over the survivors
+(including non-pow2 remainders — buckets.node_bucket re-pads to the new
+shard count) and bumps the generation. Every mesh-keyed cache
+(backend's select/chain cache, microbatch's vmapped wrappers, the state
+cache's _jit helpers and resident twins, the AOT warmup grid)
+invalidates on generation change instead of throwing against a dead
+Mesh forever; `MeshSnapshot` (mesh + generation + shard count, captured
+atomically) is what the placer hands through `backend.select()` so a
+mid-eval rebuild cannot split-brain bucket padding vs the launch spec.
+`fire_device_loss_sites()` is the fault seam: `device.lost.d<N>` sites
+fired at every dispatch entry, so the whole loss→quarantine→rebuild→
+evacuate→replay path is drivable on the CPU dev mesh
+(docs/SHARDED_SOLVE.md "Elasticity", docs/FAULT_INJECTION.md).
+
 ISSUE 9 additions on top of the kernel wrappers:
   * `mesh()`/`node_sharding()`/`vec_sharding()`/`lane_sharding()` — the
     process-wide mesh singleton and the specs every resident node-axis
@@ -36,6 +53,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults
+from ..metrics import metrics
 from .kernels import (
     fill_depth, fill_greedy_binpack, place_chunked, preempt_top_k,
 )
@@ -44,6 +63,8 @@ NODE_AXIS = "nodes"
 
 _mesh_lock = threading.Lock()
 _mesh_singleton: Mesh | None = None
+_generation: int = 0            # bumped by every rebuild()
+_quarantined: set[int] = set()  # device ids removed from the mesh
 
 # ---------------------------------------------------- launch serialization
 #
@@ -87,29 +108,168 @@ def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def healthy_devices() -> list:
+    """The device set the mesh may span: every jax device NOT in the
+    quarantine. If quarantine ever swallows the whole fleet the raw set
+    is returned — the solo/host tiers still need a device object to
+    exist, and the breaker keeps real traffic off it."""
+    devs = list(jax.devices())
+    if _quarantined:
+        healthy = [d for d in devs if d.id not in _quarantined]
+        if healthy:
+            return healthy
+    return devs
+
+
 def mesh() -> Mesh | None:
-    """The process-wide 1-D solver mesh over ALL devices, or None when
-    only one device exists (solo tiers own that regime). One mesh for
-    the whole process: state-cache twins, microbatch lanes and the
-    sharded kernel wrappers must agree on it or chained dispatches
-    reshard between owners."""
+    """The process-wide 1-D solver mesh over all HEALTHY devices, or
+    None when at most one healthy device exists (solo tiers own that
+    regime). One mesh for the whole process: state-cache twins,
+    microbatch lanes and the sharded kernel wrappers must agree on it
+    or chained dispatches reshard between owners."""
+    with _mesh_lock:
+        return _mesh_locked()
+
+
+def _mesh_locked() -> Mesh | None:
     global _mesh_singleton
-    devs = jax.devices()
+    devs = healthy_devices()
     if len(devs) <= 1:
         return None
+    want = [d.id for d in devs]
+    if _mesh_singleton is None or \
+            [d.id for d in _mesh_singleton.devices.flat] != want:
+        _mesh_singleton = make_mesh(devs)
+    return _mesh_singleton
+
+
+class MeshSnapshot:
+    """Mesh + generation + shard count captured in ONE atomic read
+    (ISSUE 14 satellite): a solve's bucket padding, tier selection and
+    launch specs must all describe the SAME device set — handing these
+    out separately let a mid-eval rebuild split-brain the bucket math
+    (buckets.mesh_shards) against the launch spec (backend._mesh)."""
+
+    __slots__ = ("mesh", "generation", "shards")
+
+    def __init__(self, mesh: Mesh | None, generation: int):
+        self.mesh = mesh
+        self.generation = generation
+        self.shards = 1 if mesh is None else len(mesh.devices.flat)
+
+
+def snapshot() -> MeshSnapshot:
     with _mesh_lock:
-        if _mesh_singleton is None or \
-                len(_mesh_singleton.devices.flat) != len(devs):
-            _mesh_singleton = make_mesh(devs)
-        return _mesh_singleton
+        return MeshSnapshot(_mesh_locked(), _generation)
+
+
+def generation() -> int:
+    """The current mesh generation (monotonic; bumped by rebuild())."""
+    return _generation
+
+
+def quarantined() -> frozenset:
+    """Device ids currently quarantined out of the mesh."""
+    return frozenset(_quarantined)
+
+
+# rebuild reasons are a BOUNDED enum (they feed metric names — OBS001)
+_REBUILD_REASONS = ("device_loss", "operator", "test")
+
+# replay ceiling per in-flight dispatch: one replay per generation bump,
+# and a cascade can bump at most (devices - 1) times before the mesh is
+# solo — the cap is a runaway backstop, not a policy knob
+MAX_REPLAYS = 8
+
+
+def rebuild(reason: str, lost_device_ids=(),
+            observed_generation: int | None = None) -> int:
+    """Quarantine `lost_device_ids`, rebuild the mesh singleton over the
+    survivors and bump the generation — then invalidate every mesh-keyed
+    consumer (backend select/chain caches, microbatch vmapped wrappers)
+    and EVACUATE the state cache's resident twins onto the new mesh.
+    Returns the resulting generation.
+
+    Idempotent under concurrent detection (the 4-thread launch hammer):
+    a caller passing the `observed_generation` its dispatch rode is a
+    no-op when a sibling already rebuilt past it and every device it
+    blames is already quarantined — K threads watching one device die
+    cost ONE rebuild, not K."""
+    global _generation, _mesh_singleton
+    if reason not in _REBUILD_REASONS:
+        reason = "operator"
+    with _mesh_lock:
+        lost = {int(i) for i in lost_device_ids}
+        new_lost = lost - _quarantined
+        if observed_generation is not None and not new_lost and \
+                _generation > observed_generation:
+            return _generation          # a sibling already handled this
+        _quarantined.update(new_lost)
+        quarantined_new = bool(new_lost)
+        _generation += 1
+        gen = _generation
+        _mesh_singleton = None
+        _explain_cache.clear()
+        metrics.set_gauge("nomad.mesh.generation", gen)
+        metrics.set_gauge("nomad.mesh.quarantined_devices",
+                          len(_quarantined))
+        metrics.incr("nomad.mesh.rebuilds")
+        # reason is clamped to the _REBUILD_REASONS enum above — bounded
+        # nomadlint: disable=OBS001 — reason clamped to a 3-value enum
+        metrics.incr(f"nomad.mesh.rebuilds.{reason}")
+    # consumer invalidation runs OUTSIDE the mesh lock (each consumer
+    # takes its own lock; the mesh lock must never nest around them).
+    # Ordering: caches first — an eval racing the rebuild must not pull
+    # a dead-mesh chain while the evacuation below re-seeds the twins.
+    from . import backend, microbatch, state_cache
+    backend.on_mesh_rebuild(gen, quarantined_new=quarantined_new)
+    microbatch.on_mesh_rebuild(gen)
+    state_cache.cache().evacuate(reason=reason)
+    return gen
+
+
+def fire_device_loss_sites(m: Mesh | None = None) -> None:
+    """`device.lost.d<N>` fault sites (ISSUE 14), fired at every
+    dispatch seam entry (backend chain tiers, the micro-batcher's
+    coalesced dispatch, state-cache device gathers/scatters, the sharded
+    preemption scan) for each device the launch would touch — so a test
+    or the chaos bench can kill device N at the n-th dispatch and drive
+    the whole detect→quarantine→rebuild→evacuate→replay path on the CPU
+    dev mesh. Costs one module-attribute read when no plan is armed."""
+    if faults.active() is None:
+        return
+    devs = list(m.devices.flat) if m is not None else healthy_devices()
+    for d in devs:
+        faults.fire(f"device.lost.d{d.id}")
+
+
+def describe() -> dict:
+    """The operator debug bundle's Mesh block (docs/OBSERVABILITY.md):
+    generation, quarantine, and the surviving mesh shape."""
+    with _mesh_lock:
+        m = _mesh_locked()
+        return {
+            "Generation": _generation,
+            "QuarantinedDevices": sorted(_quarantined),
+            "HealthyDevices": len(healthy_devices()),
+            "Shards": 1 if m is None else len(m.devices.flat),
+            "AxisName": NODE_AXIS,
+        }
 
 
 def reset() -> None:
-    """Tests that fake the device set drop the mesh singleton."""
-    global _mesh_singleton, _launch_blocks
+    """Tests that fake the device set drop the mesh singleton, the
+    quarantine and the generation counter (consumers reset separately:
+    backend.reset, microbatch.reset, state_cache.reset)."""
+    global _mesh_singleton, _launch_blocks, _generation
     with _mesh_lock:
         _mesh_singleton = None
         _launch_blocks = None
+        _generation = 0
+        _quarantined.clear()
+        _explain_cache.clear()
+        metrics.set_gauge("nomad.mesh.generation", 0)
+        metrics.set_gauge("nomad.mesh.quarantined_devices", 0)
 
 
 def node_sharding(m: Mesh | None = None) -> NamedSharding | None:

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -86,20 +87,28 @@ class GatherResult:
     order. cap/used are fresh host copies (callers may apply in-plan
     corrections in place); cap_dev/used_dev — when the current device
     generation served the request — are bucket-padded device arrays ready
-    for dispatch (padding rows zero, exactly like the host np.pad path)."""
+    for dispatch (padding rows zero, exactly like the host np.pad path).
+    `gen` is the MESH generation the device pair was seeded at (ISSUE
+    14): the placer declines twins whose generation predates a rebuild
+    (the buffers may reference a dead mesh) and serves from the host
+    copies — same bits, different route."""
 
-    __slots__ = ("cap", "used", "cap_dev", "used_dev")
+    __slots__ = ("cap", "used", "cap_dev", "used_dev", "gen")
 
-    def __init__(self, cap, used, cap_dev=None, used_dev=None):
+    def __init__(self, cap, used, cap_dev=None, used_dev=None, gen=None):
         self.cap = cap
         self.used = used
         self.cap_dev = cap_dev
         self.used_dev = used_dev
+        self.gen = gen
 
 
 class TensorCache:
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock: a device-loss detected INSIDE an advance (the sharded
+        # scatter throwing) triggers sharding.rebuild -> evacuate(),
+        # which re-enters this lock to re-seed the twins (ISSUE 14)
+        self._lock = threading.RLock()
         self._uid = 0                   # source UsageIndex identity
         self._epoch = -1                # node-set fingerprint
         self.version = 0                # version of the last applied entry
@@ -117,6 +126,7 @@ class TensorCache:
         self._cap_dev = None
         self._used_dev = None
         self._sharded = False           # twins partitioned over the mesh
+        self._gen = -1                  # mesh generation the twins ride
         self._jits: dict = {}           # (kind, *shape) -> jitted helper
 
     # ------------------------------------------------------------- control
@@ -136,6 +146,7 @@ class TensorCache:
             self._bucket = 0
             self._cap_dev = self._used_dev = None
             self._sharded = False
+            self._gen = -1
             self._jits.clear()
 
     def stats(self) -> dict:
@@ -144,6 +155,8 @@ class TensorCache:
                     "version": self.version, "seq": self._seq,
                     "rows": 0 if self.cap is None else int(self.cap.shape[0]),
                     "generations": len(self._ring),
+                    "mesh_generation": self._gen,
+                    "twins_sharded": self._sharded,
                     "tainted_rows": (0 if self.elig is None
                                      else int((self.elig < 0.5).sum()))}
 
@@ -246,7 +259,8 @@ class TensorCache:
         self._bucket = node_bucket(n)
         try:
             import jax.numpy as jnp
-            from .sharding import mesh, put_node_sharded
+            from .sharding import generation, mesh, put_node_sharded
+            self._gen = generation()
             pad = self._bucket - n
             cap_p = np.pad(self.cap, ((0, pad), (0, 0)))
             used_p = np.pad(self.used, ((0, pad), (0, 0)))
@@ -351,6 +365,8 @@ class TensorCache:
         if self._used_dev is None:
             return
         try:
+            from .sharding import fire_device_loss_sites
+            fire_device_loss_sites()
             uniq = np.unique(rows)
             k = pow2(len(uniq))
             idx = np.full(k, uniq[0], np.int32)      # pad repeats row 0:
@@ -358,8 +374,77 @@ class TensorCache:
             vals = self.used[idx]
             fn = self._jit("scatter", self._sharded, self._bucket, k)
             self._used_dev = fn(self._used_dev, idx, vals)
-        except Exception:   # noqa: BLE001 — drop the twin, host wins
-            self._cap_dev = self._used_dev = None
+        except Exception as e:   # noqa: BLE001 — drop the twin, host wins
+            # a LOST device (vs a transient scatter error) additionally
+            # rebuilds the mesh; the rebuild's evacuation re-enters this
+            # lock (RLock) and re-seeds the twins from the host mirrors —
+            # which already hold this advance's bits, so nothing is lost
+            from . import backend
+            handled = False
+            if isinstance(e, backend.device_error_types()):
+                handled = backend.note_dispatch_failure(
+                    "sharded" if self._sharded else "xla", e,
+                    generation=self._gen)
+            if not handled:
+                self._cap_dev = self._used_dev = None
+
+    # ----------------------------------------------------------- evacuation
+
+    def evacuate(self, reason: str = "") -> dict:
+        """Mesh-rebuild hook (sharding.rebuild, ISSUE 14): move the
+        resident twins onto the CURRENT mesh generation.
+
+        Ordering contract (docs/SHARDED_SOLVE.md "Elasticity"):
+          1. gather-to-host under the LAUNCH lock at the old generation —
+             a defensive salvage of the displaced twins. The host
+             mirrors are the bit-identity source by construction (every
+             advance lands host-side BEFORE the device scatter), so the
+             salvage is never trusted over them; a loss caught MID-
+             advance legitimately leaves the twin one scatter behind
+             the mirror, so no equality is asserted — `salvaged` simply
+             reports whether the old twins were still readable and
+             current;
+          2. re-seed the twins sharded onto the new mesh through
+             `_seed_device_locked` — which re-reads `node_bucket` (the
+             survivor count's re-pad, non-pow2 remainders included) and
+             the sharded-tier floor for the new device set;
+          3. the JOURNAL REPLAY STATE IS PRESERVED: `version`/`_seq`/the
+             stale-generation ring are untouched, so post-evacuation
+             advances continue the same delta stream and the twins stay
+             bit-identical to a never-failed oracle.
+        Dead-mesh jit helpers are dropped (`_jits`) so no executable
+        referencing the old Mesh can serve the new generation."""
+        if not self.enabled():
+            return {"skipped": True}
+        t0 = time.monotonic()
+        with self._lock:
+            old_used = self._used_dev
+            self._jits.clear()
+            if self.cap is None:
+                self._cap_dev = self._used_dev = None
+                self._sharded = False
+                return {"skipped": True}
+            salvaged = False
+            if old_used is not None:
+                try:
+                    import jax
+
+                    from .sharding import _launch_lock
+                    with _launch_lock:      # old-generation gather
+                        got = np.asarray(jax.device_get(old_used))
+                    n = self.used.shape[0]
+                    salvaged = got[:n].tobytes() == self.used.tobytes()
+                except Exception:   # noqa: BLE001 — dead buffers; the
+                    pass            # host mirror is the same bits anyway
+            self._seed_device_locked()
+            rows = int(self.cap.shape[0])
+        seconds = time.monotonic() - t0
+        metrics.incr("nomad.solver.state_cache.evacuations")
+        metrics.set_gauge("nomad.mesh.evacuation_seconds",
+                          round(seconds, 4))
+        metrics.add_sample("nomad.mesh.evacuation", seconds)
+        return {"skipped": False, "seconds": seconds, "reason": reason,
+                "salvaged": salvaged, "rows": rows}
 
     # -------------------------------------------------------------- reading
 
@@ -417,7 +502,7 @@ class TensorCache:
                         # twins: the gather below runs outside the lock,
                         # and a concurrent reseed may flip self._sharded
                         dev = (self._cap_dev, self._used_dev,
-                               self._bucket, self._sharded)
+                               self._bucket, self._sharded, self._gen)
                 else:
                     for gen in self._ring:
                         if gen.lo <= view.version < gen.hi:
@@ -438,13 +523,16 @@ class TensorCache:
         trace.annotate(cache="miss" if src_cap is view.cap else "hit")
         out = GatherResult(src_cap[rows], src_used[rows])
         if dev is not None:
+            out.gen = dev[4]
             out.cap_dev, out.used_dev = self._gather_device(dev, rows,
                                                             bucket)
         return out
 
     def _gather_device(self, dev: tuple, rows: np.ndarray, bucket: int):
-        cap_dev, used_dev, src_bucket, sharded = dev
+        cap_dev, used_dev, src_bucket, sharded, gen = dev
         try:
+            from .sharding import fire_device_loss_sites
+            fire_device_loss_sites()
             n = len(rows)
             idx = np.zeros(bucket, np.int32)
             idx[:n] = rows
@@ -452,7 +540,14 @@ class TensorCache:
             valid[:n] = True
             fn = self._jit("gather", sharded, src_bucket, bucket)
             return fn(cap_dev, used_dev, idx, valid)
-        except Exception:   # noqa: BLE001 — host arrays already serve
+        except Exception as e:   # noqa: BLE001 — host arrays already serve
+            # device loss quarantines + rebuilds (evacuating the twins
+            # onto the survivor mesh); either way THIS eval proceeds on
+            # the host copies it already holds — same bits, zero loss
+            from . import backend
+            if isinstance(e, backend.device_error_types()):
+                backend.note_dispatch_failure(
+                    "sharded" if sharded else "xla", e, generation=gen)
             return None, None
 
     # ------------------------------------------------------------- feeding
@@ -562,5 +657,6 @@ gather = _cache.gather
 note_commit = _cache.note_commit
 standby_feed = _cache.standby_feed
 reseed = _cache.reseed
+evacuate = _cache.evacuate
 reset = _cache.reset
 enabled = _cache.enabled

@@ -38,6 +38,9 @@ class GroupTensors:
     distinct_hosts: bool
     cap_dev: object = None             # f32[B, R'] device twin (or None)
     used_dev: object = None            # f32[B, R'] device twin (or None)
+    gen: Optional[int] = None          # mesh generation the twins ride
+                                       # (ISSUE 14: placer._dev_mats
+                                       # declines stale-generation twins)
     # explain stage attribution (ISSUE 11), populated only when the
     # placer lowers with explain=True: counts of nodes eliminated by
     # the taint/eligibility mask and the pre-solve distinct-hosts
@@ -350,9 +353,11 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     # `tier` rides along so the cache can also decline the mismatch case
     # (sharded twins + solo tier for a constraint-filtered small eval)
     cached = state_cache.gather(view, rows, bucket=dev_bucket, tier=tier)
+    gen = None
     if cached is not None:
         cap, used = cached.cap, cached.used
         cap_dev, used_dev = cached.cap_dev, cached.used_dev
+        gen = cached.gen
     else:
         cap = view.cap[rows]                   # fancy index => fresh arrays
         used = view.used[rows]
@@ -446,7 +451,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
         nodes=nodes, cap=cap, used=used, feasible=feasible,
         ask=group_ask_row(tg), job_collisions=collisions,
         distinct_hosts=distinct_hosts,
-        cap_dev=cap_dev, used_dev=used_dev, ex_stages=ex_stages,
+        cap_dev=cap_dev, used_dev=used_dev, gen=gen, ex_stages=ex_stages,
     )
 
 

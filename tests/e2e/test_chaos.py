@@ -87,3 +87,68 @@ def test_stream_survives_tier_death_no_orphan_dead_letters(chaos_cluster):
     # the faulted scheduler invoke surfaced as a counted worker eval
     # failure (then nack + redelivery), not a silent swallow
     assert counters.get("nomad.worker.eval_failures", 0) >= 1
+
+
+# ------------------------------------------------- elastic mesh (ISSUE 14)
+
+# device d1 dies on the 3rd multi-device dispatch the agent makes: the
+# mesh must rebuild over the 7 survivors and keep serving evals — the
+# live-agent half of tests/test_mesh_elastic.py's generation-bump
+# acceptance (the agent inherits the virtual 8-device mesh via the
+# XLA_FLAGS conftest exports)
+MESH_FAULTS = '{"device.lost.d1": {"mode": "after", "n": 3, "times": 1}}'
+
+
+@pytest.fixture(scope="module")
+def mesh_cluster(tmp_path_factory):
+    c = Cluster(str(tmp_path_factory.mktemp("meshchaos")), n_servers=1,
+                n_clients=1, env={"NOMAD_FAULTS": MESH_FAULTS})
+    try:
+        c.start()
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_agent_keeps_serving_evals_across_generation_bump(mesh_cluster):
+    """A real 1-agent cluster under a device.lost fault: the eval stream
+    before AND after the forced generation bump lands every alloc, the
+    mesh telemetry shows the bump + quarantine, and zero evals fail."""
+    c = mesh_cluster
+    lead = c.leader()
+    cfg = lead.get("/v1/operator/scheduler/configuration")
+    sc = cfg["SchedulerConfig"]
+    sc["SchedulerAlgorithm"] = "tpu-batch"
+    lead.send("/v1/operator/scheduler/configuration", sc)
+
+    job_ids = []
+    for i in range(4):
+        job_id = f"mesh-{i}-{uuid.uuid4().hex[:6]}"
+        c.run_job(sleep_job(job_id, count=2, seconds=600))
+        job_ids.append(job_id)
+    for job_id in job_ids:
+        assert c.wait_running(job_id, 2, timeout=60), \
+            f"{job_id} never fully running:\n" + "\n".join(
+                p.tail(2000) for p in c.servers + c.clients)
+
+    # zero evals lost to the device death
+    evals = lead.get("/v1/evaluations")
+    assert not [e for e in evals if e["Status"] == "failed"], evals
+
+    # the loss fired, the generation bumped, and the operator can see it
+    def bumped():
+        tel = lead.get("/v1/metrics")["telemetry"]
+        return tel["counters"].get("nomad.faults.fired.device.lost.d1",
+                                   0) >= 1 and \
+            tel["gauges"].get("nomad.mesh.generation", 0) >= 1
+    assert wait_until(bumped, timeout=30), \
+        lead.get("/v1/metrics")["telemetry"]["counters"]
+    bundle = lead.get("/v1/operator/debug")
+    assert bundle["Mesh"]["Generation"] >= 1
+    assert bundle["Mesh"]["QuarantinedDevices"] == [1]
+    assert bundle["Mesh"]["HealthyDevices"] == 7
+
+    # the rebuilt mesh still serves: one more job lands cleanly
+    job_id = f"mesh-post-{uuid.uuid4().hex[:6]}"
+    c.run_job(sleep_job(job_id, count=2, seconds=600))
+    assert c.wait_running(job_id, 2, timeout=60)

@@ -516,6 +516,57 @@ def test_crash_recovery_gate():
         f"— the discipline knob is not reaching the write path")
 
 
+def test_device_chaos_gate():
+    """ISSUE 14 acceptance: once a bench records the device_chaos block,
+    the elastic-mesh lineage (kill 1→K of the 8 virtual devices in the
+    middle of a stream of concurrent 1k-task evals — the
+    `evals_per_sec_1k_stream` workload shape) must show — per leg —
+    every fired loss costing
+    exactly ONE generation bump + quarantine entry, ZERO evals lost
+    (every in-flight solve replayed or was served from the host
+    mirrors), at least one replay across the lineage, and the state-
+    cache evacuation wall under 5s on the dev mesh."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    dc = latest.get("device_chaos")
+    if isinstance(dc, dict) and "error" in dc:
+        pytest.fail(f"BENCH_r{latest_round:02d}: device-chaos lineage "
+                    f"run crashed: {dc['error']}")
+    if not isinstance(dc, dict) or "legs" not in dc:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the "
+                    f"device-chaos lineage")
+    assert dc["evals_lost"] == 0, (
+        f"BENCH_r{latest_round:02d}: {dc['evals_lost']} eval(s) lost to "
+        f"device deaths — the replay/evacuation contract is broken")
+    assert dc["replays"] >= 1, (
+        f"BENCH_r{latest_round:02d}: no in-flight solve ever replayed — "
+        f"the chaos never hit a dispatch, the lineage proved nothing")
+    assert dc["max_evacuation_s"] < 5.0, (
+        f"BENCH_r{latest_round:02d}: state-cache evacuation took "
+        f"{dc['max_evacuation_s']}s — breaches the 5s dev-mesh budget")
+    kills_seen = set()
+    for leg in dc["legs"]:
+        kills_seen.add(leg["killed"])
+        assert leg["loss_faults_fired"] == leg["killed"], (
+            f"BENCH_r{latest_round:02d}: leg killed={leg['killed']} "
+            f"only fired {leg['loss_faults_fired']} losses — the chaos "
+            f"under-delivered and the leg proved less than it claims")
+        assert leg["generation_bumps"] == leg["killed"], (
+            f"BENCH_r{latest_round:02d}: {leg['killed']} kills cost "
+            f"{leg['generation_bumps']} generation bumps — detection "
+            f"must be idempotent (one rebuild per corpse)")
+        assert len(leg["quarantined"]) == leg["killed"], (
+            f"BENCH_r{latest_round:02d}: quarantine "
+            f"{leg['quarantined']} does not match the "
+            f"{leg['killed']} kills")
+        assert leg["evals_lost"] == 0
+    assert {1, 4} <= kills_seen, (
+        f"BENCH_r{latest_round:02d}: the lineage must sweep 1→4 of 8 "
+        f"devices (saw {sorted(kills_seen)})")
+
+
 def test_explain_overhead_gate():
     """ISSUE 11 acceptance: once a bench records the `explain` block,
     the placement-explain byproduct (per-solve fixed-shape reduce +

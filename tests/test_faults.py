@@ -442,7 +442,7 @@ def test_acceptance_pallas_faulted_stream_completes_bit_identical(
     real_build = backend._build
 
     def fake_build(kernel, tier, devs_, k_max, max_steps,
-                   spread_algorithm, depth_grid=None):
+                   spread_algorithm, depth_grid=None, mesh_obj=None):
         if tier == "pallas":
             return real_build(kernel, "xla", devs_, k_max, max_steps,
                               spread_algorithm, depth_grid)
@@ -450,7 +450,7 @@ def test_acceptance_pallas_faulted_stream_completes_bit_identical(
                           spread_algorithm, depth_grid)
 
     monkeypatch.setattr(backend, "_tier",
-                        lambda n, count=None: ("pallas", devs))
+                        lambda n, count=None, snap=None: ("pallas", devs))
     monkeypatch.setattr(backend, "_build", fake_build)
     backend.reset()
     faults.install({"solver.dispatch.pallas": {"mode": "raise"}})

@@ -561,7 +561,7 @@ def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
             "EXC001", "PERF001", "LEAD001", "OBS001", "OBS002",
-            "QUEUE001", "SHARD001"} <= ids
+            "QUEUE001", "SHARD001", "MESH001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -1196,3 +1196,96 @@ def test_nomadlint_gate_whole_tree():
     buf = io.StringIO()
     rc = lint_main([os.path.join(REPO_ROOT, "nomad_tpu")], out=buf)
     assert rc == 0, f"nomadlint regressions:\n{buf.getvalue()}"
+
+
+# ---------------------------------------------------------------- MESH001
+
+MESH001_SHAPE_KEY_BAD = """
+    _cache = {}
+
+    def compiled_for(mesh, k):
+        key = None
+        fn = _cache.get((mesh.shape, k))
+        if fn is None:
+            _cache[(tuple(mesh.axis_names), k)] = fn = object()
+        return fn
+"""
+
+
+def test_mesh001_fires_on_shape_keyed_mesh_cache():
+    out = findings(MESH001_SHAPE_KEY_BAD, path="solver/wrappers.py")
+    assert [f.rule for f in out] == ["MESH001", "MESH001"]
+    assert "generation" in out[0].message
+
+
+def test_mesh001_quiet_on_object_or_generation_keys_and_out_of_scope():
+    good = """
+        _cache = {}
+
+        def compiled_for(mesh, gen, k):
+            fn = _cache.get((mesh, k))          # Mesh OBJECT key: ok
+            if fn is None:
+                _cache[(gen, k)] = fn = object()   # generation key: ok
+            return fn
+    """
+    assert rule_ids(good, path="solver/wrappers.py") == []
+    # scope: the rule only patrols /solver/
+    assert rule_ids(MESH001_SHAPE_KEY_BAD, path="server/plan.py") == []
+    # non-mesh shapes (array bucketing) stay untouched
+    arrays = """
+        _cache = {}
+
+        def for_bucket(cap, k):
+            return _cache.get((cap.shape, k))
+    """
+    assert rule_ids(arrays, path="solver/wrappers.py") == []
+
+
+MESH001_EXCEPT_BAD = """
+    def scan(vr, vp, ask, free, prio, m):
+        try:
+            return sharded_preempt_top_k(m)(vr, vp, ask, free, prio)
+        except Exception:
+            return None
+"""
+
+
+def test_mesh001_fires_on_broad_except_around_sharded_dispatch():
+    out = findings(MESH001_EXCEPT_BAD, path="solver/placer.py")
+    assert [f.rule for f in out] == ["MESH001"]
+    assert "device_error_types" in out[0].message
+
+
+def test_mesh001_quiet_when_classification_is_consulted():
+    good = """
+        def scan(vr, m, backend):
+            try:
+                return sharded_preempt_top_k(m)(vr)
+            except backend.device_error_types():
+                return None
+
+        def scan2(vr, m, backend):
+            try:
+                return sharded_preempt_top_k(m)(vr)
+            except Exception as e:
+                if isinstance(e, backend.device_error_types()):
+                    backend.note_dispatch_failure("sharded", e)
+                return None
+    """
+    assert rule_ids(good, path="solver/placer.py") == []
+    # non-sharded calls under broad except are EXC001's turf, not ours
+    plain = """
+        def go(fn):
+            try:
+                return fn()
+            except Exception:
+                return None
+    """
+    assert rule_ids(plain, path="solver/placer.py") == []
+
+
+def test_mesh001_inline_suppression():
+    src = MESH001_EXCEPT_BAD.replace(
+        "except Exception:",
+        "except Exception:   # nomadlint: disable=MESH001 — probe only")
+    assert rule_ids(src, path="solver/placer.py") == []
