@@ -828,6 +828,81 @@ def _device_chaos_run() -> dict:
     }
 
 
+def _fused_stream_run() -> dict:
+    """Whole-eval residency lineage (ISSUE 15): STRUCTURAL keys only —
+    round-trips-per-eval percentiles over a fused short stream, the
+    per-phase dispatch counts, and a fixed-seed fused-vs-unfused
+    bit-parity differential (the pod-scale diff's shape). Deliberately
+    wall-clock-free: the lineage gates identically on a loaded 1-core
+    box and a TPU pod (the >=70 evals/s assertion lives with the
+    wall-clock stream keys and only arms on multi-core hardware).
+    NOMAD_FUSED_EVALS resizes."""
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.server.fsm import RaftLog
+    from nomad_tpu.server.plan_apply import Planner
+    from nomad_tpu.solver import backend, state_cache
+    from nomad_tpu.structs import SCHED_ALG_TPU
+
+    n_evals = int(os.environ.get("NOMAD_FUSED_EVALS", "64"))
+
+    # ---- fused short stream: round trips + dispatch counts
+    state_cache.reset()
+    backend.reset()
+    base = dict(metrics.snapshot()["counters"])
+    rt_skip = metrics.sample_count("nomad.solver.device_round_trips")
+    fsm_f = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=29)
+    _stream_run(fsm_f, n_evals, STREAM_CONCURRENCY)
+
+    def delta(key):
+        return int(metrics.counter(key) - base.get(key, 0))
+
+    dispatches = {ph: delta(f"nomad.solver.dispatches.{ph}")
+                  for ph in ("gather", "solve", "explain", "preempt",
+                             "fused")}
+    # computed HERE: the parity legs below also dispatch fused programs
+    fused_dispatches = delta("nomad.solver.dispatch.fused")
+
+    # ---- fixed-seed bit parity: identical cluster + eval id, only the
+    # fused knob differs between legs
+    def parity_leg(flag: str):
+        saved = os.environ.get("NOMAD_SOLVER_FUSED")
+        os.environ["NOMAD_SOLVER_FUSED"] = flag
+        state_cache.reset()
+        backend.reset()
+        try:
+            f = _seed_fsm(N_NODES, SCHED_ALG_TPU, seed=31,
+                          pin_ids="fused-par-")
+            p = Planner(RaftLog(f), f.state)
+            j = _mk_batch_job("fused-par", 1_000)
+            _register(f, j)
+            _run_eval(f, p, j, eval_id="fused-par-eval")
+            return {(a.name, a.node_id)
+                    for a in f.state.allocs_by_job("default",
+                                                   "fused-par")}
+        finally:
+            if saved is None:
+                os.environ.pop("NOMAD_SOLVER_FUSED", None)
+            else:
+                os.environ["NOMAD_SOLVER_FUSED"] = saved
+            state_cache.reset()
+            backend.reset()
+
+    fused_placed = parity_leg("1")
+    classic_placed = parity_leg("0")
+
+    return {
+        "evals": n_evals,
+        "round_trips_p50": metrics.percentile(
+            "nomad.solver.device_round_trips", 0.5, skip=rt_skip),
+        "round_trips_p95": metrics.percentile(
+            "nomad.solver.device_round_trips", 0.95, skip=rt_skip),
+        "fused_dispatches": fused_dispatches,
+        "dispatches": dispatches,
+        "bit_parity": fused_placed == classic_placed,
+        "parity_placed": len(fused_placed),
+    }
+
+
 def _crash_recovery_run() -> dict:
     """Crash-recovery lineage (ISSUE 13, docs/DURABILITY.md): the raft
     WAL's durability/throughput envelope on this box.
@@ -1727,6 +1802,14 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         device_chaos = {"error": repr(e)[:200]}
 
+    # whole-eval-residency lineage (ISSUE 15): fused round-trips-per-eval
+    # + fused-vs-unfused bit parity, structural keys only; gated once
+    # recorded
+    try:
+        fused_stream = _fused_stream_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        fused_stream = {"error": repr(e)[:200]}
+
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
     # tests/test_bench_regression.py once recorded
@@ -1804,6 +1887,9 @@ def main() -> None:
         # ISSUE 14: elastic-mesh device-chaos lineage (kill 1..K of 8
         # virtual devices mid-stream; zero evals lost, replays recorded)
         "device_chaos": device_chaos,
+        # ISSUE 15: whole-eval residency (fused dispatch) — structural,
+        # load-insensitive keys (round trips per eval, bit parity)
+        "fused_stream": fused_stream,
         "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
         "state_cache": state_cache_counters,
         **phases,
@@ -2155,6 +2241,10 @@ if __name__ == "__main__":
         # standalone device-chaos lineage (ISSUE 14): kill 1..K of the
         # 8 virtual devices mid-1k-eval-stream; NOMAD_CHAOS_EVALS resizes
         print(json.dumps(_device_chaos_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fused-stream":
+        # standalone whole-eval-residency lineage (ISSUE 15): fused
+        # round trips per eval + bit parity; NOMAD_FUSED_EVALS resizes
+        print(json.dumps(_fused_stream_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":

@@ -1,0 +1,127 @@
+"""SYNC001 — single-sync discipline on the solver hot path (ISSUE 15,
+docs/BACKEND_TIERS.md "Whole-eval residency").
+
+The fused-dispatch contract is structural: an eval touches the device
+ONCE — one compiled program, one materialization at the designated sync
+seam. The failure shape this rule patrols is the quiet re-introduction
+of per-eval host syncs: an `np.asarray(...)` / `jax.device_get(...)` /
+`.block_until_ready()` dropped into a placer or micro-batcher hot-path
+function "just to peek" at a device value forces an extra host↔device
+round trip per eval and silently re-splits the fused dispatch — the
+exact regression class the round-trips-per-eval lineage gates, but
+caught at review time instead of at the next bench round.
+
+Scope: `/solver/placer.py` and `/solver/microbatch.py` — the two
+modules whose function bodies run once per eval (or per coalesced
+window). Materializations of HOST-tier results are exempt by shape
+(`np.asarray(host_fn(...))` and friends: the host tier never left the
+host, so there is nothing to sync). Every legitimate seam — the
+placer's single materialization point, the pipelined chunk collector,
+the preemption verdict, the micro-batcher's coalesced dispatch —
+carries the standard inline `# nomadlint: disable=SYNC001 — <why>`
+naming its reason (docs/STATIC_ANALYSIS.md).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, SourceModule, register
+
+# (import-origin, attr) pairs that synchronize host<->device
+_SYNC_ATTRS = ("asarray", "device_get", "block_until_ready")
+_SYNC_ORIGINS = ("numpy", "jax")
+
+
+def _name_chain(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_hostish(node: ast.AST) -> bool:
+    """Is the materialized value already host-resident by shape — the
+    result of a host-tier call (`host_fn(...)`, `host_fallback`
+    products) or a read off an already-materialized `host*` binding
+    (`host[0]`, `req.host_args`)? Those never left (or already left)
+    the device; materializing them is free."""
+    if isinstance(node, ast.Call):
+        return "host" in _name_chain(node.func).lower()
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    return "host" in _name_chain(node).lower()
+
+
+@register
+class SingleSyncSeam(Rule):
+    id = "SYNC001"
+    severity = "error"
+    short = ("per-eval host sync (np.asarray / jax.device_get / "
+             ".block_until_ready) on the placer/micro-batcher hot path "
+             "outside the designated single-sync seam — re-splits the "
+             "fused dispatch into extra host↔device round trips")
+    path_markers = ("/solver/placer.py", "/solver/microbatch.py")
+
+    def _sync_call(self, mod: SourceModule, call: ast.Call) -> str:
+        """-> description of the sync if `call` is one, else ''.
+        `jnp.asarray` (origin jax.numpy) is a host->device PLACEMENT,
+        not a sync, so origins are matched exactly: numpy's asarray and
+        jax's device_get/block_until_ready. An asarray carrying a dtype
+        (second arg or keyword) is the host-lowering idiom over host
+        data — exempt."""
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready" and not call.args:
+                # x.block_until_ready()
+                return ".block_until_ready()"
+            if isinstance(func.value, ast.Name):
+                origin = mod.imports.get(func.value.id, "")
+                if func.attr == "asarray" and origin == "numpy" and \
+                        len(call.args) == 1 and not call.keywords:
+                    return f"{func.value.id}.asarray(...)"
+                if func.attr in ("device_get", "block_until_ready") and \
+                        origin == "jax":
+                    return f"{func.value.id}.{func.attr}(...)"
+        elif isinstance(func, ast.Name):
+            origin = mod.imports.get(func.id, "")
+            if origin == "numpy.asarray" and len(call.args) == 1 and \
+                    not call.keywords:
+                return f"{func.id}(...)"
+            if origin in ("jax.device_get", "jax.block_until_ready"):
+                return f"{func.id}(...)"
+        return ""
+
+    @staticmethod
+    def _scope_of(mod: SourceModule, node: ast.AST):
+        """Nearest enclosing function def (rules_det's scope discipline
+        — one module walk, each call attributed exactly once, nested
+        defs included)."""
+        for anc in mod.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = self._scope_of(mod, node)
+            if fn is None:
+                continue            # module scope: not a per-eval path
+            desc = self._sync_call(mod, node)
+            if not desc:
+                continue
+            if node.args and _is_hostish(node.args[0]):
+                continue            # host-tier result: nothing to sync
+            out.append(mod.finding(
+                self, node,
+                f"{desc} inside hot-path `{fn.name}` synchronizes "
+                f"host↔device once per eval — route the value "
+                f"through the fused program / the designated "
+                f"single-sync seam, or mark the seam with "
+                f"`# nomadlint: disable=SYNC001 — <why>`"))
+        return out

@@ -267,7 +267,11 @@ class AllocReconciler:
 
         self._handle_delayed_reschedules(reschedule_later, group)
 
-        name_index = AllocNameIndex(
+        # name-slot membership as a fixed-shape masked tensor (ISSUE 15):
+        # the twin's selection ops are field-exact with AllocNameIndex
+        # (fuzz-pinned); NOMAD_RECONCILE_TENSOR=0 restores the set walk
+        from .reconcile_tensor import make_name_index
+        name_index = make_name_index(
             self.job_id, group, tg.count,
             union(untainted, migrate, reschedule_now, lost))
 
@@ -583,8 +587,9 @@ class AllocReconciler:
 
         # prefer stopping migrating allocs
         if migrate:
-            m_index = AllocNameIndex(self.job_id, tg.name, tg.count,
-                                     dict(migrate))
+            from .reconcile_tensor import make_name_index
+            m_index = make_name_index(self.job_id, tg.name, tg.count,
+                                      dict(migrate))
             remove_names = m_index.highest(remove)
             for aid, alloc in list(migrate.items()):
                 if alloc.name not in remove_names:

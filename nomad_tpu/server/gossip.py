@@ -280,53 +280,62 @@ class Gossip:
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.interval):
-            # periodic anti-entropy push-pull with a random member of ANY
-            # status (memberlist's full state sync): this is how a node
-            # wrongly marked DEAD after a healed partition hears the
-            # rumor about itself and refutes — probes alone never reach
-            # it because DEAD members leave the probe set
-            now = time.monotonic()
-            if now - self._last_sync >= self.sync_interval:
-                self._last_sync = now
+            self.probe_tick()
+
+    def probe_tick(self) -> None:
+        """One probe-loop pass, extracted from the daemon loop so tests
+        can drive it directly inside a bounded wait_until poll instead
+        of racing the background thread's scheduling on a loaded box
+        (the PR-6 gossip / PR-13 deployment-watcher deflake pattern).
+        An extra pass is idempotent by construction: probes re-confirm
+        state, suspicion/reaping key on wall-clock timeouts."""
+        # periodic anti-entropy push-pull with a random member of ANY
+        # status (memberlist's full state sync): this is how a node
+        # wrongly marked DEAD after a healed partition hears the
+        # rumor about itself and refutes — probes alone never reach
+        # it because DEAD members leave the probe set
+        now = time.monotonic()
+        if now - self._last_sync >= self.sync_interval:
+            self._last_sync = now
+            with self._lock:
+                others = [m for m in self.members.values()
+                          if m.name != self.name]
+            if others:
+                target = random.choice(others)
                 with self._lock:
-                    others = [m for m in self.members.values()
-                              if m.name != self.name]
-                if others:
-                    target = random.choice(others)
-                    with self._lock:
-                        wire = [m.to_wire() for m in self.members.values()]
-                    self._send(target.addr, {"t": "push-pull", "seq": 0,
-                                             "members": wire})
-            with self._lock:
-                candidates = [m for m in self.members.values()
-                              if m.name != self.name and
-                              m.status in (ALIVE, SUSPECT)]
-            if not candidates:
-                continue
-            target = random.choice(candidates)
-            if self._ping(target.addr):
-                self._mark_alive_probe(target)
-                continue
-            # indirect probes via k helpers
-            with self._lock:
-                helpers = [m for m in candidates
-                           if m.name != target.name and m.status == ALIVE]
-            random.shuffle(helpers)
-            with self._lock:
-                self._seq += 1
-                seq = self._seq
-            ev = threading.Event()
-            self._acks[seq] = ev
-            for h in helpers[:2]:
-                self._send(h.addr, {"t": "ping-req", "seq": seq,
-                                    "target": [target.host, target.port]})
-            ok = ev.wait(self.probe_timeout * 2)
-            self._acks.pop(seq, None)
-            if ok:
-                self._mark_alive_probe(target)
-            else:
-                self._suspect(target)
-            self._reap_suspects()
+                    wire = [m.to_wire() for m in self.members.values()]
+                self._send(target.addr, {"t": "push-pull", "seq": 0,
+                                         "members": wire})
+        with self._lock:
+            candidates = [m for m in self.members.values()
+                          if m.name != self.name and
+                          m.status in (ALIVE, SUSPECT)]
+        if not candidates:
+            return
+        target = random.choice(candidates)
+        if self._ping(target.addr):
+            self._mark_alive_probe(target)
+            return
+        # indirect probes via k helpers
+        with self._lock:
+            helpers = [m for m in candidates
+                       if m.name != target.name and m.status == ALIVE]
+        random.shuffle(helpers)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        ev = threading.Event()
+        self._acks[seq] = ev
+        for h in helpers[:2]:
+            self._send(h.addr, {"t": "ping-req", "seq": seq,
+                                "target": [target.host, target.port]})
+        ok = ev.wait(self.probe_timeout * 2)
+        self._acks.pop(seq, None)
+        if ok:
+            self._mark_alive_probe(target)
+        else:
+            self._suspect(target)
+        self._reap_suspects()
 
     def _mark_alive_probe(self, target: Member) -> None:
         with self._lock:

@@ -678,6 +678,20 @@ class Planner:
         # referenced alloc ids overlap an earlier plan's (impossible for
         # broker-serialized evals; pipelined chunks place disjoint fresh
         # allocs) drop to the exact ordered pass wholesale.
+        # fused solver verdict (ISSUE 15): trusted ONLY for a batch of
+        # one — the monotone fast path has no view of sibling plans'
+        # asks on a shared row, and the batch machinery's prefix-order
+        # verdicts must stay authoritative whenever plans can interact.
+        # The stamp binds iff it describes exactly the usage bits this
+        # evaluation reads (same uid/epoch/version).
+        verdict_rows = None
+        if tensor and view is not None and len(plans) == 1:
+            sv = getattr(plans[0], "solver_verdict", None)
+            if sv and sv.get("uid") == getattr(view, "uid", 0) and \
+                    sv.get("epoch") == getattr(view, "epoch", -1) and \
+                    sv.get("version") == getattr(view, "version", -2):
+                verdict_rows = sv.get("rows") or None
+
         shapes: list[_PlanShape] = []
         seen_refs: set[str] = set()
         for plan in plans:
@@ -698,7 +712,8 @@ class Planner:
                 if view is None or not tensor or conflicted:
                     shape.exact_nodes = list(plan.node_allocation)
                     continue
-                self._shape_dense(snap, view, plan, shape)
+                self._shape_dense(snap, view, plan, shape,
+                                  verdict_rows=verdict_rows)
             except BaseException as e:   # noqa: BLE001 — isolate the plan
                 # a malformed plan (bad alloc shapes, poisoned resources)
                 # fails ALONE: it contributes no dense/exact work and the
@@ -772,8 +787,8 @@ class Planner:
                     refs.add(a.id)
         return refs
 
-    def _shape_dense(self, snap, view, plan: Plan,
-                     shape: _PlanShape) -> None:
+    def _shape_dense(self, snap, view, plan: Plan, shape: _PlanShape,
+                     verdict_rows: dict = None) -> None:
         """Classify one plan's nodes and build its dense ask rows (the
         former per-plan `_evaluate_plan_dense` gather, ctx-free: phase 1
         runs before any in-batch commits exist for these plans)."""
@@ -826,6 +841,22 @@ class Planner:
                     old = alloc_usage_tuple(existing)
                     for i, x in enumerate(old):
                         ask[i] -= x
+            if verdict_rows is not None:
+                v = verdict_rows.get(r)
+                if v is not None and np.all(
+                        np.asarray(ask, np.float32) <= v):
+                    # fused verdict fast path (ISSUE 15): the device
+                    # proved used[r] + verified <= cap + eps at these
+                    # exact usage bits; this plan's ask is elementwise
+                    # <= verified and IEEE addition is monotone, so the
+                    # dense compare must also pass. Node-status checks
+                    # above still ran against LATEST state; only the
+                    # row-fit re-gather is skipped. A False/absent/
+                    # larger-ask row re-checks normally — fit is not
+                    # monotone in the other direction.
+                    shape.verdicts[node_id] = True
+                    metrics.incr("nomad.plan.verdict_fastpath")
+                    continue
             shape.dense_nodes.append(node_id)
             shape.dense_rows.append(r)
             shape.dense_asks.append(tuple(ask))

@@ -539,6 +539,9 @@ def _chain(kernel: str, tiers: tuple, devs, k_max: int, max_steps: int,
                 # async callers report success/failure from their
                 # materialize site (an unblocked future proves nothing)
                 _breaker.record_success(tier)
+            if tier != "host":
+                from . import roundtrip
+                roundtrip.note("solve")
             metrics.incr(f"nomad.solver.dispatch.{tier}")
             if i > 0:
                 metrics.incr(f"nomad.solver.tier_degraded_serves.{tier}")
@@ -663,6 +666,197 @@ def select(kernel: str, n_padded: int, *, count=None, k_max: int = 128,
                                       max_steps, spread_algorithm,
                                       depth_grid, snap=snap))
     return out
+
+
+def fused_enabled(cfg=None) -> bool:
+    """Whole-eval residency gate (ISSUE 15): SchedulerConfiguration
+    .solver_fused_enabled (hot-reloadable through the same replicated
+    config path as the other solver knobs), NOMAD_SOLVER_FUSED=0/1
+    force-overrides (the bit-parity differentials flip it per leg)."""
+    env = os.environ.get("NOMAD_SOLVER_FUSED", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return bool(getattr(cfg, "solver_fused_enabled", True))
+
+
+def select_fused(kernel: str, n_padded: int, *, count=None,
+                 k_max: int = 128, spread_algorithm: bool = False,
+                 depth_grid=None, n_classes: int = 0,
+                 sharded_twins: bool = False, mesh_snap=None):
+    """-> (tier, run) for the whole-eval fused program (ISSUE 15), or
+    None when the fused route should not engage for this shape: host-
+    tier resolution (no device to fuse onto), a twin/tier shardedness
+    mismatch (sharded twins must feed the sharded tier and vice versa,
+    same rule as the classic gather path), or a non-fusable kernel.
+
+    `run(*fused_args, host_args=...)` dispatches ONE compiled
+    gather+solve+plan-verdict(+explain) program — the eval touches the
+    device once — and returns a flat tuple whose first element is the
+    placement vector, second the fit verdict, remainder the explain
+    reduce outputs. On any device-tier failure it classifies the error
+    (ISSUE 14: loss quarantines + rebuilds + counts a replay; transients
+    feed the breaker), then re-solves through a FRESH classic select()
+    chain at the current generation from `host_args` (the uncommitted
+    numpy twin of the identical inputs) — bits identical, the eval
+    survives, only the route changes; that fallback returns a 1-tuple
+    (placed,) so callers know no verdict/explain rode along. Cache is
+    generation-keyed like select()'s (a mesh rebuild invalidates every
+    fused chain instead of serving dead-mesh shardings)."""
+    from . import sharding
+    if kernel not in ("depth", "greedy"):
+        return None
+    snap = mesh_snap if mesh_snap is not None else sharding.snapshot()
+    if snap.generation != sharding.generation():
+        snap = sharding.snapshot()      # mid-eval rebuild: never pin dead
+    tier, devs = _tier(n_padded, count, snap=snap)
+    if tier == "pallas":
+        # the hand-fused VMEM kernel owns this shape (one HBM read of
+        # the node matrix beats XLA's materialized temporaries at these
+        # buckets): declining keeps the pallas tier + its ladder exactly
+        # as before rather than silently trading it for a fused XLA
+        # program — the pallas route already rides the resident twins
+        return None
+    if tier == "batch" and kernel != "depth":
+        tier = "xla"    # only depth solves micro-batch (select() rule)
+    if tier == "host":
+        return None     # no accelerator in the route: nothing to fuse
+    if (tier == "sharded") != bool(sharded_twins):
+        return None     # shardedness mismatch: classic route serves it
+    key = ("fused", kernel, n_padded, k_max, spread_algorithm, depth_grid,
+           n_classes, tier, PALLAS_MIN_NODES, SHARD_MIN_NODES,
+           HOST_MAX_COUNT, snap.generation,
+           os.environ.get("NOMAD_SOLVER_BACKEND", ""))
+    cached = _cache.get(key)
+    if cached is not None:
+        return cached
+    out = _cache[key] = (tier, _fused_chain(kernel, tier, devs, snap,
+                                            n_padded, count, k_max,
+                                            spread_algorithm, depth_grid,
+                                            n_classes))
+    return out
+
+
+def _fused_chain(kernel: str, tier: str, devs, snap, n_padded: int,
+                 count, k_max: int, spread_algorithm: bool, depth_grid,
+                 n_classes: int):
+    """The fused dispatch seam: one attempt on the fused program under
+    the serving tier's breaker + fault site + device-loss seams, then
+    the classic select() ladder from `host_args` on any failure. The
+    classic fallback is the whole unfused route (its own ladder,
+    breakers, and host floor), so the fused path can never strand an
+    eval below the availability the pre-fusion code had."""
+    fn = _build_fused(kernel, tier, devs, k_max, spread_algorithm,
+                      depth_grid, n_classes,
+                      mesh_obj=snap.mesh if tier == "sharded" else None)
+    gen = snap.generation
+
+    def classic(host_args):
+        _, cfn = select(kernel, n_padded, count=count, k_max=k_max,
+                        spread_algorithm=spread_algorithm,
+                        depth_grid=depth_grid)
+        return (cfn(*host_args),)
+
+    def run(*args, host_args=None):
+        import jax
+
+        from . import roundtrip, sharding
+        from ..obs import trace
+        errs = device_error_types()
+        if not _breaker.admit(tier):
+            metrics.incr(
+                f"nomad.solver.tier_breaker_short_circuit.{tier}")
+            return classic(host_args)
+        # the batch tier's wrapper span covers the WHOLE coalesced-window
+        # wait (like the classic solver.dispatch.batch spans the bench's
+        # dispatch-share attribution excludes) — the actual device time
+        # is the shared solver.microbatch.dispatch span; naming it
+        # .batch keeps the PR-7 attribution math honest
+        span_name = ("solver.dispatch.batch" if tier == "batch"
+                     else "solver.dispatch.fused")
+        try:
+            with trace.span(span_name, tier=tier, fused=True,
+                            kernel=kernel):
+                faults.fire("solver.dispatch.fused")
+                # the fused program IS a dispatch on `tier`: existing
+                # per-tier fault plans (chaos suites, operator drills)
+                # must keep hitting it — a faulted tier then falls to
+                # the classic ladder below, which re-fires the site and
+                # demotes exactly as the unfused path would
+                faults.fire(f"solver.dispatch.{tier}")
+                if tier != "batch":
+                    sharding.fire_device_loss_sites()
+                if tier == "batch":
+                    # the micro-batcher owns its own breaker feedback,
+                    # fault sites and per-lane host fanout
+                    out = fn(*args, host_args=host_args)
+                else:
+                    out = jax.block_until_ready(fn(*args))
+        except errs as e:
+            replay = note_dispatch_failure(tier, e, generation=gen)
+            metrics.incr("nomad.solver.tier_demotions")
+            metrics.incr("nomad.solver.tier_demotions.fused")
+            trace.annotate_list("demotions", "fused")
+            if replay:
+                # the classic re-select below rides the NEW generation:
+                # the in-flight eval replays on the survivors from its
+                # uncommitted host args — zero evals lost (ISSUE 14)
+                metrics.incr("nomad.mesh.replays")
+            return classic(host_args)
+        except BaseException:
+            # non-demotable failure: the breaker must still see it or a
+            # half-open probe leaks probing=True (same rule as _chain)
+            _breaker.record_failure(tier)
+            raise
+        if tier != "batch":
+            _breaker.record_success(tier)
+        if len(out) > 1:
+            # arity 1 = the micro-batcher fell to a solo host solve (no
+            # siblings to coalesce with): no device was touched, so no
+            # fused dispatch or round trip is billed
+            metrics.incr("nomad.solver.dispatch.fused")
+            metrics.incr(f"nomad.solver.dispatch.fused.{tier}")
+            roundtrip.note("fused")
+        return out
+    return run
+
+
+def _build_fused(kernel: str, tier: str, devs, k_max: int,
+                 spread_algorithm: bool, depth_grid, n_classes: int,
+                 mesh_obj=None):
+    """One fused executable per (kernel, tier, statics): the solo jit,
+    the mesh-spec'd sharded variant (twin specs in, matching specs out),
+    or the micro-batched lane dispatcher."""
+    import jax
+
+    from .kernels import fused_eval_depth, fused_eval_greedy
+    if tier == "sharded":
+        from .sharding import sharded_fused
+        return sharded_fused(mesh_obj if mesh_obj is not None
+                             else _mesh(devs), kernel=kernel, k_max=k_max,
+                             spread_algorithm=spread_algorithm,
+                             depth_grid=depth_grid, n_classes=n_classes)
+    if kernel == "depth":
+        impl = functools.partial(
+            fused_eval_depth, k_max=k_max,
+            spread_algorithm=spread_algorithm, depth_grid=depth_grid,
+            n_classes=n_classes)
+    else:
+        impl = functools.partial(fused_eval_greedy, n_classes=n_classes)
+    if tier == "batch":
+        from . import microbatch
+        skey = ("fused", kernel, k_max, spread_algorithm, depth_grid,
+                n_classes)
+        host_fn = host_fallback(kernel, k_max=k_max,
+                                spread_algorithm=spread_algorithm,
+                                depth_grid=depth_grid)
+
+        def run_batched(*args, host_args=None):
+            return microbatch.solve_fused(skey, impl, args[:2], args[2:],
+                                          host_fn, host_args)
+        return run_batched
+    return jax.jit(impl)
 
 
 def _on_host(fn):
@@ -856,6 +1050,57 @@ def warmup(n_nodes: int, k_maxes: tuple = (8, 64, 128),
             if os.environ.get("NOMAD_DEBUG"):
                 raise
             del e
+    # whole-eval fused artifacts (ISSUE 15): the solo fused jit per
+    # depth regime + greedy, driven with synthetic resident twins so a
+    # promoted leader's first fused eval replays compiled artifacts.
+    # count=None routes by bucket (the small-count batch window warms
+    # itself on the first coalesced stream dispatch); select_fused's
+    # declines (pallas-owned shapes, host) just skip.
+    if fused_enabled() and time.monotonic() - t0 <= budget_s:
+        import jax.numpy as jnp
+        cap_res, used_res = jnp.asarray(cap), jnp.asarray(used)
+        idx = np.arange(bucket, dtype=np.int32)
+        valid = np.ones(bucket, bool)
+        cls = np.zeros(bucket, np.int32)
+        fused_plan = []
+        for k_max in k_maxes:
+            grid = tuple(g for g in DEPTH_GRID if g <= k_max) or (1,)
+            fused_plan.append(("depth", k_max, None))
+            fused_plan.append(("depth", k_max, grid))
+        fused_plan.append(("greedy", 8, None))
+        for kernel, k_max, grid in fused_plan:
+            if time.monotonic() - t0 > budget_s:
+                metrics.incr("nomad.solver.warmup.budget_exhausted")
+                break
+            try:
+                sel = select_fused(kernel, bucket, k_max=k_max,
+                                   depth_grid=grid)
+                if sel is None:
+                    continue
+                _, fn = sel
+                if kernel == "depth":
+                    fn(cap_res, used_res, idx, valid, ask, np.int32(1),
+                       feasible, coll, np.int32(1),
+                       np.zeros(bucket, np.float32), np.int32(2 ** 30),
+                       jitter, np.float32(1.0), np.float32(0.0),
+                       cls, np.bool_(False),
+                       host_args=(cap, used, ask, np.int32(1), feasible,
+                                  coll, np.int32(1),
+                                  np.zeros(bucket, np.float32),
+                                  np.int32(2 ** 30), jitter,
+                                  np.float32(1.0), np.float32(0.0)))
+                else:
+                    fn(cap_res, used_res, idx, valid, ask, np.int32(1),
+                       feasible, np.int32(2 ** 30), cls, np.bool_(False),
+                       coll,
+                       host_args=(cap, used, ask, np.int32(1), feasible,
+                                  np.int32(2 ** 30)))
+                artifacts += 1
+            except Exception as e:  # noqa: BLE001 — warmup never wedges
+                metrics.incr("nomad.solver.warmup.errors")
+                if os.environ.get("NOMAD_DEBUG"):
+                    raise
+                del e
     seconds = time.monotonic() - t0
     metrics.incr("nomad.solver.warmup.artifacts", artifacts)
     metrics.set_gauge("nomad.solver.warmup.seconds", round(seconds, 3))

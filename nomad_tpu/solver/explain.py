@@ -431,11 +431,15 @@ def dispatch_reduce(gt, placed, class_ids: np.ndarray, n_classes_pad: int):
     args = (cap_m, used_m, gt.ask, gt.feasible, gt.job_collisions,
             placed, class_ids, dh_flag)
     if sharding.is_node_sharded(placed):
+        from . import roundtrip
         fn = sharding.sharded_explain_reduce(
             placed.sharding.mesh, n_classes=n_classes_pad)
+        roundtrip.note("explain")
         return fn(*args)
     if wants_device_reduce(placed):
+        from . import roundtrip
         from .kernels import explain_reduce
+        roundtrip.note("explain")
         return explain_reduce(*args, n_classes=n_classes_pad)
     # host route: padding rows are infeasible with zero placements, so
     # they contribute nothing — slice them off (bit-identical, pinned in

@@ -569,6 +569,96 @@ explain_reduce = jax.jit(_explain_reduce_impl,
                          static_argnames=("n_classes",))
 
 
+# ---------------------------------------------------- whole-eval residency
+
+# the plan-evaluate fit tolerance — MUST equal plan_apply._FIT_EPS: the
+# fused verdict is only sound as a fast path because it is the literal
+# same compare the applier's vectorized AllocsFit pass runs
+FIT_EPS = 1e-3
+
+
+def gather_rows(cap_res: jnp.ndarray, used_res: jnp.ndarray,
+                idx: jnp.ndarray, valid: jnp.ndarray) -> tuple:
+    """The state cache's device gather as a pure jnp body (state_cache
+    _jit "gather" kind, verbatim): rows of the RESIDENT bucket-padded
+    twins in eval (shuffled) order, padding rows zeroed exactly like the
+    host np.pad path. Inlined into the fused program below so the gather
+    never materializes as its own dispatch."""
+    m2 = valid[:, None]
+    return (jnp.where(m2, cap_res[idx], 0.0),
+            jnp.where(m2, used_res[idx], 0.0))
+
+
+def plan_fit_verdict(cap: jnp.ndarray, used: jnp.ndarray, ask: jnp.ndarray,
+                     placed: jnp.ndarray) -> jnp.ndarray:
+    """The plan-evaluate feasibility verdict at solve-snapshot state:
+    bool[N], True where the node still fits its placements post-solve —
+    the same `used + k·ask <= cap + eps` compare the applier's dense
+    vector pass runs (plan_apply._vector_pass). Monotone consumption
+    contract: a True verdict proves fit for any ask elementwise <= the
+    verified k·ask (IEEE addition is monotone), so the applier may trust
+    True rows at an unchanged usage version and must re-check False
+    rows (a smaller actual ask can still fit)."""
+    post = used + placed[:, None].astype(jnp.float32) * ask[None, :]
+    return jnp.all(post <= cap + FIT_EPS, axis=1)
+
+
+def fused_eval_depth(cap_res, used_res, idx, valid, ask, count, feasible,
+                     job_collisions, desired_count, affinity_boost,
+                     max_per_node, order_jitter, jitter_scale,
+                     jitter_samples, class_ids, distinct_hosts,
+                     k_max: int = 128, spread_algorithm: bool = False,
+                     depth_grid=None, n_classes: int = 0) -> tuple:
+    """Whole-eval residency (ISSUE 15 tentpole): gather + depth solve +
+    plan-evaluate verdict (+ explain reduce when `n_classes` > 0) as ONE
+    traced body — jitted by the backend into a single compiled program,
+    so an eval's device work is one dispatch and one device_get instead
+    of 3-5 round trips. Intermediates (the gathered [B, R'] matrices,
+    the [B, K] score curve) live and die inside the program — XLA reuses
+    their buffers like donated inputs; nothing round-trips to host.
+
+    The solve body is fill_depth itself (traced through), so placements
+    are bit-identical to the unfused path by construction. Returns
+    (placed i32[B], fit bool[B][, counts, dim_exh, class_exh, class_dh])
+    — the explain tail is kernels._explain_reduce_impl on the same
+    gathered matrices, identical bits to the standalone reduce."""
+    cap, used = gather_rows(cap_res, used_res, idx, valid)
+    placed = fill_depth(cap, used, ask, count, feasible, job_collisions,
+                        desired_count, affinity_boost,
+                        max_per_node=max_per_node, k_max=k_max,
+                        spread_algorithm=spread_algorithm,
+                        order_jitter=order_jitter,
+                        jitter_scale=jitter_scale,
+                        jitter_samples=jitter_samples,
+                        depth_grid=depth_grid)
+    fit = plan_fit_verdict(cap, used, ask, placed)
+    if not n_classes:
+        return placed, fit
+    ex = _explain_reduce_impl(cap, used, ask, feasible, job_collisions,
+                              placed, class_ids, distinct_hosts,
+                              n_classes=n_classes)
+    return (placed, fit) + ex
+
+
+def fused_eval_greedy(cap_res, used_res, idx, valid, ask, count, feasible,
+                      max_per_node, class_ids, distinct_hosts,
+                      job_collisions, n_classes: int = 0) -> tuple:
+    """fused_eval_depth's greedy-binpack sibling: gather +
+    fill_greedy_binpack + verdict (+ explain) in one traced body.
+    `job_collisions` rides along only for the explain reduce (the greedy
+    kernel itself never reads it — exactly like the unfused path)."""
+    cap, used = gather_rows(cap_res, used_res, idx, valid)
+    placed = fill_greedy_binpack(cap, used, ask, count, feasible,
+                                 max_per_node=max_per_node)
+    fit = plan_fit_verdict(cap, used, ask, placed)
+    if not n_classes:
+        return placed, fit
+    ex = _explain_reduce_impl(cap, used, ask, feasible, job_collisions,
+                              placed, class_ids, distinct_hosts,
+                              n_classes=n_classes)
+    return (placed, fit) + ex
+
+
 @jax.jit
 def preemption_distance(victim_res: jnp.ndarray, ask: jnp.ndarray
                         ) -> jnp.ndarray:

@@ -48,10 +48,13 @@ FOLLOWER_TIMEOUT = 120.0    # follower safety valve if a leader dies
 
 class _Request:
     __slots__ = ("args", "event", "out", "err", "ctx", "t0",
-                 "dispatch_ctx")
+                 "dispatch_ctx", "host_args")
 
-    def __init__(self, args: tuple):
+    def __init__(self, args: tuple, host_args: tuple = None):
         self.args = args
+        # classic (unfused) numpy twin of a FUSED request's inputs — the
+        # per-lane host fallback when a fused window fans out (ISSUE 15)
+        self.host_args = host_args
         self.event = threading.Event()
         self.out: Optional[np.ndarray] = None
         self.err: Optional[BaseException] = None
@@ -198,6 +201,175 @@ class MicroBatcher:
             raise RuntimeError("microbatch leader never delivered a result")
         return req.out
 
+    # ------------------------------------------------- fused lane solving
+
+    def solve_fused(self, static_key: tuple, impl, twins: tuple,
+                    lane_args: tuple, host_fn, host_args: tuple) -> tuple:
+        """One normalized FUSED whole-eval solve (ISSUE 15): concurrent
+        evals whose fused inputs reference the SAME resident twin pair
+        coalesce into one vmapped fused dispatch — the twins broadcast
+        into every lane (in_axes=None; ONE pair of [B, R'] matrices for
+        the whole window instead of K stacked copies, which is also what
+        kills the classic path's [K, B, R'] host np.stack), and only the
+        small per-lane columns (row indices, jitter, scalars) stack.
+        Returns the lane's flat (placed, fit[, explain...]) tuple, or a
+        1-tuple (placed,) when the lane fell to the classic host solve
+        (solo window, fanout) — callers read the arity as "did a verdict
+        ride along".
+
+        Twin identity keys the queue: lanes gathered at different
+        journal versions hold different (functionally-updated) twin
+        objects and form separate windows, so every lane's bits are
+        exactly its own snapshot's."""
+        # None-vs-scalar guard exactly as solve()'s key: a None optional
+        # column must not collide with a 0-d scalar's () shape, or a
+        # mixed window would hand stack_lanes the None/array shape its
+        # docstring calls a caller bug
+        key = (static_key, id(twins[0]), id(twins[1])) + tuple(
+            None if a is None else getattr(a, "shape", ())
+            for a in lane_args)
+        solo = False
+        with self._lock:
+            if self.concurrency() <= 1:
+                solo = True
+            else:
+                q = self._queues.setdefault(key, [])
+                req = _Request(lane_args, host_args=host_args)
+                q.append(req)
+                leader = len(q) == 1
+        if solo:
+            metrics.incr("nomad.solver.microbatch.solo")
+            return (np.asarray(host_fn(*host_args)),)
+        if leader:
+            deadline = time.monotonic() + self.window_s()
+            while True:
+                time.sleep(min(0.001, max(0.0,
+                                          deadline - time.monotonic())))
+                with self._lock:
+                    arrived = len(self._queues.get(key, ()))
+                    expected = max(self._active_evals, self._broker_hint)
+                if time.monotonic() >= deadline:
+                    break
+                if arrived >= LANES or arrived >= expected:
+                    metrics.incr("nomad.solver.microbatch.early_fire")
+                    break
+            with self._lock:
+                batch = self._queues.pop(key, [])
+            try:
+                if len(batch) == 1:
+                    # window expired with no siblings: host tier
+                    metrics.incr("nomad.solver.microbatch.solo")
+                    batch[0].out = (np.asarray(
+                        host_fn(*batch[0].host_args)),)
+                    batch[0].event.set()
+                else:
+                    metrics.incr("nomad.solver.microbatch.dispatches")
+                    metrics.add_sample("nomad.solver.microbatch.size",
+                                       len(batch))
+                    for start in range(0, len(batch), LANES):
+                        self._dispatch_fused(static_key, impl, twins,
+                                             host_fn,
+                                             batch[start:start + LANES])
+            except BaseException as e:   # noqa: BLE001 — fan the error out
+                for r in batch:
+                    if r.err is None and r.out is None:
+                        r.err = e
+                        r.event.set()
+                raise
+        else:
+            req.event.wait(self.window_s() + FOLLOWER_TIMEOUT)
+        trace.record_span(
+            "solver.microbatch.wait", req.ctx, req.t0,
+            links=(req.dispatch_ctx,) if req.dispatch_ctx else (),
+            status="error" if req.err is not None else "ok",
+            solo=req.dispatch_ctx is None, leader=leader, fused=True)
+        if req.err is not None:
+            raise req.err
+        if req.out is None:
+            raise RuntimeError("microbatch leader never delivered a result")
+        return req.out
+
+    def _dispatch_fused(self, static_key: tuple, impl, twins: tuple,
+                        host_fn, lanes: list[_Request]) -> None:
+        """One coalesced fused window: pad to LANES with count=0 clones
+        (arg 3 of the de-twinned fused signature is `count`; zero places
+        nothing), vmap the fused body with the twins broadcast, dispatch
+        once. Device failure classifies per ISSUE 14 — but a LOST device
+        invalidates the captured twin references themselves (the rebuild
+        evacuated + re-seeded NEW twins the next window will capture),
+        so recovery here is the per-lane classic host fanout from each
+        lane's uncommitted host args: bits identical, zero evals lost,
+        and the stream re-enters the fused route at the new generation
+        on its next eval."""
+        from . import backend, sharding
+        from .. import faults
+        from .tensorize import stack_lanes
+        pad = lanes[0].args
+        pad = pad[:3] + (np.int32(0),) + pad[4:]
+        cols = stack_lanes([r.args for r in lanes], pad, LANES)
+        sp = trace.start_span(
+            "solver.microbatch.dispatch",
+            links=[r.ctx for r in lanes if r.ctx is not None],
+            tier="batch", bucket=LANES, lanes=len(lanes), fused=True)
+        sctx = sp.ctx()
+        for req in lanes:
+            req.dispatch_ctx = sctx
+        gen = sharding.generation()
+        fn = self._fused_fn(static_key, impl, len(cols))
+        try:
+            faults.fire("solver.microbatch.dispatch")
+            sharding.fire_device_loss_sites()
+            import jax
+            # nomadlint: disable=SYNC001 — the fused window's one sync
+            outs = jax.block_until_ready(fn(twins[0], twins[1], *cols))
+        except backend.device_error_types() as e:
+            backend.note_dispatch_failure("batch", e, generation=gen)
+            metrics.incr("nomad.solver.microbatch.fanout")
+            metrics.incr("nomad.solver.microbatch.fanout_lanes",
+                         len(lanes))
+            sp.end("fanout", fanout_lanes=len(lanes))
+            for req in lanes:
+                try:
+                    req.out = (np.asarray(host_fn(*req.host_args)),)
+                except BaseException as le:  # noqa: BLE001 — per lane
+                    req.err = le
+                req.event.set()
+            return
+        except BaseException as e:      # noqa: BLE001 — non-demotable
+            sp.end("error", error=repr(e)[:200])
+            raise
+        backend.breaker_record("batch", ok=True)
+        sp.end("ok")
+        for row, req in enumerate(lanes):
+            req.out = tuple(np.array(o[row]) for o in outs)
+            req.event.set()
+
+    def _fused_fn(self, static_key: tuple, impl, n_lane_args: int):
+        """Get-or-create the vmapped fused wrapper (same store +
+        locking discipline as _batched_fn; the mesh object keys the
+        cache so a generation rebuild re-resolves executables instead of
+        throwing on dead shardings). The twins broadcast (in_axes=None);
+        every stacked lane column maps on axis 0."""
+        with self._lock:
+            from .sharding import _serialize_launches, mesh
+            m = mesh()
+            key = ("fused", static_key, n_lane_args, m)
+            fn = self._vmapped.get(key)
+            if fn is None:
+                import jax
+                axes = (None, None) + (0,) * n_lane_args
+                if m is not None:
+                    # committed sharded twins make this a multi-device
+                    # launch: serialize like every sharded callable
+                    # (sharding.py rendezvous discipline)
+                    self._vmapped[key] = _serialize_launches(
+                        jax.jit(jax.vmap(impl, in_axes=axes)))
+                else:
+                    self._vmapped[key] = jax.jit(
+                        jax.vmap(impl, in_axes=axes))
+                fn = self._vmapped[key]
+        return fn
+
     def _run_batch(self, static_key: tuple, inner, host_fn,
                    batch: list[_Request]) -> None:
         if not batch:
@@ -246,6 +418,7 @@ class MicroBatcher:
             try:
                 faults.fire("solver.microbatch.dispatch")
                 sharding.fire_device_loss_sites()
+                # nomadlint: disable=SYNC001 — the window's one sync
                 out = np.asarray(fn(*cols))
                 break
             except backend.device_error_types() as e:
@@ -354,5 +527,6 @@ eval_finished = _batcher.eval_finished
 broker_in_flight = _batcher.broker_in_flight
 concurrency = _batcher.concurrency
 solve = _batcher.solve
+solve_fused = _batcher.solve_fused
 on_mesh_rebuild = _batcher.on_mesh_rebuild
 reset = _batcher.reset

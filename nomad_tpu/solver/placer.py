@@ -37,7 +37,8 @@ from ..structs import (
     skeleton_for,
 )
 from ..scheduler.stack import SelectOptions
-from . import backend, explain as explain_mod, microbatch, sharding
+from . import backend, explain as explain_mod, microbatch, roundtrip, \
+    sharding
 from ..obs import trace
 from .buckets import node_bucket, pow2
 from .tensorize import (
@@ -135,9 +136,14 @@ class SolverPlacer:
         explain_mod.configure(
             capacity=getattr(cfg, "placement_explain_recent", 256))
         microbatch.eval_started()
+        # per-eval host↔device transition accounting (ISSUE 15): every
+        # dispatch seam notes itself; the total lands in the
+        # nomad.solver.device_round_trips histogram at eval exit
+        roundtrip.begin()
         try:
             return self._compute_placements(destructive, place)
         finally:
+            roundtrip.end()
             microbatch.eval_finished()
             # abandoned async probes (degraded/unwound pipelines) must
             # not wedge a tier half-open forever
@@ -434,7 +440,7 @@ class SolverPlacer:
             #     (weight exponent g ~ w-1, sharpened as m grows), with
             #     per-node depth capped at ceil(m)+1 — a host worker can
             #     stack a node only once per pass over the shuffled list.
-            n_feas = max(int(np.asarray(gt.feasible).sum()), 1)
+            n_feas = max(int(np.count_nonzero(gt.feasible)), 1)
             width = 2.0 if self.sched.batch else \
                 max(2.0, float(np.ceil(np.log2(max(n_feas, 2)))))
             m = width * count / n_feas
@@ -513,6 +519,103 @@ class SolverPlacer:
                 np.int32(prep.max_per_node), prep.jitter,
                 np.float32(prep.bias_g), np.float32(prep.m))
 
+    def _fused_solve(self, kernel: str, prep, classic_args):
+        """Whole-eval residency (ISSUE 15 tentpole): dispatch ONE
+        compiled gather+solve+plan-verdict(+explain) program against the
+        state cache's RESIDENT twins and materialize everything at ONE
+        device_get — the eval touches the device once, where the classic
+        device-resident route paid gather + solve + explain dispatches.
+
+        Returns (placed_h padded, fit_h | None, ex_host | None, tier),
+        or None when the fused route declines for this shape (no
+        resident handle — cache miss, in-plan divergence, fused
+        disabled; stale mesh generation; host-tier resolution;
+        twin/tier shardedness mismatch) — the caller then runs the
+        classic route unchanged, same bits. A fallback INSIDE the fused
+        chain (device failure, breaker) comes back as a 1-tuple from
+        the classic ladder: placed only, no verdict — fit/ex None."""
+        gt = prep.gt
+        if gt.resident is None or gt.rows is None:
+            return None
+        if gt.gen is not None and gt.gen != sharding.generation():
+            # twins captured before a mesh rebuild (ISSUE 14): their
+            # buffers may reference the dead mesh — classic route
+            return None
+        cap_res, used_res, twins_sharded = gt.resident
+        bucket = gt.cap.shape[0]
+        n_classes = prep.ex_ncls if prep.ex is not None else 0
+        sel = backend.select_fused(
+            kernel, bucket, count=prep.count, k_max=prep.k_max,
+            spread_algorithm=prep.spread_alg,
+            depth_grid=prep.depth_grid if kernel == "depth" else None,
+            n_classes=n_classes, sharded_twins=twins_sharded,
+            mesh_snap=prep.snap)
+        if sel is None:
+            return None
+        tier, run = sel
+        idx = np.zeros(bucket, np.int32)
+        idx[:prep.n] = gt.rows
+        valid = np.zeros(bucket, bool)
+        valid[:prep.n] = True
+        class_ids = (prep.ex_ids if n_classes and prep.ex_ids is not None
+                     else np.zeros(bucket, np.int32))
+        dh = np.bool_(gt.distinct_hosts)
+        if kernel == "depth":
+            args = (cap_res, used_res, idx, valid) + classic_args[2:] + \
+                (class_ids, dh)
+        else:
+            args = (cap_res, used_res, idx, valid) + classic_args[2:] + \
+                (class_ids, dh, gt.job_collisions)
+        out = run(*args, host_args=classic_args)
+        import jax
+        # THE single sync of the fused eval: one device_get materializes
+        # placement vector, fit verdict and explain outputs together
+        # nomadlint: disable=SYNC001 — the designated single-sync seam
+        host = jax.device_get(out)
+        placed_h = np.asarray(host[0])
+        fit_h = np.asarray(host[1]) if len(host) > 1 else None
+        ex_host = tuple(host[2:]) if len(host) > 2 else None
+        return placed_h, fit_h, ex_host, tier
+
+    def _stamp_verdict(self, prep, placed: np.ndarray,
+                       fit: np.ndarray) -> None:
+        """Attach the fused plan-evaluate verdict to the eval's plan:
+        per-VIEW-ROW verified ask vectors (k·ask at the solve's journal
+        version) for placed rows whose post-solve fit held. The applier
+        consumes it as a MONOTONE fast path (plan_apply._shape_dense):
+        a True row with an actual plan ask elementwise <= the verified
+        one provably fits at the same usage bits (IEEE addition is
+        monotone), so the dense re-compare is skipped; anything else —
+        version moved, bigger ask, False verdict — re-checks exactly as
+        before. Solves of one plan at DIFFERENT journal versions void
+        the stamp (it is one snapshot's truth or nothing)."""
+        gt = prep.gt
+        if gt.version < 0 or gt.rows is None or fit is None:
+            return
+        plan = self.plan
+        sv = getattr(plan, "solver_verdict", None)
+        if sv is not None and (sv.get("version") != gt.version or
+                               sv.get("uid") != gt.uid or
+                               sv.get("epoch") != gt.epoch):
+            plan.solver_verdict = None
+            return
+        if sv is None:
+            sv = plan.solver_verdict = {
+                "version": gt.version, "uid": gt.uid, "epoch": gt.epoch,
+                "rows": {}}
+        ask = np.asarray(gt.ask, np.float32)
+        for i in np.flatnonzero(placed > 0):
+            if not fit[i]:
+                continue
+            row = int(gt.rows[i])
+            if row in sv["rows"]:
+                # two solves verified the same node independently: each
+                # verdict ignores the other's placements — neither is
+                # the plan's truth, so the row re-checks normally
+                del sv["rows"][row]
+                continue
+            sv["rows"][row] = np.float32(placed[i]) * ask
+
     def _solve_group(self, tg, nodes, count: int, prep=None):
         """Run the batched kernel; returns [(node, count)] sorted best-first.
         `prep` reuses a declined pipeline's solve prep (same regime, same
@@ -540,18 +643,26 @@ class SolverPlacer:
             "nomad.solver.kernel.place_chunked" if use_scan
             else "nomad.solver.kernel.fill_depth" if use_depth
             else "nomad.solver.kernel.fill_greedy_binpack")
+        fit_h = None            # fused plan-evaluate verdict (ISSUE 15)
+        ex_host = None          # fused explain outputs, already host
         if use_depth:
-            bname, depth_fn = backend.select(
-                "depth", gt.cap.shape[0], count=count, k_max=prep.k_max,
-                spread_algorithm=spread_alg, depth_grid=prep.depth_grid,
-                mesh_snap=prep.snap)
-            backend.record("depth", bname)
             d_args = self._depth_solve_args(prep, tg, count)
-            dev = self._dev_mats(gt, bname)
-            if dev is not None:
-                placed = depth_fn(*(dev + d_args[2:]), host_args=d_args)
+            fused = self._fused_solve("depth", prep, d_args)
+            if fused is not None:
+                placed, fit_h, ex_host, bname = fused
+                backend.record("depth", bname)
             else:
-                placed = depth_fn(*d_args)
+                bname, depth_fn = backend.select(
+                    "depth", gt.cap.shape[0], count=count,
+                    k_max=prep.k_max, spread_algorithm=spread_alg,
+                    depth_grid=prep.depth_grid, mesh_snap=prep.snap)
+                backend.record("depth", bname)
+                dev = self._dev_mats(gt, bname)
+                if dev is not None:
+                    placed = depth_fn(*(dev + d_args[2:]),
+                                      host_args=d_args)
+                else:
+                    placed = depth_fn(*d_args)
         elif use_scan:
             # one solve covers max_steps * k instances; split larger asks
             # across repeated solves, feeding the running state (usage,
@@ -589,24 +700,29 @@ class SolverPlacer:
                 last_total = total
             placed = placed_dev
         else:
-            bname, greedy = backend.select("greedy", gt.cap.shape[0],
-                                           count=count,
-                                           mesh_snap=prep.snap)
-            backend.record("greedy", bname)
             g_args = (gt.cap, gt.used, gt.ask, np.int32(count),
                       gt.feasible, np.int32(max_per_node))
-            dev = self._dev_mats(gt, bname)
-            if dev is not None:
-                placed = greedy(*(dev + g_args[2:]), host_args=g_args)
+            fused = self._fused_solve("greedy", prep, g_args)
+            if fused is not None:
+                placed, fit_h, ex_host, bname = fused
+                backend.record("greedy", bname)
             else:
-                placed = greedy(*g_args)
+                bname, greedy = backend.select("greedy", gt.cap.shape[0],
+                                               count=count,
+                                               mesh_snap=prep.snap)
+                backend.record("greedy", bname)
+                dev = self._dev_mats(gt, bname)
+                if dev is not None:
+                    placed = greedy(*(dev + g_args[2:]), host_args=g_args)
+                else:
+                    placed = greedy(*g_args)
         ex_out = None
         # the distinct_property trim below mutates `placed` host-side —
         # attribution must describe the TRIMMED (committed) placements,
         # so the early device enqueue is skipped on that path
         trim_pending = use_scan and bool(distincts)
-        if prep.ex is not None and not trim_pending and \
-                explain_mod.wants_device_reduce(placed):
+        if prep.ex is not None and ex_host is None and not trim_pending \
+                and explain_mod.wants_device_reduce(placed):
             prep.ex.tier = bname
             try:
                 # enqueued BEHIND the in-flight solve on its device;
@@ -617,7 +733,10 @@ class SolverPlacer:
                     gt, placed, prep.ex_ids, prep.ex_ncls)
             except Exception:       # noqa: BLE001 — never fail the solve
                 metrics.incr("nomad.solver.explain.errors")
-        placed_h = np.asarray(placed)       # the single device_get
+        # the single device_get (no-op on the fused route: _fused_solve
+        # already materialized everything at ITS one sync)
+        # nomadlint: disable=SYNC001 — the designated single-sync seam
+        placed_h = np.asarray(placed)
         placed = placed_h[:n]
         if trim_pending:
             # chunk > 1 places several instances per scan step, which can
@@ -644,6 +763,10 @@ class SolverPlacer:
                         remaining[d][vid] -= allowed
                 placed[i] = allowed
             placed_h = np.pad(placed, (0, placed_h.shape[0] - n))
+        if fit_h is not None:
+            # fused plan-evaluate verdict: stamp the plan so the applier
+            # can skip its dense re-compare at an unchanged version
+            self._stamp_verdict(prep, placed, fit_h)
         if prep.ex is not None:
             prep.ex.tier = bname
             prep.ex.kernel = ("chunked" if use_scan
@@ -651,13 +774,20 @@ class SolverPlacer:
             try:
                 import jax
                 with metrics.measure("nomad.solver.explain.seconds"):
-                    if ex_out is None:
-                        # host-resident (or post-trim) result: the numpy
-                        # twin, same bits
-                        ex_out = explain_mod.dispatch_reduce(
-                            gt, placed_h, prep.ex_ids, prep.ex_ncls)
-                    prep.ex.absorb_reduce(jax.device_get(ex_out), gt,
-                                          placed)
+                    if ex_host is not None:
+                        # the fused program's explain tail: already
+                        # host-resident, same bits as the standalone
+                        # reduce (one program, zero extra dispatches)
+                        prep.ex.absorb_reduce(ex_host, gt, placed)
+                    else:
+                        if ex_out is None:
+                            # host-resident (or post-trim) result: the
+                            # numpy twin, same bits
+                            ex_out = explain_mod.dispatch_reduce(
+                                gt, placed_h, prep.ex_ids, prep.ex_ncls)
+                        # nomadlint: disable=SYNC001 — explain seam
+                        prep.ex.absorb_reduce(jax.device_get(ex_out), gt,
+                                              placed)
             except Exception:       # noqa: BLE001 — never fail the solve
                 metrics.incr("nomad.solver.explain.errors")
             self._register_explain(tg, prep.ex)
@@ -833,6 +963,8 @@ class SolverPlacer:
                 placed_pad = None
                 if degraded is None:
                     try:
+                        # the pipeline's designed per-chunk sync point
+                        # nomadlint: disable=SYNC001 — chunk seam
                         placed_pad = np.asarray(fut)
                         # async dispatch defers breaker feedback to HERE:
                         # only a materialized result proves the serving
@@ -876,7 +1008,8 @@ class SolverPlacer:
                          coll_h) + args[6:]
                     placed_pad = np.asarray(host_fn(*a))
                     used_h = used_h + placed_pad[:, None].astype(
-                        np.float32) * np.asarray(args[2])[None, :]
+                        np.float32) * np.asarray(args[2],
+                                                 np.float32)[None, :]
                     coll_h = coll_h + placed_pad.astype(np.int32)
                     degraded = (host_fn, used_h, coll_h)
                 chunk_done.append(placed_pad)
@@ -936,14 +1069,18 @@ class SolverPlacer:
             # pendings wait above is the pipeline's own sync point), so
             # the record describes the whole eval's post-solve state
             try:
+                # chunk_done holds already-materialized host arrays
+                # nomadlint: disable=SYNC001 — summing host chunk results
                 total = np.asarray(chunk_done[0]).astype(np.int32)
                 for c in chunk_done[1:]:
+                    # nomadlint: disable=SYNC001 — host chunk result
                     total = total + np.asarray(c).astype(np.int32)
                 prep.ex.tier = chunk_tiers[-1] if chunk_tiers else bname
                 prep.ex.kernel = "depth"
                 out = explain_mod.dispatch_reduce(
                     prep.gt, total, prep.ex_ids, prep.ex_ncls)
                 import jax
+                # nomadlint: disable=SYNC001 — pipeline's explain seam
                 prep.ex.absorb_reduce(jax.device_get(out), prep.gt, total)
             except Exception:       # noqa: BLE001 — never fail the eval
                 metrics.incr("nomad.solver.explain.errors")
@@ -976,6 +1113,7 @@ class SolverPlacer:
         coll_h = np.array(prep.gt.job_collisions, np.int32)
         ask = np.asarray(prep.gt.ask, np.float32)
         for placed in chunk_done:
+            # nomadlint: disable=SYNC001 — host replay of materialized chunks
             p = np.asarray(placed)
             used_h = used_h + p[:, None].astype(np.float32) * ask[None, :]
             coll_h = coll_h + p.astype(np.int32)
@@ -1228,11 +1366,13 @@ class SolverPlacer:
                     vp = np.pad(victim_prio, ((0, pad), (0, 0)),
                                 constant_values=2 ** 20)
                     fr = np.pad(free, ((0, pad), (0, 0)))
+                    # nomadlint: disable=SYNC001 — preemption sync seam
                     out = np.asarray(_preempt_sharded_fn[1](
                         vr, vp, np.asarray(ask, np.float32), fr,
                         np.int32(job_prio)))[:c]
                 backend.breaker_record("sharded", ok=True)
                 metrics.incr("nomad.solver.dispatch.sharded")
+                roundtrip.note("preempt")
                 return out
             except backend.device_error_types() as e:
                 metrics.incr("nomad.solver.tier_demotions")
@@ -1252,6 +1392,10 @@ class SolverPlacer:
                     continue
                 demoted = True
                 break
+        roundtrip.note("preempt")
+        # preemption's own sync seam: the victim masks gate an exact
+        # host verify, nothing overlaps them
+        # nomadlint: disable=SYNC001 — preemption sync seam
         out = np.asarray(_preempt_batched()(
             jnp.asarray(victim_res), jnp.asarray(victim_prio),
             jnp.asarray(ask), jnp.asarray(free), jnp.int32(job_prio)))

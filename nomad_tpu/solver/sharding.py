@@ -501,6 +501,48 @@ def sharded_fill_depth(mesh: Mesh, axis: str = "nodes", k_max: int = 16,
         out_shardings=nv))
 
 
+def sharded_fused(mesh: Mesh, kernel: str = "depth", k_max: int = 16,
+                  spread_algorithm: bool = False, depth_grid=None,
+                  n_classes: int = 0, axis: str = "nodes"):
+    """The whole-eval fused program (kernels.fused_eval_*) with the
+    resident twins consumed PARTITIONED (ISSUE 15): in_shardings for
+    cap_res/used_res are exactly the node-axis spec the state cache
+    seeds the twins with — so the fused dispatch chains off the resident
+    pair with zero re-scatter — and the node-axis outputs (placed, fit)
+    carry the SAME spec out, keeping chained consumers partitioned (the
+    SNIPPETS pjit out↔in contract). idx/valid ride replicated like the
+    state cache's own sharded gather; the in-program gather's
+    cross-shard row routing lowers to the identical GSPMD collective."""
+    from .kernels import fused_eval_depth, fused_eval_greedy
+    nd = NamedSharding(mesh, P(axis, None))
+    nv = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    if kernel == "depth":
+        def run(cap_res, used_res, idx, valid, ask, count, feasible,
+                coll, desired, aff, mpn, jitter, jscale, jsamples,
+                class_ids, dh):
+            return fused_eval_depth(
+                cap_res, used_res, idx, valid, ask, count, feasible,
+                coll, desired, aff, mpn, jitter, jscale, jsamples,
+                class_ids, dh, k_max=k_max,
+                spread_algorithm=spread_algorithm,
+                depth_grid=depth_grid, n_classes=n_classes)
+        in_sh = (nd, nd, rep, rep, rep, rep, nv, nv, rep, nv, rep, nv,
+                 rep, rep, nv, rep)
+    elif kernel == "greedy":
+        def run(cap_res, used_res, idx, valid, ask, count, feasible,
+                mpn, class_ids, dh, coll):
+            return fused_eval_greedy(
+                cap_res, used_res, idx, valid, ask, count, feasible,
+                mpn, class_ids, dh, coll, n_classes=n_classes)
+        in_sh = (nd, nd, rep, rep, rep, rep, nv, rep, nv, rep, nv)
+    else:
+        raise ValueError(f"unknown fused kernel {kernel!r}")
+    out_sh = (nv, nv) + ((rep, rep, rep, rep) if n_classes else ())
+    return _serialize_launches(jax.jit(run, in_shardings=in_sh,
+                                       out_shardings=out_sh))
+
+
 def sharded_preempt_top_k(mesh: Mesh, axis: str = "nodes"):
     """Batched preemption victim selection with the CANDIDATE-NODE axis
     sharded: each shard runs its nodes' masked top-k victim scans

@@ -91,9 +91,21 @@ class GatherResult:
     `gen` is the MESH generation the device pair was seeded at (ISSUE
     14): the placer declines twins whose generation predates a rebuild
     (the buffers may reference a dead mesh) and serves from the host
-    copies — same bits, different route."""
+    copies — same bits, different route.
 
-    __slots__ = ("cap", "used", "cap_dev", "used_dev", "gen")
+    `resident` (ISSUE 15, whole-eval residency) is the zero-launch twin
+    handle: (cap_res, used_res, sharded) referencing the RESIDENT
+    bucket-padded device twins themselves, captured under the cache lock
+    — the fused dispatch gathers INSIDE its one compiled program
+    (kernels.gather_rows) instead of this module launching a separate
+    gather. Safe to hand out because twin updates are functional
+    (scatter returns a NEW array; a displaced twin is never mutated), so
+    the handle's bits stay exactly the served version's. `version` is
+    the usage-journal version those bits reflect — the stamp the plan
+    applier's verdict fast-path keys trust on."""
+
+    __slots__ = ("cap", "used", "cap_dev", "used_dev", "gen", "resident",
+                 "version", "uid", "epoch")
 
     def __init__(self, cap, used, cap_dev=None, used_dev=None, gen=None):
         self.cap = cap
@@ -101,6 +113,10 @@ class GatherResult:
         self.cap_dev = cap_dev
         self.used_dev = used_dev
         self.gen = gen
+        self.resident = None
+        self.version = -1
+        self.uid = 0
+        self.epoch = -1
 
 
 class TensorCache:
@@ -449,7 +465,8 @@ class TensorCache:
     # -------------------------------------------------------------- reading
 
     def gather(self, view, rows: np.ndarray,
-               bucket: int = 0, tier: str = "") -> Optional[GatherResult]:
+               bucket: int = 0, tier: str = "",
+               fused: bool = False) -> Optional[GatherResult]:
         """Serve one eval's (shuffled) node rows from the cache, advancing
         it to the view's version first. Returns None when the cache is
         disabled or the view carries no versioning stamp (plain test
@@ -465,7 +482,14 @@ class TensorCache:
         shard by the CLUSTER bucket, the tier resolves by the EVAL's
         candidate axis, so a constraint-filtered small eval on a big
         sharded cluster would otherwise pay a serialized multi-device
-        gather collective whose result the solo tier then discards."""
+        gather collective whose result the solo tier then discards.
+
+        `fused=True` (ISSUE 15) additionally captures the ZERO-LAUNCH
+        resident handle on the result: the raw twin references + the
+        served journal version, for the fused dispatch to gather inside
+        its own single compiled program. No device program launches here
+        in that mode; the tier-match gate above does not apply (the
+        fused selector does its own shardedness routing)."""
         if view.uid == 0 or view.delta_log is None or not self.enabled():
             return None
         # the lock covers only version bookkeeping + the journal replay;
@@ -474,6 +498,7 @@ class TensorCache:
         # generation arrays (host and device) are never mutated again, so
         # concurrent workers' gathers don't convoy on one lock
         dev = None
+        res = None
         with self._lock:
             if view.uid == self._uid and view.epoch < self._epoch:
                 # a snapshot from BEFORE a node-set change (churn +
@@ -495,7 +520,17 @@ class TensorCache:
                     if not seeded:  # a reseed already counted its miss
                         metrics.incr("nomad.solver.state_cache.hits")
                     src_cap, src_used = self.cap, self.used
-                    if bucket and self._used_dev is not None and \
+                    if fused and self._used_dev is not None:
+                        # zero-launch resident handle (ISSUE 15): twin
+                        # references + the version their bits reflect,
+                        # captured atomically with the host serve. Twin
+                        # updates are functional, so these references
+                        # stay exactly this version's bits even if a
+                        # concurrent advance displaces them.
+                        res = (self._cap_dev, self._used_dev,
+                               self._sharded, self._gen, self.version,
+                               self._uid, self._epoch)
+                    elif bucket and self._used_dev is not None and \
                             (not tier or
                              (tier == "sharded") == self._sharded):
                         # the shardedness flag travels WITH the captured
@@ -526,13 +561,20 @@ class TensorCache:
             out.gen = dev[4]
             out.cap_dev, out.used_dev = self._gather_device(dev, rows,
                                                             bucket)
+        if res is not None:
+            out.resident = res[:3]
+            out.gen = res[3]
+            out.version = res[4]
+            out.uid, out.epoch = res[5], res[6]
         return out
 
     def _gather_device(self, dev: tuple, rows: np.ndarray, bucket: int):
         cap_dev, used_dev, src_bucket, sharded, gen = dev
         try:
+            from . import roundtrip
             from .sharding import fire_device_loss_sites
             fire_device_loss_sites()
+            roundtrip.note("gather")
             n = len(rows)
             idx = np.zeros(bucket, np.int32)
             idx[:n] = rows

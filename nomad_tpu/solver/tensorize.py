@@ -41,6 +41,17 @@ class GroupTensors:
     gen: Optional[int] = None          # mesh generation the twins ride
                                        # (ISSUE 14: placer._dev_mats
                                        # declines stale-generation twins)
+    # whole-eval residency (ISSUE 15): the zero-launch resident-twin
+    # handle (cap_res, used_res, sharded) + the view row index per node
+    # and the usage-journal version the twins' bits reflect — the fused
+    # dispatch gathers in-program and the plan applier's verdict
+    # fast-path trusts the version stamp. Dropped (like the dev twins)
+    # whenever the host copies diverge via in-plan corrections.
+    resident: object = None
+    rows: Optional[np.ndarray] = None  # i64[N] view row per node
+    version: int = -1                  # journal version of resident bits
+    uid: int = 0
+    epoch: int = -1
     # explain stage attribution (ISSUE 11), populated only when the
     # placer lowers with explain=True: counts of nodes eliminated by
     # the taint/eligibility mask and the pre-solve distinct-hosts
@@ -344,20 +355,37 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     bucket = node_bucket(n)
     dev_bucket = bucket
     tier = ""
+    from . import backend
+    fused = backend.fused_enabled(getattr(ctx, "scheduler_config", None))
     from .sharding import mesh as _mesh
-    if _mesh() is not None:
-        from . import backend
+    if fused or _mesh() is not None:
         tier = backend._tier(bucket, count)[0]
-        if tier not in ("sharded", "xla", "pallas"):
-            dev_bucket = 0
+    if fused and tier == "pallas":
+        # pallas-resolved shapes DECLINE fusion (select_fused: the VMEM
+        # hand kernel owns them) — keep the classic resident-twin gather
+        # here or the decline would re-upload cap/used per eval, the
+        # exact transfer ISSUE 4 removed
+        fused = False
+    if not fused and _mesh() is not None and \
+            tier not in ("sharded", "xla", "pallas"):
+        dev_bucket = 0
     # `tier` rides along so the cache can also decline the mismatch case
-    # (sharded twins + solo tier for a constraint-filtered small eval)
-    cached = state_cache.gather(view, rows, bucket=dev_bucket, tier=tier)
+    # (sharded twins + solo tier for a constraint-filtered small eval).
+    # With the fused path enabled (ISSUE 15) no gather launches at all:
+    # the cache hands back the zero-launch resident handle and the fused
+    # program gathers inside its one dispatch.
+    cached = state_cache.gather(view, rows, bucket=dev_bucket, tier=tier,
+                                fused=fused)
     gen = None
+    resident = None
+    res_version, res_uid, res_epoch = -1, 0, -1
     if cached is not None:
         cap, used = cached.cap, cached.used
         cap_dev, used_dev = cached.cap_dev, cached.used_dev
         gen = cached.gen
+        resident = cached.resident
+        res_version = cached.version
+        res_uid, res_epoch = cached.uid, cached.epoch
     else:
         cap = view.cap[rows]                   # fancy index => fresh arrays
         used = view.used[rows]
@@ -383,6 +411,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
                         and existing.node_id == node_id:
                     used[i] -= alloc_usage_tuple(existing)
                     used_dev = None     # host copy diverged from the twin
+                    resident = None
         for node_id, placed in plan.node_allocation.items():
             i = pos.get(node_id)
             for a in placed:
@@ -396,6 +425,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
                     used[i] -= alloc_usage_tuple(existing)   # in-place update
                 used[i] += alloc_usage_tuple(a)
                 used_dev = None         # host copy diverged from the twin
+                resident = None
                 if a.job_id == job.id and a.task_group == tg.name:
                     collisions[i] += 1
 
@@ -452,6 +482,8 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
         ask=group_ask_row(tg), job_collisions=collisions,
         distinct_hosts=distinct_hosts,
         cap_dev=cap_dev, used_dev=used_dev, gen=gen, ex_stages=ex_stages,
+        resident=resident, rows=rows, version=res_version,
+        uid=res_uid, epoch=res_epoch,
     )
 
 

@@ -124,6 +124,14 @@ class SchedulerConfiguration:
       placement_explain_recent  how many recent explain records the
                               bounded process ring retains for the
                               operator debug bundle.
+      solver_fused_enabled    whole-eval device residency (ISSUE 15):
+                              dispatch gather+solve+plan-verdict
+                              (+explain) as ONE compiled program per
+                              solve against the resident state-cache
+                              twins — one device round trip per eval.
+                              Placements are bit-identical on or off;
+                              NOMAD_SOLVER_FUSED=0/1 overrides
+                              (docs/BACKEND_TIERS.md).
       raft_fsync              fsync discipline for raft persistence
                               (ISSUE 13, docs/DURABILITY.md): `always`
                               fsyncs every append/meta/commit (the
@@ -171,6 +179,11 @@ class SchedulerConfiguration:
     flap_damping_backoff_max_s: float = 900.0
     placement_explain_enabled: bool = True
     placement_explain_recent: int = 256
+    # whole-eval residency (ISSUE 15): fuse gather+solve+plan-verdict
+    # (+explain) into ONE compiled dispatch against the state cache's
+    # resident twins. Placements are bit-identical on or off;
+    # NOMAD_SOLVER_FUSED=0/1 env force-overrides (bench parity legs).
+    solver_fused_enabled: bool = True
     raft_fsync: str = "always"
     raft_fsync_interval_ms: float = 50.0
     create_index: int = 0

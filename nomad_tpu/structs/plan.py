@@ -151,6 +151,13 @@ class Plan:
     # past-deadline plan BEFORE the raft round — its caller already gave
     # up, committing would be wasted device+consensus work. 0 = none.
     deadline_unix: float = 0.0
+    # fused plan-evaluate verdict (ISSUE 15): {version, uid, epoch,
+    # rows: {view_row -> verified-ask f32[R']}} stamped by the solver's
+    # fused dispatch — rows the device proved fit post-solve at that
+    # usage-journal version. Worker-local advisory state (never crosses
+    # raft); the applier consumes it as a monotone fast path and falls
+    # back to its own dense compare whenever the stamp doesn't bind.
+    solver_verdict: Optional[dict] = None
 
     # ---- mutators used by the schedulers (ref structs.go Plan.AppendAlloc etc) ----
 

@@ -567,6 +567,38 @@ def test_device_chaos_gate():
         f"devices (saw {sorted(kills_seen)})")
 
 
+def test_fused_stream_gate():
+    """ISSUE 15 acceptance: once a bench records the fused_stream
+    block, the whole-eval-residency lineage must show the fused route
+    actually dispatching, fused-vs-unfused placements bit-identical,
+    and round-trips-per-eval p50 <= 1 — STRUCTURAL keys only, so the
+    gate arms identically on a loaded 1-core box and a TPU pod (the
+    >=70 evals/s wall-clock assertion rides the stream drift gate and
+    only arms where wall-clock keys are recorded on multi-core
+    hardware)."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    fs = latest.get("fused_stream")
+    if isinstance(fs, dict) and "error" in fs:
+        pytest.fail(f"BENCH_r{latest_round:02d}: fused-stream lineage "
+                    f"run crashed: {fs['error']}")
+    if not isinstance(fs, dict) or "round_trips_p50" not in fs:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the "
+                    f"fused-stream lineage")
+    assert fs.get("bit_parity") is True, (
+        f"BENCH_r{latest_round:02d}: fused placements diverged from "
+        f"the unfused path — the bit-identity contract is broken")
+    assert fs.get("fused_dispatches", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: the fused route never dispatched "
+        f"— the lineage proved nothing")
+    assert fs["round_trips_p50"] <= 1, (
+        f"BENCH_r{latest_round:02d}: round_trips_p50 "
+        f"{fs['round_trips_p50']} > 1 — the whole-eval residency "
+        f"contract (one dispatch + one device_get per eval) regressed")
+
+
 def test_explain_overhead_gate():
     """ISSUE 11 acceptance: once a bench records the `explain` block,
     the placement-explain byproduct (per-solve fixed-shape reduce +

@@ -1,0 +1,545 @@
+"""ISSUE 15: whole-eval device residency — the fused
+gather+solve+plan-verdict(+explain) dispatch, its parity contracts, the
+round-trips-per-eval accounting, the plan applier's verdict fast path,
+and the reconciler's tensorized name-slot twin.
+
+Contracts pinned here (docs/BACKEND_TIERS.md "Whole-eval residency"):
+  * placements BIT-IDENTICAL fused vs unfused across the greedy,
+    jittered-depth, deterministic-depth, pipelined and (forced) sharded
+    regimes, explain on and off;
+  * one device round trip per fused eval (the structural lineage the
+    bench gate arms);
+  * a fused window survives a mid-dispatch device-loss generation bump
+    with ZERO evals lost (PR-14 replay semantics: classic re-solve at
+    the new generation from uncommitted host args, bits identical);
+  * the applier's verdict fast path is MONOTONE-sound: it engages only
+    for a batch of one at the exact stamped usage version with an ask
+    elementwise <= the verified one, and produces the identical result;
+  * TensorNameIndex == AllocNameIndex op-for-op, and the full
+    reconciler is field-exact with the twin on vs off on fuzzed sets.
+"""
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.scheduler.reconcile import AllocReconciler
+from nomad_tpu.scheduler.reconcile_util import AllocNameIndex
+from nomad_tpu.scheduler.reconcile_tensor import TensorNameIndex
+from nomad_tpu.server.fsm import NomadFSM, PlanApplyRequest, RaftLog
+from nomad_tpu.server.plan_apply import Planner
+from nomad_tpu.solver import (
+    backend, buckets, microbatch, roundtrip, sharding, state_cache,
+)
+from nomad_tpu.solver.kernels import NUM_XR, fused_eval_depth
+from nomad_tpu.solver.state_cache import cache
+from nomad_tpu.structs import (
+    Allocation, Evaluation, Plan, SchedulerConfiguration, SCHED_ALG_TPU,
+    new_id,
+)
+
+from test_solver import Harness
+from test_state_cache import _mk_alloc, _run_placements, _seed_store
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    faults.clear()
+    state_cache.reset()
+    backend.reset()
+    microbatch.reset()
+    yield
+    faults.clear()
+    state_cache.reset()
+    backend.reset()
+    microbatch.reset()
+
+
+# --------------------------------------------------- bit-parity contract
+
+@pytest.mark.parametrize("count", [1, 6, 48])
+@pytest.mark.parametrize("explain", ["1", "0"])
+def test_placements_bit_identical_fused_on_vs_off(monkeypatch, count,
+                                                  explain):
+    """The acceptance differential across the greedy (count=1),
+    jittered sampled-grid (count=6) and deterministic full-curve
+    (count=48) regimes, explain on and off: the fused single-dispatch
+    path places EXACTLY what the classic multi-dispatch path places."""
+    monkeypatch.setenv("NOMAD_EXPLAIN", explain)
+    f0 = metrics.counter("nomad.solver.dispatch.fused")
+    fused = _run_placements(count, f"fu-eval-{count}-{explain}")
+    assert metrics.counter("nomad.solver.dispatch.fused") > f0, \
+        "the fused route never engaged"
+    state_cache.reset()
+    backend.reset()
+    monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+    classic = _run_placements(count, f"fu-eval-{count}-{explain}")
+    assert fused == classic
+
+
+def test_pipelined_regime_parity_fused_on_vs_off(monkeypatch):
+    """The pipelined lifecycle keeps its classic async-chunk dispatches
+    (fused targets the stream smalls); flipping the fused knob must not
+    perturb its placements — and the pipeline must actually engage."""
+
+    def run(eval_id):
+        random.seed(4321)
+        h = Harness()
+        h.state.set_scheduler_config(
+            h.get_next_index(),
+            SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU,
+                                   plan_pipeline_min_count=16,
+                                   plan_pipeline_chunks=2))
+        for i in range(16):
+            n = mock.node()
+            n.id = f"node-{i:04d}"
+            h.state.upsert_node(h.get_next_index(), n)
+        job = mock.batch_job()
+        job.id = job.name = "fu-pipe-job"
+        tg = job.task_groups[0]
+        tg.count = 64
+        tg.networks = []
+        t = tg.tasks[0]
+        t.resources.networks = []
+        t.resources.cpu = 100
+        t.resources.memory_mb = 64
+        h.state.upsert_job(h.get_next_index(), job)
+        ev = Evaluation(id=eval_id, job_id=job.id, type=job.type)
+        h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+        allocs = h.state.allocs_by_job("default", job.id)
+        assert len(allocs) == 64
+        return frozenset((a.name, a.node_id) for a in allocs)
+
+    p0 = metrics.counter("nomad.plan.pipeline.evals")
+    fused = run("fu-pipe-eval")
+    assert metrics.counter("nomad.plan.pipeline.evals") > p0, \
+        "the pipelined lifecycle never engaged"
+    state_cache.reset()
+    backend.reset()
+    monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+    classic = run("fu-pipe-eval")
+    assert fused == classic
+
+
+@pytest.mark.chaos
+def test_sharded_fused_parity_and_twin_specs(monkeypatch):
+    """Forced-sharded tier: the fused program consumes the PARTITIONED
+    resident twins (in_shardings == the twins' node spec, out spec
+    matching — the SNIPPETS pjit contract) and places bit-identically
+    to the classic sharded route."""
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "sharded")
+    sharding.reset()
+    buckets._reset_shards()
+    f0 = metrics.counter("nomad.solver.dispatch.fused.sharded")
+    try:
+        fused = _run_placements(48, "fu-shard-eval")
+        assert metrics.counter(
+            "nomad.solver.dispatch.fused.sharded") > f0, \
+            "the sharded fused route never engaged"
+        assert cache().stats()["twins_sharded"], \
+            "forced sharded seeding regressed"
+        state_cache.reset()
+        backend.reset()
+        monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+        classic = _run_placements(48, "fu-shard-eval")
+        assert fused == classic
+    finally:
+        sharding.reset()
+        buckets._reset_shards()
+
+
+# ------------------------------------------ round trips: the structural 1
+
+def test_fused_eval_counts_at_most_one_round_trip():
+    skip = metrics.sample_count("nomad.solver.device_round_trips")
+    _run_placements(48, "fu-rt-eval")
+    assert metrics.sample_count("nomad.solver.device_round_trips") > skip
+    worst = metrics.percentile("nomad.solver.device_round_trips", 1.0,
+                               skip=skip)
+    assert worst <= 1, (
+        f"fused eval paid {worst} device round trips — the whole-eval "
+        f"residency contract is one dispatch + one device_get")
+
+
+def test_unfused_device_route_counts_more_than_fused(monkeypatch):
+    """The lineage's contrast leg: with fusion off, the classic
+    device-resident route pays (at least) separate gather + solve
+    dispatches per eval."""
+    monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+    skip = metrics.sample_count("nomad.solver.device_round_trips")
+    _run_placements(48, "fu-rt-classic")
+    worst = metrics.percentile("nomad.solver.device_round_trips", 1.0,
+                               skip=skip)
+    assert worst >= 2, f"classic route measured {worst} round trips"
+
+
+# ----------------------------------------- device loss: zero evals lost
+
+@pytest.mark.chaos
+def test_fused_dispatch_survives_device_loss_bit_identically():
+    """A device loss inside the fused dispatch quarantines + rebuilds
+    (ISSUE 14) and the eval re-solves through the classic ladder at the
+    NEW generation from uncommitted host args — zero evals lost,
+    placements bit-identical to an undisturbed run."""
+    sharding.reset()
+    buckets._reset_shards()
+    try:
+        want = _run_placements(48, "fu-loss-eval")
+        state_cache.reset()
+        backend.reset()
+        gen0 = sharding.generation()
+        faults.install({"device.lost.d0": {"mode": "nth_call", "n": 1,
+                                           "times": 1}})
+        got = _run_placements(48, "fu-loss-eval")
+        faults.clear()
+        assert got == want, "loss recovery diverged from the healthy path"
+        assert sharding.generation() > gen0, "the loss never rebuilt"
+    finally:
+        sharding.reset()
+        buckets._reset_shards()
+
+
+# -------------------------------------------- fused micro-batch window
+
+def _fused_lane_inputs(n, count, seed):
+    rng = np.random.default_rng(seed)
+    bucket = n
+    idx = np.arange(bucket, dtype=np.int32)
+    valid = np.ones(bucket, bool)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[0], ask[1] = 250, 512
+    feasible = np.ones(bucket, bool)
+    lane = (idx, valid, ask, np.int32(count), feasible,
+            np.zeros(bucket, np.int32), np.int32(count),
+            np.zeros(bucket, np.float32), np.int32(2 ** 30),
+            rng.random(bucket, dtype=np.float32), np.float32(1.0),
+            np.float32(0.0), np.zeros(bucket, np.int32), np.bool_(False))
+    return lane
+
+
+def _window_twins(n, seed=3):
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+    cap = np.zeros((n, NUM_XR), np.float32)
+    cap[:, 0] = rng.choice([2000, 4000], n)
+    cap[:, 1] = rng.choice([4096, 8192], n)
+    cap[:, 2:] = 100_000
+    used = np.zeros_like(cap)
+    return (jnp.asarray(cap), jnp.asarray(used)), cap, used
+
+
+def _host_args_for(cap, used, lane):
+    return (cap, used) + lane[2:12]
+
+
+def _impl(k_max=8):
+    import functools
+    return functools.partial(fused_eval_depth, k_max=k_max,
+                             spread_algorithm=False, depth_grid=None,
+                             n_classes=0)
+
+
+def test_fused_window_coalesces_and_matches_direct_dispatch():
+    """Two concurrent fused lanes sharing one resident twin pair ride
+    ONE vmapped dispatch; each lane's (placed, fit) equals a direct
+    solo evaluation of the fused body on its own inputs."""
+    twins, cap, used = _window_twins(16)
+    impl = _impl()
+    skey = ("fused", "depth", 8, False, None, 0)
+    lanes = [_fused_lane_inputs(16, 3, seed=i) for i in range(2)]
+    microbatch.configure(enabled=True, window_s=0.05)
+    microbatch.broker_in_flight(2)
+    host_fn = backend.host_fallback("depth", k_max=8)
+    outs = [None, None]
+    errs = []
+
+    def worker(i):
+        try:
+            outs[i] = microbatch.solve_fused(
+                skey, impl, twins, lanes[i], host_fn,
+                _host_args_for(cap, used, lanes[i]))
+        except BaseException as e:      # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    d0 = metrics.counter("nomad.solver.microbatch.dispatches")
+    assert d0 > 0
+    for i, out in enumerate(outs):
+        assert out is not None and len(out) >= 2, \
+            f"lane {i} fell out of the fused window: {out and len(out)}"
+        want = impl(twins[0], twins[1], *lanes[i])
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(want[0]))
+        np.testing.assert_array_equal(np.asarray(out[1]),
+                                      np.asarray(want[1]))
+        assert int(np.asarray(out[0]).sum()) == 3
+
+
+@pytest.mark.chaos
+def test_fused_window_device_loss_fans_out_zero_lost():
+    """A device loss inside the fused window's one dispatch rebuilds the
+    mesh and fans every lane to its classic host solve — zero lanes
+    lost, bits identical to the direct host evaluation."""
+    sharding.reset()
+    buckets._reset_shards()
+    try:
+        twins, cap, used = _window_twins(16)
+        impl = _impl()
+        skey = ("fused", "depth", 8, False, None, 0)
+        lanes = [_fused_lane_inputs(16, 3, seed=i) for i in range(2)]
+        microbatch.configure(enabled=True, window_s=0.05)
+        microbatch.broker_in_flight(2)
+        host_fn = backend.host_fallback("depth", k_max=8)
+        gen0 = sharding.generation()
+        faults.install({"device.lost.d0": {"mode": "nth_call", "n": 1,
+                                           "times": 1}})
+        outs = [None, None]
+        errs = []
+
+        def worker(i):
+            try:
+                outs[i] = microbatch.solve_fused(
+                    skey, impl, twins, lanes[i], host_fn,
+                    _host_args_for(cap, used, lanes[i]))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        faults.clear()
+        assert not errs, errs
+        assert sharding.generation() > gen0
+        for i, out in enumerate(outs):
+            assert out is not None, f"lane {i} lost"
+            want = np.asarray(host_fn(*_host_args_for(cap, used,
+                                                      lanes[i])))
+            np.testing.assert_array_equal(np.asarray(out[0]), want)
+    finally:
+        sharding.reset()
+        buckets._reset_shards()
+
+
+# --------------------------------------------- applier verdict fast path
+
+def _verdict_world():
+    fsm = NomadFSM()
+    store = fsm.state
+    store.set_scheduler_config(
+        1, SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    idx = 2
+    for i in range(4):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        store.upsert_node(idx, n)
+        idx += 1
+    planner = Planner(RaftLog(fsm), store)
+    return store, planner
+
+
+def _fresh_plan(store, node_id, k=2):
+    plan = Plan(eval_id=new_id(), snapshot_index=store.latest_index())
+    for _ in range(k):
+        a = _mk_alloc(node_id)
+        plan.node_allocation.setdefault(node_id, []).append(a)
+    return plan
+
+
+def test_verdict_fastpath_engages_and_matches():
+    from nomad_tpu.state.usage_index import alloc_usage_tuple
+    store, planner = _verdict_world()
+    view = store.snapshot().usage
+    node_id = "node-0001"
+    r = view.row[node_id]
+    plan = _fresh_plan(store, node_id, k=2)
+    asks = np.sum([alloc_usage_tuple(a)
+                   for a in plan.node_allocation[node_id]], axis=0)
+    plan.solver_verdict = {
+        "version": view.version, "uid": view.uid, "epoch": view.epoch,
+        "rows": {r: np.asarray(asks, np.float32)}}
+    c0 = metrics.counter("nomad.plan.verdict_fastpath")
+    result = planner.apply_plan(plan)
+    assert metrics.counter("nomad.plan.verdict_fastpath") == c0 + 1
+    assert node_id in result.node_allocation
+    assert not result.rejected_nodes
+
+
+def test_verdict_declines_when_not_binding():
+    """Version drift, a bigger actual ask, or a multi-plan batch all
+    fall back to the dense compare — and produce the same verdicts a
+    verdict-free plan gets."""
+    from nomad_tpu.state.usage_index import alloc_usage_tuple
+    store, planner = _verdict_world()
+    view = store.snapshot().usage
+    node_id = "node-0002"
+    r = view.row[node_id]
+    plan = _fresh_plan(store, node_id, k=2)
+    asks = np.sum([alloc_usage_tuple(a)
+                   for a in plan.node_allocation[node_id]], axis=0)
+    # (a) stale version: ignored entirely
+    plan.solver_verdict = {
+        "version": view.version + 5, "uid": view.uid,
+        "epoch": view.epoch, "rows": {r: np.asarray(asks, np.float32)}}
+    c0 = metrics.counter("nomad.plan.verdict_fastpath")
+    result = planner.apply_plan(plan)
+    assert metrics.counter("nomad.plan.verdict_fastpath") == c0
+    assert node_id in result.node_allocation
+    # (b) verified ask SMALLER than the plan's: monotonicity cannot
+    # vouch — must re-check (and still accept: the node genuinely fits)
+    plan2 = _fresh_plan(store, node_id, k=2)
+    small = np.asarray(asks, np.float32) * np.float32(0.25)
+    plan2.solver_verdict = {
+        "version": view.version, "uid": view.uid, "epoch": view.epoch,
+        "rows": {r: small}}
+    c0 = metrics.counter("nomad.plan.verdict_fastpath")
+    result2 = planner.apply_plan(plan2)
+    assert metrics.counter("nomad.plan.verdict_fastpath") == c0
+    assert node_id in result2.node_allocation
+
+
+def test_fused_eval_stamps_verdict_end_to_end():
+    """A fused scheduler eval leaves the plan carrying a verdict whose
+    rows cover its placed nodes at the solve's journal version."""
+    random.seed(1234)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_TPU))
+    for i in range(16):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    job.id = job.name = "fu-verdict-job"
+    tg = job.task_groups[0]
+    tg.count = 48
+    tg.networks = []
+    t = tg.tasks[0]
+    t.resources.networks = []
+    t.resources.cpu = 250
+    t.resources.memory_mb = 128
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(id="fu-verdict-eval", job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    assert h.plans, "no plan submitted"
+    sv = h.plans[-1].solver_verdict
+    assert sv is not None and sv["rows"], "fused eval stamped no verdict"
+    view = h.state.snapshot().usage
+    placed_rows = {view.row[nid] for nid in h.plans[-1].node_allocation}
+    assert placed_rows <= set(sv["rows"]), \
+        "verdict rows do not cover the plan's placed nodes"
+
+
+# ------------------------------------------- reconciler tensorized diff
+
+def _rand_alloc_set(rng, job_id, tg, n, dup_frac=0.1):
+    out = {}
+    for _ in range(n):
+        a = Allocation(
+            id=new_id(), namespace="default", job_id=job_id,
+            task_group=tg,
+            name=f"{job_id}.{tg}[{int(rng.integers(0, 24))}]",
+            node_id=f"node-{int(rng.integers(0, 8)):04d}",
+            desired_status="run", client_status="running")
+        if rng.random() < dup_frac:
+            a.name = f"{job_id}.{tg}-weird"      # unparseable index
+        out[a.id] = a
+    return out
+
+
+def test_tensor_name_index_matches_reference_op_for_op():
+    rng = np.random.default_rng(20260804)
+    for trial in range(40):
+        count = int(rng.integers(1, 24))
+        in_use = _rand_alloc_set(rng, "j", "web", int(rng.integers(0, 30)))
+        ref = AllocNameIndex("j", "web", count, in_use)
+        twin = TensorNameIndex("j", "web", count, in_use)
+        assert twin.used == ref.used, f"trial {trial}: seed membership"
+        for _ in range(int(rng.integers(1, 8))):
+            op = int(rng.integers(0, 4))
+            if op == 0:
+                n = int(rng.integers(0, 6))
+                assert twin.highest(n) == ref.highest(n), \
+                    f"trial {trial}: highest({n})"
+            elif op == 1:
+                n = int(rng.integers(0, 6))
+                assert twin.next(n) == ref.next(n), \
+                    f"trial {trial}: next({n})"
+            elif op == 2:
+                idx = int(rng.integers(-1, 40))
+                twin.unset_index(idx)
+                ref.unset_index(idx)
+            else:
+                existing = _rand_alloc_set(rng, "j", "web",
+                                           int(rng.integers(0, 6)))
+                destructive = _rand_alloc_set(rng, "j", "web",
+                                              int(rng.integers(0, 6)))
+                n = int(rng.integers(0, 5))
+                assert twin.next_canaries(n, existing, destructive) == \
+                    ref.next_canaries(n, existing, destructive), \
+                    f"trial {trial}: next_canaries({n})"
+            assert twin.used == ref.used, f"trial {trial}: membership"
+
+
+def _reconcile_fields(result):
+    return {
+        "place": sorted((p.name, p.canary, p.reschedule, p.lost)
+                        for p in result.place),
+        "stop": sorted((s.alloc.id, s.client_status,
+                        s.status_description) for s in result.stop),
+        "destructive": sorted((d.place_name, d.stop_alloc.id)
+                              for d in result.destructive_update),
+        "inplace": sorted(a.id for a in result.inplace_update),
+        "desired": {g: (d.place, d.stop, d.ignore, d.migrate, d.canary,
+                        d.in_place_update, d.destructive_update)
+                    for g, d in result.desired_tg_updates.items()},
+    }
+
+
+def test_reconciler_field_exact_twin_on_vs_off(monkeypatch):
+    """Fuzzed alloc sets through the FULL reconciler: the tensorized
+    name-slot twin must produce field-exact results vs the reference
+    python-set index."""
+    for seed in range(12):
+        rng = np.random.default_rng(900 + seed)
+        job = mock.batch_job()
+        job.id = job.name = f"rt-job-{seed}"
+        tg = job.task_groups[0]
+        tg.count = int(rng.integers(1, 20))
+        allocs = list(_rand_alloc_set(
+            rng, job.id, tg.name, int(rng.integers(0, 30)),
+            dup_frac=0.05).values())
+        for a in allocs:
+            a.job = job
+            if rng.random() < 0.2:
+                a.client_status = "failed"
+            if rng.random() < 0.2:
+                a.desired_status = "stop"
+                a.client_status = "complete"
+
+        def run():
+            r = AllocReconciler(
+                alloc_update_fn=lambda alloc, j, g: (True, False, None),
+                batch=True, job_id=job.id, job=job, deployment=None,
+                existing_allocs=[a.copy() for a in allocs],
+                tainted_nodes={}, eval_id=f"rt-eval-{seed}",
+                eval_priority=50, now=1_000_000.0)
+            return _reconcile_fields(r.compute())
+
+        monkeypatch.setenv("NOMAD_RECONCILE_TENSOR", "1")
+        twin = run()
+        monkeypatch.setenv("NOMAD_RECONCILE_TENSOR", "0")
+        ref = run()
+        assert twin == ref, f"seed {seed} diverged"

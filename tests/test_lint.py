@@ -561,7 +561,7 @@ def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
             "EXC001", "PERF001", "LEAD001", "OBS001", "OBS002",
-            "QUEUE001", "SHARD001", "MESH001"} <= ids
+            "QUEUE001", "SHARD001", "MESH001", "SYNC001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -1289,3 +1289,53 @@ def test_mesh001_inline_suppression():
         "except Exception:",
         "except Exception:   # nomadlint: disable=MESH001 — probe only")
     assert rule_ids(src, path="solver/placer.py") == []
+
+
+# ---------------------------------------------------------------- SYNC001
+
+SYNC001_BAD = """
+    import numpy as np
+    import jax
+
+    def _solve_group(self, placed, fut, dev):
+        peek = np.asarray(placed)
+        got = jax.device_get(fut)
+        dev.block_until_ready()
+        return peek, got
+"""
+
+
+def test_sync001_fires_on_hot_path_syncs():
+    out = findings(SYNC001_BAD, path="solver/placer.py")
+    assert [f.rule for f in out] == ["SYNC001"] * 3
+    assert "single-sync seam" in out[0].message
+    # microbatch is the other patrolled module
+    assert rule_ids(SYNC001_BAD, path="solver/microbatch.py") == \
+        ["SYNC001"] * 3
+
+
+def test_sync001_scope_and_exemptions():
+    # scope: only the two hot-path modules are patrolled
+    assert rule_ids(SYNC001_BAD, path="solver/backend.py") == []
+    assert rule_ids(SYNC001_BAD, path="server/plan_apply.py") == []
+    good = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def _prep(self, gt, host_fn, args, host):
+            lowered = np.asarray(gt.ask, np.float32)   # dtype lowering
+            placed = np.asarray(host_fn(*args))        # host-tier result
+            row = np.asarray(host[0])                  # materialized read
+            dev = jnp.asarray(lowered)                 # h2d placement
+            return lowered, placed, row, dev
+    """
+    assert rule_ids(good, path="solver/placer.py") == []
+
+
+def test_sync001_inline_suppression_at_the_seam():
+    src = SYNC001_BAD.replace(
+        "peek = np.asarray(placed)",
+        "peek = np.asarray(placed)"
+        "  # nomadlint: disable=SYNC001 — the designated seam")
+    assert rule_ids(src, path="solver/placer.py") == \
+        ["SYNC001"] * 2

@@ -327,7 +327,9 @@ def test_loss_during_aot_warmup_rebuilds_and_completes(monkeypatch):
                                        "times": 1}})
     res = backend.warmup(512, k_maxes=(8,))
     faults.clear()
-    assert res["artifacts"] == 4        # both depth regimes+greedy+chunked
+    # both depth regimes+greedy+chunked, plus the ISSUE-15 fused trio
+    # (whose chain re-selects at the post-loss generation and completes)
+    assert res["artifacts"] == 7
     assert metrics.counter("nomad.solver.warmup.errors") == 0
     assert sharding.generation() >= 1
     assert 6 in sharding.quarantined()

@@ -352,10 +352,13 @@ def test_warmup_compiles_the_grid(monkeypatch):
     out = backend.warmup(12, k_maxes=(8,), budget_s=120.0)
     assert out["skipped"] is False
     assert out["bucket"] == 16
-    # 2 depth regimes + greedy + chunked
-    assert out["artifacts"] == 4
+    # 2 depth regimes + greedy + chunked, plus the fused trio
+    # (both depth regimes + greedy against synthetic resident twins,
+    # ISSUE 15 — select_fused declines count for none of them at this
+    # bucket on the dev mesh)
+    assert out["artifacts"] == 7
     assert metrics.counter("nomad.solver.warmup.errors") == 0
-    assert metrics.counter("nomad.solver.warmup.artifacts") == 4
+    assert metrics.counter("nomad.solver.warmup.artifacts") == 7
 
 
 def test_warmup_budget_exhaustion_is_loud(monkeypatch):
