@@ -903,6 +903,147 @@ def _fused_stream_run() -> dict:
     }
 
 
+def _read_storm_run() -> dict:
+    """Read-path scale-out lineage (ISSUE 16, docs/READ_PATH.md):
+    STRUCTURAL keys only — on a 3-server virtual cluster, a read storm
+    spread across all servers with `stale=True, max_stale_index=<leader
+    index>` must (a) serve a nonzero fraction from followers, (b) honor
+    the staleness bound on every read, and (c) return payloads
+    bit-identical to the leader's at the same index. Plus an event
+    fan-out burst against a slow subscriber (coalescing folds engage,
+    latest state per key survives, nobody drops) and the columnar-vs-
+    row-wise byte ratio for the stub-shaped list payloads. Deliberately
+    wall-clock-free: gates identically on a loaded 1-core box and a
+    TPU pod. NOMAD_READ_STORM_{JOBS,READS} resize."""
+    from nomad_tpu.api_codec import to_columnar
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.rpc.virtual import VirtualNetwork
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.event_broker import Event, EventBroker
+
+    n_jobs = int(os.environ.get("NOMAD_READ_STORM_JOBS", "32"))
+    n_reads = int(os.environ.get("NOMAD_READ_STORM_READS", "120"))
+
+    net = VirtualNetwork(seed=16)
+    servers = []
+    base = dict(metrics.snapshot()["counters"])
+    # all setup inside the try: a failure mid-construction must still
+    # shut down started servers or they election-churn through the rest
+    # of the bench (same discipline as _election_probe)
+    try:
+        for i in range(3):
+            sv = Server(num_workers=0, gc_interval=9999)
+            sv.rpc_listen_virtual(net, f"r{i}")
+            servers.append(sv)
+        peers = {f"r{i}": sv.rpc_addr for i, sv in enumerate(servers)}
+        for i, sv in enumerate(servers):
+            sv.enable_raft(f"r{i}", peers, election_timeout=(0.5, 1.0),
+                           heartbeat_interval=0.08, seed=16_000 + i)
+            sv.start()
+
+        deadline = time.time() + 60.0
+        leader = None
+        while time.time() < deadline and leader is None:
+            led = [sv for sv in servers
+                   if sv.raft_node.is_leader() and sv.is_leader]
+            leader = led[0] if len(led) == 1 else None
+            time.sleep(0.005)
+        if leader is None:
+            raise RuntimeError("read storm: no leader")
+
+        for i in range(n_jobs):
+            leader.job_register(_mk_batch_job(f"storm-{i:03d}", 1))
+        bound = leader.state.latest_index()
+        deadline = time.time() + 30.0
+        while time.time() < deadline and any(
+                sv.state.latest_index() < bound for sv in servers):
+            time.sleep(0.005)
+
+        # ---- the storm: round-robin across ALL servers, stale reads
+        # bounded at the leader's index so every answer is current
+        served = {"leader": 0, "follower": 0}
+        bound_honored = True
+        for i in range(n_reads):
+            sv = servers[i % len(servers)]
+            out = sv.read_list("jobs", stale=True, max_stale_index=bound,
+                               timeout=10.0)
+            meta = out["QueryMeta"]
+            served["follower" if meta["Stale"] else "leader"] += 1
+            bound_honored &= meta["LastIndex"] >= bound
+
+        # ---- differential: follower payloads bit-identical to the
+        # leader's at the same index (the staleness contract)
+        lead = leader.read_list("jobs")
+        lead_js = json.dumps(lead["Items"], sort_keys=True)
+        bit_identical = all(
+            json.dumps(sv.read_list("jobs", stale=True,
+                                    max_stale_index=bound,
+                                    timeout=10.0)["Items"],
+                       sort_keys=True) == lead_js
+            for sv in servers if sv is not leader)
+
+        # ---- fan-out burst: slow subscriber, many updates over few
+        # keys — coalescing must fold, latest state per key must
+        # survive, and the drop rung must NOT fire
+        fanout_keys, fanout_events = 16, 400
+        broker = EventBroker(max_pending=64, coalesce_after=4)
+        sub = broker.subscribe({"Job": ["*"]})
+        expect = {}
+        for i in range(fanout_events):
+            key = f"k{i % fanout_keys}"
+            broker.publish(i + 1, [Event(topic="Job", type="T", key=key,
+                                         index=i + 1)])
+            expect[key] = i + 1
+        got = {}
+        while True:
+            batch = sub.next_events(timeout=0.05)
+            if batch is None:
+                break
+            for e in batch[1]:
+                got[e.key] = e.index
+
+        def delta(key):
+            return int(metrics.counter(key) - base.get(key, 0))
+
+        fanout = {
+            "events_published": fanout_events,
+            "keys": fanout_keys,
+            "keys_delivered": sum(1 for k, v in expect.items()
+                                  if got.get(k) == v),
+            "lost_keys": sum(1 for k, v in expect.items()
+                             if got.get(k) != v),
+            "coalesced_batches": delta("nomad.event.coalesced_batches"),
+            "superseded_events": delta("nomad.event.coalesced_events"),
+            "dropped_subscribers": delta("nomad.event.subscriber_dropped"),
+        }
+
+        # ---- columnar-vs-row bytes on the real stub rows
+        rows = lead["Items"]
+        row_bytes = len(json.dumps(rows).encode())
+        col_bytes = len(json.dumps(to_columnar(rows)).encode())
+
+        total = max(1, served["leader"] + served["follower"])
+        return {
+            "jobs_seeded": n_jobs,
+            "reads": n_reads,
+            "leader_served": served["leader"],
+            "follower_served": served["follower"],
+            "follower_served_frac": round(served["follower"] / total, 4),
+            "max_stale_index_honored": bound_honored,
+            "stale_bit_identical": bit_identical,
+            "fanout": fanout,
+            "columnar": {
+                "rows": len(rows),
+                "row_bytes": row_bytes,
+                "columnar_bytes": col_bytes,
+                "ratio": round(col_bytes / max(1, row_bytes), 4),
+            },
+        }
+    finally:
+        for sv in servers:
+            sv.shutdown()
+
+
 def _crash_recovery_run() -> dict:
     """Crash-recovery lineage (ISSUE 13, docs/DURABILITY.md): the raft
     WAL's durability/throughput envelope on this box.
@@ -1810,6 +1951,14 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         fused_stream = {"error": repr(e)[:200]}
 
+    # read-path lineage (ISSUE 16): follower-served stale reads +
+    # bit-identity differential + coalescing fan-out zero-loss +
+    # columnar byte ratio, structural keys only; gated once recorded
+    try:
+        read_storm = _read_storm_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        read_storm = {"error": repr(e)[:200]}
+
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
     # tests/test_bench_regression.py once recorded
@@ -1890,6 +2039,9 @@ def main() -> None:
         # ISSUE 15: whole-eval residency (fused dispatch) — structural,
         # load-insensitive keys (round trips per eval, bit parity)
         "fused_stream": fused_stream,
+        # ISSUE 16: read-path scale-out (follower stale reads, fan-out
+        # coalescing zero-loss, columnar list codec byte ratio)
+        "read_storm": read_storm,
         "tensor_cache_hit_rate": round(tensor_cache_hit_rate, 4),
         "state_cache": state_cache_counters,
         **phases,
@@ -2245,6 +2397,11 @@ if __name__ == "__main__":
         # standalone whole-eval-residency lineage (ISSUE 15): fused
         # round trips per eval + bit parity; NOMAD_FUSED_EVALS resizes
         print(json.dumps(_fused_stream_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--read-storm":
+        # standalone read-path lineage (ISSUE 16): follower stale reads
+        # + fan-out coalescing + columnar byte ratio;
+        # NOMAD_READ_STORM_{JOBS,READS} resize
+        print(json.dumps(_read_storm_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":

@@ -145,7 +145,36 @@ class HTTPAPI:
                     raise HTTPError(400, str(e))
                 return {}, None
 
-        def blocking(index_fn, payload_fn):
+        # ---- read staleness (ISSUE 16): agent-local reads are stale by
+        # construction on a follower (served from its replicated store).
+        # `?stale=false` demands leader consistency — a follower redirects
+        # via NotLeaderError (the handler proxies one hop to the leader);
+        # `?max_stale_index=N` bounds the staleness — serve only once the
+        # local store has applied index N, else redirect/504. Responses
+        # stamp X-Nomad-KnownLeader / X-Nomad-Stale so it is provable.
+        if method == "GET":
+            if s.raft_node is not None:
+                s._raft_leadership()   # refresh the cached leader addr
+            stale_q = query.get("stale")
+            if stale_q is not None and \
+                    str(stale_q).lower() in ("false", "0", "no") and \
+                    s.raft_node is not None and not s.is_leader:
+                raise NotLeaderError(s.leader_rpc_addr)
+            max_stale = int(query.get("max_stale_index", 0) or 0)
+            if max_stale:
+                cap_s = s.overload.blocking_cap_s() \
+                    if getattr(s, "overload", None) is not None else 5.0
+                try:
+                    s.state.snapshot_min_index(max_stale,
+                                               timeout=min(cap_s, 5.0))
+                except TimeoutError:
+                    if s.raft_node is not None and not s.is_leader and \
+                            s.leader_rpc_addr:
+                        raise NotLeaderError(s.leader_rpc_addr)
+                    raise HTTPError(
+                        504, f"index {max_stale} not reached locally")
+
+        def blocking(index_fn, payload_fn, topics=None):
             min_index = int(query.get("index", 0) or 0)
             # the hold ceiling shrinks under pressure (brownout, ISSUE 8):
             # parked long-polls are the cheapest capacity to reclaim, and
@@ -156,10 +185,31 @@ class HTTPAPI:
                        cap_s)
             if min_index and wait:
                 deadline = time.time() + wait
+                # park on the event broker, not the store condvar: only
+                # writes on this route's topic wake the watcher (ISSUE
+                # 16), instead of every store write waking every parked
+                # blocking query. `seen` chases the topic index so churn
+                # on OTHER keys of the topic re-checks once, then parks
+                # again; the deadline re-check covers the rare writes
+                # that emit no event (bounded delay, never wrong).
+                broker = s.event_broker
+                seen = min_index
                 while index_fn() <= min_index and time.time() < deadline:
-                    s.state.block_min_index(
-                        min_index, timeout=max(0.05, deadline - time.time()))
+                    seen = max(seen, broker.wait_for_index(
+                        topics, seen,
+                        timeout=max(0.05, deadline - time.time())))
             return payload_fn(), index_fn()
+
+        def list_reply(rows):
+            # stub-field projection + columnar struct-of-arrays mode for
+            # the list hot paths (ISSUE 16); ?fields=A,B&format=columnar
+            from ..api_codec import project_fields, to_columnar
+            fields = [f for f in (query.get("fields") or "").split(",")
+                      if f]
+            rows = project_fields(rows, fields or None)
+            if query.get("format") == "columnar":
+                return to_columnar(rows)
+            return rows
 
         # ---- jobs
         if parts == ["jobs"]:
@@ -178,8 +228,9 @@ class HTTPAPI:
                         None if ns == "*" else ns)
                         if j.id.startswith(prefix)
                         and (ns != "*" or acl.allow_namespace_operation(
-                            j.namespace, NS_LIST_JOBS))])
-                return payload, index
+                            j.namespace, NS_LIST_JOBS))],
+                    topics=("Job",))
+                return list_reply(payload), index
             if method in ("PUT", "POST"):
                 job = from_api(Job, body.get("Job", body))
                 if not job.namespace:
@@ -356,11 +407,14 @@ class HTTPAPI:
         if parts == ["evaluations"]:
             if ns != "*":
                 require(acl.allow_namespace_operation(ns, NS_READ_JOB))
-            evs = [e for e in s.state.iter_evals()
-                   if (e.namespace == ns if ns != "*" else
-                       acl.allow_namespace_operation(e.namespace,
-                                                     NS_READ_JOB))]
-            return [to_api(e) for e in evs], s.state.table_index("evals")
+            payload, index = blocking(
+                lambda: s.state.table_index("evals"),
+                lambda: [to_api(e) for e in s.state.iter_evals()
+                         if (e.namespace == ns if ns != "*" else
+                             acl.allow_namespace_operation(e.namespace,
+                                                           NS_READ_JOB))],
+                topics=("Evaluation",))
+            return list_reply(payload), index
         if parts and parts[0] == "evaluation" and len(parts) >= 2:
             ev = s.state.eval_by_id(parts[1])
             if ev is None:
@@ -381,8 +435,9 @@ class HTTPAPI:
                 lambda: [self._alloc_stub(a) for a in s.state.iter_allocs()
                          if (a.namespace == ns if ns != "*" else
                              acl.allow_namespace_operation(a.namespace,
-                                                           NS_READ_JOB))])
-            return payload, index
+                                                           NS_READ_JOB))],
+                topics=("Allocation",))
+            return list_reply(payload), index
         if parts and parts[0] == "allocation" and len(parts) >= 2:
             alloc = s.state.alloc_by_id(parts[1])
             if alloc is None:
@@ -403,8 +458,9 @@ class HTTPAPI:
             require(acl.allow_node_read())
             payload, index = blocking(
                 lambda: s.state.table_index("nodes"),
-                lambda: [self._node_stub(n) for n in s.state.iter_nodes()])
-            return payload, index
+                lambda: [self._node_stub(n) for n in s.state.iter_nodes()],
+                topics=("Node",))
+            return list_reply(payload), index
         if parts and parts[0] == "node" and len(parts) >= 2:
             require(acl.allow_node_write() if method != "GET"
                     else acl.allow_node_read())
@@ -1179,51 +1235,21 @@ class HTTPAPI:
 
     # ------------------------------------------------------------- stubs
 
+    # builders live in api_codec so the Read.List RPC serves the exact
+    # same shapes (the follower-read differential is bit-exact by
+    # construction, ISSUE 16)
+
     def _job_stub(self, j) -> dict:
-        summ = self.server.state.job_summary(j.namespace, j.id)
-        return {
-            "ID": j.id, "Name": j.name, "Namespace": j.namespace,
-            "Type": j.type, "Priority": j.priority, "Status": j.status,
-            "StatusDescription": j.status_description, "Stop": j.stop,
-            "JobSummary": to_api(summ) if summ else None,
-            "Version": j.version, "SubmitTime": j.submit_time,
-            "CreateIndex": j.create_index, "ModifyIndex": j.modify_index,
-        }
+        from ..api_codec import job_stub
+        return job_stub(j, self.server.state.job_summary(j.namespace, j.id))
 
     def _alloc_stub(self, a) -> dict:
-        # AllocatedCPU/AllocatedMemoryMB: rollups the reference's stub
-        # carries via AllocatedResources on the full alloc; the topology
-        # view needs per-node utilization without N full-alloc fetches
-        cpu = mem = 0
-        if a.allocated_resources is not None:
-            for tr in a.allocated_resources.tasks.values():
-                cpu += tr.cpu_shares
-                mem += tr.memory_mb
-        return {
-            "ID": a.id, "Name": a.name, "Namespace": a.namespace,
-            "EvalID": a.eval_id, "NodeID": a.node_id, "NodeName": a.node_name,
-            "JobID": a.job_id, "JobVersion": a.job.version if a.job else 0,
-            "TaskGroup": a.task_group,
-            "DesiredStatus": a.desired_status,
-            "DesiredDescription": a.desired_description,
-            "ClientStatus": a.client_status,
-            "DeploymentID": a.deployment_id,
-            "FollowupEvalID": a.follow_up_eval_id,
-            "TaskStates": to_api(a.task_states),
-            "AllocatedCPU": cpu, "AllocatedMemoryMB": mem,
-            "CreateIndex": a.create_index, "ModifyIndex": a.modify_index,
-            "CreateTime": a.create_time_unix, "ModifyTime": a.modify_time_unix,
-        }
+        from ..api_codec import alloc_stub
+        return alloc_stub(a)
 
     def _node_stub(self, n) -> dict:
-        return {
-            "ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
-            "NodeClass": n.node_class, "Status": n.status,
-            "SchedulingEligibility": n.scheduling_eligibility,
-            "Drain": n.drain, "Drivers": to_api(n.drivers),
-            "Address": n.http_addr,
-            "CreateIndex": n.create_index, "ModifyIndex": n.modify_index,
-        }
+        from ..api_codec import node_stub
+        return node_stub(n)
 
 
 def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
@@ -1318,6 +1344,17 @@ def make_http_server(api: HTTPAPI, host: str = "127.0.0.1",
             headers = {}
             if index is not None:
                 headers["X-Nomad-Index"] = str(index)
+            srv = api.server
+            if method == "GET" and srv is not None:
+                # staleness stamping (ISSUE 16): provable QueryMeta on
+                # every read — KnownLeader=False flags an election in
+                # flight (LastIndex may lag an unreachable majority);
+                # Stale=true means a follower's local store served this
+                known = srv.is_leader or bool(srv.leader_rpc_addr)
+                headers["X-Nomad-KnownLeader"] = \
+                    "true" if known else "false"
+                headers["X-Nomad-Stale"] = \
+                    "false" if srv.is_leader else "true"
             self._respond(200, payload, headers)
 
         def _serve_ui(self, path: str) -> None:

@@ -12,8 +12,8 @@ from .core import (                                    # noqa: F401
 )
 from . import (                                            # noqa: F401
     rules_det, rules_dur, rules_exc, rules_jit, rules_lead, rules_lock,
-    rules_mesh, rules_obs, rules_perf, rules_queue, rules_shard,
-    rules_sync,
+    rules_mesh, rules_obs, rules_perf, rules_queue, rules_read,
+    rules_shard, rules_sync,
 )
 
 __all__ = ["Baseline", "Finding", "Rule", "all_rules", "analyze_paths",

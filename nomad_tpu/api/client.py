@@ -27,11 +27,25 @@ class APIError(Exception):
 
 @dataclasses.dataclass
 class QueryOptions:
-    """ref api/api.go QueryOptions"""
+    """ref api/api.go QueryOptions (+ AllowStale semantics, ISSUE 16)"""
     namespace: str = ""
     prefix: str = ""
     wait_index: int = 0
     wait_time_sec: float = 0.0
+    # stale=False demands leader consistency (a follower redirects the
+    # read to the leader); stale=True accepts whichever server answers,
+    # served from its local replicated store. None keeps the server's
+    # default (agent-local reads, stale on a follower by construction).
+    stale: Optional[bool] = None
+    # bound the staleness: serve only from a store that has applied at
+    # least this index (block briefly / redirect to the leader otherwise)
+    max_stale_index: int = 0
+    # server-side stub-field projection for list endpoints (API field
+    # names, e.g. ["ID", "Status"]); None returns full stubs
+    fields: Optional[list[str]] = None
+    # request the columnar struct-of-arrays list encoding; the client
+    # decodes it back to rows transparently (wire-size win only)
+    columnar: bool = False
     params: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
@@ -44,6 +58,11 @@ class WriteOptions:
 class QueryMeta:
     """ref api/api.go QueryMeta"""
     last_index: int = 0
+    # False while an election is in flight: last_index may lag an
+    # unreachable majority (X-Nomad-KnownLeader)
+    known_leader: bool = True
+    # True when a follower's local store served the read (X-Nomad-Stale)
+    stale: bool = False
 
 
 class Client:
@@ -97,6 +116,14 @@ class Client:
                 params["index"] = str(q.wait_index)
             if q.wait_time_sec:
                 params["wait"] = f"{q.wait_time_sec}s"
+            if q.stale is not None:
+                params["stale"] = "true" if q.stale else "false"
+            if q.max_stale_index:
+                params["max_stale_index"] = str(q.max_stale_index)
+            if q.fields:
+                params["fields"] = ",".join(q.fields)
+            if q.columnar:
+                params["format"] = "columnar"
             params.update(q.params)
         params.update(extra or {})
         qs = urllib.parse.urlencode(params)
@@ -119,11 +146,22 @@ class Client:
                 with urllib.request.urlopen(req,
                                             timeout=self.timeout) as resp:
                     payload = resp.read()
-                    meta = QueryMeta(last_index=int(
-                        resp.headers.get("X-Nomad-Index", 0) or 0))
+                    meta = QueryMeta(
+                        last_index=int(
+                            resp.headers.get("X-Nomad-Index", 0) or 0),
+                        known_leader=(resp.headers.get(
+                            "X-Nomad-KnownLeader", "true") != "false"),
+                        stale=(resp.headers.get(
+                            "X-Nomad-Stale", "false") == "true"))
                     if raw:
                         return payload, meta
-                    return (json.loads(payload) if payload else None), meta
+                    decoded = json.loads(payload) if payload else None
+                    from ..api_codec import from_columnar, is_columnar
+                    if is_columnar(decoded):
+                        # columnar is a wire encoding, not an API shape:
+                        # callers always see row dicts
+                        decoded = from_columnar(decoded)
+                    return decoded, meta
             except urllib.error.HTTPError as e:
                 try:
                     msg = json.loads(e.read() or b"{}").get("error", str(e))

@@ -361,6 +361,25 @@ class Registry:
 
 metrics = Registry()
 
+# read-path scale-out telemetry (ISSUE 16): pre-registered HELP text so
+# the Prometheus page documents the staleness/backpressure counters even
+# before they first move (reset() keeps help, so tests see these too)
+metrics.describe("nomad.read.leader_served",
+                 "list/get reads served from the leader's store")
+metrics.describe("nomad.read.follower_served",
+                 "list/get reads served from a follower's replicated "
+                 "store (stale reads)")
+metrics.describe("nomad.event.subscriber_dropped",
+                 "event subscribers closed for falling behind after "
+                 "coalescing could not shrink their queue (last rung)")
+metrics.describe("nomad.event.coalesced_batches",
+                 "per-subscriber queue folds (backpressure rung 1)")
+metrics.describe("nomad.event.coalesced_events",
+                 "events superseded latest-wins-per-key by coalescing")
+metrics.describe("nomad.event.waiters_parked",
+                 "blocking queries parked on the event broker instead "
+                 "of poll-looping the state store")
+
 
 def record_swallowed_error(site: str, err: BaseException,
                            logger=None) -> None:

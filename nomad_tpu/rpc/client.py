@@ -273,5 +273,27 @@ class ServerRpc:
     def node_update_allocs(self, allocs):
         return self.rpc.call("Node.UpdateAlloc", allocs)
 
+    # ------------------------------------------------------------ read plane
+    # ISSUE 16: list/get off any server. With stale=False a follower
+    # answers NotLeaderError and call_timeout retries transparently
+    # against the leader, so the default stays leader-consistent; with
+    # stale=True whichever server answers first serves from its local
+    # replicated store and stamps QueryMeta accordingly.
+
+    def read_list(self, table: str, namespace=None, stale: bool = False,
+                  max_stale_index: int = 0, fields=None,
+                  columnar: bool = False, timeout: float = 5.0):
+        return self.rpc.call_timeout(
+            timeout + 15.0, "Read.List", table, namespace=namespace,
+            stale=stale, max_stale_index=max_stale_index, fields=fields,
+            columnar=columnar, timeout=timeout)
+
+    def read_get(self, table: str, key: str, namespace: str = "default",
+                 stale: bool = False, max_stale_index: int = 0,
+                 timeout: float = 5.0):
+        return self.rpc.call_timeout(
+            timeout + 15.0, "Read.Get", table, key, namespace=namespace,
+            stale=stale, max_stale_index=max_stale_index, timeout=timeout)
+
     def close(self) -> None:
         self.rpc.close()

@@ -1,19 +1,35 @@
-"""Event broker: at-most-once pub/sub of state-change events
-(ref nomad/stream/event_broker.go:30 EventBroker, event_buffer.go).
+"""Event broker: pub/sub of state-change events with per-subscriber
+backpressure (ref nomad/stream/event_broker.go:30 EventBroker,
+event_buffer.go).
 
-A bounded ring buffer of event batches with per-subscriber cursors: slow
-subscribers that fall off the tail are closed and must re-subscribe (the
-reference's ErrSubscriptionClosed contract). Feeds `/v1/event/stream`.
+A bounded ring buffer of event batches with per-subscriber queues. A
+subscriber that falls behind rides three backpressure rungs, gentlest
+first (ISSUE 16):
+
+  1. **coalesce** — above `coalesce_after` queued batches, the queue is
+     folded latest-wins per (topic, namespace, key); the threshold
+     tightens with the overload pressure state (`pressure_fn`). Opt-in
+     at construction (the Server opts in; a bare broker keeps the
+     legacy deliver-every-event contract).
+  2. **park** — blocking readers wait on `wait_for_index(topics, index)`
+     instead of poll-looping the state store, so only writes on the
+     watched topics wake them.
+  3. **drop** — only when coalescing cannot shrink the queue under
+     `max_pending` (that many *distinct* keys in flight) is the
+     subscriber closed (the reference's ErrSubscriptionClosed contract,
+     `nomad.event.subscriber_dropped`).
 
 Events originate from the state store's `event_sinks` (our analog of
-nomad/state/events.go eventsFromChanges).
+nomad/state/events.go eventsFromChanges). Feeds `/v1/event/stream` and
+the HTTP blocking-query helpers.
 """
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Iterable, Optional, Union
 
 from ..metrics import metrics
 
@@ -89,8 +105,11 @@ class Subscription:
                 return
             if wanted:
                 self._queue.append((index, wanted))
+                threshold = self._broker._coalesce_threshold()
+                if threshold is not None and len(self._queue) > threshold:
+                    self._coalesce_locked()
                 if len(self._queue) > self._broker.max_pending:
-                    self._closed = True   # slow consumer: drop
+                    self._closed = True   # slow consumer: drop (last rung)
                     self._queue.clear()
                     dropped = True
             self._cond.notify_all()
@@ -101,13 +120,51 @@ class Subscription:
             metrics.incr("nomad.event.subscriber_dropped")
             self._broker._unsubscribe(self)
 
+    def _coalesce_locked(self) -> None:
+        """Fold the queued batches latest-wins per (topic, namespace, key).
+
+        The zero-loss contract is per key, not per event: after a
+        coalesce a reader still observes the latest state of every key
+        that was ever queued, in index order, but intermediate updates
+        to the same key are superseded. Caller holds self._cond."""
+        total = sum(len(evs) for _, evs in self._queue)
+        latest: dict[tuple[str, str, str], Event] = {}
+        max_index = 0
+        for idx, evs in self._queue:
+            max_index = max(max_index, idx)
+            for e in evs:
+                latest[(e.topic, e.namespace, e.key)] = e
+        superseded = total - len(latest)
+        if superseded <= 0:
+            return
+        merged = sorted(latest.values(), key=lambda e: e.index)
+        self._queue.clear()
+        # strictly shrinking: N queued batches fold into this single one,
+        # and _offer still drops the subscriber past max_pending
+        # nomadlint: disable=QUEUE001 — shrinking fold, bound in _offer
+        self._queue.append((max_index, merged))
+        metrics.incr("nomad.event.coalesced_batches")
+        metrics.incr("nomad.event.coalesced_events", superseded)
+
     def next_events(self, timeout: Optional[float] = None
                     ) -> Optional[tuple[int, list[Event]]]:
         """Block until the next matching batch; None on timeout. Raises
         SubscriptionClosedError if dropped for falling behind."""
+        # loop on a deadline: a bare cond.wait(timeout) returns early on
+        # notify-without-data (e.g. a publish whose batch matched nothing,
+        # or a batch consumed by a racing reader under the RLock), which
+        # silently truncated the caller's timeout (ISSUE 16 satellite)
+        deadline = (None if timeout is None
+                    else time.monotonic() + max(0.0, timeout))
         with self._cond:
-            if not self._queue and not self._closed:
-                self._cond.wait(timeout)
+            while not self._queue and not self._closed:
+                if deadline is None:
+                    self._cond.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
             if self._closed:
                 raise SubscriptionClosedError()
             if self._queue:
@@ -125,7 +182,9 @@ class EventBroker:
     """ref nomad/stream/event_broker.go:30; buffer_size mirrors
     EventBufferSize (default 100 batches)."""
 
-    def __init__(self, buffer_size: int = 256, max_pending: int = 512):
+    def __init__(self, buffer_size: int = 256, max_pending: int = 512,
+                 coalesce_after: Optional[int] = None,
+                 pressure_fn=None):
         # RLock: subscribe() replays into the sub while holding the lock; an
         # overflowing replay re-enters via _unsubscribe
         self._lock = threading.RLock()
@@ -133,7 +192,36 @@ class EventBroker:
             maxlen=buffer_size)
         self._subs: list[Subscription] = []
         self.max_pending = max_pending
+        # backpressure rung 1: queued batches past this start coalescing
+        # latest-wins per key. None (the default) keeps the legacy
+        # deliver-every-event contract — rung 1 is OPT-IN at
+        # construction because folding is only sound for consumers that
+        # want latest STATE per key, not an exhaustive event log; the
+        # Server opts its broker in (server.py), bare brokers don't
+        self.coalesce_after = coalesce_after
+        # optional overload pressure feed ("ok"/"saturated"/"shedding");
+        # pressure tightens the coalesce threshold so bursty fan-out
+        # degrades to latest-state delivery before anything drops
+        self.pressure_fn = pressure_fn
         self._latest_index = 0
+        # highest published index per topic, for wait_for_index parking
+        self._topic_index: dict[str, int] = {}
+        self._pub_cond = threading.Condition(self._lock)
+
+    def _coalesce_threshold(self) -> Optional[int]:
+        ca = self.coalesce_after
+        if ca is None:
+            return None
+        if self.pressure_fn is not None:
+            try:
+                pressure = self.pressure_fn()
+            except Exception:
+                pressure = "ok"
+            if pressure == "saturated":
+                return max(1, ca // 4)
+            if pressure == "shedding":
+                return 1
+        return ca
 
     # ------------------------------------------------------------- publish
 
@@ -143,10 +231,14 @@ class EventBroker:
             return
         with self._lock:
             self._latest_index = max(self._latest_index, index)
+            for ev in events:
+                if index > self._topic_index.get(ev.topic, 0):
+                    self._topic_index[ev.topic] = index
             # the ring bound lives in __init__: deque(maxlen=buffer_size)
             # nomadlint: disable=QUEUE001 — deque maxlen ring (above)
             self._buffer.append((index, events))
             subs = list(self._subs)
+            self._pub_cond.notify_all()
         for sub in subs:
             sub._offer(index, events)
 
@@ -179,6 +271,56 @@ class EventBroker:
     def latest_index(self) -> int:
         with self._lock:
             return self._latest_index
+
+    def topic_index(self, topic: str) -> int:
+        """Highest index that has published an event on `topic`."""
+        with self._lock:
+            if topic == TOPIC_ALL:
+                return self._latest_index
+            return self._topic_index.get(topic, 0)
+
+    # ------------------------------------------------------------- parking
+
+    def wait_for_index(self, topics: Union[dict, Iterable[str], None],
+                       index: int, timeout: float = 30.0) -> int:
+        """Park until an event on one of `topics` carries index > `index`;
+        backpressure rung 2 for blocking queries.
+
+        `topics` is a subscribe()-style dict (only the topic names are
+        consulted — wakeups are topic-granular), an iterable of topic
+        names, or None/"*" for any topic. Returns the highest published
+        index across the watched topics at wake time, which may still be
+        <= `index` on timeout: writes that emit no event (rare GC paths)
+        move the store index without waking the broker, so callers keep
+        a deadline re-check of their own index_fn. That bounded re-check
+        is the correctness backstop; the broker is the fast path that
+        avoids waking every watcher on every unrelated write."""
+        names: Optional[list[str]] = None
+        if topics:
+            names = list(topics.keys() if isinstance(topics, dict)
+                         else topics)
+            if TOPIC_ALL in names:
+                names = None
+
+        def current_locked() -> int:
+            if names is None:
+                return self._latest_index
+            return max((self._topic_index.get(t, 0) for t in names),
+                       default=0)
+
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._pub_cond:
+            cur = current_locked()
+            if cur > index:
+                return cur
+            metrics.incr("nomad.event.waiters_parked")
+            while cur <= index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._pub_cond.wait(remaining)
+                cur = current_locked()
+            return cur
 
 
 def make_event(topic: str, etype: str, index: int, payload) -> Event:

@@ -624,6 +624,12 @@ class RaftNode:
 
     def leadership(self) -> tuple[bool, str]:
         with self._lock:
+            if self.state == CANDIDATE:
+                # mid-election there is NO known leader: advertising the
+                # deposed one would forward RPCs at a server we just
+                # timed out on, and stale reads must be able to stamp
+                # KnownLeader=False while a vote is in flight (ISSUE 16)
+                return False, ""
             return self.state == LEADER, self.leader_addr
 
     # ----------------------------------------------------------- elections

@@ -151,6 +151,11 @@ RPC_ENDPOINTS = {
     "ACL.ListTokens": ("acl_list_tokens_wire", False),
     "Status.Members": ("members", False),
     "Status.Regions": ("regions", False),
+    # read plane (ISSUE 16): list/get served from any server's replicated
+    # store; `stale=False` on a follower raises NotLeaderError so the
+    # client's transparent redirect keeps default reads leader-consistent
+    "Read.List": ("read_list", False),
+    "Read.Get": ("read_get", False),
 }
 
 
@@ -184,7 +189,14 @@ class Server:
         self.eval_broker = EvalBroker(
             config_fn=self.state.get_scheduler_config)
         from .event_broker import EventBroker
-        self.event_broker = EventBroker()
+        # backpressure rung 1 (opt-in at construction: the server's
+        # consumers watch latest STATE per key, not an exhaustive event
+        # log) rides the overload pressure state: bursty fan-out
+        # coalesces to latest-state delivery before anything drops
+        # (self.overload is assigned below; the lambda defers)
+        self.event_broker = EventBroker(
+            coalesce_after=64,
+            pressure_fn=lambda: self.overload.state())
         self.state.event_sinks.append(self.event_broker.sink)
         self.blocked_evals = BlockedEvals(self._enqueue_unblocked)
         from .acl_endpoint import ACLEndpoint
@@ -1914,6 +1926,13 @@ class Server:
         under pressure (brownout, ISSUE 8) — parked long-polls return
         capacity, clients just re-poll sooner."""
         deadline = time.time() + min(timeout, self.overload.blocking_cap_s())
+        # park on the broker, not the store condvar: only Allocation
+        # events wake this long-poll, instead of every write in the
+        # cluster waking every parked client (ISSUE 16). `seen` tracks
+        # the last observed topic index so unrelated alloc churn cannot
+        # busy-spin the re-check loop; the deadline re-check keeps the
+        # no-event GC paths correct (bounded-delay, never wrong).
+        seen = min_index
         while True:
             allocs = self.state.allocs_by_node(node_id)
             index = self.state.latest_index()
@@ -1923,8 +1942,114 @@ class Server:
             if any(mi > min_index for mi in relevant.values()) or \
                time.time() >= deadline:
                 return {"allocs": relevant, "index": index}
-            self.state.block_min_index(min_index,
-                                       timeout=max(0.05, deadline - time.time()))
+            seen = max(seen, self.event_broker.wait_for_index(
+                ("Allocation",), seen,
+                timeout=max(0.05, deadline - time.time())))
+
+    # ---------------------------------------------------------- read plane
+    # ISSUE 16: list/get served from ANY server's replicated store off the
+    # leader's hot lock, via the snapshot memo (`state/store.py _snap_memo`
+    # — repeated reads between writes share one snapshot). Staleness is
+    # provable: every response carries QueryMeta {LastIndex, KnownLeader,
+    # Stale, Server} (ref nomad/structs QueryMeta + AllowStale).
+
+    def _read_snapshot(self, stale: bool, max_stale_index: int,
+                       timeout: float):
+        """Resolve the snapshot a read is served from.
+
+        Consistent (default) reads on a follower redirect to the leader
+        via NotLeaderError (the rpc client retries transparently). Stale
+        reads serve locally; `max_stale_index` bounds the staleness —
+        the follower blocks until its store has applied that index, and
+        redirects to the leader if it cannot catch up in time."""
+        if self.raft_node is not None:
+            # leader_rpc_addr is otherwise only refreshed when the
+            # dispatcher gates a leader-only endpoint; read endpoints are
+            # leader_only=False, so pull the current leader from raft here
+            # or KnownLeader/redirects would ride a stale cache
+            self._raft_leadership()
+        if not stale and self.raft_node is not None and not self.is_leader:
+            raise NotLeaderError(self.leader_rpc_addr)
+        if max_stale_index:
+            cap = min(timeout, self.overload.blocking_cap_s())
+            try:
+                return self.state.snapshot_min_index(max_stale_index,
+                                                     timeout=cap)
+            except TimeoutError:
+                # this replica is too far behind the bound: the leader
+                # (which defines the index) can always serve it
+                if not self.is_leader and self.leader_rpc_addr:
+                    raise NotLeaderError(self.leader_rpc_addr)
+                raise
+        return self.state.snapshot()
+
+    def _read_meta(self, index: int, stale: bool) -> dict:
+        # KnownLeader=False during elections is the client's signal that
+        # LastIndex may lag an unreachable majority (ref QueryMeta)
+        known = self.is_leader or bool(self.leader_rpc_addr)
+        metrics.incr("nomad.read.leader_served" if self.is_leader
+                     else "nomad.read.follower_served")
+        return {"LastIndex": index, "KnownLeader": known,
+                "Stale": bool(stale and not self.is_leader),
+                "Server": self.name}
+
+    def read_list(self, table: str, namespace: Optional[str] = None,
+                  stale: bool = False, max_stale_index: int = 0,
+                  fields: Optional[list] = None, columnar: bool = False,
+                  timeout: float = 5.0) -> dict:
+        """List stubs for the fleet-dashboard hot paths. Rows are sorted
+        by (CreateIndex, ID) so leader and follower payloads at the same
+        index are bit-identical (the staleness differential contract)."""
+        from ..api_codec import (alloc_stub, job_stub, node_stub,
+                                 project_fields, to_api, to_columnar)
+        snap = self._read_snapshot(stale, max_stale_index, timeout)
+        by_create = lambda o: (o.create_index, o.id)  # noqa: E731
+        if table == "nodes":
+            rows = [node_stub(n) for n in sorted(snap.iter_nodes(),
+                                                 key=by_create)]
+        elif table == "allocs":
+            allocs = [a for a in snap.iter_allocs()
+                      if namespace is None or a.namespace == namespace]
+            rows = [alloc_stub(a) for a in sorted(allocs, key=by_create)]
+        elif table == "evals":
+            evals = [e for e in snap.iter_evals()
+                     if namespace is None or e.namespace == namespace]
+            rows = [to_api(e) for e in sorted(evals, key=by_create)]
+        elif table == "jobs":
+            rows = [job_stub(j, snap.job_summary(j.namespace, j.id))
+                    for j in sorted(snap.iter_jobs(namespace),
+                                    key=by_create)]
+        else:
+            raise ValueError(f"unknown read table: {table!r}")
+        rows = project_fields(rows, fields)
+        out = {"QueryMeta": self._read_meta(snap.index, stale)}
+        if columnar:
+            out["Columnar"] = to_columnar(rows)
+        else:
+            out["Items"] = rows
+        return out
+
+    def read_get(self, table: str, key: str,
+                 namespace: str = "default", stale: bool = False,
+                 max_stale_index: int = 0, timeout: float = 5.0) -> dict:
+        """Single-object read off any server (same staleness contract as
+        read_list)."""
+        from ..api_codec import to_api
+        snap = self._read_snapshot(stale, max_stale_index, timeout)
+        if table == "node":
+            obj = snap.node_by_id(key)
+        elif table == "alloc":
+            obj = snap.alloc_by_id(key)
+        elif table == "eval":
+            obj = snap.eval_by_id(key)
+        elif table == "job":
+            obj = snap.job_by_id(namespace, key)
+        elif table == "deployment":
+            obj = snap.deployment_by_id(key)
+        else:
+            raise ValueError(f"unknown read table: {table!r}")
+        return {"Item": to_api(obj) if obj is not None else None,
+                "QueryMeta": self._read_meta(snap.index, stale)}
 
     def node_update_allocs(self, allocs: list[Allocation]) -> dict:
         """Client pushes alloc status (ref node_endpoint.go UpdateAlloc):

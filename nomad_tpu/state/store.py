@@ -1514,6 +1514,7 @@ class StateSnapshot:
         self.job_versions = dict(store.job_versions)
         self.evals = dict(store.evals)
         self.allocs = dict(store.allocs)
+        self.job_summaries = dict(store.job_summaries)
         self.deployments = dict(store.deployments)
         self.scheduler_config = store.scheduler_config
         self.csi_volumes = dict(store.csi_volumes)
@@ -1536,6 +1537,19 @@ class StateSnapshot:
 
     def iter_nodes(self) -> list[Node]:
         return list(self.nodes.values())
+
+    def iter_jobs(self, ns: Optional[str] = None) -> list[Job]:
+        return [j for j in self.jobs.values()
+                if ns is None or j.namespace == ns]
+
+    def iter_evals(self) -> list[Evaluation]:
+        return list(self.evals.values())
+
+    def iter_allocs(self) -> list[Allocation]:
+        return list(self.allocs.values())
+
+    def job_summary(self, ns: str, job_id: str) -> Optional[JobSummary]:
+        return self.job_summaries.get((ns, job_id))
 
     def ready_nodes_in_dcs(self, datacenters: Iterable[str]) -> list[Node]:
         dcs = set(datacenters)

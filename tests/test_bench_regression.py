@@ -599,6 +599,52 @@ def test_fused_stream_gate():
         f"contract (one dispatch + one device_get per eval) regressed")
 
 
+def test_read_storm_gate():
+    """ISSUE 16 acceptance: once a bench records the read_storm block,
+    the read-path lineage must show (a) a nonzero follower-served
+    fraction with the staleness bound honored on every read and
+    payloads bit-identical to the leader's, (b) zero per-key loss and
+    zero drops under coalescing in the fan-out burst (with the fold
+    actually engaging), and (c) columnar list payloads strictly smaller
+    than row-wise — STRUCTURAL keys only, load-insensitive."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    rs = latest.get("read_storm")
+    if isinstance(rs, dict) and "error" in rs:
+        pytest.fail(f"BENCH_r{latest_round:02d}: read-storm lineage "
+                    f"run crashed: {rs['error']}")
+    if not isinstance(rs, dict) or "follower_served_frac" not in rs:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the "
+                    f"read-storm lineage")
+    assert rs["follower_served_frac"] > 0, (
+        f"BENCH_r{latest_round:02d}: every read landed on the leader — "
+        f"stale reads never scaled out")
+    assert rs.get("max_stale_index_honored") is True, (
+        f"BENCH_r{latest_round:02d}: a bounded stale read answered "
+        f"below its max_stale_index")
+    assert rs.get("stale_bit_identical") is True, (
+        f"BENCH_r{latest_round:02d}: follower stale payloads diverged "
+        f"from the leader's at the same index")
+    fanout = rs.get("fanout", {})
+    assert fanout.get("lost_keys", 1) == 0, (
+        f"BENCH_r{latest_round:02d}: coalescing lost the latest state "
+        f"of {fanout.get('lost_keys')} key(s) — the per-key zero-loss "
+        f"contract is broken")
+    assert fanout.get("coalesced_batches", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: the fan-out burst never engaged "
+        f"coalescing — the lineage proved nothing")
+    assert fanout.get("dropped_subscribers", 0) == 0, (
+        f"BENCH_r{latest_round:02d}: a subscriber dropped under a "
+        f"coalescible burst — drop must stay the LAST rung")
+    col = rs.get("columnar", {})
+    assert col.get("columnar_bytes", 1) < col.get("row_bytes", 0), (
+        f"BENCH_r{latest_round:02d}: columnar encoding "
+        f"({col.get('columnar_bytes')}B) is not smaller than row-wise "
+        f"({col.get('row_bytes')}B)")
+
+
 def test_explain_overhead_gate():
     """ISSUE 11 acceptance: once a bench records the `explain` block,
     the placement-explain byproduct (per-solve fixed-shape reduce +

@@ -561,7 +561,8 @@ def test_rule_catalog_is_complete():
     ids = {r.id for r in all_rules()}
     assert {"JIT001", "JIT002", "LOCK001", "DET001", "DET002",
             "EXC001", "PERF001", "LEAD001", "OBS001", "OBS002",
-            "QUEUE001", "SHARD001", "MESH001", "SYNC001"} <= ids
+            "QUEUE001", "SHARD001", "MESH001", "SYNC001",
+            "READ001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -1339,3 +1340,74 @@ def test_sync001_inline_suppression_at_the_seam():
         "  # nomadlint: disable=SYNC001 — the designated seam")
     assert rule_ids(src, path="solver/placer.py") == \
         ["SYNC001"] * 2
+
+
+# ---------------------------------------------------------------- READ001
+
+READ001_BAD = """
+    import time
+
+    def long_poll(self, min_index, deadline):
+        while True:
+            if self.state.latest_index() > min_index or \\
+                    time.time() >= deadline:
+                return self.state.snapshot()
+            self.state.block_min_index(min_index, timeout=0.5)
+"""
+
+
+def test_read001_fires_on_store_poll_loop():
+    out = findings(READ001_BAD, path="server/some_endpoint.py")
+    assert [f.rule for f in out] == ["READ001"]
+    assert "wait_for_index" in out[0].message
+    # the agent HTTP layer is patrolled too
+    assert rule_ids(READ001_BAD, path="agent/http.py") == ["READ001"]
+    # a snapshot_min_index retry loop is the same shape
+    assert rule_ids(READ001_BAD.replace("block_min_index",
+                                        "snapshot_min_index"),
+                    path="server/some_endpoint.py") == ["READ001"]
+
+
+def test_read001_scope_and_exemptions():
+    # the store's own condvar (/state/) and the broker (the parking
+    # primitive itself) are out of scope
+    assert rule_ids(READ001_BAD, path="state/store.py") == []
+    assert rule_ids(READ001_BAD, path="server/event_broker.py") == []
+    # a one-shot bounded wait outside a loop is not a poll loop
+    one_shot = """
+        def fetch(self, min_index):
+            snap = self.state.snapshot_min_index(min_index, timeout=5.0)
+            return snap
+    """
+    assert rule_ids(one_shot, path="server/some_endpoint.py") == []
+    # parking on the broker is the blessed shape
+    parked = """
+        import time
+
+        def long_poll(self, min_index, deadline):
+            seen = min_index
+            while time.time() < deadline:
+                if self.state.latest_index() > min_index:
+                    return self.state.snapshot()
+                seen = self.event_broker.wait_for_index(
+                    ("Allocation",), seen, timeout=0.5)
+    """
+    assert rule_ids(parked, path="server/some_endpoint.py") == []
+    # a loop in an OUTER function does not taint a helper's one-shot wait
+    nested = """
+        def outer(self, items):
+            for it in items:
+                self.handle(it)
+
+        def handle(self, it):
+            return self.state.snapshot_min_index(it.index, timeout=5.0)
+    """
+    assert rule_ids(nested, path="server/some_endpoint.py") == []
+
+
+def test_read001_inline_suppression():
+    src = READ001_BAD.replace(
+        "self.state.block_min_index(min_index, timeout=0.5)",
+        "self.state.block_min_index(min_index, timeout=0.5)"
+        "  # nomadlint: disable=READ001 — no event topic covers this")
+    assert rule_ids(src, path="server/some_endpoint.py") == []

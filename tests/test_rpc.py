@@ -158,6 +158,13 @@ class TestTLS:
 
     @pytest.fixture()
     def tls_dir(self, tmp_path):
+        # every TestTLS test consumes this fixture, so a box without the
+        # cryptography package records clean skips instead of setup
+        # errors (--continue-on-collection-errors is no longer
+        # load-bearing for the tier-1 run)
+        pytest.importorskip(
+            "cryptography",
+            reason="TLS tests need the optional cryptography package")
         from nomad_tpu.tlsutil import TLSConfig, generate_ca, generate_cert
         d = str(tmp_path)
         ca, cakey = generate_ca(d)
