@@ -80,12 +80,50 @@ def _scan_imports(tree: ast.AST) -> dict:
     return out
 
 
-def _scan_suppressions(text: str) -> dict:
-    """line number -> set of rule ids disabled there. A comment with code
-    before it on the line applies to that line; a standalone comment line
-    applies to itself AND the next line (for statements too long to carry
-    the marker inline)."""
+@dataclasses.dataclass(frozen=True)
+class SuppressionComment:
+    """One `# nomadlint: ...` comment as written, for hygiene checks
+    (LINT000): the raw text, the rule ids it names, whether any prose
+    justification surrounds the marker, and whether the marker parsed
+    at all (`malformed` = mentions nomadlint+disable but no rule list
+    matched)."""
+    line: int
+    text: str
+    rules: tuple = ()
+    justified: bool = False
+    malformed: bool = False
+
+
+def _has_prose(s: str) -> bool:
+    """True when `s` contains justification text beyond comment
+    punctuation (hash marks, dashes, separators)."""
+    return bool(re.sub(r"[#\s—–\-:,.;]+", "", s))
+
+
+def _suppression_comment(line: int, text: str):
+    """-> SuppressionComment for a comment mentioning nomadlint, else
+    None. A justification may sit before the marker or after the rule
+    list (`# why — nomadlint: disable=X` / `# nomadlint: disable=X — why`)."""
+    if "nomadlint" not in text:
+        return None
+    m = _SUPPRESS_RE.search(text)
+    if not m:
+        if "disable" in text:
+            return SuppressionComment(line, text, malformed=True)
+        return None
+    rules = tuple(sorted({r.strip() for r in m.group(1).split(",")}))
+    justified = _has_prose(text[:m.start()]) or _has_prose(text[m.end():])
+    return SuppressionComment(line, text, rules=rules, justified=justified)
+
+
+def _scan_suppressions(text: str) -> tuple:
+    """-> (line number -> set of rule ids disabled there,
+           [SuppressionComment records for LINT000]).
+    A comment with code before it on the line applies to that line; a
+    standalone comment line applies to itself AND the next line (for
+    statements too long to carry the marker inline)."""
     out: dict[int, set] = {}
+    records: list = []
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
     except (tokenize.TokenError, SyntaxError, IndentationError):
@@ -94,24 +132,31 @@ def _scan_suppressions(text: str) -> dict:
         for tok in tokens:
             if tok.type != tokenize.COMMENT:
                 continue
-            m = _SUPPRESS_RE.search(tok.string)
-            if not m:
+            rec = _suppression_comment(tok.start[0], tok.string)
+            if rec is None:
                 continue
-            rules = {r.strip() for r in m.group(1).split(",")}
+            records.append(rec)
+            if not rec.rules:
+                continue
             line = tok.start[0]
-            out.setdefault(line, set()).update(rules)
+            out.setdefault(line, set()).update(rec.rules)
             if tok.line.strip().startswith("#"):        # standalone comment
-                out.setdefault(line + 1, set()).update(rules)
-        return out
+                out.setdefault(line + 1, set()).update(rec.rules)
+        return out, records
     # tokenizer refused the file (it still parsed somehow): raw-line scan
     for i, raw in enumerate(text.splitlines(), 1):
-        m = _SUPPRESS_RE.search(raw)
-        if m:
-            rules = {r.strip() for r in m.group(1).split(",")}
-            out.setdefault(i, set()).update(rules)
-            if raw.strip().startswith("#"):
-                out.setdefault(i + 1, set()).update(rules)
-    return out
+        if "#" not in raw:
+            continue
+        rec = _suppression_comment(i, raw[raw.index("#"):])
+        if rec is None:
+            continue
+        records.append(rec)
+        if not rec.rules:
+            continue
+        out.setdefault(i, set()).update(rec.rules)
+        if raw.strip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rec.rules)
+    return out, records
 
 
 class SourceModule:
@@ -127,7 +172,7 @@ class SourceModule:
         self.lines = text.splitlines()
         self.tree = ast.parse(text)
         self.imports = _scan_imports(self.tree)
-        self._suppressed = _scan_suppressions(text)
+        self._suppressed, self.suppression_comments = _scan_suppressions(text)
         self._parent: dict[int, ast.AST] = {}
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
@@ -156,6 +201,18 @@ class SourceModule:
             parts.append(self.imports.get(node.id, node.id))
             return ".".join(reversed(parts))
         return None
+
+    @property
+    def modname(self) -> str:
+        """Approximate dotted module name derived from match_path
+        ("nomad_tpu/server/raft.py" -> "nomad_tpu.server.raft") — the
+        namespace the ProjectIndex files this module's defs under."""
+        mp = self.match_path
+        if mp.endswith(".py"):
+            mp = mp[:-3]
+        if mp.endswith("/__init__"):
+            mp = mp[:-len("/__init__")]
+        return mp.strip("/").replace("/", ".")
 
     # ------------------------------------------------------------- findings
 
@@ -198,6 +255,22 @@ class Rule:
         return any(m in p for m in self.path_markers)
 
     def check(self, mod: SourceModule) -> list:
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """A whole-program rule: runs once per analysis over the memoized
+    ProjectIndex (pass 2) instead of once per file. Findings may land on
+    any scanned module (inline suppressions still apply, looked up
+    through the index) or on a docs file (baseline-only suppression).
+    `path_markers`/`applies_to` are not consulted — scope inside
+    `check_project` against `mod.match_path` so cross-module findings
+    stay possible."""
+
+    def check(self, mod: SourceModule) -> list:   # pragma: no cover
+        return []                                 # driver never calls this
+
+    def check_project(self, index) -> list:
         raise NotImplementedError
 
 
@@ -265,19 +338,48 @@ class Baseline:
 
 # ------------------------------------------------------------------ driver
 
-def analyze_source(text: str, path: str = "<string>",
-                   rules: Optional[list] = None,
-                   match_path: str = "") -> list:
-    """Findings for one source text, inline suppressions already applied
-    (the baseline is the caller's concern)."""
-    mod = SourceModule(path, text, match_path=match_path)
+def _run_file_rules(mod: SourceModule, rules: Optional[list]) -> list:
     out = []
     for rule in (rules if rules is not None else all_rules()):
-        if not rule.applies_to(mod):
+        if isinstance(rule, ProjectRule) or not rule.applies_to(mod):
             continue
         for f in rule.check(mod):
             if not mod.suppressed(f.rule, f.line):
                 out.append(f)
+    return out
+
+
+def _run_project_rules(mods: list, scan_paths: Iterable[str],
+                       rules: Optional[list]) -> list:
+    """Pass 2: build the ProjectIndex ONCE over every parsed module and
+    run each ProjectRule against it. Inline suppressions on scanned
+    modules still win; findings on non-module paths (docs tables) can
+    only be baselined."""
+    project_rules = [r for r in (rules if rules is not None else all_rules())
+                     if isinstance(r, ProjectRule)]
+    if not project_rules or not mods:
+        return []
+    from .project import ProjectIndex             # deferred: import cycle
+    index = ProjectIndex(mods, scan_paths)
+    out = []
+    for rule in project_rules:
+        for f in rule.check_project(index):
+            mod = index.module_by_path.get(f.path)
+            if mod is None or not mod.suppressed(f.rule, f.line):
+                out.append(f)
+    return out
+
+
+def analyze_source(text: str, path: str = "<string>",
+                   rules: Optional[list] = None,
+                   match_path: str = "") -> list:
+    """Findings for one source text, inline suppressions already applied
+    (the baseline is the caller's concern). Project rules run over a
+    single-module index with NO docs discovery — LOCK002/LOCK003
+    fixtures work standalone, registry-drift rules need a real tree."""
+    mod = SourceModule(path, text, match_path=match_path)
+    out = _run_file_rules(mod, rules)
+    out.extend(_run_project_rules([mod], (), rules))
     out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return out
 
@@ -305,11 +407,16 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[tuple]:
 
 
 def analyze_paths(paths: Iterable[str],
-                  rules: Optional[list] = None) -> tuple:
+                  rules: Optional[list] = None,
+                  project: bool = True) -> tuple:
     """-> (findings, errors): errors are (path, message) pairs for files
-    that failed to parse — reported, never silently skipped."""
+    that failed to parse — reported, never silently skipped. Two passes:
+    per-file rules as each module parses, then (unless `project=False`,
+    the `--changed` fast path) the ProjectRule family over one shared
+    ProjectIndex of every module that parsed."""
     findings: list = []
     errors: list = []
+    mods: list = []
     paths = list(paths)
     for p in paths:
         # a mistyped/cwd-relative path must not greenlight by scanning
@@ -320,8 +427,13 @@ def analyze_paths(paths: Iterable[str],
         try:
             with open(path, encoding="utf-8") as fh:
                 text = fh.read()
-            findings.extend(analyze_source(text, path=path, rules=rules,
-                                           match_path=match_path))
+            mod = SourceModule(path, text, match_path=match_path)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append((path, f"{type(e).__name__}: {e}"))
+            continue
+        mods.append(mod)
+        findings.extend(_run_file_rules(mod, rules))
+    if project:
+        findings.extend(_run_project_rules(mods, paths, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, errors

@@ -112,6 +112,8 @@ class ACLEndpoint:
                     "ACL bootstrap already done")
             token = ACLToken.new(name="Bootstrap Token",
                                  type=TOKEN_TYPE_MANAGEMENT, global_=True)
+            # one-shot cold path; the lock exists to serialize exactly
+            # this apply against racers — nomadlint: disable=LOCK003
             self.server.raft.apply(ACL_TOKEN_BOOTSTRAP, {"tokens": [token]})
         return token
 

@@ -967,6 +967,8 @@ class RaftNode:
                 self._apply_cond.notify_all()
                 if len(self.log) >= self.snapshot_threshold:
                     try:
+                        # compaction must be atomic with log state;
+                        # audited ISSUE 13 — nomadlint: disable=LOCK003
                         self._compact_locked()
                     except Exception as ex:   # noqa: BLE001
                         # a failed compaction must not kill the applier:
@@ -1006,6 +1008,8 @@ class RaftNode:
             # atomic manifest replace) — the old persist-snapshot-then-
             # rewrite-log pair left a crash window in which an
             # index-less stale log shadowed the new snapshot (ISSUE 13)
+            # raft persists before acking; the disk commit IS the state
+            # transition, by design — nomadlint: disable=LOCK003
             self._durable.commit_generation(
                 self._snapshot_doc(data),
                 [(e.term, e.type, e.payload) for e in self.log],
@@ -1100,6 +1104,8 @@ class RaftNode:
             persist_ok = True
             try:
                 if truncated:
+                    # replication ack only after the truncated log is
+                    # durable (raft safety) — nomadlint: disable=LOCK003
                     self._rewrite_log_on_disk()
                 elif appended:
                     self._append_to_disk(appended)
@@ -1165,6 +1171,8 @@ class RaftNode:
                     else dict(self._base_peers)
                 nonvoters = set(snap.get("nonvoters", ())) \
                     if snap.get("peers") else set(self._base_nonvoters)
+                # an installed snapshot must be durable before the node
+                # acks it (raft safety) — nomadlint: disable=LOCK003
                 self._durable.commit_generation(
                     {"index": snap["index"], "term": snap["term"],
                      "data": snap["data"], "peers": peers,

@@ -766,6 +766,8 @@ class Server:
                 if self.raft_node is not None else 0)
             try:
                 with trace.use(root):
+                    # establishment is exclusive by design; the lock
+                    # serializes it — nomadlint: disable=LOCK003
                     self._establish_leadership_locked()
             except BaseException as e:
                 root.end("error", error=repr(e)[:200])
@@ -820,12 +822,16 @@ class Server:
                 # every leader subsystem permanently disabled
                 self.logger(f"server: leadership barrier error, "
                             f"retrying: {e!r}")
+                # barrier retry backoff; nothing else contends this
+                # lock while establishing — nomadlint: disable=LOCK003
                 time.sleep(0.05)
         timings["barrier"] = time.perf_counter() - t0
         metrics.add_sample("nomad.leader.establish.barrier",
                            timings["barrier"])
         trace.record_span("leader.establish.barrier", None, t0)
 
+        # step retries back off under the establish lock on purpose
+        # (revoke waits for a clean stop) — nomadlint: disable=LOCK003
         ok = (self._establish_step("plan_queue", self._step_plan_queue,
                                    timings)
               and self._establish_step("state_cache", self._step_state_cache,

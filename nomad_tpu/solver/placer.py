@@ -1669,7 +1669,7 @@ class SolverPlacer:
             # the stack's ranked task_resources genuinely vary per option
             # (penalized nodes, assigned ports) so the wrapper is
             # per-alloc; the disk-only shared row is pooled
-            # nomadlint: disable=PERF001
+            # nomadlint: disable=PERF001 — wrapper differs per alloc
             resources = AllocatedResources(
                 tasks=dict(option.task_resources),
                 shared=option.alloc_resources or

@@ -447,6 +447,8 @@ class TensorCache:
 
                     from .sharding import _launch_lock
                     with _launch_lock:      # old-generation gather
+                        # audited: evacuation is stop-the-world behind
+                        # the rendezvous — nomadlint: disable=LOCK003
                         got = np.asarray(jax.device_get(old_used))
                     n = self.used.shape[0]
                     salvaged = got[:n].tobytes() == self.used.tobytes()

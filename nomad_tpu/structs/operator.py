@@ -33,6 +33,20 @@ class SchedulerConfiguration:
     stored config, and every eval reads the latest copy through its
     EvalContext — no restart, no cache to bust.
 
+      preemption_config       per-scheduler-kind preemption switches
+                              (system/sysbatch/batch/service) the
+                              planner consults before evicting victims.
+      memory_oversubscription_enabled
+                              allow tasks to exceed their memory reserve
+                              up to the node max (ref behavior); off =
+                              reserve is the hard cap at placement time.
+      reject_job_registration drain valve: refuse new job registrations
+                              (writes) while the cluster sheds load —
+                              reads and in-flight work are untouched.
+      pause_eval_broker       stop the broker handing evals to workers
+                              (dequeue returns empty); enqueued work
+                              parks until unpaused. Operator brownout
+                              lever, not a data-path state.
       plan_pipeline_enabled   pipelined plan lifecycle: chunk the solve,
                               dispatch chunk N+1 on the accelerator while
                               the host materializes/evaluates/commits

@@ -673,3 +673,38 @@ def test_explain_overhead_gate():
     assert block.get("errors", 0) == 0, (
         f"BENCH_r{latest_round:02d}: {block['errors']} explain "
         f"reductions swallowed errors during the bench")
+
+
+def test_lint_gate():
+    """ISSUE 17 acceptance: once a bench records the `lint` block, the
+    tree must have been finding-free at bench time (zero active
+    findings — everything fixed, inline-suppressed with a reason, or
+    baselined) and the whole-program two-pass scan must stay inside
+    tier-1's budget (<30s: the ProjectIndex is built once and memoized
+    across LOCK002/LOCK003/REG001/REG002)."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    block = latest.get("lint")
+    if block is None:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates this gate")
+    if "error" in block:
+        pytest.fail(
+            f"BENCH_r{latest_round:02d}: lint bench errored instead of "
+            f"recording: {block['error']}")
+    assert block["active_findings"] == 0, (
+        f"BENCH_r{latest_round:02d}: {block['active_findings']} active "
+        f"nomadlint finding(s) at bench time — fix, suppress with a "
+        f"justification, or baseline with a reason")
+    assert block.get("exit_status", 0) == 0, (
+        f"BENCH_r{latest_round:02d}: nomadlint exited "
+        f"{block['exit_status']} (parse errors?)")
+    assert block["scan_seconds"] < 30.0, (
+        f"BENCH_r{latest_round:02d}: full-tree scan took "
+        f"{block['scan_seconds']}s — the whole-program pass fell out "
+        f"of tier-1's budget")
+    assert block["files_scanned"] > 100 and block["rules"] >= 20, (
+        f"BENCH_r{latest_round:02d}: lint block scanned "
+        f"{block['files_scanned']} files with {block['rules']} rules — "
+        f"the scan measured a stub tree")
