@@ -1044,6 +1044,297 @@ def _read_storm_run() -> dict:
             sv.shutdown()
 
 
+def _partition_chaos_run() -> dict:
+    """Partition-chaos lineage (ISSUE 18, docs/PARTITIONS.md): a seeded
+    3-server virtual cluster plus live write/heartbeat clients walk
+    leader isolation -> asymmetric drops (including reply loss) -> link
+    flaps -> heal, with all protocol TIMING (election timeouts, TTLs,
+    retry backoff) on a shared ManualClock pumped at a fixed rate so the
+    phase schedule is virtual-time, not wall-clock. STRUCTURAL gates
+    only: zero double-applied writes (no dedup token committed twice),
+    zero lost acked writes (every ack is in the replicated dedup table),
+    zero heartbeat invalidations while the drop phase is live, bounded
+    post-heal reconvergence in virtual seconds, and a committed state
+    identical to a same-seed run with no faults at all."""
+    import tempfile
+    from collections import Counter
+
+    from nomad_tpu import faults, mock
+    from nomad_tpu.chrono import ManualClock
+    from nomad_tpu.client import Client
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.rpc.retry import RetryPolicy
+    from nomad_tpu.rpc.virtual import VirtualNetwork
+    from nomad_tpu.server import Server
+
+    SEED = int(os.environ.get("NOMAD_CHAOS_PARTITION_SEED", "18"))
+    # virtual seconds the lossy phase must dwell: longer than a full
+    # heartbeat TTL (10-15 virtual s) so "zero invalidations" proves the
+    # retry ladder kept TTLs alive, not that the phase was too short
+    DROP_DWELL_VS = float(os.environ.get("NOMAD_CHAOS_DROP_DWELL", "18.0"))
+
+    def run_cluster(chaotic: bool) -> dict:
+        clock = ManualClock()
+        net = VirtualNetwork(seed=SEED, clock=clock)
+        servers, stop = [], threading.Event()
+
+        def pump():
+            # ~5x real time: fast enough that TTL/backoff sleeps resolve
+            # quickly, slow enough that the raft loops' REAL-time
+            # cadences (heartbeat sender ~0.08s, reaper sweep 1s) stay
+            # far inside the virtual election/TTL windows
+            while not stop.is_set():
+                clock.advance(0.01)
+                time.sleep(0.002)
+
+        pumper = threading.Thread(target=pump, daemon=True,
+                                  name="chaos-clock-pump")
+        pumper.start()
+        hb_thread = None
+        try:
+            for i in range(3):
+                sv = Server(num_workers=0, gc_interval=9999)
+                sv.rpc_listen_virtual(net, f"p{i}")
+                servers.append(sv)
+            peers = {f"p{i}": sv.rpc_addr for i, sv in enumerate(servers)}
+            for i, sv in enumerate(servers):
+                # election timeout in VIRTUAL seconds: the leader's
+                # real-time heartbeat cadence (0.08s real ~ 0.4 virtual)
+                # must fit many times inside it
+                sv.enable_raft(f"p{i}", peers, election_timeout=(6.0, 12.0),
+                               heartbeat_interval=0.08, clock=clock,
+                               seed=SEED * 1000 + i)
+                sv.heartbeats.clock = clock
+                sv.start()
+
+            def stable_leader(pool, timeout=45.0):
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    led = [s for s in pool
+                           if s.raft_node.is_leader() and s.is_leader]
+                    if len(led) == 1:
+                        return led[0]
+                    time.sleep(0.005)
+                raise RuntimeError("partition chaos: no stable leader")
+
+            leader = stable_leader(servers)
+            addrs = [sv.rpc_addr for sv in servers]
+            base_invalidate = metrics.counter("nomad.heartbeat.invalidate")
+
+            # ---- live clients: two writers (every op flips node status,
+            # so each acked write is one raft apply) + one heartbeater
+            writers, acked, minted = {}, [], {}
+            for w in ("w0", "w1"):
+                writers[w] = net.client(
+                    addrs, src=w, client_id=w, timeout=10.0,
+                    retry=RetryPolicy(max_attempts=6, base_s=0.02,
+                                      seed=SEED, clock=clock))
+                minted[w] = 0
+
+            def write(w, method, *a):
+                # token accounting mirrors RpcClient: one req_id per
+                # logical write, acked only when the call returns
+                minted[w] += 1
+                tok = f"{w}:{minted[w]}"
+                writers[w].call_write(method, *a)
+                acked.append(tok)
+
+            flips = {"w0": 0, "w1": 0}
+
+            def flip(w):
+                flips[w] += 1
+                write(w, "Node.UpdateStatus", f"chaos-{w}",
+                      "down" if flips[w] % 2 else "ready")
+
+            for w in ("w0", "w1"):
+                node = mock.node()
+                node.id = f"chaos-{w}"
+                write(w, "Node.Register", node)
+
+            hb_rpc = net.client(
+                addrs, src="hb0", client_id="hb0", timeout=10.0,
+                retry=RetryPolicy(max_attempts=3, base_s=0.02,
+                                  seed=SEED + 1, clock=clock))
+
+            class _HbRpc:
+                # the Client duck-type over the virtual transport:
+                # mutating verbs ride call_write (same dedup token on
+                # every retry), reads ride call
+                def node_register(self, node):
+                    return hb_rpc.call_write("Node.Register", node)
+
+                def node_update_status(self, node_id, status):
+                    return hb_rpc.call_write("Node.UpdateStatus",
+                                             node_id, status)
+
+                def node_get_client_allocs(self, node_id, min_index=0,
+                                           timeout=30.0):
+                    return hb_rpc.call_timeout(
+                        timeout + 15.0, "Node.GetClientAllocs", node_id,
+                        min_index=min_index, timeout=timeout)
+
+            hb_client = Client(_HbRpc(), data_dir=tempfile.mkdtemp(
+                prefix="nomad-chaos-hb-"), clock=clock, seed=SEED)
+            hb_client.node.id = "chaos-hb0"
+            ttl = _HbRpc().node_register(hb_client.node)["heartbeat_ttl"]
+            hb_client._heartbeat_ttl = ttl
+
+            def hb_loop():
+                # the bench drives the beat cadence on the VIRTUAL clock
+                # (Client's own loop waits real time); each beat runs the
+                # full _heartbeat_once retry ladder
+                while not stop.is_set():
+                    hb_client._heartbeat_once()
+                    until = clock.monotonic() + hb_client._heartbeat_ttl / 3
+                    while not stop.is_set() and clock.monotonic() < until:
+                        time.sleep(0.002)
+
+            hb_thread = threading.Thread(target=hb_loop, daemon=True,
+                                         name="chaos-hb")
+            hb_thread.start()
+
+            def dwell(virtual_s):
+                until = clock.monotonic() + virtual_s
+                deadline = time.time() + 60.0
+                while clock.monotonic() < until and time.time() < deadline:
+                    time.sleep(0.002)
+
+            # ---- phase 1: baseline writes on the healthy cluster
+            for _ in range(2):
+                flip("w0")
+                flip("w1")
+            dwell(2.0)
+
+            # ---- phase 2: leader isolation; writers fail over
+            if chaotic:
+                net.isolate(leader.raft_node.node_id)
+                stable_leader([s for s in servers if s is not leader])
+            flip("w0")
+            flip("w1")
+
+            # ---- phase 3: asymmetric drops + seeded reply loss on the
+            # client links (request direction via net.drop, reply
+            # direction via the recv fault site — the double-apply trap).
+            # Writer flips INTERLEAVE with the dwell: a flip rides the
+            # heartbeat path server-side, so writing through the loss is
+            # also what keeps the writers' TTLs alive — the zero-
+            # invalidation gate proves the retry ladder carried every
+            # beat, not that the phase was too short to expire one
+            hb_base = metrics.counter("nomad.heartbeat.invalidate")
+            if chaotic:
+                net.heal()
+                for src in ("w0", "w1", "hb0"):
+                    for i in range(3):
+                        net.drop(src, f"p{i}", 0.25)
+                faults.install({
+                    f"raft.transport.recv.{src}.p{i}":
+                        {"mode": "probability", "p": 0.15,
+                         "seed": SEED + 7}
+                    for src in ("w0", "w1", "hb0") for i in range(3)})
+            for _ in range(3):
+                flip("w0")
+                flip("w1")
+                dwell(DROP_DWELL_VS / 3)
+            hb_invalidations = int(
+                metrics.counter("nomad.heartbeat.invalidate") - hb_base)
+            faults.clear()
+
+            # ---- phase 4: flap the w0 links AND isolate one follower
+            # (quorum holds at 2/3), so the heal has real catch-up to do
+            if chaotic:
+                net.heal()
+                lagger = next(s for s in servers
+                              if not s.raft_node.is_leader())
+                for i in range(3):
+                    net.flap("w0", f"p{i}", 2.0)
+                net.isolate(lagger.raft_node.node_id)
+            flip("w0")
+            flip("w1")
+
+            # ---- phase 5: heal, measure reconvergence in virtual time
+            # (single established leader + every server at one index)
+            net.heal()
+            heal_t = clock.monotonic()
+            deadline = time.time() + 60.0
+            reconverged = None
+            while time.time() < deadline:
+                led = [s for s in servers
+                       if s.raft_node.is_leader() and s.is_leader]
+                if len(led) == 1 and len({
+                        s.state.latest_index() for s in servers}) == 1:
+                    reconverged = clock.monotonic() - heal_t
+                    break
+                time.sleep(0.005)
+            leader = stable_leader(servers)
+
+            # ---- phase 6: the healed cluster still commits; let the
+            # final flips replicate so the cross-server log audit
+            # compares settled logs, not a replication race
+            flip("w0")
+            flip("w1")
+            deadline = time.time() + 30.0
+            while time.time() < deadline and len({
+                    s.state.latest_index() for s in servers}) != 1:
+                time.sleep(0.005)
+
+            # ---- audits on the converged logs
+            def tokens(sv):
+                return [e.payload["_dedup"] for e in sv.raft_node.log
+                        if isinstance(e.payload, dict)
+                        and "_dedup" in e.payload]
+
+            toks = tokens(leader)
+            writer_acked = [t for t in acked
+                            if t.startswith(("w0:", "w1:"))]
+            lost = [t for t in writer_acked
+                    if leader.state.rpc_dedup_get(t) is None]
+            return {
+                "lost_tokens": lost,
+                "lost_in_log": [t for t in lost if t in toks],
+                "hb_invalidations_total": int(
+                    metrics.counter("nomad.heartbeat.invalidate")
+                    - base_invalidate),
+                "acked_writes": len(acked),
+                "writer_acked": len(writer_acked),
+                "double_applied_writes": sum(
+                    c - 1 for c in Counter(toks).values() if c > 1),
+                "lost_acked_writes": len(lost),
+                "heartbeat_invalidations": hb_invalidations,
+                "reconverge_virtual_s": round(reconverged, 3)
+                if reconverged is not None else None,
+                "reconverged": reconverged is not None,
+                "token_logs_identical": len({
+                    tuple(tokens(sv)) for sv in servers}) == 1,
+                "view": {
+                    "nodes": {w: leader.state.node_by_id(
+                        f"chaos-{w}").status for w in ("w0", "w1")},
+                    "writer_tokens": sorted(
+                        t for t in toks if t.startswith(("w0:", "w1:"))),
+                },
+            }
+        finally:
+            faults.clear()
+            stop.set()
+            if hb_thread is not None:
+                hb_thread.join(5.0)
+            for sv in servers:
+                sv.shutdown()
+            pumper.join(5.0)
+
+    chaos = run_cluster(chaotic=True)
+    oracle = run_cluster(chaotic=False)
+    view = chaos.pop("view")
+    oracle_view = oracle.pop("view")
+    return {
+        **chaos,
+        "oracle_acked_writes": oracle["acked_writes"],
+        # the differential twin of the placement-determinism gate: once
+        # healed, the committed writer state (statuses + the exact token
+        # set) is bit-identical to the same-seed run with no faults
+        "state_identical_to_oracle": view == oracle_view,
+    }
+
+
 def _crash_recovery_run() -> dict:
     """Crash-recovery lineage (ISSUE 13, docs/DURABILITY.md): the raft
     WAL's durability/throughput envelope on this box.
@@ -1986,6 +2277,15 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         read_storm = {"error": repr(e)[:200]}
 
+    # partition-chaos lineage (ISSUE 18): seeded isolation/drop/flap/heal
+    # phases on a ManualClock — exactly-once writes through reply loss,
+    # live TTLs through the drop phase, bounded reconvergence, and the
+    # faulty-vs-clean same-seed state differential; gated once recorded
+    try:
+        partition_chaos = _partition_chaos_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        partition_chaos = {"error": repr(e)[:200]}
+
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
     # tests/test_bench_regression.py once recorded
@@ -2077,6 +2377,9 @@ def main() -> None:
         # ISSUE 16: read-path scale-out (follower stale reads, fan-out
         # coalescing zero-loss, columnar list codec byte ratio)
         "read_storm": read_storm,
+        # ISSUE 18: partition-tolerant RPC plane (exactly-once writes
+        # through reply loss, heartbeats through drops, reconvergence)
+        "partition_chaos": partition_chaos,
         # ISSUE 17: whole-program nomadlint (LOCK002/LOCK003/REG001/
         # REG002) — structural keys only, gated by test_lint_gate
         "lint": lint,
@@ -2440,6 +2743,11 @@ if __name__ == "__main__":
         # + fan-out coalescing + columnar byte ratio;
         # NOMAD_READ_STORM_{JOBS,READS} resize
         print(json.dumps(_read_storm_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--partition-chaos":
+        # standalone partition-chaos lineage (ISSUE 18): seeded
+        # isolation/drop/flap/heal phases on a ManualClock;
+        # NOMAD_CHAOS_PARTITION_SEED / NOMAD_CHAOS_DROP_DWELL resize
+        print(json.dumps(_partition_chaos_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-probe":
         warm_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--failover-probe":

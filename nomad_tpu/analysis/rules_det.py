@@ -36,8 +36,12 @@ class DecisionPathNondeterminism(Rule):
     # decision there reads the injectable chrono.Clock and the TTL jitter
     # draws from a seeded per-instance Random, so ManualClock storm tests
     # replay bit-identically — a wall-clock or global-RNG regression
-    # would silently de-determinize the mass-failure suite
-    path_markers = ("/scheduler/", "/solver/", "/server/heartbeat.py")
+    # would silently de-determinize the mass-failure suite.
+    # client/client.py joined with ISSUE 18: heartbeat bookkeeping and
+    # retry jitter ride the client's injectable clock + seeded rng so
+    # partition sims time-compress the disconnect/reconnect cycle
+    path_markers = ("/scheduler/", "/solver/", "/server/heartbeat.py",
+                    "/client/client.py")
 
     def check(self, mod: SourceModule) -> list:
         out = []

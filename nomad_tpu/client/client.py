@@ -9,11 +9,13 @@ node_get_client_allocs / node_update_allocs.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from typing import Optional
 
-from ..metrics import record_swallowed_error
+from .. import chrono
+from ..metrics import metrics, record_swallowed_error
 from ..structs import (
     Allocation, Node, ALLOC_DESIRED_STOP, NODE_STATUS_DOWN,
     NODE_STATUS_INIT, NODE_STATUS_READY, new_id,
@@ -28,9 +30,16 @@ class Client:
     def __init__(self, rpc, data_dir: str, datacenter: str = "dc1",
                  node_class: str = "", name: str = "",
                  drivers: Optional[dict[str, Driver]] = None,
-                 logger=None, plugin_dir: str = ""):
+                 logger=None, plugin_dir: str = "",
+                 clock: Optional[chrono.Clock] = None, seed: int = 0):
         self.rpc = rpc
         self.data_dir = data_dir
+        # heartbeat bookkeeping and retry jitter ride the injectable
+        # clock (ISSUE 18): partition sims time-compress the whole
+        # disconnect/reconnect cycle on a ManualClock; `seed` makes the
+        # retry jitter stream reproducible
+        self._clock = clock or chrono.REAL
+        self._hb_rng = random.Random(f"client-hb:{seed}:{name}")
         self.alloc_dir_root = os.path.join(data_dir, "allocs")
         self.logger = logger or (lambda msg: None)
         os.makedirs(self.alloc_dir_root, exist_ok=True)
@@ -104,8 +113,12 @@ class Client:
         # client has been unable to heartbeat for that long — the client
         # half of the server-side lost-alloc handling
         # (reconcile_util.delay_by_stop_after_client_disconnect)
-        self._last_heartbeat_ok = time.monotonic()
+        self._last_heartbeat_ok = self._clock.monotonic()
         self._shutdown = threading.Event()
+        # consecutive _watch_allocations failures; >0 marks a suspected
+        # partition, and the first successful poll after one triggers a
+        # full reconcile against the server's view (ISSUE 18)
+        self._watch_failures = 0
         self._dirty_allocs: set[str] = set()
         self._dirty_cond = threading.Condition()
         self._exec_sessions: dict[str, list] = {}  # sid -> [session, last]
@@ -198,11 +211,12 @@ class Client:
         # assigned the same dynamic ports immediately. ONE shared
         # deadline: many slow-dying tasks must not serialize into
         # minutes of shutdown
-        deadline = time.time() + 5.0
+        deadline = time.monotonic() + 5.0
         for ar in runners:
             for tr in list(ar.task_runners.values()):
                 try:
-                    tr.wait_done(timeout=max(0.0, deadline - time.time()))
+                    tr.wait_done(timeout=max(0.0,
+                                             deadline - time.monotonic()))
                 # shutdown path: a runner that outlives the shared
                 # deadline is logged by its own kill path; nothing to do
                 except Exception:  # nomadlint: disable=EXC001 — shutdown best-effort
@@ -230,30 +244,57 @@ class Client:
         except Exception as e:          # noqa: BLE001
             self.logger(f"client: ready update failed: {e!r}")
 
+    # a failed beat is retried this many times within ONE loop tick,
+    # after short seeded jitter — N dropped requests must not cost
+    # N * TTL/2 of silence and an invalidation (ISSUE 18)
+    HEARTBEAT_RETRIES = 3
+    HEARTBEAT_RETRY_JITTER_S = (0.1, 0.5)
+
+    def _heartbeat_once(self) -> bool:
+        """One heartbeat with bounded in-tick retries. Returns True when
+        a beat landed. Test-drivable without the loop thread."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(1 + self.HEARTBEAT_RETRIES):
+            if self._shutdown.is_set():
+                return False
+            if attempt:
+                metrics.incr("nomad.client.heartbeat_retries")
+                lo, hi = self.HEARTBEAT_RETRY_JITTER_S
+                self._clock.sleep(lo + (hi - lo) * self._hb_rng.random())
+            try:
+                resp = self.rpc.node_update_status(self.node.id,
+                                                   NODE_STATUS_READY)
+                self._heartbeat_ttl = resp.get("heartbeat_ttl",
+                                               self._heartbeat_ttl)
+                self._last_heartbeat_ok = self._clock.monotonic()
+                return True
+            except Exception as e:      # noqa: BLE001
+                last_exc = e
+                self.logger(f"client: heartbeat failed "
+                            f"(attempt {attempt + 1}): {e!r}")
+        # retries exhausted — re-register OUTSIDE the retry ladder: the
+        # server may have GC'd us. A silent re-register failure leaves
+        # the node invisibly dead (EXC001) — count + log it; the loop
+        # retries next tick
+        self.logger(f"client: heartbeat gave up after "
+                    f"{1 + self.HEARTBEAT_RETRIES} attempts: {last_exc!r}")
+        try:
+            self.rpc.node_register(self.node)
+            self.rpc.node_update_status(self.node.id, NODE_STATUS_READY)
+            self._last_heartbeat_ok = self._clock.monotonic()
+            return True
+        except Exception as e2:         # noqa: BLE001
+            record_swallowed_error("client.heartbeat.reregister",
+                                   e2, self.logger)
+            return False
+
     def _heartbeat_loop(self) -> None:
         # heartbeats go through UpdateStatus(ready), not a bare TTL reset,
         # so a node the server marked down transitions back to ready and
         # blocked evals unblock (ref client.go registerAndHeartbeat ->
         # Node.UpdateStatus)
         while not self._shutdown.wait(max(0.2, self._heartbeat_ttl / 2)):
-            try:
-                resp = self.rpc.node_update_status(self.node.id,
-                                                   NODE_STATUS_READY)
-                self._heartbeat_ttl = resp.get("heartbeat_ttl",
-                                               self._heartbeat_ttl)
-                self._last_heartbeat_ok = time.monotonic()
-            except Exception as e:      # noqa: BLE001
-                self.logger(f"client: heartbeat failed: {e!r}")
-                # re-register: the server may have GC'd us. A silent
-                # re-register failure leaves the node invisibly dead
-                # (EXC001) — count + log it; the loop retries next tick
-                try:
-                    self.rpc.node_register(self.node)
-                    self.rpc.node_update_status(self.node.id,
-                                                NODE_STATUS_READY)
-                except Exception as e2:     # noqa: BLE001
-                    record_swallowed_error("client.heartbeat.reregister",
-                                           e2, self.logger)
+            self._heartbeat_once()
 
     def _heartbeat_stop_loop(self) -> None:
         """Stop allocs locally after prolonged server disconnection (ref
@@ -263,7 +304,7 @@ class Client:
         it, and two live copies of (say) a singleton service is exactly
         what the knob exists to prevent."""
         while not self._shutdown.wait(1.0):
-            silence = time.monotonic() - self._last_heartbeat_ok
+            silence = self._clock.monotonic() - self._last_heartbeat_ok
             if silence <= self._heartbeat_ttl:
                 continue
             with self._lock:
@@ -288,19 +329,62 @@ class Client:
     # --------------------------------------------------------- alloc watch
 
     def _watch_allocations(self) -> None:
-        """Long-poll the server for alloc changes (ref client.go:2033)."""
+        """Long-poll the server for alloc changes (ref client.go:2033).
+
+        Reconnect reconciliation (ISSUE 18): after ANY poll failure the
+        next contact does a full `_reconcile_allocs()` instead of
+        resuming the incremental long-poll — during the outage the
+        server may have replaced/stopped allocs at indexes this client
+        never saw, and trusting `_last_alloc_index` would silently skip
+        them."""
         while not self._shutdown.is_set():
+            if self._watch_failures:
+                if self._reconcile_allocs():
+                    self._watch_failures = 0
+                else:
+                    self._shutdown.wait(1.0)
+                continue
             try:
                 resp = self.rpc.node_get_client_allocs(
                     self.node.id, min_index=self._last_alloc_index,
                     timeout=5.0)
             except Exception as e:      # noqa: BLE001
                 self.logger(f"client: watch allocs failed: {e!r}")
+                self._watch_failures += 1
                 self._shutdown.wait(1.0)
                 continue
             self._last_alloc_index = max(self._last_alloc_index,
                                          resp.get("index", 0))
             self._run_allocs(resp.get("allocs", {}))
+
+    def _reconcile_allocs(self) -> bool:
+        """Resync alloc state against the server's CURRENT view at a
+        known index (the heal half of a partition). timeout=0.0 makes
+        Node.GetClientAllocs return immediately with the full alloc map
+        + the server's index; `_run_allocs` then applies adds/updates
+        AND removals, and every surviving alloc is marked dirty so the
+        sync loop re-pushes client status the server may have missed.
+        Returns True once the resync landed."""
+        try:
+            resp = self.rpc.node_get_client_allocs(
+                self.node.id, min_index=0, timeout=0.0)
+        except Exception as e:          # noqa: BLE001
+            self.logger(f"client: reconcile failed: {e!r}")
+            return False
+        index = resp.get("index", 0)
+        self._run_allocs(resp.get("allocs", {}))
+        # adopt the server's index only AFTER the diff applied: a crash
+        # in between re-reconciles rather than skipping the window
+        self._last_alloc_index = max(self._last_alloc_index, index)
+        with self._lock:
+            survivors = list(self.alloc_runners)
+        with self._dirty_cond:
+            self._dirty_allocs.update(survivors)
+            self._dirty_cond.notify_all()
+        metrics.incr("nomad.client.reconnect_reconciles")
+        self.logger(f"client: reconciled {len(survivors)} allocs at "
+                    f"server index {index} after reconnect")
+        return True
 
     def _run_allocs(self, server_allocs: dict[str, int]) -> None:
         """Diff desired vs running (ref client.go:2263 runAllocs)."""
@@ -578,7 +662,9 @@ class Client:
                                 -1)
             if data or time.monotonic() >= deadline:
                 return data, offset + len(data)
-            time.sleep(0.1)
+            # local log-tail poll cadence (fs_stat reads the local disk),
+            # not an RPC retry backoff
+            time.sleep(0.1)  # nomadlint: disable=RPC001 — log-follow poll, no transport involved
 
     def fs_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
                 offset: int = 0, origin: str = "start",
@@ -609,6 +695,7 @@ class Client:
 
     def host_stats(self) -> dict:
         """ref client/stats/host.go HostStats"""
+        # nomadlint: disable=DET001 — capture timestamp, not a decision
         stats = {"Timestamp": time.time(), "CPUTicksConsumed": 0.0}
         try:
             load1, load5, load15 = os.getloadavg()

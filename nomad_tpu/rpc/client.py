@@ -1,18 +1,42 @@
-"""RPC client: pooled connections with server failover and leader redirect
-(ref helper/pool/pool.go ConnPool, client/servers/manager.go server registry,
-client/rpc.go RPC retry/failover).
+"""RPC client: pooled connections with server failover, leader redirect,
+bounded retry rounds with deadline propagation, and per-server breakers
+(ref helper/pool/pool.go ConnPool, client/servers/manager.go server
+registry, client/rpc.go RPC retry/failover + RPCHoldTimeout backoff).
+
+ISSUE 18 partition tolerance, three client-side pieces:
+
+  * every call computes an absolute `deadline` and stamps it into the
+    request envelope; each hop's socket timeout is the REMAINING budget
+    (never the full per-hop timeout again), and the server sheds work
+    whose deadline already passed (rpc/server.py);
+  * failed rounds over the failover list repeat up to
+    `RetryPolicy.max_attempts` times with seeded exponential backoff,
+    sleeping on the injectable clock (default policy is ONE round — the
+    legacy walk-once behavior — because framework-internal clients like
+    raft replication and leader forwarding carry their own retry
+    discipline; `ServerRpc` opts into 3 rounds);
+  * `RpcBreaker` short-circuits addresses that keep failing so a dead
+    server costs one cooldown instead of one connect-timeout per call.
+
+Idempotent writes (`call_write` / `_idempotent=True`) mint ONE dedup
+token before the retry loop; every internal retry carries the same
+token, so "applied but reply lost" resolves to the original result
+server-side instead of a double apply (rpc/dedup.py).
 """
 from __future__ import annotations
 
-import random
 import socket
 import threading
-import time
+import uuid
 from typing import Optional
 
+from .. import chrono
+from ..metrics import metrics
 from .codec import (
-    NotLeaderError, RateLimitError, RpcError, recv_msg, send_msg,
+    DeadlineExceededError, NotLeaderError, RateLimitError, RpcError,
+    recv_msg, send_msg,
 )
+from .retry import RetryPolicy, RpcBreaker
 from .server import DEFAULT_KEY
 
 
@@ -27,7 +51,11 @@ class RpcClient:
     """
 
     def __init__(self, servers: list[str], key: bytes = DEFAULT_KEY,
-                 timeout: float = 30.0, tls=None):
+                 timeout: float = 30.0, tls=None,
+                 clock: Optional[chrono.Clock] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[RpcBreaker] = None,
+                 client_id: str = ""):
         if not servers:
             raise ValueError("RpcClient needs at least one server address")
         self.key = key
@@ -37,10 +65,20 @@ class RpcClient:
         # optional VerifyServerHostname against server.<region>.nomad)
         self.tls = tls
         self._tls_ctx = tls.client_context() if tls else None
+        self.clock = clock or chrono.REAL
+        # default policy = ONE round over the failover list (the legacy
+        # behavior); callers that want partition tolerance pass a policy
+        # with max_attempts > 1
+        self.retry = retry or RetryPolicy(max_attempts=1, clock=self.clock)
+        self.breaker = breaker or RpcBreaker(clock=self.clock)
+        # stable per-process identity for idempotency tokens; chaos sims
+        # pass an explicit id so token streams are seed-reproducible
+        self.client_id = client_id or f"rpc-{uuid.uuid4().hex[:12]}"
         self._lock = threading.Lock()
         self._servers = list(servers)
         self._pool: dict[str, list[socket.socket]] = {}
         self._seq = 0
+        self._req_id = 0
 
     # ------------------------------------------------------------- servers
     def set_servers(self, servers: list[str]) -> None:
@@ -77,9 +115,35 @@ class RpcClient:
             self._seq += 1
             return self._seq
 
+    def _next_req_id(self) -> int:
+        with self._lock:
+            self._req_id += 1
+            return self._req_id
+
+    def _build_env(self, method: str, args, kwargs, region: str = "",
+                   deadline: Optional[float] = None,
+                   dedup: Optional[str] = None) -> dict:
+        """Request envelope shared by the TCP and virtual transports so
+        deterministic partition tests exercise EXACTLY the production
+        wire shape (deadline + dedup stamps included)."""
+        env = {"seq": self._next_seq(), "method": method, "args": args,
+               "kwargs": kwargs}
+        if region:
+            # cross-region routing stamp (ref nomad/rpc.go
+            # forwardRegion; every reference RPC carries Region)
+            env["region"] = region
+        if deadline is not None:
+            # absolute wall-clock deadline (caller's clock.time()); every
+            # downstream hop sheds the request once this passes
+            env["deadline"] = deadline
+        if dedup is not None:
+            env["dedup"] = dedup
+        return env
+
     def _call_addr(self, addr: str, method: str, args, kwargs,
                    sock_timeout: Optional[float] = None,
-                   region: str = ""):
+                   region: str = "", deadline: Optional[float] = None,
+                   dedup: Optional[str] = None):
         resp = None
         for attempt in (0, 1):
             with self._lock:
@@ -87,13 +151,8 @@ class RpcClient:
             sock = self._checkout(addr)
             try:
                 sock.settimeout(sock_timeout or self.timeout)
-                seq = self._next_seq()
-                env = {"seq": seq, "method": method, "args": args,
-                       "kwargs": kwargs}
-                if region:
-                    # cross-region routing stamp (ref nomad/rpc.go
-                    # forwardRegion; every reference RPC carries Region)
-                    env["region"] = region
+                env = self._build_env(method, args, kwargs, region=region,
+                                      deadline=deadline, dedup=dedup)
                 send_msg(sock, env, self.key)
                 resp = recv_msg(sock, self.key)
                 break
@@ -118,6 +177,11 @@ class RpcClient:
         failover tests exercise EXACTLY the production error mapping."""
         if resp.get("kind") == "NotLeaderError":
             raise NotLeaderError(resp.get("error") or "")
+        if resp.get("kind") == "DeadlineExceededError":
+            # server shed the request past its deadline: typed so the
+            # retry loop knows there is no budget left to spend
+            raise DeadlineExceededError(resp.get("error") or
+                                        "rpc deadline exceeded")
         if resp.get("kind") == "RateLimitError":
             # admission rejection (ISSUE 8): typed so callers can back
             # off for the server's hinted interval instead of retrying
@@ -134,50 +198,121 @@ class RpcClient:
     def call(self, method: str, *args, **kwargs):
         return self.call_timeout(None, method, *args, **kwargs)
 
-    def call_timeout(self, sock_timeout: Optional[float], method: str,
-                     *args, _region: str = "", **kwargs):
-        """Like call(); sock_timeout overrides the per-connection socket
-        timeout for this call (long-polls must out-wait the server hold).
-        `_region` stamps the envelope for cross-region forwarding."""
-        last_err: Optional[Exception] = None
+    def call_write(self, method: str, *args, **kwargs):
+        """A mutating call carrying an idempotency token: safe to retry
+        through lost replies — the server dedups on `(client_id, req_id)`
+        and returns the ORIGINAL committed result (rpc/dedup.py)."""
+        return self.call_timeout(None, method, *args, _idempotent=True,
+                                 **kwargs)
+
+    def _failover_order(self) -> list[str]:
         # deterministic preference for the first configured server keeps
-        # -dev single-server behavior snappy; the shuffled remainder is the
-        # failover order (dedup'd so a dead first server costs one timeout)
+        # -dev single-server behavior snappy; the seeded-shuffled
+        # remainder is the failover order (dedup'd so a dead first server
+        # costs one timeout)
         first = self.servers()[:1]
         rest = [a for a in self.servers() if a not in first]
-        random.shuffle(rest)
-        for addr in first + rest:
-            try:
-                return self._call_addr(addr, method, args, kwargs,
-                                       sock_timeout=sock_timeout,
-                                       region=_region)
-            except NotLeaderError as e:
-                if e.leader_addr and e.leader_addr != addr:
-                    try:
-                        return self._call_addr(e.leader_addr, method, args,
-                                               kwargs,
-                                               sock_timeout=sock_timeout,
-                                               region=_region)
-                    except RpcError as e2:
-                        if e2.kind != "RetryableError":
-                            raise
-                        last_err = e2
-                        continue
-                    except NotLeaderError as e2:
-                        # leadership moved again mid-call: keep trying the
-                        # remaining servers, which may know the new leader
-                        last_err = e2
-                        continue
-                    except (ConnectionError, OSError, TimeoutError) as e2:
-                        last_err = e2
-                        continue
-                last_err = e
-            except RpcError as e:
-                if e.kind != "RetryableError":
-                    raise
-                last_err = e    # stale-leader forward: try the next server
-            except (ConnectionError, OSError, TimeoutError) as e:
-                last_err = e
+        self.retry.shuffle_tail(rest)
+        return first + rest
+
+    def call_timeout(self, sock_timeout: Optional[float], method: str,
+                     *args, _region: str = "", _deadline: Optional[float] = None,
+                     _idempotent: bool = False,
+                     _forward_dedup: Optional[str] = None, **kwargs):
+        """Like call(); sock_timeout overrides the per-connection socket
+        timeout for this call (long-polls must out-wait the server hold).
+        `_region` stamps the envelope for cross-region forwarding.
+
+        `_deadline` is an absolute clock.time() budget for the WHOLE call
+        including retries (default: now + per-hop timeout); each hop's
+        socket timeout is clipped to the remaining budget and the
+        envelope carries the deadline so servers shed expired work.
+        `_idempotent` mints one dedup token reused by every retry;
+        `_forward_dedup` instead carries a token minted UPSTREAM (a
+        follower proxying a stamped request to the leader)."""
+        per_hop = sock_timeout or self.timeout
+        clock = self.clock
+        deadline = _deadline if _deadline is not None \
+            else clock.time() + per_hop
+        dedup_tok = _forward_dedup if _forward_dedup is not None else (
+            f"{self.client_id}:{self._next_req_id()}"
+            if _idempotent else None)
+        last_err: Optional[Exception] = None
+        for round_idx in range(self.retry.max_attempts):
+            if round_idx > 0:
+                remaining = deadline - clock.time()
+                if remaining <= 0:
+                    break
+                metrics.incr("nomad.rpc.retries")
+                clock.sleep(min(self.retry.backoff_s(round_idx - 1),
+                                remaining))
+            candidates = self._failover_order()
+            admitted = [a for a in candidates if self.breaker.admit(a)]
+            if not admitted:
+                # availability floor: every breaker open must never mean
+                # "no servers tried" — force one probe of the preferred
+                admitted = candidates[:1]
+            for addr in admitted:
+                remaining = deadline - clock.time()
+                if remaining <= 0:
+                    break
+                hop_timeout = min(per_hop, remaining)
+                try:
+                    result = self._call_addr(
+                        addr, method, args, kwargs,
+                        sock_timeout=hop_timeout, region=_region,
+                        deadline=deadline, dedup=dedup_tok)
+                    self.breaker.record_success(addr)
+                    return result
+                except NotLeaderError as e:
+                    # the server ANSWERED (transport healthy) — a leader
+                    # redirect is not a breaker failure
+                    self.breaker.record_success(addr)
+                    if e.leader_addr and e.leader_addr != addr:
+                        try:
+                            result = self._call_addr(
+                                e.leader_addr, method, args, kwargs,
+                                sock_timeout=min(
+                                    per_hop,
+                                    max(0.001, deadline - clock.time())),
+                                region=_region, deadline=deadline,
+                                dedup=dedup_tok)
+                            self.breaker.record_success(e.leader_addr)
+                            return result
+                        except RpcError as e2:
+                            if e2.kind != "RetryableError":
+                                raise
+                            last_err = e2
+                            continue
+                        except NotLeaderError as e2:
+                            # leadership moved again mid-call: keep trying
+                            # the remaining servers, which may know the
+                            # new leader
+                            last_err = e2
+                            continue
+                        except (ConnectionError, OSError,
+                                TimeoutError) as e2:
+                            self.breaker.record_failure(e.leader_addr)
+                            metrics.incr("nomad.rpc.failovers")
+                            last_err = e2
+                            continue
+                    last_err = e
+                except RpcError as e:
+                    if e.kind != "RetryableError":
+                        raise   # includes DeadlineExceededError: no budget
+                    last_err = e  # stale-leader forward: try next server
+                except (ConnectionError, OSError, TimeoutError) as e:
+                    self.breaker.record_failure(addr)
+                    metrics.incr("nomad.rpc.failovers")
+                    last_err = e
+        if deadline - clock.time() <= 0 and \
+                (last_err is None or self.retry.max_attempts > 1):
+            # budget gone: retrying clients surface the typed deadline
+            # error; legacy single-round clients keep their original
+            # transport error type below for back-compat
+            raise DeadlineExceededError(
+                f"rpc deadline exceeded calling {method} "
+                f"(last error: {last_err!r})") from last_err
         raise last_err if last_err else RpcError("no servers available")
 
     def close(self) -> None:
@@ -203,15 +338,31 @@ class ServerRpc:
     client RPCs Node.Register / Node.UpdateStatus / Node.GetClientAllocs /
     Alloc.GetAlloc / Node.UpdateAlloc through its server list)."""
 
+    #: retry rounds for the client->server control plane: the reference
+    #: client retries RPCs through partitions (client/rpc.go canRetry),
+    #: so ServerRpc opts into 3 failover rounds with seeded backoff
+    RETRY_ROUNDS = 3
+
     def __init__(self, servers: list[str], key: bytes = DEFAULT_KEY,
-                 timeout: float = 30.0, tls=None):
-        self.rpc = RpcClient(servers, key=key, timeout=timeout, tls=tls)
+                 timeout: float = 30.0, tls=None,
+                 clock: Optional[chrono.Clock] = None,
+                 client_id: str = "", retry_seed: int = 0):
+        clock = clock or chrono.REAL
+        self.rpc = RpcClient(
+            servers, key=key, timeout=timeout, tls=tls, clock=clock,
+            retry=RetryPolicy(max_attempts=self.RETRY_ROUNDS,
+                              seed=retry_seed, clock=clock),
+            client_id=client_id)
+
+    # mutating RPCs go through call_write so a reply lost to a partition
+    # is retried with the SAME dedup token — exactly-once commit of node
+    # status flips, alloc updates, and service (de)registrations
 
     def node_register(self, node):
-        return self.rpc.call("Node.Register", node)
+        return self.rpc.call_write("Node.Register", node)
 
     def node_update_status(self, node_id: str, status: str):
-        return self.rpc.call("Node.UpdateStatus", node_id, status)
+        return self.rpc.call_write("Node.UpdateStatus", node_id, status)
 
     def node_get_client_allocs(self, node_id: str, min_index: int = 0,
                                timeout: float = 30.0):
@@ -262,16 +413,16 @@ class ServerRpc:
         return self.rpc.call("Vault.Read", path)
 
     def service_register(self, instances):
-        return self.rpc.call("Service.Register", instances)
+        return self.rpc.call_write("Service.Register", instances)
 
     def service_deregister(self, alloc_id: str = "", keys=None):
-        return self.rpc.call("Service.Deregister", alloc_id, keys)
+        return self.rpc.call_write("Service.Deregister", alloc_id, keys)
 
     def service_instances(self, namespace: str, name: str):
         return self.rpc.call("Service.Instances", namespace, name)
 
     def node_update_allocs(self, allocs):
-        return self.rpc.call("Node.UpdateAlloc", allocs)
+        return self.rpc.call_write("Node.UpdateAlloc", allocs)
 
     # ------------------------------------------------------------ read plane
     # ISSUE 16: list/get off any server. With stale=False a follower

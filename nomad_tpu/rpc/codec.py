@@ -53,6 +53,18 @@ class RateLimitError(RpcError):
         self.retry_after_s = max(0.0, float(retry_after_s))
 
 
+class DeadlineExceededError(RpcError):
+    """The request's propagated deadline expired (ISSUE 18). Raised
+    client-side when the retry budget runs dry, and returned server-side
+    when a request arrives (or surfaces from a queue) after its envelope
+    `deadline` — the server SHEDS such work instead of spending raft
+    throughput on a result nobody is waiting for. Never retried: by
+    definition there is no budget left."""
+
+    def __init__(self, message: str = "rpc deadline exceeded"):
+        super().__init__(message, kind="DeadlineExceededError")
+
+
 class NotLeaderError(Exception):
     """Write hit a follower (ref nomad/rpc.go forward). .leader_addr may
     name the current leader's rpc address ("host:port") or be empty."""

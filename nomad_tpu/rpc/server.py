@@ -8,6 +8,20 @@ server here and the in-memory `rpc/virtual.py` transport the deterministic
 multi-server tests ride (ISSUE 6): both route outbound hops through
 `client_for`, so follower->leader and cross-region forwarding behave
 identically over either transport.
+
+ISSUE 18 partition tolerance, server side:
+
+  * **deadline shed** — a request whose envelope `deadline` already
+    passed is answered with `DeadlineExceededError` WITHOUT invoking the
+    handler (checked twice: on arrival — before the admission ladder even
+    spends a token on doomed work — and again after the leader-discovery
+    wait, so a queued write nobody is waiting for never consumes raft
+    throughput; composes with the ISSUE-8 overload ladder);
+  * **write dedup** — requests stamped `dedup` are checked against the
+    `WriteDedup` cache before the handler runs; a hit returns the
+    original committed result (exactly-once through lost replies);
+  * forwarded hops (`_forward`) propagate BOTH stamps so the leader
+    applies the same shed/dedup discipline.
 """
 from __future__ import annotations
 
@@ -18,6 +32,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import chrono, faults
+from ..metrics import metrics
 from .codec import (FrameError, NotLeaderError, RpcError, recv_msg, send_msg)
 
 DEFAULT_KEY = b"nomad-tpu-dev-cluster-key"
@@ -46,6 +62,17 @@ class RpcDispatcher:
         # region are proxied to a known server of that region
         self.region = ""
         self.region_servers_fn: Callable[[], dict] = lambda: {}
+        # deadline arithmetic ONLY (comparisons, never sleeps): virtual
+        # transports repoint this at the network's ManualClock so
+        # envelope deadlines and server shedding share one timeline
+        self.clock: chrono.Clock = chrono.REAL
+        # WriteDedup (rpc/dedup.py), wired by Server.rpc_listen*; None
+        # (the default) dispatches every request to its handler
+        self.dedup = None
+        # per-process breaker for OUTBOUND hops (leader/region forwards);
+        # shared across client_for handles so failure history accumulates
+        from .retry import RpcBreaker
+        self.rpc_breaker = RpcBreaker(clock=self.clock)
 
     # ------------------------------------------------------------ registry
     def register(self, method: str, fn: Callable,
@@ -63,7 +90,9 @@ class RpcDispatcher:
         way framework code (raft replication, forwarding) dials out, so
         the virtual transport can intercept every hop."""
         from .client import RpcClient
-        return RpcClient([addr], key=self.key, timeout=timeout, tls=self.tls)
+        return RpcClient([addr], key=self.key, timeout=timeout,
+                         tls=self.tls, clock=self.clock,
+                         breaker=self.rpc_breaker)
 
     # ------------------------------------------------------------ dispatch
     def _dispatch(self, req) -> dict:
@@ -82,6 +111,11 @@ class RpcDispatcher:
             return {"seq": seq, "error": f"unknown rpc method {method!r}",
                     "kind": "RpcError"}
         fn, leader_only = entry
+        rpc_deadline = req.get("deadline")
+        if self._deadline_passed(rpc_deadline):
+            # shed BEFORE admission: no rate-limit token, no handler, no
+            # raft throughput for a result nobody is waiting for
+            return self._shed(seq, method)
         if self.admission_fn is not None:
             # admission BEFORE leader forwarding: an over-rate write is
             # rejected at whichever server it hit, not proxied to pile
@@ -105,12 +139,18 @@ class RpcDispatcher:
             if not is_leader and not leader_addr:
                 # no known leader yet (mid-election): wait briefly for
                 # discovery instead of bouncing the caller
-                # (ref nomad/rpc.go:450 forward retries on ErrNoLeader)
-                deadline = time.monotonic() + 2.0
-                while time.monotonic() < deadline:
+                # (ref nomad/rpc.go:450 forward retries on ErrNoLeader).
+                # Deliberately REAL time, not self.clock: under a frozen
+                # ManualClock a virtual-time wait here would deadlock the
+                # delivering thread; the rpc deadline (caller's clock)
+                # still bounds the hold via the re-check below.
+                wait_until = time.monotonic() + 2.0
+                while time.monotonic() < wait_until:
                     time.sleep(0.05)
                     is_leader, leader_addr = self.leadership_fn()
                     if is_leader or leader_addr:
+                        break
+                    if self._deadline_passed(rpc_deadline):
                         break
             if not is_leader:
                 fwd = self._forward(method, req, leader_addr)
@@ -119,13 +159,48 @@ class RpcDispatcher:
                     return fwd
                 return {"seq": seq, "error": leader_addr,
                         "kind": "NotLeaderError"}
+        if self._deadline_passed(rpc_deadline):
+            # re-check after the (real-time) leader-discovery wait: the
+            # budget may have drained while we held the request
+            return self._shed(seq, method)
+        dedup_tok = req.get("dedup")
+        if dedup_tok is not None and self.dedup is not None:
+            cached = self.dedup.lookup(dedup_tok)
+            if cached is not self.dedup.MISS:
+                # retry of an already-committed write: return the
+                # original result, never re-apply
+                return {"seq": seq, "result": cached}
+        faults.fire(f"rpc.server.handler.{method}")
         try:
-            result = fn(*req.get("args", ()), **req.get("kwargs", {}))
+            if dedup_tok is not None and self.dedup is not None:
+                with self.dedup.pending(dedup_tok):
+                    result = fn(*req.get("args", ()),
+                                **req.get("kwargs", {}))
+                self.dedup.record(dedup_tok, result)
+            else:
+                result = fn(*req.get("args", ()), **req.get("kwargs", {}))
             return {"seq": seq, "result": result}
         except NotLeaderError as e:
             return {"seq": seq, "error": e.leader_addr, "kind": "NotLeaderError"}
         except Exception as e:   # noqa: BLE001
             return {"seq": seq, "error": str(e), "kind": type(e).__name__}
+
+    # -------------------------------------------------- deadline shedding
+    def _deadline_passed(self, deadline) -> bool:
+        if deadline is None:
+            return False
+        try:
+            return self.clock.time() >= float(deadline)
+        except (TypeError, ValueError):
+            return False        # garbage stamp: dispatch normally
+
+    def _shed(self, seq, method: str) -> dict:
+        metrics.incr("nomad.rpc.deadline_exceeded")
+        # method names come from the fixed handler registry (bounded set)
+        metrics.incr(f"nomad.rpc.deadline_exceeded.{method}")  # nomadlint: disable=OBS001 — dimension bounded by the RPC handler registry
+        return {"seq": seq,
+                "error": f"deadline exceeded before {method} dispatched",
+                "kind": "DeadlineExceededError"}
 
     def _forward_region(self, method: str, req, region: str) -> dict:
         """Proxy to a server of the requested region (ref nomad/rpc.go
@@ -158,13 +233,22 @@ class RpcDispatcher:
                 "kind": "RetryableError"}
 
     def _forward(self, method: str, req, leader_addr: str) -> Optional[dict]:
-        """Proxy a leader-only call to the leader (ref nomad/rpc.go:450)."""
+        """Proxy a leader-only call to the leader (ref nomad/rpc.go:450).
+
+        The deadline and dedup stamps ride the forwarded hop verbatim:
+        the leader sheds the same expired work this follower would, and
+        a forwarded retry of a committed write still dedups (the token
+        lives in the REPLICATED table, so the leader knows acks this
+        follower relayed before a partition)."""
         if not leader_addr or leader_addr == self.addr:
             return None
         try:
             with self.client_for(leader_addr) as cli:
-                return {"result": cli.call(method, *req.get("args", ()),
-                                           **req.get("kwargs", {}))}
+                return {"result": cli.call_timeout(
+                    None, method, *req.get("args", ()),
+                    _deadline=req.get("deadline"),
+                    _forward_dedup=req.get("dedup"),
+                    **req.get("kwargs", {}))}
         except NotLeaderError as e:
             return {"error": e.leader_addr, "kind": "NotLeaderError"}
         except Exception as e:   # noqa: BLE001

@@ -11,10 +11,21 @@ whose failure modes are INJECTED, SEEDED, and INSTANT:
     whose calls are direct function calls through `deliver()`.
   * Link faults — `partition(*groups)`, `isolate(name)`,
     `drop(src, dst, p)` (asymmetric, per-link seeded RNG),
-    `delay(src, dst, seconds)`, `heal()`, and `crash(name)`/
-    `restart(name)` for a member that vanishes mid-protocol. All are
-    runtime-switchable, so a test can partition a leader mid-batch at an
-    exact protocol step.
+    `delay(src, dst, seconds)`, `flap(src, dst, period_s)` (the link
+    cycles healthy/blocked on the network's clock: healthy for the
+    first `period_s`, blocked for the next, repeating — a deterministic
+    function of clock time, so ManualClock tests step a flap boundary
+    exactly), `heal()`, and `crash(name)`/`restart(name)` for a member
+    that vanishes mid-protocol. All are runtime-switchable, so a test
+    can partition a leader mid-batch at an exact protocol step.
+
+    Rules COMPOSE deterministically per delivery attempt, evaluated in a
+    fixed order: crash -> partition/isolate -> flap phase -> delay ->
+    drop. A slow lossy link (`delay` + `drop`) therefore costs its
+    latency FIRST and may then lose the request — and the drop RNG is
+    drawn exactly once per attempt from the per-link seeded stream, so
+    the loss pattern for a given (seed, src, dst, attempt ordinal) is
+    identical no matter which other rules are active.
   * Fault-plan integration — every hop fires the sites
     ``raft.transport.send.<src>.<dst>`` (request direction) and
     ``raft.transport.recv.<src>.<dst>`` (reply direction), so a
@@ -58,6 +69,11 @@ class VirtualRpcServer(RpcDispatcher):
         self.name = name
         self.addr = ADDR_PREFIX + name
         self.closed = False
+        # deadline shedding and the outbound breaker ride the network's
+        # clock: one virtual timeline for envelope deadlines, link flaps,
+        # and breaker cooldowns
+        self.clock = network.clock
+        self.rpc_breaker.clock = network.clock
 
     def client_for(self, addr: str, timeout: float = 30.0):
         return self.network.client([addr], src=self.name, key=self.key,
@@ -78,18 +94,23 @@ class VirtualRpcClient(RpcClient):
 
     def __init__(self, network: "VirtualNetwork", servers: list[str],
                  src: str = "client", key: bytes = DEFAULT_KEY,
-                 timeout: float = 30.0):
-        super().__init__(servers, key=key, timeout=timeout, tls=None)
+                 timeout: float = 30.0, retry=None, breaker=None,
+                 client_id: str = ""):
+        # clock = the network's clock: retry backoff, deadline budgets,
+        # and breaker cooldowns all compress under ManualClock with the
+        # simulated links
+        super().__init__(servers, key=key, timeout=timeout, tls=None,
+                         clock=network.clock, retry=retry, breaker=breaker,
+                         client_id=client_id)
         self.network = network
         self.src = src
 
     def _call_addr(self, addr: str, method: str, args, kwargs,
                    sock_timeout: Optional[float] = None,
-                   region: str = ""):
-        env = {"seq": self._next_seq(), "method": method, "args": args,
-               "kwargs": kwargs}
-        if region:
-            env["region"] = region
+                   region: str = "", deadline: Optional[float] = None,
+                   dedup: Optional[str] = None):
+        env = self._build_env(method, args, kwargs, region=region,
+                              deadline=deadline, dedup=dedup)
         resp = self.network.deliver(self.src, addr, env,
                                     timeout=sock_timeout or self.timeout)
         return self._raise_for_response(resp)
@@ -112,6 +133,8 @@ class VirtualNetwork:
         self._blocked: set[tuple[str, str]] = set()     # (src, dst)
         self._drops: dict[tuple[str, str], float] = {}
         self._delays: dict[tuple[str, str], float] = {}
+        # (src, dst) -> (period_s, phase_origin): see flap()
+        self._flaps: dict[tuple[str, str], tuple[float, float]] = {}
         self._rngs: dict[tuple[str, str], random.Random] = {}
 
     # ----------------------------------------------------------- endpoints
@@ -125,10 +148,12 @@ class VirtualNetwork:
             return srv
 
     def client(self, servers: list[str], src: str = "client",
-               key: bytes = DEFAULT_KEY,
-               timeout: float = 30.0) -> VirtualRpcClient:
+               key: bytes = DEFAULT_KEY, timeout: float = 30.0,
+               retry=None, breaker=None,
+               client_id: str = "") -> VirtualRpcClient:
         return VirtualRpcClient(self, servers, src=src, key=key,
-                                timeout=timeout)
+                                timeout=timeout, retry=retry,
+                                breaker=breaker, client_id=client_id)
 
     @staticmethod
     def name_of(addr: str) -> str:
@@ -176,13 +201,26 @@ class VirtualNetwork:
         with self._lock:
             self._delays[(src, dst)] = float(seconds)
 
+    def flap(self, src: str, dst: str, period_s: float) -> None:
+        """The directed link cycles on the network's clock: healthy for
+        `period_s` (starting now), blocked for the next `period_s`,
+        repeating. Phase is a pure function of clock time, so a
+        ManualClock test advances exactly onto a boundary and a
+        delivery attempt's outcome is reproducible."""
+        if period_s <= 0:
+            raise ValueError("flap period must be positive")
+        with self._lock:
+            self._flaps[(src, dst)] = (float(period_s),
+                                       self.clock.monotonic())
+
     def heal(self) -> None:
-        """Clear partitions, drops, and delays (crashed members stay
-        crashed until restart())."""
+        """Clear partitions, drops, delays, and flaps (crashed members
+        stay crashed until restart())."""
         with self._lock:
             self._blocked.clear()
             self._drops.clear()
             self._delays.clear()
+            self._flaps.clear()
 
     def crash(self, name: str) -> None:
         """The member vanishes mid-protocol: every in-flight and future
@@ -227,6 +265,7 @@ class VirtualNetwork:
             blocked = (src, dst) in self._blocked
             p = self._drops.get((src, dst), 0.0)
             lag = self._delays.get((src, dst), 0.0)
+            flap = self._flaps.get((src, dst))
             rng = self._rng(src, dst) if p else None
         # the send site fires before rule checks so observed-call counts
         # include attempts into a partition (tests assert wiring that way)
@@ -237,14 +276,24 @@ class VirtualNetwork:
             raise ConnectionError(f"virtual member crashed ({src}->{dst})")
         if blocked:
             raise ConnectionError(f"partitioned {src}->{dst}")
-        if p and rng.random() < p:
-            raise ConnectionError(f"dropped {src}->{dst}")
+        if flap is not None:
+            period, origin = flap
+            # phase 0 = healthy, phase 1 = blocked (starts healthy)
+            elapsed = self.clock.monotonic() - origin
+            if int(elapsed / period) % 2 == 1:
+                raise ConnectionError(f"link flap {src}->{dst} "
+                                      f"(down phase @ {elapsed:.3f}s)")
+        # composition order (module docstring): latency BEFORE loss — a
+        # slow lossy link costs its lag, then may drop the request; the
+        # drop RNG is drawn exactly once per attempt either way
         if lag:
             if lag >= timeout:
                 self.clock.sleep(timeout)
                 raise TimeoutError(f"link {src}->{dst} slower than "
                                    f"the {timeout}s call timeout")
             self.clock.sleep(lag)
+        if p and rng.random() < p:
+            raise ConnectionError(f"dropped {src}->{dst}")
         if server.closed:
             raise ConnectionError(f"virtual server {dst} is shut down")
         # real-wire fidelity: each side owns its object graph, and

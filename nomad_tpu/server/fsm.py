@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import pickle
 import threading
+from collections import OrderedDict
 from typing import Callable, Optional
 
 from ..state import StateStore
@@ -101,6 +102,15 @@ class NomadFSM:
     def apply(self, index: int, msg_type: str, payload: dict) -> object:
         """ref fsm.go:194 Apply (type switch :211-307)"""
         s = self.state
+        # RPC write-dedup ack (ISSUE 18): an entry stamped by
+        # rpc/dedup.stamp() records (token -> index) into the replicated
+        # table on EVERY server as part of the same apply — a failover
+        # cannot forget the ack. `.get`, never `.pop`: this payload dict
+        # is aliased by the in-memory raft log entry and WAL, and
+        # stripping the stamp here would desync followers and replays.
+        tok = payload.get("_dedup") if isinstance(payload, dict) else None
+        if tok is not None:
+            s.record_rpc_dedup(index, tok)
         if msg_type == NODE_REGISTER:
             s.upsert_node(index, payload["node"])
         elif msg_type == NODE_DEREGISTER:
@@ -304,6 +314,7 @@ class NomadFSM:
                 "autopilot_config": s.autopilot_config,
                 "services": s.services,
                 "intentions": s.intentions,
+                "rpc_dedup": s.rpc_dedup,
             }
             return pickle.dumps(blob)
 
@@ -336,6 +347,8 @@ class NomadFSM:
                 blob.get("autopilot_config", s.autopilot_config))
             s.services = dict(blob.get("services", {}))
             s.intentions = dict(blob.get("intentions", {}))
+            # .get: snapshots from before ISSUE 18 carry no dedup table
+            s.rpc_dedup = OrderedDict(blob.get("rpc_dedup", {}))
             s._acl_token_by_secret = {
                 t.secret_id: t.accessor_id for t in s.acl_tokens.values()}
             # rebuild secondary indexes
@@ -374,6 +387,12 @@ class RaftLog:
         with self._lock:
             return self._fence
 
+    def quorum_fresh(self, window: Optional[float] = None) -> bool:
+        """Single-node twin of RaftNode.quorum_fresh (ISSUE 18): a
+        single-node log cannot be deposed, so its local state is always
+        current and fast-path acks from it are always safe."""
+        return True
+
     def apply(self, msg_type: str, payload: dict,
               timeout: float = 30.0, fence: Optional[int] = None) -> int:
         # `timeout` mirrors the multi-server RaftNode.apply budget (the
@@ -382,6 +401,11 @@ class RaftLog:
         # is nothing to wait on here.
         from .. import faults
         faults.fire("raft.apply")
+        # idempotency stamp (ISSUE 18): if this thread is dispatching a
+        # dedup-tokened RPC, the token rides THIS entry's payload so the
+        # ack commits atomically with the write (rpc/dedup.py)
+        from ..rpc import dedup as rpc_dedup
+        payload = rpc_dedup.stamp(payload)
         # the lock spans index assignment AND application so state-store
         # mutations happen in strict log order (replay determinism)
         with self._lock:

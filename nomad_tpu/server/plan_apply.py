@@ -592,7 +592,7 @@ class Planner:
                 commit_sp.end("ok")
             except TimeoutError as e:
                 metrics.incr("nomad.plan.commit_timeout", len(reqs))
-                commit_sp.end("timeout", error=repr(e)[:200])
+                commit_sp.end("timeout", error=repr(e)[:200])  # nomadlint: disable=RPC001 — closes the trace span with the failure verdict, not a re-attempt
                 commit_err = e
             except NotLeaderError as e:
                 # FencedWriteError (entry never appended) and

@@ -350,6 +350,11 @@ class RaftNode:
         from .. import faults
         faults.fire("raft.apply")
         faults.fire(f"raft.apply.{self.node_id}")
+        # idempotency stamp (ISSUE 18): a dedup-tokened RPC dispatch on
+        # this thread marks the entry BEFORE append, so the ack
+        # replicates with the write and survives failover (rpc/dedup.py)
+        from ..rpc import dedup as rpc_dedup
+        payload = rpc_dedup.stamp(payload)
         t_enter = time.monotonic()
         with self._lock:
             if self.state != LEADER:
@@ -621,6 +626,34 @@ class RaftNode:
     def is_leader(self) -> bool:
         with self._lock:
             return self.state == LEADER
+
+    def quorum_fresh(self, window: Optional[float] = None) -> bool:
+        """Leader-lease check (read-index lite, ISSUE 18): True iff this
+        node is leader AND has replicated successfully to a voting
+        quorum within `window` seconds (default: half the minimum
+        election timeout — no rival can have been elected while a
+        quorum was heard from inside that window). A leader that heals
+        from a partition still believing it leads fails this check
+        until its next successful replication round, so local-state
+        fast paths (e.g. the unchanged-status heartbeat ack) must not
+        vouch for reads taken from a possibly-deposed leader's state —
+        acking a write from stale state LOSES it (docs/PARTITIONS.md)."""
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            voters = [pid for pid in self.peers
+                      if pid not in self.nonvoters]
+            need = len(voters) // 2 + 1
+            if need <= 1:
+                return True
+            w = window if window is not None \
+                else self.election_timeout[0] / 2.0
+            now = self.clock.monotonic()
+            fresh = sum(
+                1 for pid in voters
+                if pid == self.node_id
+                or now - self._last_ok.get(pid, float("-inf")) <= w)
+            return fresh >= need
 
     def leadership(self) -> tuple[bool, str]:
         with self._lock:

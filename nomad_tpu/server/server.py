@@ -214,6 +214,11 @@ class Server:
         # burst must engage brownout before the next 1s leader tick
         self.eval_broker.on_overflow = self.overload.tick
         self.periodic = PeriodicDispatch(self)
+        # RPC write-dedup (ISSUE 18): one per process, shared by the TCP
+        # and virtual dispatchers (wired in rpc_listen*) — retried writes
+        # whose reply was lost return the original committed result
+        from ..rpc.dedup import WriteDedup
+        self.write_dedup = WriteDedup(self.state)
         self.heartbeats = HeartbeatTimers(self)
         # flap damper (ISSUE 10): holds down/up-cycling nodes ineligible
         # with exponential re-admit backoff so reconnect churn cannot
@@ -368,6 +373,7 @@ class Server:
         self.rpc_server.leadership_fn = \
             lambda: (self.is_leader, self.leader_rpc_addr)
         self.rpc_server.admission_fn = self._rpc_admission
+        self.rpc_server.dedup = self.write_dedup
         self.rpc_server.start()
         return self.rpc_server.addr
 
@@ -385,6 +391,7 @@ class Server:
         self.rpc_server.leadership_fn = \
             lambda: (self.is_leader, self.leader_rpc_addr)
         self.rpc_server.admission_fn = self._rpc_admission
+        self.rpc_server.dedup = self.write_dedup
         self.rpc_server.start()
         return self.rpc_server.addr
 
@@ -824,7 +831,7 @@ class Server:
                             f"retrying: {e!r}")
                 # barrier retry backoff; nothing else contends this
                 # lock while establishing — nomadlint: disable=LOCK003
-                time.sleep(0.05)
+                time.sleep(0.05)  # nomadlint: disable=RPC001 — in-process raft barrier retry on the real-time establish path, not a client RPC
         timings["barrier"] = time.perf_counter() - t0
         metrics.add_sample("nomad.leader.establish.barrier",
                            timings["barrier"])
@@ -1846,6 +1853,17 @@ class Server:
         node = self.state.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node {node_id} not found")
+        if node.status == status and not self.raft.quorum_fresh():
+            # the unchanged-status fast path (below) acks without a raft
+            # round — safe only when the local state it consulted is
+            # provably current. A leader healing from a partition can
+            # still believe it leads while its state is behind the real
+            # leader's: acking "already in that state" from it LOSES an
+            # acked write (ISSUE 18, docs/PARTITIONS.md). Refuse instead;
+            # the client's retry ladder re-lands the same dedup token on
+            # a server that can vouch for its read.
+            metrics.incr("nomad.rpc.stale_ack_refused")
+            raise NotLeaderError("")
         evals: list[Evaluation] = []
         if node.status != status:
             was_up = node.status == NODE_STATUS_READY
@@ -2390,6 +2408,20 @@ class Server:
             "BlockedEvals": dict(self.blocked_evals.stats),
             "SchedulerConfig": to_api(self.state.get_scheduler_config()),
             "Raft": raft_block,
+            # partition-event forensics (ISSUE 18, docs/PARTITIONS.md):
+            # per-peer outbound breaker state, dedup cache occupancy, and
+            # the rpc retry/shed counters — one capture answers "which
+            # link was down, what got retried, what got shed"
+            "Rpc": {
+                "Breakers": (self.rpc_server.rpc_breaker.snapshot()
+                             if self.rpc_server is not None else {}),
+                "Dedup": self.write_dedup.stats(),
+                "Counters": {
+                    k: int(metrics.counter(f"nomad.rpc.{k}"))
+                    for k in ("retries", "failovers", "deadline_exceeded",
+                              "dedup_hits", "breaker_open",
+                              "breaker_closed")},
+            },
         }
 
     def run_gc(self) -> None:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Iterable, Optional
 
 from .usage_index import UsageIndex
@@ -33,6 +34,11 @@ from ..structs import (
     NODE_STATUS_DOWN,
 )
 from ..structs.summary import JobSummary, TaskGroupSummary
+
+# replicated dedup-ack LRU bound (ISSUE 18): sized to out-live any
+# client's retry window at chaos write rates while keeping the snapshot
+# blob contribution trivial (token + int per entry)
+RPC_DEDUP_CAP = 4096
 
 
 class StateStore:
@@ -74,6 +80,13 @@ class StateStore:
             "LastContactThresholdSec": 10.0,
             "ServerStabilizationTimeSec": 10.0,
         }
+        # replicated RPC write-dedup acks (ISSUE 18): token -> commit
+        # index, LRU-bounded. Written by the FSM when a raft entry
+        # carries a `_dedup` stamp, so EVERY server (and a restored
+        # snapshot) remembers which client requests already committed —
+        # the failover half of rpc/dedup.py (the leader-local result
+        # cache holds the full result blobs).
+        self.rpc_dedup: "OrderedDict[str, int]" = OrderedDict()
 
         # secondary indexes
         self._allocs_by_node: dict[str, set[str]] = {}
@@ -148,6 +161,28 @@ class StateStore:
             from ..metrics import metrics
             metrics.incr("nomad.state.snapshot_shared")
         return snap
+
+    # -------------------------------------------------- rpc write dedup
+    # (ISSUE 18) token -> commit index, written from NomadFSM.apply when
+    # an entry carries a `_dedup` stamp. Deliberately NOT a _bump table:
+    # a dedup record is metadata ABOUT an apply at `index`, not a write
+    # of its own, and must not wake blocking queries or churn the memo.
+
+    def record_rpc_dedup(self, index: int, token: str) -> None:
+        with self._lock:
+            dd = self.rpc_dedup
+            dd[token] = index
+            dd.move_to_end(token)
+            while len(dd) > RPC_DEDUP_CAP:
+                dd.popitem(last=False)
+
+    def rpc_dedup_get(self, token: str) -> Optional[int]:
+        with self._lock:
+            return self.rpc_dedup.get(token)
+
+    def rpc_dedup_len(self) -> int:
+        with self._lock:
+            return len(self.rpc_dedup)
 
     def fork(self) -> "StateStore":
         """Writable scratch copy for dry-run planning (the Job.Plan endpoint

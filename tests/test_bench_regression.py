@@ -645,6 +645,59 @@ def test_read_storm_gate():
         f"({col.get('row_bytes')}B)")
 
 
+def test_partition_chaos_gate():
+    """ISSUE 18 acceptance: once a bench records the partition_chaos
+    block, the seeded isolation/drop/flap/heal lineage must show (a)
+    zero double-applied writes — no dedup token committed twice, (b)
+    zero lost acked writes — every ack the client saw is in the
+    replicated dedup table, (c) zero heartbeat invalidations while the
+    drop phase was live — the retry ladder carried every beat, (d)
+    bounded post-heal reconvergence on the ManualClock, and (e) a
+    healed committed state identical to the same-seed run with no
+    faults at all. STRUCTURAL keys only, load-insensitive."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    pc = latest.get("partition_chaos")
+    if isinstance(pc, dict) and "error" in pc:
+        pytest.fail(f"BENCH_r{latest_round:02d}: partition-chaos "
+                    f"lineage run crashed: {pc['error']}")
+    if not isinstance(pc, dict) or "double_applied_writes" not in pc:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the "
+                    f"partition-chaos lineage")
+    assert pc.get("acked_writes", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: the chaos run acked no writes — "
+        f"the lineage proved nothing")
+    assert pc["double_applied_writes"] == 0, (
+        f"BENCH_r{latest_round:02d}: {pc['double_applied_writes']} "
+        f"write(s) double-applied — a retried dedup token committed "
+        f"twice; exactly-once is broken")
+    assert pc.get("lost_acked_writes", 1) == 0, (
+        f"BENCH_r{latest_round:02d}: {pc.get('lost_acked_writes')} "
+        f"acked write(s) missing from the replicated dedup table "
+        f"(lost tokens: {pc.get('lost_tokens')}) — an ack was a lie")
+    assert pc.get("heartbeat_invalidations", 1) == 0, (
+        f"BENCH_r{latest_round:02d}: "
+        f"{pc.get('heartbeat_invalidations')} node(s) invalidated "
+        f"during the drop phase — the heartbeat retry ladder failed "
+        f"to carry beats through transient loss")
+    assert pc.get("reconverged") is True, (
+        f"BENCH_r{latest_round:02d}: the cluster never reconverged "
+        f"after the heal")
+    assert pc.get("reconverge_virtual_s", 1e9) <= 60.0, (
+        f"BENCH_r{latest_round:02d}: post-heal reconvergence took "
+        f"{pc.get('reconverge_virtual_s')} virtual seconds — not a "
+        f"bounded heal")
+    assert pc.get("token_logs_identical") is True, (
+        f"BENCH_r{latest_round:02d}: servers disagree on the committed "
+        f"dedup token sequence after the heal")
+    assert pc.get("state_identical_to_oracle") is True, (
+        f"BENCH_r{latest_round:02d}: the healed committed state "
+        f"diverged from the same-seed no-fault run — partitions "
+        f"changed WHAT committed, not just when")
+
+
 def test_explain_overhead_gate():
     """ISSUE 11 acceptance: once a bench records the `explain` block,
     the placement-explain byproduct (per-solve fixed-shape reduce +

@@ -566,7 +566,7 @@ def test_rule_catalog_is_complete():
             "EXC001", "PERF001", "LEAD001", "OBS001", "OBS002",
             "QUEUE001", "SHARD001", "MESH001", "SYNC001",
             "READ001", "LINT000", "LOCK002", "LOCK003",
-            "REG001", "REG002"} <= ids
+            "REG001", "REG002", "RPC001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -1420,6 +1420,96 @@ def test_read001_inline_suppression():
         "self.state.block_min_index(min_index, timeout=0.5)"
         "  # nomadlint: disable=READ001 — no event topic covers this")
     assert rule_ids(src, path="server/some_endpoint.py") == []
+
+
+# ----------------------------------------------------------------- RPC001
+
+RPC001_HOT = """
+    def beat(self):
+        try:
+            self.rpc.node_update_status(self.node_id, "ready")
+        except ConnectionError:
+            self.rpc.node_update_status(self.node_id, "ready")
+"""
+
+RPC001_SLEEP = """
+    import time
+
+    def pump(self):
+        while not self._shutdown.is_set():
+            try:
+                self.rpc.node_update_allocs(self.updates)
+            except (ConnectionError, TimeoutError):
+                pass
+            time.sleep(0.2)
+"""
+
+
+def test_rpc001_fires_on_hot_recall_in_transport_handler():
+    out = findings(RPC001_HOT, path="client/client.py")
+    assert [f.rule for f in out] == ["RPC001"]
+    assert "node_update_status" in out[0].message
+    # rpc/ and server/ are patrolled too; other trees are not
+    assert rule_ids(RPC001_HOT, path="rpc/client.py") == ["RPC001"]
+    assert rule_ids(RPC001_HOT, path="scheduler/stack.py") == []
+
+
+def test_rpc001_fires_on_raw_sleep_in_retry_loop():
+    out = findings(RPC001_SLEEP, path="client/client.py")
+    assert [f.rule for f in out] == ["RPC001"]
+    assert "chrono.Clock" in out[0].message
+    # sleeping on the injectable clock is the blessed shape
+    fixed = RPC001_SLEEP.replace("time.sleep(0.2)",
+                                 "self._clock.sleep(0.2)")
+    assert rule_ids(fixed, path="client/client.py") == []
+    # Event.wait is shutdown plumbing, not backoff
+    waited = RPC001_SLEEP.replace("time.sleep(0.2)",
+                                  "self._shutdown.wait(0.2)")
+    assert rule_ids(waited, path="client/client.py") == []
+
+
+def test_rpc001_exempts_benign_and_raise_wrapping():
+    # wrapping the transport error in a typed exception is propagation,
+    # not a retry, even when the try body raises the same type
+    wrapping = """
+        def read(self, path):
+            try:
+                if path is None:
+                    raise ArtifactError("no path")
+                return self._open(path)
+            except OSError as e:
+                raise ArtifactError(f"io error: {e}") from e
+    """
+    assert rule_ids(wrapping, path="client/artifact.py") == []
+    # counters/logging on both sides are bookkeeping, regardless of how
+    # the import resolves (metrics.metrics.incr)
+    counted = """
+        from ..metrics import metrics
+
+        def send(self):
+            try:
+                metrics.incr("x.sent")
+                self.rpc.service_register(self.svc)
+            except TimeoutError:
+                metrics.incr("x.err")
+    """
+    assert rule_ids(counted, path="client/client.py") == []
+    # a handler for the typed consensus errors is not a transport handler
+    redirect = """
+        def call(self):
+            try:
+                return self._call_addr(self.addr)
+            except NotLeaderError:
+                return self._call_addr(self.leader)
+    """
+    assert rule_ids(redirect, path="rpc/client.py") == []
+
+
+def test_rpc001_inline_suppression():
+    src = RPC001_SLEEP.replace(
+        "time.sleep(0.2)",
+        "time.sleep(0.2)  # nomadlint: disable=RPC001 — local poll")
+    assert rule_ids(src, path="client/client.py") == []
 
 
 # ================================================= whole-program pass

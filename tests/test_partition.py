@@ -1,0 +1,638 @@
+"""Partition-tolerant RPC plane (ISSUE 18, docs/PARTITIONS.md): retry
+policy + breaker determinism, deadline propagation and server-side
+shedding, exactly-once idempotent writes through lost replies (local
+result cache AND the replicated dedup table across a failover), client
+heartbeat retries + reconnect reconciliation, flap/drop composition on
+the virtual transport, and the lossy-vs-clean same-seed differential.
+The chaos lineage itself lives in `bench.py --partition-chaos`, gated by
+tests/test_bench_regression.py::test_partition_chaos_gate."""
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import faults, mock
+from nomad_tpu.chrono import ManualClock
+from nomad_tpu.client import Client
+from nomad_tpu.metrics import metrics
+from nomad_tpu.rpc import retry as retry_mod
+from nomad_tpu.rpc.client import RpcClient
+from nomad_tpu.rpc.codec import (
+    DeadlineExceededError, FencedWriteError, NotLeaderError, RpcError,
+)
+from nomad_tpu.rpc.dedup import WriteDedup, peek_pending, stamp
+from nomad_tpu.rpc.retry import RetryPolicy, RpcBreaker
+from nomad_tpu.rpc.virtual import VirtualNetwork
+from nomad_tpu.server.fsm import EVAL_UPDATE, NomadFSM
+from nomad_tpu.state.store import StateStore
+
+from tests.test_raft import (
+    FAST, make_cluster, shutdown_all, wait_stable_leader, wait_until,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------ RetryPolicy
+
+def test_retry_policy_backoff_is_seeded_and_bounded():
+    p1 = RetryPolicy(max_attempts=5, base_s=0.1, multiplier=2.0,
+                     max_backoff_s=1.0, seed=7)
+    p2 = RetryPolicy(max_attempts=5, base_s=0.1, multiplier=2.0,
+                     max_backoff_s=1.0, seed=7)
+    seq1 = [p1.backoff_s(i) for i in range(6)]
+    seq2 = [p2.backoff_s(i) for i in range(6)]
+    # the schedule is a pure function of (seed, retry ordinal)
+    assert seq1 == seq2
+    for i, b in enumerate(seq1):
+        raw = min(1.0, 0.1 * (2.0 ** i))
+        # jitter scales into [0.5, 1.0) — never collapses to zero
+        assert 0.5 * raw <= b < raw
+    # the failover-tail shuffle is seeded too
+    items1, items2 = ["a", "b", "c", "d", "e"], ["a", "b", "c", "d", "e"]
+    RetryPolicy(seed=3).shuffle_tail(items1)
+    RetryPolicy(seed=3).shuffle_tail(items2)
+    assert items1 == items2
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+
+
+# ------------------------------------------------------------- RpcBreaker
+
+def test_breaker_open_halfopen_closed_on_manual_clock():
+    clock = ManualClock()
+    b = RpcBreaker(clock=clock)
+    addr = "vrt/s0"
+    assert b.admit(addr) and b.state(addr) == "closed"
+    for _ in range(retry_mod.BREAKER_THRESHOLD):
+        b.record_failure(addr)
+    assert b.state(addr) == "open"
+    assert not b.admit(addr)
+    # cooldown elapses: exactly ONE caller gets the half-open probe slot
+    clock.advance(retry_mod.BREAKER_COOLDOWN_S + 0.01)
+    assert b.state(addr) == "half-open"
+    assert b.admit(addr)
+    assert not b.admit(addr)            # probe already in flight
+    b.record_success(addr)
+    assert b.state(addr) == "closed" and b.admit(addr)
+    # a FAILED probe re-opens for a fresh cooldown
+    for _ in range(retry_mod.BREAKER_THRESHOLD):
+        b.record_failure(addr)
+    clock.advance(retry_mod.BREAKER_COOLDOWN_S + 0.01)
+    assert b.admit(addr)
+    b.record_failure(addr)
+    assert b.state(addr) == "open" and not b.admit(addr)
+    snap = b.snapshot()
+    assert snap[addr]["State"] == "open"
+    assert snap[addr]["OpenForS"] > 0
+    b.reset()
+    assert b.state(addr) == "closed"
+
+
+def test_breaker_failure_window_prunes_old_failures():
+    clock = ManualClock()
+    b = RpcBreaker(clock=clock)
+    b.record_failure("a")
+    b.record_failure("a")
+    # the window slides past the first two failures; the third alone
+    # must not trip the breaker
+    clock.advance(retry_mod.BREAKER_WINDOW_S + 1.0)
+    b.record_failure("a")
+    assert b.state("a") == "closed" and b.admit("a")
+
+
+# ------------------------------------------------- deadline: server shed
+
+def _echo_server(clock=None):
+    net = VirtualNetwork(seed=0, clock=clock)
+    srv = net.server("s0")
+    calls = []
+    srv.register("Echo.Ping", lambda x: (calls.append(x), x)[1])
+    srv.start()
+    return net, srv, calls
+
+
+def test_server_sheds_expired_deadline_before_handler():
+    clock = ManualClock()
+    net, srv, calls = _echo_server(clock=clock)
+    base = metrics.counter("nomad.rpc.deadline_exceeded")
+    resp = srv._dispatch({"seq": 1, "method": "Echo.Ping", "args": ("hi",),
+                          "deadline": clock.time() - 1.0})
+    assert resp["kind"] == "DeadlineExceededError"
+    assert calls == []                  # handler never invoked
+    assert metrics.counter("nomad.rpc.deadline_exceeded") == base + 1
+    # a live deadline dispatches normally
+    resp = srv._dispatch({"seq": 2, "method": "Echo.Ping", "args": ("hi",),
+                          "deadline": clock.time() + 30.0})
+    assert resp["result"] == "hi" and calls == ["hi"]
+    # a garbage stamp is tolerated (dispatch, don't shed)
+    resp = srv._dispatch({"seq": 3, "method": "Echo.Ping", "args": ("yo",),
+                          "deadline": "bogus"})
+    assert resp["result"] == "yo"
+
+
+def test_client_raises_typed_error_on_server_shed():
+    clock = ManualClock()
+    net, srv, calls = _echo_server(clock=clock)
+    cli = net.client([srv.addr], src="c")
+    with pytest.raises(DeadlineExceededError):
+        cli.call_timeout(5.0, "Echo.Ping", "hi",
+                         _deadline=clock.time() - 1.0)
+    assert calls == []
+
+
+# ------------------------------------------------ deadline: client budget
+
+class _RecordingClient(RpcClient):
+    """RpcClient with the transport replaced by a scripted hop log."""
+
+    def __init__(self, script, clock, **kw):
+        super().__init__(["a", "b", "c"], clock=clock, **kw)
+        self._script = list(script)
+        self.hops = []                  # (addr, sock_timeout)
+
+    def _call_addr(self, addr, method, args, kwargs, sock_timeout=None,
+                   region="", deadline=None, dedup=None):
+        self.hops.append((addr, sock_timeout))
+        step = self._script.pop(0)
+        if isinstance(step, tuple):
+            cost, outcome = step
+            self.clock.advance(cost)
+        else:
+            outcome = step
+        if isinstance(outcome, Exception):
+            raise outcome
+        return outcome
+
+
+def test_hop_timeout_is_the_remaining_budget():
+    clock = ManualClock()
+    cli = _RecordingClient(
+        [(4.0, ConnectionError("down")), (7.0, ConnectionError("down"))],
+        clock, timeout=10.0,
+        retry=RetryPolicy(max_attempts=2, clock=clock))
+    with pytest.raises(DeadlineExceededError) as ei:
+        cli.call("X.Y")
+    # hop 1 gets the full budget; hop 2 gets what 4 virtual seconds left
+    assert [t for _, t in cli.hops] == [10.0, 6.0]
+    # the transport error that exhausted the budget rides along as cause
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_deadline_exceeded_is_never_retried():
+    clock = ManualClock()
+    cli = _RecordingClient(
+        [DeadlineExceededError("server shed")] * 5, clock, timeout=10.0,
+        retry=RetryPolicy(max_attempts=3, clock=clock))
+    with pytest.raises(DeadlineExceededError):
+        cli.call("X.Y")
+    assert len(cli.hops) == 1           # no failover, no second round
+
+
+def test_legacy_single_round_client_keeps_transport_error_type():
+    # a walk-once client (the framework-internal default) that burns its
+    # whole budget must surface the ORIGINAL error, not the typed
+    # deadline error — raft replication's failure handling predates it
+    clock = ManualClock()
+    cli = _RecordingClient(
+        [(11.0, ConnectionError("down"))], clock, timeout=10.0,
+        retry=RetryPolicy(max_attempts=1, clock=clock))
+    with pytest.raises(ConnectionError):
+        cli.call("X.Y")
+
+
+# -------------------------------------------- dedup: stamp + WriteDedup
+
+def test_stamp_consumes_token_and_never_mutates_payload():
+    state = StateStore()
+    wd = WriteDedup(state, cap=8)
+    payload = {"node_id": "n1"}
+    with wd.pending("cli:1"):
+        assert peek_pending() == "cli:1"
+        stamped = stamp(payload)
+        assert stamped == {"node_id": "n1", "_dedup": "cli:1"}
+        assert stamped is not payload and "_dedup" not in payload
+        # consumed: only the FIRST apply of a multi-apply handler stamps
+        assert stamp(payload) is payload
+    assert peek_pending() is None       # always cleared on exit
+    # no pending token => zero-copy passthrough
+    assert stamp(payload) is payload
+    assert stamp(["not", "a", "dict"]) == ["not", "a", "dict"]
+
+
+def test_write_dedup_lru_and_replicated_fallback():
+    state = StateStore()
+    wd = WriteDedup(state, cap=2)
+    wd.record("a", {"r": 1})
+    wd.record("b", {"r": 2})
+    wd.record("c", {"r": 3})            # evicts "a" from the local LRU
+    assert wd.lookup("c") == {"r": 3}
+    assert wd.lookup("a") is WriteDedup.MISS
+    # the replicated table answers for tokens the local LRU lost
+    state.record_rpc_dedup(41, "a")
+    assert wd.lookup("a") == {"index": 41, "deduped": True}
+    st = wd.stats()
+    assert st["LocalResults"] == 2 and st["LocalCap"] == 2
+    assert st["Recorded"] == 3 and st["ReplicatedTokens"] == 1
+
+
+def test_state_store_dedup_table_is_bounded(monkeypatch):
+    from nomad_tpu.state import store as store_mod
+    monkeypatch.setattr(store_mod, "RPC_DEDUP_CAP", 3)
+    s = StateStore()
+    for i in range(5):
+        s.record_rpc_dedup(i, f"tok-{i}")
+    assert s.rpc_dedup_len() == 3
+    assert s.rpc_dedup_get("tok-0") is None     # oldest evicted
+    assert s.rpc_dedup_get("tok-4") == 4
+
+
+def test_dedup_table_survives_snapshot_restore():
+    fsm = NomadFSM()
+    fsm.state.record_rpc_dedup(17, "cli:9")
+    blob = fsm.snapshot_bytes()
+    fsm2 = NomadFSM()
+    fsm2.restore_bytes(blob)
+    assert fsm2.state.rpc_dedup_get("cli:9") == 17
+    # pre-ISSUE-18 snapshots restore to an empty table, not a crash
+    fsm3 = NomadFSM()
+    fsm3.restore_bytes(blob)
+    assert fsm3.state.rpc_dedup_len() == 1
+
+
+# ----------------------------------- exactly-once through a lost reply
+
+def _dedup_tokens(server):
+    """Every `_dedup` token riding a committed raft entry, in order."""
+    return [e.payload["_dedup"] for e in server.raft_node.log
+            if isinstance(e.payload, dict) and "_dedup" in e.payload]
+
+
+def test_write_retried_after_reply_loss_applies_exactly_once():
+    """The tentpole shape: request applied, reply lost, client retries
+    with the SAME token — the server answers the ORIGINAL result from
+    its local cache and raft commits exactly one entry."""
+    servers = make_cluster(1, seed=0)
+    try:
+        s = servers[0]
+        assert wait_until(lambda: s.raft_node.is_leader() and s.is_leader,
+                          timeout=20)
+        net = s.rpc_server.network
+        cli = net.client(
+            [s.rpc_addr], src="cli", client_id="cli0",
+            retry=RetryPolicy(max_attempts=3, base_s=0.01, seed=1,
+                              clock=net.clock))
+        node = mock.node()
+        cli.call_write("Node.Register", node)       # mints cli0:1
+        # lose exactly the NEXT reply out of s0 (after the handler ran)
+        faults.install({"raft.transport.recv.cli.s0":
+                        {"mode": "raise", "times": 1}})
+        hits = metrics.counter("nomad.rpc.dedup_hits")
+        retries = metrics.counter("nomad.rpc.retries")
+        resp = cli.call_write("Node.UpdateStatus", node.id, "down")
+        # the retry got the ORIGINAL committed result, not a re-apply
+        assert "heartbeat_ttl" in resp
+        assert metrics.counter("nomad.rpc.dedup_hits") == hits + 1
+        assert metrics.counter("nomad.rpc.retries") == retries + 1
+        assert s.state.node_by_id(node.id).status == "down"
+        # exactly one committed entry carries the write's token
+        assert _dedup_tokens(s).count("cli0:2") == 1
+        # ...and the replicated ack table knows it
+        assert s.state.rpc_dedup_get("cli0:2") is not None
+    finally:
+        shutdown_all(servers)
+
+
+def test_replicated_dedup_answers_after_leader_failover():
+    """The ack must survive the leader's death: a retry landing on the
+    NEW leader (whose local result cache never saw the write) answers
+    from the replicated table instead of re-applying."""
+    servers = make_cluster(3, seed=0)
+    try:
+        leader = wait_stable_leader(servers, timeout=30)
+        net = leader.rpc_server.network
+        cli = net.client(
+            [leader.rpc_addr], src="cli", client_id="cliX",
+            retry=RetryPolicy(max_attempts=3, base_s=0.01, seed=2,
+                              clock=net.clock))
+        node = mock.node()
+        cli.call_write("Node.Register", node)               # cliX:1
+        cli.call_write("Node.UpdateStatus", node.id, "down")  # cliX:2
+        assert wait_until(lambda: all(
+            s.state.rpc_dedup_get("cliX:2") is not None for s in servers),
+            timeout=15)
+        net.isolate(leader.raft_node.node_id)
+        rest = [s for s in servers if s is not leader]
+        new_leader = wait_stable_leader(rest, timeout=30)
+        # the client's retry reaches the new leader with the same token
+        cli2 = net.client([new_leader.rpc_addr], src="cli2")
+        resp = cli2.call_timeout(None, "Node.UpdateStatus", node.id,
+                                 "down", _forward_dedup="cliX:2")
+        assert resp.get("deduped") is True and "index" in resp
+        # still exactly one committed entry cluster-wide for that token
+        assert _dedup_tokens(new_leader).count("cliX:2") == 1
+    finally:
+        shutdown_all(servers)
+
+
+def test_stale_fence_token_rejected_after_partition_failover():
+    """Leader isolation fences: a write prepared under the pre-partition
+    reign (fence = old term) presented to the post-heal leader is
+    rejected with FencedWriteError — entry never appended, safe as
+    not-happened (docs/PARTITIONS.md error contract)."""
+    servers = make_cluster(3, seed=4)
+    try:
+        leader = wait_stable_leader(servers, timeout=30)
+        stale_fence = leader.raft_node.fence_token()
+        assert stale_fence is not None
+        net = leader.rpc_server.network
+        net.isolate(leader.raft_node.node_id)
+        rest = [s for s in servers if s is not leader]
+        new_leader = wait_stable_leader(rest, timeout=30)
+        assert new_leader.raft_node.fence_token() > stale_fence
+        with pytest.raises(FencedWriteError):
+            new_leader.raft.apply(EVAL_UPDATE, {"evals": []},
+                                  fence=stale_fence)
+        # heal: the old leader hears the higher term and steps down — a
+        # stale-fenced apply there is equally refused (never appended)
+        net.heal()
+        assert wait_until(lambda: not leader.raft_node.is_leader(),
+                          timeout=20)
+        with pytest.raises((FencedWriteError, NotLeaderError)):
+            leader.raft.apply(EVAL_UPDATE, {"evals": []},
+                              fence=stale_fence)
+        # the healed cluster still commits fresh fenced writes
+        new_leader.raft.apply(EVAL_UPDATE, {"evals": []},
+                              fence=new_leader.raft_node.fence_token())
+    finally:
+        shutdown_all(servers)
+
+
+def test_unchanged_status_ack_refused_on_stale_leader():
+    """The chaos lineage's sharpest find: a leader healing from a
+    partition still believes it leads while its state is behind — the
+    unchanged-status fast path (no raft round) would ack a write from
+    that stale state and LOSE it. The quorum-lease check refuses
+    instead, so the client's retry re-lands the token on a server that
+    can vouch for its read."""
+    servers = make_cluster(3, seed=6)
+    try:
+        leader = wait_stable_leader(servers, timeout=30)
+        net = leader.rpc_server.network
+        node = mock.node()
+        leader.node_register(node)
+        assert leader.raft_node.quorum_fresh()
+        net.isolate(leader.raft_node.node_id)
+        # replication to every follower now fails; once the lease window
+        # (half the minimum election timeout) drains, this leader can no
+        # longer vouch that a rival was not elected behind its back
+        assert wait_until(lambda: not leader.raft_node.quorum_fresh(),
+                          timeout=20)
+        base = metrics.counter("nomad.rpc.stale_ack_refused")
+        with pytest.raises(NotLeaderError):
+            leader.node_update_status(node.id, node.status)
+        assert metrics.counter("nomad.rpc.stale_ack_refused") == base + 1
+        # after the heal the cluster converges and the ack path recovers
+        net.heal()
+        fresh = wait_stable_leader(servers, timeout=30)
+        assert wait_until(fresh.raft_node.quorum_fresh, timeout=20)
+        assert "heartbeat_ttl" in fresh.node_update_status(node.id,
+                                                           node.status)
+    finally:
+        shutdown_all(servers)
+
+
+def test_quorum_fresh_trivially_true_without_rivals():
+    # the single-node log cannot be deposed...
+    fsm = NomadFSM()
+    from nomad_tpu.server.fsm import RaftLog
+    assert RaftLog(fsm).quorum_fresh() is True
+    # ...and neither can a one-voter raft cluster
+    servers = make_cluster(1, seed=0)
+    try:
+        assert wait_until(lambda: servers[0].raft_node.is_leader(),
+                          timeout=20)
+        assert servers[0].raft_node.quorum_fresh() is True
+    finally:
+        shutdown_all(servers)
+
+
+# -------------------------------------------- client heartbeat + heal
+
+class _FlakyRpc:
+    """ServerRpc stand-in: fail the first `fail` UpdateStatus calls with
+    a transport error, then succeed."""
+
+    def __init__(self, fail):
+        self.fail = fail
+        self.status_calls = 0
+        self.registers = 0
+
+    def node_update_status(self, node_id, status):
+        self.status_calls += 1
+        if self.status_calls <= self.fail:
+            raise ConnectionError("partitioned")
+        return {"heartbeat_ttl": 7.5, "eval_ids": []}
+
+    def node_register(self, node):
+        self.registers += 1
+        return {"heartbeat_ttl": 7.5, "index": 1}
+
+
+def _drive_on_manual_clock(fn, clock, timeout=10.0):
+    """Run `fn` in a thread while pumping the ManualClock so its seeded
+    jitter sleeps resolve; returns fn()'s result."""
+    box = {}
+    t = threading.Thread(target=lambda: box.update(r=fn()), daemon=True)
+    t.start()
+    deadline = time.monotonic() + timeout
+    while t.is_alive() and time.monotonic() < deadline:
+        clock.advance(0.05)
+        time.sleep(0.002)
+    t.join(1.0)
+    assert "r" in box, "driven fn never completed"
+    return box["r"]
+
+
+def test_heartbeat_survives_seeded_drops_within_one_tick(tmp_path):
+    clock = ManualClock()
+    rpc = _FlakyRpc(fail=2)
+    c = Client(rpc, data_dir=str(tmp_path / "c1"), clock=clock, seed=3)
+    retries = metrics.counter("nomad.client.heartbeat_retries")
+    before = clock.monotonic()
+    assert _drive_on_manual_clock(c._heartbeat_once, clock) is True
+    # 2 drops + 1 success, no TTL/2 silence, no re-register needed
+    assert rpc.status_calls == 3 and rpc.registers == 0
+    assert c._heartbeat_ttl == 7.5
+    assert c._last_heartbeat_ok > before
+    assert metrics.counter("nomad.client.heartbeat_retries") == retries + 2
+    # the retry jitter rode the ManualClock (bounded, per-retry window)
+    lo, hi = Client.HEARTBEAT_RETRY_JITTER_S
+    assert 2 * lo <= clock.monotonic() - before <= 2 * hi + 0.1
+
+
+def test_heartbeat_falls_back_to_reregister_after_ladder(tmp_path):
+    clock = ManualClock()
+    # every in-ladder UpdateStatus fails; the re-register path's second
+    # UpdateStatus (call #5) succeeds
+    rpc = _FlakyRpc(fail=1 + Client.HEARTBEAT_RETRIES)
+    c = Client(rpc, data_dir=str(tmp_path / "c2"), clock=clock, seed=3)
+    assert _drive_on_manual_clock(c._heartbeat_once, clock) is True
+    assert rpc.registers == 1
+    assert rpc.status_calls == 1 + Client.HEARTBEAT_RETRIES + 1
+
+
+class _ReconcileRpc:
+    def __init__(self, index=42, allocs=None, boom=False):
+        self.index = index
+        self.allocs = allocs or {}
+        self.boom = boom
+        self.calls = []
+
+    def node_get_client_allocs(self, node_id, min_index=0, timeout=30.0):
+        self.calls.append((min_index, timeout))
+        if self.boom:
+            raise ConnectionError("still partitioned")
+        return {"allocs": dict(self.allocs), "index": self.index}
+
+
+def test_reconcile_resyncs_full_map_and_adopts_server_index(tmp_path):
+    rpc = _ReconcileRpc(index=42)
+    c = Client(rpc, data_dir=str(tmp_path / "c3"))
+    # an alloc the server stopped during the outage — the client would
+    # never see its removal through the incremental long-poll
+    c._alloc_versions["ghost"] = 5
+    base = metrics.counter("nomad.client.reconnect_reconciles")
+    assert c._reconcile_allocs() is True
+    # full-map fetch at a known index: min_index=0, immediate return
+    assert rpc.calls == [(0, 0.0)]
+    assert "ghost" not in c._alloc_versions
+    assert c._last_alloc_index == 42
+    assert metrics.counter("nomad.client.reconnect_reconciles") == base + 1
+    # a failed reconcile adopts NOTHING (retry next tick re-reconciles)
+    rpc2 = _ReconcileRpc(boom=True)
+    c2 = Client(rpc2, data_dir=str(tmp_path / "c4"))
+    assert c2._reconcile_allocs() is False
+    assert c2._last_alloc_index == 0
+
+
+# ------------------------------------------- virtual-network composition
+
+def test_flap_phase_is_a_pure_function_of_clock_time():
+    clock = ManualClock()
+    net, srv, _ = _echo_server(clock=clock)
+    cli = net.client([srv.addr], src="c")
+    net.flap("c", "s0", 1.0)
+    assert cli.call("Echo.Ping", "a") == "a"        # phase 0: healthy
+    clock.advance(1.5)                              # phase 1: blocked
+    with pytest.raises(ConnectionError):
+        cli.call("Echo.Ping", "b")
+    clock.advance(0.7)                              # phase 2: healthy
+    assert cli.call("Echo.Ping", "c") == "c"
+    # heal() clears flaps along with partitions/drops/delays
+    clock.advance(1.0)                              # blocked again...
+    net.heal()
+    assert cli.call("Echo.Ping", "d") == "d"
+    with pytest.raises(ValueError):
+        net.flap("c", "s0", 0.0)
+
+
+def test_drop_pattern_is_seeded_per_link():
+    def pattern(seed):
+        net, srv, _ = _echo_server()
+        net.drop("c", "s0", 0.5)
+        cli = net.client([srv.addr], src="c")
+        out = []
+        for i in range(20):
+            try:
+                cli.call("Echo.Ping", i)
+                out.append(True)
+            except ConnectionError:
+                out.append(False)
+        return out
+
+    p0a, p0b, p1 = pattern(0), pattern(0), pattern(1)
+    assert p0a == p0b                   # same seed => same loss pattern
+    assert True in p0a and False in p0a
+
+
+def test_delay_composes_before_drop_and_bounds_on_timeout():
+    net, srv, calls = _echo_server()
+    cli = net.client([srv.addr], src="c", timeout=0.05)
+    # lag >= the call timeout: the caller waits its timeout, then fails
+    net.delay("c", "s0", 0.2)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        cli.call("Echo.Ping", "x")
+    assert 0.04 <= time.monotonic() - t0 < 0.19
+    assert calls == []                  # never delivered
+    # lag below the timeout: latency is paid, the call succeeds
+    net.delay("c", "s0", 0.01)
+    assert cli.call("Echo.Ping", "y") == "y"
+
+
+# ------------------------------------------------- same-seed differential
+
+def _lossy_workload(drop_p):
+    """One node's write sequence against a 1-server cluster with seeded
+    request loss; returns the derived committed view."""
+    servers = make_cluster(1, seed=0)
+    try:
+        s = servers[0]
+        assert wait_until(lambda: s.raft_node.is_leader() and s.is_leader,
+                          timeout=20)
+        net = s.rpc_server.network
+        if drop_p:
+            net.drop("cli", "s0", drop_p)
+        cli = net.client(
+            [s.rpc_addr], src="cli", client_id="cliD",
+            retry=RetryPolicy(max_attempts=6, base_s=0.005, seed=9,
+                              clock=net.clock))
+        node = mock.node()
+        node.id = "node-differential-1"
+        cli.call_write("Node.Register", node)
+        for status in ("down", "ready", "down"):
+            cli.call_write("Node.UpdateStatus", node.id, status)
+        return {
+            "status": s.state.node_by_id(node.id).status,
+            "tokens": sorted(t for t in _dedup_tokens(s)),
+            "acked": sorted(
+                t for t in (f"cliD:{i}" for i in range(1, 5))
+                if s.state.rpc_dedup_get(t) is not None),
+        }
+    finally:
+        shutdown_all(servers)
+
+
+def test_lossy_run_converges_to_clean_same_seed_state():
+    """The acceptance differential: after retries absorb the (seeded)
+    request loss, the committed state — final status, the exact token
+    sequence, every acked write — is identical to the no-fault run."""
+    clean = _lossy_workload(0.0)
+    lossy = _lossy_workload(0.3)
+    assert lossy == clean
+    assert clean["acked"] == [f"cliD:{i}" for i in range(1, 5)]
+
+
+# -------------------------------------------------- operator observability
+
+def test_operator_debug_bundle_carries_rpc_block():
+    servers = make_cluster(1, seed=0)
+    try:
+        s = servers[0]
+        assert wait_until(lambda: s.raft_node.is_leader() and s.is_leader,
+                          timeout=20)
+        bundle = s.operator_debug_bundle()
+        rpc = bundle["Rpc"]
+        assert set(rpc) == {"Breakers", "Dedup", "Counters"}
+        assert set(rpc["Counters"]) == {
+            "retries", "failovers", "deadline_exceeded", "dedup_hits",
+            "breaker_open", "breaker_closed"}
+        assert rpc["Dedup"]["LocalCap"] > 0
+    finally:
+        shutdown_all(servers)
