@@ -903,6 +903,110 @@ def _fused_stream_run() -> dict:
     }
 
 
+def _convex_run() -> dict:
+    """Global convex placement tier lineage (ISSUE 19): STRUCTURAL keys
+    only — round-trips-per-eval over a convex-algorithm short stream
+    (the one-dispatch contract: p50 <= 1), iterations-to-convergence,
+    and the greedy-vs-convex fragmentation/fairness differential on a
+    pinned 10k-node fragmented cluster with a host AllocsFit oracle
+    re-walk (feasibility_violations must be 0). Deliberately
+    wall-clock-free: the lineage gates identically on a loaded 1-core
+    box and a TPU pod. NOMAD_CONVEX_EVALS / NOMAD_CONVEX_NODES
+    resize."""
+    import jax
+    from nomad_tpu.metrics import metrics
+    from nomad_tpu.solver import backend, convex, state_cache
+    from nomad_tpu.solver.kernels import (
+        FIT_EPS, NUM_XR, fill_greedy_binpack,
+    )
+    from nomad_tpu.structs import SCHED_ALG_CONVEX
+
+    n_evals = int(os.environ.get("NOMAD_CONVEX_EVALS", "32"))
+
+    # ---- convex short stream: the one-dispatch round-trip contract.
+    # _stream_run pins its own SCHED_ALG_TPU config (the coalescing
+    # window knob rides the same write), so the stream engages convex
+    # through the NOMAD_SOLVER_CONVEX=1 force lever — the documented
+    # bench-parity override (docs/BACKEND_TIERS.md)
+    state_cache.reset()
+    backend.reset()
+    base = dict(metrics.snapshot()["counters"])
+    rt_skip = metrics.sample_count("nomad.solver.device_round_trips")
+    saved = os.environ.get("NOMAD_SOLVER_CONVEX")
+    os.environ["NOMAD_SOLVER_CONVEX"] = "1"
+    try:
+        fsm_c = _seed_fsm(N_NODES, SCHED_ALG_CONVEX, seed=37)
+        _stream_run(fsm_c, n_evals, STREAM_CONCURRENCY)
+    finally:
+        if saved is None:
+            os.environ.pop("NOMAD_SOLVER_CONVEX", None)
+        else:
+            os.environ["NOMAD_SOLVER_CONVEX"] = saved
+    convex_dispatches = int(
+        metrics.counter("nomad.solver.dispatch.convex")
+        - base.get("nomad.solver.dispatch.convex", 0))
+    stream_iters = int(metrics.snapshot()["gauges"].get(
+        "nomad.solver.convex.iterations", 0))
+
+    # ---- pinned 10k-node fragmented-cluster differential. Kernel-level
+    # on purpose: it drives the SAME compiled program the placer
+    # dispatches, with the cluster shape exactly reproducible (beta-skewed
+    # usage: most nodes part-full, a tail nearly exhausted)
+    n_nodes = int(os.environ.get("NOMAD_CONVEX_NODES", "10000"))
+    rng = np.random.default_rng(1910)
+    cap = np.zeros((n_nodes, NUM_XR), np.float32)
+    cap[:] = (4_000.0, 8_192.0, 500_000.0, 12_001.0, 10_000.0)
+    used = np.zeros_like(cap)
+    used[:, 0] = (rng.beta(2, 3, n_nodes) * 3_900).astype(np.float32)
+    used[:, 1] = (rng.beta(2, 3, n_nodes) * 8_000).astype(np.float32)
+    used[:, 2] = (rng.beta(2, 5, n_nodes) * 400_000).astype(np.float32)
+    feasible = rng.random(n_nodes) > 0.05
+    coll = rng.integers(0, 4, n_nodes).astype(np.int32)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[:3] = (250.0, 512.0, 300.0)
+    count = np.int32(3_000)
+    fn = jax.jit(lambda *a: convex.convex_eval(*a))
+    placed, fit, iters, gap, won = jax.device_get(fn(
+        cap, used, np.arange(n_nodes, dtype=np.int32),
+        np.ones(n_nodes, bool), ask, count, feasible, np.int32(2 ** 30),
+        np.zeros(n_nodes, np.float32), coll, np.zeros(n_nodes, np.int32),
+        np.bool_(False), np.int32(200), np.float32(1e-4),
+        np.float32(0.05), np.float32(2 ** 30)))
+    greedy = np.asarray(jax.device_get(fill_greedy_binpack(
+        cap, used, ask, count, feasible, np.int32(2 ** 30))))
+    # host AllocsFit oracle re-walk at the applier's epsilon
+    post = used + placed[:, None].astype(np.float32) * ask[None, :]
+    violations = int((post > cap + FIT_EPS).any(axis=1).sum())
+    oc = convex.placement_objective(cap, used, ask, placed, coll,
+                                    False, 0.05)
+    og = convex.placement_objective(cap, used, ask, greedy, coll,
+                                    False, 0.05)
+    state_cache.reset()
+    backend.reset()
+    return {
+        "evals": n_evals,
+        "round_trips_p50": metrics.percentile(
+            "nomad.solver.device_round_trips", 0.5, skip=rt_skip),
+        "round_trips_p95": metrics.percentile(
+            "nomad.solver.device_round_trips", 0.95, skip=rt_skip),
+        "convex_dispatches": convex_dispatches,
+        "stream_iterations": stream_iters,
+        "n_nodes": n_nodes,
+        "placed": int(placed.sum()),
+        "greedy_placed": int(greedy.sum()),
+        "iterations": int(iters),
+        "objective_gap": float(gap),
+        "convex_won": bool(won),
+        "feasibility_violations": violations,
+        # positive deltas == greedy worse on that objective term
+        "fragmentation_delta": float(og["fragmentation"]
+                                     - oc["fragmentation"]),
+        "fairness_delta": float(og["fairness"] - oc["fairness"]),
+        "objective_delta": float(og["total"] - oc["total"]),
+        "all_fit": bool(fit.all()),
+    }
+
+
 def _read_storm_run() -> dict:
     """Read-path scale-out lineage (ISSUE 16, docs/READ_PATH.md):
     STRUCTURAL keys only — on a 3-server virtual cluster, a read storm
@@ -2269,6 +2373,15 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         fused_stream = {"error": repr(e)[:200]}
 
+    # convex placement tier lineage (ISSUE 19): one-dispatch round trips
+    # under the convex algorithm + the greedy-vs-convex
+    # fragmentation/fairness differential on the pinned 10k-node
+    # fragmented cluster, structural keys only; gated once recorded
+    try:
+        convex_tier = _convex_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        convex_tier = {"error": repr(e)[:200]}
+
     # read-path lineage (ISSUE 16): follower-served stale reads +
     # bit-identity differential + coalescing fan-out zero-loss +
     # columnar byte ratio, structural keys only; gated once recorded
@@ -2374,6 +2487,7 @@ def main() -> None:
         # ISSUE 15: whole-eval residency (fused dispatch) — structural,
         # load-insensitive keys (round trips per eval, bit parity)
         "fused_stream": fused_stream,
+        "convex": convex_tier,
         # ISSUE 16: read-path scale-out (follower stale reads, fan-out
         # coalescing zero-loss, columnar list codec byte ratio)
         "read_storm": read_storm,
@@ -2738,6 +2852,11 @@ if __name__ == "__main__":
         # standalone whole-eval-residency lineage (ISSUE 15): fused
         # round trips per eval + bit parity; NOMAD_FUSED_EVALS resizes
         print(json.dumps(_fused_stream_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--convex":
+        # standalone convex-tier lineage (ISSUE 19): one-dispatch round
+        # trips + the greedy-vs-convex differential on the pinned
+        # 10k-node fragmented cluster; NOMAD_CONVEX_{EVALS,NODES} resize
+        print(json.dumps(_convex_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--read-storm":
         # standalone read-path lineage (ISSUE 16): follower stale reads
         # + fan-out coalescing + columnar byte ratio;

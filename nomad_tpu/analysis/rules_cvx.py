@@ -1,0 +1,74 @@
+"""CVX001 — one-dispatch discipline in the convex solve path (ISSUE 19,
+docs/BACKEND_TIERS.md "Convex tier").
+
+The convex tier's whole contract is that a solve costs ONE compiled
+dispatch: every projected-gradient iteration, the water-filling
+projection, the rounding and the in-program greedy baseline live inside
+`lax.while_loop`/`lax.fori_loop` so XLA sees a single program. The
+failure shape this rule patrols is the obvious refactor: hoisting the
+iteration into a Python-level `for`/`while` around the device math
+("just to debug convergence", "just N fixed steps"). That compiles per
+step and dispatches per iteration — up to `max_iters` round trips where
+the contract (and the round-trips-per-eval bench lineage) promises one.
+
+Scope: `/solver/convex.py` only — the module whose docstring carries the
+one-dispatch promise. `lax.*` calls are exactly the sanctioned iteration
+primitives, so they are exempt by origin; any other jax/jnp operation,
+or a call into the traced placement kernels (`kernels.*`), appearing
+under a Python loop is the violation.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, SourceModule, register
+
+
+@register
+class OneDispatchLoop(Rule):
+    id = "CVX001"
+    severity = "error"
+    short = ("Python-level for/while wrapping device dispatches in the "
+             "convex solve path — iteration must live inside "
+             "lax.while_loop/fori_loop so the solve stays ONE compiled "
+             "dispatch")
+    path_markers = ("/solver/convex.py",)
+
+    @staticmethod
+    def _device_call(mod: SourceModule, call: ast.Call) -> str:
+        """-> dotted description if `call` dispatches device math, else
+        ''. Resolution is by import origin: jax/jnp operations and the
+        traced placement kernels count; `jax.lax.*` is the sanctioned
+        in-program iteration, exempt."""
+        dotted = mod.dotted(call.func)
+        if not dotted:
+            return ""
+        if dotted == "jax.lax" or dotted.startswith("jax.lax."):
+            return ""
+        if dotted == "jax" or dotted.startswith(("jax.", "kernels.")):
+            return dotted
+        return ""
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                desc = self._device_call(mod, sub)
+                if desc:
+                    kind = "while" if isinstance(node, ast.While) else "for"
+                    out.append(mod.finding(
+                        self, node,
+                        f"Python-level `{kind}` loop wraps the device "
+                        f"dispatch `{desc}(...)` — each iteration is its "
+                        f"own device round trip, breaking the convex "
+                        f"tier's one-dispatch contract; move the "
+                        f"iteration into `lax.while_loop`/"
+                        f"`lax.fori_loop` (or mark a deliberate host "
+                        f"loop with `# nomadlint: disable=CVX001 — "
+                        f"<why>`)"))
+                    break               # one finding per loop
+        return out

@@ -19,7 +19,8 @@ from ..structs import (
     DesiredUpdates, DESC_CANARY, DESC_NODE_TAINTED,
     EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, JOB_TYPE_BATCH,
     JOB_TYPE_SERVICE, TRIGGER_MAX_PLANS, TRIGGER_PREEMPTION,
-    TRIGGER_RETRY_FAILED_ALLOC, new_id, SCHED_ALG_TPU, skeleton_for,
+    TRIGGER_RETRY_FAILED_ALLOC, new_id, SCHED_ALG_CONVEX, SCHED_ALG_TPU,
+    skeleton_for,
 )
 from ..metrics import metrics
 from ..obs import trace
@@ -273,7 +274,10 @@ class GenericScheduler:
         """Place missing allocations (ref generic_sched.go:472
         computePlacements). Delegates to the TPU solver when configured."""
         algorithm = self.ctx.scheduler_config.effective_scheduler_algorithm()
-        if algorithm == SCHED_ALG_TPU:
+        if algorithm in (SCHED_ALG_TPU, SCHED_ALG_CONVEX):
+            # the convex algorithm rides the same tensor placer; its
+            # solves route through the convex tier (backend.select_convex)
+            # and demote to the identical greedy ladder on any failure
             try:
                 from ..solver import SolverPlacer
             except ImportError:

@@ -1023,7 +1023,8 @@ class Server:
                 if n < _warmup_floor():
                     continue
                 from ..solver import backend
-                out = backend.warmup(n)
+                out = backend.warmup(
+                    n, cfg=self.state.get_scheduler_config())
                 if not out.get("skipped"):
                     self.logger(
                         f"server: standby warmup compiled "
@@ -1062,7 +1063,8 @@ class Server:
         logged, never fatal — evals just pay the compiles lazily."""
         try:
             from ..solver import backend
-            out = backend.warmup(len(self.state.iter_nodes()))
+            out = backend.warmup(len(self.state.iter_nodes()),
+                                 cfg=self.state.get_scheduler_config())
             if not out.get("skipped"):
                 self.logger(
                     f"server: solver warmup compiled {out['artifacts']} "

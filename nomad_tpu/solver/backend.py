@@ -859,6 +859,174 @@ def _build_fused(kernel: str, tier: str, devs, k_max: int,
     return jax.jit(impl)
 
 
+def convex_enabled(cfg=None, algorithm=None) -> bool:
+    """Global convex placement tier gate (ISSUE 19). Engages when the
+    eval's effective scheduler algorithm is "convex" (the operator-API
+    SchedulerAlgorithm option) AND the hot-reloadable
+    SchedulerConfiguration.solver_convex_enabled kill-switch is on;
+    NOMAD_SOLVER_CONVEX=0/1 force-overrides both (the bench and the
+    bit-parity differentials flip it per leg)."""
+    env = os.environ.get("NOMAD_SOLVER_CONVEX", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    if algorithm is not None and algorithm != "convex":
+        return False
+    return bool(getattr(cfg, "solver_convex_enabled", True))
+
+
+def select_convex(kernel: str, n_padded: int, *, count=None,
+                  k_max: int = 128, spread_algorithm: bool = False,
+                  depth_grid=None, n_classes: int = 0,
+                  sharded_twins: bool = False, mesh_snap=None):
+    """-> (tier, run) for the global convex solve (ISSUE 19), or None
+    when the convex route should not engage for this shape: host-tier
+    resolution (a latency-bound small eval has nothing to gain from an
+    iterative device solve) or a twin/tier shardedness mismatch (same
+    rule as select_fused). Unlike select_fused, a pallas-tier resolution
+    REMAPS to the solo xla jit instead of declining — there is no hand
+    convex kernel, and declining would disable the convex tier at
+    exactly the large-cluster shapes it targets; the greedy ladder the
+    breaker demotes to still owns the pallas artifact. The batch tier
+    remaps to xla too (the convex objective is a whole-cluster solve,
+    not a coalescable lane).
+
+    `run(*convex_args, host_args=...)` dispatches the ONE compiled
+    gather+solve+round+verdict(+explain) program; on any device-tier
+    failure it classifies the error (loss quarantines + rebuilds + counts
+    a replay; transients feed the breaker) and re-solves through a FRESH
+    classic select() chain for `kernel` at the current generation from
+    `host_args` — the uncommitted numpy twin of the same eval — so a
+    convex failure can never strand an eval; that fallback returns a
+    1-tuple (placed,). `kernel` names the greedy-ladder route the
+    demotion lands on; the compiled convex program itself is shared
+    across kernels (its statics are tier/spread/n_classes only)."""
+    from . import sharding
+    snap = mesh_snap if mesh_snap is not None else sharding.snapshot()
+    if snap.generation != sharding.generation():
+        snap = sharding.snapshot()      # mid-eval rebuild: never pin dead
+    tier, devs = _tier(n_padded, count, snap=snap)
+    if tier in ("pallas", "batch"):
+        tier = "xla"
+    if tier == "host":
+        return None     # no accelerator in the route: greedy ladder serves
+    if (tier == "sharded") != bool(sharded_twins):
+        return None     # shardedness mismatch: classic route serves it
+    key = ("convex", kernel, n_padded, k_max, spread_algorithm,
+           depth_grid, n_classes, tier, PALLAS_MIN_NODES, SHARD_MIN_NODES,
+           HOST_MAX_COUNT, snap.generation,
+           os.environ.get("NOMAD_SOLVER_BACKEND", ""))
+    cached = _cache.get(key)
+    if cached is not None:
+        return cached
+    out = _cache[key] = (tier, _convex_chain(kernel, tier, devs, snap,
+                                             n_padded, count, k_max,
+                                             spread_algorithm, depth_grid,
+                                             n_classes))
+    return out
+
+
+def _fire_convex_sites(tier: str) -> None:
+    """The convex dispatch seam's fault sites, hoisted to module level
+    so the whole-program analyzer indexes them (REG001 keeps the
+    `solver.dispatch.convex` docs/FAULT_INJECTION.md row honest; nested
+    closures are deliberately outside its call index). The convex
+    program IS a dispatch on `tier`: per-tier fault plans keep hitting
+    it, and a faulted tier falls to the classic ladder, which re-fires
+    and demotes exactly as the unfused path would."""
+    from . import sharding
+    faults.fire("solver.dispatch.convex")
+    faults.fire(f"solver.dispatch.{tier}")
+    sharding.fire_device_loss_sites()
+
+
+def _convex_chain(kernel: str, tier: str, devs, snap, n_padded: int,
+                  count, k_max: int, spread_algorithm: bool, depth_grid,
+                  n_classes: int):
+    """The convex dispatch seam: one attempt on the compiled solve under
+    the serving tier's breaker + the `solver.dispatch.convex` fault site
+    + device-loss seams, then the classic select() ladder from
+    `host_args` on any failure — the demotion discipline is _fused_chain
+    verbatim, so the convex tier inherits the exact never-strand
+    availability contract the fused path proved out."""
+    fn = _build_convex(tier, devs, spread_algorithm, n_classes,
+                       generation=snap.generation,
+                       mesh_obj=snap.mesh if tier == "sharded" else None)
+    gen = snap.generation
+
+    def classic(host_args):
+        _, cfn = select(kernel, n_padded, count=count, k_max=k_max,
+                        spread_algorithm=spread_algorithm,
+                        depth_grid=depth_grid)
+        return (cfn(*host_args),)
+
+    def run(*args, host_args=None):
+        import jax
+
+        from . import roundtrip, sharding
+        from ..obs import trace
+        errs = device_error_types()
+        if not _breaker.admit(tier):
+            metrics.incr(
+                f"nomad.solver.tier_breaker_short_circuit.{tier}")
+            return classic(host_args)
+        try:
+            with trace.span("solver.dispatch.convex", tier=tier,
+                            convex=True, kernel=kernel):
+                _fire_convex_sites(tier)
+                out = jax.block_until_ready(fn(*args))
+        except errs as e:
+            replay = note_dispatch_failure(tier, e, generation=gen)
+            metrics.incr("nomad.solver.tier_demotions")
+            metrics.incr("nomad.solver.tier_demotions.convex")
+            trace.annotate_list("demotions", "convex")
+            if replay:
+                # the classic re-select rides the NEW generation: the
+                # in-flight eval replays on the survivors from its
+                # uncommitted host args — zero evals lost (ISSUE 14)
+                metrics.incr("nomad.mesh.replays")
+            return classic(host_args)
+        except BaseException:
+            # non-demotable failure: the breaker must still see it or a
+            # half-open probe leaks probing=True (same rule as _chain)
+            _breaker.record_failure(tier)
+            raise
+        _breaker.record_success(tier)
+        metrics.incr("nomad.solver.dispatch.convex")
+        metrics.incr(f"nomad.solver.dispatch.convex.{tier}")
+        roundtrip.note("convex")
+        return out
+    return run
+
+
+def _build_convex(tier: str, devs, spread_algorithm: bool,
+                  n_classes: int, generation: int, mesh_obj=None):
+    """One convex executable per (tier, spread, n_classes, generation):
+    the solo jit or the mesh-spec'd sharded variant. Cached separately
+    from the chains — every (kernel, bucket) chain that resolves to the
+    same statics shares ONE compiled program (all the solve knobs are
+    runtime scalars, so operator hot-reloads never fan this out)."""
+    import jax
+
+    bkey = ("convex-build", tier, spread_algorithm, n_classes, generation,
+            os.environ.get("NOMAD_SOLVER_BACKEND", ""))
+    cached = _cache.get(bkey)
+    if cached is not None:
+        return cached
+    if tier == "sharded":
+        from .sharding import sharded_convex
+        _cache[bkey] = sharded_convex(
+            mesh_obj if mesh_obj is not None else _mesh(devs),
+            spread_algorithm=spread_algorithm, n_classes=n_classes)
+    else:
+        from .convex import convex_eval
+        _cache[bkey] = jax.jit(functools.partial(
+            convex_eval, spread_algorithm=spread_algorithm,
+            n_classes=n_classes))
+    return _cache[bkey]
+
+
 def _on_host(fn):
     """Run an XLA kernel on the host cpu backend. Inputs must be
     UNCOMMITTED (numpy) so jax.default_device places them host-side —
@@ -978,7 +1146,7 @@ WARMUP_MIN_NODES = 256
 
 
 def warmup(n_nodes: int, k_maxes: tuple = (8, 64, 128),
-           budget_s: float = 300.0) -> dict:
+           budget_s: float = 300.0, cfg=None) -> dict:
     """Pre-compile the (kernel, tier, bucket) grid a leader will dispatch
     (ISSUE 4 tentpole): called from Server._establish_leadership on
     promotion (background thread), so the first real eval after an
@@ -1095,6 +1263,43 @@ def warmup(n_nodes: int, k_maxes: tuple = (8, 64, 128),
                        coll,
                        host_args=(cap, used, ask, np.int32(1), feasible,
                                   np.int32(2 ** 30)))
+                artifacts += 1
+            except Exception as e:  # noqa: BLE001 — warmup never wedges
+                metrics.incr("nomad.solver.warmup.errors")
+                if os.environ.get("NOMAD_DEBUG"):
+                    raise
+                del e
+    # convex-tier artifacts (ISSUE 19): ONE compiled program per
+    # (tier, spread, n_classes) — all solve knobs are runtime scalars —
+    # driven through the real select_convex chain so a warm standby or
+    # rejoining process skips the first convex compile. Warmed whenever
+    # the operator config could route evals to the convex algorithm
+    # (cfg says so, or the env force is on); select_convex's declines
+    # (host tier) just skip.
+    if convex_enabled(cfg, getattr(cfg, "scheduler_algorithm", "convex")) \
+            and time.monotonic() - t0 <= budget_s:
+        import jax.numpy as jnp
+        cap_res, used_res = jnp.asarray(cap), jnp.asarray(used)
+        idx = np.arange(bucket, dtype=np.int32)
+        valid = np.ones(bucket, bool)
+        cls = np.zeros(bucket, np.int32)
+        for spread in (False, True):
+            if time.monotonic() - t0 > budget_s:
+                metrics.incr("nomad.solver.warmup.budget_exhausted")
+                break
+            try:
+                sel = select_convex("greedy", bucket,
+                                    spread_algorithm=spread)
+                if sel is None:
+                    continue
+                _, fn = sel
+                fn(cap_res, used_res, idx, valid, ask, np.int32(1),
+                   feasible, np.int32(2 ** 30),
+                   np.zeros(bucket, np.float32), coll, cls,
+                   np.bool_(False), np.int32(200), np.float32(1e-4),
+                   np.float32(0.05), np.float32(2 ** 30),
+                   host_args=(cap, used, ask, np.int32(1), feasible,
+                              np.int32(2 ** 30)))
                 artifacts += 1
             except Exception as e:  # noqa: BLE001 — warmup never wedges
                 metrics.incr("nomad.solver.warmup.errors")

@@ -1,0 +1,232 @@
+"""Global convex placement tier (ISSUE 19 tentpole): cluster-wide
+allocation as ONE on-device projected-gradient solve.
+
+Every other solve path scores nodes one-shot and fills greedily; nothing
+optimizes across the whole cluster, so fragmented or unfair packings
+stay that way. CvxCluster (PAPERS.md, 2605.01614) shows granular
+resource-allocation problems cast as convex programs solve orders of
+magnitude faster than combinatorial search, and Gavel (2008.09213)
+expresses whole scheduling policies as optimization objectives. This
+module is that road: the binpack/spread/affinity preferences plus the
+cluster-wide constraints (per-tenant quota budget, namespace-stacking
+fairness) become one differentiable objective over the already-resident
+sharded cap/used tensors, minimized by projected gradient descent with
+EVERY iteration inside a `lax.while_loop` — a solve costs ONE compiled
+dispatch and ONE device_get, exactly like the PR-15 fused path.
+
+The program (convex_eval):
+
+  1. gather the eval's rows from the resident twins (kernels.gather_rows
+     — inlined, never its own dispatch);
+  2. relax placement to x in R^N with box 0 <= x_i <= u_i (u = the dense
+     AllocsFit instance capacity, distinct_hosts-capped) and budget
+     sum(x) = min(count, quota_budget, sum(u)) — the per-tenant quota is
+     a hard cap on the budget, not a soft penalty;
+  3. minimize  f(x) = <cost, x> + (curv/2)|x|^2 + (w_f/2)|coll + x|^2
+     where `cost` is the ScoreFitBinPack/Spread preference (affinity
+     boost subtracted — preferred nodes are cheaper) and the fairness
+     term levels same-job/namespace stacking across nodes (`coll` is the
+     lowered per-node collision count); f is strongly convex, so the
+     fixed step 1/(curv + w_f) projected-gradient iteration converges
+     geometrically;
+  4. project each iterate onto the capped simplex {0 <= x <= u,
+     sum(x) = budget} by bisecting the water-filling threshold — a fixed
+     `lax.fori_loop`, still inside the one program;
+  5. round fractional -> integral ON DEVICE: floor, then distribute the
+     remainder by largest fractional part, never exceeding u_i — so the
+     integral placement is feasible-by-construction against the same
+     `AllocsFit` arithmetic (kernels.FIT_EPS == plan_apply._FIT_EPS) the
+     applier re-checks;
+  6. evaluate the SAME objective on the rounded placement and on the
+     greedy fill of the same budget, and emit whichever is better. The
+     convex tier is therefore never worse than greedy on the combined
+     fragmentation+fairness objective by construction, and a solution
+     that rounds infeasible (or loses to greedy) falls back to the
+     greedy placement *inside the same dispatch* — zero extra round
+     trips, zero evals stranded.
+
+Iteration count and final objective gap ride out with the placement so
+the ONE device_get materializes the debug-bundle gauges too.
+
+nomadlint CVX001 guards this file: iteration must live in
+`lax.while_loop`/`fori_loop`; a Python-level `for`/`while` wrapping
+device math here would shatter the one-dispatch contract.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .kernels import (
+    BINPACK_MAX_SCORE, _explain_reduce_impl, fill_greedy_binpack,
+    gather_rows, instance_capacity, plan_fit_verdict, score_fit,
+)
+
+# per-unit quadratic curvature of the fragmentation term. Binpack wants
+# concentration, so the curvature stays small (the linear cost dominates
+# and extreme points of the capped simplex = fill-best-first); spread
+# mode raises it so the quadratic genuinely disperses the iterate.
+CURV_BINPACK = 0.05
+CURV_SPREAD = 1.0
+
+# water-filling bisection depth: 50 halvings on a float32 threshold
+# bracket is past machine precision for any cluster budget we serve
+PROJECT_ITERS = 50
+
+
+def _projection_bracket(y: jnp.ndarray, u: jnp.ndarray,
+                        budget: jnp.ndarray) -> jnp.ndarray:
+    """Project y onto {x : 0 <= x <= u, sum(x) = budget} (water-filling:
+    x_i = clip(y_i - tau, 0, u_i), tau bisected so the sum hits budget).
+    The sum is monotone decreasing in tau, so PROJECT_ITERS halvings of
+    a bracket that provably contains the root converge it."""
+    lo = jnp.min(y - u) - 1.0           # tau <= lo => every x_i = u_i
+    hi = jnp.max(y) + 1.0               # tau >= hi => every x_i = 0
+
+    def body(_, bracket):
+        b_lo, b_hi = bracket
+        mid = 0.5 * (b_lo + b_hi)
+        s = jnp.sum(jnp.clip(y - mid, 0.0, u))
+        too_big = s > budget            # need a larger threshold
+        return (jnp.where(too_big, mid, b_lo),
+                jnp.where(too_big, b_hi, mid))
+
+    lo, hi = lax.fori_loop(0, PROJECT_ITERS, body, (lo, hi))
+    tau = 0.5 * (lo + hi)
+    return jnp.clip(y - tau, 0.0, u)
+
+
+def _objective(x: jnp.ndarray, cost: jnp.ndarray, curv: jnp.ndarray,
+               coll: jnp.ndarray, fairness_weight: jnp.ndarray
+               ) -> jnp.ndarray:
+    """f(x) = <cost, x> + (curv/2)|x|^2 + (w_f/2)|coll + x|^2 — the one
+    formula the solve minimizes, the rounded candidates are compared
+    with, and placement_objective() reports host-side. Keep all three in
+    lockstep or the never-worse-than-greedy selection stops meaning
+    anything."""
+    frag = jnp.sum(cost * x) + 0.5 * curv * jnp.sum(x * x)
+    fair = 0.5 * fairness_weight * jnp.sum((coll + x) ** 2)
+    return frag + fair
+
+
+def _round_to_budget(x: jnp.ndarray, u_int: jnp.ndarray,
+                     budget_int: jnp.ndarray) -> jnp.ndarray:
+    """Fractional iterate -> integral placement, on device: floor, then
+    hand the remaining budget to the largest fractional parts, never
+    exceeding a node's integral capacity u_int — the rounded placement
+    is AllocsFit-feasible by construction (floor of a capacity-clipped
+    iterate can only undershoot)."""
+    base = jnp.minimum(jnp.floor(x).astype(jnp.int32), u_int)
+    rem = jnp.maximum(budget_int - jnp.sum(base), 0)
+    frac = jnp.where(base < u_int, x - base.astype(jnp.float32), -1.0)
+    order = jnp.argsort(-frac)
+    eligible = (base < u_int)[order] & (frac[order] >= 0.0)
+    take = eligible & (jnp.cumsum(eligible.astype(jnp.int32)) <= rem)
+    placed_sorted = base[order] + take.astype(jnp.int32)
+    return jnp.zeros_like(base).at[order].set(placed_sorted)
+
+
+def convex_eval(cap_res, used_res, idx, valid, ask, count, feasible,
+                max_per_node, affinity_boost, job_collisions, class_ids,
+                distinct_hosts, max_iters, tolerance, fairness_weight,
+                quota_budget, spread_algorithm: bool = False,
+                n_classes: int = 0) -> tuple:
+    """The whole convex solve as ONE traced body — jitted by the backend
+    into a single compiled program (solo, or mesh-spec'd by
+    sharding.sharded_convex with the node axis partitioned; the global
+    sums/min/max/argsort lower to GSPMD psum/all-gather collectives).
+
+    Dynamic scalars (count, max_per_node, max_iters, tolerance,
+    fairness_weight, quota_budget) are runtime args, so hot-reloading
+    the operator knobs never recompiles. Returns
+      (placed i32[B], fit bool[B], iterations i32, objective_gap f32,
+       convex_won bool[, counts, dim_exh, class_exh, class_dh])
+    — one device_get materializes everything, gauges included."""
+    cap, used = gather_rows(cap_res, used_res, idx, valid)
+    u_int = jnp.minimum(instance_capacity(cap, used, ask, feasible),
+                        max_per_node)                       # i32[B]
+    u = u_int.astype(jnp.float32)
+    count_f = count.astype(jnp.float32) if hasattr(count, "astype") \
+        else jnp.float32(count)
+    budget = jnp.minimum(jnp.minimum(count_f, quota_budget), jnp.sum(u))
+    budget = jnp.maximum(budget, 0.0)
+    budget_int = budget.astype(jnp.int32)
+
+    # node preference: the same ScoreFitBinPack/Spread the greedy ladder
+    # ranks by (scored WITH the candidate instance placed, rank.go:479),
+    # normalized to [0, 1] cost (lower = better), affinity subtracted
+    pref = score_fit(cap, used + ask[None, :], spread=spread_algorithm)
+    cost = (BINPACK_MAX_SCORE - pref) / BINPACK_MAX_SCORE
+    cost = cost - affinity_boost
+    curv = jnp.float32(CURV_SPREAD if spread_algorithm else CURV_BINPACK)
+    coll = job_collisions.astype(jnp.float32)
+    step = 1.0 / (curv + fairness_weight + 1e-6)
+
+    # feasible interior start: capacity-proportional budget split — a
+    # deterministic function of the inputs, so fixed seeds replay bits
+    x0 = u * (budget / jnp.maximum(jnp.sum(u), 1.0))
+
+    def cond(carry):
+        _, it, gap = carry
+        return (it < max_iters) & (gap > tolerance)
+
+    def body(carry):
+        x, it, _ = carry
+        g = cost + curv * x + fairness_weight * (coll + x)
+        x2 = _projection_bracket(x - step * g, u, budget)
+        f_old = _objective(x, cost, curv, coll, fairness_weight)
+        f_new = _objective(x2, cost, curv, coll, fairness_weight)
+        gap = jnp.abs(f_old - f_new) / (1.0 + jnp.abs(f_new))
+        return x2, it + 1, gap
+
+    x, iters, gap = lax.while_loop(
+        cond, body, (x0, jnp.int32(0), jnp.float32(jnp.inf)))
+
+    placed_cvx = _round_to_budget(x, u_int, budget_int)
+    fit_cvx = plan_fit_verdict(cap, used, ask, placed_cvx)
+
+    # the in-program greedy baseline on the SAME budget: the convex
+    # candidate must beat it on the combined objective, place at least
+    # as many instances, and round feasible — else the greedy fill IS
+    # the emitted placement (still one dispatch, nothing stranded)
+    placed_greedy = fill_greedy_binpack(cap, used, ask, budget_int,
+                                        feasible, max_per_node)
+    obj_cvx = _objective(placed_cvx.astype(jnp.float32), cost, curv,
+                         coll, fairness_weight)
+    obj_greedy = _objective(placed_greedy.astype(jnp.float32), cost,
+                            curv, coll, fairness_weight)
+    convex_won = (jnp.all(fit_cvx)
+                  & (obj_cvx <= obj_greedy + 1e-6)
+                  & (jnp.sum(placed_cvx) >= jnp.sum(placed_greedy)))
+    placed = jnp.where(convex_won, placed_cvx, placed_greedy)
+    fit = plan_fit_verdict(cap, used, ask, placed)
+    out = (placed, fit, iters, gap, convex_won)
+    if not n_classes:
+        return out
+    ex = _explain_reduce_impl(cap, used, ask, feasible, job_collisions,
+                              placed, class_ids, distinct_hosts,
+                              n_classes=n_classes)
+    return out + ex
+
+
+def placement_objective(cap, used, ask, placed, job_collisions=None,
+                        spread: bool = False,
+                        fairness_weight: float = 0.0) -> dict:
+    """The convex objective evaluated host-side on an INTEGRAL placement
+    — the differential oracle tests/bench compare greedy-vs-convex with.
+    Must stay formula-identical to _objective (it is the same code path:
+    eager jnp on host arrays). Returns the split the bench JSON records:
+    {"total", "fragmentation", "fairness"}."""
+    x = jnp.asarray(placed).astype(jnp.float32)
+    cap = jnp.asarray(cap, jnp.float32)
+    used = jnp.asarray(used, jnp.float32)
+    ask = jnp.asarray(ask, jnp.float32)
+    pref = score_fit(cap, used + ask[None, :], spread=spread)
+    cost = (BINPACK_MAX_SCORE - pref) / BINPACK_MAX_SCORE
+    curv = jnp.float32(CURV_SPREAD if spread else CURV_BINPACK)
+    coll = (jnp.zeros_like(x) if job_collisions is None
+            else jnp.asarray(job_collisions).astype(jnp.float32))
+    frag = float(jnp.sum(cost * x) + 0.5 * curv * jnp.sum(x * x))
+    fair = float(0.5 * jnp.float32(fairness_weight)
+                 * jnp.sum((coll + x) ** 2))
+    return {"total": frag + fair, "fragmentation": frag, "fairness": fair}

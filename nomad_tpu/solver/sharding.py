@@ -543,6 +543,41 @@ def sharded_fused(mesh: Mesh, kernel: str = "depth", k_max: int = 16,
                                        out_shardings=out_sh))
 
 
+def sharded_convex(mesh: Mesh, spread_algorithm: bool = False,
+                   n_classes: int = 0, axis: str = "nodes"):
+    """The convex placement solve (convex.convex_eval, ISSUE 19) with
+    the resident twins consumed PARTITIONED, riding the exact node-spec
+    in/out contract of sharded_fused: cap_res/used_res chain off the
+    resident pair with zero re-scatter, the bucket-axis vectors
+    (feasible/affinity/collisions/class_ids) shard alongside, and
+    placed/fit carry the node spec back out. The projected-gradient
+    iterate x stays partitioned across shards for the whole
+    `lax.while_loop`; the global reduces (budget sum, water-filling
+    bisection sums, objective values, argsort ranks in the rounding and
+    the greedy baseline) lower to GSPMD psum/all-gather collectives —
+    still ONE launch. Iterations/gap/convex_won come out replicated."""
+    from .convex import convex_eval
+    nd = NamedSharding(mesh, P(axis, None))
+    nv = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    def run(cap_res, used_res, idx, valid, ask, count, feasible, mpn,
+            aff, coll, class_ids, dh, max_iters, tolerance,
+            fairness_weight, quota_budget):
+        return convex_eval(cap_res, used_res, idx, valid, ask, count,
+                           feasible, mpn, aff, coll, class_ids, dh,
+                           max_iters, tolerance, fairness_weight,
+                           quota_budget, spread_algorithm=spread_algorithm,
+                           n_classes=n_classes)
+
+    in_sh = (nd, nd, rep, rep, rep, rep, nv, rep,
+             nv, nv, nv, rep, rep, rep, rep, rep)
+    out_sh = (nv, nv, rep, rep, rep) + \
+        ((rep, rep, rep, rep) if n_classes else ())
+    return _serialize_launches(jax.jit(run, in_shardings=in_sh,
+                                       out_shardings=out_sh))
+
+
 def sharded_preempt_top_k(mesh: Mesh, axis: str = "nodes"):
     """Batched preemption victim selection with the CANDIDATE-NODE axis
     sharded: each shard runs its nodes' masked top-k victim scans

@@ -356,17 +356,24 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     dev_bucket = bucket
     tier = ""
     from . import backend
-    fused = backend.fused_enabled(getattr(ctx, "scheduler_config", None))
+    cfg = getattr(ctx, "scheduler_config", None)
+    fused = backend.fused_enabled(cfg)
+    # the convex tier (ISSUE 19) rides the SAME zero-launch resident
+    # handle as the fused path — a fused kill-switch must not strip the
+    # twins out from under an in-force "convex" algorithm
+    cvx = cfg is not None and backend.convex_enabled(
+        cfg, cfg.effective_scheduler_algorithm())
     from .sharding import mesh as _mesh
-    if fused or _mesh() is not None:
+    if fused or cvx or _mesh() is not None:
         tier = backend._tier(bucket, count)[0]
     if fused and tier == "pallas":
         # pallas-resolved shapes DECLINE fusion (select_fused: the VMEM
         # hand kernel owns them) — keep the classic resident-twin gather
         # here or the decline would re-upload cap/used per eval, the
-        # exact transfer ISSUE 4 removed
+        # exact transfer ISSUE 4 removed (convex instead REMAPS pallas
+        # to xla, so it keeps wanting the resident handle)
         fused = False
-    if not fused and _mesh() is not None and \
+    if not (fused or cvx) and _mesh() is not None and \
             tier not in ("sharded", "xla", "pallas"):
         dev_bucket = 0
     # `tier` rides along so the cache can also decline the mismatch case
@@ -375,7 +382,7 @@ def _build_dense(ctx, job, tg: TaskGroup, nodes: list[Node], feasible_fn,
     # the cache hands back the zero-launch resident handle and the fused
     # program gathers inside its one dispatch.
     cached = state_cache.gather(view, rows, bucket=dev_bucket, tier=tier,
-                                fused=fused)
+                                fused=fused or cvx)
     gen = None
     resident = None
     res_version, res_uid, res_epoch = -1, 0, -1

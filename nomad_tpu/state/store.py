@@ -1125,6 +1125,19 @@ class StateStore:
             return [self.allocs[a] for a in self._allocs_by_eval.get(eval_id, ())
                     if a in self.allocs]
 
+    def namespace_alloc_counts(self) -> dict[str, int]:
+        """Per-namespace allocation counts off the job index — the
+        per-tenant usage signal the convex tier's quota budget reads
+        (ISSUE 19). Counts index membership (includes recently-stopped
+        allocs until GC), so it is a smoothed usage signal, not an exact
+        running-instance census — quotas gate NEW placements, where
+        over-counting errs safe."""
+        with self._lock:
+            counts: dict[str, int] = {}
+            for (ns, _job), ids in self._allocs_by_job.items():
+                counts[ns] = counts.get(ns, 0) + len(ids)
+            return counts
+
     def iter_allocs(self) -> list[Allocation]:
         with self._lock:
             return list(self.allocs.values())
@@ -1614,6 +1627,14 @@ class StateSnapshot:
     def evals_by_job(self, ns: str, job_id: str) -> list[Evaluation]:
         return [self.evals[e] for e in self._evals_by_job.get((ns, job_id), ())
                 if e in self.evals]
+
+    def namespace_alloc_counts(self) -> dict[str, int]:
+        """Snapshot twin of StateStore.namespace_alloc_counts — the
+        convex quota budget reads whichever state view the eval holds."""
+        counts: dict[str, int] = {}
+        for (ns, _job), ids in self._allocs_by_job.items():
+            counts[ns] = counts.get(ns, 0) + len(ids)
+        return counts
 
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
         return self.deployments.get(deployment_id)

@@ -75,7 +75,7 @@ from .funcs import (  # noqa: F401
 )
 from .operator import (  # noqa: F401
     PreemptionConfig, SchedulerConfiguration,
-    SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU,
+    SCHED_ALG_BINPACK, SCHED_ALG_CONVEX, SCHED_ALG_SPREAD, SCHED_ALG_TPU,
     VALID_SCHEDULER_ALGORITHMS,
 )
 from .csi import (  # noqa: F401

@@ -10,8 +10,10 @@ from dataclasses import dataclass, field
 SCHED_ALG_BINPACK = "binpack"
 SCHED_ALG_SPREAD = "spread"
 SCHED_ALG_TPU = "tpu-batch"   # the new one: batched JAX/XLA solve
+SCHED_ALG_CONVEX = "convex"   # ISSUE 19: global projected-gradient solve
 
-VALID_SCHEDULER_ALGORITHMS = (SCHED_ALG_BINPACK, SCHED_ALG_SPREAD, SCHED_ALG_TPU)
+VALID_SCHEDULER_ALGORITHMS = (SCHED_ALG_BINPACK, SCHED_ALG_SPREAD,
+                              SCHED_ALG_TPU, SCHED_ALG_CONVEX)
 
 
 @dataclass
@@ -162,6 +164,31 @@ class SchedulerConfiguration:
                               for bench legs.
       raft_fsync_interval_ms  append-fsync pacing for raft_fsync =
                               interval.
+      solver_convex_enabled   global convex placement tier (ISSUE 19):
+                              with scheduler_algorithm = "convex", solve
+                              the whole eval as ONE on-device projected-
+                              gradient program (binpack/spread/affinity
+                              objective + per-tenant quota budget +
+                              namespace-stacking fairness), demoting to
+                              the greedy ladder via the tier breaker on
+                              any failure. False pins the greedy ladder
+                              even under the convex algorithm;
+                              NOMAD_SOLVER_CONVEX=0/1 env overrides
+                              (docs/BACKEND_TIERS.md).
+      solver_convex_max_iters projected-gradient iteration ceiling (the
+                              `lax.while_loop` bound; convergence
+                              usually stops the loop far earlier).
+      solver_convex_tolerance relative objective-decrease threshold that
+                              declares convergence.
+      solver_convex_fairness_weight
+                              weight of the namespace-stacking fairness
+                              term in the objective; 0 solves pure
+                              fragmentation.
+      solver_convex_namespace_quota
+                              per-tenant (namespace) running-instance
+                              budget the convex solve hard-caps each
+                              eval's placement count against; 0 = no
+                              quota.
     """
     scheduler_algorithm: str = SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
@@ -198,6 +225,16 @@ class SchedulerConfiguration:
     # resident twins. Placements are bit-identical on or off;
     # NOMAD_SOLVER_FUSED=0/1 env force-overrides (bench parity legs).
     solver_fused_enabled: bool = True
+    # global convex placement tier (ISSUE 19): cluster-wide allocation
+    # as one on-device projected-gradient solve when the operator picks
+    # scheduler_algorithm = "convex". All four knobs are runtime scalars
+    # of the compiled program — hot-reloading them never recompiles.
+    # NOMAD_SOLVER_CONVEX=0/1 env force-overrides (bench parity legs).
+    solver_convex_enabled: bool = True
+    solver_convex_max_iters: int = 200
+    solver_convex_tolerance: float = 1e-4
+    solver_convex_fairness_weight: float = 0.05
+    solver_convex_namespace_quota: int = 0
     raft_fsync: str = "always"
     raft_fsync_interval_ms: float = 50.0
     create_index: int = 0
@@ -252,6 +289,14 @@ class SchedulerConfiguration:
                     "flap_damping_backoff_s")
         if self.placement_explain_recent < 1:
             return "placement_explain_recent must be >= 1"
+        if self.solver_convex_max_iters < 1:
+            return "solver_convex_max_iters must be >= 1"
+        if self.solver_convex_tolerance <= 0:
+            return "solver_convex_tolerance must be > 0"
+        if self.solver_convex_fairness_weight < 0:
+            return "solver_convex_fairness_weight must be >= 0"
+        if self.solver_convex_namespace_quota < 0:
+            return "solver_convex_namespace_quota must be >= 0 (0 = no quota)"
         if self.raft_fsync not in ("always", "interval", "never"):
             return ("raft_fsync must be one of 'always', 'interval', "
                     "'never'")

@@ -599,6 +599,49 @@ def test_fused_stream_gate():
         f"contract (one dispatch + one device_get per eval) regressed")
 
 
+def test_convex_gate():
+    """ISSUE 19 acceptance: once a bench records the convex block, the
+    convex-tier lineage must show the convex route actually dispatching
+    under the stream, round-trips-per-eval p50 <= 1 (the one-dispatch
+    contract), ZERO feasibility violations on the pinned 10k-node
+    fragmented differential (host AllocsFit oracle re-walk), instance
+    parity with greedy, and the combined fragmentation+fairness
+    objective never worse than greedy — STRUCTURAL keys only, so the
+    gate arms identically on a loaded 1-core box and a TPU pod."""
+    history = _bench_history()
+    if not history:
+        pytest.skip("no BENCH_*.json recorded yet")
+    latest_round, latest = history[-1]
+    cx = latest.get("convex")
+    if isinstance(cx, dict) and "error" in cx:
+        pytest.fail(f"BENCH_r{latest_round:02d}: convex lineage run "
+                    f"crashed: {cx['error']}")
+    if not isinstance(cx, dict) or "feasibility_violations" not in cx:
+        pytest.skip(f"BENCH_r{latest_round:02d} predates the convex "
+                    f"lineage")
+    assert cx.get("convex_dispatches", 0) > 0, (
+        f"BENCH_r{latest_round:02d}: the convex route never dispatched "
+        f"— the lineage proved nothing")
+    assert cx["round_trips_p50"] <= 1, (
+        f"BENCH_r{latest_round:02d}: round_trips_p50 "
+        f"{cx['round_trips_p50']} > 1 — the convex one-dispatch "
+        f"contract (one compiled solve + one device_get) regressed")
+    assert cx["feasibility_violations"] == 0, (
+        f"BENCH_r{latest_round:02d}: {cx['feasibility_violations']} "
+        f"nodes over capacity after rounding — the AllocsFit re-check "
+        f"inside the convex program is broken")
+    assert cx.get("all_fit") is True
+    assert cx.get("placed", 0) == cx.get("greedy_placed", -1), (
+        f"BENCH_r{latest_round:02d}: convex placed {cx.get('placed')} "
+        f"vs greedy {cx.get('greedy_placed')} — instance-count parity "
+        f"with the greedy baseline is broken")
+    assert cx.get("objective_delta", -1.0) >= 0.0, (
+        f"BENCH_r{latest_round:02d}: convex objective worse than "
+        f"greedy by {-cx.get('objective_delta', 0.0)} — the in-program "
+        f"greedy-baseline argmin guarantee regressed")
+    assert cx.get("iterations", 0) >= 1
+
+
 def test_read_storm_gate():
     """ISSUE 16 acceptance: once a bench records the read_storm block,
     the read-path lineage must show (a) a nonzero follower-served

@@ -1,0 +1,366 @@
+"""Global convex placement tier differential suite (ISSUE 19): the
+greedy-vs-convex differential over fuzzed clusters (feasibility by the
+host AllocsFit oracle, objective never worse than greedy, bit-determinism
+under a fixed seed), the one-dispatch round-trip contract, breaker
+demotion bit-identical to a never-convex run, and device-loss mid-solve
+replaying at the new generation with zero evals lost.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from nomad_tpu import faults, mock
+from nomad_tpu.metrics import metrics
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.solver import (
+    backend, buckets, convex, microbatch, sharding, state_cache,
+)
+from nomad_tpu.solver.kernels import FIT_EPS, NUM_XR, fill_greedy_binpack
+from nomad_tpu.solver.state_cache import cache
+from nomad_tpu.structs import (
+    Evaluation, SchedulerConfiguration, SCHED_ALG_CONVEX, SCHED_ALG_TPU,
+)
+
+from test_solver import Harness
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("NOMAD_SOLVER_CONVEX", raising=False)
+    faults.clear()
+    state_cache.reset()
+    backend.reset()
+    microbatch.reset()
+    yield
+    faults.clear()
+    state_cache.reset()
+    backend.reset()
+    microbatch.reset()
+
+
+# ------------------------------------------------ fuzzed kernel differential
+
+_B = 128        # one bucket -> one compile across every fuzz case
+
+
+def _fuzz_cluster(rng):
+    """A fragmented cluster: uniform caps, beta-skewed usage (most nodes
+    part-full, a few nearly exhausted), random same-job collisions."""
+    cap = np.zeros((_B, NUM_XR), np.float32)
+    cap[:] = (4_000.0, 8_192.0, 500_000.0, 12_001.0, 10_000.0)
+    used = np.zeros_like(cap)
+    used[:, 0] = (rng.beta(2, 3, _B) * 3_900).astype(np.float32)
+    used[:, 1] = (rng.beta(2, 3, _B) * 8_000).astype(np.float32)
+    used[:, 2] = (rng.beta(2, 5, _B) * 400_000).astype(np.float32)
+    feasible = rng.random(_B) > 0.1
+    coll = rng.integers(0, 4, _B).astype(np.int32)
+    ask = np.zeros(NUM_XR, np.float32)
+    ask[:3] = (250.0, 512.0, 300.0)
+    return cap, used, feasible, coll, ask
+
+
+def _convex_fn(spread=False):
+    return jax.jit(lambda *a: convex.convex_eval(
+        *a, spread_algorithm=spread, n_classes=0))
+
+
+def _solve(fn, cap, used, feasible, coll, ask, count, *,
+           fairness=0.05, budget=float(2 ** 30), max_iters=200):
+    idx = np.arange(_B, dtype=np.int32)
+    valid = np.ones(_B, bool)
+    return jax.device_get(fn(
+        np.asarray(cap), np.asarray(used), idx, valid, ask,
+        np.int32(count), feasible, np.int32(2 ** 30),
+        np.zeros(_B, np.float32), coll, np.zeros(_B, np.int32),
+        np.bool_(False), np.int32(max_iters), np.float32(1e-4),
+        np.float32(fairness), np.float32(budget)))
+
+
+@pytest.mark.parametrize("spread", [False, True])
+def test_fuzzed_convex_feasible_and_never_worse_than_greedy(spread):
+    """The acceptance differential: over fuzzed fragmented clusters the
+    convex placement (a) always passes the host AllocsFit oracle re-walk
+    at the applier's epsilon, (b) places exactly as many instances as
+    greedy, and (c) is never worse on the combined fragmentation +
+    fairness objective."""
+    rng = np.random.default_rng(20260806)
+    fn = _convex_fn(spread)
+    for case in range(10):
+        cap, used, feasible, coll, ask = _fuzz_cluster(rng)
+        count = int(rng.integers(1, 80))
+        placed, fit, iters, gap, won = _solve(
+            fn, cap, used, feasible, coll, ask, count)
+        # host oracle: the same AllocsFit arithmetic the plan applier
+        # re-checks, re-walked in numpy
+        post = used + placed[:, None].astype(np.float32) * ask[None, :]
+        assert (post <= cap + FIT_EPS).all(), f"case {case}: infeasible"
+        assert (placed[~feasible] == 0).all()
+        assert fit.all()
+        greedy = np.asarray(jax.device_get(fill_greedy_binpack(
+            cap, used, ask, np.int32(count), feasible, np.int32(2 ** 30))))
+        assert placed.sum() == greedy.sum(), \
+            f"case {case}: placement-count parity broken"
+        oc = convex.placement_objective(cap, used, ask, placed, coll,
+                                        spread, 0.05)
+        og = convex.placement_objective(cap, used, ask, greedy, coll,
+                                        spread, 0.05)
+        assert oc["total"] <= og["total"] + 1e-3, \
+            f"case {case}: convex worse than greedy"
+        assert int(iters) >= 1 and np.isfinite(float(gap))
+
+
+def test_fuzzed_convex_bit_deterministic():
+    rng = np.random.default_rng(7)
+    fn = _convex_fn()
+    cap, used, feasible, coll, ask = _fuzz_cluster(rng)
+    a = _solve(fn, cap, used, feasible, coll, ask, 40)
+    b = _solve(fn, cap, used, feasible, coll, ask, 40)
+    assert (a[0] == b[0]).all() and int(a[2]) == int(b[2])
+
+
+def test_quota_budget_hard_caps_the_placement():
+    rng = np.random.default_rng(11)
+    fn = _convex_fn()
+    cap, used, feasible, coll, ask = _fuzz_cluster(rng)
+    placed, fit, *_ = _solve(fn, cap, used, feasible, coll, ask, 40,
+                             budget=5.0)
+    assert placed.sum() == 5
+    post = used + placed[:, None].astype(np.float32) * ask[None, :]
+    assert (post <= cap + FIT_EPS).all() and fit.all()
+
+
+def test_fairness_weight_levels_stacking():
+    """With heavy same-job collisions on half the nodes, a positive
+    fairness weight must move placements off the stacked half relative
+    to the fairness-off solve — and still beat greedy on ITS objective."""
+    rng = np.random.default_rng(13)
+    cap, used, feasible, coll, ask = _fuzz_cluster(rng)
+    feasible = np.ones(_B, bool)
+    coll = np.zeros(_B, np.int32)
+    coll[:_B // 2] = 6
+    fn = _convex_fn()
+    fair, *_ = _solve(fn, cap, used, feasible, coll, ask, 60,
+                      fairness=2.0)
+    flat, *_ = _solve(fn, cap, used, feasible, coll, ask, 60,
+                      fairness=0.0)
+    assert fair[:_B // 2].sum() <= flat[:_B // 2].sum(), \
+        "fairness weight failed to shift load off the stacked nodes"
+
+
+# ------------------------------------------------------- e2e via scheduler
+
+def _run_convex(count: int, eval_id: str, n_nodes: int = 16, **cfg_kw):
+    """One fixed-seed scheduler run under the convex algorithm; returns
+    frozenset of (alloc name, node) assignments (the bit-identity
+    witness, same shape as test_state_cache._run_placements)."""
+    random.seed(1234)
+    h = Harness()
+    h.state.set_scheduler_config(
+        h.get_next_index(),
+        SchedulerConfiguration(scheduler_algorithm=SCHED_ALG_CONVEX,
+                               **cfg_kw))
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        n.name = f"cx-{i}"
+        h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    job.id = job.name = f"cx-job-{count}"
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.networks = []
+    t = tg.tasks[0]
+    t.resources.networks = []
+    t.resources.cpu = 250
+    t.resources.memory_mb = 128
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(id=eval_id, job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    allocs = h.state.allocs_by_job("default", job.id)
+    assert len(allocs) == count, "evals lost placements"
+    # host AllocsFit oracle over the COMMITTED placements: per-node
+    # usage summed from the store never exceeds capacity
+    per_node: dict = {}
+    for a in allocs:
+        per_node[a.node_id] = per_node.get(a.node_id, 0) + 1
+    for node_id, k in per_node.items():
+        assert k * 250 <= 4_000 + FIT_EPS, "node over cpu capacity"
+        assert k * 128 <= 8_192 + FIT_EPS, "node over memory capacity"
+    return frozenset((a.name, a.node_id, i)
+                     for i, a in enumerate(sorted(
+                         allocs, key=lambda a: (a.node_id, a.name, a.id))))
+
+
+def test_convex_algorithm_engages_and_is_deterministic():
+    c0 = metrics.counter("nomad.solver.dispatch.convex")
+    first = _run_convex(48, "cx-eval-det")
+    assert metrics.counter("nomad.solver.dispatch.convex") > c0, \
+        "the convex route never engaged"
+    state_cache.reset()
+    backend.reset()
+    second = _run_convex(48, "cx-eval-det")
+    assert first == second
+
+
+def test_convex_eval_counts_at_most_one_round_trip():
+    """The structural 1: a convex eval is ONE dispatch + ONE device_get,
+    exactly the PR-15 fused contract."""
+    skip = metrics.sample_count("nomad.solver.device_round_trips")
+    _run_convex(48, "cx-rt-eval")
+    assert metrics.sample_count("nomad.solver.device_round_trips") > skip
+    worst = metrics.percentile("nomad.solver.device_round_trips", 1.0,
+                               skip=skip)
+    assert worst <= 1, (
+        f"convex eval paid {worst} device round trips — the one-dispatch "
+        f"contract is one compiled solve + one device_get")
+
+
+def test_convex_gauges_ride_the_solve():
+    _run_convex(48, "cx-gauge-eval")
+    snap = metrics.snapshot()["gauges"]
+    assert snap.get("nomad.solver.convex.iterations", 0) >= 1
+    assert "nomad.solver.convex.objective_gap" in snap
+
+
+def test_kill_switch_pins_the_greedy_ladder(monkeypatch):
+    """NOMAD_SOLVER_CONVEX=0 under the convex algorithm must serve the
+    exact never-convex bits (the fused/classic route)."""
+    monkeypatch.setenv("NOMAD_SOLVER_CONVEX", "0")
+    c0 = metrics.counter("nomad.solver.dispatch.convex")
+    off = _run_convex(48, "cx-kill-eval")
+    assert metrics.counter("nomad.solver.dispatch.convex") == c0
+    state_cache.reset()
+    backend.reset()
+    monkeypatch.delenv("NOMAD_SOLVER_CONVEX")
+    monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+    monkeypatch.setenv("NOMAD_SOLVER_CONVEX", "0")
+    classic = _run_convex(48, "cx-kill-eval")
+    assert off == classic
+
+
+def test_env_force_engages_convex_under_tpu_batch(monkeypatch):
+    """NOMAD_SOLVER_CONVEX=1 forces the convex tier even when the
+    operator algorithm is tpu-batch (the bench parity lever)."""
+    from test_state_cache import _run_placements
+    monkeypatch.setenv("NOMAD_SOLVER_CONVEX", "1")
+    c0 = metrics.counter("nomad.solver.dispatch.convex")
+    _run_placements(48, "cx-force-eval")
+    assert metrics.counter("nomad.solver.dispatch.convex") > c0
+
+
+def test_breaker_demotion_bit_identical_to_never_convex(monkeypatch):
+    """A convex dispatch failure demotes through the breaker to the
+    classic ladder from the uncommitted host args — placements
+    bit-identical to a run where convex never existed, zero evals
+    lost."""
+    monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+    monkeypatch.setenv("NOMAD_SOLVER_CONVEX", "0")
+    never = _run_convex(48, "cx-demo-eval")
+    state_cache.reset()
+    backend.reset()
+    monkeypatch.delenv("NOMAD_SOLVER_CONVEX")
+    d0 = metrics.counter("nomad.solver.tier_demotions.convex")
+    faults.install({"solver.dispatch.convex": {"mode": "raise"}})
+    try:
+        demoted = _run_convex(48, "cx-demo-eval")
+    finally:
+        faults.clear()
+    assert metrics.counter("nomad.solver.tier_demotions.convex") > d0, \
+        "the fault never forced a demotion"
+    assert demoted == never
+
+
+@pytest.mark.chaos
+def test_device_loss_mid_solve_replays_at_new_generation(monkeypatch):
+    """A device loss inside the convex dispatch quarantines + rebuilds
+    (ISSUE 14) and the eval re-solves through the classic ladder at the
+    NEW generation from uncommitted host args — zero evals lost,
+    placements bit-identical to the never-convex (classic) run."""
+    sharding.reset()
+    buckets._reset_shards()
+    try:
+        monkeypatch.setenv("NOMAD_SOLVER_FUSED", "0")
+        monkeypatch.setenv("NOMAD_SOLVER_CONVEX", "0")
+        never = _run_convex(48, "cx-loss-eval")
+        state_cache.reset()
+        backend.reset()
+        monkeypatch.delenv("NOMAD_SOLVER_CONVEX")
+        gen0 = sharding.generation()
+        r0 = metrics.counter("nomad.mesh.replays")
+        faults.install({"device.lost.d0": {"mode": "nth_call", "n": 1,
+                                           "times": 1}})
+        try:
+            got = _run_convex(48, "cx-loss-eval")
+        finally:
+            faults.clear()
+        assert got == never, "loss recovery diverged from the classic path"
+        assert sharding.generation() > gen0, "the loss never rebuilt"
+        assert metrics.counter("nomad.mesh.replays") > r0
+    finally:
+        sharding.reset()
+        buckets._reset_shards()
+
+
+@pytest.mark.chaos
+def test_sharded_convex_parity_with_solo(monkeypatch):
+    """Forced-sharded tier: the convex program consumes the PARTITIONED
+    resident twins (sharding.sharded_convex's node-spec contract) and
+    places bit-identically to the solo convex solve."""
+    solo = _run_convex(48, "cx-shard-eval")
+    state_cache.reset()
+    backend.reset()
+    monkeypatch.setenv("NOMAD_SOLVER_BACKEND", "sharded")
+    sharding.reset()
+    buckets._reset_shards()
+    c0 = metrics.counter("nomad.solver.dispatch.convex.sharded")
+    try:
+        shard = _run_convex(48, "cx-shard-eval")
+        assert metrics.counter(
+            "nomad.solver.dispatch.convex.sharded") > c0, \
+            "the sharded convex route never engaged"
+        assert cache().stats()["twins_sharded"], \
+            "forced sharded seeding regressed"
+        assert shard == solo
+    finally:
+        sharding.reset()
+        buckets._reset_shards()
+
+
+def test_convex_knobs_validate():
+    assert SchedulerConfiguration(
+        solver_convex_max_iters=0).validate() != ""
+    assert SchedulerConfiguration(
+        solver_convex_tolerance=0.0).validate() != ""
+    assert SchedulerConfiguration(
+        solver_convex_fairness_weight=-1.0).validate() != ""
+    assert SchedulerConfiguration(
+        solver_convex_namespace_quota=-1).validate() != ""
+    assert SchedulerConfiguration(
+        scheduler_algorithm=SCHED_ALG_CONVEX).validate() == ""
+
+
+def test_namespace_alloc_counts_tracks_the_job_index():
+    h = Harness()
+    assert h.state.namespace_alloc_counts() == {}
+    n = mock.node()
+    n.id = "node-0000"
+    h.state.upsert_node(h.get_next_index(), n)
+    job = mock.batch_job()
+    job.id = job.name = "ns-count-job"
+    tg = job.task_groups[0]
+    tg.count = 3
+    tg.networks = []
+    t = tg.tasks[0]
+    t.resources.networks = []
+    t.resources.cpu = 100
+    t.resources.memory_mb = 64
+    h.state.upsert_job(h.get_next_index(), job)
+    ev = Evaluation(id="ns-count-eval", job_id=job.id, type=job.type)
+    h.process(lambda s, p: new_scheduler(job.type, s, p), ev)
+    counts = h.state.namespace_alloc_counts()
+    assert counts.get("default") == 3
+    # the snapshot view answers identically
+    assert h.state.snapshot().namespace_alloc_counts() == counts

@@ -566,7 +566,7 @@ def test_rule_catalog_is_complete():
             "EXC001", "PERF001", "LEAD001", "OBS001", "OBS002",
             "QUEUE001", "SHARD001", "MESH001", "SYNC001",
             "READ001", "LINT000", "LOCK002", "LOCK003",
-            "REG001", "REG002", "RPC001"} <= ids
+            "REG001", "REG002", "RPC001", "CVX001"} <= ids
     assert all(r.short for r in all_rules())
 
 
@@ -1349,6 +1349,69 @@ def test_sync001_inline_suppression_at_the_seam():
         "  # nomadlint: disable=SYNC001 — the designated seam")
     assert rule_ids(src, path="solver/placer.py") == \
         ["SYNC001"] * 2
+
+
+# ---------------------------------------------------------------- CVX001
+
+CVX001_BAD = """
+    import jax.numpy as jnp
+    from jax import lax
+    from .kernels import plan_fit_verdict
+
+    def solve(x, u, budget, max_iters, cap, used, ask):
+        for _ in range(int(max_iters)):
+            x = jnp.clip(x - 0.1, 0.0, u)
+        it = 0
+        while it < 50:
+            s = jnp.sum(x)
+            it += 1
+        verdicts = []
+        for k in range(3):
+            verdicts.append(plan_fit_verdict(cap, used, ask, x))
+        return x, s, verdicts
+"""
+
+
+def test_cvx001_fires_on_python_loops_around_device_math():
+    out = findings(CVX001_BAD, path="solver/convex.py")
+    assert [f.rule for f in out] == ["CVX001"] * 3
+    assert "one-dispatch" in out[0].message.lower() or \
+        "lax.while_loop" in out[0].message
+
+
+def test_cvx001_scope_and_exemptions():
+    # scope: only the convex solve module is patrolled
+    assert rule_ids(CVX001_BAD, path="solver/kernels.py") == []
+    assert rule_ids(CVX001_BAD, path="solver/placer.py") == []
+    good = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def solve(x0, u, budget, cost, max_iters, tolerance):
+            def body(carry):
+                x, it = carry
+                return jnp.clip(x - 0.1 * cost, 0.0, u), it + 1
+
+            def cond(carry):
+                return carry[1] < max_iters
+
+            x, it = lax.while_loop(cond, body, (x0, 0))
+            lo, hi = lax.fori_loop(0, 50, lambda i, b: b, (0.0, 1.0))
+            # host-side bookkeeping loops with no device math are fine
+            names = []
+            for k in range(3):
+                names.append(str(k))
+            return x, it, lo, hi, names
+    """
+    assert rule_ids(good, path="solver/convex.py") == []
+
+
+def test_cvx001_inline_suppression():
+    src = CVX001_BAD.replace(
+        "        while it < 50:",
+        "        while it < 50:"
+        "  # nomadlint: disable=CVX001 — deliberate host probe")
+    assert rule_ids(src, path="solver/convex.py") == ["CVX001"] * 2
 
 
 # ---------------------------------------------------------------- READ001

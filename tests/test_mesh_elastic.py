@@ -329,7 +329,8 @@ def test_loss_during_aot_warmup_rebuilds_and_completes(monkeypatch):
     faults.clear()
     # both depth regimes+greedy+chunked, plus the ISSUE-15 fused trio
     # (whose chain re-selects at the post-loss generation and completes)
-    assert res["artifacts"] == 7
+    # and the ISSUE-19 convex pair (both spread modes, same discipline)
+    assert res["artifacts"] == 9
     assert metrics.counter("nomad.solver.warmup.errors") == 0
     assert sharding.generation() >= 1
     assert 6 in sharding.quarantined()

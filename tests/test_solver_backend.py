@@ -355,10 +355,11 @@ def test_warmup_compiles_the_grid(monkeypatch):
     # 2 depth regimes + greedy + chunked, plus the fused trio
     # (both depth regimes + greedy against synthetic resident twins,
     # ISSUE 15 — select_fused declines count for none of them at this
-    # bucket on the dev mesh)
-    assert out["artifacts"] == 7
+    # bucket on the dev mesh), plus the convex pair (both spread modes
+    # through the real select_convex chain, ISSUE 19)
+    assert out["artifacts"] == 9
     assert metrics.counter("nomad.solver.warmup.errors") == 0
-    assert metrics.counter("nomad.solver.warmup.artifacts") == 7
+    assert metrics.counter("nomad.solver.warmup.artifacts") == 9
 
 
 def test_warmup_budget_exhaustion_is_loud(monkeypatch):
