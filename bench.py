@@ -1543,6 +1543,176 @@ def _crash_recovery_run() -> dict:
     }
 
 
+WRITE_STORM_WRITERS = int(os.environ.get("NOMAD_WRITE_STORM_WRITERS", "16"))
+WRITE_STORM_OPS = int(os.environ.get("NOMAD_WRITE_STORM_OPS", "320"))
+
+
+def _write_storm_run() -> dict:
+    """Write-storm lineage (ISSUE 20, docs/DURABILITY.md "Group
+    commit"): the raft write path under CONCURRENT load at
+    fsync=always — the regime group commit exists for. Records, all
+    structural (this container is a 1-core box, so wall-clock keys are
+    reported but NOT gated — the note key says so):
+
+      * entries-per-fsync p50/max over the storm window — the
+        amortization evidence (16 writers must coalesce, p50 >= 4);
+      * fsyncs saved vs the one-fsync-per-entry serial discipline;
+      * zero lost commits across a restart — batching must not loosen
+        the ack-implies-durable contract;
+      * batched-vs-serial parity — the same op multiset driven through
+        the knob at 1 (the serial oracle) lands the same FSM content.
+
+    Gated by tests/test_bench_regression.py::test_write_storm_gate
+    once a BENCH_*.json carries the block."""
+    import shutil
+    import tempfile
+
+    from nomad_tpu.rpc.virtual import VirtualNetwork
+    from nomad_tpu.server import Server
+    from nomad_tpu.server.fsm import NODE_REGISTER
+
+    rng = np.random.default_rng(20)
+    writers = WRITE_STORM_WRITERS
+    per = max(1, WRITE_STORM_OPS // writers)
+    work = [[_mk_node(w * per + i, rng) for i in range(per)]
+            for w in range(writers)]
+
+    def _boot(root, net_seed):
+        net = VirtualNetwork(seed=net_seed)
+        s = Server(num_workers=0, gc_interval=9999)
+        s.rpc_listen_virtual(net, "s0")
+        s.enable_raft("s0", {"s0": s.rpc_addr}, data_dir=root,
+                      snapshot_threshold=1 << 30, seed=1,
+                      election_timeout=(0.2, 0.4),
+                      heartbeat_interval=0.05)
+        s.start()
+        deadline = time.time() + 20
+        while not s.raft_node.is_leader() and time.time() < deadline:
+            time.sleep(0.005)
+        assert s.raft_node.is_leader(), "sole voter failed to establish"
+        return s
+
+    def _storm_leg(root, net_seed):
+        """-> (acked, node_ids, batch_sizes, appends, fsyncs, wall_s)."""
+        import sys as _sys
+        s = _boot(root, net_seed)
+        dur = s.raft_node._durable
+        sizes = []
+        orig_append = dur.append
+
+        def _recording_append(start_index, entries):
+            sizes.append(len(entries))
+            return orig_append(start_index, entries)
+
+        dur.append = _recording_append
+        appends0, fsyncs0 = dur.appends, dur.fsyncs
+        acked, ids = [], []
+        # the amortization stats ride the FULL-CONCURRENCY window: once
+        # the first writer drains its share, the storm winds down into
+        # staggered stragglers committing alone — a finite-workload
+        # artifact, not the steady state group commit amortizes
+        steady_cut = [None]
+        lock = threading.Lock()
+
+        def _writer(nodes):
+            for n in nodes:
+                try:
+                    s.raft.apply(NODE_REGISTER, {"node": n}, timeout=30.0)
+                    with lock:
+                        acked.append(1)
+                        ids.append(n.id)
+                except Exception:   # noqa: BLE001 — counted as unacked
+                    pass
+            with lock:
+                if steady_cut[0] is None:
+                    steady_cut[0] = len(sizes)
+
+        threads = [threading.Thread(target=_writer, args=(w,))
+                   for w in work]
+        # 1-core box: the default 5ms GIL switch interval is longer
+        # than an entire append+fsync round here, so freshly woken
+        # writers cannot re-enqueue before the committer drains again
+        # and the storm degenerates toward serial. A sub-ms interval
+        # restores the interleaving a multi-core server gets for free.
+        switch0 = _sys.getswitchinterval()
+        _sys.setswitchinterval(0.0005)
+        t0 = time.perf_counter()
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            _sys.setswitchinterval(switch0)
+        wall = time.perf_counter() - t0
+        appends = dur.appends - appends0
+        fsyncs = dur.fsyncs - fsyncs0
+        dur.append = orig_append
+        s.shutdown()
+        steady = sizes[:steady_cut[0]] if steady_cut[0] else sizes
+        return len(acked), sorted(ids), sizes, steady, appends, fsyncs, \
+            wall
+
+    os.environ["NOMAD_RAFT_FSYNC"] = "always"
+    try:
+        # leg 1 — batched (the default group-commit knob)
+        root = tempfile.mkdtemp(prefix="nomad-write-storm-")
+        (acked_b, ids_b, sizes, steady, appends_b,
+         fsyncs_b, wall_b) = _storm_leg(root, 201)
+
+        # restart audit: every acked write survives at fsync=always
+        s2 = _boot(root, 202)
+        recovered = len(s2.state.nodes)
+        s2.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+        lost = max(0, acked_b - recovered)
+
+        # leg 2 — serial oracle: the knob forced to 1 (one entry per
+        # append/fsync), same writers, same op multiset
+        os.environ["NOMAD_RAFT_GROUP_COMMIT"] = "1"
+        try:
+            root_s = tempfile.mkdtemp(prefix="nomad-write-serial-")
+            (acked_s, ids_s, sizes_s, _steady_s, appends_s,
+             _fs, wall_s) = _storm_leg(root_s, 203)
+            shutil.rmtree(root_s, ignore_errors=True)
+        finally:
+            os.environ.pop("NOMAD_RAFT_GROUP_COMMIT", None)
+    finally:
+        os.environ.pop("NOMAD_RAFT_FSYNC", None)
+
+    sizes_arr = np.asarray(sizes if sizes else [1])
+    steady_arr = np.asarray(steady if steady else [1])
+    total_ops = writers * per
+    return {
+        "writers": writers,
+        "ops": total_ops,
+        "acked_batched": acked_b,
+        "acked_serial": acked_s,
+        # percentiles over the full-concurrency (steady-state) window
+        "entries_per_fsync_p50": float(np.percentile(steady_arr, 50)),
+        "entries_per_fsync_p90": float(np.percentile(steady_arr, 90)),
+        "entries_per_fsync_max": int(sizes_arr.max()),
+        "steady_windows": len(steady_arr),
+        "entries_per_fsync_p50_with_drain": float(
+            np.percentile(sizes_arr, 50)),
+        "appends_batched": appends_b,
+        "appends_serial": appends_s,
+        "fsyncs_batched": fsyncs_b,
+        "fsyncs_saved": int(sizes_arr.sum() - len(sizes_arr)),
+        "serial_max_batch": int(max(sizes_s) if sizes_s else 1),
+        "recovered_entries": recovered,
+        "lost_commits": lost,
+        "serial_parity_ok": bool(ids_b == ids_s),
+        # 1-core container: recorded for the curious, NOT gated
+        "entries_per_s_batched_ungated": round(total_ops / wall_b, 1)
+        if wall_b else 0.0,
+        "entries_per_s_serial_ungated": round(total_ops / wall_s, 1)
+        if wall_s else 0.0,
+        "wallclock_note": "1-core container — throughput keys recorded "
+                          "but ungated; the gate rides structural keys",
+    }
+
+
 POD_NODES = int(os.environ.get("NOMAD_POD_NODES", "100000"))
 POD_TASKS = int(os.environ.get("NOMAD_POD_TASKS", "1000000"))
 
@@ -2399,6 +2569,15 @@ def main() -> None:
     except Exception as e:              # noqa: BLE001 — probe is optional
         partition_chaos = {"error": repr(e)[:200]}
 
+    # write-storm lineage (ISSUE 20): concurrent raft writers at
+    # fsync=always — group-commit amortization (entries per fsync),
+    # zero lost commits across restart, batched-vs-serial parity;
+    # gated by tests/test_bench_regression.py once recorded
+    try:
+        write_storm = _write_storm_run()
+    except Exception as e:              # noqa: BLE001 — probe is optional
+        write_storm = {"error": repr(e)[:200]}
+
     # leader-failover lineage (ISSUE 6): election latency + warm-standby
     # vs cold promotion-to-first-solve, gated by
     # tests/test_bench_regression.py once recorded
@@ -2481,6 +2660,9 @@ def main() -> None:
         # taint-riding state cache, deduped eval flood, recovery wall)
         "node_storm": node_storm,
         "crash_recovery": crash_recovery,
+        # ISSUE 20: raft write-path group commit (batched fsync windows
+        # under 16 concurrent writers; structural keys only)
+        "write_storm": write_storm,
         # ISSUE 14: elastic-mesh device-chaos lineage (kill 1..K of 8
         # virtual devices mid-stream; zero evals lost, replays recorded)
         "device_chaos": device_chaos,
@@ -2844,6 +3026,12 @@ if __name__ == "__main__":
         # raft-apply throughput + restart wall pre/post compaction +
         # lost-commit audit; NOMAD_CRASH_ENTRIES resizes
         print(json.dumps(_crash_recovery_run()))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--write-storm":
+        # standalone write-storm lineage (ISSUE 20): 16 concurrent raft
+        # writers at fsync=always — entries-per-fsync amortization +
+        # restart audit + batched-vs-serial parity;
+        # NOMAD_WRITE_STORM_{WRITERS,OPS} resize
+        print(json.dumps(_write_storm_run()))
     elif len(sys.argv) > 1 and sys.argv[1] == "--device-chaos":
         # standalone device-chaos lineage (ISSUE 14): kill 1..K of the
         # 8 virtual devices mid-1k-eval-stream; NOMAD_CHAOS_EVALS resizes

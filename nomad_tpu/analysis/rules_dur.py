@@ -1,4 +1,6 @@
-"""DUR001 — raw persistence writes outside the durable-storage helpers.
+"""DUR001/DUR002 — durable-storage discipline rules.
+
+DUR001 — raw persistence writes outside the durable-storage helpers.
 
 ISSUE 13 moved every byte the control plane persists behind
 `server/durable.py`: CRC-framed WAL appends, crc-enveloped blobs, an
@@ -21,6 +23,18 @@ Flagged inside `server/`, `state/`, and `client/`:
 
 `server/durable.py` itself is exempt: it IS the helper module whose
 write paths carry the crc/fsync discipline (and the fault sites).
+
+DUR002 — per-entry durable writes inside loops (ISSUE 20).
+
+`DurableRaftDir.append()` takes a LIST of entries and amortizes the
+frame writes and the fsync over the whole call — the group-commit
+window raft.py stages exists to exploit exactly that. A durable append
+or an explicit fsync issued per loop iteration re-serializes the disk:
+N iterations pay N fsyncs where one batched call pays one, the shape
+whose cost BENCH_r12's fsync ladder measured at 2-4x throughput.
+Collect the entries and land them as one `append(start, entries)`
+call (or justify the loop with an inline disable — e.g. a recovery
+path intentionally re-proving each generation).
 """
 from __future__ import annotations
 
@@ -117,4 +131,68 @@ class RawPersistenceWrite(Rule):
                     "fsync before os.replace (see "
                     "client/state_db.py._flush_snapshot) or use "
                     "server/durable.py"))
+        return out
+
+
+@register
+class PerEntryDurableWriteInLoop(Rule):
+    id = "DUR002"
+    severity = "error"
+    short = ("per-entry durable append/fsync inside a loop — batch the "
+             "entries into one amortized durable call (ISSUE 20)")
+    path_markers = ("/server/", "/state/", "/client/")
+    EXEMPT = ("server/durable.py",)
+
+    def applies_to(self, mod: SourceModule) -> bool:
+        if any(mod.match_path.endswith(e) for e in self.EXEMPT):
+            return False
+        return super().applies_to(mod)
+
+    @staticmethod
+    def _is_durable_append(dotted: str) -> bool:
+        """`<something durable>.append(...)` — the receiver chain must
+        name the durable handle (self._durable.append, durable.append),
+        so plain list.append traffic never matches."""
+        parts = dotted.split(".")
+        return (len(parts) >= 2 and parts[-1] == "append"
+                and any("durable" in p for p in parts[:-1]))
+
+    @staticmethod
+    def _is_fsync(dotted: str) -> bool:
+        parts = dotted.split(".")
+        return dotted == "os.fsync" or parts[-1] == "_fsync"
+
+    def _loop_ancestor(self, mod: SourceModule, node: ast.AST):
+        """Nearest enclosing For/While that is NOT across a function
+        boundary (a nested def runs on its own clock, not once per
+        iteration of the loop that defines it)."""
+        for anc in mod.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return anc
+        return None
+
+    def check(self, mod: SourceModule) -> list:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted(node.func)
+            if not d:
+                continue
+            if not (self._is_durable_append(d) or self._is_fsync(d)):
+                continue
+            if self._loop_ancestor(mod, node) is None:
+                continue
+            what = "durable append" if self._is_durable_append(d) \
+                else "fsync"
+            out.append(mod.finding(
+                self, node,
+                f"per-entry {what} inside a loop pays one disk sync "
+                f"per iteration — collect the frames and land them as "
+                f"ONE batched durable call (the group-commit window, "
+                f"docs/DURABILITY.md); loops that must re-prove each "
+                f"write carry an inline disable saying why"))
         return out

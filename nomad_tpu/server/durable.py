@@ -152,9 +152,10 @@ class DurableLoad:
 
 
 class DurableRaftDir:
-    """One raft data dir. NOT thread-safe on its own: RaftNode calls in
-    under its state lock, which already serializes every persistence
-    decision with the protocol decisions they record."""
+    """One raft data dir. NOT thread-safe on its own: RaftNode
+    serializes every call under its dedicated disk lock (ISSUE 20 — the
+    group committer writes batches outside the state lock, so the state
+    lock alone no longer covers this object)."""
 
     def __init__(self, path: str,
                  policy_fn: Optional[Callable[[], tuple]] = None,
@@ -336,6 +337,11 @@ class DurableRaftDir:
             raise
         self.appends += 1
         metrics.incr("nomad.durable.appends")
+        if len(entries) > 1:
+            # group-commit amortization telemetry (ISSUE 20): N frames
+            # rode ONE append/sync window — the serial write path would
+            # have paid a sync per entry at raft_fsync=always
+            metrics.incr("nomad.durable.fsyncs_saved", len(entries) - 1)
         self._good_size = f.tell()
         self._next_index = start_index + len(entries)
 

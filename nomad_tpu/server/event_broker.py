@@ -246,6 +246,20 @@ class EventBroker:
         """Adapter matching StateStore.event_sinks signature."""
         self.publish(index, [make_event(topic, etype, index, payload)])
 
+    def sink_batch(self, rows: list) -> None:
+        """Adapter matching StateStore.event_batch_sinks (ISSUE 20): a
+        whole apply-batch window's events — [(topic, etype, index,
+        payload)] — as ONE publish: one broker-lock round, one ring
+        batch, one _offer per subscriber, published at the window's
+        highest index (each event keeps its own index; a watcher woken
+        at the window index re-reads state that already contains the
+        whole window, the same visibility rule as the store's
+        one-lock-hold batch applies)."""
+        if not rows:
+            return
+        self.publish(max(r[2] for r in rows),
+                     [make_event(t, e, i, p) for t, e, i, p in rows])
+
     # ----------------------------------------------------------- subscribe
 
     def subscribe(self, topics: Optional[dict[str, list[str]]] = None,

@@ -98,6 +98,11 @@ class NomadFSM:
         # so promotion finds the device tensors already current. Best-
         # effort: a callback failure must never fail the FSM apply
         self.on_plan_apply: list[Callable[[int], None]] = []
+        # apply_batch deferral buffer (ISSUE 20): while a batched apply
+        # window is open, eval/plan callbacks collect here and fire once
+        # after the store lock drops. Only the single applier thread
+        # opens windows, so a plain attribute suffices.
+        self._defer: Optional[tuple[list, list]] = None
 
     def apply(self, index: int, msg_type: str, payload: dict) -> object:
         """ref fsm.go:194 Apply (type switch :211-307)"""
@@ -275,11 +280,56 @@ class NomadFSM:
             raise ValueError(f"unknown message type {msg_type!r}")
         return None
 
+    def apply_batch(self, items: list, on_error=None) -> None:
+        """Apply N contiguous committed entries as ONE window (ISSUE 20
+        group commit): one store write-lock hold, one snapshot-memo
+        displacement cycle, one event-broker publish batch, one
+        blocking-query wakeup — instead of N of each. Entry order
+        inside the window IS log order, so replay equals the serial
+        per-entry sequence bit for bit.
+
+        Broker/standby callbacks (`on_eval_update`, `on_plan_apply`)
+        are DEFERRED and fired once per window after the store lock
+        drops: firing them under the held lock would mint new
+        store->broker lock edges for the whole-program lock-order lint
+        to choke on, and the serial path never ran them under the lock
+        either. `on_error(index, exc)` preserves the applier's
+        per-entry error isolation — one malformed entry must not drop
+        its batch-mates. Caller contract: ONE applier thread opens
+        windows at a time (RaftNode._run_apply is strictly serial)."""
+        if not items:
+            return
+        deferred: tuple[list, list] = ([], [])
+        self._defer = deferred
+        try:
+            with self.state.batch_window():
+                for index, msg_type, payload in items:
+                    try:
+                        self.apply(index, msg_type, payload)
+                    except Exception as ex:   # noqa: BLE001
+                        if on_error is None:
+                            raise
+                        on_error(index, ex)
+        finally:
+            self._defer = None
+        evals, plan_indexes = deferred
+        if evals:
+            for cb in self.on_eval_update:
+                cb(evals)
+        for idx in plan_indexes:
+            self._notify_plan_apply(idx)
+
     def _notify_evals(self, evals: list[Evaluation]) -> None:
+        if self._defer is not None:
+            self._defer[0].extend(evals)
+            return
         for cb in self.on_eval_update:
             cb(evals)
 
     def _notify_plan_apply(self, index: int) -> None:
+        if self._defer is not None:
+            self._defer[1].append(index)
+            return
         for cb in self.on_plan_apply:
             try:
                 cb(index)

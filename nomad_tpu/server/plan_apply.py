@@ -562,7 +562,15 @@ class Planner:
             # hold the queue for 30s per message (ISSUE 5 satellite).
             # ONE shared raft-apply span for the coalesced entry, linked
             # to every committing plan's eval span — the commit-path
-            # fan-in twin of the micro-batch dispatch span (ISSUE 7)
+            # fan-in twin of the micro-batch dispatch span (ISSUE 7).
+            # Two amortization layers compose here, by design: this
+            # coalescer folds queued PLANS into one log entry, and the
+            # raft group-commit window (ISSUE 20, docs/DURABILITY.md)
+            # then folds that entry with whatever OTHER writers —
+            # heartbeat sweeps, client alloc updates, dedup records —
+            # enqueued during the previous window's fsync. Neither
+            # subsumes the other: coalescing cuts entries per fsync,
+            # group commit cuts fsyncs per entry.
             remaining = deadline - time.monotonic()
             commit_sp = trace.start_span(
                 "plan.commit",

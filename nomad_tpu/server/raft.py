@@ -24,10 +24,13 @@ boot).
 """
 from __future__ import annotations
 
+import heapq
+import itertools
 import os
 import random
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from .. import chrono
@@ -38,6 +41,8 @@ FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
 
+_CONFIG_TYPES = ("_config_add", "_config_remove")
+
 
 class _Entry:
     __slots__ = ("term", "type", "payload")
@@ -46,6 +51,26 @@ class _Entry:
         self.term = term
         self.type = type_
         self.payload = payload
+
+
+class _Proposal:
+    """One queued apply() call riding the group-commit pipeline (ISSUE
+    20). Acks are PER-PROPOSAL: `done` is set only on a terminal event
+    for THIS proposal — staging failure (`error`), config append (those
+    callers return at append), or its own index becoming applied — so a
+    waiter never wakes for a batch-mate's progress (wake-by-index)."""
+    __slots__ = ("msg_type", "payload", "fence", "index", "term",
+                 "error", "appended", "done")
+
+    def __init__(self, msg_type: str, payload, fence: Optional[int]):
+        self.msg_type = msg_type
+        self.payload = payload
+        self.fence = fence
+        self.index = 0
+        self.term = 0
+        self.error: Optional[BaseException] = None
+        self.appended = False
+        self.done = threading.Event()
 
 
 class RaftNode:
@@ -101,6 +126,36 @@ class RaftNode:
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
         self._commit_cond = threading.Condition(self._lock)
+        # Serializes every DurableRaftDir touch (ISSUE 20): the group
+        # committer writes its batch OUTSIDE self._lock (so enqueuers
+        # never block on an in-flight fsync), but meta persists, the
+        # follower append path, compaction and snapshot installs all
+        # write under self._lock — without this second lock a step-down
+        # mid-batch could interleave two writers in one WAL file. Lock
+        # ORDER: _lock -> _disk_lock, and never acquire _lock while
+        # holding _disk_lock (the committer releases it before
+        # re-entering _lock to publish).
+        self._disk_lock = threading.Lock()
+        # group-commit pipeline state (all guarded by self._lock): FIFO
+        # of staged proposals + the single-committer flag. The committer
+        # is the FIRST enqueuing caller; everything that queues while
+        # its batch is appending/fsyncing lands in the NEXT batch —
+        # self-clocking, no timer, no added latency floor.
+        self._proposals: deque[_Proposal] = deque()
+        self._committer_busy = False
+        # wake-by-index commit waiters: (index, seq, proposal) min-heap;
+        # the applier pops exactly the prefix the new last_applied
+        # covers instead of broadcasting to every waiter (ISSUE 20
+        # satellite — the thundering herd matters exactly when group
+        # commit raises writer concurrency).
+        self._commit_waiters: list = []
+        self._waiter_seq = itertools.count()
+        # True between a batch's durable append and its publish into
+        # self.log: compaction must not regenerate the WAL inside that
+        # window (the new generation is built from self.log, which does
+        # not hold the in-flight frames yet — they would vanish from
+        # disk the moment the batch publishes and acks)
+        self._commit_in_flight = False
 
         # persistent state
         self.current_term = 0
@@ -183,29 +238,71 @@ class RaftNode:
         except Exception:       # noqa: BLE001 — config unreadable mid-
             return "always", 0.0    # restore: default to safety
 
+    def _group_commit_max(self) -> int:
+        """Group-commit window ceiling (ISSUE 20): how many queued
+        proposals one committer drain may stage into a SINGLE WAL
+        append + fsync. 1 = today's serial one-entry-per-sync shape
+        (the differential-test oracle). Same hot-reload plumbing as
+        _fsync_policy; NOMAD_RAFT_GROUP_COMMIT force-overrides for
+        bench legs and the crash fuzzer."""
+        env = os.environ.get("NOMAD_RAFT_GROUP_COMMIT", "")
+        if env:
+            try:
+                return max(1, int(env))
+            except ValueError:
+                pass
+        try:
+            return max(1, int(self.fsm.state.get_scheduler_config()
+                              .raft_group_commit_max_entries))
+        except Exception:   # noqa: BLE001 — config unreadable mid-
+            return 64           # restore: bounded default
+
+    def _replicate_batch_max(self) -> int:
+        """Per-AppendEntries shipping window (ISSUE 20): the follower
+        persists the whole batch with ONE fsync before acking."""
+        env = os.environ.get("NOMAD_RAFT_REPL_BATCH", "")
+        if env:
+            try:
+                return max(1, int(env))
+            except ValueError:
+                pass
+        try:
+            return max(1, int(self.fsm.state.get_scheduler_config()
+                              .raft_replicate_batch_max))
+        except Exception:   # noqa: BLE001 — config unreadable mid-
+            return 1024         # restore: bounded default
+
     def _persist_meta(self) -> None:
         if self._durable is None:
             return
-        self._durable.save_meta(
-            {"term": self.current_term, "voted_for": self.voted_for,
-             "peers": self.peers, "nonvoters": set(self.nonvoters)})
+        with self._disk_lock:
+            # _disk_lock EXISTS to serialize durable I/O (the state
+            # lock is not held here) — nomadlint: disable=LOCK003
+            self._durable.save_meta(
+                {"term": self.current_term, "voted_for": self.voted_for,
+                 "peers": self.peers, "nonvoters": set(self.nonvoters)})
 
     def _append_to_disk(self, entries: list[_Entry]) -> None:
         """Append the TAIL `entries` (already in self.log) to the WAL."""
         if self._durable is None or not entries:
             return
         start = self._last_index() - len(entries) + 1
-        self._durable.append(start,
-                             [(e.term, e.type, e.payload) for e in entries])
+        with self._disk_lock:
+            self._durable.append(
+                start, [(e.term, e.type, e.payload) for e in entries])
 
     def _rewrite_log_on_disk(self) -> None:
         """After truncation/conflict resolution: commit a new log
         generation under the manifest (the snapshot is untouched)."""
         if self._durable is None:
             return
-        self._durable.commit_generation(
-            None, [(e.term, e.type, e.payload) for e in self.log],
-            self.base_index + 1)
+        with self._disk_lock:
+            # truncation must be durable before any later append lands
+            # behind it; _disk_lock is the I/O serialization lock, not
+            # the state lock — nomadlint: disable=LOCK003
+            self._durable.commit_generation(
+                None, [(e.term, e.type, e.payload) for e in self.log],
+                self.base_index + 1)
 
     def _snapshot_doc(self, data: bytes) -> dict:
         return {"index": self.base_index, "term": self.base_term,
@@ -312,8 +409,14 @@ class RaftNode:
             self._apply_cond.notify_all()
             for ev in self._replicate_events.values():
                 ev.set()
+            # release apply() waiters promptly: commit waiters break on
+            # the stop flag once woken (same contract as the old
+            # cond-broadcast shutdown)
+            while self._commit_waiters:
+                heapq.heappop(self._commit_waiters)[2].done.set()
         if self._durable is not None:
-            self._durable.close()
+            with self._disk_lock:
+                self._durable.close()
 
     # ------------------------------------------------------- public: apply
 
@@ -346,7 +449,16 @@ class RaftNode:
 
         `fence` (a fence_token() value) rejects the write atomically —
         FencedWriteError, entry NOT appended, commit provably impossible
-        — when the term has moved since the token was captured."""
+        — when the term has moved since the token was captured.
+
+        Group commit (ISSUE 20): callers ENQUEUE proposals; the first
+        enqueuer becomes the committer and drains everything queued
+        while the previous batch was appending/fsyncing into ONE
+        multi-entry WAL append (one fsync at raft_fsync=always). Acks
+        stay per-proposal: this caller returns only once ITS index is
+        durable and applied, and a persist failure fails the whole
+        batch with nothing entered into memory (the PR-13 memory==disk
+        invariant at batch granularity, via disk-first staging)."""
         from .. import faults
         faults.fire("raft.apply")
         faults.fire(f"raft.apply.{self.node_id}")
@@ -356,6 +468,7 @@ class RaftNode:
         from ..rpc import dedup as rpc_dedup
         payload = rpc_dedup.stamp(payload)
         t_enter = time.monotonic()
+        prop = _Proposal(msg_type, payload, fence)
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_addr)
@@ -364,73 +477,233 @@ class RaftNode:
                 # since the caller captured its token: the caller's
                 # prepared write raced another leader's commits. Checked
                 # under the SAME lock that serializes step-down, so the
-                # rejection is atomic with the append decision.
+                # rejection is atomic with the append decision (the
+                # committer re-checks at staging time for proposals that
+                # queue before a step-down lands).
                 metrics.incr("nomad.raft.fence_rejected")
                 from ..obs import trace
                 trace.annotate(fence_rejected=True, fence_expected=fence,
                                fence_current=self.current_term)
                 raise FencedWriteError(self.current_term, fence,
                                        self.leader_addr)
-            entry = _Entry(self.current_term, msg_type, payload)
-            self.log.append(entry)
-            index = self._last_index()
-            try:
-                self._append_to_disk([entry])
-            except Exception:
-                # durability first: the entry was never written, never
-                # replicated (the replicate events fire below), and the
-                # caller sees the failure — roll the in-memory log back
-                # so memory and disk stay one object
-                self.log.pop()
-                metrics.incr("nomad.raft.persist_errors")
-                raise
-            if msg_type in ("_config_add", "_config_remove"):
-                # adopt the new configuration at append time (§4.1); a
-                # leader removing itself keeps replicating but no longer
-                # counts toward majority, and steps down only once the
-                # entry commits (§4.2.2, handled by the apply loop)
-                self._adopt_config_locked(entry)
-            self._match_index[self.node_id] = index
-            for ev in self._replicate_events.values():
-                ev.set()
-            if len(self._voters()) == 1:
-                self._advance_commit_locked()
-            if msg_type in ("_config_add", "_config_remove"):
-                # membership changes take effect at append (adopted above)
-                # and commit asynchronously once the NEW majority acks —
-                # blocking here would deadlock a 1→2 addition where the
-                # joining server only starts raft after `join` returns
-                # (hashicorp/raft AddVoter likewise returns an index future)
-                return index
-            deadline = time.monotonic() + timeout
-            while self.last_applied < index and not self._stop.is_set():
+            self._proposals.append(prop)
+            run_committer = not self._committer_busy
+            if run_committer:
+                self._committer_busy = True
+        if run_committer:
+            self._commit_proposals()
+        deadline = t_enter + timeout
+        index = 0
+        while True:
+            with self._lock:
+                if prop.error is not None:
+                    raise prop.error
+                if prop.appended:
+                    index = prop.index
+                    if msg_type in _CONFIG_TYPES:
+                        # membership changes take effect at append
+                        # (adopted by the committer) and commit
+                        # asynchronously once the NEW majority acks —
+                        # blocking here would deadlock a 1→2 addition
+                        # where the joining server only starts raft
+                        # after `join` returns (hashicorp/raft AddVoter
+                        # likewise returns an index future)
+                        return index
+                    if self.last_applied >= index or self._stop.is_set():
+                        break
+                    if self.state != LEADER:
+                        # the entry IS appended; it may still commit
+                        # under the next leader — callers must not
+                        # retry/forward (ref hashicorp/raft
+                        # ErrLeadershipLost)
+                        raise LeadershipLostError(self.leader_addr)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     metrics.incr("nomad.raft.apply_timeout")
                     raise TimeoutError(
                         f"raft apply of {msg_type} timed out at index "
-                        f"{index} (budget {timeout:.1f}s)")
-                if self.state != LEADER:
-                    # the entry IS appended; it may still commit under
-                    # the next leader — callers must not retry/forward
-                    # (ref hashicorp/raft ErrLeadershipLost)
-                    raise LeadershipLostError(self.leader_addr)
-                self._apply_cond.wait(min(remaining, 0.5))
+                        f"{prop.index} (budget {timeout:.1f}s)")
+            # wait OUTSIDE the lock, in bounded slices: the wake is the
+            # per-proposal event (set by the committer on failure or by
+            # the applier exactly when this index is covered), and the
+            # slices keep leadership loss / shutdown / timeout
+            # observable even if no wake ever arrives
+            prop.done.wait(min(remaining, 0.5))
+        with self._lock:
             # leadership lost mid-wait: a new leader may have overwritten
             # our uncommitted entry at this index (hashicorp/raft returns
             # ErrLeadershipLost for exactly this)
             if index > self.base_index and \
-                    self._term_at(index) != entry.term:
+                    self._term_at(index) != prop.term:
                 raise LeadershipLostError(self.leader_addr)
-            metrics.add_sample("nomad.raft.apply_wait",
-                               time.monotonic() - t_enter)
-            # attribute the replication wait + assigned index onto the
-            # caller's in-flight span (the applier's plan.commit, ISSUE 7)
-            from ..obs import trace
-            trace.annotate(raft_index=index, term=entry.term,
-                           replicate_wait_s=round(
-                               time.monotonic() - t_enter, 6))
-            return index
+        metrics.add_sample("nomad.raft.apply_wait",
+                           time.monotonic() - t_enter)
+        # attribute the replication wait + assigned index onto the
+        # caller's in-flight span (the applier's plan.commit, ISSUE 7)
+        from ..obs import trace
+        trace.annotate(raft_index=index, term=prop.term,
+                       replicate_wait_s=round(
+                           time.monotonic() - t_enter, 6))
+        return index
+
+    def _commit_proposals(self) -> None:
+        """THE group committer (ISSUE 20): runs on the first enqueuing
+        caller's thread and drains the proposal queue batch by batch
+        until it is empty, then clears the busy flag — so any queued
+        proposal always has a live committer, and an idle leader's lone
+        proposal commits immediately on its own thread (no timer, no
+        handoff latency).
+
+        Disk-first staging keeps memory == disk at batch granularity:
+        staged entries enter self.log only AFTER the durable append
+        succeeds, so the replicate loops can never ship an entry that a
+        persist failure would roll back (same-index+term ⇒ same-entry
+        stays inviolate), and a failed batch leaves memory untouched —
+        every batch-mate fails, none half-lands. A batch orphaned on
+        disk by a mid-write deposition resolves at the next append or
+        boot through the WAL's index-regression later-write-wins rule
+        (docs/DURABILITY.md)."""
+        while True:
+            with self._lock:
+                if not self._proposals:
+                    if (self.last_applied < self.commit_index
+                            and not self._stop.is_set()):
+                        # an apply window is in flight: park (bounded)
+                        # instead of resigning the committer role. The
+                        # waiters that apply wakes re-enqueue into ONE
+                        # drain here, rather than racing a fresh
+                        # committer one at a time — the thundering-herd
+                        # shape that halves batch sizes under storm
+                        # load. An idle leader never enters this arm
+                        # (applier caught up ⇒ immediate exit), so the
+                        # lone-proposal latency floor stays zero.
+                        self._apply_cond.wait(0.05)
+                        continue
+                    self._committer_busy = False
+                    return
+                if self._stop.is_set():
+                    while self._proposals:
+                        p = self._proposals.popleft()
+                        p.error = NotLeaderError(self.leader_addr)
+                        p.done.set()
+                    self._committer_busy = False
+                    return
+                limit = self._group_commit_max()
+                batch = []
+                while self._proposals and len(batch) < limit:
+                    batch.append(self._proposals.popleft())
+                if self.state != LEADER:
+                    for p in batch:
+                        p.error = NotLeaderError(self.leader_addr)
+                        p.done.set()
+                    continue
+                term = self.current_term
+                accepted = []
+                for p in batch:
+                    if p.fence is not None and p.fence != term:
+                        # the term moved while this proposal sat queued:
+                        # same atomic rejection as the enqueue-time
+                        # check — the entry is provably not appended
+                        metrics.incr("nomad.raft.fence_rejected")
+                        p.error = FencedWriteError(term, p.fence,
+                                                   self.leader_addr)
+                        p.done.set()
+                        continue
+                    accepted.append(p)
+                if not accepted:
+                    continue
+                start = self._last_index() + 1
+                frames = []
+                for off, p in enumerate(accepted):
+                    p.term = term
+                    p.index = start + off
+                    frames.append((term, p.msg_type, p.payload))
+                durable = self._durable
+                self._commit_in_flight = True
+                # take the disk lock BEFORE releasing the state lock
+                # (consistent _lock -> _disk_lock order): from here to
+                # release, no other durable writer can interleave with
+                # this batch's frames
+                self._disk_lock.acquire()
+            persist_err: Optional[BaseException] = None
+            try:
+                if durable is not None:
+                    try:
+                        # one append per drained WINDOW, never per
+                        # entry — this IS the amortized batch call:
+                        # nomadlint: disable=DUR002 — per-window batch
+                        durable.append(start, frames)
+                    except Exception as e:   # noqa: BLE001
+                        persist_err = e
+            finally:
+                self._disk_lock.release()
+            if persist_err is None:
+                try:
+                    # crash window between the durable batch append and
+                    # its acks (ISSUE 20 fuzzer site): treated exactly
+                    # like a persist failure — nothing entered memory,
+                    # the indexes will be re-staged, and the orphaned
+                    # frames resolve by the index-regression rule
+                    from .. import faults
+                    faults.fire("raft.group_commit.ack")
+                    faults.fire(f"raft.group_commit.ack.{self.node_id}")
+                except Exception as e:   # noqa: BLE001
+                    persist_err = e
+            with self._lock:
+                self._commit_in_flight = False
+                if persist_err is not None:
+                    # durability first: the WHOLE batch's callers see
+                    # the failure and no entry is visible to
+                    # replication or the FSM — memory and disk stay one
+                    # object (any flushed prefix is superseded on the
+                    # next append at the same indexes)
+                    metrics.incr("nomad.raft.persist_errors")
+                    for p in accepted:
+                        p.error = persist_err
+                        p.done.set()
+                    continue
+                if self.state != LEADER or self.current_term != term:
+                    # deposed while the batch was on its way to disk:
+                    # disk-first staging means self.log never saw these
+                    # entries, so there is nothing to roll back
+                    for p in accepted:
+                        p.error = LeadershipLostError(self.leader_addr)
+                        p.done.set()
+                    continue
+                if self._last_index() + 1 != start:
+                    # a leader-elect establishment batch landed between
+                    # staging and publish (state flips to LEADER before
+                    # _become_leader appends): the reserved indexes
+                    # moved under us. Re-stage the same proposals at
+                    # the head of the queue — the superseding append
+                    # overwrites the orphaned frames.
+                    for p in reversed(accepted):
+                        self._proposals.appendleft(p)
+                    continue
+                for p in accepted:
+                    e = _Entry(term, p.msg_type, p.payload)
+                    self.log.append(e)
+                    if p.msg_type in _CONFIG_TYPES:
+                        # adopt the new configuration at append time
+                        # (§4.1); a leader removing itself keeps
+                        # replicating but no longer counts toward
+                        # majority, and steps down only once the entry
+                        # commits (§4.2.2, handled by the apply loop)
+                        self._adopt_config_locked(e)
+                        p.appended = True
+                        p.done.set()   # config callers return at append
+                    else:
+                        p.appended = True
+                        heapq.heappush(
+                            self._commit_waiters,
+                            (p.index, next(self._waiter_seq), p))
+                self._match_index[self.node_id] = self._last_index()
+                metrics.add_sample("nomad.raft.batch_entries",
+                                   len(accepted))
+                for ev in self._replicate_events.values():
+                    ev.set()
+                if len(self._voters()) == 1:
+                    self._advance_commit_locked()
 
     def bootstrap_with(self, peers: dict[str, str]) -> bool:
         """One-shot cluster bootstrap with a full initial configuration
@@ -900,9 +1173,17 @@ class RaftNode:
                 snap = None
                 prev_idx = nxt - 1
                 prev_term = self._term_at(prev_idx)
+                # ship the full pending window, bounded by the hot-
+                # reloadable replication knob (ISSUE 20): the follower
+                # persists the whole batch with ONE fsync before acking
+                win = self._replicate_batch_max()
                 entries = [(e.term, e.type, e.payload)
                            for e in self.log[prev_idx - self.base_index:
-                                             prev_idx - self.base_index + 64]]
+                                             prev_idx - self.base_index
+                                             + win]]
+                if entries:
+                    metrics.add_sample("nomad.raft.replicate_batch_entries",
+                                       len(entries))
                 commit = self.commit_index
         if snap is not None:
             resp = cli.call("Raft.InstallSnapshot", term, self.node_id,
@@ -961,8 +1242,22 @@ class RaftNode:
 
     # --------------------------------------------------------------- apply
 
+    def _wake_applied_locked(self) -> None:
+        """Wake exactly the apply() waiters whose index the new
+        last_applied covers (wake-by-index, ISSUE 20 satellite): pop
+        the covered prefix of the waiter heap instead of broadcasting
+        to every writer parked on the node."""
+        waiters = self._commit_waiters
+        while waiters and waiters[0][0] <= self.last_applied:
+            heapq.heappop(waiters)[2].done.set()
+
     def _run_apply(self) -> None:
-        """Dedicated applier: keeps FSM application strictly ordered."""
+        """Dedicated applier: keeps FSM application strictly ordered.
+        When the commit index jumps N entries (group commit, batched
+        replication), contiguous runs of FSM entries apply as ONE
+        fsm.apply_batch window — one store-lock hold, one snapshot-memo
+        displacement, one event-broker publish batch — and commit
+        waiters wake once, by index (ISSUE 20)."""
         while not self._stop.is_set():
             with self._lock:
                 while self.last_applied >= self.commit_index and \
@@ -973,8 +1268,16 @@ class RaftNode:
                 start = self.last_applied + 1
                 end = self.commit_index
                 batch = [(i, self._entry_at(i)) for i in range(start, end + 1)]
-            for idx, e in batch:
-                if e.type in ("_config_remove", "_config_add"):
+
+            def _on_entry_error(idx: int, ex: BaseException) -> None:
+                # per-entry error isolation inside a batched window: a
+                # malformed entry must not drop its batch-mates
+                self.logger(f"raft: fsm apply failed at {idx}: {ex!r}")
+
+            i, n = 0, len(batch)
+            while i < n:
+                idx, e = batch[i]
+                if e.type in _CONFIG_TYPES:
                     try:
                         with self._lock:
                             if e.type == "_config_remove":
@@ -990,13 +1293,27 @@ class RaftNode:
                         metrics.incr("nomad.raft.persist_errors")
                         self.logger(f"raft: config apply persist "
                                     f"failed at {idx}: {ex!r}")
-                elif e.type != "_noop":
+                    i += 1
+                elif e.type == "_noop":
+                    i += 1
+                else:
+                    # contiguous FSM run: config/noop entries break the
+                    # window so raft-state and store-state mutations
+                    # stay in strict log order relative to each other
+                    run = []
+                    while i < n and batch[i][1].type not in _CONFIG_TYPES \
+                            and batch[i][1].type != "_noop":
+                        run.append((batch[i][0], batch[i][1].type,
+                                    batch[i][1].payload))
+                        i += 1
                     try:
-                        self.fsm.apply(idx, e.type, e.payload)
+                        self.fsm.apply_batch(run, on_error=_on_entry_error)
                     except Exception as ex:   # noqa: BLE001
-                        self.logger(f"raft: fsm apply failed at {idx}: {ex!r}")
+                        self.logger(f"raft: fsm apply batch failed at "
+                                    f"{run[0][0]}..{run[-1][0]}: {ex!r}")
             with self._lock:
                 self.last_applied = end
+                self._wake_applied_locked()
                 self._apply_cond.notify_all()
                 if len(self.log) >= self.snapshot_threshold:
                     try:
@@ -1017,6 +1334,13 @@ class RaftNode:
         """Snapshot the FSM and truncate the applied prefix of the log."""
         snap_index = self.last_applied
         if snap_index <= self.base_index:
+            return
+        if self._commit_in_flight:
+            # a group-commit batch sits between its durable append and
+            # its publish (ISSUE 20): the regenerated WAL would be
+            # built from a self.log that lacks the in-flight frames,
+            # silently un-persisting entries about to be acked. Skip;
+            # the applier retries after the next batch.
             return
         data = self.fsm.snapshot_bytes()
         keep_from = snap_index - self.base_index
@@ -1043,10 +1367,13 @@ class RaftNode:
             # index-less stale log shadowed the new snapshot (ISSUE 13)
             # raft persists before acking; the disk commit IS the state
             # transition, by design — nomadlint: disable=LOCK003
-            self._durable.commit_generation(
-                self._snapshot_doc(data),
-                [(e.term, e.type, e.payload) for e in self.log],
-                self.base_index + 1)
+            with self._disk_lock:
+                # same audit: _disk_lock is the durable-I/O serializer
+                # (ISSUE 13/20) — nomadlint: disable=LOCK003
+                self._durable.commit_generation(
+                    self._snapshot_doc(data),
+                    [(e.term, e.type, e.payload) for e in self.log],
+                    self.base_index + 1)
 
     # ------------------------------------------------------- RPC handlers
 
@@ -1159,11 +1486,20 @@ class RaftNode:
                     # and disk agree, and make the leader retry
                     del self.log[-len(appended):]
                 appended = []
-            if truncated or any(e.type in ("_config_add", "_config_remove")
-                                for e in appended):
+            if truncated or any(e.type in _CONFIG_TYPES for e in appended):
                 # adopt appended config entries immediately (§4.1) and roll
                 # back any truncated ones, in one recompute
                 self._recompute_config_locked()
+            if appended:
+                # crash window between a durable follower persist and
+                # the ack leaving this server (ISSUE 20 fuzzer site): a
+                # raise here drops the response — the leader retries
+                # the identical batch, which matches in place and acks,
+                # so a durably-persisted-but-unacked follower batch is
+                # never double-applied and never lost
+                from .. import faults
+                faults.fire("raft.follower.ack")
+                faults.fire(f"raft.follower.ack.{self.node_id}")
             if not persist_ok:
                 # `retry` distinguishes a LOCAL persist hiccup from a
                 # log conflict: the logs match, so the leader must not
@@ -1206,11 +1542,14 @@ class RaftNode:
                     if snap.get("peers") else set(self._base_nonvoters)
                 # an installed snapshot must be durable before the node
                 # acks it (raft safety) — nomadlint: disable=LOCK003
-                self._durable.commit_generation(
-                    {"index": snap["index"], "term": snap["term"],
-                     "data": snap["data"], "peers": peers,
-                     "nonvoters": nonvoters},
-                    [], snap["index"] + 1)
+                with self._disk_lock:
+                    # same audit: _disk_lock is the durable-I/O
+                    # serializer — nomadlint: disable=LOCK003
+                    self._durable.commit_generation(
+                        {"index": snap["index"], "term": snap["term"],
+                         "data": snap["data"], "peers": peers,
+                         "nonvoters": nonvoters},
+                        [], snap["index"] + 1)
             self.fsm.restore_bytes(snap["data"])
             self.base_index = snap["index"]
             self.base_term = snap["term"]

@@ -198,6 +198,9 @@ class Server:
             coalesce_after=64,
             pressure_fn=lambda: self.overload.state())
         self.state.event_sinks.append(self.event_broker.sink)
+        # batched twin (ISSUE 20): a whole FSM apply-batch window's
+        # events land in the broker as ONE publish
+        self.state.event_batch_sinks.append(self.event_broker.sink_batch)
         self.blocked_evals = BlockedEvals(self._enqueue_unblocked)
         from .acl_endpoint import ACLEndpoint
         self.acl = ACLEndpoint(self, enabled=acl_enabled)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Callable, Iterable, Optional
 
 from .usage_index import UsageIndex
@@ -108,6 +109,20 @@ class StateStore:
 
         # event sink (wired to the event broker by the server)
         self.event_sinks: list[Callable[[str, str, int, object], None]] = []
+        # batched sink twin (ISSUE 20): one call per apply-batch window
+        # flush, carrying [(topic, etype, index, payload)] — the broker
+        # publishes the whole window as ONE batch (one broker lock
+        # round, one offer per subscriber). When empty, a window flush
+        # falls back to the per-event sinks.
+        self.event_batch_sinks: list[Callable[[list], None]] = []
+        # apply-batch window state (ISSUE 20 group commit), guarded by
+        # self._lock — the window HOLDS the lock for its whole extent
+        # (that is exactly what makes the deferrals below invisible to
+        # readers): depth of nested windows, buffered events, and
+        # whether any commit happened inside the window.
+        self._batch_depth = 0
+        self._batch_events: list[tuple] = []
+        self._batch_dirty = False
         # optional: the owning server/agent wires its logger in so sink
         # failures surface in the agent log (counted regardless)
         self.logger: Optional[Callable[[str], None]] = None
@@ -137,9 +152,23 @@ class StateStore:
         return self._index
 
     def _commit(self) -> None:
+        if self._batch_depth:
+            # inside an apply-batch window: ONE wakeup at window exit
+            # (blocking queries re-check their predicate anyway, and
+            # the lock is held until the flush, so no reader can
+            # observe the gap). _commit is only ever called with the
+            # write lock held, like _bump above.
+            # nomadlint: disable=LOCK001 — caller holds the write lock
+            self._batch_dirty = True
+            return
         self._cond.notify_all()
 
     def _emit(self, topic: str, etype: str, index: int, payload) -> None:
+        if self._batch_depth:
+            # inside an apply-batch window: buffer for ONE batched
+            # publish at window exit (ISSUE 20)
+            self._batch_events.append((topic, etype, index, payload))
+            return
         for sink in self.event_sinks:
             try:
                 sink(topic, etype, index, payload)
@@ -148,6 +177,47 @@ class StateStore:
                 # silently stops delivering is an invisible outage —
                 # count it (EXC001; logger is optional, agents wire one)
                 record_swallowed_error("state.emit", e, self.logger)
+
+    @contextmanager
+    def batch_window(self):
+        """Hold the write lock across a batch of FSM applies and flush
+        their side effects ONCE at exit (ISSUE 20 group commit): one
+        condvar broadcast, one event-sink publish batch, and — because
+        the lock never drops inside the window — one effective
+        snapshot-memo rebuild for the whole batch instead of one per
+        entry. Re-entrant (RLock + depth counter); the outermost exit
+        flushes. Mutations inside the window are ordinary mutator
+        calls; they re-enter the already-held lock."""
+        with self._lock:
+            self._batch_depth += 1
+            try:
+                yield self
+            finally:
+                self._batch_depth -= 1
+                if self._batch_depth == 0:
+                    self._flush_batch_locked()
+
+    def _flush_batch_locked(self) -> None:
+        events, self._batch_events = self._batch_events, []
+        dirty, self._batch_dirty = self._batch_dirty, False
+        if events:
+            if self.event_batch_sinks:
+                for sink in self.event_batch_sinks:
+                    try:
+                        sink(events)
+                    except Exception as e:      # noqa: BLE001
+                        record_swallowed_error("state.emit_batch", e,
+                                               self.logger)
+            else:
+                for topic, etype, index, payload in events:
+                    for sink in self.event_sinks:
+                        try:
+                            sink(topic, etype, index, payload)
+                        except Exception as e:      # noqa: BLE001
+                            record_swallowed_error("state.emit", e,
+                                                   self.logger)
+        if dirty or events:
+            self._cond.notify_all()
 
     def snapshot(self) -> "StateSnapshot":
         with self._lock:

@@ -164,6 +164,24 @@ class SchedulerConfiguration:
                               for bench legs.
       raft_fsync_interval_ms  append-fsync pacing for raft_fsync =
                               interval.
+      raft_group_commit_max_entries
+                              leader write-path group commit (ISSUE 20,
+                              docs/DURABILITY.md): max proposals one
+                              committer drain stages into a SINGLE WAL
+                              append + fsync window. Self-clocking (no
+                              timer): an idle leader still commits a
+                              lone entry immediately. 1 = serial
+                              one-entry-per-sync (the differential-test
+                              oracle). Hot-reloadable;
+                              NOMAD_RAFT_GROUP_COMMIT overrides for
+                              bench legs and the crash fuzzer.
+      raft_replicate_batch_max
+                              max log entries one AppendEntries RPC
+                              ships per follower round; the follower
+                              persists the whole batch with ONE fsync
+                              before acking (persist-before-ack at
+                              batch granularity). Hot-reloadable;
+                              NOMAD_RAFT_REPL_BATCH overrides.
       solver_convex_enabled   global convex placement tier (ISSUE 19):
                               with scheduler_algorithm = "convex", solve
                               the whole eval as ONE on-device projected-
@@ -237,6 +255,8 @@ class SchedulerConfiguration:
     solver_convex_namespace_quota: int = 0
     raft_fsync: str = "always"
     raft_fsync_interval_ms: float = 50.0
+    raft_group_commit_max_entries: int = 64
+    raft_replicate_batch_max: int = 1024
     create_index: int = 0
     modify_index: int = 0
 
@@ -302,4 +322,8 @@ class SchedulerConfiguration:
                     "'never'")
         if self.raft_fsync_interval_ms <= 0:
             return "raft_fsync_interval_ms must be > 0"
+        if self.raft_group_commit_max_entries < 1:
+            return "raft_group_commit_max_entries must be >= 1 (1 = serial)"
+        if self.raft_replicate_batch_max < 1:
+            return "raft_replicate_batch_max must be >= 1"
         return ""

@@ -24,7 +24,9 @@ jitter, seeded fault plans, pickle-copied payload scripts.
 """
 import os
 import pickle
+import threading
 import time
+from collections import deque
 
 import pytest
 
@@ -619,6 +621,287 @@ def test_midfile_corruption_quarantines_and_recovers_via_snapshot(
     finally:
         for s in servers:
             s.shutdown()
+
+
+# ------------------- Part D: group-commit batch boundaries (ISSUE 20)
+#
+# Group commit introduces three NEW crash windows the per-entry fuzzer
+# above never exercised: a torn write in the middle of a MULTI-entry
+# append frame run, a leader crash after the batch is durable but before
+# any proposer is acked, and a follower crash after its batched persist
+# but before the AppendEntries response leaves. Each must preserve the
+# same ledger: acked ⇒ durable; unacked may vanish OR legally commit
+# (the classic "appended entry may still commit" raft outcome) — but
+# only as a contiguous frame-order prefix, never a gap.
+
+
+class _CountingDeque(deque):
+    """The committer's proposal queue with an enqueue odometer, so the
+    test can release writer i+1 only once writer i's proposal is
+    visibly queued — making the raft total order equal script order
+    (and therefore comparable bit-for-bit against the serial oracle)."""
+
+    def __init__(self, src=()):
+        super().__init__(src)
+        self.enqueued = len(self)
+
+    def append(self, x):
+        super().append(x)
+        self.enqueued += 1
+
+
+def _settle(server):
+    """Wait for the establishment entries (noop+config) to be appended
+    AND applied — is_leader() flips before _become_leader appends, so a
+    fault installed too early would fire on the establishment fsync
+    instead of the first script batch."""
+    node = server.raft_node
+    assert wait_until(lambda: node.commit_index >= 1
+                      and node.last_applied == node.commit_index,
+                      timeout=8)
+
+
+def _drive_concurrent(server, ops, timeout=20.0):
+    """Submit ops as OVERLAPPING writers in deterministic enqueue order.
+    -> (acked_indexes, {i: "ok" | exception})."""
+    node = server.raft_node
+    counted = _CountingDeque(node._proposals)
+    with node._lock:
+        node._proposals = counted
+    results = {}
+
+    def _w(i, msg_type, payload):
+        try:
+            server.raft.apply(msg_type, payload, timeout=timeout)
+            results[i] = "ok"
+        except Exception as e:   # noqa: BLE001 — injected crash
+            results[i] = e
+
+    threads = []
+    for i, (m, p) in enumerate(ops):
+        t = threading.Thread(target=_w, args=(i, m, _copy(p)), daemon=True)
+        t.start()
+        threads.append(t)
+        assert wait_until(lambda: counted.enqueued >= i + 1, timeout=5), \
+            f"writer {i} never enqueued"
+    for t in threads:
+        t.join(timeout)
+    return sorted(i for i, r in results.items() if r == "ok"), results
+
+
+def test_torn_mid_batch_append_loses_only_an_unacked_suffix(
+        tmp_path, script_and_oracle):
+    """Tear the disk mid-way through a MULTI-entry group-commit append.
+    The whole batch fails (memory untouched), yet the torn prefix may
+    hold complete leading frames that legally commit after restart —
+    so the restored FSM must equal the oracle at SOME contiguous prefix
+    covering everything acked, with no gaps and no reordering."""
+    ops, oracle_snaps = script_and_oracle
+    ops = ops[:6]
+    net = VirtualNetwork(seed=51)
+    root = tmp_path / "raft"
+    a = _mk_server(net, "s0", root, seed=1)
+    assert wait_until(lambda: a.raft_node.is_leader(), timeout=8)
+    _settle(a)
+    # writer 0's single-entry batch parks in a slow fsync; writers 1..5
+    # pile up behind it and drain as ONE multi-entry append — which the
+    # disk tears mid-frame (append #1 is writer 0's, #2 is the batch)
+    faults.install({
+        "disk.fsync": {"mode": "delay", "delay_ms": 2000, "times": 1},
+        "disk.append": {"mode": "torn", "n": 2, "times": 1, "seed": 29},
+    })
+    acked, results = _drive_concurrent(a, ops)
+    assert faults.fired("disk.append") == 1, "batch append was never torn"
+    assert len(acked) < len(ops)        # the torn batch really failed
+    # batch rollback: a failed proposer's op is NOT in leader memory
+    for i in range(len(ops)):
+        if i in acked:
+            continue
+        msg_type, payload = ops[i]
+        if msg_type == JOB_REGISTER:
+            assert a.state.job_by_id("default", payload["job"].id) is None
+        else:
+            assert a.state.node_by_id(payload["node"].id) is None
+    a.shutdown()
+    faults.clear()
+
+    b = _mk_server(net, "s0", root, seed=1)
+    try:
+        assert wait_until(lambda: b.raft_node.is_leader(), timeout=8)
+        present = _present_map(b, ops)
+        lost = [i for i in acked if not present[i]]
+        assert not lost, f"acked op(s) {lost} lost (present={present})"
+        k = 0
+        while k < len(ops) and present[k]:
+            k += 1
+        # frame order == script order: survivors are a contiguous prefix
+        assert not any(present[k:]), (
+            f"non-prefix survivors after a torn batch: {present}")
+        assert pickle.loads(b.fsm.snapshot_bytes()) == \
+            pickle.loads(oracle_snaps[k]), \
+            f"restored FSM diverged from the oracle at prefix {k}"
+    finally:
+        b.shutdown()
+
+
+def test_leader_crash_between_batch_append_and_ack(tmp_path,
+                                                   script_and_oracle):
+    """Crash the leader in the window AFTER the batch's single durable
+    append succeeds but BEFORE any proposer is acked (the
+    `raft.group_commit.ack` site). Every proposer sees an error and the
+    entries never reach leader memory — yet the frames are on disk, so
+    the restart legally commits ALL of them (append-may-still-commit):
+    zero acked loss, full oracle equality at the attempted prefix."""
+    ops, oracle_snaps = script_and_oracle
+    ops = ops[:6]
+    net = VirtualNetwork(seed=53)
+    root = tmp_path / "raft"
+    a = _mk_server(net, "s0", root, seed=1)
+    assert wait_until(lambda: a.raft_node.is_leader(), timeout=8)
+    _settle(a)
+    faults.install({
+        "disk.fsync": {"mode": "delay", "delay_ms": 2000, "times": 1},
+        # ack #1 is writer 0's lone batch; ack #2 is the pile-up batch
+        "raft.group_commit.ack": {"mode": "after", "n": 2, "times": 1},
+    })
+    acked, results = _drive_concurrent(a, ops)
+    assert faults.fired("raft.group_commit.ack") == 1
+    assert len(acked) < len(ops)
+    # rollback contract: the failed batch is durable but NOT in memory
+    for i in range(len(ops)):
+        if i in acked:
+            continue
+        msg_type, payload = ops[i]
+        if msg_type == JOB_REGISTER:
+            assert a.state.job_by_id("default", payload["job"].id) is None
+        else:
+            assert a.state.node_by_id(payload["node"].id) is None
+    a.shutdown()
+    faults.clear()
+
+    b = _mk_server(net, "s0", root, seed=1)
+    try:
+        assert wait_until(lambda: b.raft_node.is_leader(), timeout=8)
+        # the orphaned-but-durable frames all commit on restart
+        assert _present_map(b, ops) == [True] * len(ops)
+        assert pickle.loads(b.fsm.snapshot_bytes()) == \
+            pickle.loads(oracle_snaps[len(ops)])
+    finally:
+        b.shutdown()
+
+
+def test_follower_crash_between_persist_and_ack_converges_exactly_once(
+        tmp_path):
+    """Drop a follower's AppendEntries RESPONSE after its batched
+    persist succeeded (the `raft.follower.ack` site). The leader
+    retries the identical window; the follower's durable append matches
+    in place (same index+term ⇒ same entry) — convergence with no
+    double apply and no lost committed entry."""
+    net = VirtualNetwork(seed=57)
+    servers = _mk_cluster(3, net, tmp_path)
+    try:
+        leader = _stable_leader(servers)
+        victim = next(s for s in servers if s is not leader)
+        vid = victim.raft_node.node_id
+        jobs = [mock.job() for _ in range(6)]
+        for j in jobs[:2]:
+            leader.job_register(j)
+        assert wait_until(lambda: victim.state.job_by_id(
+            "default", jobs[1].id) is not None, timeout=20)
+
+        faults.install({f"raft.follower.ack.{vid}":
+                        {"mode": "after", "n": 1, "times": 2}})
+        for j in jobs[2:]:
+            leader.job_register(j)      # commits via the OTHER follower
+        assert wait_until(
+            lambda: faults.fired(f"raft.follower.ack.{vid}") > 0,
+            timeout=10), "follower ack window never exercised"
+        faults.clear()
+
+        assert wait_until(lambda: all(
+            victim.state.job_by_id("default", j.id) is not None
+            for j in jobs), timeout=30), \
+            "follower never converged after dropped acks"
+        for j in jobs:      # exactly once: ONE registration per job
+            assert victim.state.job_by_id("default", j.id).version == 0
+    finally:
+        faults.clear()
+        for s in servers:
+            s.shutdown()
+
+
+def test_empty_heartbeats_never_fsync(tmp_path):
+    """Regression pin (ISSUE 20 satellite): batched replication must
+    not regress the heartbeat path — an empty AppendEntries keeps
+    followers warm without touching their disks. Several heartbeat
+    rounds of a quiet cluster move NO fsync counter on any node."""
+    net = VirtualNetwork(seed=61)
+    servers = _mk_cluster(3, net, tmp_path)
+    try:
+        leader = _stable_leader(servers)
+        leader.job_register(mock.job())
+        assert wait_until(lambda: all(
+            s.raft_node.commit_index == leader.raft_node.commit_index
+            for s in servers), timeout=20)
+        time.sleep(0.5)     # drain any in-flight appends
+        term = leader.raft_node.current_term
+        before = {s.raft_node.node_id: s.raft_node._durable.fsyncs
+                  for s in servers}
+        time.sleep(1.2)     # ≈8 heartbeat intervals at DISK timing
+        after = {s.raft_node.node_id: s.raft_node._durable.fsyncs
+                 for s in servers}
+        assert after == before, (
+            f"idle heartbeats hit the disk: {before} -> {after}")
+        # the heartbeats genuinely flowed: same leader, same term
+        assert leader.raft_node.is_leader()
+        assert leader.raft_node.current_term == term
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_batched_vs_serial_group_commit_differential(tmp_path,
+                                                     script_and_oracle):
+    """The group-commit knob at 1 is the serial oracle: the same script
+    driven through multi-entry batches and through one-entry batches
+    must ack identically and produce bit-identical FSMs (both equal to
+    the never-crashed module oracle)."""
+    ops, oracle_snaps = script_and_oracle
+
+    # leg 1 — batched: overlapping writers, deterministic enqueue order
+    net = VirtualNetwork(seed=63)
+    a = _mk_server(net, "s0", tmp_path / "batched", seed=1)
+    assert wait_until(lambda: a.raft_node.is_leader(), timeout=8)
+    _settle(a)
+    faults.install({"disk.fsync":
+                    {"mode": "delay", "delay_ms": 150, "times": -1}})
+    appends_before = a.raft_node._durable.appends
+    acked, _ = _drive_concurrent(a, ops)
+    appends_delta = a.raft_node._durable.appends - appends_before
+    faults.clear()
+    assert acked == list(range(len(ops)))
+    assert appends_delta < len(ops), (
+        f"no batching happened: {appends_delta} appends for "
+        f"{len(ops)} ops")
+    batched_snap = a.fsm.snapshot_bytes()
+    a.shutdown()
+
+    # leg 2 — serial: knob forced to 1, same ops in the same order
+    os.environ["NOMAD_RAFT_GROUP_COMMIT"] = "1"
+    try:
+        b = _mk_server(VirtualNetwork(seed=64), "s0",
+                       tmp_path / "serial", seed=1)
+        assert wait_until(lambda: b.raft_node.is_leader(), timeout=8)
+        for msg_type, payload in ops:
+            b.raft.apply(msg_type, _copy(payload), timeout=10.0)
+        serial_snap = b.fsm.snapshot_bytes()
+        b.shutdown()
+    finally:
+        os.environ.pop("NOMAD_RAFT_GROUP_COMMIT", None)
+
+    assert pickle.loads(batched_snap) == pickle.loads(serial_snap)
+    assert pickle.loads(batched_snap) == \
+        pickle.loads(oracle_snaps[len(ops)])
 
 
 def test_install_snapshot_persist_failure_is_retryable(tmp_path):

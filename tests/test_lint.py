@@ -1192,6 +1192,91 @@ def test_dur001_inline_suppression():
     assert rule_ids(src, "pkg/client/logs.py") == []
 
 
+# ------------------------------------------------------------------ DUR002
+
+DUR002_APPEND_IN_LOOP_BAD = """
+    def replicate(durable, entries, start):
+        for i, e in enumerate(entries):
+            durable.append(start + i, [e])
+"""
+
+DUR002_FSYNC_IN_LOOP_BAD = """
+    import os
+
+    def flush_all(fds):
+        while fds:
+            os.fsync(fds.pop())
+"""
+
+
+def test_dur002_fires_on_per_entry_durable_append():
+    out = findings(DUR002_APPEND_IN_LOOP_BAD, "pkg/server/thing.py")
+    assert [f.rule for f in out] == ["DUR002"]
+    assert "batched" in out[0].message
+
+
+def test_dur002_fires_on_fsync_in_loop():
+    out = findings(DUR002_FSYNC_IN_LOOP_BAD, "pkg/state/thing.py")
+    assert [f.rule for f in out] == ["DUR002"]
+
+
+def test_dur002_scoped_and_exempts_durable():
+    # out of scope: solver/, scheduler/
+    assert rule_ids(DUR002_APPEND_IN_LOOP_BAD, "pkg/solver/thing.py") == []
+    # durable.py OWNS the frame loop that a batched append amortizes
+    assert rule_ids(DUR002_FSYNC_IN_LOOP_BAD, "server/durable.py") == []
+
+
+def test_dur002_list_append_in_loop_is_quiet():
+    # plain container traffic: the receiver chain does not name a
+    # durable handle
+    src = """
+        def collect(entries):
+            frames = []
+            for e in entries:
+                frames.append(e)
+            return frames
+    """
+    assert rule_ids(src, "pkg/server/thing.py") == []
+
+
+def test_dur002_batched_call_outside_loop_is_quiet():
+    # the blessed shape: collect in the loop, ONE durable call after
+    src = """
+        def commit(durable, entries, start):
+            frames = []
+            for e in entries:
+                frames.append(e)
+            durable.append(start, frames)
+    """
+    assert rule_ids(src, "pkg/server/thing.py") == []
+
+
+def test_dur002_nested_def_is_its_own_clock():
+    # a closure DEFINED inside a loop runs on its own schedule — the
+    # loop does not multiply its durable call
+    src = """
+        def arm(durable, slots):
+            hooks = []
+            for s in slots:
+                def flush(start, frames):
+                    durable.append(start, frames)
+                hooks.append(flush)
+            return hooks
+    """
+    assert rule_ids(src, "pkg/server/thing.py") == []
+
+
+def test_dur002_inline_suppression():
+    src = """
+        def reprove(durable, entries):
+            for i, e in enumerate(entries):
+                # nomadlint: disable=DUR002 — recovery re-proves each
+                durable.append(i, [e])
+    """
+    assert rule_ids(src, "pkg/server/recovery.py") == []
+
+
 # ------------------------------------------------------------- tier-1 gate
 
 def test_nomadlint_gate_whole_tree():
